@@ -1,0 +1,27 @@
+"""photon-ml-tpu: a TPU-native framework for Generalized Linear Models and
+Generalized Additive Mixed Effect (GAME / GLMix) models.
+
+A ground-up JAX/XLA re-design of the capabilities of LinkedIn's photon-ml
+(Spark/Scala, reference layer map in SURVEY.md): GLM training (linear,
+logistic, Poisson regression and smoothed-hinge linear SVM) with LBFGS /
+OWL-QN / TRON optimizers, and GAME coordinate descent over fixed-effect,
+per-entity random-effect, and factored (matrix-factorization) coordinates.
+
+Design principles (TPU-first, not a port):
+  * all hot math is jit-compiled XLA: objectives are pure functions,
+    optimizers are ``lax.while_loop`` kernels with fixed-shape carried state;
+  * data parallelism = batch sharding over a ``jax.sharding.Mesh`` with
+    XLA-inserted (or explicit ``psum``) collectives — replacing Spark
+    ``treeAggregate``/``broadcast``;
+  * entity parallelism (random effects) = entities bucketed into padded
+    ``(entities, samples, dims)`` tensors sharded over the mesh, with the
+    local solver ``vmap``-ed across entities — replacing RDD joins;
+  * host-side ingest produces a deterministic, device-ready columnar layout —
+    replacing RDD lineage.
+"""
+
+from photon_ml_tpu.types import TaskType
+
+__version__ = "0.1.0"
+
+__all__ = ["TaskType", "__version__"]

@@ -1,0 +1,3 @@
+from photon_ml_tpu.data.sampler import down_sample_binary, down_sample_default
+
+__all__ = ["down_sample_binary", "down_sample_default"]

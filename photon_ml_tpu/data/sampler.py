@@ -1,0 +1,39 @@
+"""Down-sampling within a coordinate — weight/mask based, on device.
+
+Reference spec: sampler/BinaryClassificationDownSampler.scala:31-60
+(negatives kept with prob=rate, weight scaled by 1/rate) and
+sampler/DefaultDownSampler.scala:26-45 (uniform sample, weights unscaled...
+actually weight scaled by 1/rate for unbiasedness). On Spark this physically
+drops rows; on TPU shapes must stay static, so we *zero the weights* of
+dropped rows instead — mathematically identical for every objective in this
+framework (weight-0 rows contribute nothing) with no re-batching.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from photon_ml_tpu.ops.objective import GLMBatch
+
+Array = jax.Array
+
+
+def down_sample_binary(batch: GLMBatch, rate: float | Array, key: Array) -> GLMBatch:
+    """Keep all positives; keep negatives with probability ``rate`` and
+    re-weight survivors by 1/rate (unbiased gradient)."""
+    u = jax.random.uniform(key, batch.labels.shape)
+    is_positive = batch.labels > 0.5
+    keep = is_positive | (u < rate)
+    scale = jnp.where(is_positive, 1.0, 1.0 / rate)
+    new_w = jnp.where(keep, batch.weights * scale, 0.0)
+    return GLMBatch(batch.features, batch.labels, batch.offsets, new_w)
+
+
+def down_sample_default(batch: GLMBatch, rate: float | Array, key: Array) -> GLMBatch:
+    """Uniform down-sample: keep each row with probability ``rate``,
+    re-weight survivors by 1/rate."""
+    u = jax.random.uniform(key, batch.labels.shape)
+    keep = u < rate
+    new_w = jnp.where(keep, batch.weights / rate, 0.0)
+    return GLMBatch(batch.features, batch.labels, batch.offsets, new_w)

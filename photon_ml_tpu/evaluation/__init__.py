@@ -1,0 +1,17 @@
+from photon_ml_tpu.evaluation.evaluators import (
+    Evaluator,
+    EvaluatorType,
+    area_under_roc_curve,
+    evaluator_for,
+    precision_at_k,
+    rmse,
+)
+
+__all__ = [
+    "Evaluator",
+    "EvaluatorType",
+    "area_under_roc_curve",
+    "evaluator_for",
+    "precision_at_k",
+    "rmse",
+]

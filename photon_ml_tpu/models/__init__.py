@@ -1,0 +1,3 @@
+from photon_ml_tpu.models.glm import Coefficients, GeneralizedLinearModel
+
+__all__ = ["Coefficients", "GeneralizedLinearModel"]

@@ -1,0 +1,111 @@
+"""Generalized linear models: coefficients + per-task mean functions.
+
+Reference spec: model/Coefficients.scala:27-85 (means + optional variances,
+score = dot), supervised/model/GeneralizedLinearModel.scala:31-145 and task
+subclasses (LogisticRegressionModel sigmoid, LinearRegressionModel identity,
+PoissonRegressionModel exp, SmoothedHingeLossLinearSVMModel raw margin).
+
+TPU-native shape: a model is a pytree of device arrays; bulk scoring is the
+batched margin kernel from the objective module. Stacked models (a leading
+entity axis) represent whole random-effect model collections — the analogue
+of the reference's RDD[(entityId, GLM)] — and score under ``vmap``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from photon_ml_tpu.ops import losses as losses_mod
+from photon_ml_tpu.ops.normalization import NormalizationContext
+from photon_ml_tpu.ops.objective import GLMBatch
+from photon_ml_tpu.types import TaskType
+
+Array = jax.Array
+
+
+@jax.tree_util.register_pytree_node_class
+@dataclasses.dataclass
+class Coefficients:
+    """(means, optional variances) — Coefficients.scala:27 parity."""
+
+    means: Array  # (D,) — or (E, D) stacked per-entity
+    variances: Optional[Array] = None
+
+    @property
+    def dim(self) -> int:
+        return self.means.shape[-1]
+
+    def tree_flatten(self):
+        return (self.means, self.variances), None
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(*children)
+
+
+@jax.tree_util.register_pytree_node_class
+@dataclasses.dataclass
+class GeneralizedLinearModel:
+    """A trained GLM for one task type.
+
+    ``task`` is static (selects the mean function at trace time); the
+    coefficients are traced arrays so models flow through jit/vmap.
+    """
+
+    coefficients: Coefficients
+    task: TaskType = dataclasses.field(default=TaskType.LOGISTIC_REGRESSION,
+                                       metadata={"static": True})
+
+    # -- scoring ------------------------------------------------------------
+    def compute_margins(self, batch: GLMBatch,
+                        norm: Optional[NormalizationContext] = None) -> Array:
+        w = self.coefficients.means
+        if norm is not None and not norm.is_identity:
+            w_eff = norm.effective_coefficients(w)
+            return batch.features.matvec(w_eff) + norm.margin_shift(w_eff) + batch.offsets
+        return batch.features.matvec(w) + batch.offsets
+
+    def compute_mean_functions(self, batch: GLMBatch,
+                               norm: Optional[NormalizationContext] = None) -> Array:
+        """Mean prediction with offset (computeMeanFunctionWithOffset parity)."""
+        loss = losses_mod.for_task(self.task)
+        return loss.mean(self.compute_margins(batch, norm))
+
+    def predict_class(self, batch: GLMBatch, threshold: float = 0.5,
+                      norm: Optional[NormalizationContext] = None) -> Array:
+        """Binary classification (BinaryClassifier.predictClassWithThreshold).
+
+        Pass the training ``norm`` when the coefficients live in normalized
+        space (i.e. they were not back-transformed via
+        ``norm.model_to_original_space``).
+        """
+        if self.task not in (TaskType.LOGISTIC_REGRESSION,
+                             TaskType.SMOOTHED_HINGE_LOSS_LINEAR_SVM):
+            raise ValueError(f"{self.task} is not a classifier")
+        return (self.compute_mean_functions(batch, norm) > threshold).astype(jnp.float32)
+
+    def update_coefficients(self, coefficients: Coefficients) -> "GeneralizedLinearModel":
+        return GeneralizedLinearModel(coefficients, self.task)
+
+    # -- pytree protocol ----------------------------------------------------
+    def tree_flatten(self):
+        return (self.coefficients,), self.task
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(children[0], aux)
+
+    def summary(self) -> str:
+        m = self.means_as_numpy()
+        return (f"{self.task.value}: dim={m.shape[-1]} "
+                f"|w|_2={float(jnp.linalg.norm(self.coefficients.means)):.4g} "
+                f"nnz={int((m != 0).sum())}")
+
+    def means_as_numpy(self):
+        import numpy as np
+
+        return np.asarray(self.coefficients.means)

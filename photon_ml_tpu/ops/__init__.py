@@ -1,0 +1,15 @@
+from photon_ml_tpu.ops import losses
+from photon_ml_tpu.ops.features import DenseFeatures, SparseFeatures
+from photon_ml_tpu.ops.normalization import NormalizationContext
+from photon_ml_tpu.ops.objective import GLMBatch, GLMObjective
+from photon_ml_tpu.ops.regularization import RegularizationContext
+
+__all__ = [
+    "losses",
+    "DenseFeatures",
+    "SparseFeatures",
+    "NormalizationContext",
+    "GLMBatch",
+    "GLMObjective",
+    "RegularizationContext",
+]

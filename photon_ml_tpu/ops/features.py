@@ -1,0 +1,149 @@
+"""Feature-matrix abstractions for TPU-friendly GLM math.
+
+The reference (photon-ml) stores each example as a Breeze sparse/dense vector
+and loops per-datum inside Spark partitions (ValueAndGradientAggregator.add).
+On TPU the same math must be *batched*: the whole (sub-)batch participates in
+one fused matmul / gather so the MXU sees large contractions.
+
+Two layouts:
+
+  * ``DenseFeatures``  — an ``(N, D)`` dense matrix. The fast path whenever
+    the (possibly projected) feature dimension is modest. All four GLM
+    kernels (margin, X^T d, Hessian-vector, Hessian diagonal) are matmuls.
+
+  * ``SparseFeatures`` — padded per-row COO: ``indices (N, K)`` into the
+    feature axis plus ``values (N, K)``, with out-of-row slots pointing at a
+    dedicated padding column. Margin is a gather + row-sum; the transpose
+    action is a scatter-add. This handles photon-ml's wide-sparse regime
+    (millions of features, few non-zeros per row) without materializing
+    ``(N, D)``.
+
+Both expose the same protocol so the objective is layout-agnostic:
+
+  matvec(w)        -> X @ w                      shape (N,)
+  rmatvec(d)       -> X^T @ d                    shape (D,)
+  sq_rmatvec(d)    -> (X*X)^T @ d                shape (D,)  (Hessian diag)
+  col_stats()      -> per-column summary helpers used by normalization
+
+Reference behavior spec: function/ValueAndGradientAggregator.scala:87-139,
+HessianVectorAggregator.scala:90-116 (re-derived algebra, batched here).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Union
+
+import jax
+import jax.numpy as jnp
+
+Array = jax.Array
+
+
+@jax.tree_util.register_pytree_node_class
+@dataclasses.dataclass
+class DenseFeatures:
+    """Dense (N, D) feature matrix."""
+
+    matrix: Array  # (N, D)
+
+    @property
+    def num_rows(self) -> int:
+        return self.matrix.shape[0]
+
+    @property
+    def dim(self) -> int:
+        return self.matrix.shape[1]
+
+    def matvec(self, w: Array) -> Array:
+        return self.matrix @ w
+
+    def rmatvec(self, d: Array) -> Array:
+        return d @ self.matrix
+
+    def sq_rmatvec(self, d: Array) -> Array:
+        return d @ jnp.square(self.matrix)
+
+    def row_sq_norms(self) -> Array:
+        return jnp.sum(jnp.square(self.matrix), axis=-1)
+
+    def to_dense(self) -> Array:
+        return self.matrix
+
+    # -- pytree protocol ----------------------------------------------------
+    def tree_flatten(self):
+        return (self.matrix,), None
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(*children)
+
+
+@jax.tree_util.register_pytree_node_class
+@dataclasses.dataclass
+class SparseFeatures:
+    """Padded per-row sparse features.
+
+    ``indices``/``values`` have shape (N, K) where K is the max non-zeros per
+    row in the batch. Padding slots carry ``values == 0`` and any valid index
+    (conventionally 0) so gathers stay in-bounds and scatter-adds of zero are
+    no-ops.
+    """
+
+    indices: Array  # (N, K) int32
+    values: Array  # (N, K)
+    dim: int = dataclasses.field(metadata={"static": True})
+
+    @property
+    def num_rows(self) -> int:
+        return self.indices.shape[0]
+
+    def matvec(self, w: Array) -> Array:
+        return jnp.sum(w[self.indices] * self.values, axis=-1)
+
+    def rmatvec(self, d: Array) -> Array:
+        contrib = self.values * d[:, None]  # (N, K)
+        return jnp.zeros((self.dim,), contrib.dtype).at[self.indices.reshape(-1)].add(
+            contrib.reshape(-1)
+        )
+
+    def sq_rmatvec(self, d: Array) -> Array:
+        contrib = jnp.square(self.values) * d[:, None]
+        return jnp.zeros((self.dim,), contrib.dtype).at[self.indices.reshape(-1)].add(
+            contrib.reshape(-1)
+        )
+
+    def row_sq_norms(self) -> Array:
+        return jnp.sum(jnp.square(self.values), axis=-1)
+
+    def to_dense(self) -> Array:
+        n, k = self.indices.shape
+        out = jnp.zeros((n, self.dim), self.values.dtype)
+        rows = jnp.broadcast_to(jnp.arange(n)[:, None], (n, k))
+        return out.at[rows.reshape(-1), self.indices.reshape(-1)].add(self.values.reshape(-1))
+
+    # -- pytree protocol ----------------------------------------------------
+    def tree_flatten(self):
+        return (self.indices, self.values), self.dim
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(children[0], children[1], aux)
+
+
+Features = Union[DenseFeatures, SparseFeatures]
+
+
+def from_scipy_like(rows, dim: int, dtype=jnp.float32) -> SparseFeatures:
+    """Build SparseFeatures from a list of (indices, values) per row (host)."""
+    import numpy as np
+
+    n = len(rows)
+    k = max((len(ix) for ix, _ in rows), default=1)
+    k = max(k, 1)
+    indices = np.zeros((n, k), np.int32)
+    values = np.zeros((n, k), np.float32)
+    for i, (ix, vs) in enumerate(rows):
+        indices[i, : len(ix)] = ix
+        values[i, : len(vs)] = vs
+    return SparseFeatures(jnp.asarray(indices), jnp.asarray(values, dtype), dim)

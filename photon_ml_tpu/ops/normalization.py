@@ -1,0 +1,126 @@
+"""Feature normalization, folded algebraically into the objective.
+
+The reference never materializes normalized data: the aggregators fold the
+(factor, shift) transform into the coefficient vector —
+``effectiveCoef = coef * factor``, ``marginShift = -effectiveCoef . shift`` —
+so the raw data is touched once per pass (ValueAndGradientAggregator.scala:
+87-113, NormalizationContext.scala:41-163). We keep exactly that trick: it is
+even more valuable on TPU because it preserves the sparse/dense layout of X
+and keeps normalization out of the hot matmul.
+
+Semantics: a normalized example is ``x' = (x - shift) * factor`` (shift
+optional, factor optional), with the intercept column (if any) exempt from
+both.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from photon_ml_tpu.types import NormalizationType
+
+Array = jax.Array
+
+
+@jax.tree_util.register_pytree_node_class
+@dataclasses.dataclass
+class NormalizationContext:
+    """(factors, shifts) pair; either may be None (= identity).
+
+    ``intercept_id`` (static) marks the intercept column: its factor is 1 and
+    shift is 0 by construction in the factory methods.
+    """
+
+    factors: Optional[Array]  # (D,) or None
+    shifts: Optional[Array]  # (D,) or None
+    intercept_id: Optional[int] = dataclasses.field(default=None, metadata={"static": True})
+
+    # -- coefficient-space transforms ---------------------------------------
+    def model_to_original_space(self, w: Array) -> Array:
+        """Map coefficients trained in normalized space back to raw space.
+
+        If z' = x'.w with x' = (x - shift)*factor then in raw space
+        w_raw = w * factor and intercept absorbs -sum(w*factor*shift).
+        Mirrors NormalizationContext.scala:72-90.
+        """
+        out = w * self.factors if self.factors is not None else w
+        if self.shifts is not None:
+            if self.intercept_id is None:
+                raise ValueError("shift normalization requires an intercept column")
+            out = out.at[self.intercept_id].add(-jnp.sum(out * self.shifts))
+        return out
+
+    def effective_coefficients(self, w: Array) -> Array:
+        return w * self.factors if self.factors is not None else w
+
+    def margin_shift(self, w_eff: Array) -> Array:
+        if self.shifts is None:
+            return jnp.zeros((), w_eff.dtype)
+        return -jnp.sum(w_eff * self.shifts)
+
+    @property
+    def is_identity(self) -> bool:
+        return self.factors is None and self.shifts is None
+
+    # -- factories (from per-column summary stats) --------------------------
+    @staticmethod
+    def identity() -> "NormalizationContext":
+        return NormalizationContext(None, None, None)
+
+    @staticmethod
+    def build(
+        norm_type: NormalizationType,
+        *,
+        mean: Optional[Array] = None,
+        std: Optional[Array] = None,
+        max_magnitude: Optional[Array] = None,
+        intercept_id: Optional[int] = None,
+    ) -> "NormalizationContext":
+        """Factory mirroring NormalizationContext.scala:109-160."""
+
+        def _protect(v):
+            # zero-variance / zero-magnitude columns get factor 1
+            return jnp.where(v == 0.0, 1.0, v)
+
+        def _except_intercept(arr, fill):
+            if intercept_id is not None and arr is not None:
+                arr = arr.at[intercept_id].set(fill)
+            return arr
+
+        if norm_type == NormalizationType.NONE:
+            return NormalizationContext(None, None, intercept_id)
+        if norm_type == NormalizationType.SCALE_WITH_MAX_MAGNITUDE:
+            if max_magnitude is None:
+                raise ValueError("SCALE_WITH_MAX_MAGNITUDE requires max_magnitude")
+            f = 1.0 / _protect(max_magnitude)
+            return NormalizationContext(_except_intercept(f, 1.0), None, intercept_id)
+        if norm_type == NormalizationType.SCALE_WITH_STANDARD_DEVIATION:
+            if std is None:
+                raise ValueError("SCALE_WITH_STANDARD_DEVIATION requires std")
+            f = 1.0 / _protect(std)
+            return NormalizationContext(_except_intercept(f, 1.0), None, intercept_id)
+        if norm_type == NormalizationType.STANDARDIZATION:
+            if std is None or mean is None:
+                raise ValueError("STANDARDIZATION requires mean and std")
+            if intercept_id is None:
+                raise ValueError(
+                    "STANDARDIZATION requires an intercept column "
+                    "(NormalizationContext.scala:150-156 parity)"
+                )
+            f = 1.0 / _protect(std)
+            return NormalizationContext(
+                _except_intercept(f, 1.0), _except_intercept(mean, 0.0), intercept_id
+            )
+        raise ValueError(f"unknown normalization type {norm_type}")
+
+    # -- pytree protocol ----------------------------------------------------
+    def tree_flatten(self):
+        return (self.factors, self.shifts), self.intercept_id
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(children[0], children[1], aux)

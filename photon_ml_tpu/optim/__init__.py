@@ -1,0 +1,5 @@
+from photon_ml_tpu.optim.common import OptimizerConfig, OptResult
+from photon_ml_tpu.optim.lbfgs import lbfgs_minimize
+from photon_ml_tpu.optim.tron import tron_minimize
+
+__all__ = ["OptimizerConfig", "OptResult", "lbfgs_minimize", "tron_minimize"]

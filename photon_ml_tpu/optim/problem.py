@@ -1,0 +1,136 @@
+"""GLM optimization problems: couple an objective + optimizer + regularization.
+
+Reference spec: optimization/GeneralizedLinearOptimizationProblem.scala:42-279
+(run/updateObjective/variance) and the per-task problem factories
+(LogisticRegressionOptimizationProblem.scala etc.): LBFGS accepts any
+once-differentiable loss (L1/elastic-net switches to OWL-QN); TRON requires a
+twice-differentiable loss and rejects L1 (OptimizerFactory.scala:49-70,
+Params.scala:177-180); smoothed-hinge SVM is first-order only.
+
+TPU-native: the problem is a thin static config whose ``run`` builds pure
+closures over a batch and dispatches to the while_loop kernels. The
+regularization weight is a *traced* scalar so a lambda-grid sweep reuses one
+compiled kernel. Variances = 1 / diag(Hessian) as in the reference
+(:109-124 of the per-task problems).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from photon_ml_tpu.models.glm import Coefficients, GeneralizedLinearModel
+from photon_ml_tpu.ops import losses as losses_mod
+from photon_ml_tpu.ops.normalization import NormalizationContext
+from photon_ml_tpu.ops.objective import GLMBatch, GLMObjective
+from photon_ml_tpu.optim.common import OptimizerConfig, OptResult
+from photon_ml_tpu.optim.lbfgs import lbfgs_minimize_
+from photon_ml_tpu.optim.tron import tron_minimize_
+from photon_ml_tpu.ops.regularization import RegularizationContext
+from photon_ml_tpu.types import OptimizerType, RegularizationType, TaskType
+
+Array = jax.Array
+
+
+def _split_reg_weight(reg: RegularizationContext, reg_weight):
+    """Split a total regularization weight into (l1, l2) per the context's
+    type; ``reg_weight=None`` uses the context's own weight."""
+    if reg_weight is None:
+        return reg.l1_weight, reg.l2_weight
+    if reg.reg_type == RegularizationType.L1:
+        return reg_weight, 0.0
+    if reg.reg_type == RegularizationType.L2:
+        return 0.0, reg_weight
+    if reg.reg_type == RegularizationType.ELASTIC_NET:
+        a = reg.elastic_net_alpha
+        return a * reg_weight, (1.0 - a) * reg_weight
+    return 0.0, 0.0
+
+
+@dataclasses.dataclass(frozen=True)
+class GLMOptimizationProblem:
+    """Static problem description; ``run`` is pure and jit/vmap-composable."""
+
+    task: TaskType
+    optimizer: OptimizerType = OptimizerType.LBFGS
+    # None -> per-optimizer reference defaults (LBFGS 80/1e-7, TRON 15/1e-5)
+    optimizer_config: Optional[OptimizerConfig] = None
+    regularization: RegularizationContext = dataclasses.field(
+        default_factory=RegularizationContext.none
+    )
+    compute_variance: bool = False
+    axis_name: Optional[str] = None  # set under shard_map for psum reductions
+
+    def __post_init__(self):
+        if self.optimizer_config is None:
+            cfg = (
+                OptimizerConfig.tron_default()
+                if self.optimizer == OptimizerType.TRON
+                else OptimizerConfig.lbfgs_default()
+            )
+            object.__setattr__(self, "optimizer_config", cfg)
+        loss = losses_mod.for_task(self.task)
+        if self.optimizer == OptimizerType.TRON:
+            if not loss.twice_differentiable:
+                raise ValueError(
+                    f"TRON requires a twice-differentiable loss; {self.task} is first-order "
+                    "only (OptimizerFactory.scala:49-70 parity)"
+                )
+            if self.regularization.reg_type in (
+                RegularizationType.L1,
+                RegularizationType.ELASTIC_NET,
+            ):
+                raise ValueError(
+                    "TRON does not support L1/ELASTIC_NET regularization "
+                    "(Params.scala:177-180 parity)"
+                )
+
+    @property
+    def objective(self) -> GLMObjective:
+        return GLMObjective(losses_mod.for_task(self.task), self.axis_name)
+
+    # ------------------------------------------------------------------
+    def run(
+        self,
+        batch: GLMBatch,
+        norm: NormalizationContext,
+        init_coefficients: Optional[Array] = None,
+        reg_weight: Optional[Array] = None,
+    ) -> Tuple[GeneralizedLinearModel, OptResult]:
+        """Solve; returns (model, solve result). Pure — jit/vmap freely.
+
+        ``reg_weight`` overrides the context's total weight (traced scalar,
+        the updateObjective analogue for lambda sweeps).
+        """
+        obj = self.objective
+        l1, l2 = _split_reg_weight(self.regularization, reg_weight)
+
+        w0 = (
+            init_coefficients
+            if init_coefficients is not None
+            else jnp.zeros((batch.dim,), jnp.float32)
+        )
+        vg = lambda w: obj.value_and_grad(w, batch, norm, l2)
+
+        if self.optimizer == OptimizerType.TRON:
+            hvp = lambda w, v: obj.hessian_vector(w, v, batch, norm, l2)
+            result = tron_minimize_(vg, hvp, w0, self.optimizer_config)
+        else:
+            result = lbfgs_minimize_(vg, w0, self.optimizer_config, l1_weight=l1)
+
+        w = result.coefficients
+        variances = None
+        if self.compute_variance:
+            diag = obj.hessian_diagonal(w, batch, norm, l2)
+            variances = 1.0 / jnp.maximum(diag, 1e-12)
+        model = GeneralizedLinearModel(Coefficients(w, variances), self.task)
+        return model, result
+
+    # ------------------------------------------------------------------
+    def regularization_term_value(self, w: Array, reg_weight: Optional[Array] = None) -> Array:
+        """lambda_1 * ||w||_1 + lambda_2/2 * ||w||^2 (GLOP.scala:235-278)."""
+        l1, l2 = _split_reg_weight(self.regularization, reg_weight)
+        return l1 * jnp.sum(jnp.abs(w)) + 0.5 * l2 * jnp.sum(jnp.square(w))

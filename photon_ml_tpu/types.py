@@ -1,0 +1,70 @@
+"""Core enums and type aliases.
+
+Reference parity: TaskType mirrors supervised/TaskType.scala:28 of photon-ml;
+OptimizerType mirrors optimization/OptimizerType.scala; RegularizationType
+mirrors optimization/RegularizationType.scala; NormalizationType mirrors
+normalization/NormalizationType.scala.
+"""
+
+from __future__ import annotations
+
+import enum
+
+
+class TaskType(enum.Enum):
+    LINEAR_REGRESSION = "LINEAR_REGRESSION"
+    POISSON_REGRESSION = "POISSON_REGRESSION"
+    LOGISTIC_REGRESSION = "LOGISTIC_REGRESSION"
+    SMOOTHED_HINGE_LOSS_LINEAR_SVM = "SMOOTHED_HINGE_LOSS_LINEAR_SVM"
+
+
+class OptimizerType(enum.Enum):
+    LBFGS = "LBFGS"
+    TRON = "TRON"
+
+
+class RegularizationType(enum.Enum):
+    NONE = "NONE"
+    L1 = "L1"
+    L2 = "L2"
+    ELASTIC_NET = "ELASTIC_NET"
+
+
+class NormalizationType(enum.Enum):
+    NONE = "NONE"
+    SCALE_WITH_MAX_MAGNITUDE = "SCALE_WITH_MAX_MAGNITUDE"
+    SCALE_WITH_STANDARD_DEVIATION = "SCALE_WITH_STANDARD_DEVIATION"
+    STANDARDIZATION = "STANDARDIZATION"
+
+
+class DataValidationType(enum.Enum):
+    VALIDATE_FULL = "VALIDATE_FULL"
+    VALIDATE_SAMPLE = "VALIDATE_SAMPLE"
+    VALIDATE_DISABLED = "VALIDATE_DISABLED"
+
+
+class ConvergenceReason(enum.IntEnum):
+    """Why an optimizer stopped (AbstractOptimizer.scala:47-61 parity).
+
+    Integer-coded so it can live inside jitted carried state.
+    """
+
+    NOT_CONVERGED = 0
+    MAX_ITERATIONS = 1
+    FUNCTION_VALUES_CONVERGED = 2
+    GRADIENT_CONVERGED = 3
+    OBJECTIVE_NOT_IMPROVING = 4
+
+
+class ModelOutputMode(enum.Enum):
+    ALL = "ALL"
+    BEST = "BEST"
+    NONE = "NONE"
+
+
+class ProjectorType(enum.Enum):
+    """projector/ProjectorType.scala:22-30 parity."""
+
+    RANDOM = "RANDOM"
+    INDEX_MAP = "INDEX_MAP"
+    IDENTITY = "IDENTITY"

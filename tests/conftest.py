@@ -1,0 +1,37 @@
+"""Test configuration: run everything on a virtual 8-device CPU mesh.
+
+Mirrors the reference's strategy of testing "distributed" behavior on a
+local multi-threaded context (SparkTestUtils.sparkTest with master=local[4]):
+we force 8 virtual CPU devices so mesh/sharding/collective paths are
+exercised without TPU hardware.
+"""
+
+import os
+
+# Hard-set (not setdefault): the environment pre-sets JAX_PLATFORMS=axon (the
+# real TPU tunnel, single-client) which must never be touched by unit tests.
+# A sitecustomize pre-imports jax before this file runs, so the env var alone
+# is too late — update jax.config directly (backends are not yet initialized).
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ.setdefault("JAX_ENABLE_X64", "0")
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+assert jax.devices()[0].platform == "cpu", "tests must not touch the TPU tunnel"
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def devices():
+    return jax.devices()
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(20260729)

@@ -1,0 +1,67 @@
+"""Pointwise loss unit tests: analytic values + derivative consistency."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from photon_ml_tpu.ops import losses
+
+
+ALL = [losses.logistic, losses.squared, losses.poisson, losses.smoothed_hinge]
+
+
+@pytest.mark.parametrize("loss", ALL, ids=lambda l: l.name)
+def test_d1_matches_autodiff(loss):
+    # grid avoids z=0 / t∈{0,1} kinks where autodiff picks an arbitrary subgradient
+    z = jnp.linspace(-4.0, 4.0, 41) + 0.0123
+    for y in (0.0, 1.0):
+        yv = jnp.full_like(z, y)
+        want = jax.vmap(jax.grad(lambda zz, yy: loss.loss(zz, yy)))(z, yv)
+        got = loss.d1(z, yv)
+        np.testing.assert_allclose(got, want, atol=1e-5)
+
+
+@pytest.mark.parametrize("loss", [losses.logistic, losses.squared, losses.poisson])
+def test_d2_matches_autodiff(loss):
+    z = jnp.linspace(-4.0, 4.0, 41) + 0.0123
+    for y in (0.0, 1.0, 3.0):
+        yv = jnp.full_like(z, y)
+        want = jax.vmap(jax.grad(jax.grad(lambda zz, yy: loss.loss(zz, yy))))(z, yv)
+        np.testing.assert_allclose(loss.d2(z, yv), want, atol=1e-5)
+
+
+def test_logistic_stability():
+    # No overflow at extreme margins; loss(z,1) -> 0 as z -> +inf
+    z = jnp.array([-500.0, -50.0, 0.0, 50.0, 500.0])
+    y1 = jnp.ones_like(z)
+    v = losses.logistic.loss(z, y1)
+    assert np.all(np.isfinite(v))
+    np.testing.assert_allclose(v[-1], 0.0, atol=1e-6)
+    np.testing.assert_allclose(losses.logistic.loss(z, jnp.zeros_like(z))[0], 0.0, atol=1e-6)
+    np.testing.assert_allclose(losses.logistic.loss(jnp.array([0.0]), jnp.array([0.0]))[0],
+                               np.log(2.0), rtol=1e-6)
+
+
+def test_squared_values():
+    np.testing.assert_allclose(losses.squared.loss(jnp.array([3.0]), jnp.array([1.0]))[0], 2.0)
+
+
+def test_poisson_values():
+    z, y = jnp.array([0.5]), jnp.array([2.0])
+    np.testing.assert_allclose(losses.poisson.loss(z, y)[0], np.exp(0.5) - 1.0, rtol=1e-5)
+
+
+def test_smoothed_hinge_piecewise():
+    # t = (2y-1)z; y=1 -> t=z. Regions: z<=0: 0.5-z; 0<z<1: (1-z)^2/2; z>=1: 0
+    y = jnp.ones((5,))
+    z = jnp.array([-1.0, 0.0, 0.5, 1.0, 2.0])
+    want = np.array([1.5, 0.5, 0.125, 0.0, 0.0])
+    np.testing.assert_allclose(losses.smoothed_hinge.loss(z, y), want, atol=1e-6)
+
+
+def test_for_task_lookup():
+    from photon_ml_tpu.types import TaskType
+
+    assert losses.for_task(TaskType.LOGISTIC_REGRESSION) is losses.logistic
+    assert losses.for_task("LINEAR_REGRESSION") is losses.squared

@@ -1,0 +1,172 @@
+"""Optimizer kernel tests on analytic objectives (reference OptimizerIntegTest
+/ IntegTestObjective strategy: known minima, statistical assertions) plus
+cross-checks against scipy and closed forms, plus vmap batching.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+import scipy.optimize
+
+from photon_ml_tpu.ops import losses
+from photon_ml_tpu.ops.features import DenseFeatures
+from photon_ml_tpu.ops.normalization import NormalizationContext
+from photon_ml_tpu.ops.objective import GLMBatch, GLMObjective
+from photon_ml_tpu.optim import OptimizerConfig, lbfgs_minimize, tron_minimize
+from photon_ml_tpu.optim.lbfgs import lbfgs_minimize_
+from photon_ml_tpu.types import ConvergenceReason
+
+
+def quadratic(A, b):
+    """f(w) = 0.5 w^T A w - b^T w; minimum at A^{-1} b."""
+
+    def vg(w):
+        g = A @ w - b
+        return 0.5 * jnp.dot(w, A @ w) - jnp.dot(b, w), g
+
+    return vg
+
+
+def make_spd(rng, d, cond=50.0):
+    q, _ = np.linalg.qr(rng.normal(size=(d, d)))
+    eig = np.geomspace(1.0, cond, d)
+    return (q * eig) @ q.T
+
+
+def test_lbfgs_quadratic_exact(rng):
+    d = 12
+    A = jnp.asarray(make_spd(rng, d), jnp.float32)
+    b = jnp.asarray(rng.normal(size=d), jnp.float32)
+    res = lbfgs_minimize(quadratic(A, b), jnp.zeros(d, jnp.float32),
+                         OptimizerConfig(max_iterations=100, tolerance=1e-7))
+    w_star = jnp.linalg.solve(A, b)
+    np.testing.assert_allclose(res.coefficients, w_star, rtol=1e-3, atol=1e-3)
+    assert int(res.reason) in (ConvergenceReason.GRADIENT_CONVERGED,
+                               ConvergenceReason.FUNCTION_VALUES_CONVERGED)
+
+
+def test_tron_quadratic_exact(rng):
+    d = 12
+    A = jnp.asarray(make_spd(rng, d), jnp.float32)
+    b = jnp.asarray(rng.normal(size=d), jnp.float32)
+    vg = quadratic(A, b)
+    res = tron_minimize(vg, lambda w, v: A @ v, jnp.zeros(d, jnp.float32),
+                        OptimizerConfig(max_iterations=50, tolerance=1e-6))
+    w_star = jnp.linalg.solve(A, b)
+    np.testing.assert_allclose(res.coefficients, w_star, rtol=1e-3, atol=1e-3)
+
+
+def make_logreg(rng, n=200, d=8, l2=1e-2):
+    x = rng.normal(size=(n, d)).astype(np.float32)
+    w_true = rng.normal(size=d).astype(np.float32)
+    y = (1.0 / (1.0 + np.exp(-x @ w_true)) > rng.random(n)).astype(np.float32)
+    batch = GLMBatch.create(DenseFeatures(jnp.asarray(x)), jnp.asarray(y))
+    obj = GLMObjective(losses.logistic)
+    norm = NormalizationContext.identity()
+    vg = lambda w: obj.value_and_grad(w, batch, norm, l2)
+    hvp = lambda w, v: obj.hessian_vector(w, v, batch, norm, l2)
+    # scipy ground truth (float64)
+    def f64(w):
+        z = x.astype(np.float64) @ w
+        val = np.sum(np.maximum(z, 0) + np.log1p(np.exp(-np.abs(z))) - y * z)
+        return val + 0.5 * l2 * np.sum(w * w)
+    ref = scipy.optimize.minimize(f64, np.zeros(d), method="L-BFGS-B",
+                                  options={"maxiter": 500, "ftol": 1e-14, "gtol": 1e-10})
+    return vg, hvp, jnp.asarray(ref.x, jnp.float32), d
+
+
+def test_lbfgs_logistic_vs_scipy(rng):
+    vg, _, w_ref, d = make_logreg(rng)
+    res = lbfgs_minimize(vg, jnp.zeros(d, jnp.float32),
+                         OptimizerConfig(max_iterations=200, tolerance=1e-7))
+    np.testing.assert_allclose(res.coefficients, w_ref, rtol=2e-2, atol=2e-2)
+
+
+def test_tron_logistic_vs_scipy(rng):
+    vg, hvp, w_ref, d = make_logreg(rng)
+    res = tron_minimize(vg, hvp, jnp.zeros(d, jnp.float32),
+                        OptimizerConfig(max_iterations=30, tolerance=1e-6))
+    np.testing.assert_allclose(res.coefficients, w_ref, rtol=2e-2, atol=2e-2)
+
+
+def test_owlqn_lasso_closed_form(rng):
+    """min 0.5||w - b||^2 + l1*||w||_1 has solution soft_threshold(b, l1)."""
+    d = 16
+    b = jnp.asarray(rng.normal(size=d).astype(np.float32)) * 2.0
+    l1 = 0.8
+    vg = lambda w: (0.5 * jnp.sum((w - b) ** 2), w - b)
+    res = lbfgs_minimize(vg, jnp.zeros(d, jnp.float32),
+                         OptimizerConfig(max_iterations=200, tolerance=1e-8), l1_weight=l1)
+    want = jnp.sign(b) * jnp.maximum(jnp.abs(b) - l1, 0.0)
+    np.testing.assert_allclose(res.coefficients, want, rtol=1e-3, atol=1e-3)
+    # sparsity: exact zeros, not merely small values
+    assert np.sum(np.asarray(res.coefficients) == 0.0) == np.sum(np.abs(np.asarray(b)) <= l1)
+
+
+def test_owlqn_elastic_net_logistic_sparsity(rng):
+    n, d = 300, 20
+    x = rng.normal(size=(n, d)).astype(np.float32)
+    w_true = np.zeros(d, np.float32)
+    w_true[:3] = [2.0, -2.0, 1.5]  # only 3 informative features
+    y = (1.0 / (1.0 + np.exp(-x @ w_true)) > rng.random(n)).astype(np.float32)
+    batch = GLMBatch.create(DenseFeatures(jnp.asarray(x)), jnp.asarray(y))
+    obj = GLMObjective(losses.logistic)
+    norm = NormalizationContext.identity()
+    vg = lambda w: obj.value_and_grad(w, batch, norm, 0.0)
+    res = lbfgs_minimize(vg, jnp.zeros(d, jnp.float32),
+                         OptimizerConfig(max_iterations=200, tolerance=1e-7), l1_weight=10.0)
+    w = np.asarray(res.coefficients)
+    assert np.sum(w != 0.0) <= 10  # strong L1 produces real sparsity
+    assert np.abs(w[0]) > 0 and np.abs(w[1]) > 0  # informative features survive
+    assert w[0] > 0 and w[1] < 0
+
+
+def test_lbfgs_vmap_batched_solves(rng):
+    """vmap over independent problems — the GAME random-effect pattern."""
+    E, d = 5, 6
+    As = jnp.asarray(np.stack([make_spd(rng, d) for _ in range(E)]), jnp.float32)
+    bs = jnp.asarray(rng.normal(size=(E, d)), jnp.float32)
+    cfg = OptimizerConfig(max_iterations=80, tolerance=1e-7)
+
+    def solve_one(A, b):
+        return lbfgs_minimize_(quadratic(A, b), jnp.zeros(d, jnp.float32), cfg).coefficients
+
+    ws = jax.jit(jax.vmap(solve_one))(As, bs)
+    want = jnp.linalg.solve(As, bs[..., None])[..., 0]
+    np.testing.assert_allclose(ws, want, rtol=5e-3, atol=5e-3)
+
+
+def test_poisson_tron(rng):
+    n, d = 150, 5
+    x = (rng.normal(size=(n, d)) * 0.5).astype(np.float32)
+    w_true = (rng.normal(size=d) * 0.5).astype(np.float32)
+    lam = np.exp(x @ w_true)
+    y = rng.poisson(lam).astype(np.float32)
+    batch = GLMBatch.create(DenseFeatures(jnp.asarray(x)), jnp.asarray(y))
+    obj = GLMObjective(losses.poisson)
+    norm = NormalizationContext.identity()
+    l2 = 1e-3
+    vg = lambda w: obj.value_and_grad(w, batch, norm, l2)
+    hvp = lambda w, v: obj.hessian_vector(w, v, batch, norm, l2)
+    res = tron_minimize(vg, hvp, jnp.zeros(d, jnp.float32),
+                        OptimizerConfig(max_iterations=50, tolerance=1e-6))
+    def f64(w):
+        z = x.astype(np.float64) @ w
+        return np.sum(np.exp(z) - y * z) + 0.5 * l2 * np.sum(w * w)
+    ref = scipy.optimize.minimize(f64, np.zeros(d), method="L-BFGS-B",
+                                  options={"maxiter": 500, "ftol": 1e-14, "gtol": 1e-10})
+    np.testing.assert_allclose(res.coefficients, ref.x.astype(np.float32), rtol=3e-2, atol=3e-2)
+
+
+def test_state_tracking(rng):
+    d = 8
+    A = jnp.asarray(make_spd(rng, d), jnp.float32)
+    b = jnp.asarray(rng.normal(size=d), jnp.float32)
+    res = lbfgs_minimize(quadratic(A, b), jnp.zeros(d, jnp.float32),
+                         OptimizerConfig(max_iterations=60, tolerance=1e-7))
+    it = int(res.iterations)
+    vals = np.asarray(res.value_history)[: it + 1]
+    assert np.all(np.isfinite(vals))
+    assert vals[-1] <= vals[0]  # monotone-ish improvement overall
+    assert np.all(np.isnan(np.asarray(res.value_history)[it + 1:]))
