@@ -1,0 +1,92 @@
+"""Benchmark driver: GLM training throughput on the current accelerator.
+
+Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+
+Workload: L2 logistic regression value+gradient passes (the hot loop of GLM
+training — the reference's ValueAndGradientAggregator treeAggregate,
+SURVEY.md §2.2) on a synthetic dense dataset sized like a realistic ads/feed
+shard: N=262144 examples x D=512 features, bf16 matmul inputs with f32
+accumulation semantics via XLA default.
+
+``vs_baseline``: the reference publishes no numbers (BASELINE.md), so the
+baseline is a single-host NumPy implementation of the identical computation
+measured in-process (a stand-in for the reference's JVM/Breeze per-partition
+CPU path, which it bounds from above). Values > 1 mean faster than baseline.
+"""
+
+import json
+import sys
+import time
+
+import numpy as np
+
+
+def _numpy_baseline(x, y, w, iters=3):
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        z = x @ w
+        s = 1.0 / (1.0 + np.exp(-z))
+        val = np.sum(np.maximum(z, 0) + np.log1p(np.exp(-np.abs(z))) - y * z)
+        g = (s - y) @ x
+        g = g + 0.1 * w
+        val = val + 0.05 * np.sum(w * w)
+    dt = (time.perf_counter() - t0) / iters
+    return x.shape[0] / dt, float(val), g
+
+
+def main():
+    n, d = 262144, 512
+    rng = np.random.default_rng(0)
+    x_h = rng.normal(size=(n, d)).astype(np.float32)
+    w_true = rng.normal(size=d).astype(np.float32) * 0.1
+    y_h = (1.0 / (1.0 + np.exp(-x_h @ w_true)) > rng.random(n)).astype(np.float32)
+
+    base_eps, _, _ = _numpy_baseline(x_h, y_h, np.zeros(d, np.float32))
+
+    import jax
+    import jax.numpy as jnp
+
+    from photon_ml_tpu.ops import losses
+    from photon_ml_tpu.ops.features import DenseFeatures
+    from photon_ml_tpu.ops.normalization import NormalizationContext
+    from photon_ml_tpu.ops.objective import GLMBatch, GLMObjective
+
+    dev = jax.devices()[0]
+    print(f"device: {dev} ({dev.platform})", file=sys.stderr)
+
+    batch = GLMBatch.create(DenseFeatures(jnp.asarray(x_h)), jnp.asarray(y_h))
+    batch = jax.device_put(batch, dev)
+    obj = GLMObjective(losses.logistic)
+    norm = NormalizationContext.identity()
+
+    vg = jax.jit(lambda w: obj.value_and_grad(w, batch, norm, 0.1))
+    w = jnp.zeros((d,), jnp.float32)
+
+    # warmup + compile
+    v, g = vg(w)
+    jax.block_until_ready((v, g))
+
+    iters = 50
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        v, g = vg(w)
+    jax.block_until_ready((v, g))
+    dt = (time.perf_counter() - t0) / iters
+    eps = n / dt
+
+    print(f"tpu: {eps:.3e} ex/s  baseline(numpy): {base_eps:.3e} ex/s", file=sys.stderr)
+    print(
+        json.dumps(
+            {
+                "metric": "glm_logistic_value_and_grad_throughput",
+                "value": round(eps, 1),
+                "unit": "examples/sec/chip",
+                "vs_baseline": round(eps / base_eps, 3),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()
