@@ -1,0 +1,5 @@
+from photon_ml_tpu.algorithm.coordinate_descent import CoordinateDescent
+from photon_ml_tpu.algorithm.fixed_effect import FixedEffectCoordinate
+from photon_ml_tpu.algorithm.random_effect import RandomEffectCoordinate
+
+__all__ = ["CoordinateDescent", "FixedEffectCoordinate", "RandomEffectCoordinate"]

@@ -1,0 +1,457 @@
+"""GAME data layer: host-side columnar ingest + device-ready entity tensors.
+
+Reference spec (re-designed, not ported):
+  * GameDatum (data/GameDatum.scala:33-59): response/offset/weight +
+    per-shard feature vectors + id-type -> id map. Here: a columnar
+    ``GameData`` in one global row order — the row index replaces Spark's
+    ``zipWithUniqueId`` global id, and score vectors are plain dense arrays
+    in that order (KeyValueScore join-arithmetic becomes elementwise add).
+  * RandomEffectDataSet (data/RandomEffectDataSet.scala:38-380): grouping by
+    entity, active/passive split with reservoir caps, balanced partitioner.
+    Here: entities become the leading axis of padded tensors
+    ``(E, M, D_loc)`` so the per-entity solver vmaps; the balanced
+    partitioner (RandomEffectIdPartitioner.scala:29-97) becomes
+    sort-by-size + strided interleave so an even slice over the entity axis
+    is load-balanced; the active/passive split is a host-side deterministic
+    sample (reservoir semantics with a seeded RNG).
+  * Per-entity feature projection (projector/IndexMapProjectorRDD.scala:
+    30-119): each entity's observed feature set maps to a dense local space
+    [0, D_loc); unseen features drop. Stored as ``local_to_global`` gather
+    indices, making per-entity dims uniform — the key trick that makes
+    per-entity solves vmappable (SURVEY.md §2.4).
+  * Pearson feature selection (data/LocalDataSet.scala:118-136): top-k
+    features per entity by |corr(feature, label)|, computed vectorized over
+    all (entity, feature) pairs at once.
+
+Everything here is one-time ingest work on the host; training touches only
+the produced device arrays.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+Array = jax.Array
+
+
+# ---------------------------------------------------------------------------
+# host-side columnar containers
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class HostFeatures:
+    """CSR features for one feature shard (host)."""
+
+    indptr: np.ndarray  # (N+1,) int64
+    indices: np.ndarray  # (nnz,) int32
+    values: np.ndarray  # (nnz,) float32
+    dim: int
+
+    @property
+    def num_rows(self) -> int:
+        return len(self.indptr) - 1
+
+    def row_slice(self, r: int) -> Tuple[np.ndarray, np.ndarray]:
+        s, e = self.indptr[r], self.indptr[r + 1]
+        return self.indices[s:e], self.values[s:e]
+
+
+@dataclasses.dataclass
+class GameData:
+    """Columnar GAME dataset in one global row order (host).
+
+    ``ids[id_type]`` holds dense entity indices (already mapped from raw id
+    strings via ``id_vocabs[id_type]``).
+    """
+
+    response: np.ndarray  # (N,) float32
+    offset: np.ndarray  # (N,) float32
+    weight: np.ndarray  # (N,) float32
+    ids: Dict[str, np.ndarray]  # id_type -> (N,) int32 dense entity index
+    id_vocabs: Dict[str, List[str]]  # id_type -> raw id per dense index
+    shards: Dict[str, HostFeatures]  # feature shard id -> CSR
+
+    @property
+    def num_rows(self) -> int:
+        return len(self.response)
+
+
+# ---------------------------------------------------------------------------
+# balanced entity ordering (RandomEffectIdPartitioner analogue)
+# ---------------------------------------------------------------------------
+
+
+def balanced_entity_order(active_counts: np.ndarray, num_shards: int) -> np.ndarray:
+    """Order entities so equal slices over the entity axis balance work.
+
+    Sort by active-sample count descending, then stride-interleave across
+    ``num_shards``: shard s receives sorted positions s, s+S, s+2S, ... This
+    is the static-table analogue of the reference's greedy min-heap
+    bin-packing (RandomEffectIdPartitioner.scala:64-97) — both put the
+    heaviest entities on distinct shards first.
+
+    Returns entity indices in tensor-layout order: the first E/S rows of the
+    stacked tensor belong to shard 0, etc.
+    """
+    e = len(active_counts)
+    by_size = np.argsort(-active_counts, kind="stable")
+    per_shard: List[List[int]] = [[] for _ in range(num_shards)]
+    for pos, ent in enumerate(by_size):
+        per_shard[pos % num_shards].append(int(ent))
+    # pad shards to equal length with -1 (empty slots)
+    cap = max(len(p) for p in per_shard)
+    order = []
+    for p in per_shard:
+        order.extend(p + [-1] * (cap - len(p)))
+    return np.asarray(order, np.int64)
+
+
+# ---------------------------------------------------------------------------
+# Pearson-correlation feature selection (vectorized across entities)
+# ---------------------------------------------------------------------------
+
+
+def pearson_feature_scores(
+    entity_of_row: np.ndarray,
+    labels: np.ndarray,
+    feats: HostFeatures,
+    row_mask: np.ndarray,
+) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """|Pearson corr(feature, label)| per (entity, feature) pair present.
+
+    Returns (pair_entity, pair_feature, pair_score) for every distinct
+    (entity, feature) pair among masked-in rows. Sparse-aware: absent
+    features are zeros and enter through the n/mean terms.
+    (data/LocalDataSet.scala:198-259 semantics, vectorized.)
+    """
+    n = feats.num_rows
+    rows_nnz = np.repeat(np.arange(n), np.diff(feats.indptr))
+    keep = row_mask[rows_nnz]
+    r = rows_nnz[keep]
+    c = feats.indices[keep].astype(np.int64)
+    v = feats.values[keep]
+    ent = entity_of_row[r].astype(np.int64)
+    y = labels[r]
+
+    # per-entity label stats over masked rows
+    me = np.max(entity_of_row[row_mask]) + 1 if row_mask.any() else 0
+    cnt_e = np.bincount(entity_of_row[row_mask], minlength=me).astype(np.float64)
+    sum_y = np.bincount(entity_of_row[row_mask], weights=labels[row_mask], minlength=me)
+    sum_y2 = np.bincount(entity_of_row[row_mask], weights=labels[row_mask] ** 2, minlength=me)
+
+    # per-(entity, feature) sums via composite keys
+    key = ent * feats.dim + c
+    uniq, inv = np.unique(key, return_inverse=True)
+    sum_x = np.bincount(inv, weights=v)
+    sum_x2 = np.bincount(inv, weights=v.astype(np.float64) ** 2)
+    sum_xy = np.bincount(inv, weights=(v * y).astype(np.float64))
+
+    pe = (uniq // feats.dim).astype(np.int64)
+    pf = (uniq % feats.dim).astype(np.int64)
+    ne = cnt_e[pe]
+    mean_x = sum_x / ne
+    mean_y = sum_y[pe] / ne
+    var_x = sum_x2 / ne - mean_x**2
+    var_y = sum_y2[pe] / ne - mean_y**2
+    cov = sum_xy / ne - mean_x * mean_y
+    denom = np.sqrt(np.maximum(var_x, 0.0) * np.maximum(var_y, 0.0))
+    score = np.where(denom > 1e-12, np.abs(cov) / np.maximum(denom, 1e-12), 0.0)
+    # features with zero variance (e.g. an intercept column) score 1.0 in the
+    # reference convention so they are always kept
+    score = np.where(var_x <= 1e-12, 1.0, score)
+    return pe, pf, score
+
+
+# ---------------------------------------------------------------------------
+# RandomEffectDataset: device tensors for vmapped per-entity training
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class RandomEffectDataConfig:
+    """Parity with data/RandomEffectDataConfiguration.scala:42-130."""
+
+    random_effect_id: str  # id type to group by (e.g. "userId")
+    feature_shard_id: str
+    num_shards: int = 1  # entity-axis shards (mesh slices)
+    active_upper_bound: Optional[int] = None  # max active samples per entity
+    passive_lower_bound: Optional[int] = None  # min passive rows to keep entity's passive set
+    features_to_samples_ratio: Optional[float] = None  # Pearson selection cap
+    projector: str = "INDEX_MAP"  # INDEX_MAP | IDENTITY | RANDOM
+    random_projection_dim: Optional[int] = None
+    seed: int = 7
+
+
+@jax.tree_util.register_pytree_node_class
+@dataclasses.dataclass
+class RandomEffectDataset:
+    """Device-resident, entity-major random-effect training + scoring data.
+
+    Training (active) tensors, entity-major:
+      row_index   (E, M) int32  — global row of each active sample (-1 pad)
+      x           (E, M, D_loc) float32 — locally-projected dense features
+      labels      (E, M), base_offsets (E, M), weights (E, M) (0 = pad)
+
+    Scoring tensors, global row order (covers active + passive rows):
+      entity_pos  (N,) int32 — row's entity position in the tensor (-1 none)
+      feat_idx    (N, K) int32 — local feature indices (-1 masked)
+      feat_val    (N, K) float32
+
+    Projection bookkeeping:
+      local_to_global (E, D_loc) int32 — global column per local column (-1 pad)
+    """
+
+    row_index: Array
+    x: Array
+    labels: Array
+    base_offsets: Array
+    weights: Array
+    entity_pos: Array
+    feat_idx: Array
+    feat_val: Array
+    local_to_global: Array
+    num_entities: int = dataclasses.field(metadata={"static": True})
+    global_dim: int = dataclasses.field(metadata={"static": True})
+
+    @property
+    def num_rows(self) -> int:
+        return self.entity_pos.shape[0]
+
+    @property
+    def local_dim(self) -> int:
+        return self.x.shape[-1]
+
+    def tree_flatten(self):
+        children = (
+            self.row_index,
+            self.x,
+            self.labels,
+            self.base_offsets,
+            self.weights,
+            self.entity_pos,
+            self.feat_idx,
+            self.feat_val,
+            self.local_to_global,
+        )
+        return children, (self.num_entities, self.global_dim)
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(*children, aux[0], aux[1])
+
+
+def build_random_effect_dataset(
+    data: GameData, config: RandomEffectDataConfig
+) -> RandomEffectDataset:
+    """Host-side build: group, cap, project, pad, ship to device."""
+    ids = data.ids[config.random_effect_id]
+    feats = data.shards[config.feature_shard_id]
+    n = data.num_rows
+    num_entities_raw = int(ids.max()) + 1 if n else 0
+    rng = np.random.default_rng(config.seed)
+
+    # ---- active/passive split (reservoir-cap semantics) -------------------
+    counts = np.bincount(ids, minlength=num_entities_raw)
+    cap = config.active_upper_bound or (int(counts.max()) if n else 1)
+    # deterministic "reservoir": random priority per row, keep the cap
+    # smallest priorities per entity
+    priority = rng.random(n)
+    order = np.lexsort((priority, ids))  # group by entity, random within
+    sorted_ids = ids[order]
+    group_start = np.searchsorted(sorted_ids, np.arange(num_entities_raw), side="left")
+    rank = np.arange(n) - group_start[sorted_ids]
+    is_active_sorted = rank < cap
+    active_mask = np.zeros(n, bool)
+    active_mask[order] = is_active_sorted
+    # reference re-scales kept weights so the active set represents the full
+    # entity (RandomEffectDataSet.scala:298-301)
+    active_counts = np.minimum(counts, cap)
+    scale = np.ones(num_entities_raw)
+    over = counts > cap
+    scale[over] = counts[over] / cap
+
+    # ---- per-entity feature selection / local index maps ------------------
+    if config.projector == "RANDOM":
+        raise NotImplementedError(
+            "RANDOM projection is built via projection.random_projection_matrix; "
+            "use build_random_effect_dataset_projected"
+        )
+    if config.features_to_samples_ratio is not None:
+        pe, pf, score = pearson_feature_scores(ids, data.response, feats, active_mask)
+        # keep top ceil(ratio * n_active_e) features per entity
+        budget = np.ceil(config.features_to_samples_ratio * active_counts).astype(np.int64)
+        sel_order = np.lexsort((-score, pe))
+        pe_s, pf_s = pe[sel_order], pf[sel_order]
+        start = np.searchsorted(pe_s, np.arange(num_entities_raw), side="left")
+        rank_f = np.arange(len(pe_s)) - start[pe_s]
+        keep_pair = rank_f < budget[pe_s]
+        pair_e, pair_f = pe_s[keep_pair], pf_s[keep_pair]
+    else:
+        # all features each entity saw in its active rows
+        rows_nnz = np.repeat(np.arange(n), np.diff(feats.indptr))
+        keep = active_mask[rows_nnz]
+        pair_key = ids[rows_nnz[keep]].astype(np.int64) * feats.dim + feats.indices[
+            keep
+        ].astype(np.int64)
+        uniq = np.unique(pair_key)
+        pair_e = (uniq // feats.dim).astype(np.int64)
+        pair_f = (uniq % feats.dim).astype(np.int64)
+
+    if config.projector == "IDENTITY":
+        d_loc = feats.dim
+        local_to_global = np.tile(
+            np.arange(feats.dim, dtype=np.int32), (num_entities_raw, 1)
+        )
+    else:  # INDEX_MAP
+        # sort pairs by (entity, feature) for deterministic local ordering
+        o = np.lexsort((pair_f, pair_e))
+        pair_e, pair_f = pair_e[o], pair_f[o]
+        ent_start = np.searchsorted(pair_e, np.arange(num_entities_raw), side="left")
+        local_idx = np.arange(len(pair_e)) - ent_start[pair_e]
+        per_entity_dims = np.bincount(pair_e, minlength=num_entities_raw)
+        d_loc = int(per_entity_dims.max()) if len(pair_e) else 1
+        d_loc = max(d_loc, 1)
+        local_to_global = np.full((num_entities_raw, d_loc), -1, np.int32)
+        local_to_global[pair_e, local_idx] = pair_f.astype(np.int32)
+
+    # hashmap (entity, global feature) -> local index for projecting rows
+    pair_lookup = dict() if config.projector != "IDENTITY" else None
+    if pair_lookup is not None:
+        composite = pair_e * feats.dim + pair_f
+        pair_lookup = (composite, local_idx)  # sorted composite keys
+
+    def project_rows(row_sel: np.ndarray):
+        """Project rows' features into their entity's local space.
+
+        Returns (feat_idx (R, K) int32 with -1 masked, feat_val (R, K)).
+        """
+        sub_nnz_counts = np.diff(feats.indptr)[row_sel]
+        k = int(sub_nnz_counts.max()) if len(row_sel) and sub_nnz_counts.size else 1
+        k = max(k, 1)
+        out_idx = np.full((len(row_sel), k), -1, np.int32)
+        out_val = np.zeros((len(row_sel), k), np.float32)
+        # gather nnz of selected rows
+        starts = feats.indptr[row_sel]
+        ends = feats.indptr[row_sel + 1]
+        lens = (ends - starts).astype(np.int64)
+        flat_rows = np.repeat(np.arange(len(row_sel)), lens)
+        flat_ptr = np.concatenate(
+            [np.arange(s, e) for s, e in zip(starts, ends)]
+        ) if len(row_sel) else np.zeros(0, np.int64)
+        cols = feats.indices[flat_ptr].astype(np.int64)
+        vals = feats.values[flat_ptr]
+        slot = np.arange(len(flat_rows)) - np.repeat(
+            np.concatenate([[0], np.cumsum(lens)[:-1]]), lens
+        )
+        if config.projector == "IDENTITY":
+            out_idx[flat_rows, slot] = cols.astype(np.int32)
+            out_val[flat_rows, slot] = vals
+            return out_idx, out_val
+        comp = ids[row_sel][flat_rows].astype(np.int64) * feats.dim + cols
+        keys, locs = pair_lookup
+        pos = np.searchsorted(keys, comp)
+        pos_c = np.clip(pos, 0, len(keys) - 1) if len(keys) else np.zeros_like(pos)
+        found = len(keys) > 0
+        hit = (keys[pos_c] == comp) if found else np.zeros(len(comp), bool)
+        out_idx[flat_rows[hit], slot[hit]] = locs[pos_c[hit]].astype(np.int32)
+        out_val[flat_rows[hit], slot[hit]] = vals[hit]
+        return out_idx, out_val
+
+    # ---- entity-major training tensors ------------------------------------
+    entity_order = balanced_entity_order(active_counts, config.num_shards)
+    e_padded = len(entity_order)
+    m = int(active_counts.max()) if n else 1
+    m = max(min(m, cap), 1)
+
+    row_index = np.full((e_padded, m), -1, np.int32)
+    # position of each entity in the tensor layout
+    tensor_pos = np.full(num_entities_raw + 1, -1, np.int32)
+    valid_ents = entity_order >= 0
+    tensor_pos[entity_order[valid_ents]] = np.nonzero(valid_ents)[0].astype(np.int32)
+
+    act_rows = np.nonzero(active_mask)[0]
+    act_ids = ids[act_rows]
+    o2 = np.lexsort((act_rows, act_ids))
+    act_rows_s = act_rows[o2]
+    act_ids_s = act_ids[o2]
+    astart = np.searchsorted(act_ids_s, np.arange(num_entities_raw), side="left")
+    arank = np.arange(len(act_rows_s)) - astart[act_ids_s]
+    row_index[tensor_pos[act_ids_s], arank] = act_rows_s.astype(np.int32)
+
+    # densify projected features per active slot
+    flat_sel = row_index.reshape(-1)
+    valid_slot = flat_sel >= 0
+    sel_rows = flat_sel[valid_slot].astype(np.int64)
+    pidx, pval = project_rows(sel_rows)
+    x = np.zeros((e_padded * m, d_loc), np.float32)
+    rr = np.repeat(np.arange(len(sel_rows)), pidx.shape[1])
+    cc = pidx.reshape(-1)
+    vv = pval.reshape(-1)
+    ok = cc >= 0
+    dense_rows = np.nonzero(valid_slot)[0][rr[ok]]
+    x[dense_rows, cc[ok]] = vv[ok]
+    x = x.reshape(e_padded, m, d_loc)
+
+    def scatter_col(src, fill=0.0):
+        out = np.full((e_padded, m), fill, np.float32)
+        out.reshape(-1)[valid_slot] = src[sel_rows]
+        return out
+
+    labels_t = scatter_col(data.response)
+    offsets_t = scatter_col(data.offset)
+    weights_t = scatter_col(data.weight)
+    # re-scale active weights where the entity was capped
+    weights_t.reshape(-1)[valid_slot] *= scale[ids[sel_rows]].astype(np.float32)
+
+    # ---- scoring tensors (all rows) ---------------------------------------
+    entity_pos_all = tensor_pos[ids].astype(np.int32)
+    sc_idx, sc_val = project_rows(np.arange(n, dtype=np.int64))
+
+    # local_to_global above is indexed by RAW entity id; the tensors are laid
+    # out in balanced (tensor-position) order — permute to match.
+    l2g_tensor = np.full((e_padded, d_loc), -1, np.int32)
+    valid_pos = np.nonzero(valid_ents)[0]
+    l2g_tensor[valid_pos] = local_to_global[entity_order[valid_ents]]
+
+    return RandomEffectDataset(
+        row_index=jnp.asarray(row_index),
+        x=jnp.asarray(x),
+        labels=jnp.asarray(labels_t),
+        base_offsets=jnp.asarray(offsets_t),
+        weights=jnp.asarray(weights_t),
+        entity_pos=jnp.asarray(entity_pos_all),
+        feat_idx=jnp.asarray(sc_idx),
+        feat_val=jnp.asarray(sc_val),
+        local_to_global=jnp.asarray(l2g_tensor),
+        num_entities=e_padded,
+        global_dim=feats.dim,
+    )
+
+
+# ---------------------------------------------------------------------------
+# FixedEffect dataset: one GLMBatch over all rows for one shard
+# ---------------------------------------------------------------------------
+
+
+def build_fixed_effect_batch(data: GameData, feature_shard_id: str, dense: bool = True):
+    """(data/FixedEffectDataSet.scala:31-105 analogue.)"""
+    from photon_ml_tpu.io.libsvm import HostDataset, to_batch
+
+    feats = data.shards[feature_shard_id]
+    ds = HostDataset(
+        labels=data.response,
+        indptr=feats.indptr,
+        indices=feats.indices,
+        values=feats.values,
+        dim=feats.dim,
+        offsets=data.offset,
+        weights=data.weight,
+    )
+    return to_batch(ds, dense=dense, pad_rows_to=1)

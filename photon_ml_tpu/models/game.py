@@ -1,0 +1,79 @@
+"""GAME model containers: fixed-effect, random-effect, full GAME model.
+
+Reference spec: model/GAMEModel.scala:29-115 (Map[coordinateId ->
+DatumScoringModel], total score = sum of sub-scores), FixedEffectModel.scala
+(Broadcast[GLM] + featureShardId), RandomEffectModel.scala:32-160 (RDD of
+(entityId, GLM); datum with no model -> score 0),
+RandomEffectModelInProjectedSpace.scala (projected coefficients + projector).
+
+TPU-native: a random-effect model is ONE stacked coefficient tensor
+(E, D_loc) plus the gather bookkeeping — the whole per-entity model
+collection is a single sharded array, not millions of objects.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from photon_ml_tpu.types import TaskType
+
+Array = jax.Array
+
+
+@dataclasses.dataclass
+class FixedEffectModel:
+    """Replicated global coefficients for one feature shard."""
+
+    coefficients: Array  # (D,)
+    feature_shard_id: str
+    task: TaskType
+
+    def score(self, features) -> Array:
+        """Raw margin contribution (FixedEffectModel.scala:91-100)."""
+        return features.matvec(self.coefficients)
+
+
+@dataclasses.dataclass
+class RandomEffectModel:
+    """Stacked per-entity coefficients in a projected local space.
+
+    ``entity_tensor_pos`` maps dense entity index -> row of ``coefficients``
+    (-1 = entity unseen at train time -> scores 0).
+    """
+
+    coefficients: Array  # (E, D_loc)
+    local_to_global: Array  # (E, D_loc) int32, -1 padded
+    random_effect_id: str
+    feature_shard_id: str
+    task: TaskType
+    entity_tensor_pos: Optional[np.ndarray] = None  # host array, raw idx -> row
+    entity_vocab: Optional[List[str]] = None
+
+    def score_rows(self, entity_pos: Array, feat_idx: Array, feat_val: Array) -> Array:
+        """Score rows given precomputed local projections (gather form)."""
+        ep = jnp.maximum(entity_pos, 0)
+        li = jnp.maximum(feat_idx, 0)
+        coefs = self.coefficients[ep[:, None], li]
+        valid = (entity_pos[:, None] >= 0) & (feat_idx >= 0)
+        return jnp.sum(jnp.where(valid, coefs * feat_val, 0.0), axis=-1)
+
+
+@dataclasses.dataclass
+class GameModel:
+    """Map coordinate name -> sub-model; total score = sum of sub-scores
+    (GAMEModel.scala:92-94)."""
+
+    models: Dict[str, object]
+    task: TaskType
+
+    def __getitem__(self, name: str):
+        return self.models[name]
+
+    def coordinate_names(self) -> List[str]:
+        return list(self.models)

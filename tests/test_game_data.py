@@ -1,0 +1,121 @@
+"""RandomEffectDataset build: grouping, caps, projection, scoring gathers."""
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from photon_ml_tpu.data.game import (
+    RandomEffectDataConfig,
+    balanced_entity_order,
+    build_fixed_effect_batch,
+    build_random_effect_dataset,
+    pearson_feature_scores,
+)
+from game_test_utils import make_glmix_data, dense_to_csr
+
+
+def test_balanced_entity_order():
+    counts = np.array([100, 1, 1, 1, 50, 49, 2, 2])
+    order = balanced_entity_order(counts, num_shards=2)
+    assert len(order) == 8
+    shard0, shard1 = order[:4], order[4:]
+    w0 = counts[shard0[shard0 >= 0]].sum()
+    w1 = counts[shard1[shard1 >= 0]].sum()
+    # heaviest two entities land on different shards
+    assert not ({0, 4} <= set(shard0.tolist()) or {0, 4} <= set(shard1.tolist()))
+    assert abs(w0 - w1) <= counts.max()
+
+
+def test_re_dataset_identity_projection_roundtrip(rng):
+    data, truth = make_glmix_data(rng, num_users=10, d_random=4)
+    cfg = RandomEffectDataConfig("userId", "per_user", projector="IDENTITY")
+    ds = build_random_effect_dataset(data, cfg)
+    n = data.num_rows
+    # scoring gather must reproduce x_random rows exactly:
+    # score with W[e] = onehot(j) equals column j of x_random
+    e, d_loc = ds.local_to_global.shape
+    for j in range(truth["x_random"].shape[1]):
+        w = jnp.zeros((ds.num_entities, d_loc)).at[:, j].set(1.0)
+        ep = jnp.maximum(ds.entity_pos, 0)
+        li = jnp.maximum(ds.feat_idx, 0)
+        coefs = w[ep[:, None], li]
+        valid = (ds.entity_pos[:, None] >= 0) & (ds.feat_idx >= 0)
+        score = jnp.sum(jnp.where(valid, coefs * ds.feat_val, 0.0), -1)
+        np.testing.assert_allclose(score, truth["x_random"][:, j], atol=1e-6)
+
+
+def test_re_dataset_active_cap_and_weights(rng):
+    data, truth = make_glmix_data(rng, num_users=8, rows_per_user_range=(10, 30))
+    cap = 5
+    cfg = RandomEffectDataConfig("userId", "per_user", active_upper_bound=cap)
+    ds = build_random_effect_dataset(data, cfg)
+    counts = np.bincount(truth["user_of_row"], minlength=8)
+    # each entity has at most cap active rows
+    active_per_slot = np.asarray(ds.row_index >= 0).sum(1)
+    assert active_per_slot.max() <= cap
+    # weight rescaling: total active weight per entity == original count
+    w = np.asarray(ds.weights)
+    ri = np.asarray(ds.row_index)
+    for pos in range(ds.num_entities):
+        rows = ri[pos][ri[pos] >= 0]
+        if len(rows) == 0:
+            continue
+        ent = truth["user_of_row"][rows[0]]
+        np.testing.assert_allclose(w[pos].sum(), counts[ent], rtol=1e-5)
+
+
+def test_re_dataset_index_map_projection(rng):
+    """INDEX_MAP: each entity sees only its own observed features, densely."""
+    data, truth = make_glmix_data(rng, num_users=6, d_random=4)
+    # zero out some columns per user to create per-entity sparsity patterns
+    x = truth["x_random"].copy()
+    u = truth["user_of_row"]
+    x[u % 2 == 0, 3] = 0.0  # even users never see feature 3
+    data.shards["per_user"] = dense_to_csr(x)
+    cfg = RandomEffectDataConfig("userId", "per_user", projector="INDEX_MAP")
+    ds = build_random_effect_dataset(data, cfg)
+    l2g = np.asarray(ds.local_to_global)
+    ri = np.asarray(ds.row_index)
+    for pos in range(ds.num_entities):
+        rows = ri[pos][ri[pos] >= 0]
+        if len(rows) == 0:
+            continue
+        ent = u[rows[0]]
+        cols = set(l2g[pos][l2g[pos] >= 0].tolist())
+        seen = set(np.nonzero(np.abs(x[u == ent]).sum(0) > 0)[0].tolist())
+        assert cols == seen, f"entity {ent}: local map {cols} != observed {seen}"
+    # scoring with global one-hot columns still reproduces x
+    for j in range(4):
+        w = jnp.asarray((l2g == j).astype(np.float32))
+        ep = jnp.maximum(ds.entity_pos, 0)
+        li = jnp.maximum(ds.feat_idx, 0)
+        coefs = w[ep[:, None], li]
+        valid = (ds.entity_pos[:, None] >= 0) & (ds.feat_idx >= 0)
+        score = np.asarray(jnp.sum(jnp.where(valid, coefs * ds.feat_val, 0.0), -1))
+        np.testing.assert_allclose(score, x[:, j], atol=1e-6)
+
+
+def test_pearson_feature_selection(rng):
+    """Features correlated with the label score high; noise features low."""
+    n = 400
+    ents = np.zeros(n, np.int32)
+    y = (rng.random(n) > 0.5).astype(np.float32)
+    x = np.zeros((n, 3), np.float32)
+    x[:, 0] = y * 2.0 + rng.normal(size=n) * 0.05  # strongly correlated
+    x[:, 1] = rng.normal(size=n)  # noise
+    x[:, 2] = 1.0  # intercept-like (zero variance -> kept, score 1)
+    feats = dense_to_csr(x)
+    pe, pf, score = pearson_feature_scores(ents, y, feats, np.ones(n, bool))
+    s = {int(f): float(v) for f, v in zip(pf, score)}
+    assert s[0] > 0.9
+    assert s[1] < 0.3
+    assert s[2] == 1.0
+
+
+def test_fixed_effect_batch_build(rng):
+    data, truth = make_glmix_data(rng, num_users=5)
+    batch = build_fixed_effect_batch(data, "global", dense=True)
+    np.testing.assert_allclose(
+        np.asarray(batch.features.to_dense())[: data.num_rows], truth["x_fixed"], atol=1e-6
+    )
+    np.testing.assert_allclose(np.asarray(batch.labels)[: data.num_rows], data.response)
