@@ -37,6 +37,10 @@ class HostDataset:
     def num_rows(self) -> int:
         return len(self.labels)
 
+    def row_slice(self, r: int) -> Tuple[np.ndarray, np.ndarray]:
+        s, e = self.indptr[r], self.indptr[r + 1]
+        return self.indices[s:e], self.values[s:e]
+
 
 def read_libsvm(path: str, dim: Optional[int] = None, add_intercept: bool = True,
                 zero_based: bool = False) -> HostDataset:

@@ -1,0 +1,77 @@
+"""Per-column statistical summaries (on device).
+
+Reference spec: stat/BasicStatisticalSummary.scala:33-100 (wraps Spark MLlib
+colStats: mean/variance/count/numNonzeros/max/min/normL1/normL2 + meanAbs).
+TPU-native: one weighted reduction pass over the batch; feeds the
+normalization factory and the diagnostics summary tables.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from photon_ml_tpu.ops.objective import GLMBatch
+
+Array = jax.Array
+
+
+@jax.tree_util.register_pytree_node_class
+@dataclasses.dataclass
+class BasicStatisticalSummary:
+    mean: Array
+    variance: Array
+    count: Array  # scalar — number of (non-padding) rows
+    num_nonzeros: Array
+    max: Array
+    min: Array
+    norm_l1: Array
+    norm_l2: Array
+    mean_abs: Array
+
+    @property
+    def std(self) -> Array:
+        return jnp.sqrt(jnp.maximum(self.variance, 0.0))
+
+    @property
+    def max_magnitude(self) -> Array:
+        return jnp.maximum(jnp.abs(self.max), jnp.abs(self.min))
+
+    def tree_flatten(self):
+        return (
+            self.mean, self.variance, self.count, self.num_nonzeros,
+            self.max, self.min, self.norm_l1, self.norm_l2, self.mean_abs,
+        ), None
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(*children)
+
+
+def summarize(batch: GLMBatch) -> BasicStatisticalSummary:
+    """Unweighted column stats over non-padding rows (colStats parity —
+    MLlib colStats ignores sample weights, and so does the reference)."""
+    x = batch.features.to_dense()
+    present = (batch.weights > 0.0).astype(x.dtype)[:, None]  # (N, 1)
+    n = jnp.maximum(jnp.sum(present), 1.0)
+    xm = x * present
+    mean = jnp.sum(xm, axis=0) / n
+    # unbiased variance (MLlib convention)
+    var = (jnp.sum(jnp.square(xm), axis=0) - n * jnp.square(mean)) / jnp.maximum(n - 1.0, 1.0)
+    big = jnp.asarray(jnp.finfo(x.dtype).max, x.dtype)
+    x_or_neginf = jnp.where(present > 0, x, -big)
+    x_or_posinf = jnp.where(present > 0, x, big)
+    return BasicStatisticalSummary(
+        mean=mean,
+        variance=jnp.maximum(var, 0.0),
+        count=n,
+        num_nonzeros=jnp.sum((xm != 0.0).astype(x.dtype), axis=0),
+        max=jnp.max(x_or_neginf, axis=0),
+        min=jnp.min(x_or_posinf, axis=0),
+        norm_l1=jnp.sum(jnp.abs(xm), axis=0),
+        norm_l2=jnp.sqrt(jnp.sum(jnp.square(xm), axis=0)),
+        mean_abs=jnp.sum(jnp.abs(xm), axis=0) / n,
+    )
