@@ -1,4 +1,9 @@
 from photon_ml_tpu.algorithm.coordinate_descent import CoordinateDescent
+from photon_ml_tpu.algorithm.factored_random_effect import (
+    FactoredRandomEffectCoordinate,
+    FactoredState,
+    MFOptimizationConfig,
+)
 from photon_ml_tpu.algorithm.fixed_effect import FixedEffectCoordinate
 from photon_ml_tpu.algorithm.random_effect import RandomEffectCoordinate
 

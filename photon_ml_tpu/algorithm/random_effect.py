@@ -133,10 +133,14 @@ class RandomEffectCoordinate:
 
     # ------------------------------------------------------------------
     def global_coefficients(self, coefficients: Array) -> Array:
-        """Scatter per-entity local coefficients back to global feature space
+        """Per-entity local coefficients back in the global feature space
         -> (E, D_global) (RandomEffectModelInProjectedSpace.toRandomEffectModel
-        parity). Host-sized output; use for export/inspection only."""
+        parity). INDEX_MAP/IDENTITY datasets scatter via local_to_global;
+        RANDOM datasets back-project through the stored projection matrix
+        (W_global = W_proj @ M). Host-sized output; for export/inspection."""
         ds = self.dataset
+        if ds.projection_matrix is not None:
+            return coefficients @ ds.projection_matrix
         e, d_loc = coefficients.shape
         out = jnp.zeros((e, ds.global_dim), coefficients.dtype)
         cols = jnp.maximum(ds.local_to_global, 0)

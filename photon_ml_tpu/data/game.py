@@ -186,6 +186,9 @@ class RandomEffectDataConfig:
     features_to_samples_ratio: Optional[float] = None  # Pearson selection cap
     projector: str = "INDEX_MAP"  # INDEX_MAP | IDENTITY | RANDOM
     random_projection_dim: Optional[int] = None
+    # whether the shard's last column is an intercept the RANDOM projection
+    # must pass through untouched (ProjectionMatrix.scala isKeepingInterceptTerm)
+    random_projection_intercept: bool = True
     seed: int = 7
 
 
@@ -219,6 +222,10 @@ class RandomEffectDataset:
     local_to_global: Array
     num_entities: int = dataclasses.field(metadata={"static": True})
     global_dim: int = dataclasses.field(metadata={"static": True})
+    # shared RANDOM-projection matrix (k, D_global) when the local space is a
+    # random projection; None for INDEX_MAP/IDENTITY. Needed to back-project
+    # coefficients to the original space.
+    projection_matrix: Optional[Array] = None
 
     @property
     def num_rows(self) -> int:
@@ -239,18 +246,24 @@ class RandomEffectDataset:
             self.feat_idx,
             self.feat_val,
             self.local_to_global,
+            self.projection_matrix,
         )
         return children, (self.num_entities, self.global_dim)
 
     @classmethod
     def tree_unflatten(cls, aux, children):
-        return cls(*children, aux[0], aux[1])
+        return cls(*children[:9], aux[0], aux[1], children[9])
 
 
 def build_random_effect_dataset(
-    data: GameData, config: RandomEffectDataConfig
+    data: GameData, config: RandomEffectDataConfig, projector=None
 ) -> RandomEffectDataset:
-    """Host-side build: group, cap, project, pad, ship to device."""
+    """Host-side build: group, cap, project, pad, ship to device.
+
+    ``projector`` (a ProjectionMatrixProjector) is only consulted when
+    ``config.projector == "RANDOM"``; omitted, one is built from
+    ``config.random_projection_dim`` and ``config.seed``.
+    """
     ids = data.ids[config.random_effect_id]
     feats = data.shards[config.feature_shard_id]
     n = data.num_rows
@@ -279,9 +292,43 @@ def build_random_effect_dataset(
 
     # ---- per-entity feature selection / local index maps ------------------
     if config.projector == "RANDOM":
-        raise NotImplementedError(
-            "RANDOM projection is built via projection.random_projection_matrix; "
-            "use build_random_effect_dataset_projected"
+        # shared Gaussian random projection (projector/ProjectionMatrixBroadcast
+        # .scala:30-96): every entity shares one dense (k, d) matrix, applied
+        # host-side to CSR rows; the local space is the k-dim projected space.
+        from photon_ml_tpu.projectors import build_projector
+        from photon_ml_tpu.types import ProjectorType
+
+        if projector is None:
+            projector = build_projector(
+                ProjectorType.RANDOM,
+                feats.dim,
+                config.random_projection_dim,
+                keep_intercept=config.random_projection_intercept,
+                seed=config.seed,
+            )
+        d_loc = projector.projected_dim
+        local_to_global = np.full((num_entities_raw, d_loc), -1, np.int32)
+
+        def project_rows(row_sel: np.ndarray):
+            starts = feats.indptr[row_sel]
+            ends = feats.indptr[row_sel + 1]
+            lens = (ends - starts).astype(np.int64)
+            flat_ptr = (
+                np.concatenate([np.arange(s, e) for s, e in zip(starts, ends)])
+                if len(row_sel)
+                else np.zeros(0, np.int64)
+            )
+            row_splits = np.concatenate([[0], np.cumsum(lens)])
+            dense = projector.project_sparse_features(
+                feats.indices[flat_ptr].astype(np.int64), feats.values[flat_ptr], row_splits
+            )
+            out_idx = np.tile(np.arange(d_loc, dtype=np.int32), (len(row_sel), 1))
+            return out_idx, dense.astype(np.float32)
+
+        return _assemble_random_effect_tensors(
+            data, config, ids, feats, n, num_entities_raw, active_mask, active_counts,
+            scale, d_loc, local_to_global, project_rows, cap,
+            projection_matrix=projector.matrix,
         )
     if config.features_to_samples_ratio is not None:
         pe, pf, score = pearson_feature_scores(ids, data.response, feats, active_mask)
@@ -364,6 +411,18 @@ def build_random_effect_dataset(
         out_val[flat_rows[hit], slot[hit]] = vals[hit]
         return out_idx, out_val
 
+    return _assemble_random_effect_tensors(
+        data, config, ids, feats, n, num_entities_raw, active_mask, active_counts,
+        scale, d_loc, local_to_global, project_rows, cap,
+    )
+
+
+def _assemble_random_effect_tensors(
+    data, config, ids, feats, n, num_entities_raw, active_mask, active_counts,
+    scale, d_loc, local_to_global, project_rows, cap, projection_matrix=None,
+):
+    """Shared tail of the random-effect build: entity-major training tensors
+    + global-row-order scoring tensors, for any local projection."""
     # ---- entity-major training tensors ------------------------------------
     entity_order = balanced_entity_order(active_counts, config.num_shards)
     e_padded = len(entity_order)
@@ -432,6 +491,7 @@ def build_random_effect_dataset(
         local_to_global=jnp.asarray(l2g_tensor),
         num_entities=e_padded,
         global_dim=feats.dim,
+        projection_matrix=projection_matrix,
     )
 
 

@@ -110,13 +110,15 @@ def read_game_data(
             if t not in meta:
                 raise ValueError(f"row {n}: id type {t!r} missing from metadataMap")
             raw_ids[t].append(meta[t])
+        # compute each feature's key once, then probe every shard's map
+        keyed = [(feature_key(f["name"], f["term"]), float(f["value"])) for f in rec["features"]]
         for s, imap in shard_index_maps.items():
             ptr, idx, val = per_shard[s]
-            for f in rec["features"]:
-                j = imap.get_index(feature_key(f["name"], f["term"]))
+            for key, value in keyed:
+                j = imap.get_index(key)
                 if j >= 0:
                     idx.append(j)
-                    val.append(float(f["value"]))
+                    val.append(value)
             if shard_intercepts.get(s, True) and imap.intercept_index >= 0:
                 idx.append(imap.intercept_index)
                 val.append(1.0)
@@ -165,9 +167,9 @@ def write_training_examples(
 
     def records():
         for r in range(ds.num_rows):
-            s, e = ds.indptr[r], ds.indptr[r + 1]
+            row_indices, row_values = ds.row_slice(r)
             feats = []
-            for j, v in zip(ds.indices[s:e], ds.values[s:e]):
+            for j, v in zip(row_indices, row_values):
                 if skip_intercept and j == intercept_idx:
                     continue
                 key = index_map.get_feature_name(int(j)) or str(int(j))

@@ -40,7 +40,12 @@ def _split_key(key: str) -> Tuple[str, str]:
 
 def _coeff_records(means: np.ndarray, variances: Optional[np.ndarray],
                    index_map: IndexMap) -> Tuple[List[dict], Optional[List[dict]]]:
+    # sparse encoding keeps every index where EITHER the mean or the variance
+    # is nonzero (an exactly-zero mean — common under OWL-QN — must not drop
+    # its posterior variance)
     nz = np.nonzero(means)[0]
+    if variances is not None:
+        nz = np.union1d(nz, np.nonzero(variances)[0])
     means_rec = []
     for j in nz:
         name, term = _split_key(index_map.get_feature_name(int(j)) or str(int(j)))

@@ -65,6 +65,73 @@ class RandomEffectModel:
 
 
 @dataclasses.dataclass
+class FactoredRandomEffectModel:
+    """Per-entity latent coefficients + shared latent projection matrix
+    (model/FactoredRandomEffectModel.scala:30-80: projected-space models +
+    ProjectionMatrixBroadcast)."""
+
+    latent_coefficients: Array  # (E, k)
+    latent_matrix: Array  # (k, D_loc)
+    random_effect_id: str
+    feature_shard_id: str
+    task: TaskType
+    entity_tensor_pos: Optional[np.ndarray] = None
+    entity_vocab: Optional[List[str]] = None
+
+    def to_random_effect_model(self, local_to_global: Array) -> RandomEffectModel:
+        """Original-space stacked coefficients W = V M — one matmul
+        (FactoredRandomEffectModel.toRandomEffectModel)."""
+        return RandomEffectModel(
+            coefficients=self.latent_coefficients @ self.latent_matrix,
+            local_to_global=local_to_global,
+            random_effect_id=self.random_effect_id,
+            feature_shard_id=self.feature_shard_id,
+            task=self.task,
+            entity_tensor_pos=self.entity_tensor_pos,
+            entity_vocab=self.entity_vocab,
+        )
+
+
+@dataclasses.dataclass
+class MatrixFactorizationModel:
+    """Row/column latent factors; score = dot of the row's and column's
+    factors (model/MatrixFactorizationModel.scala:32-180 — the RDDs of
+    (id, Vector) become two stacked factor tensors).
+    """
+
+    row_effect_type: str
+    col_effect_type: str
+    row_latent_factors: Array  # (R, k)
+    col_latent_factors: Array  # (C, k)
+    row_vocab: Optional[List[str]] = None
+    col_vocab: Optional[List[str]] = None
+
+    @property
+    def num_latent_factors(self) -> int:
+        return self.row_latent_factors.shape[-1]
+
+    def score(self, row_ids: Array, col_ids: Array) -> Array:
+        """(N,) scores for paired (row id, col id) indices; ids < 0 (no
+        factor for that entity) score 0, matching the reference's cogroup
+        dropping datums without factors."""
+        r = jnp.maximum(row_ids, 0)
+        c = jnp.maximum(col_ids, 0)
+        dots = jnp.sum(self.row_latent_factors[r] * self.col_latent_factors[c], axis=-1)
+        valid = (row_ids >= 0) & (col_ids >= 0)
+        return jnp.where(valid, dots, 0.0)
+
+    def to_summary_string(self) -> str:
+        rn = np.linalg.norm(np.asarray(self.row_latent_factors), axis=-1)
+        cn = np.linalg.norm(np.asarray(self.col_latent_factors), axis=-1)
+        return (
+            f"MatrixFactorizationModel(row={self.row_effect_type}, "
+            f"col={self.col_effect_type}, k={self.num_latent_factors}): "
+            f"row L2 mean={rn.mean():.4g} max={rn.max():.4g}; "
+            f"col L2 mean={cn.mean():.4g} max={cn.max():.4g}"
+        )
+
+
+@dataclasses.dataclass
 class GameModel:
     """Map coordinate name -> sub-model; total score = sum of sub-scores
     (GAMEModel.scala:92-94)."""

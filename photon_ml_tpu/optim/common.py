@@ -74,3 +74,25 @@ def summarize_result(res: OptResult) -> str:
         f"value={float(res.value):.6g} |grad|={float(res.grad_norm):.3e} "
         f"iters={int(res.iterations)} reason={reason}"
     )
+
+
+def summarize_stacked_results(res: OptResult) -> str:
+    """Aggregate summary of a vmapped solve (leading entity axis on every
+    field) — convergence-reason counts + iteration/value stats, the analogue
+    of RandomEffectOptimizationTracker.toSummaryString
+    (optimization/game/RandomEffectOptimizationTracker.scala:62-95)."""
+    import numpy as np
+
+    reasons = np.asarray(res.reason).ravel()
+    iters = np.asarray(res.iterations).ravel()
+    values = np.asarray(res.value).ravel()
+    counts = {
+        ConvergenceReason(code).name: int(n)
+        for code, n in zip(*np.unique(reasons, return_counts=True))
+        if code != 0
+    }
+    return (
+        f"entities={reasons.size} convergenceReasons={counts} "
+        f"iterations(mean={iters.mean():.1f} max={int(iters.max())}) "
+        f"value(mean={values.mean():.6g} max={values.max():.6g})"
+    )

@@ -1,0 +1,144 @@
+"""Box constraints on coefficients (projection onto a hypercube).
+
+Reference spec: optimization/OptimizationUtils.scala:30-80
+(projectCoefficientsToHypercube — per-index clipping to (lower, upper)
+intervals) and io/GLMSuite.scala:207-270 (createConstraintFeatureMap — JSON
+constraint string -> Map[featureIndex -> (lowerBound, upperBound)] with
+wildcard handling, io/ConstraintMapKeys.scala keys).
+
+TPU-native: the constraint map is densified once into (lower, upper) arrays
+of shape (D,) (unconstrained entries are +/-inf) so the projection is a
+single fused ``jnp.clip`` — branch-free, vmappable, and free inside the
+optimizer while_loop kernels.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import math
+from typing import Dict, Mapping, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+Array = jax.Array
+
+WILDCARD = "*"
+DELIMITER = "\x01"
+INTERCEPT_KEY = "(INTERCEPT)" + DELIMITER
+
+# JSON keys (ConstraintMapKeys.scala)
+NAME_KEY = "name"
+TERM_KEY = "term"
+LOWER_BOUND_KEY = "lowerBound"
+UPPER_BOUND_KEY = "upperBound"
+
+
+@dataclasses.dataclass(frozen=True)
+class BoxConstraints:
+    """Dense (lower, upper) bound arrays of shape (D,)."""
+
+    lower: Array
+    upper: Array
+
+    def project(self, w: Array) -> Array:
+        return jnp.clip(w, self.lower, self.upper)
+
+    @property
+    def dim(self) -> int:
+        return self.lower.shape[0]
+
+    @staticmethod
+    def from_map(dim: int, constraint_map: Mapping[int, Tuple[float, float]]) -> "BoxConstraints":
+        lower = np.full((dim,), -np.inf, np.float32)
+        upper = np.full((dim,), np.inf, np.float32)
+        for idx, (lb, ub) in constraint_map.items():
+            lower[idx] = lb
+            upper[idx] = ub
+        return BoxConstraints(jnp.asarray(lower), jnp.asarray(upper))
+
+
+def parse_constraint_string(
+    constraint_string: str,
+    feature_key_to_index: Mapping[str, int],
+    intercept_key: Optional[str] = INTERCEPT_KEY,
+) -> Optional[Dict[int, Tuple[float, float]]]:
+    """JSON constraint string -> {feature index: (lower, upper)}.
+
+    Mirrors GLMSuite.createConstraintFeatureMap (io/GLMSuite.scala:207-270):
+
+      * each entry must carry "name" and "term"; missing bounds default to
+        -inf / +inf, but at least one must be finite and lower < upper;
+      * name "*" + term "*" constrains every feature except the intercept
+        and must be the only entry;
+      * name "*" with a concrete term is rejected (unsupported);
+      * a concrete name with term "*" constrains every feature whose key
+        starts with ``name + DELIMITER``;
+      * duplicate coverage of the same feature index is rejected;
+      * returns None when the resulting map is empty.
+    """
+    entries = json.loads(constraint_string)
+    if not isinstance(entries, list):
+        raise ValueError(f"Constraint string must be a JSON list: {constraint_string!r}")
+
+    constraint_map: Dict[int, Tuple[float, float]] = {}
+    saw_full_wildcard = False
+    for entry in entries:
+        if NAME_KEY not in entry or TERM_KEY not in entry:
+            raise ValueError(
+                f"Each constraint map entry needs '{NAME_KEY}' and '{TERM_KEY}': {entry!r}"
+            )
+        name = entry[NAME_KEY]
+        term = entry[TERM_KEY]
+        lb = float(entry.get(LOWER_BOUND_KEY, -math.inf))
+        ub = float(entry.get(UPPER_BOUND_KEY, math.inf))
+        if not (lb > -math.inf or ub < math.inf):
+            raise ValueError(
+                f"Both bounds infinite for feature name={name!r} term={term!r} — "
+                "invalid constraint specification"
+            )
+        if not lb < ub:
+            raise ValueError(
+                f"Lower bound {lb} >= upper bound {ub} for feature name={name!r} term={term!r}"
+            )
+
+        if name == WILDCARD:
+            if term != WILDCARD:
+                raise ValueError(
+                    "Wildcard in feature name alone is not supported; wildcard name "
+                    "requires wildcard term"
+                )
+            saw_full_wildcard = True
+            for key, idx in feature_key_to_index.items():
+                if intercept_key is not None and key == intercept_key:
+                    continue
+                constraint_map[idx] = (lb, ub)
+        elif term == WILDCARD:
+            prefix = name + DELIMITER
+            for key, idx in feature_key_to_index.items():
+                if key.startswith(prefix):
+                    if idx in constraint_map:
+                        raise ValueError(
+                            f"Conflicting bounds for feature key {key!r}: already "
+                            f"{constraint_map[idx]}, attempted {(lb, ub)}"
+                        )
+                    constraint_map[idx] = (lb, ub)
+        else:
+            idx = feature_key_to_index.get(name + DELIMITER + term)
+            if idx is not None:
+                if idx in constraint_map:
+                    raise ValueError(
+                        f"Conflicting bounds for feature name={name!r} term={term!r}: "
+                        f"already {constraint_map[idx]}, attempted {(lb, ub)}"
+                    )
+                constraint_map[idx] = (lb, ub)
+
+    if saw_full_wildcard and len(entries) > 1:
+        raise ValueError(
+            "When name and term are both wildcards no other constraints may be "
+            f"specified: {constraint_string!r}"
+        )
+
+    return constraint_map or None

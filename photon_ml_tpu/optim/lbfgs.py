@@ -94,6 +94,7 @@ def lbfgs_minimize(
     w0: Array,
     config: OptimizerConfig = OptimizerConfig.lbfgs_default(),
     l1_weight: Array | float = 0.0,
+    bounds: Optional[Tuple[Array, Array]] = None,
 ) -> OptResult:
     """Minimize f(w) + l1_weight * ||w||_1.
 
@@ -101,7 +102,7 @@ def lbfgs_minimize(
     (close over data, or partially apply before calling). For a traced/
     data-dependent objective, use :func:`lbfgs_minimize_` below.
     """
-    return lbfgs_minimize_(value_and_grad_fn, w0, config, l1_weight)
+    return lbfgs_minimize_(value_and_grad_fn, w0, config, l1_weight, bounds)
 
 
 def lbfgs_minimize_(
@@ -109,6 +110,7 @@ def lbfgs_minimize_(
     w0: Array,
     config: OptimizerConfig,
     l1_weight: Array | float = 0.0,
+    bounds: Optional[Tuple[Array, Array]] = None,
 ) -> OptResult:
     """Non-jitted body (callable from inside other jitted code / vmap)."""
     m = config.num_corrections
@@ -121,9 +123,22 @@ def lbfgs_minimize_(
     def F_of(w, f):
         return f + l1 * jnp.sum(jnp.abs(w))
 
+    def reduced_pg(w, g):
+        """(Pseudo-)gradient with bound-blocked components zeroed: at an
+        active bound whose descent direction (-pg) points outward, the
+        coordinate cannot move, so it must not steer the direction or the
+        convergence test (standard gradient-projection reduction)."""
+        pg = _pseudo_gradient(w, g, l1)
+        if bounds is not None:
+            blocked = ((w >= bounds[1]) & (pg < 0.0)) | ((w <= bounds[0]) & (pg > 0.0))
+            pg = jnp.where(blocked, 0.0, pg)
+        return pg
+
+    if bounds is not None:
+        w0 = jnp.clip(w0, bounds[0], bounds[1])
     f0, g0 = value_and_grad_fn(w0)
     F0 = F_of(w0, f0)
-    pg0 = _pseudo_gradient(w0, g0, l1)
+    pg0 = reduced_pg(w0, g0)
     pg0_norm = jnp.linalg.norm(pg0)
 
     hist0 = jnp.full((max_iter + 1,), jnp.nan, dtype)
@@ -148,13 +163,22 @@ def lbfgs_minimize_(
     def orthant_project(w_trial, xi):
         # project onto the orthant xi; identity when no L1
         projected = jnp.where(w_trial * xi > 0.0, w_trial, 0.0)
-        return jnp.where(l1 > 0.0, projected, w_trial)
+        w_trial = jnp.where(l1 > 0.0, projected, w_trial)
+        # box-constraint projection after each step (LBFGS.scala:94-97 via
+        # OptimizationUtils.projectCoefficientsToHypercube). Caveat: combined
+        # with L1 and a box that excludes 0, the clip can move an
+        # orthant-zeroed coordinate onto a nonzero bound — the reference has
+        # the same post-hoc-projection semantics (OWL-QN cannot honor boxes
+        # that exclude the origin); prefer L2 or pure bounds in that regime.
+        if bounds is not None:
+            w_trial = jnp.clip(w_trial, bounds[0], bounds[1])
+        return w_trial
 
     def cond(s: _State):
         return s.reason == 0
 
     def body(s: _State):
-        pg = _pseudo_gradient(s.w, s.g, l1)
+        pg = reduced_pg(s.w, s.g)
         d = _two_loop_direction(pg, s.S, s.Y, s.rho, s.k, m)
         # OWL-QN: constrain direction to the descent orthant of -pg
         d = jnp.where(l1 > 0.0, jnp.where(d * pg < 0.0, d, 0.0), d)
@@ -178,7 +202,11 @@ def lbfgs_minimize_(
             w_t = orthant_project(s.w + t * d, xi)
             f_t, g_t = value_and_grad_fn(w_t)
             F_t = F_of(w_t, f_t)
-            ok_t = F_t <= s.F + _C1 * t * deriv
+            # Armijo on the step ACTUALLY taken (pg . (w_t - w)): identical to
+            # _C1*t*deriv when nothing is projected, but correct when the
+            # orthant/box projection removes part of the direction — the
+            # OWL-QN sufficient-decrease form, also right for bounds.
+            ok_t = F_t <= s.F + _C1 * jnp.dot(pg, w_t - s.w)
             t_next = jnp.where(ok_t, t, t * 0.5)
             return (t_next, w_t, f_t, g_t, F_t, steps + 1, ok_t)
 
@@ -201,7 +229,7 @@ def lbfgs_minimize_(
         g_out = jnp.where(ls_ok, g_new, s.g)
         F_out = jnp.where(ls_ok, F_new, s.F)
 
-        pg_new = _pseudo_gradient(w_out, g_out, l1)
+        pg_new = reduced_pg(w_out, g_out)
         pg_norm = jnp.linalg.norm(pg_new)
         it = s.iteration + 1
 

@@ -27,6 +27,7 @@ from photon_ml_tpu.ops import losses as losses_mod
 from photon_ml_tpu.ops.normalization import NormalizationContext
 from photon_ml_tpu.ops.objective import GLMBatch, GLMObjective
 from photon_ml_tpu.optim.common import OptimizerConfig, OptResult
+from photon_ml_tpu.optim.constraints import BoxConstraints
 from photon_ml_tpu.optim.lbfgs import lbfgs_minimize_
 from photon_ml_tpu.optim.tron import tron_minimize_
 from photon_ml_tpu.ops.regularization import RegularizationContext
@@ -63,6 +64,9 @@ class GLMOptimizationProblem:
     )
     compute_variance: bool = False
     axis_name: Optional[str] = None  # set under shard_map for psum reductions
+    # box constraints on coefficients (OptimizationUtils.projectCoefficientsToHypercube);
+    # densified (lower, upper) arrays — see optim/constraints.py
+    constraints: Optional["BoxConstraints"] = None
 
     def __post_init__(self):
         if self.optimizer_config is None:
@@ -114,12 +118,17 @@ class GLMOptimizationProblem:
             else jnp.zeros((batch.dim,), jnp.float32)
         )
         vg = lambda w: obj.value_and_grad(w, batch, norm, l2)
+        bounds = (
+            (self.constraints.lower, self.constraints.upper)
+            if self.constraints is not None
+            else None
+        )
 
         if self.optimizer == OptimizerType.TRON:
             hvp = lambda w, v: obj.hessian_vector(w, v, batch, norm, l2)
-            result = tron_minimize_(vg, hvp, w0, self.optimizer_config)
+            result = tron_minimize_(vg, hvp, w0, self.optimizer_config, bounds=bounds)
         else:
-            result = lbfgs_minimize_(vg, w0, self.optimizer_config, l1_weight=l1)
+            result = lbfgs_minimize_(vg, w0, self.optimizer_config, l1_weight=l1, bounds=bounds)
 
         w = result.coefficients
         variances = None

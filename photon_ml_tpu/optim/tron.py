@@ -22,7 +22,7 @@ validation, as in the reference (Params.scala:177-180).
 from __future__ import annotations
 
 import functools
-from typing import Callable, NamedTuple, Tuple
+from typing import Callable, NamedTuple, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -112,18 +112,33 @@ def tron_minimize(
     hvp_fn: Callable[[Array, Array], Array],
     w0: Array,
     config: OptimizerConfig = OptimizerConfig.tron_default(),
+    bounds: Optional[Tuple[Array, Array]] = None,
 ) -> OptResult:
-    return tron_minimize_(value_and_grad_fn, hvp_fn, w0, config)
+    return tron_minimize_(value_and_grad_fn, hvp_fn, w0, config, bounds)
 
 
-def tron_minimize_(value_and_grad_fn, hvp_fn, w0, config: OptimizerConfig) -> OptResult:
+def tron_minimize_(
+    value_and_grad_fn, hvp_fn, w0, config: OptimizerConfig, bounds=None
+) -> OptResult:
     """Non-jitted body (callable from inside jit / vmap / shard_map)."""
     dtype = w0.dtype
     max_iter = config.max_iterations
     tol = config.tolerance
 
+    def reduced_grad(w, g):
+        """Gradient with bound-blocked components zeroed (a coordinate at an
+        active bound whose descent direction points outward cannot move):
+        steers the CG subproblem into the free subspace and keeps the
+        convergence test honest at the constrained optimum."""
+        if bounds is None:
+            return g
+        blocked = ((w >= bounds[1]) & (g < 0.0)) | ((w <= bounds[0]) & (g > 0.0))
+        return jnp.where(blocked, 0.0, g)
+
+    if bounds is not None:
+        w0 = jnp.clip(w0, bounds[0], bounds[1])
     f0, g0 = value_and_grad_fn(w0)
-    g0_norm = jnp.linalg.norm(g0)
+    g0_norm = jnp.linalg.norm(reduced_grad(w0, g0))
     hist0 = jnp.full((max_iter + 1,), jnp.nan, dtype)
     s0 = _State(
         w=w0,
@@ -144,16 +159,38 @@ def tron_minimize_(value_and_grad_fn, hvp_fn, w0, config: OptimizerConfig) -> Op
 
     def body(s: _State):
         step, r = _truncated_cg(
-            lambda v: hvp_fn(s.w, v), s.g, s.delta, config.max_cg_iterations, dtype
+            lambda v: hvp_fn(s.w, v),
+            reduced_grad(s.w, s.g),
+            s.delta,
+            config.max_cg_iterations,
+            dtype,
         )
-        snorm = jnp.linalg.norm(step)
+
+        # clip the trial point BEFORE evaluating so the carried (w, f, g)
+        # stay consistent (the reference projects after evaluation,
+        # TRON.scala:200-202; evaluating at the projected point is strictly
+        # more correct for the trust-region accept/shrink decisions)
+        w_trial = s.w + step
+        if bounds is not None:
+            w_trial = jnp.clip(w_trial, bounds[0], bounds[1])
+            # the step actually taken is the clipped one: measure the
+            # quadratic model (gs, prered) and the radius-update step length
+            # on it, else improving clipped steps are judged against the
+            # unclipped step's predicted reduction and rejected forever
+            step = w_trial - s.w
+            snorm = jnp.linalg.norm(step)
+            gs = jnp.dot(s.g, step)
+            prered = -(gs + 0.5 * jnp.dot(step, hvp_fn(s.w, step)))
+        else:
+            snorm = jnp.linalg.norm(step)
+            gs = jnp.dot(s.g, step)
+            # r = -g - H s  =>  -0.5*(gs - s.r) = -(g.s + 0.5 s.H.s)
+            prered = -0.5 * (gs - jnp.dot(step, r))
+        f_new, g_new = value_and_grad_fn(w_trial)
+        actred = s.f - f_new
+
         # first iteration: shrink the initial radius to the first step length
         delta = jnp.where(s.iteration == 0, jnp.minimum(s.delta, snorm), s.delta)
-
-        gs = jnp.dot(s.g, step)
-        prered = -0.5 * (gs - jnp.dot(step, r))
-        f_new, g_new = value_and_grad_fn(s.w + step)
-        actred = s.f - f_new
 
         # radius update (interpolated step-length alpha, LIBLINEAR rules)
         denom = f_new - s.f - gs
@@ -174,12 +211,12 @@ def tron_minimize_(value_and_grad_fn, hvp_fn, w0, config: OptimizerConfig) -> Op
         )
 
         accept = actred > _ETA0 * prered
-        w_out = jnp.where(accept, s.w + step, s.w)
+        w_out = jnp.where(accept, w_trial, s.w)
         f_out = jnp.where(accept, f_new, s.f)
         g_out = jnp.where(accept, g_new, s.g)
         failures = jnp.where(accept, 0, s.failures + 1).astype(jnp.int32)
 
-        g_norm = jnp.linalg.norm(g_out)
+        g_norm = jnp.linalg.norm(reduced_grad(w_out, g_out))
         it = s.iteration + 1
         grad_ok = g_norm <= tol * jnp.maximum(g0_norm, _EPS)
         func_ok = accept & (jnp.abs(actred) <= tol * jnp.maximum(jnp.abs(f0), _EPS))
@@ -213,7 +250,7 @@ def tron_minimize_(value_and_grad_fn, hvp_fn, w0, config: OptimizerConfig) -> Op
     return OptResult(
         coefficients=final.w,
         value=final.f,
-        grad_norm=jnp.linalg.norm(final.g),
+        grad_norm=jnp.linalg.norm(reduced_grad(final.w, final.g)),
         iterations=final.iteration,
         reason=final.reason,
         value_history=final.value_history,

@@ -1,0 +1,130 @@
+"""Dimensionality projectors for random-effect feature spaces.
+
+Reference spec: projector/ProjectionMatrix.scala:31-119 (dense Gaussian
+random projection: entries ~ N(0, 1)/k clipped to [-1, 1], optional dummy
+intercept row selecting the last original column; projectFeatures = M @ x,
+projectCoefficients = M.T @ c i.e. projected -> original),
+projector/RandomEffectProjector.scala:35-77 (factory over ProjectorType),
+projector/ProjectionMatrixBroadcast.scala:30-96 (shared matrix applied per
+datum — here one dense matmul over the whole batch),
+model/RandomEffectModelInProjectedSpace.scala:83 (project model coefficients
+back for scoring).
+
+TPU-native: the matrix is replicated (the pjit analogue of a Spark
+broadcast); feature projection is a single (N, d) @ (d, k) matmul that XLA
+tiles onto the MXU, and coefficient back-projection for a whole stacked
+random-effect model is one (E, k) @ (k, d) matmul. The INDEX_MAP projector
+(per-entity gather indices) lives in data/game.py where the entity tensors
+are built; IDENTITY is the absence of projection.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from photon_ml_tpu.types import ProjectorType
+
+Array = jax.Array
+
+# MathConst.scala:24
+RANDOM_SEED = 1234567890
+
+
+def gaussian_random_projection_matrix(
+    projected_dim: int,
+    original_dim: int,
+    keep_intercept: bool = True,
+    seed: int = RANDOM_SEED,
+) -> np.ndarray:
+    """Dense Gaussian random projection matrix, reference semantics.
+
+    Entries are drawn N(0, 1), divided by ``projected_dim`` (the reference
+    deliberately uses std = k rather than sqrt(k) to keep magnitudes small,
+    ProjectionMatrix.scala:96-99) and clipped to [-1, 1]. With
+    ``keep_intercept`` a final row is appended that passes the last original
+    column (the intercept) through untouched, so the output has
+    ``projected_dim + 1`` rows.
+    """
+    rng = np.random.default_rng(seed)
+    m = rng.standard_normal((projected_dim, original_dim)) / float(projected_dim)
+    m = np.clip(m, -1.0, 1.0).astype(np.float32)
+    if keep_intercept:
+        intercept_row = np.zeros((1, original_dim), np.float32)
+        intercept_row[0, original_dim - 1] = 1.0
+        m = np.concatenate([m, intercept_row], axis=0)
+    return m
+
+
+@dataclasses.dataclass(frozen=True)
+class ProjectionMatrixProjector:
+    """Shared dense projection matrix, replicated across the mesh.
+
+    ``matrix`` has shape (k, d): k = projected-space dim (incl. intercept
+    row when kept), d = original-space dim.
+    """
+
+    matrix: Array  # (k, d)
+
+    @property
+    def projected_dim(self) -> int:
+        return self.matrix.shape[0]
+
+    @property
+    def original_dim(self) -> int:
+        return self.matrix.shape[1]
+
+    def project_features(self, features: Array) -> Array:
+        """(..., d) -> (..., k): batched M @ x as one MXU matmul."""
+        return features @ self.matrix.T
+
+    def project_sparse_features(
+        self, indices: np.ndarray, values: np.ndarray, row_splits: np.ndarray
+    ) -> np.ndarray:
+        """Host-side CSR rows -> dense projected (N, k) without densifying
+        the original d-wide matrix: gather the needed columns of M."""
+        mat = np.asarray(self.matrix)
+        n = len(row_splits) - 1
+        out = np.zeros((n, mat.shape[0]), np.float32)
+        rows = np.repeat(np.arange(n), np.diff(row_splits))
+        contrib = mat[:, indices].T * values[:, None]  # (nnz, k)
+        np.add.at(out, rows, contrib)
+        return out
+
+    def project_coefficients(self, coefficients: Array) -> Array:
+        """Projected-space coefficients (..., k) -> original space (..., d).
+
+        One matmul for a whole stacked random-effect model
+        (RandomEffectModelInProjectedSpace.toRandomEffectModel analogue).
+        """
+        return coefficients @ self.matrix
+
+    def to_summary_string(self) -> str:
+        flat = np.asarray(self.matrix).ravel()
+        return (
+            f"ProjectionMatrix(k={self.projected_dim}, d={self.original_dim}): "
+            f"mean={flat.mean():.3e} var={flat.var():.3e} l2={np.linalg.norm(flat):.3e}"
+        )
+
+
+def build_projector(
+    projector_type: ProjectorType,
+    original_dim: int,
+    projected_dim: Optional[int] = None,
+    keep_intercept: bool = True,
+    seed: int = RANDOM_SEED,
+) -> Optional[ProjectionMatrixProjector]:
+    """Factory mirroring RandomEffectProjector.buildRandomEffectProjector
+    (projector/RandomEffectProjector.scala:54-77): RANDOM -> Gaussian matrix
+    projector; INDEX_MAP / IDENTITY -> None (handled structurally by the
+    random-effect dataset build)."""
+    if projector_type == ProjectorType.RANDOM:
+        if projected_dim is None:
+            raise ValueError("RANDOM projector requires projected_dim")
+        m = gaussian_random_projection_matrix(projected_dim, original_dim, keep_intercept, seed)
+        return ProjectionMatrixProjector(jnp.asarray(m))
+    return None

@@ -1,0 +1,210 @@
+"""Factored random effect: alternating (v, M) optimization + MF model.
+
+Reference behavior: algorithm/FactoredRandomEffectCoordinate.scala:36-285
+(alternating RE-solve in latent space + latent matrix refit over Kronecker
+features), model/MatrixFactorizationModel.scala (latent-factor dot scoring),
+optimization/game/MFOptimizationConfiguration.scala (config parsing).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from photon_ml_tpu.algorithm.factored_random_effect import (
+    FactoredRandomEffectCoordinate,
+    FactoredState,
+    MFOptimizationConfig,
+)
+from photon_ml_tpu.algorithm.coordinate_descent import CoordinateDescent
+from photon_ml_tpu.algorithm.fixed_effect import FixedEffectCoordinate
+from photon_ml_tpu.data.game import RandomEffectDataConfig, build_random_effect_dataset
+from photon_ml_tpu.models.game import FactoredRandomEffectModel, MatrixFactorizationModel
+from photon_ml_tpu.ops import losses as losses_mod
+from photon_ml_tpu.optim.common import OptimizerConfig
+from photon_ml_tpu.types import TaskType
+from tests.game_test_utils import make_glmix_data
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(3)
+
+
+def _identity_re_dataset(rng, num_users=10, d_random=6):
+    data, truth = make_glmix_data(rng, num_users=num_users, d_random=d_random, noise=0.1)
+    config = RandomEffectDataConfig(
+        random_effect_id="userId", feature_shard_id="per_user", projector="IDENTITY"
+    )
+    return data, truth, build_random_effect_dataset(data, config)
+
+
+def test_mf_config_parse():
+    cfg = MFOptimizationConfig.parse("3,7")
+    assert cfg.num_inner_iterations == 3
+    assert cfg.latent_space_dimension == 7
+
+
+def test_initial_state_shapes(rng):
+    data, _, ds = _identity_re_dataset(rng)
+    coord = FactoredRandomEffectCoordinate(
+        dataset=ds,
+        task=TaskType.LOGISTIC_REGRESSION,
+        mf_config=MFOptimizationConfig(1, 3),
+    )
+    st = coord.initial_coefficients()
+    assert st.v.shape == (ds.num_entities, 3)
+    assert st.matrix.shape == (3, ds.local_dim)
+    np.testing.assert_allclose(np.asarray(st.v), 0.0)
+
+
+def test_latent_objective_matches_explicit_kronecker(rng):
+    """The implicit-Kronecker margin <M, v x^T> must equal the margin of the
+    flattened M against explicitly materialized kron(x, v) features
+    (FactoredRandomEffectCoordinate.scala:267-284 semantics)."""
+    k, d = 3, 5
+    x = rng.normal(size=(d,)).astype(np.float32)
+    v = rng.normal(size=(k,)).astype(np.float32)
+    M = rng.normal(size=(k, d)).astype(np.float32)
+    implicit = float(v @ (M @ x))
+    # kron(x, v)[j*k + i] = x_j * v_i against column-major flattened M
+    kron = np.kron(x, v)
+    m_flat_colmajor = M.ravel(order="F")
+    explicit = float(kron @ m_flat_colmajor)
+    np.testing.assert_allclose(implicit, explicit, rtol=1e-5)
+
+
+def test_update_reduces_loss_and_scores(rng):
+    data, truth, ds = _identity_re_dataset(rng)
+    coord = FactoredRandomEffectCoordinate(
+        dataset=ds,
+        task=TaskType.LOGISTIC_REGRESSION,
+        mf_config=MFOptimizationConfig(num_inner_iterations=2, latent_space_dimension=3),
+        re_optimizer_config=OptimizerConfig(max_iterations=10, tolerance=1e-6),
+        latent_optimizer_config=OptimizerConfig(max_iterations=10, tolerance=1e-6),
+    )
+    st0 = coord.initial_coefficients()
+    loss = losses_mod.for_task(TaskType.LOGISTIC_REGRESSION)
+    resid = jnp.zeros(data.num_rows)
+
+    def data_loss(scores):
+        return float(
+            jnp.sum(loss.loss(jnp.asarray(scores), jnp.asarray(data.response)))
+        )
+
+    loss0 = data_loss(coord.score(st0))
+    st1, res = coord.update(resid, st0)
+    loss1 = data_loss(coord.score(st1))
+    assert loss1 < loss0
+    assert np.isfinite(np.asarray(res.value)).all()
+    # latent matrix actually moved
+    assert not np.allclose(np.asarray(st1.matrix), np.asarray(st0.matrix))
+
+
+def test_score_gather_matches_dense_math(rng):
+    data, truth, ds = _identity_re_dataset(rng)
+    coord = FactoredRandomEffectCoordinate(
+        dataset=ds,
+        task=TaskType.LOGISTIC_REGRESSION,
+        mf_config=MFOptimizationConfig(1, 4),
+    )
+    st = FactoredState(
+        v=jnp.asarray(rng.normal(size=(ds.num_entities, 4)).astype(np.float32)),
+        matrix=jnp.asarray(rng.normal(size=(4, ds.local_dim)).astype(np.float32)),
+    )
+    scores = np.asarray(coord.score(st))
+    # check a handful of rows against dense math
+    W = np.asarray(st.v) @ np.asarray(st.matrix)  # (E, d)
+    for row in [0, 7, data.num_rows - 1]:
+        pos = int(ds.entity_pos[row])
+        x_row = truth["x_random"][row]
+        np.testing.assert_allclose(scores[row], x_row @ W[pos], rtol=1e-4, atol=1e-5)
+
+
+def test_regularization_term(rng):
+    from photon_ml_tpu.ops.regularization import RegularizationContext
+
+    data, _, ds = _identity_re_dataset(rng, num_users=4)
+    coord = FactoredRandomEffectCoordinate(
+        dataset=ds,
+        task=TaskType.LOGISTIC_REGRESSION,
+        mf_config=MFOptimizationConfig(1, 2),
+        re_regularization=RegularizationContext.l2(2.0),
+        latent_regularization=RegularizationContext.l2(4.0),
+    )
+    st = FactoredState(
+        v=jnp.ones((ds.num_entities, 2)),
+        matrix=jnp.ones((2, ds.local_dim)),
+    )
+    expected = 0.5 * 2.0 * ds.num_entities * 2 + 0.5 * 4.0 * 2 * ds.local_dim
+    np.testing.assert_allclose(float(coord.regularization_term(st)), expected, rtol=1e-5)
+
+
+def test_in_coordinate_descent_with_fixed_effect(rng):
+    """Full GAME: fixed effect + factored random effect through CD."""
+    data, truth, ds = _identity_re_dataset(rng, num_users=8)
+    from photon_ml_tpu.data.game import build_fixed_effect_batch
+
+    from photon_ml_tpu.optim.problem import GLMOptimizationProblem
+
+    batch = build_fixed_effect_batch(data, "global")
+    fixed = FixedEffectCoordinate(
+        batch=batch,
+        problem=GLMOptimizationProblem(
+            task=TaskType.LOGISTIC_REGRESSION,
+            optimizer_config=OptimizerConfig(max_iterations=20, tolerance=1e-6),
+        ),
+    )
+    factored = FactoredRandomEffectCoordinate(
+        dataset=ds,
+        task=TaskType.LOGISTIC_REGRESSION,
+        mf_config=MFOptimizationConfig(1, 3),
+        re_optimizer_config=OptimizerConfig(max_iterations=8, tolerance=1e-6),
+        latent_optimizer_config=OptimizerConfig(max_iterations=8, tolerance=1e-6),
+    )
+    loss = losses_mod.for_task(TaskType.LOGISTIC_REGRESSION)
+    y = jnp.asarray(data.response)
+    cd = CoordinateDescent(
+        {"fixed": fixed, "factored-re": factored},
+        training_loss=lambda s: jnp.sum(loss.loss(s, y)),
+    )
+    result = cd.run(num_iterations=2, num_rows=data.num_rows)
+    assert result.objective_history[-1] < result.objective_history[0]
+    assert isinstance(result.coefficients["factored-re"], FactoredState)
+
+
+def test_matrix_factorization_model(rng):
+    mf = MatrixFactorizationModel(
+        row_effect_type="userId",
+        col_effect_type="movieId",
+        row_latent_factors=jnp.asarray(rng.normal(size=(5, 3)).astype(np.float32)),
+        col_latent_factors=jnp.asarray(rng.normal(size=(7, 3)).astype(np.float32)),
+    )
+    rows = jnp.asarray([0, 2, 4, -1])
+    cols = jnp.asarray([1, 6, -1, 3])
+    s = np.asarray(mf.score(rows, cols))
+    expected0 = float(
+        np.asarray(mf.row_latent_factors)[0] @ np.asarray(mf.col_latent_factors)[1]
+    )
+    np.testing.assert_allclose(s[0], expected0, rtol=1e-5)
+    # missing factors -> score 0 (reference cogroup semantics)
+    assert s[2] == 0.0 and s[3] == 0.0
+    assert mf.num_latent_factors == 3
+    assert "k=3" in mf.to_summary_string()
+
+
+def test_factored_model_to_random_effect_model(rng):
+    frem = FactoredRandomEffectModel(
+        latent_coefficients=jnp.asarray(rng.normal(size=(4, 2)).astype(np.float32)),
+        latent_matrix=jnp.asarray(rng.normal(size=(2, 6)).astype(np.float32)),
+        random_effect_id="userId",
+        feature_shard_id="per_user",
+        task=TaskType.LOGISTIC_REGRESSION,
+    )
+    rem = frem.to_random_effect_model(jnp.tile(jnp.arange(6, dtype=jnp.int32), (4, 1)))
+    assert rem.coefficients.shape == (4, 6)
+    np.testing.assert_allclose(
+        np.asarray(rem.coefficients),
+        np.asarray(frem.latent_coefficients) @ np.asarray(frem.latent_matrix),
+        rtol=1e-5,
+    )

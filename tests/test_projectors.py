@@ -1,0 +1,144 @@
+"""Random Gaussian projection: matrix semantics + RE dataset integration.
+
+Reference behavior: projector/ProjectionMatrix.scala:31-119 (N(0,1)/k
+entries clipped to [-1,1], intercept pass-through row, projectFeatures /
+projectCoefficients), projector/ProjectionMatrixBroadcast.scala (shared
+matrix), RandomEffectModelInProjectedSpace.scala:83 (project back).
+"""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from photon_ml_tpu.algorithm.random_effect import RandomEffectCoordinate
+from photon_ml_tpu.data.game import RandomEffectDataConfig, build_random_effect_dataset
+from photon_ml_tpu.optim.common import OptimizerConfig
+from photon_ml_tpu.projectors import (
+    ProjectionMatrixProjector,
+    build_projector,
+    gaussian_random_projection_matrix,
+)
+from photon_ml_tpu.types import ProjectorType, TaskType
+from tests.game_test_utils import make_glmix_data
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(42)
+
+
+class TestProjectionMatrix:
+    def test_shape_and_intercept_row(self):
+        m = gaussian_random_projection_matrix(8, 10, keep_intercept=True, seed=1)
+        assert m.shape == (9, 10)
+        # dummy intercept row: all zero except last column = 1
+        np.testing.assert_allclose(m[-1, :-1], 0.0)
+        assert m[-1, -1] == 1.0
+
+    def test_no_intercept_shape(self):
+        m = gaussian_random_projection_matrix(8, 10, keep_intercept=False, seed=1)
+        assert m.shape == (8, 10)
+
+    def test_entries_scaled_and_clipped(self):
+        k = 4
+        m = gaussian_random_projection_matrix(k, 1000, keep_intercept=False, seed=1)
+        assert np.abs(m).max() <= 1.0
+        # entries ~ N(0, 1/k^2): std should be close to 1/k
+        assert abs(m.std() - 1.0 / k) < 0.05 / k
+
+    def test_deterministic_in_seed(self):
+        a = gaussian_random_projection_matrix(4, 7, seed=9)
+        b = gaussian_random_projection_matrix(4, 7, seed=9)
+        np.testing.assert_array_equal(a, b)
+
+    def test_project_features_and_coefficients_transpose_pair(self, rng):
+        m = gaussian_random_projection_matrix(5, 12, keep_intercept=False, seed=2)
+        proj = ProjectionMatrixProjector(jnp.asarray(m))
+        x = rng.normal(size=(3, 12)).astype(np.float32)
+        fx = np.asarray(proj.project_features(jnp.asarray(x)))
+        np.testing.assert_allclose(fx, x @ m.T, rtol=1e-5)
+        c = rng.normal(size=(7, 5)).astype(np.float32)  # stacked (E, k)
+        back = np.asarray(proj.project_coefficients(jnp.asarray(c)))
+        np.testing.assert_allclose(back, c @ m, rtol=1e-5)
+
+    def test_sparse_projection_matches_dense(self, rng):
+        m = gaussian_random_projection_matrix(6, 20, keep_intercept=False, seed=3)
+        proj = ProjectionMatrixProjector(jnp.asarray(m))
+        dense = rng.normal(size=(4, 20)).astype(np.float32)
+        dense[dense < 0.5] = 0.0  # sparsify
+        mask = dense != 0
+        indices = np.nonzero(mask)[1].astype(np.int64)
+        values = dense[mask].astype(np.float32)
+        row_splits = np.concatenate([[0], np.cumsum(mask.sum(1))])
+        out = proj.project_sparse_features(indices, values, row_splits)
+        np.testing.assert_allclose(out, dense @ m.T, rtol=1e-4, atol=1e-5)
+
+    def test_factory(self):
+        assert build_projector(ProjectorType.IDENTITY, 10) is None
+        assert build_projector(ProjectorType.INDEX_MAP, 10) is None
+        p = build_projector(ProjectorType.RANDOM, 10, projected_dim=4)
+        assert p.projected_dim == 5  # + intercept row
+        with pytest.raises(ValueError):
+            build_projector(ProjectorType.RANDOM, 10)
+
+
+class TestRandomProjectedDataset:
+    def test_build_and_train(self, rng):
+        data, truth = make_glmix_data(rng, num_users=12, d_random=6)
+        k = 4
+        config = RandomEffectDataConfig(
+            random_effect_id="userId",
+            feature_shard_id="per_user",
+            projector="RANDOM",
+            random_projection_dim=k,
+            seed=5,
+        )
+        ds = build_random_effect_dataset(data, config)
+        assert ds.local_dim == k + 1  # + intercept row
+        assert ds.x.shape[0] >= 12
+
+        # features in the dataset equal the projected originals
+        m = gaussian_random_projection_matrix(
+            k, data.shards["per_user"].dim, True, config.seed
+        )
+        row0 = int(ds.row_index[0, 0])
+        x0 = truth["x_random"][row0] @ m.T
+        np.testing.assert_allclose(np.asarray(ds.x[0, 0]), x0, rtol=1e-4, atol=1e-5)
+
+        # a vmapped solve over the projected space runs and reduces loss
+        coord = RandomEffectCoordinate(
+            dataset=ds,
+            task=TaskType.LOGISTIC_REGRESSION,
+            optimizer_config=OptimizerConfig(max_iterations=15, tolerance=1e-6),
+        )
+        w, res = coord.update(jnp.zeros(data.num_rows), coord.initial_coefficients())
+        assert w.shape == (ds.num_entities, k + 1)
+        assert np.isfinite(np.asarray(res.value)).all()
+
+        # scoring path agrees with direct projected dot product
+        scores = np.asarray(coord.score(w))
+        pos0 = int(ds.entity_pos[row0])
+        expected = float(x0 @ np.asarray(w[pos0]))
+        np.testing.assert_allclose(scores[row0], expected, rtol=1e-4, atol=1e-5)
+
+    def test_coefficients_project_back_to_original_space(self, rng):
+        data, _ = make_glmix_data(rng, num_users=6, d_random=5)
+        k = 3
+        config = RandomEffectDataConfig(
+            random_effect_id="userId",
+            feature_shard_id="per_user",
+            projector="RANDOM",
+            random_projection_dim=k,
+            seed=11,
+        )
+        ds = build_random_effect_dataset(data, config)
+        proj = ProjectionMatrixProjector(
+            jnp.asarray(
+                gaussian_random_projection_matrix(
+                    k, data.shards["per_user"].dim, True, config.seed
+                )
+            )
+        )
+        coefs = jnp.asarray(rng.normal(size=(ds.num_entities, k + 1)).astype(np.float32))
+        back = proj.project_coefficients(coefs)
+        assert back.shape == (ds.num_entities, data.shards["per_user"].dim)
