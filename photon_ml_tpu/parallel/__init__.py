@@ -1,0 +1,34 @@
+"""Distributed execution layer: mesh construction, sharding, and distributed
+fixed/random-effect solvers.
+
+This package is the TPU-native replacement for the reference's Spark
+distributed substrate (SURVEY.md §5.8):
+
+  treeAggregate      -> psum over the mesh data axis inside one jitted kernel
+  broadcast          -> replicated arrays (PartitionSpec())
+  partitionBy/join   -> static entity->shard assignment + gathers at ingest
+  groupByKey shuffle -> one-time host-side bucketing (data/game.py)
+
+Design follows the scaling-book recipe: pick a Mesh, annotate shardings,
+let XLA insert collectives over ICI.
+"""
+
+from photon_ml_tpu.parallel.mesh import (
+    MeshContext,
+    data_mesh,
+    pad_rows,
+    pad_leading,
+)
+from photon_ml_tpu.parallel.distributed import (
+    DistributedFixedEffectSolver,
+    DistributedRandomEffectSolver,
+)
+
+__all__ = [
+    "MeshContext",
+    "data_mesh",
+    "pad_rows",
+    "pad_leading",
+    "DistributedFixedEffectSolver",
+    "DistributedRandomEffectSolver",
+]
