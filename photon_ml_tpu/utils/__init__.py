@@ -1,0 +1,26 @@
+"""Utility layer: timing, logging, date-range input discovery, text IO.
+
+(Reference analogues: util/Timer.scala, util/PhotonLogger.scala,
+util/DateRange.scala + IOUtils date-range expansion, IOUtils text writers.)
+"""
+
+from photon_ml_tpu.utils.timer import Timer
+from photon_ml_tpu.utils.logging import PhotonLogger
+from photon_ml_tpu.utils.date_range import DateRange, expand_date_range_paths
+from photon_ml_tpu.utils.io_utils import (
+    prepare_output_dir,
+    read_models_from_text,
+    write_basic_statistics,
+    write_models_in_text,
+)
+
+__all__ = [
+    "Timer",
+    "PhotonLogger",
+    "DateRange",
+    "expand_date_range_paths",
+    "prepare_output_dir",
+    "read_models_from_text",
+    "write_basic_statistics",
+    "write_models_in_text",
+]

@@ -1,0 +1,167 @@
+"""Evaluation.evaluate metric-map parity, model selection, bootstrap."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from photon_ml_tpu import bootstrap as bootstrap_mod
+from photon_ml_tpu import model_selection
+from photon_ml_tpu.evaluation import metrics as M
+from photon_ml_tpu.models.glm import Coefficients, GeneralizedLinearModel
+from photon_ml_tpu.ops.features import DenseFeatures
+from photon_ml_tpu.ops.normalization import NormalizationContext
+from photon_ml_tpu.ops.objective import GLMBatch
+from photon_ml_tpu.ops.regularization import RegularizationContext
+from photon_ml_tpu.optim.common import OptimizerConfig
+from photon_ml_tpu.optim.problem import GLMOptimizationProblem
+from photon_ml_tpu.types import OptimizerType, TaskType
+
+
+def _logistic_fixture(rng, n=400, d=5):
+    x = rng.normal(size=(n, d)).astype(np.float32)
+    w = rng.normal(size=d).astype(np.float32)
+    p = 1.0 / (1.0 + np.exp(-x @ w))
+    y = (p > rng.random(n)).astype(np.float32)
+    batch = GLMBatch.create(DenseFeatures(jnp.asarray(x)), jnp.asarray(y))
+    model = GeneralizedLinearModel(Coefficients(jnp.asarray(w)), TaskType.LOGISTIC_REGRESSION)
+    return batch, model, x, w, y
+
+
+def test_logistic_metric_map_keys_and_sanity(rng):
+    batch, model, x, w, y = _logistic_fixture(rng)
+    m = M.evaluate(model, batch)
+    for key in (
+        M.AREA_UNDER_PRECISION_RECALL,
+        M.AREA_UNDER_RECEIVER_OPERATOR_CHARACTERISTICS,
+        M.PEAK_F1_SCORE,
+        M.DATA_LOG_LIKELIHOOD,
+        M.AIKAKE_INFORMATION_CRITERION,
+    ):
+        assert key in m, key
+    assert 0.5 < m[M.AREA_UNDER_RECEIVER_OPERATOR_CHARACTERISTICS] <= 1.0
+    assert 0.0 < m[M.PEAK_F1_SCORE] <= 1.0
+    assert m[M.DATA_LOG_LIKELIHOOD] < 0.0
+    # true-model LL should beat a null model's LL
+    null = GeneralizedLinearModel(
+        Coefficients(jnp.zeros_like(model.coefficients.means)), TaskType.LOGISTIC_REGRESSION
+    )
+    m0 = M.evaluate(null, batch)
+    assert m[M.DATA_LOG_LIKELIHOOD] > m0[M.DATA_LOG_LIKELIHOOD]
+
+
+def test_aupr_peak_f1_vs_sklearn_style_reference(rng):
+    # hand-computed tiny case: scores separate perfectly
+    scores = jnp.asarray([0.9, 0.8, 0.2, 0.1])
+    labels = jnp.asarray([1.0, 1.0, 0.0, 0.0])
+    assert float(M.area_under_pr(scores, labels)) == pytest.approx(1.0)
+    assert float(M.peak_f1(scores, labels)) == pytest.approx(1.0)
+    # worst ordering: all negatives first
+    scores2 = jnp.asarray([0.9, 0.8, 0.2, 0.1])
+    labels2 = jnp.asarray([0.0, 0.0, 1.0, 1.0])
+    assert float(M.peak_f1(scores2, labels2)) == pytest.approx(2 / 3, abs=1e-6)
+
+
+def test_linear_regression_metric_map(rng):
+    n, d = 200, 4
+    x = rng.normal(size=(n, d)).astype(np.float32)
+    w = rng.normal(size=d).astype(np.float32)
+    y = (x @ w + 0.1 * rng.normal(size=n)).astype(np.float32)
+    batch = GLMBatch.create(DenseFeatures(jnp.asarray(x)), jnp.asarray(y))
+    model = GeneralizedLinearModel(Coefficients(jnp.asarray(w)), TaskType.LINEAR_REGRESSION)
+    m = M.evaluate(model, batch)
+    assert set(m) == {M.MEAN_ABSOLUTE_ERROR, M.MEAN_SQUARE_ERROR, M.ROOT_MEAN_SQUARE_ERROR}
+    assert m[M.ROOT_MEAN_SQUARE_ERROR] == pytest.approx(np.sqrt(m[M.MEAN_SQUARE_ERROR]))
+    assert m[M.ROOT_MEAN_SQUARE_ERROR] < 0.2
+
+
+def test_poisson_log_likelihood_formula(rng):
+    margins = jnp.asarray([0.1, -0.2, 0.5])
+    labels = jnp.asarray([1.0, 0.0, 3.0])
+    got = float(M.poisson_log_likelihood(margins, labels))
+    import math
+
+    expect = np.mean(
+        [
+            1.0 * 0.1 - math.exp(0.1) - math.lgamma(2.0),
+            0.0 * -0.2 - math.exp(-0.2) - math.lgamma(1.0),
+            3.0 * 0.5 - math.exp(0.5) - math.lgamma(4.0),
+        ]
+    )
+    assert got == pytest.approx(expect, rel=1e-6)
+
+
+def test_select_best_model_logistic(rng):
+    batch, model, x, w, y = _logistic_fixture(rng)
+    good = model
+    bad = GeneralizedLinearModel(
+        Coefficients(-model.coefficients.means), TaskType.LOGISTIC_REGRESSION
+    )
+    lam, best, all_metrics = model_selection.select_best_model(
+        [(0.1, bad), (1.0, good)], batch
+    )
+    assert lam == 1.0
+    assert best is good
+    assert set(all_metrics) == {0.1, 1.0}
+
+
+def test_bootstrap_training(rng):
+    n, d = 300, 3
+    x = rng.normal(size=(n, d)).astype(np.float32)
+    w_true = np.array([1.5, -2.0, 0.0], np.float32)
+    p = 1.0 / (1.0 + np.exp(-x @ w_true))
+    y = (p > rng.random(n)).astype(np.float32)
+    batch = GLMBatch.create(DenseFeatures(jnp.asarray(x)), jnp.asarray(y))
+    problem = GLMOptimizationProblem(
+        TaskType.LOGISTIC_REGRESSION,
+        OptimizerType.LBFGS,
+        OptimizerConfig(max_iterations=30, tolerance=1e-8),
+        RegularizationContext.l2(1.0),
+    )
+    res = bootstrap_mod.bootstrap_train(
+        problem,
+        batch,
+        NormalizationContext.identity(),
+        num_samples=8,
+        seed=3,
+        metrics_fn=lambda m: M.evaluate(m, batch),
+    )
+    assert len(res.models) == 8
+    assert len(res.coefficient_summaries) == d
+    # strong coefficients' CIs exclude zero; the null one includes it
+    assert not res.coefficient_summaries[0].contains_zero()
+    assert not res.coefficient_summaries[1].contains_zero()
+    assert res.coefficient_summaries[2].contains_zero()
+    auc = res.metric_summaries[M.AREA_UNDER_RECEIVER_OPERATOR_CHARACTERISTICS]
+    assert auc.min > 0.6
+    assert auc.min <= auc.median <= auc.max
+
+
+def test_bootstrap_weights_shape_and_total(rng):
+    import jax
+
+    w = bootstrap_mod.bootstrap_weights(jax.random.PRNGKey(0), 4, 50)
+    assert w.shape == (4, 50)
+    np.testing.assert_allclose(np.asarray(w.sum(axis=1)), 50.0)
+
+
+def test_metrics_padding_invariance(rng):
+    """weight-0 padding rows must not change any metric."""
+    batch, model, x, w, y = _logistic_fixture(rng, n=100)
+    m1 = M.evaluate(model, batch)
+    xp = np.concatenate([x, np.zeros((28, x.shape[1]), np.float32)])
+    yp = np.concatenate([y, np.zeros(28, np.float32)])
+    wp = np.concatenate([np.ones(100, np.float32), np.zeros(28, np.float32)])
+    padded = GLMBatch(
+        DenseFeatures(jnp.asarray(xp)), jnp.asarray(yp),
+        jnp.zeros(128, jnp.float32), jnp.asarray(wp),
+    )
+    m2 = M.evaluate(model, padded)
+    for k in m1:
+        assert m1[k] == pytest.approx(m2[k], rel=1e-5), k
+
+
+def test_confidently_wrong_is_penalized():
+    scores = jnp.asarray([1.0 - 1e-12])  # p ~ 1 but label 0
+    labels = jnp.asarray([0.0])
+    ll = float(M.logistic_log_likelihood(scores, labels))
+    assert ll < -15.0  # log(EPSILON), not +log(2)
