@@ -1,0 +1,269 @@
+"""Physical report tree + HTML / plain-text renderers.
+
+Reference spec: diagnostics/reporting/ (SURVEY.md §2.10) — the reference
+models rendered output as a typed tree (DocumentPhysicalReport →
+ChapterPhysicalReport → SectionPhysicalReport → {SimpleText, BulletedList,
+NumberedList, Plot} physical reports; reporting/html/*.scala renderers walk
+the tree emitting HTML with chapter/section numbering from a
+NumberingContext; reporting/text/*.scala emit plain text).
+
+This build keeps the same two-stage split (logical diagnostic reports are
+transformed into this physical tree, then rendered) but collapses the
+renderer strategy classes into two walkers. Plots are embedded as inline
+SVG (the reference rasterizes xchart plots through batik; here matplotlib
+renders straight to SVG, no raster round-trip).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import html as _html
+import io
+from typing import Dict, List, Optional, Sequence, Tuple, Union
+
+
+# ---------------------------------------------------------------------------
+# Physical report tree
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class SimpleTextReport:
+    """One paragraph (SimpleTextPhysicalReport.scala parity)."""
+
+    text: str
+
+
+@dataclasses.dataclass
+class BulletedListReport:
+    items: List[str]
+
+
+@dataclasses.dataclass
+class NumberedListReport:
+    items: List[str]
+
+
+@dataclasses.dataclass
+class TableReport:
+    """Header + rows of stringifiable cells.
+
+    The reference renders tables as preformatted text blocks inside
+    SimpleTextPhysicalReports; a first-class table node renders better HTML.
+    """
+
+    header: List[str]
+    rows: List[List[object]]
+    caption: str = ""
+
+
+@dataclasses.dataclass
+class PlotReport:
+    """An XY plot (PlotPhysicalReport.scala parity, matplotlib-rendered).
+
+    ``series``: name -> (x, y) arrays. Rendered lazily to SVG so building a
+    report tree stays cheap when the text renderer is used.
+    """
+
+    title: str
+    x_label: str
+    y_label: str
+    series: Dict[str, Tuple[Sequence[float], Sequence[float]]]
+    log_x: bool = False
+    log_y: bool = False
+    caption: str = ""
+
+    def to_svg(self) -> str:
+        import matplotlib
+
+        matplotlib.use("svg", force=False)
+        from matplotlib import pyplot as plt
+
+        fig, ax = plt.subplots(figsize=(7.0, 4.2), dpi=96)
+        try:
+            for name, (xs, ys) in self.series.items():
+                ax.plot(list(xs), list(ys), marker="o", markersize=3, label=name)
+            if self.log_x:
+                ax.set_xscale("log")
+            if self.log_y:
+                ax.set_yscale("log")
+            ax.set_title(self.title)
+            ax.set_xlabel(self.x_label)
+            ax.set_ylabel(self.y_label)
+            if len(self.series) > 1:
+                ax.legend(loc="best", fontsize=8)
+            ax.grid(True, alpha=0.3)
+            buf = io.StringIO()
+            fig.savefig(buf, format="svg", bbox_inches="tight")
+            return buf.getvalue()
+        finally:
+            plt.close(fig)
+
+
+LeafReport = Union[SimpleTextReport, BulletedListReport, NumberedListReport, TableReport, PlotReport]
+
+
+@dataclasses.dataclass
+class SectionReport:
+    """SectionPhysicalReport.scala parity: titled list of leaves/subsections."""
+
+    title: str
+    items: List[Union[LeafReport, "SectionReport"]] = dataclasses.field(default_factory=list)
+
+
+@dataclasses.dataclass
+class ChapterReport:
+    title: str
+    sections: List[SectionReport] = dataclasses.field(default_factory=list)
+
+
+@dataclasses.dataclass
+class DocumentReport:
+    title: str
+    chapters: List[ChapterReport] = dataclasses.field(default_factory=list)
+
+
+# ---------------------------------------------------------------------------
+# HTML renderer (reporting/html/*.scala parity)
+# ---------------------------------------------------------------------------
+
+_CSS = """
+body { font-family: -apple-system, 'Segoe UI', Helvetica, Arial, sans-serif;
+       margin: 2em auto; max-width: 70em; color: #1a1a1a; }
+h1 { border-bottom: 2px solid #444; padding-bottom: .3em; }
+h2 { border-bottom: 1px solid #999; padding-bottom: .2em; margin-top: 2em; }
+h3 { margin-top: 1.5em; }
+table { border-collapse: collapse; margin: 1em 0; }
+th, td { border: 1px solid #bbb; padding: .3em .7em; text-align: right; }
+th { background: #eee; }
+td:first-child, th:first-child { text-align: left; }
+caption { caption-side: top; font-weight: bold; text-align: left; }
+pre { background: #f6f6f6; padding: .8em; overflow-x: auto; }
+nav ul { list-style: none; }
+.plot svg { max-width: 100%; height: auto; }
+"""
+
+
+def _esc(s: object) -> str:
+    return _html.escape(str(s))
+
+
+def _fmt_cell(v: object) -> str:
+    if isinstance(v, float):
+        return f"{v:.6g}"
+    return str(v)
+
+
+def _render_leaf_html(item: LeafReport, out: List[str]) -> None:
+    if isinstance(item, SimpleTextReport):
+        out.append(f"<p>{_esc(item.text)}</p>")
+    elif isinstance(item, BulletedListReport):
+        out.append("<ul>" + "".join(f"<li>{_esc(i)}</li>" for i in item.items) + "</ul>")
+    elif isinstance(item, NumberedListReport):
+        out.append("<ol>" + "".join(f"<li>{_esc(i)}</li>" for i in item.items) + "</ol>")
+    elif isinstance(item, TableReport):
+        out.append("<table>")
+        if item.caption:
+            out.append(f"<caption>{_esc(item.caption)}</caption>")
+        out.append(
+            "<thead><tr>" + "".join(f"<th>{_esc(h)}</th>" for h in item.header) + "</tr></thead>"
+        )
+        out.append("<tbody>")
+        for row in item.rows:
+            out.append("<tr>" + "".join(f"<td>{_esc(_fmt_cell(c))}</td>" for c in row) + "</tr>")
+        out.append("</tbody></table>")
+    elif isinstance(item, PlotReport):
+        out.append('<div class="plot">')
+        out.append(item.to_svg())
+        if item.caption:
+            out.append(f"<p><em>{_esc(item.caption)}</em></p>")
+        out.append("</div>")
+    else:  # pragma: no cover - defensive
+        out.append(f"<pre>{_esc(item)}</pre>")
+
+
+def _render_section_html(
+    section: SectionReport, number: str, level: int, out: List[str]
+) -> None:
+    tag = f"h{min(level, 6)}"
+    anchor = "sec-" + number.replace(".", "-")
+    out.append(f'<{tag} id="{anchor}">{number} {_esc(section.title)}</{tag}>')
+    sub = 0
+    for item in section.items:
+        if isinstance(item, SectionReport):
+            sub += 1
+            _render_section_html(item, f"{number}.{sub}", level + 1, out)
+        else:
+            _render_leaf_html(item, out)
+
+
+def render_html(doc: DocumentReport) -> str:
+    """Render the tree to a standalone HTML page (DocumentToHTMLRenderer
+    parity: title, table of contents, numbered chapters/sections)."""
+    body: List[str] = [f"<h1>{_esc(doc.title)}</h1>"]
+
+    toc: List[str] = ["<nav><ul>"]
+    for ci, chapter in enumerate(doc.chapters, 1):
+        toc.append(f'<li><a href="#ch-{ci}">{ci} {_esc(chapter.title)}</a><ul>')
+        for si, section in enumerate(chapter.sections, 1):
+            toc.append(
+                f'<li><a href="#sec-{ci}-{si}">{ci}.{si} {_esc(section.title)}</a></li>'
+            )
+        toc.append("</ul></li>")
+    toc.append("</ul></nav>")
+    body.extend(toc)
+
+    for ci, chapter in enumerate(doc.chapters, 1):
+        body.append(f'<h2 id="ch-{ci}">{ci} {_esc(chapter.title)}</h2>')
+        for si, section in enumerate(chapter.sections, 1):
+            _render_section_html(section, f"{ci}.{si}", 3, body)
+
+    return (
+        "<!DOCTYPE html><html><head><meta charset='utf-8'>"
+        f"<title>{_esc(doc.title)}</title><style>{_CSS}</style></head><body>"
+        + "\n".join(body)
+        + "</body></html>"
+    )
+
+
+# ---------------------------------------------------------------------------
+# Text renderer (reporting/text/*.scala parity)
+# ---------------------------------------------------------------------------
+
+
+def _render_leaf_text(item: LeafReport, indent: str, out: List[str]) -> None:
+    if isinstance(item, SimpleTextReport):
+        out.append(indent + item.text)
+    elif isinstance(item, (BulletedListReport, NumberedListReport)):
+        numbered = isinstance(item, NumberedListReport)
+        for i, entry in enumerate(item.items, 1):
+            bullet = f"{i}." if numbered else "*"
+            out.append(f"{indent}{bullet} {entry}")
+    elif isinstance(item, TableReport):
+        if item.caption:
+            out.append(indent + item.caption)
+        out.append(indent + " | ".join(item.header))
+        for row in item.rows:
+            out.append(indent + " | ".join(_fmt_cell(c) for c in row))
+    elif isinstance(item, PlotReport):
+        out.append(f"{indent}[plot: {item.title} ({item.x_label} vs {item.y_label})]")
+
+
+def _render_section_text(section: SectionReport, number: str, out: List[str]) -> None:
+    out.append(f"{number} {section.title}")
+    sub = 0
+    for item in section.items:
+        if isinstance(item, SectionReport):
+            sub += 1
+            _render_section_text(item, f"{number}.{sub}", out)
+        else:
+            _render_leaf_text(item, "  ", out)
+
+
+def render_text(doc: DocumentReport) -> str:
+    out: List[str] = [doc.title, "=" * len(doc.title)]
+    for ci, chapter in enumerate(doc.chapters, 1):
+        out.append(f"\n{ci} {chapter.title}")
+        for si, section in enumerate(chapter.sections, 1):
+            _render_section_text(section, f"{ci}.{si}", out)
+    return "\n".join(out) + "\n"

@@ -1,0 +1,20 @@
+"""Diagnostic mode flags (DiagnosticMode.scala:22 parity)."""
+
+from __future__ import annotations
+
+import enum
+
+
+class DiagnosticMode(enum.Enum):
+    ALL = "ALL"
+    TRAIN = "TRAIN"
+    VALIDATE = "VALIDATE"
+    NONE = "NONE"
+
+    @property
+    def runs_train(self) -> bool:
+        return self in (DiagnosticMode.ALL, DiagnosticMode.TRAIN)
+
+    @property
+    def runs_validate(self) -> bool:
+        return self in (DiagnosticMode.ALL, DiagnosticMode.VALIDATE)

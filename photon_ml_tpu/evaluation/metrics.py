@@ -18,7 +18,7 @@ sort/cumsum kernels on device — no RDD co-grouping.
 
 from __future__ import annotations
 
-from typing import Dict, Optional
+from typing import TYPE_CHECKING, Dict, Optional
 
 import jax
 import jax.numpy as jnp
@@ -26,6 +26,9 @@ import jax.numpy as jnp
 from photon_ml_tpu.models.glm import GeneralizedLinearModel
 from photon_ml_tpu.ops.objective import GLMBatch
 from photon_ml_tpu.types import TaskType
+
+if TYPE_CHECKING:  # pragma: no cover
+    from photon_ml_tpu.ops.normalization import NormalizationContext
 
 Array = jax.Array
 
@@ -123,10 +126,16 @@ def _aic(log_likelihood_per_datum: float, n: float, coefficients: Array) -> floa
 def evaluate(
     model: GeneralizedLinearModel,
     batch: GLMBatch,
+    norm: Optional["NormalizationContext"] = None,
 ) -> Dict[str, float]:
-    """Full metric map for one model on one dataset (Evaluation.evaluate)."""
+    """Full metric map for one model on one dataset (Evaluation.evaluate).
+
+    Pass the training ``norm`` when the coefficients live in normalized
+    space (i.e. they were not back-transformed via
+    ``norm.model_to_original_space``).
+    """
     task = model.task
-    mean_scores = model.compute_mean_functions(batch)
+    mean_scores = model.compute_mean_functions(batch, norm)
     labels = batch.labels
     weights = batch.weights  # weight 0 = padding; all metrics honor it
     n = float(jnp.sum(weights > 0.0))
@@ -156,7 +165,7 @@ def evaluate(
             logistic_log_likelihood(mean_scores, labels, weights)
         )
     elif task == TaskType.POISSON_REGRESSION:
-        margins = model.compute_margins(batch)
+        margins = model.compute_margins(batch, norm)
         metrics[DATA_LOG_LIKELIHOOD] = float(
             poisson_log_likelihood(margins, labels, weights)
         )
@@ -181,12 +190,3 @@ METRIC_LARGER_IS_BETTER: Dict[str, bool] = {
 }
 
 
-def best_model_by_metric(
-    metric_maps: Dict[float, Dict[str, float]], metric: str
-) -> Optional[float]:
-    """Best reg-weight by a metric key; None if the metric is absent."""
-    candidates = [(lam, m[metric]) for lam, m in metric_maps.items() if metric in m]
-    if not candidates:
-        return None
-    larger = METRIC_LARGER_IS_BETTER.get(metric, True)
-    return (max if larger else min)(candidates, key=lambda t: t[1])[0]

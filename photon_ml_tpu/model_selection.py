@@ -7,10 +7,11 @@ metric scores as -1 (worst under an increasing ordering).
 
 from __future__ import annotations
 
-from typing import Dict, Iterable, Tuple
+from typing import Dict, Iterable, Optional, Tuple
 
 from photon_ml_tpu.evaluation import metrics as metrics_mod
 from photon_ml_tpu.models.glm import GeneralizedLinearModel
+from photon_ml_tpu.ops.normalization import NormalizationContext
 from photon_ml_tpu.ops.objective import GLMBatch
 from photon_ml_tpu.types import TaskType
 
@@ -31,9 +32,14 @@ def selection_metric_for(task: TaskType) -> str:
 def select_best_model(
     models: Iterable[Tuple[float, GeneralizedLinearModel]],
     validation_batch: GLMBatch,
+    norm: Optional[NormalizationContext] = None,
 ) -> Tuple[float, GeneralizedLinearModel, Dict[float, Dict[str, float]]]:
     """Evaluate every (lambda, model) on validation data and return
-    (best lambda, best model, all metric maps keyed by lambda)."""
+    (best lambda, best model, all metric maps keyed by lambda).
+
+    Pass the training ``norm`` when the models' coefficients live in
+    normalized space (not yet back-transformed to raw space).
+    """
     models = list(models)
     if not models:
         raise ValueError("no models to select from")
@@ -45,7 +51,7 @@ def select_best_model(
     all_metrics: Dict[float, Dict[str, float]] = {}
     scored = []
     for lam, model in models:
-        m = metrics_mod.evaluate(model, validation_batch)
+        m = metrics_mod.evaluate(model, validation_batch, norm)
         all_metrics[lam] = m
         scored.append((m.get(metric, worst), lam, model))
     best = max(scored, key=lambda t: t[0] if larger else -t[0])

@@ -1,0 +1,405 @@
+"""Diagnostics subsystem tests (SURVEY.md §2.10 parity).
+
+Mirrors the reference's unit-test approach: statistical-property assertions
+on synthetic data (well-calibrated model passes HL; independent pairs give
+small Kendall tau) plus report-pipeline structure checks.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from photon_ml_tpu.diagnostics import (
+    DocumentReport,
+    render_html,
+    render_text,
+)
+from photon_ml_tpu.diagnostics import (
+    bootstrap_diagnostic,
+    feature_importance,
+    fitting,
+    hosmer_lemeshow,
+    independence,
+)
+from photon_ml_tpu.diagnostics.reporting import (
+    BulletedListReport,
+    ChapterReport,
+    PlotReport,
+    SectionReport,
+    SimpleTextReport,
+    TableReport,
+)
+from photon_ml_tpu.diagnostics.reports import (
+    ModelDiagnosticReport,
+    SystemReport,
+    assemble_document,
+)
+from photon_ml_tpu.models.glm import Coefficients, GeneralizedLinearModel
+from photon_ml_tpu.ops.features import DenseFeatures
+from photon_ml_tpu.ops.normalization import NormalizationContext
+from photon_ml_tpu.ops.objective import GLMBatch
+from photon_ml_tpu.ops.stats import summarize
+from photon_ml_tpu.optim.problem import GLMOptimizationProblem
+from photon_ml_tpu.types import TaskType
+
+
+def _logistic_batch(rng, n=2000, d=8, w_scale=1.0):
+    x = rng.normal(size=(n, d)).astype(np.float32)
+    w = (rng.normal(size=d) * w_scale).astype(np.float32)
+    p = 1.0 / (1.0 + np.exp(-(x @ w)))
+    y = (rng.random(n) < p).astype(np.float32)
+    batch = GLMBatch.create(DenseFeatures(jnp.asarray(x)), jnp.asarray(y))
+    model = GeneralizedLinearModel(
+        Coefficients(jnp.asarray(w)), TaskType.LOGISTIC_REGRESSION
+    )
+    return batch, model, w
+
+
+# ---------------------------------------------------------------------------
+# Hosmer-Lemeshow
+# ---------------------------------------------------------------------------
+
+
+class TestHosmerLemeshow:
+    def test_well_calibrated_model_has_high_p(self, rng):
+        batch, model, _ = _logistic_batch(rng, n=4000)
+        report = hosmer_lemeshow.diagnose(model, batch)
+        # True model: chi2 probability should not be extreme
+        assert report.chi_square >= 0.0
+        assert report.chi_square_probability < 0.999999
+        assert report.degrees_of_freedom == len(report.histogram) - 2
+
+    def test_miscalibrated_model_scores_worse(self, rng):
+        batch, model, w = _logistic_batch(rng, n=4000)
+        bad = GeneralizedLinearModel(
+            Coefficients(jnp.asarray(w * 5.0)), TaskType.LOGISTIC_REGRESSION
+        )
+        good = hosmer_lemeshow.diagnose(model, batch, num_bins=10)
+        worse = hosmer_lemeshow.diagnose(bad, batch, num_bins=10)
+        assert worse.chi_square > good.chi_square
+
+    def test_bin_counts_conserve_samples(self, rng):
+        batch, model, _ = _logistic_batch(rng, n=1000)
+        report = hosmer_lemeshow.diagnose(model, batch, num_bins=7)
+        total = sum(b.observed_pos + b.observed_neg for b in report.histogram)
+        assert total == 1000
+        for b in report.histogram:
+            assert b.expected_pos + b.expected_neg == b.observed_pos + b.observed_neg
+
+    def test_default_bin_count_heuristic(self):
+        msg, bins = hosmer_lemeshow.default_bin_count(10000, 5)
+        assert bins == 7  # dim + 2 dominates for big n
+        _, bins_small = hosmer_lemeshow.default_bin_count(20, 100)
+        assert 3 <= bins_small < 102  # data-driven bound kicks in
+        assert "bins" in msg.lower() or "samples" in msg.lower()
+
+    def test_padding_rows_ignored(self, rng):
+        batch, model, _ = _logistic_batch(rng, n=500)
+        padded = GLMBatch(
+            batch.features,
+            batch.labels,
+            batch.offsets,
+            batch.weights.at[:100].set(0.0),
+        )
+        report = hosmer_lemeshow.diagnose(model, padded, num_bins=5)
+        total = sum(b.observed_pos + b.observed_neg for b in report.histogram)
+        assert total == 400
+
+    def test_rejects_non_logistic(self, rng):
+        batch, model, _ = _logistic_batch(rng, n=100)
+        linear = GeneralizedLinearModel(
+            model.coefficients, TaskType.LINEAR_REGRESSION
+        )
+        with pytest.raises(ValueError):
+            hosmer_lemeshow.diagnose(linear, batch)
+
+    def test_to_section_structure(self, rng):
+        batch, model, _ = _logistic_batch(rng, n=500)
+        section = hosmer_lemeshow.to_section(
+            hosmer_lemeshow.diagnose(model, batch, num_bins=5)
+        )
+        kinds = [type(i) for i in section.items]
+        assert TableReport in kinds and PlotReport in kinds
+
+
+# ---------------------------------------------------------------------------
+# Kendall tau / independence
+# ---------------------------------------------------------------------------
+
+
+class TestKendallTau:
+    def test_perfect_concordance(self):
+        a = np.arange(100, dtype=np.float64)
+        report = independence.analyze(a, 2.0 * a)
+        assert report.tau_alpha == pytest.approx(1.0)
+        assert report.num_discordant == 0
+
+    def test_perfect_discordance(self):
+        a = np.arange(100, dtype=np.float64)
+        report = independence.analyze(a, -a)
+        assert report.tau_alpha == pytest.approx(-1.0)
+
+    def test_independent_gives_small_tau(self, rng):
+        a = rng.normal(size=800)
+        b = rng.normal(size=800)
+        report = independence.analyze(a, b)
+        assert abs(report.tau_alpha) < 0.1
+        # true two-sided p-value: large under independence
+        assert report.p_value > 0.05
+
+    def test_dependent_gives_small_p(self, rng):
+        a = rng.normal(size=500)
+        report = independence.analyze(a, a + rng.normal(size=500) * 0.1)
+        assert report.p_value < 1e-6
+
+    def test_tie_message_interpolated(self):
+        a = np.array([1.0, 2.0, 3.0, 4.0])
+        b = np.array([1.0, 1.0, 2.0, 3.0])
+        report = independence.analyze(a, b, max_points=10)
+        assert "{" not in report.message
+
+    def test_counts_vs_scipy(self, rng):
+        from scipy.stats import kendalltau
+
+        a = rng.normal(size=200)
+        b = a + rng.normal(size=200) * 2.0
+        report = independence.analyze(a, b, max_points=200)
+        expected = kendalltau(a, b).statistic
+        assert report.tau_beta == pytest.approx(expected, abs=1e-6)
+
+    def test_pair_identity(self):
+        a = np.array([1.0, 2.0, 2.0, 3.0])
+        b = np.array([1.0, 2.0, 3.0, 1.0])
+        report = independence.analyze(a, b, max_points=10)
+        # pairs: C(4,2) = 6 total
+        assert report.num_pairs == 6
+        assert (
+            report.num_concordant + report.num_discordant <= report.num_pairs
+        )
+
+    def test_prediction_error_diagnostic(self, rng):
+        batch, model, _ = _logistic_batch(rng, n=600)
+        rep = independence.diagnose(model, batch)
+        assert -1.0 <= rep.kendall_tau.tau_alpha <= 1.0
+        section = independence.to_section(rep)
+        assert isinstance(section.items[1], TableReport)
+
+
+# ---------------------------------------------------------------------------
+# Feature importance
+# ---------------------------------------------------------------------------
+
+
+class TestFeatureImportance:
+    def test_ranking_follows_w_times_meanabs(self, rng):
+        x = rng.normal(size=(500, 4)).astype(np.float32) * np.array(
+            [1.0, 10.0, 1.0, 1.0], np.float32
+        )
+        batch = GLMBatch.create(
+            DenseFeatures(jnp.asarray(x)), jnp.zeros((500,), jnp.float32)
+        )
+        summary = summarize(batch)
+        w = jnp.asarray([1.0, 1.0, 0.0, 5.0], jnp.float32)
+        model = GeneralizedLinearModel(Coefficients(w), TaskType.LINEAR_REGRESSION)
+        report = feature_importance.diagnose(
+            model, summary, feature_names=["a", "b", "c", "d"]
+        )
+        ranked_names = [r[0] for r in report.ranked_features]
+        # feature b: |1 * E|x|~8|, d: |5 * E|x|~0.8| = 4 -> b first
+        assert ranked_names[0] == "b"
+        assert ranked_names[-1] == "c"  # zero coefficient -> zero importance
+
+    def test_variance_type(self, rng):
+        x = rng.normal(size=(300, 3)).astype(np.float32)
+        batch = GLMBatch.create(
+            DenseFeatures(jnp.asarray(x)), jnp.zeros((300,), jnp.float32)
+        )
+        summary = summarize(batch)
+        model = GeneralizedLinearModel(
+            Coefficients(jnp.asarray([1.0, 2.0, 3.0])), TaskType.LINEAR_REGRESSION
+        )
+        report = feature_importance.diagnose(
+            model, summary, importance_type=feature_importance.VARIANCE
+        )
+        assert report.importance_type == feature_importance.VARIANCE
+        # var ~ 1 for all -> importance ~ |w|
+        assert report.ranked_features[0][1] == 2
+
+    def test_fractile_curve_spans_full_range(self):
+        d = 1000
+        w = jnp.asarray(np.linspace(1.0, 0.0, d), jnp.float32)
+        model = GeneralizedLinearModel(Coefficients(w), TaskType.LINEAR_REGRESSION)
+        report = feature_importance.diagnose(model, None)
+        # 0% fractile = best importance, 100% fractile = worst (rank d-1)
+        assert report.rank_to_importance[0.0] == pytest.approx(1.0, abs=1e-5)
+        assert report.rank_to_importance[100.0] == pytest.approx(0.0, abs=1e-5)
+        assert report.rank_to_importance[50.0] == pytest.approx(0.5, abs=2e-3)
+
+    def test_no_summary_falls_back_to_coefficients(self):
+        model = GeneralizedLinearModel(
+            Coefficients(jnp.asarray([0.5, -3.0, 1.0])), TaskType.LINEAR_REGRESSION
+        )
+        report = feature_importance.diagnose(model, None)
+        assert report.ranked_features[0][1] == 1
+        section = feature_importance.to_section(report)
+        assert isinstance(section.items[1], TableReport)
+
+
+# ---------------------------------------------------------------------------
+# Fitting diagnostic
+# ---------------------------------------------------------------------------
+
+
+class TestFittingDiagnostic:
+    def test_learning_curves_shape(self, rng):
+        batch, _, _ = _logistic_batch(rng, n=1500, d=4)
+        problem = GLMOptimizationProblem(TaskType.LOGISTIC_REGRESSION)
+        reports = fitting.diagnose(
+            problem, batch, NormalizationContext.identity(), reg_weights=[1.0]
+        )
+        assert set(reports) == {1.0}
+        rep = reports[1.0]
+        assert rep.metrics
+        for portions, train, test in rep.metrics.values():
+            assert len(portions) == fitting.NUM_TRAINING_PARTITIONS - 1
+            assert len(train) == len(test) == len(portions)
+            assert portions == sorted(portions)
+
+    def test_normalized_space_metrics_match_raw(self, rng):
+        # Metrics of a normalized-space model with norm passed must match a
+        # raw-space solve: evaluate() must honor the NormalizationContext.
+        from photon_ml_tpu.evaluation import metrics as metrics_mod
+        from photon_ml_tpu.ops.normalization import NormalizationContext
+        from photon_ml_tpu.ops.stats import summarize
+        from photon_ml_tpu.types import NormalizationType
+
+        batch, _, _ = _logistic_batch(rng, n=800, d=4)
+        summary = summarize(batch)
+        norm = NormalizationContext.build(
+            NormalizationType.SCALE_WITH_STANDARD_DEVIATION, std=summary.std
+        )
+        problem = GLMOptimizationProblem(TaskType.LOGISTIC_REGRESSION)
+        model_norm, _ = problem.run(batch, norm)
+        model_raw, _ = problem.run(batch, NormalizationContext.identity())
+        m_norm = metrics_mod.evaluate(model_norm, batch, norm)
+        m_raw = metrics_mod.evaluate(model_raw, batch)
+        key = "Area under ROC"
+        assert m_norm[key] == pytest.approx(m_raw[key], abs=1e-3)
+        # without the norm the normalized-space model scores garbage margins
+        m_wrong = metrics_mod.evaluate(model_norm, batch)
+        assert m_wrong[key] != pytest.approx(m_norm[key], abs=1e-6) or np.allclose(
+            np.asarray(summary.std), 1.0, atol=0.2
+        )
+
+    def test_too_small_dataset_returns_empty(self, rng):
+        batch, _, _ = _logistic_batch(rng, n=30, d=8)
+        problem = GLMOptimizationProblem(TaskType.LOGISTIC_REGRESSION)
+        assert (
+            fitting.diagnose(
+                problem, batch, NormalizationContext.identity(), reg_weights=[1.0]
+            )
+            == {}
+        )
+
+    def test_to_section(self, rng):
+        batch, _, _ = _logistic_batch(rng, n=1200, d=3)
+        problem = GLMOptimizationProblem(TaskType.LOGISTIC_REGRESSION)
+        reports = fitting.diagnose(
+            problem, batch, NormalizationContext.identity(), reg_weights=[0.1]
+        )
+        section = fitting.to_section(reports)
+        assert any(isinstance(i, SectionReport) for i in section.items)
+
+
+# ---------------------------------------------------------------------------
+# Bootstrap diagnostic
+# ---------------------------------------------------------------------------
+
+
+class TestBootstrapDiagnostic:
+    def test_report_contents(self, rng):
+        batch, _, _ = _logistic_batch(rng, n=400, d=4)
+        holdout, _, _ = _logistic_batch(rng, n=200, d=4)
+        problem = GLMOptimizationProblem(TaskType.LOGISTIC_REGRESSION)
+        report = bootstrap_diagnostic.diagnose(
+            problem,
+            batch,
+            NormalizationContext.identity(),
+            holdout,
+            feature_names=["a", "b", "c", "d"],
+            num_samples=5,
+        )
+        assert report.metric_distributions
+        for lo, q1, med, q3, hi in report.metric_distributions.values():
+            assert lo <= q1 <= med <= q3 <= hi
+        assert report.bagged_model_metrics
+        assert len(report.important_feature_distributions) <= 4
+        section = bootstrap_diagnostic.to_section(report)
+        assert isinstance(section.items[0], TableReport)
+
+
+# ---------------------------------------------------------------------------
+# Report pipeline / renderers
+# ---------------------------------------------------------------------------
+
+
+def _sample_document():
+    return assemble_document(
+        "photon-ml-tpu diagnostic report",
+        SystemReport({"task": "LOGISTIC_REGRESSION", "lambdas": [0.1, 1.0]}),
+        [
+            ModelDiagnosticReport(
+                model=GeneralizedLinearModel(
+                    Coefficients(jnp.asarray([1.0, 2.0])),
+                    TaskType.LOGISTIC_REGRESSION,
+                ),
+                reg_weight=0.1,
+                metrics={"Area under ROC": 0.8},
+                sections=[
+                    SectionReport(
+                        "Extra",
+                        [
+                            SimpleTextReport("hello"),
+                            BulletedListReport(["x", "y"]),
+                            PlotReport("t", "x", "y", {"s": ([1, 2], [3, 4])}),
+                        ],
+                    )
+                ],
+            )
+        ],
+    )
+
+
+class TestReporting:
+    def test_html_renderer(self):
+        html = render_html(_sample_document())
+        assert html.startswith("<!DOCTYPE html>")
+        assert "photon-ml-tpu diagnostic report" in html
+        assert "<svg" in html  # plot embedded as SVG
+        assert "Area under ROC" in html
+        assert "<nav>" in html  # table of contents
+
+    def test_html_escapes(self):
+        doc = DocumentReport(
+            "<script>", [ChapterReport("a&b", [SectionReport("s", [SimpleTextReport("<x>")])])]
+        )
+        html = render_html(doc)
+        assert "<script>" not in html.split("</title>")[1]
+        assert "&lt;x&gt;" in html
+
+    def test_text_renderer(self):
+        text = render_text(_sample_document())
+        assert "photon-ml-tpu diagnostic report" in text
+        assert "1.1" in text  # section numbering
+        assert "[plot:" in text
+
+    def test_system_report_with_summary(self, rng):
+        batch, _, _ = _logistic_batch(rng, n=100, d=3)
+        chapter = SystemReport(
+            {"k": "v"}, summarize(batch), ["f0", "f1", "f2"]
+        ).to_chapter()
+        assert len(chapter.sections) == 2
+        table = chapter.sections[1].items[0]
+        assert isinstance(table, TableReport)
+        assert len(table.rows) == 3
