@@ -1,0 +1,1 @@
+"""Command-line drivers (reference L9 parity: Driver.scala, cli/game/)."""

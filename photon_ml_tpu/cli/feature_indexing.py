@@ -1,0 +1,87 @@
+"""Feature indexing job: build partitioned name->index maps from data.
+
+Reference spec: FeatureIndexingJob.scala:59-350 — scan the dataset for
+distinct (name, term) keys per feature shard (+ intercept), hash-partition,
+and write partitioned index stores the drivers later load via
+--offheap-indexmap-dir. The PalDB-per-partition layout is replaced by the
+IndexMap partitioned build (same hash-partition + global-offset semantics,
+io/index_map.py) persisted as one JSON file per shard:
+
+    <output>/feature-index.json              (single/global map)
+    <output>/feature-index-<shard>.json      (per feature shard)
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+from typing import List, Optional
+
+from photon_ml_tpu.cli.game_params import parse_shard_intercepts, parse_shard_sections
+from photon_ml_tpu.io import avro_data
+from photon_ml_tpu.io.index_map import IndexMap
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="photon-ml-tpu feature-indexing",
+        description="Build feature index maps (FeatureIndexingJob parity)",
+    )
+    a = p.add_argument
+    a("--data-input-dirs", required=True, help="comma-separated input dirs")
+    a("--partition-num", type=int, default=1, help="hash partitions in the map")
+    a("--output-dir", required=True)
+    a("--feature-shard-id-to-feature-section-keys-map", dest="shard_sections", default=None)
+    a("--feature-shard-id-to-intercept-map", dest="shard_intercepts", default=None)
+    a("--add-intercept", default="true")
+    return p
+
+
+def main(argv: Optional[List[str]] = None) -> List[str]:
+    ns = build_parser().parse_args(argv)
+    paths = []
+    for d in ns.data_input_dirs.split(","):
+        if not d:
+            continue
+        if os.path.isfile(d):
+            paths.append(d)
+        else:
+            paths.extend(
+                os.path.join(d, f)
+                for f in sorted(os.listdir(d))
+                if not f.startswith((".", "_"))
+            )
+    os.makedirs(ns.output_dir, exist_ok=True)
+    add_intercept_default = str(ns.add_intercept).strip().lower() in ("true", "1", "yes")
+
+    written: List[str] = []
+    shard_sections = parse_shard_sections(ns.shard_sections)
+    shard_intercepts = parse_shard_intercepts(ns.shard_intercepts)
+    if shard_sections:
+        for shard, sections in shard_sections.items():
+            keys = avro_data.collect_feature_keys(paths, sections)
+            imap = IndexMap.build(
+                keys,
+                add_intercept=shard_intercepts.get(shard, add_intercept_default),
+                num_partitions=max(ns.partition_num, 1),
+            )
+            out = os.path.join(ns.output_dir, f"feature-index-{shard}.json")
+            imap.save(out)
+            written.append(out)
+            print(f"shard {shard}: {len(imap)} features -> {out}")
+    else:
+        keys = avro_data.collect_feature_keys(paths)
+        imap = IndexMap.build(
+            keys,
+            add_intercept=add_intercept_default,
+            num_partitions=max(ns.partition_num, 1),
+        )
+        out = os.path.join(ns.output_dir, "feature-index.json")
+        imap.save(out)
+        written.append(out)
+        print(f"{len(imap)} features -> {out}")
+    return written
+
+
+if __name__ == "__main__":
+    main()

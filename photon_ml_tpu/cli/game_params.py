@@ -1,0 +1,456 @@
+"""GAME driver parameters: delimited-string configs + command-line parsers.
+
+Reference spec: cli/game/training/Params.scala:196-395 and the config string
+grammars (SURVEY.md Appendix A.2/A.3):
+
+  per-coordinate optimization config (GLMOptimizationConfiguration.scala:41-75):
+      maxIter,tol,regWeight,downSamplingRate,optimizer,regType
+  coordinate map: "name:cfg|name2:cfg2", grid alternatives ';'-separated
+  fixed-effect data config (FixedEffectDataConfiguration.scala): "name:shardId,minPartitions"
+  random-effect data config (RandomEffectDataConfiguration.scala:60-124):
+      "name:reId,shardId,numPartitions,activeUB,passiveLB,featureRatio,projector[=dim]"
+  feature shard map: "shard1:sec1,sec2|shard2:sec3"
+  factored config (MFOptimizationConfiguration.scala): REcfg:latentCfg:mfIters,latentDim
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import itertools
+from typing import Dict, List, Optional, Tuple
+
+from photon_ml_tpu.data.game import RandomEffectDataConfig
+from photon_ml_tpu.evaluation.evaluators import EvaluatorType
+from photon_ml_tpu.optim.common import OptimizerConfig
+from photon_ml_tpu.ops.regularization import RegularizationContext
+from photon_ml_tpu.types import (
+    ModelOutputMode,
+    OptimizerType,
+    RegularizationType,
+    TaskType,
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class CoordinateOptConfig:
+    """One coordinate's solve configuration (GLMOptimizationConfiguration
+    parity; the reference default is TRON(20, 1e-5), no reg, no sampling)."""
+
+    optimizer: OptimizerType = OptimizerType.TRON
+    max_iterations: int = 20
+    tolerance: float = 1e-5
+    reg_weight: float = 0.0
+    reg_type: RegularizationType = RegularizationType.NONE
+    down_sampling_rate: float = 1.0
+
+    @staticmethod
+    def parse(s: str) -> "CoordinateOptConfig":
+        parts = [p.strip() for p in s.split(",")]
+        if len(parts) != 6:
+            raise ValueError(
+                f"Parsing {s!r} failed: expected 6 comma-separated parts "
+                "(maxIter,tol,regWeight,downSamplingRate,optimizer,regType)"
+            )
+        max_iter, tol, reg_w, rate = (
+            int(parts[0]), float(parts[1]), float(parts[2]), float(parts[3])
+        )
+        if not (0.0 < rate <= 1.0):
+            raise ValueError(f"Unexpected downSamplingRate: {rate}")
+        return CoordinateOptConfig(
+            optimizer=OptimizerType(parts[4].upper()),
+            max_iterations=max_iter,
+            tolerance=tol,
+            reg_weight=reg_w,
+            reg_type=RegularizationType(parts[5].upper()),
+            down_sampling_rate=rate,
+        )
+
+    def optimizer_config(self) -> OptimizerConfig:
+        return OptimizerConfig(max_iterations=self.max_iterations, tolerance=self.tolerance)
+
+    def regularization_context(self) -> RegularizationContext:
+        if self.reg_type == RegularizationType.L1:
+            return RegularizationContext.l1(self.reg_weight)
+        if self.reg_type == RegularizationType.L2:
+            return RegularizationContext.l2(self.reg_weight)
+        if self.reg_type == RegularizationType.ELASTIC_NET:
+            return RegularizationContext.elastic_net(self.reg_weight, 0.5)
+        return RegularizationContext.none()
+
+
+def parse_coordinate_config_map(s: str) -> Dict[str, CoordinateOptConfig]:
+    """"name:cfg|name2:cfg2" -> map."""
+    out: Dict[str, CoordinateOptConfig] = {}
+    for chunk in s.split("|"):
+        chunk = chunk.strip()
+        if not chunk:
+            continue
+        name, cfg = chunk.split(":", 1)
+        out[name.strip()] = CoordinateOptConfig.parse(cfg)
+    return out
+
+
+def parse_coordinate_config_grid(s: Optional[str]) -> List[Dict[str, CoordinateOptConfig]]:
+    """';'-separated grid of coordinate config maps; empty -> [{}]."""
+    if not s:
+        return [{}]
+    return [parse_coordinate_config_map(chunk) for chunk in s.split(";") if chunk.strip()]
+
+
+@dataclasses.dataclass(frozen=True)
+class FixedEffectDataSpec:
+    feature_shard_id: str
+    min_partitions: int = 1  # obsolete on TPU, accepted for parity
+
+
+def parse_fixed_effect_data_configs(s: Optional[str]) -> Dict[str, FixedEffectDataSpec]:
+    out: Dict[str, FixedEffectDataSpec] = {}
+    if not s:
+        return out
+    for chunk in s.split("|"):
+        chunk = chunk.strip()
+        if not chunk:
+            continue
+        name, cfg = chunk.split(":", 1)
+        parts = [p.strip() for p in cfg.split(",")]
+        if len(parts) != 2:
+            raise ValueError(
+                f"Parsing {cfg!r} failed: expected featureShardId,minPartitions"
+            )
+        out[name.strip()] = FixedEffectDataSpec(parts[0], int(parts[1]))
+    return out
+
+
+def parse_random_effect_data_configs(s: Optional[str]) -> Dict[str, RandomEffectDataConfig]:
+    """RandomEffectDataConfiguration.scala:60-124 grammar; negative bounds
+    mean unbounded; projector RANDOM takes '=dim'."""
+    out: Dict[str, RandomEffectDataConfig] = {}
+    if not s:
+        return out
+    for chunk in s.split("|"):
+        chunk = chunk.strip()
+        if not chunk:
+            continue
+        name, cfg = chunk.split(":", 1)
+        parts = [p.strip() for p in cfg.split(",")]
+        if len(parts) != 7:
+            raise ValueError(
+                f"Parsing {cfg!r} failed: expected reId,shardId,numPartitions,"
+                "activeUpperBound,passiveLowerBound,featureRatio,projector"
+            )
+        active_ub = int(parts[3])
+        passive_lb = int(parts[4])
+        ratio = float(parts[5])
+        proj = parts[6].split("=")
+        proj_type = proj[0].upper()
+        proj_dim = None
+        if proj_type == "RANDOM":
+            if len(proj) != 2:
+                raise ValueError(
+                    "RANDOM projector needs a dimension: RANDOM=projectedSpaceDimension"
+                )
+            proj_dim = int(proj[1])
+        out[name.strip()] = RandomEffectDataConfig(
+            random_effect_id=parts[0],
+            feature_shard_id=parts[1],
+            num_shards=max(int(parts[2]), 1),
+            active_upper_bound=active_ub if active_ub >= 0 else None,
+            passive_lower_bound=passive_lb if passive_lb >= 0 else None,
+            features_to_samples_ratio=ratio if ratio >= 0 else None,
+            projector=proj_type,
+            random_projection_dim=proj_dim,
+        )
+    return out
+
+
+@dataclasses.dataclass(frozen=True)
+class FactoredSpec:
+    """Factored random effect: RE config + latent config + (mfIters, latentDim)
+    (FactoredRandomEffectOptimizationProblem parity)."""
+
+    random_effect: CoordinateOptConfig
+    latent_factor: CoordinateOptConfig
+    mf_num_iterations: int
+    latent_dim: int
+
+
+def parse_factored_config_map(s: Optional[str]) -> Dict[str, FactoredSpec]:
+    """"name:REcfg:latentCfg:mfIters,latentDim|..." (the reference nests three
+    config strings per coordinate, ':'-separated)."""
+    out: Dict[str, FactoredSpec] = {}
+    if not s:
+        return out
+    for chunk in s.split("|"):
+        chunk = chunk.strip()
+        if not chunk:
+            continue
+        name, re_cfg, latent_cfg, mf_cfg = chunk.split(":", 3)
+        mf_parts = [p.strip() for p in mf_cfg.split(",")]
+        if len(mf_parts) != 2:
+            raise ValueError(f"Parsing {mf_cfg!r} failed: expected mfIters,latentDim")
+        out[name.strip()] = FactoredSpec(
+            CoordinateOptConfig.parse(re_cfg),
+            CoordinateOptConfig.parse(latent_cfg),
+            int(mf_parts[0]),
+            int(mf_parts[1]),
+        )
+    return out
+
+
+def parse_shard_sections(s: Optional[str]) -> Dict[str, List[str]]:
+    """"shard1:sec1,sec2|shard2:sec3" -> shard -> section field list."""
+    out: Dict[str, List[str]] = {}
+    if not s:
+        return out
+    for chunk in s.split("|"):
+        chunk = chunk.strip()
+        if not chunk:
+            continue
+        shard, secs = chunk.split(":", 1)
+        out[shard.strip()] = [x.strip() for x in secs.split(",") if x.strip()]
+    return out
+
+
+def parse_shard_intercepts(s: Optional[str]) -> Dict[str, bool]:
+    """"shard1:true|shard2:false"."""
+    out: Dict[str, bool] = {}
+    if not s:
+        return out
+    for chunk in s.split("|"):
+        chunk = chunk.strip()
+        if not chunk:
+            continue
+        shard, flag = chunk.split(":", 1)
+        out[shard.strip()] = flag.strip().lower() in ("true", "1", "yes")
+    return out
+
+
+def parse_evaluators(s: Optional[str]) -> List[Tuple[EvaluatorType, Optional[int], Optional[str]]]:
+    """Comma list; precision@K spelled "PRECISION@K:idName" with K an int
+    (EvaluatorType.scala withName parity). Returns (type, k, id name)."""
+    out: List[Tuple[EvaluatorType, Optional[int], Optional[str]]] = []
+    if not s:
+        return out
+    for tok in s.split(","):
+        tok = tok.strip()
+        if not tok:
+            continue
+        up = tok.upper()
+        if up.startswith("PRECISION@"):
+            body = tok.split("@", 1)[1]
+            if ":" in body:
+                k_s, id_name = body.split(":", 1)
+            else:
+                k_s, id_name = body, None
+            out.append((EvaluatorType.PRECISION_AT_K, int(k_s), id_name))
+        else:
+            out.append((EvaluatorType(up), None, None))
+    return out
+
+
+@dataclasses.dataclass
+class GameTrainingParams:
+    """cli/game/training/Params.scala parity."""
+
+    train_input_dirs: List[str] = dataclasses.field(default_factory=list)
+    task_type: TaskType = TaskType.LOGISTIC_REGRESSION
+    output_dir: str = ""
+    updating_sequence: List[str] = dataclasses.field(default_factory=list)
+    validate_input_dirs: Optional[List[str]] = None
+    feature_shard_sections: Dict[str, List[str]] = dataclasses.field(default_factory=dict)
+    feature_shard_intercepts: Dict[str, bool] = dataclasses.field(default_factory=dict)
+    num_iterations: int = 1
+    fixed_effect_opt_grid: List[Dict[str, CoordinateOptConfig]] = dataclasses.field(
+        default_factory=lambda: [{}]
+    )
+    random_effect_opt_grid: List[Dict[str, CoordinateOptConfig]] = dataclasses.field(
+        default_factory=lambda: [{}]
+    )
+    factored_configs: Dict[str, FactoredSpec] = dataclasses.field(default_factory=dict)
+    fixed_effect_data_configs: Dict[str, FixedEffectDataSpec] = dataclasses.field(
+        default_factory=dict
+    )
+    random_effect_data_configs: Dict[str, RandomEffectDataConfig] = dataclasses.field(
+        default_factory=dict
+    )
+    compute_variance: bool = False
+    model_output_mode: ModelOutputMode = ModelOutputMode.BEST
+    num_output_files_re_model: int = 1
+    delete_output_dir_if_exists: bool = False
+    application_name: str = "photon-ml-tpu-game"
+    offheap_indexmap_dir: Optional[str] = None
+    evaluators: List[Tuple[EvaluatorType, Optional[int], Optional[str]]] = dataclasses.field(
+        default_factory=list
+    )
+
+    def validate(self) -> None:
+        errors = []
+        if not self.train_input_dirs:
+            errors.append("--train-input-dirs is required")
+        if not self.output_dir:
+            errors.append("--output-dir is required")
+        if not self.updating_sequence:
+            errors.append("--updating-sequence is required")
+        known = (
+            set(self.fixed_effect_data_configs)
+            | set(self.random_effect_data_configs)
+            | set(self.factored_configs)
+        )
+        for name in self.updating_sequence:
+            if name not in known:
+                errors.append(f"coordinate {name!r} has no data configuration")
+        if self.num_iterations < 1:
+            errors.append("--num-iterations must be >= 1")
+        if errors:
+            raise ValueError("; ".join(errors))
+
+    def config_grid(self) -> List[Dict[str, CoordinateOptConfig]]:
+        """Cartesian product over the fixed/random grids, merged per combo
+        (cli/game/training/Driver.scala:330-337 grid semantics)."""
+        combos = []
+        for fe, re in itertools.product(self.fixed_effect_opt_grid, self.random_effect_opt_grid):
+            merged = dict(fe)
+            merged.update(re)
+            combos.append(merged)
+        return combos
+
+
+def build_training_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="photon-ml-tpu game-training",
+        description="GAME (GLMix) training driver",
+    )
+    a = p.add_argument
+    a("--train-input-dirs", required=True, help="comma-separated input dirs")
+    a("--task-type", required=True, choices=[t.value for t in TaskType])
+    a("--output-dir", required=True)
+    a("--updating-sequence", required=True, help="comma-separated coordinate names")
+    a("--validate-input-dirs", default=None)
+    a("--feature-shard-id-to-feature-section-keys-map", dest="shard_sections", default=None)
+    a("--feature-shard-id-to-intercept-map", dest="shard_intercepts", default=None)
+    a("--num-iterations", type=int, default=1)
+    a("--fixed-effect-optimization-configurations", dest="fe_opt", default=None)
+    a("--random-effect-optimization-configurations", dest="re_opt", default=None)
+    a("--factored-random-effect-optimization-configurations", dest="factored_opt", default=None)
+    a("--fixed-effect-data-configurations", dest="fe_data", default=None)
+    a("--random-effect-data-configurations", dest="re_data", default=None)
+    a("--compute-variance", default="false")
+    a("--model-output-mode", default="BEST", choices=[m.value for m in ModelOutputMode])
+    a("--num-output-files-for-random-effect-model", dest="num_re_files", type=int, default=1)
+    a("--delete-output-dir-if-exists", default="false")
+    a("--application-name", default="photon-ml-tpu-game")
+    a("--offheap-indexmap-dir", default=None)
+    a("--evaluator-type", dest="evaluators", default=None)
+    return p
+
+
+def _truthy(v) -> bool:
+    return str(v).strip().lower() in ("true", "1", "yes")
+
+
+def parse_training_params(argv: Optional[List[str]] = None) -> GameTrainingParams:
+    ns = build_training_parser().parse_args(argv)
+    params = GameTrainingParams(
+        train_input_dirs=[d for d in ns.train_input_dirs.split(",") if d],
+        task_type=TaskType(ns.task_type),
+        output_dir=ns.output_dir,
+        updating_sequence=[c.strip() for c in ns.updating_sequence.split(",") if c.strip()],
+        validate_input_dirs=(
+            [d for d in ns.validate_input_dirs.split(",") if d]
+            if ns.validate_input_dirs
+            else None
+        ),
+        feature_shard_sections=parse_shard_sections(ns.shard_sections),
+        feature_shard_intercepts=parse_shard_intercepts(ns.shard_intercepts),
+        num_iterations=ns.num_iterations,
+        fixed_effect_opt_grid=parse_coordinate_config_grid(ns.fe_opt),
+        random_effect_opt_grid=parse_coordinate_config_grid(ns.re_opt),
+        factored_configs=parse_factored_config_map(ns.factored_opt),
+        fixed_effect_data_configs=parse_fixed_effect_data_configs(ns.fe_data),
+        random_effect_data_configs=parse_random_effect_data_configs(ns.re_data),
+        compute_variance=_truthy(ns.compute_variance),
+        model_output_mode=ModelOutputMode(ns.model_output_mode),
+        num_output_files_re_model=ns.num_re_files,
+        delete_output_dir_if_exists=_truthy(ns.delete_output_dir_if_exists),
+        application_name=ns.application_name,
+        offheap_indexmap_dir=ns.offheap_indexmap_dir,
+        evaluators=parse_evaluators(ns.evaluators),
+    )
+    params.validate()
+    return params
+
+
+@dataclasses.dataclass
+class GameScoringParams:
+    """cli/game/scoring/Params.scala parity."""
+
+    input_dirs: List[str] = dataclasses.field(default_factory=list)
+    game_model_input_dir: str = ""
+    output_dir: str = ""
+    game_model_id: str = ""
+    random_effect_id_types: List[str] = dataclasses.field(default_factory=list)
+    feature_shard_sections: Dict[str, List[str]] = dataclasses.field(default_factory=dict)
+    feature_shard_intercepts: Dict[str, bool] = dataclasses.field(default_factory=dict)
+    num_output_files_for_scores: int = 1
+    delete_output_dir_if_exists: bool = False
+    application_name: str = "photon-ml-tpu-game-scoring"
+    offheap_indexmap_dir: Optional[str] = None
+    evaluators: List[Tuple[EvaluatorType, Optional[int], Optional[str]]] = dataclasses.field(
+        default_factory=list
+    )
+
+    def validate(self) -> None:
+        errors = []
+        if not self.input_dirs:
+            errors.append("--input-dirs is required")
+        if not self.game_model_input_dir:
+            errors.append("--game-model-input-dir is required")
+        if not self.output_dir:
+            errors.append("--output-dir is required")
+        if errors:
+            raise ValueError("; ".join(errors))
+
+
+def build_scoring_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="photon-ml-tpu game-scoring", description="GAME scoring driver"
+    )
+    a = p.add_argument
+    a("--input-dirs", required=True)
+    a("--game-model-input-dir", required=True)
+    a("--output-dir", required=True)
+    a("--game-model-id", default="")
+    a("--random-effect-id-set", dest="re_id_set", default=None)
+    a("--feature-shard-id-to-feature-section-keys-map", dest="shard_sections", default=None)
+    a("--feature-shard-id-to-intercept-map", dest="shard_intercepts", default=None)
+    a("--num-output-files-for-scores", type=int, default=1)
+    a("--delete-output-dir-if-exists", default="false")
+    a("--application-name", default="photon-ml-tpu-game-scoring")
+    a("--offheap-indexmap-dir", default=None)
+    a("--evaluator-type", dest="evaluators", default=None)
+    return p
+
+
+def parse_scoring_params(argv: Optional[List[str]] = None) -> GameScoringParams:
+    ns = build_scoring_parser().parse_args(argv)
+    params = GameScoringParams(
+        input_dirs=[d for d in ns.input_dirs.split(",") if d],
+        game_model_input_dir=ns.game_model_input_dir,
+        output_dir=ns.output_dir,
+        game_model_id=ns.game_model_id,
+        random_effect_id_types=(
+            [t.strip() for t in ns.re_id_set.split(",") if t.strip()]
+            if ns.re_id_set
+            else []
+        ),
+        feature_shard_sections=parse_shard_sections(ns.shard_sections),
+        feature_shard_intercepts=parse_shard_intercepts(ns.shard_intercepts),
+        num_output_files_for_scores=ns.num_output_files_for_scores,
+        delete_output_dir_if_exists=_truthy(ns.delete_output_dir_if_exists),
+        application_name=ns.application_name,
+        offheap_indexmap_dir=ns.offheap_indexmap_dir,
+        evaluators=parse_evaluators(ns.evaluators),
+    )
+    params.validate()
+    return params

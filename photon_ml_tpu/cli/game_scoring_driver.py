@@ -1,0 +1,199 @@
+"""GAME scoring driver: load a saved GAME model, score data, save + evaluate.
+
+Reference spec: cli/game/scoring/Driver.scala:50-241 — prepare feature maps,
+load GAME data (response optional), load the model from its on-disk layout
+(ModelProcessingUtils.loadGameModelFromHDFS), total score = sum of
+coordinate scores + offset (GAMEModel.scala:92-94), save ScoringResultAvro
+shards (:142-162), evaluate per requested evaluator (:222-236).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Dict, List, Optional
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from photon_ml_tpu.cli.game_params import GameScoringParams, parse_scoring_params
+from photon_ml_tpu.cli.game_training_driver import _input_files
+from photon_ml_tpu.evaluation.evaluators import evaluator_for
+from photon_ml_tpu.io import avro as avro_io
+from photon_ml_tpu.io import avro_data, model_io, schemas
+from photon_ml_tpu.io.index_map import IndexMap
+from photon_ml_tpu.utils.io_utils import prepare_output_dir
+from photon_ml_tpu.utils.logging import PhotonLogger
+
+SCORES_DIR = "scores"
+
+
+class GameScoringDriver:
+    def __init__(self, params: GameScoringParams, logger: Optional[PhotonLogger] = None):
+        params.validate()
+        self.params = params
+        self._own_logger = logger is None
+        self.logger = logger or PhotonLogger(
+            os.path.join(params.output_dir, "photon-ml-tpu-scoring.log")
+        )
+        self.shard_index_maps: Dict[str, IndexMap] = {}
+        self.scores: Optional[np.ndarray] = None
+        self.metrics: Dict[str, float] = {}
+
+    # ------------------------------------------------------------------
+    def _load_model_layout(self):
+        """Discover coordinates + their shard/id bindings from the model dir."""
+        layout = model_io.list_game_model(self.params.game_model_input_dir)
+        fixed, random = [], []
+        for name in layout[model_io.FIXED_EFFECT]:
+            base = os.path.join(
+                self.params.game_model_input_dir, model_io.FIXED_EFFECT, name
+            )
+            with open(os.path.join(base, model_io.ID_INFO)) as f:
+                shard = f.read().strip()
+            fixed.append((name, shard))
+        for name in layout[model_io.RANDOM_EFFECT]:
+            base = os.path.join(
+                self.params.game_model_input_dir, model_io.RANDOM_EFFECT, name
+            )
+            with open(os.path.join(base, model_io.ID_INFO)) as f:
+                lines = f.read().splitlines()
+            re_id = lines[0] if lines else ""
+            shard = lines[1] if len(lines) > 1 else ""
+            random.append((name, re_id, shard))
+        return fixed, random
+
+    def _prepare_feature_maps(self, shards: List[str]) -> None:
+        p = self.params
+        paths = _input_files(p.input_dirs)
+        for shard in shards:
+            if p.offheap_indexmap_dir:
+                self.shard_index_maps[shard] = IndexMap.load(
+                    os.path.join(p.offheap_indexmap_dir, f"feature-index-{shard}.json")
+                )
+            else:
+                sections = p.feature_shard_sections.get(shard) or ["features"]
+                keys = avro_data.collect_feature_keys(paths, sections)
+                add_intercept = p.feature_shard_intercepts.get(shard, True)
+                self.shard_index_maps[shard] = IndexMap.build(keys, add_intercept)
+
+    # ------------------------------------------------------------------
+    def run(self) -> None:
+        p = self.params
+        prepare_output_dir(p.output_dir, p.delete_output_dir_if_exists)
+        try:
+            fixed, random = self._load_model_layout()
+            shards = sorted(
+                {s for _, s in fixed if s} | {s for _, _, s in random if s}
+            )
+            self._prepare_feature_maps(shards)
+            id_types = sorted(
+                set(p.random_effect_id_types) | {rid for _, rid, _ in random if rid}
+            )
+            data = avro_data.read_game_data(
+                _input_files(p.input_dirs),
+                self.shard_index_maps,
+                p.feature_shard_sections,
+                id_types,
+                shard_intercepts=p.feature_shard_intercepts or None,
+            )
+            self.logger.info(f"scoring {data.num_rows} rows")
+
+            total = np.asarray(data.offset, np.float64).copy()
+            for name, shard in fixed:
+                means, _, _, _ = model_io.load_fixed_effect(
+                    p.game_model_input_dir, name, self.shard_index_maps[shard]
+                )
+                feats = data.shards[shard]
+                # CSR matvec on host (scoring path is IO-bound)
+                contrib = np.zeros(data.num_rows)
+                nnz_rows = np.repeat(np.arange(data.num_rows), np.diff(feats.indptr))
+                np.add.at(contrib, nnz_rows, means[feats.indices] * feats.values)
+                total += contrib
+                self.logger.info(f"fixed effect {name!r} applied")
+
+            for name, re_id, shard in random:
+                entity_means, _, _, _ = model_io.load_random_effect(
+                    p.game_model_input_dir, name, self.shard_index_maps[shard]
+                )
+                feats = data.shards[shard]
+                vocab = data.id_vocabs[re_id]
+                w = np.zeros((len(vocab), len(self.shard_index_maps[shard])))
+                has_model = np.zeros(len(vocab), bool)
+                for vi, raw in enumerate(vocab):
+                    if raw in entity_means:
+                        w[vi] = entity_means[raw]
+                        has_model[vi] = True
+                contrib = np.zeros(data.num_rows)
+                nnz_rows = np.repeat(np.arange(data.num_rows), np.diff(feats.indptr))
+                ent = data.ids[re_id][nnz_rows]
+                vals = w[ent, feats.indices] * feats.values
+                np.add.at(contrib, nnz_rows, vals)
+                # rows whose entity has no model score 0 (:129-158 semantics)
+                contrib[~has_model[data.ids[re_id]]] = 0.0
+                total += contrib
+                self.logger.info(
+                    f"random effect {name!r}: {int(has_model.sum())}/{len(vocab)} "
+                    "entities matched"
+                )
+
+            self.scores = total.astype(np.float32)
+            self._save_scores(data)
+            self._evaluate(data)
+        finally:
+            if self._own_logger:
+                self.logger.close()
+
+    # ------------------------------------------------------------------
+    def _save_scores(self, data) -> None:
+        p = self.params
+        out = os.path.join(p.output_dir, SCORES_DIR)
+        os.makedirs(out, exist_ok=True)
+        n = data.num_rows
+        shards = max(p.num_output_files_for_scores, 1)
+        per = (n + shards - 1) // shards
+
+        for i in range(shards):
+            lo, hi = i * per, min((i + 1) * per, n)
+
+            def records(lo=lo, hi=hi):
+                for r in range(lo, hi):
+                    yield {
+                        "uid": str(r),
+                        "label": float(data.response[r]),
+                        "modelId": p.game_model_id,
+                        "predictionScore": float(self.scores[r]),
+                        "weight": float(data.weight[r]),
+                        "metadataMap": None,
+                    }
+
+            avro_io.write_container(
+                os.path.join(out, f"part-{i:05d}.avro"),
+                records(),
+                schemas.SCORING_RESULT,
+            )
+        self.logger.info(f"wrote scores to {out}")
+
+    def _evaluate(self, data) -> None:
+        labels = jnp.asarray(data.response)
+        weights = jnp.asarray(data.weight)
+        scores = jnp.asarray(self.scores)
+        for etype, k, id_name in self.params.evaluators:
+            ev = evaluator_for(etype, k or 10)
+            kwargs = {"labels": labels, "weights": weights}
+            if id_name is not None:
+                kwargs["group_ids"] = jnp.asarray(data.ids[id_name])
+            key = etype.value if k is None else f"{etype.value}@{k}"
+            self.metrics[key] = float(ev.evaluate(scores, **kwargs))
+            self.logger.info(f"{key}: {self.metrics[key]:.6g}")
+
+
+def main(argv: Optional[List[str]] = None) -> GameScoringDriver:
+    params = parse_scoring_params(argv)
+    driver = GameScoringDriver(params)
+    driver.run()
+    return driver
+
+
+if __name__ == "__main__":
+    main()

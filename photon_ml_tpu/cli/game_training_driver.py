@@ -1,0 +1,446 @@
+"""GAME (GLMix) training driver.
+
+Reference spec: cli/game/training/Driver.scala:64-537 — prepare feature maps
+(:475), load GAME data (:480), build per-coordinate datasets (:485), build
+evaluators (:490-508), run the config grid x coordinate descent (:511,
+:313-415), save best/all models in the reference's on-disk layout
+(:424-463, ModelProcessingUtils layout).
+
+TPU-native: coordinates hold device-resident tensors (entity-major stacks
+for random effects); the grid reuses compiled update kernels across combos
+with identical shapes; model save goes through io/model_io (Avro wire-format
+parity).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from photon_ml_tpu.algorithm.coordinate_descent import CoordinateDescent, CoordinateDescentResult
+from photon_ml_tpu.algorithm.factored_random_effect import (
+    FactoredRandomEffectCoordinate,
+    FactoredState,
+    MFOptimizationConfig,
+)
+from photon_ml_tpu.algorithm.fixed_effect import FixedEffectCoordinate
+from photon_ml_tpu.algorithm.random_effect import RandomEffectCoordinate
+from photon_ml_tpu.cli.game_params import (
+    CoordinateOptConfig,
+    GameTrainingParams,
+    parse_training_params,
+)
+from photon_ml_tpu.data.game import (
+    GameData,
+    RandomEffectDataConfig,
+    build_fixed_effect_batch,
+    build_random_effect_dataset,
+)
+from photon_ml_tpu.evaluation.evaluators import Evaluator, evaluator_for
+from photon_ml_tpu.io import avro_data
+from photon_ml_tpu.io import model_io
+from photon_ml_tpu.io.index_map import IndexMap
+from photon_ml_tpu.ops import losses as losses_mod
+from photon_ml_tpu.optim.problem import GLMOptimizationProblem
+from photon_ml_tpu.types import ModelOutputMode, OptimizerType, TaskType
+from photon_ml_tpu.utils.io_utils import prepare_output_dir
+from photon_ml_tpu.utils.logging import PhotonLogger
+from photon_ml_tpu.utils.timer import Timer
+
+DENSE_DIM_THRESHOLD = 4096
+BEST_MODEL_DIR = "best"
+ALL_MODELS_DIR = "all"
+
+
+def _input_files(dirs: List[str]) -> List[str]:
+    files = []
+    for d in dirs:
+        if os.path.isfile(d):
+            files.append(d)
+        else:
+            files.extend(
+                os.path.join(d, f)
+                for f in sorted(os.listdir(d))
+                if not f.startswith((".", "_"))
+            )
+    return files
+
+
+class GameTrainingDriver:
+    """Builds coordinates from params + data, runs the grid, saves models."""
+
+    def __init__(self, params: GameTrainingParams, logger: Optional[PhotonLogger] = None):
+        params.validate()
+        self.params = params
+        self._own_logger = logger is None
+        self.logger = logger or PhotonLogger(
+            os.path.join(params.output_dir, "photon-ml-tpu-game.log")
+        )
+        self.timer = Timer(self.logger.info)
+        self.shard_index_maps: Dict[str, IndexMap] = {}
+        self.train_data: Optional[GameData] = None
+        self.validation_data: Optional[GameData] = None
+        self.re_datasets: Dict[str, object] = {}
+        self.fe_batches: Dict[str, object] = {}
+        # combo results: (config map, CoordinateDescentResult, metrics)
+        self.results: List[Tuple[Dict[str, CoordinateOptConfig], CoordinateDescentResult, Dict[str, float]]] = []
+        self.best_index: int = 0
+
+    # ------------------------------------------------------------------
+    def _shard_ids(self) -> List[str]:
+        p = self.params
+        shards = {spec.feature_shard_id for spec in p.fixed_effect_data_configs.values()}
+        shards |= {cfg.feature_shard_id for cfg in p.random_effect_data_configs.values()}
+        return sorted(shards)
+
+    def prepare_feature_maps(self) -> None:
+        """GAMEDriver.prepareFeatureMaps parity (offheap load :76-82 or
+        whole-dataset scan :49-69)."""
+        p = self.params
+        paths = _input_files(p.train_input_dirs)
+        for shard in self._shard_ids():
+            if p.offheap_indexmap_dir:
+                self.shard_index_maps[shard] = IndexMap.load(
+                    os.path.join(p.offheap_indexmap_dir, f"feature-index-{shard}.json")
+                )
+            else:
+                sections = p.feature_shard_sections.get(shard) or ["features"]
+                keys = avro_data.collect_feature_keys(paths, sections)
+                add_intercept = p.feature_shard_intercepts.get(shard, True)
+                self.shard_index_maps[shard] = IndexMap.build(keys, add_intercept)
+            self.logger.info(
+                f"feature shard {shard!r}: {len(self.shard_index_maps[shard])} features"
+            )
+
+    # ------------------------------------------------------------------
+    def _id_types(self) -> List[str]:
+        return sorted(
+            {cfg.random_effect_id for cfg in self.params.random_effect_data_configs.values()}
+        )
+
+    def prepare_datasets(self) -> None:
+        p = self.params
+        self.train_data = avro_data.read_game_data(
+            _input_files(p.train_input_dirs),
+            self.shard_index_maps,
+            p.feature_shard_sections,
+            self._id_types(),
+            shard_intercepts=p.feature_shard_intercepts or None,
+        )
+        self.logger.info(f"training rows: {self.train_data.num_rows}")
+        if p.validate_input_dirs:
+            self.validation_data = avro_data.read_game_data(
+                _input_files(p.validate_input_dirs),
+                self.shard_index_maps,
+                p.feature_shard_sections,
+                self._id_types(),
+                shard_intercepts=p.feature_shard_intercepts or None,
+                id_vocabs=self.train_data.id_vocabs,
+            )
+            self.logger.info(f"validation rows: {self.validation_data.num_rows}")
+
+        for name, spec in p.fixed_effect_data_configs.items():
+            dense = len(self.shard_index_maps[spec.feature_shard_id]) <= DENSE_DIM_THRESHOLD
+            self.fe_batches[name] = build_fixed_effect_batch(
+                self.train_data, spec.feature_shard_id, dense=dense
+            )
+        for name, cfg in p.random_effect_data_configs.items():
+            if name in p.factored_configs and cfg.projector != "IDENTITY":
+                # the factored coordinate factors the UNprojected dataset
+                cfg = RandomEffectDataConfig(
+                    **{**cfg.__dict__, "projector": "IDENTITY"}
+                )
+            self.re_datasets[name] = build_random_effect_dataset(self.train_data, cfg)
+
+    # ------------------------------------------------------------------
+    def _build_coordinates(self, opt_configs: Dict[str, CoordinateOptConfig]) -> Dict[str, object]:
+        """Coordinate objects per updating sequence
+        (cli/game/training/Driver.scala:344-402)."""
+        p = self.params
+        coords: Dict[str, object] = {}
+        for name in p.updating_sequence:
+            cfg = opt_configs.get(name, CoordinateOptConfig())
+            if name in p.fixed_effect_data_configs:
+                coords[name] = FixedEffectCoordinate(
+                    self.fe_batches[name],
+                    GLMOptimizationProblem(
+                        task=p.task_type,
+                        optimizer=cfg.optimizer,
+                        optimizer_config=cfg.optimizer_config(),
+                        regularization=cfg.regularization_context(),
+                        compute_variance=p.compute_variance,
+                    ),
+                    down_sampling_rate=(
+                        cfg.down_sampling_rate if cfg.down_sampling_rate < 1.0 else None
+                    ),
+                )
+            elif name in p.factored_configs:
+                spec = p.factored_configs[name]
+                coords[name] = FactoredRandomEffectCoordinate(
+                    self.re_datasets[name],
+                    p.task_type,
+                    mf_config=MFOptimizationConfig(
+                        spec.mf_num_iterations, spec.latent_dim
+                    ),
+                    re_optimizer=spec.random_effect.optimizer,
+                    re_optimizer_config=spec.random_effect.optimizer_config(),
+                    re_regularization=spec.random_effect.regularization_context(),
+                    latent_optimizer=spec.latent_factor.optimizer,
+                    latent_optimizer_config=spec.latent_factor.optimizer_config(),
+                    latent_regularization=spec.latent_factor.regularization_context(),
+                )
+            else:
+                coords[name] = RandomEffectCoordinate(
+                    self.re_datasets[name],
+                    p.task_type,
+                    optimizer=cfg.optimizer,
+                    optimizer_config=cfg.optimizer_config(),
+                    regularization=cfg.regularization_context(),
+                )
+        return coords
+
+    # ------------------------------------------------------------------
+    def _training_loss_fn(self):
+        """Training-objective loss evaluator over total scores
+        (the loss-evaluator analogue of Driver.scala:185-202)."""
+        loss = losses_mod.for_task(self.params.task_type)
+        labels = jnp.asarray(self.train_data.response)
+        offsets = jnp.asarray(self.train_data.offset)
+        weights = jnp.asarray(self.train_data.weight)
+
+        def fn(total_scores):
+            return jnp.sum(weights * loss.loss(total_scores + offsets, labels))
+
+        return fn
+
+    # ------------------------------------------------------------------
+    def _entity_position_of_vocab(self, name: str) -> np.ndarray:
+        """raw-vocab index -> tensor position in coordinate ``name``'s
+        stacked coefficients (built from training rows)."""
+        cfg = self.params.random_effect_data_configs[name]
+        ids = self.train_data.ids[cfg.random_effect_id]
+        ds = self.re_datasets[name]
+        entity_pos = np.asarray(ds.entity_pos)
+        vocab_size = len(self.train_data.id_vocabs[cfg.random_effect_id])
+        pos = np.full(vocab_size, -1, np.int32)
+        pos[ids] = entity_pos
+        return pos
+
+    def _validation_scorer(self, coords: Dict[str, object]):
+        """coefficients map -> (Nv,) margin scores on validation data.
+
+        Fixed effects score via matvec; random effects back-project to the
+        global feature space and gather per validation row (the
+        RandomEffectModel.scala:129-158 cogroup as static gathers). Rows of
+        unseen entities contribute 0.
+        """
+        p = self.params
+        vdata = self.validation_data
+        nv = vdata.num_rows
+        fe_feats = {}
+        re_info = {}
+        for name in p.updating_sequence:
+            if name in p.fixed_effect_data_configs:
+                spec = p.fixed_effect_data_configs[name]
+                dense = len(self.shard_index_maps[spec.feature_shard_id]) <= DENSE_DIM_THRESHOLD
+                fe_feats[name] = build_fixed_effect_batch(
+                    vdata, spec.feature_shard_id, dense=dense
+                ).features
+            else:
+                cfg = p.random_effect_data_configs[name]
+                feats = vdata.shards[cfg.feature_shard_id]
+                # padded per-row COO of validation rows in the GLOBAL space
+                row_nnz = np.diff(feats.indptr)
+                k = max(int(row_nnz.max()) if nv else 1, 1)
+                cols = np.full((nv, k), -1, np.int32)
+                vals = np.zeros((nv, k), np.float32)
+                rows = np.repeat(np.arange(nv), row_nnz)
+                slots = np.arange(len(feats.indices)) - np.repeat(feats.indptr[:-1], row_nnz)
+                cols[rows, slots] = feats.indices
+                vals[rows, slots] = feats.values
+                pos_of_vocab = self._entity_position_of_vocab(name)
+                vocab_ids = vdata.ids[cfg.random_effect_id]
+                ent_pos = np.where(
+                    vocab_ids >= 0, pos_of_vocab[np.maximum(vocab_ids, 0)], -1
+                ).astype(np.int32)
+                re_info[name] = (
+                    jnp.asarray(cols), jnp.asarray(vals), jnp.asarray(ent_pos)
+                )
+
+        def scorer(params_map):
+            total = jnp.zeros((nv,), jnp.float32)
+            for name in p.updating_sequence:
+                w = params_map[name]
+                if name in fe_feats:
+                    total = total + fe_feats[name].matvec(w)
+                else:
+                    coord = coords[name]
+                    if isinstance(w, FactoredState):
+                        wg = w.v @ w.matrix  # (E, D_global): IDENTITY local space
+                    else:
+                        wg = coord.global_coefficients(w)
+                    cols, vals, ent_pos = re_info[name]
+                    safe_pos = jnp.maximum(ent_pos, 0)
+                    safe_cols = jnp.maximum(cols, 0)
+                    gathered = wg[safe_pos[:, None], safe_cols]
+                    valid = (ent_pos[:, None] >= 0) & (cols >= 0)
+                    total = total + jnp.sum(
+                        jnp.where(valid, gathered * vals, 0.0), axis=-1
+                    )
+            return total + jnp.asarray(vdata.offset)
+
+        return scorer
+
+    def _validation_evaluators(self) -> Dict[str, Tuple[Evaluator, dict]]:
+        p = self.params
+        vdata = self.validation_data
+        labels = jnp.asarray(vdata.response)
+        weights = jnp.asarray(vdata.weight)
+        out: Dict[str, Tuple[Evaluator, dict]] = {}
+        specs = p.evaluators or _default_evaluators(p.task_type)
+        for etype, k, id_name in specs:
+            ev = evaluator_for(etype, k or 10)
+            kwargs = {"labels": labels, "weights": weights}
+            if id_name is not None:
+                kwargs["group_ids"] = jnp.asarray(vdata.ids[id_name])
+            key = etype.value if k is None else f"{etype.value}@{k}"
+            out[key] = (ev, kwargs)
+        return out
+
+    # ------------------------------------------------------------------
+    def train(self) -> None:
+        p = self.params
+        loss_fn = self._training_loss_fn()
+        combos = p.config_grid()
+        primary: Optional[str] = None
+        best_value: Optional[float] = None
+
+        for i, opt_configs in enumerate(combos):
+            coords = self._build_coordinates(opt_configs)
+            scorer = None
+            evaluators = None
+            if self.validation_data is not None:
+                scorer = self._validation_scorer(coords)
+                evaluators = self._validation_evaluators()
+                if primary is None and evaluators:
+                    primary = next(iter(evaluators))
+            cd = CoordinateDescent(coords, loss_fn, scorer, evaluators)
+            with self.timer.measure(f"combo-{i}"):
+                result = cd.run(p.num_iterations, self.train_data.num_rows)
+            metrics = result.validation_history[-1] if result.validation_history else {}
+            self.results.append((opt_configs, result, metrics))
+            self.logger.info(
+                f"combo {i}: objective={result.objective_history[-1]:.6g} "
+                + " ".join(f"{k}={v:.6g}" for k, v in metrics.items())
+            )
+            if primary is not None and metrics:
+                ev = evaluators[primary][0]
+                value = metrics[primary]
+                if best_value is None or ev.better_than(value, best_value):
+                    best_value = value
+                    self.best_index = i
+
+    # ------------------------------------------------------------------
+    def _entity_means_global(self, name: str, coefficients) -> Dict[str, np.ndarray]:
+        """Stacked coefficients -> {raw entity id: dense global-space row}."""
+        cfg = self.params.random_effect_data_configs[name]
+        coord_obj = None  # re-derive global coefficients without a coordinate
+        ds = self.re_datasets[name]
+        if isinstance(coefficients, FactoredState):
+            wg = np.asarray(coefficients.v @ coefficients.matrix)
+        else:
+            # reuse RandomEffectCoordinate.global_coefficients logic
+            from photon_ml_tpu.algorithm.random_effect import RandomEffectCoordinate
+
+            helper = RandomEffectCoordinate(ds, self.params.task_type)
+            wg = np.asarray(helper.global_coefficients(jnp.asarray(coefficients)))
+        del coord_obj
+        pos_of_vocab = self._entity_position_of_vocab(name)
+        vocab = self.train_data.id_vocabs[cfg.random_effect_id]
+        out: Dict[str, np.ndarray] = {}
+        for vi, raw in enumerate(vocab):
+            tp = pos_of_vocab[vi]
+            if tp >= 0:
+                out[raw] = wg[tp]
+        return out
+
+    def save_models(self, output_dir: str, result: CoordinateDescentResult) -> None:
+        p = self.params
+        for name in p.updating_sequence:
+            coeffs = result.coefficients[name]
+            if name in p.fixed_effect_data_configs:
+                spec = p.fixed_effect_data_configs[name]
+                model_io.save_fixed_effect(
+                    output_dir,
+                    name,
+                    p.task_type,
+                    np.asarray(coeffs),
+                    self.shard_index_maps[spec.feature_shard_id],
+                    feature_shard_id=spec.feature_shard_id,
+                )
+            else:
+                cfg = p.random_effect_data_configs[name]
+                model_io.save_random_effect(
+                    output_dir,
+                    name,
+                    p.task_type,
+                    self._entity_means_global(name, coeffs),
+                    self.shard_index_maps[cfg.feature_shard_id],
+                    random_effect_id=cfg.random_effect_id,
+                    feature_shard_id=cfg.feature_shard_id,
+                    num_files=p.num_output_files_re_model,
+                )
+
+    # ------------------------------------------------------------------
+    def run(self) -> None:
+        p = self.params
+        prepare_output_dir(p.output_dir, p.delete_output_dir_if_exists)
+        try:
+            with self.timer.measure("prepare-feature-maps"):
+                self.prepare_feature_maps()
+            with self.timer.measure("prepare-datasets"):
+                self.prepare_datasets()
+            with self.timer.measure("train"):
+                self.train()
+            if p.model_output_mode != ModelOutputMode.NONE:
+                best_dir = os.path.join(p.output_dir, BEST_MODEL_DIR)
+                self.save_models(best_dir, self.results[self.best_index][1])
+                self.logger.info(
+                    f"saved best model (combo {self.best_index}) to {best_dir}"
+                )
+                if p.model_output_mode == ModelOutputMode.ALL:
+                    for i, (_, result, _) in enumerate(self.results):
+                        self.save_models(
+                            os.path.join(p.output_dir, ALL_MODELS_DIR, str(i)), result
+                        )
+            self.logger.info(self.timer.summary())
+        finally:
+            if self._own_logger:
+                self.logger.close()
+
+
+def _default_evaluators(task: TaskType):
+    from photon_ml_tpu.evaluation.evaluators import EvaluatorType
+
+    default = {
+        TaskType.LOGISTIC_REGRESSION: EvaluatorType.AUC,
+        TaskType.SMOOTHED_HINGE_LOSS_LINEAR_SVM: EvaluatorType.AUC,
+        TaskType.LINEAR_REGRESSION: EvaluatorType.RMSE,
+        TaskType.POISSON_REGRESSION: EvaluatorType.POISSON_LOSS,
+    }[task]
+    return [(default, None, None)]
+
+
+def main(argv: Optional[List[str]] = None) -> GameTrainingDriver:
+    params = parse_training_params(argv)
+    driver = GameTrainingDriver(params)
+    driver.run()
+    return driver
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,502 @@
+"""GLM training driver: the staged end-to-end pipeline.
+
+Reference spec: Driver.scala:69-598 — stage progression INIT -> PREPROCESSED
+-> TRAINED -> VALIDATED -> DIAGNOSED (DriverStage.scala; stage assertions
+Driver.scala:513-527): preprocess (:228-254) loads + validates + summarizes
+data, train (:256-290) runs the warm-started lambda grid, validate
+(:363-372) computes metric maps and selects the best lambda, diagnose
+(:484-511) builds the HTML model-diagnostic report (writer :577-597), and
+models are written in text form (:160-163).
+
+TPU-native: one host process owns ingest and orchestration; each solve is a
+compiled XLA program on the batch (the Spark context / executors / kryo /
+partition knobs have no analogue and are accepted-but-ignored for CLI
+compatibility).
+"""
+
+from __future__ import annotations
+
+import enum
+import os
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from photon_ml_tpu.cli.glm_params import (
+    FieldNamesType,
+    GLMParams,
+    InputFormatType,
+    parse_from_command_line,
+)
+from photon_ml_tpu.data.validators import sanity_check_data
+from photon_ml_tpu.diagnostics import render_html
+from photon_ml_tpu.diagnostics import (
+    bootstrap_diagnostic,
+    feature_importance,
+    fitting,
+    hosmer_lemeshow,
+    independence,
+)
+from photon_ml_tpu.diagnostics.reports import (
+    ModelDiagnosticReport,
+    SystemReport,
+    assemble_document,
+)
+from photon_ml_tpu.evaluation import metrics as metrics_mod
+from photon_ml_tpu.io import avro_data
+from photon_ml_tpu.io.index_map import INTERCEPT_KEY, DELIMITER, IndexMap
+from photon_ml_tpu.io.libsvm import HostDataset, read_libsvm, to_batch
+from photon_ml_tpu.model_selection import select_best_model
+from photon_ml_tpu.models.glm import Coefficients, GeneralizedLinearModel
+from photon_ml_tpu.ops.normalization import NormalizationContext
+from photon_ml_tpu.ops.objective import GLMBatch
+from photon_ml_tpu.ops.regularization import RegularizationContext
+from photon_ml_tpu.ops.stats import BasicStatisticalSummary, summarize
+from photon_ml_tpu.optim.common import OptimizerConfig, summarize_result
+from photon_ml_tpu.optim.constraints import BoxConstraints, parse_constraint_string
+from photon_ml_tpu.optim.problem import GLMOptimizationProblem
+from photon_ml_tpu.training import TrainedModelList, train_glm_grid
+from photon_ml_tpu.types import (
+    DataValidationType,
+    NormalizationType,
+    RegularizationType,
+    TaskType,
+)
+from photon_ml_tpu.utils.io_utils import (
+    prepare_output_dir,
+    write_basic_statistics,
+    write_models_in_text,
+)
+from photon_ml_tpu.utils.logging import PhotonLogger
+from photon_ml_tpu.utils.timer import Timer
+
+# Above this dense width, batches stay in padded-sparse layout
+DENSE_DIM_THRESHOLD = 4096
+LEARNED_MODELS_TEXT = "output"  # Driver.LEARNED_MODELS_TEXT parity
+REPORT_FILE = "model-diagnostic.html"
+
+
+class DriverStage(enum.IntEnum):
+    """Ordered driver stages (DriverStage.scala parity)."""
+
+    INIT = 0
+    PREPROCESSED = 1
+    TRAINED = 2
+    VALIDATED = 3
+    DIAGNOSED = 4
+
+
+class Driver:
+    """Staged GLM training pipeline. Construct with params, call run()."""
+
+    def __init__(self, params: GLMParams, logger: Optional[PhotonLogger] = None):
+        params.validate()
+        self.params = params
+        self.stage = DriverStage.INIT
+        self.stage_history: List[DriverStage] = []
+        self._own_logger = logger is None
+        self.logger = logger or PhotonLogger(
+            os.path.join(params.output_dir, "photon-ml-tpu.log")
+        )
+        self.timer = Timer(self.logger.info)
+
+        self.index_map: Optional[IndexMap] = None
+        self.train_ds: Optional[HostDataset] = None
+        self.train_batch: Optional[GLMBatch] = None
+        self.validation_batch: Optional[GLMBatch] = None
+        self.summary: Optional[BasicStatisticalSummary] = None
+        self.norm: NormalizationContext = NormalizationContext.identity()
+        self.trained: Optional[TrainedModelList] = None
+        # raw-space (back-transformed) models keyed in training order
+        self.models: List[Tuple[float, GeneralizedLinearModel]] = []
+        self.best_reg_weight: Optional[float] = None
+        self.best_model: Optional[GeneralizedLinearModel] = None
+        self.validation_metrics: Dict[float, Dict[str, float]] = {}
+        self.problem: Optional[GLMOptimizationProblem] = None
+
+    # ------------------------------------------------------------------
+    def _advance(self, stage: DriverStage) -> None:
+        """Stage assertion (Driver.scala:513-527 parity)."""
+        if stage <= self.stage:
+            raise RuntimeError(f"cannot move back from {self.stage.name} to {stage.name}")
+        self.stage_history.append(self.stage)
+        self.stage = stage
+
+    def _assert_stage(self, expected: DriverStage) -> None:
+        if self.stage != expected:
+            raise RuntimeError(
+                f"stage {expected.name} required, currently {self.stage.name}"
+            )
+
+    # ------------------------------------------------------------------
+    def run(self) -> None:
+        p = self.params
+        prepare_output_dir(p.output_dir, p.delete_output_dirs_if_exist)
+        self.logger.info(f"job {p.job_name}: {p.task_type.value} via "
+                         f"{p.optimizer_type.value}, lambdas={p.regularization_weights}")
+        try:
+            with self.timer.measure("preprocess"):
+                self.preprocess()
+            with self.timer.measure("train"):
+                self.train()
+            if p.validating_data_dir:
+                with self.timer.measure("validate"):
+                    self.validate()
+            if p.diagnostic_mode.runs_train or p.diagnostic_mode.runs_validate:
+                with self.timer.measure("diagnose"):
+                    self.diagnose()
+            self.logger.info(self.timer.summary())
+        finally:
+            if self._own_logger:
+                self.logger.close()
+
+    # ------------------------------------------------------------------
+    # stage: preprocess
+    # ------------------------------------------------------------------
+    def _input_paths(self, directory: str) -> List[str]:
+        if os.path.isfile(directory):
+            return [directory]
+        return [
+            os.path.join(directory, f)
+            for f in sorted(os.listdir(directory))
+            if not f.startswith((".", "_"))
+        ]
+
+    def _selected_features(self) -> Optional[set]:
+        """Whitelist of feature keys (GLMSuite.scala:141-180 parity: a file
+        of name/term entries; text lines 'name<TAB>term' or 'name')."""
+        path = self.params.selected_features_file
+        if not path:
+            return None
+        keys = set()
+        if path.endswith(".avro"):
+            from photon_ml_tpu.io import avro as avro_io
+
+            for rec in avro_io.read_container(path):
+                keys.add(f"{rec['name']}{DELIMITER}{rec.get('term') or ''}")
+        else:
+            with open(path) as f:
+                for line in f:
+                    line = line.rstrip("\n")
+                    if not line:
+                        continue
+                    if DELIMITER in line:
+                        keys.add(line)
+                    elif "\t" in line:
+                        name, term = line.split("\t", 1)
+                        keys.add(f"{name}{DELIMITER}{term}")
+                    else:
+                        keys.add(f"{line}{DELIMITER}")
+        return keys
+
+    def _read_avro(self, directory: str) -> HostDataset:
+        label_field = (
+            "response"
+            if self.params.field_names_type == FieldNamesType.RESPONSE_PREDICTION
+            else "label"
+        )
+        return avro_data.read_training_examples(
+            self._input_paths(directory),
+            self.index_map,
+            add_intercept=self.params.add_intercept,
+            label_field=label_field,
+        )
+
+    def _build_index_map(self) -> IndexMap:
+        p = self.params
+        if p.offheap_indexmap_dir:
+            return IndexMap.load(os.path.join(p.offheap_indexmap_dir, "feature-index.json"))
+        keys = avro_data.collect_feature_keys(self._input_paths(p.training_data_dir))
+        selected = self._selected_features()
+        if selected is not None:
+            keys = [k for k in keys if k in selected]
+        return IndexMap.build(
+            keys,
+            add_intercept=p.add_intercept,
+            num_partitions=max(p.offheap_indexmap_num_partitions, 1),
+        )
+
+    def preprocess(self) -> None:
+        self._assert_stage(DriverStage.INIT)
+        p = self.params
+
+        if p.input_file_format == InputFormatType.LIBSVM:
+            paths = self._input_paths(p.training_data_dir)
+            dim = p.feature_dimension if p.feature_dimension > 0 else None
+            ds = read_libsvm(paths[0], dim=dim, add_intercept=p.add_intercept)
+            for extra in paths[1:]:
+                more = read_libsvm(extra, dim=ds.dim - int(p.add_intercept),
+                                   add_intercept=p.add_intercept)
+                ds = _concat_datasets(ds, more)
+            self.train_ds = ds
+            names = [str(i) for i in range(ds.dim - int(p.add_intercept))]
+            if p.add_intercept:
+                names.append(INTERCEPT_KEY)
+            self.index_map = IndexMap({k: i for i, k in enumerate(names)}, names)
+        else:
+            self.index_map = self._build_index_map()
+            self.train_ds = self._read_avro(p.training_data_dir)
+
+        dense = self.train_ds.dim <= DENSE_DIM_THRESHOLD
+        self.train_batch = to_batch(self.train_ds, dense=dense)
+        self.logger.info(
+            f"training data: {self.train_ds.num_rows} rows x {self.train_ds.dim} "
+            f"features ({'dense' if dense else 'sparse'} layout)"
+        )
+
+        sanity_check_data(self.train_batch, p.task_type, p.data_validation_type)
+
+        needs_summary = (
+            p.normalization_type != NormalizationType.NONE
+            or p.summarization_output_dir is not None
+            or p.diagnostic_mode.runs_train
+            or p.diagnostic_mode.runs_validate
+        )
+        if needs_summary:
+            self.summary = summarize(self.train_batch)
+            if p.summarization_output_dir:
+                write_basic_statistics(
+                    self.summary, p.summarization_output_dir, self.index_map
+                )
+
+        if p.normalization_type != NormalizationType.NONE:
+            intercept = self.index_map.intercept_index
+            self.norm = NormalizationContext.build(
+                p.normalization_type,
+                mean=self.summary.mean,
+                std=self.summary.std,
+                max_magnitude=self.summary.max_magnitude,
+                intercept_id=intercept if intercept >= 0 else None,
+            )
+
+        if p.validating_data_dir:
+            if p.input_file_format == InputFormatType.LIBSVM:
+                vds = read_libsvm(
+                    self._input_paths(p.validating_data_dir)[0],
+                    dim=self.train_ds.dim - int(p.add_intercept),
+                    add_intercept=p.add_intercept,
+                )
+            else:
+                vds = self._read_avro(p.validating_data_dir)
+            self.validation_batch = to_batch(vds, dense=dense)
+            sanity_check_data(self.validation_batch, p.task_type, p.data_validation_type)
+
+        self._advance(DriverStage.PREPROCESSED)
+
+    # ------------------------------------------------------------------
+    # stage: train
+    # ------------------------------------------------------------------
+    def _regularization_context(self) -> RegularizationContext:
+        p = self.params
+        if p.regularization_type == RegularizationType.NONE:
+            return RegularizationContext.none()
+        if p.regularization_type == RegularizationType.L1:
+            return RegularizationContext.l1(1.0)
+        if p.regularization_type == RegularizationType.ELASTIC_NET:
+            return RegularizationContext.elastic_net(
+                1.0, p.elastic_net_alpha if p.elastic_net_alpha is not None else 0.5
+            )
+        return RegularizationContext.l2(1.0)
+
+    def _constraints(self) -> Optional[BoxConstraints]:
+        p = self.params
+        if not p.coefficient_box_constraints:
+            return None
+        cmap = parse_constraint_string(
+            p.coefficient_box_constraints, self.index_map.name_to_index
+        )
+        if not cmap:
+            return None
+        return BoxConstraints.from_map(len(self.index_map), cmap)
+
+    def _to_raw_space(self, model: GeneralizedLinearModel) -> GeneralizedLinearModel:
+        if self.norm.is_identity:
+            return model
+        w = self.norm.model_to_original_space(model.coefficients.means)
+        variances = model.coefficients.variances
+        if variances is not None and self.norm.factors is not None:
+            variances = variances * jnp.square(self.norm.factors)
+        return GeneralizedLinearModel(Coefficients(w, variances), model.task)
+
+    def train(self) -> None:
+        self._assert_stage(DriverStage.PREPROCESSED)
+        p = self.params
+        self.problem = GLMOptimizationProblem(
+            task=p.task_type,
+            optimizer=p.optimizer_type,
+            optimizer_config=OptimizerConfig(
+                max_iterations=p.max_num_iterations, tolerance=p.tolerance
+            ),
+            regularization=self._regularization_context(),
+            compute_variance=p.compute_variance,
+            constraints=self._constraints(),
+        )
+        self.trained = train_glm_grid(
+            self.problem, self.train_batch, self.norm, p.regularization_weights
+        )
+        self.models = [
+            (lam, self._to_raw_space(m))
+            for lam, m in zip(self.trained.weights, self.trained.models)
+        ]
+        for lam, res in zip(self.trained.weights, self.trained.results):
+            self.logger.info(f"lambda={lam:g}: {summarize_result(res)}")
+            if p.enable_optimization_state_tracker:
+                hist = np.asarray(res.value_history)
+                hist = hist[~np.isnan(hist)]
+                self.logger.debug(
+                    f"lambda={lam:g} value history: "
+                    + " ".join(f"{v:.6g}" for v in hist)
+                )
+
+        write_models_in_text(
+            self.models,
+            os.path.join(p.output_dir, LEARNED_MODELS_TEXT),
+            self.index_map,
+        )
+        self._advance(DriverStage.TRAINED)
+
+    # ------------------------------------------------------------------
+    # stage: validate
+    # ------------------------------------------------------------------
+    def validate(self) -> None:
+        self._assert_stage(DriverStage.TRAINED)
+        best_lam, best_model, all_metrics = select_best_model(
+            self.models, self.validation_batch
+        )
+        self.best_reg_weight = best_lam
+        self.best_model = best_model
+        self.validation_metrics = all_metrics
+        for lam in sorted(all_metrics):
+            for name, value in sorted(all_metrics[lam].items()):
+                self.logger.info(f"lambda={lam:g} {name}: {value:.6g}")
+        self.logger.info(f"best model: lambda={best_lam:g}")
+        write_models_in_text(
+            [(best_lam, best_model)],
+            os.path.join(self.params.output_dir, "best"),
+            self.index_map,
+        )
+        self._advance(DriverStage.VALIDATED)
+
+    # ------------------------------------------------------------------
+    # stage: diagnose
+    # ------------------------------------------------------------------
+    def diagnose(self) -> None:
+        p = self.params
+        feature_names = [
+            (self.index_map.get_feature_name(j) or str(j)).replace(DELIMITER, ":")
+            for j in range(len(self.index_map))
+        ]
+        model_reports: List[ModelDiagnosticReport] = []
+
+        fitting_reports = {}
+        if p.diagnostic_mode.runs_train:
+            fitting_reports = fitting.diagnose(
+                self.problem,
+                self.train_batch,
+                self.norm,
+                p.regularization_weights,
+            )
+
+        for lam, model in self.models:
+            sections = []
+            if p.diagnostic_mode.runs_validate and self.validation_batch is not None:
+                metrics = self.validation_metrics.get(
+                    lam, metrics_mod.evaluate(model, self.validation_batch)
+                )
+                sections.append(
+                    feature_importance.to_section(
+                        feature_importance.diagnose(
+                            model, self.summary, feature_names=feature_names
+                        )
+                    )
+                )
+                sections.append(
+                    independence.to_section(
+                        independence.diagnose(model, self.validation_batch)
+                    )
+                )
+                if p.task_type == TaskType.LOGISTIC_REGRESSION:
+                    sections.append(
+                        hosmer_lemeshow.to_section(
+                            hosmer_lemeshow.diagnose(model, self.validation_batch)
+                        )
+                    )
+            else:
+                metrics = metrics_mod.evaluate(model, self.train_batch)
+            if p.diagnostic_mode.runs_train and lam in fitting_reports:
+                sections.append(fitting.to_section({lam: fitting_reports[lam]}))
+            model_reports.append(
+                ModelDiagnosticReport(model, lam, metrics, sections)
+            )
+
+        if p.diagnostic_mode.runs_train and self.validation_batch is not None:
+            # dataset-level bootstrap on the best (or first) lambda
+            lam0 = self.best_reg_weight if self.best_reg_weight is not None else self.models[0][0]
+            boot = bootstrap_diagnostic.diagnose(
+                self.problem,
+                self.train_batch,
+                self.norm,
+                self.validation_batch,
+                feature_names=feature_names,
+            )
+            model_reports[0].sections.append(bootstrap_diagnostic.to_section(boot))
+            del lam0
+
+        doc = assemble_document(
+            f"{p.job_name} model diagnostics",
+            SystemReport(
+                {
+                    "task": p.task_type.value,
+                    "optimizer": p.optimizer_type.value,
+                    "regularization": p.regularization_type.value,
+                    "lambdas": p.regularization_weights,
+                    "normalization": p.normalization_type.value,
+                    "training data": p.training_data_dir,
+                    "validating data": p.validating_data_dir or "(none)",
+                },
+                self.summary,
+                feature_names,
+            ),
+            model_reports,
+        )
+        with open(os.path.join(p.output_dir, REPORT_FILE), "w") as f:
+            f.write(render_html(doc))
+        self.logger.info(f"wrote {REPORT_FILE}")
+        if self.stage == DriverStage.TRAINED:
+            self._advance(DriverStage.VALIDATED)  # keep ordering monotone
+        self._advance(DriverStage.DIAGNOSED)
+
+
+def _concat_datasets(a: HostDataset, b: HostDataset) -> HostDataset:
+    if a.dim != b.dim:
+        raise ValueError(f"feature dims differ: {a.dim} vs {b.dim}")
+
+    def cat(x, y):
+        if x is None and y is None:
+            return None
+        x = x if x is not None else np.zeros(a.num_rows, np.float32)
+        y = y if y is not None else np.zeros(b.num_rows, np.float32)
+        return np.concatenate([x, y])
+
+    return HostDataset(
+        labels=np.concatenate([a.labels, b.labels]),
+        indptr=np.concatenate([a.indptr, b.indptr[1:] + a.indptr[-1]]),
+        indices=np.concatenate([a.indices, b.indices]),
+        values=np.concatenate([a.values, b.values]),
+        dim=a.dim,
+        offsets=cat(a.offsets, b.offsets),
+        weights=cat(a.weights, b.weights),
+    )
+
+
+def main(argv: Optional[List[str]] = None) -> Driver:
+    params = parse_from_command_line(argv)
+    driver = Driver(params)
+    driver.run()
+    return driver
+
+
+if __name__ == "__main__":
+    main()

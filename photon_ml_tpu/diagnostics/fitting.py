@@ -59,8 +59,15 @@ def diagnose(
     Returns an empty map when the dataset is too small for a meaningful
     curve (reference behavior).
     """
+    # Every one of the 10 partitions must support the model: n must exceed
+    # partitions * dim * per-partition minimum. (The reference compares only
+    # against dim * 10, FittingDiagnostic.scala:57-58, letting a 10% prefix
+    # train on ~dim samples; the constant's intent is per-partition.)
     n_total = int(jnp.sum(batch.weights > 0.0))
-    if n_total <= batch.dim * MIN_SAMPLES_PER_PARTITION_PER_DIMENSION:
+    min_samples = (
+        batch.dim * MIN_SAMPLES_PER_PARTITION_PER_DIMENSION * NUM_TRAINING_PARTITIONS
+    )
+    if n_total <= min_samples:
         return {}
 
     tags = jax.random.randint(

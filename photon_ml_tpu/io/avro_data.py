@@ -27,13 +27,17 @@ def _iter_records(paths: Sequence[str]) -> Iterable[dict]:
         yield from avro_io.read_directory(p)
 
 
-def collect_feature_keys(paths: Sequence[str]) -> List[str]:
+def collect_feature_keys(
+    paths: Sequence[str], sections: Sequence[str] = ("features",)
+) -> List[str]:
     """Whole-dataset feature vocabulary (NameAndTermFeatureSetContainer
-    analogue)."""
+    analogue). ``sections`` are the record fields holding FeatureAvro arrays
+    (the reference's feature sections/bags)."""
     keys = set()
     for rec in _iter_records(paths):
-        for f in rec["features"]:
-            keys.add(feature_key(f["name"], f["term"]))
+        for section in sections:
+            for f in rec.get(section) or []:
+                keys.add(feature_key(f["name"], f["term"]))
     return sorted(keys)
 
 
@@ -41,8 +45,13 @@ def read_training_examples(
     paths: Sequence[str],
     index_map: IndexMap,
     add_intercept: bool = True,
+    label_field: str = "label",
 ) -> HostDataset:
-    """TrainingExampleAvro files -> HostDataset (single feature space)."""
+    """TrainingExampleAvro files -> HostDataset (single feature space).
+
+    ``label_field``: "label" for TRAINING_EXAMPLE records, "response" for
+    RESPONSE_PREDICTION ones (io/FieldNamesType.scala parity).
+    """
     labels: List[float] = []
     offsets: List[float] = []
     weights: List[float] = []
@@ -51,7 +60,7 @@ def read_training_examples(
     values: List[float] = []
     intercept_idx = index_map.intercept_index
     for rec in _iter_records(paths):
-        labels.append(float(rec["label"]))
+        labels.append(float(rec[label_field]))
         offsets.append(float(rec.get("offset") or 0.0))
         weights.append(float(rec.get("weight") if rec.get("weight") is not None else 1.0))
         for f in rec["features"]:
@@ -80,6 +89,7 @@ def read_game_data(
     shard_sections: Dict[str, List[str]],
     id_types: Sequence[str],
     shard_intercepts: Optional[Dict[str, bool]] = None,
+    id_vocabs: Optional[Dict[str, List[str]]] = None,
 ) -> GameData:
     """TrainingExampleAvro -> GameData with per-shard feature spaces.
 
@@ -107,18 +117,32 @@ def read_game_data(
         weights.append(float(rec.get("weight") if rec.get("weight") is not None else 1.0))
         meta = rec.get("metadataMap") or {}
         for t in id_types:
-            if t not in meta:
-                raise ValueError(f"row {n}: id type {t!r} missing from metadataMap")
-            raw_ids[t].append(meta[t])
-        # compute each feature's key once, then probe every shard's map
-        keyed = [(feature_key(f["name"], f["term"]), float(f["value"])) for f in rec["features"]]
+            # record field first, then metadataMap (DataProcessingUtils.scala:
+            # 90-114 lookup order)
+            if t in rec and rec[t] is not None:
+                raw_ids[t].append(str(rec[t]))
+            elif t in meta:
+                raw_ids[t].append(meta[t])
+            else:
+                raise ValueError(
+                    f"row {n}: id type {t!r} found neither as a record field "
+                    "nor in metadataMap"
+                )
+        # compute each section's keyed features once, then probe shard maps
+        keyed_by_section: Dict[str, List[Tuple[str, float]]] = {}
         for s, imap in shard_index_maps.items():
             ptr, idx, val = per_shard[s]
-            for key, value in keyed:
-                j = imap.get_index(key)
-                if j >= 0:
-                    idx.append(j)
-                    val.append(value)
+            for section in shard_sections.get(s) or ["features"]:
+                if section not in keyed_by_section:
+                    keyed_by_section[section] = [
+                        (feature_key(f["name"], f["term"]), float(f["value"]))
+                        for f in rec.get(section) or []
+                    ]
+                for key, value in keyed_by_section[section]:
+                    j = imap.get_index(key)
+                    if j >= 0:
+                        idx.append(j)
+                        val.append(value)
             if shard_intercepts.get(s, True) and imap.intercept_index >= 0:
                 idx.append(imap.intercept_index)
                 val.append(1.0)
@@ -128,9 +152,17 @@ def read_game_data(
     ids: Dict[str, np.ndarray] = {}
     vocabs: Dict[str, List[str]] = {}
     for t in id_types:
-        vocab = sorted(set(raw_ids[t]))
-        lookup = {v: i for i, v in enumerate(vocab)}
-        ids[t] = np.asarray([lookup[v] for v in raw_ids[t]], np.int32)
+        if id_vocabs is not None and t in id_vocabs:
+            # reuse an existing (training) vocab: unseen entities map to -1
+            # ("no model", scores 0 — RandomEffectModel.scala:129-158). Only
+            # for scoring/validation reads, NOT for dataset building.
+            vocab = list(id_vocabs[t])
+            lookup = {v: i for i, v in enumerate(vocab)}
+            ids[t] = np.asarray([lookup.get(v, -1) for v in raw_ids[t]], np.int32)
+        else:
+            vocab = sorted(set(raw_ids[t]))
+            lookup = {v: i for i, v in enumerate(vocab)}
+            ids[t] = np.asarray([lookup[v] for v in raw_ids[t]], np.int32)
         vocabs[t] = vocab
 
     shards = {

@@ -13,6 +13,7 @@ compilation + k solves.
 from __future__ import annotations
 
 import dataclasses
+import functools
 from typing import Dict, List, Optional, Sequence, Tuple
 
 import jax
@@ -42,6 +43,11 @@ class TrainedModelList:
         return dict(zip(self.weights, self.models))
 
 
+@functools.partial(jax.jit, static_argnames=("problem",))
+def _solve(problem, batch, norm, w0, lam):
+    return problem.run(batch, norm, init_coefficients=w0, reg_weight=lam)
+
+
 def train_glm_grid(
     problem: GLMOptimizationProblem,
     batch: GLMBatch,
@@ -57,9 +63,16 @@ def train_glm_grid(
     """
     sorted_weights = sorted(reg_weights, reverse=True)
 
-    solve = jax.jit(
-        lambda w0, lam: problem.run(batch, norm, init_coefficients=w0, reg_weight=lam)
-    )
+    try:
+        # module-level jit: repeat calls with the same problem + shapes (e.g.
+        # the fitting diagnostic's 9 prefix solves, which differ only by a
+        # weight mask) hit one compiled kernel instead of recompiling
+        hash(problem)
+        solve = lambda w0, lam: _solve(problem, batch, norm, w0, lam)
+    except TypeError:  # unhashable problem (e.g. array-valued box constraints)
+        solve = jax.jit(
+            lambda w0, lam: problem.run(batch, norm, init_coefficients=w0, reg_weight=lam)
+        )
 
     if warm_start_models:
         max_lambda = max(warm_start_models.keys())

@@ -1,0 +1,265 @@
+"""GAME training/scoring CLI driver tests (cli/game DriverTest analogue).
+
+Writes multi-section TrainingExampleAvro data, drives the full training
+pipeline (feature maps -> datasets -> coordinate descent grid -> model
+save), then round-trips through the scoring driver and feature indexing job.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from photon_ml_tpu.cli import feature_indexing, game_scoring_driver, game_training_driver
+from photon_ml_tpu.cli.game_params import (
+    CoordinateOptConfig,
+    parse_coordinate_config_grid,
+    parse_evaluators,
+    parse_random_effect_data_configs,
+    parse_shard_sections,
+)
+from photon_ml_tpu.io import avro as avro_io
+from photon_ml_tpu.io import schemas
+from photon_ml_tpu.types import OptimizerType, RegularizationType
+
+from game_test_utils import make_glmix_data
+
+# TrainingExampleAvro extended with two feature sections (the reference's
+# multi-section records: each section is its own record field of FeatureAvro)
+GAME_EXAMPLE_SCHEMA = {
+    "name": "GameExampleAvro",
+    "namespace": "test",
+    "type": "record",
+    "fields": [
+        {"name": "uid", "type": ["null", "string"], "default": None},
+        {"name": "label", "type": "double"},
+        {"name": "fixedFeatures", "type": {"type": "array", "items": schemas.FEATURE}},
+        {
+            "name": "userFeatures",
+            "type": {"type": "array", "items": "com.linkedin.photon.avro.generated.FeatureAvro"},
+        },
+        {
+            "name": "metadataMap",
+            "type": ["null", {"type": "map", "values": "string"}],
+            "default": None,
+        },
+        {"name": "weight", "type": ["null", "double"], "default": None},
+        {"name": "offset", "type": ["null", "double"], "default": None},
+    ],
+}
+
+
+def _write_game_avro(path, data, rows):
+    def feats(x_row, prefix):
+        return [
+            {"name": f"{prefix}{j}", "term": "", "value": float(v)}
+            for j, v in enumerate(x_row)
+            if v != 0.0
+        ]
+
+    def records():
+        for r in rows:
+            yield {
+                "uid": str(r),
+                "label": float(data["y"][r]),
+                "fixedFeatures": feats(data["x_fixed"][r], "f"),
+                "userFeatures": feats(data["x_random"][r], "u"),
+                "metadataMap": {"userId": data["user_raw"][r]},
+                "weight": None,
+                "offset": None,
+            }
+
+    avro_io.write_container(path, records(), GAME_EXAMPLE_SCHEMA)
+
+
+@pytest.fixture(scope="module")
+def game_avro_dirs(tmp_path_factory):
+    base = tmp_path_factory.mktemp("game")
+    rng = np.random.default_rng(77)
+    gd, truth = make_glmix_data(
+        rng, num_users=12, rows_per_user_range=(30, 60), d_fixed=5, d_random=3
+    )
+    data = {
+        "y": gd.response,
+        "x_fixed": truth["x_fixed"],
+        "x_random": truth["x_random"],
+        "user_raw": [gd.id_vocabs["userId"][i] for i in gd.ids["userId"]],
+    }
+    n = gd.num_rows
+    split = int(n * 0.8)
+    train_dir = base / "train"
+    val_dir = base / "validate"
+    train_dir.mkdir()
+    val_dir.mkdir()
+    _write_game_avro(str(train_dir / "part-0.avro"), data, range(split))
+    _write_game_avro(str(val_dir / "part-0.avro"), data, range(split, n))
+    return str(train_dir), str(val_dir), str(base)
+
+
+COMMON_FLAGS = [
+    "--task-type", "LOGISTIC_REGRESSION",
+    "--feature-shard-id-to-feature-section-keys-map",
+    "global:fixedFeatures|per_user:userFeatures",
+    "--updating-sequence", "fixed,per-user",
+    "--fixed-effect-data-configurations", "fixed:global,1",
+    "--random-effect-data-configurations",
+    "per-user:userId,per_user,1,-1,-1,-1,INDEX_MAP",
+    "--fixed-effect-optimization-configurations", "fixed:50,1e-7,0.01,1,LBFGS,L2",
+    "--random-effect-optimization-configurations", "per-user:40,1e-6,0.1,1,LBFGS,L2",
+    "--delete-output-dir-if-exists", "true",
+]
+
+
+@pytest.fixture(scope="module")
+def trained(game_avro_dirs):
+    train_dir, val_dir, base = game_avro_dirs
+    out = os.path.join(base, "model-out")
+    driver = game_training_driver.main(
+        [
+            "--train-input-dirs", train_dir,
+            "--validate-input-dirs", val_dir,
+            "--output-dir", out,
+            "--num-iterations", "2",
+        ]
+        + COMMON_FLAGS
+    )
+    return driver, out, game_avro_dirs
+
+
+class TestGameTraining:
+    def test_validation_auc(self, trained):
+        driver, _, _ = trained
+        _, result, metrics = driver.results[driver.best_index]
+        assert metrics["AUC"] > 0.8, metrics
+        # objective decreases across coordinate updates
+        assert result.objective_history[-1] < result.objective_history[0]
+
+    def test_model_layout_on_disk(self, trained):
+        _, out, _ = trained
+        assert os.path.exists(
+            os.path.join(out, "best", "fixed-effect", "fixed", "coefficients",
+                         "part-00000.avro")
+        )
+        assert os.path.exists(
+            os.path.join(out, "best", "random-effect", "per-user", "coefficients",
+                         "part-00000.avro")
+        )
+        with open(os.path.join(out, "best", "random-effect", "per-user", "id-info")) as f:
+            lines = f.read().splitlines()
+        assert lines[0] == "userId" and lines[1] == "per_user"
+
+    def test_saved_re_model_covers_entities(self, trained):
+        driver, out, _ = trained
+        from photon_ml_tpu.io import model_io
+
+        entity_means, _, _, _ = model_io.load_random_effect(
+            out + "/best", "per-user", driver.shard_index_maps["per_user"]
+        )
+        assert len(entity_means) == 12  # every user trained
+        for v in entity_means.values():
+            assert v.shape == (len(driver.shard_index_maps["per_user"]),)
+
+
+class TestGameScoring:
+    def test_score_saved_model(self, trained):
+        driver, out, dirs = trained
+        _, val_dir, base = dirs
+        score_out = os.path.join(base, "score-out")
+        scorer = game_scoring_driver.main(
+            [
+                "--input-dirs", val_dir,
+                "--game-model-input-dir", os.path.join(out, "best"),
+                "--output-dir", score_out,
+                "--feature-shard-id-to-feature-section-keys-map",
+                "global:fixedFeatures|per_user:userFeatures",
+                "--evaluator-type", "AUC",
+                "--delete-output-dir-if-exists", "true",
+            ]
+        )
+        # scoring-driver AUC should match the training driver's validation AUC
+        _, _, train_metrics = driver.results[driver.best_index]
+        assert scorer.metrics["AUC"] == pytest.approx(train_metrics["AUC"], abs=0.02)
+        assert os.path.exists(os.path.join(score_out, "scores", "part-00000.avro"))
+        recs = list(
+            avro_io.read_container(os.path.join(score_out, "scores", "part-00000.avro"))
+        )
+        assert len(recs) == len(scorer.scores)
+        assert "predictionScore" in recs[0]
+
+
+class TestFeatureIndexingJob:
+    def test_per_shard_maps_and_offheap_training(self, game_avro_dirs):
+        train_dir, val_dir, base = game_avro_dirs
+        idx_dir = os.path.join(base, "index-maps")
+        written = feature_indexing.main(
+            [
+                "--data-input-dirs", train_dir,
+                "--output-dir", idx_dir,
+                "--partition-num", "2",
+                "--feature-shard-id-to-feature-section-keys-map",
+                "global:fixedFeatures|per_user:userFeatures",
+            ]
+        )
+        assert len(written) == 2
+        assert os.path.exists(os.path.join(idx_dir, "feature-index-global.json"))
+
+        out = os.path.join(base, "model-out-offheap")
+        driver = game_training_driver.main(
+            [
+                "--train-input-dirs", train_dir,
+                "--validate-input-dirs", val_dir,
+                "--output-dir", out,
+                "--num-iterations", "1",
+                "--offheap-indexmap-dir", idx_dir,
+            ]
+            + COMMON_FLAGS
+        )
+        _, _, metrics = driver.results[driver.best_index]
+        assert metrics["AUC"] > 0.75
+
+
+class TestGameConfigParsing:
+    def test_opt_config(self):
+        cfg = CoordinateOptConfig.parse("20,1e-5,0.5,0.8,TRON,L2")
+        assert cfg.optimizer == OptimizerType.TRON
+        assert cfg.max_iterations == 20
+        assert cfg.reg_weight == 0.5
+        assert cfg.down_sampling_rate == 0.8
+        assert cfg.reg_type == RegularizationType.L2
+
+    def test_opt_config_bad_rate(self):
+        with pytest.raises(ValueError, match="downSamplingRate"):
+            CoordinateOptConfig.parse("20,1e-5,0.5,0.0,TRON,L2")
+
+    def test_grid(self):
+        grid = parse_coordinate_config_grid(
+            "a:10,1e-4,1,1,LBFGS,L2|b:5,1e-3,0,1,TRON,NONE;a:20,1e-4,2,1,LBFGS,L1"
+        )
+        assert len(grid) == 2
+        assert set(grid[0]) == {"a", "b"}
+        assert grid[1]["a"].reg_type == RegularizationType.L1
+
+    def test_re_data_config_random_projector(self):
+        cfgs = parse_random_effect_data_configs(
+            "mf:userId,shard,4,100,20,2.5,RANDOM=8"
+        )
+        cfg = cfgs["mf"]
+        assert cfg.projector == "RANDOM"
+        assert cfg.random_projection_dim == 8
+        assert cfg.active_upper_bound == 100
+        assert cfg.num_shards == 4
+
+    def test_re_data_config_unbounded(self):
+        cfg = parse_random_effect_data_configs("x:uid,s,1,-1,-1,-1,INDEX_MAP")["x"]
+        assert cfg.active_upper_bound is None
+        assert cfg.passive_lower_bound is None
+        assert cfg.features_to_samples_ratio is None
+
+    def test_shard_sections(self):
+        m = parse_shard_sections("a:s1,s2|b:s3")
+        assert m == {"a": ["s1", "s2"], "b": ["s3"]}
+
+    def test_evaluators(self):
+        evs = parse_evaluators("AUC,RMSE,PRECISION@5:documentId")
+        assert evs[0][0].value == "AUC"
+        assert evs[2][1] == 5 and evs[2][2] == "documentId"
