@@ -1,0 +1,212 @@
+// pmix_store: memory-mapped two-way feature index store (C API).
+//
+// The TPU-native replacement for the reference's PalDB off-heap index
+// (util/PalDBIndexMap.scala:43-230 semantics): a partitioned name<->index
+// store that many host processes can share via the page cache, with O(1)
+// name->index lookup and O(1) index->name reverse lookup. Each partition is
+// one file; global index = partition offset + local index, exactly the
+// reference's global-offset scheme (PalDBIndexMap.scala:105-130) — the
+// Python layer owns partitioning (hash) and offsets, this file owns the
+// single-partition format:
+//
+//   header (32 B, little-endian):
+//     u32 magic 'PMIX' (0x58494D50), u32 version = 1,
+//     u64 num_keys, u64 table_capacity, u64 key_blob_size
+//   hash table: table_capacity slots x 12 B: u32 local_index + 1 (0 = empty),
+//     u64 FNV-1a hash of the key
+//   offsets: (num_keys + 1) x u64 into the key blob
+//   blob: UTF-8 key bytes, concatenated in local-index order
+//
+// Lookup: linear-probe the table by hash; on hash match, compare key bytes.
+// Reverse: offsets[i]..offsets[i+1] slice the blob.
+
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+
+#include <fcntl.h>
+#include <sys/mman.h>
+#include <sys/stat.h>
+#include <unistd.h>
+
+namespace {
+
+constexpr uint32_t kMagic = 0x58494D50;  // "PMIX"
+constexpr uint32_t kVersion = 1;
+constexpr size_t kHeaderSize = 32;
+constexpr size_t kSlotSize = 12;
+
+struct Header {
+  uint32_t magic;
+  uint32_t version;
+  uint64_t num_keys;
+  uint64_t table_capacity;
+  uint64_t key_blob_size;
+};
+
+struct Store {
+  void* base = nullptr;
+  size_t map_size = 0;
+  Header header;
+  const uint8_t* table = nullptr;    // capacity * 12 bytes
+  const uint64_t* offsets = nullptr; // num_keys + 1
+  const char* blob = nullptr;
+};
+
+inline uint64_t fnv1a(const char* data, long len) {
+  uint64_t h = 14695981039346656037ULL;  // FNV-1a 64 offset basis
+  for (long i = 0; i < len; ++i) {
+    h ^= static_cast<uint8_t>(data[i]);
+    h *= 1099511628211ULL;
+  }
+  return h;
+}
+
+inline uint64_t next_pow2(uint64_t v) {
+  uint64_t c = 1;
+  while (c < v) c <<= 1;
+  return c;
+}
+
+inline void slot_read(const uint8_t* table, uint64_t slot, uint32_t* idx1,
+                      uint64_t* hash) {
+  const uint8_t* p = table + slot * kSlotSize;
+  std::memcpy(idx1, p, 4);
+  std::memcpy(hash, p + 4, 8);
+}
+
+}  // namespace
+
+extern "C" {
+
+// Open a partition file read-only via mmap. Returns nullptr on failure.
+void* pmix_open(const char* path) {
+  int fd = ::open(path, O_RDONLY);
+  if (fd < 0) return nullptr;
+  struct stat st;
+  if (fstat(fd, &st) != 0 || static_cast<size_t>(st.st_size) < kHeaderSize) {
+    ::close(fd);
+    return nullptr;
+  }
+  void* base = mmap(nullptr, st.st_size, PROT_READ, MAP_SHARED, fd, 0);
+  ::close(fd);  // mapping keeps the file alive
+  if (base == MAP_FAILED) return nullptr;
+
+  Store* s = new Store();
+  s->base = base;
+  s->map_size = st.st_size;
+  std::memcpy(&s->header, base, sizeof(Header));
+  if (s->header.magic != kMagic || s->header.version != kVersion) {
+    munmap(base, st.st_size);
+    delete s;
+    return nullptr;
+  }
+  const uint8_t* p = static_cast<const uint8_t*>(base) + kHeaderSize;
+  s->table = p;
+  p += s->header.table_capacity * kSlotSize;
+  s->offsets = reinterpret_cast<const uint64_t*>(p);
+  p += (s->header.num_keys + 1) * sizeof(uint64_t);
+  s->blob = reinterpret_cast<const char*>(p);
+  return s;
+}
+
+void pmix_close(void* handle) {
+  if (!handle) return;
+  Store* s = static_cast<Store*>(handle);
+  if (s->base) munmap(s->base, s->map_size);
+  delete s;
+}
+
+long pmix_size(void* handle) {
+  return handle ? static_cast<long>(static_cast<Store*>(handle)->header.num_keys)
+                : -1;
+}
+
+// name -> local index; -1 if absent.
+long pmix_get_index(void* handle, const char* key, long len) {
+  if (!handle) return -1;
+  const Store* s = static_cast<const Store*>(handle);
+  if (s->header.num_keys == 0) return -1;
+  const uint64_t cap = s->header.table_capacity;
+  const uint64_t mask = cap - 1;
+  const uint64_t h = fnv1a(key, len);
+  for (uint64_t probe = 0; probe < cap; ++probe) {
+    uint64_t slot = (h + probe) & mask;
+    uint32_t idx1;
+    uint64_t slot_hash;
+    slot_read(s->table, slot, &idx1, &slot_hash);
+    if (idx1 == 0) return -1;  // empty slot terminates the probe chain
+    if (slot_hash == h) {
+      uint64_t i = idx1 - 1;
+      uint64_t start = s->offsets[i], end = s->offsets[i + 1];
+      if (end - start == static_cast<uint64_t>(len) &&
+          std::memcmp(s->blob + start, key, len) == 0) {
+        return static_cast<long>(i);
+      }
+    }
+  }
+  return -1;
+}
+
+// local index -> key bytes into caller buffer; returns key length (may
+// exceed cap, in which case nothing is written), or -1 if out of range.
+long pmix_get_name(void* handle, long idx, char* buf, long cap) {
+  if (!handle) return -1;
+  const Store* s = static_cast<const Store*>(handle);
+  if (idx < 0 || static_cast<uint64_t>(idx) >= s->header.num_keys) return -1;
+  uint64_t start = s->offsets[idx], end = s->offsets[idx + 1];
+  long len = static_cast<long>(end - start);
+  if (len <= cap) std::memcpy(buf, s->blob + start, len);
+  return len;
+}
+
+// Build a partition file from n keys given as a concatenated blob +
+// (n + 1) offsets. Key i gets local index i. Returns 0 on success.
+int pmix_build(const char* path, const char* blob, const uint64_t* offsets,
+               long n) {
+  if (n < 0) return 1;
+  const uint64_t blob_size = offsets[n];
+  const uint64_t cap = next_pow2(n > 0 ? static_cast<uint64_t>(n) * 2 : 1);
+
+  Header header{kMagic, kVersion, static_cast<uint64_t>(n), cap, blob_size};
+
+  uint8_t* table = new uint8_t[cap * kSlotSize]();
+  const uint64_t mask = cap - 1;
+  for (long i = 0; i < n; ++i) {
+    const char* key = blob + offsets[i];
+    long len = static_cast<long>(offsets[i + 1] - offsets[i]);
+    uint64_t h = fnv1a(key, len);
+    uint64_t slot = h & mask;
+    while (true) {
+      uint32_t idx1;
+      uint64_t slot_hash;
+      slot_read(table, slot, &idx1, &slot_hash);
+      if (idx1 == 0) break;
+      slot = (slot + 1) & mask;
+    }
+    uint8_t* p = table + slot * kSlotSize;
+    uint32_t idx1 = static_cast<uint32_t>(i) + 1;
+    std::memcpy(p, &idx1, 4);
+    std::memcpy(p + 4, &h, 8);
+  }
+
+  FILE* f = std::fopen(path, "wb");
+  if (!f) {
+    delete[] table;
+    return 2;
+  }
+  int err = 0;
+  if (std::fwrite(&header, sizeof(Header), 1, f) != 1) err = 3;
+  if (!err && cap && std::fwrite(table, kSlotSize, cap, f) != cap) err = 3;
+  if (!err &&
+      std::fwrite(offsets, sizeof(uint64_t), n + 1, f) !=
+          static_cast<size_t>(n + 1))
+    err = 3;
+  if (!err && blob_size && std::fwrite(blob, 1, blob_size, f) != blob_size)
+    err = 3;
+  if (std::fclose(f) != 0 && !err) err = 4;
+  delete[] table;
+  return err;
+}
+
+}  // extern "C"

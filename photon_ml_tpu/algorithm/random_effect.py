@@ -133,19 +133,23 @@ class RandomEffectCoordinate:
 
     # ------------------------------------------------------------------
     def global_coefficients(self, coefficients: Array) -> Array:
-        """Per-entity local coefficients back in the global feature space
-        -> (E, D_global) (RandomEffectModelInProjectedSpace.toRandomEffectModel
-        parity). INDEX_MAP/IDENTITY datasets scatter via local_to_global;
-        RANDOM datasets back-project through the stored projection matrix
-        (W_global = W_proj @ M). Host-sized output; for export/inspection."""
-        ds = self.dataset
-        if ds.projection_matrix is not None:
-            return coefficients @ ds.projection_matrix
-        e, d_loc = coefficients.shape
-        out = jnp.zeros((e, ds.global_dim), coefficients.dtype)
-        cols = jnp.maximum(ds.local_to_global, 0)
-        valid = ds.local_to_global >= 0
-        rows = jnp.broadcast_to(jnp.arange(e)[:, None], cols.shape)
-        return out.at[rows.reshape(-1), cols.reshape(-1)].add(
-            jnp.where(valid, coefficients, 0.0).reshape(-1)
-        )
+        return global_coefficients(self.dataset, coefficients)
+
+
+def global_coefficients(dataset: RandomEffectDataset, coefficients: Array) -> Array:
+    """Per-entity local coefficients back in the global feature space
+    -> (E, D_global) (RandomEffectModelInProjectedSpace.toRandomEffectModel
+    parity). INDEX_MAP/IDENTITY datasets scatter via local_to_global;
+    RANDOM datasets back-project through the stored projection matrix
+    (W_global = W_proj @ M). Host-sized output; for export/inspection."""
+    ds = dataset
+    if ds.projection_matrix is not None:
+        return coefficients @ ds.projection_matrix
+    e, d_loc = coefficients.shape
+    out = jnp.zeros((e, ds.global_dim), coefficients.dtype)
+    cols = jnp.maximum(ds.local_to_global, 0)
+    valid = ds.local_to_global >= 0
+    rows = jnp.broadcast_to(jnp.arange(e)[:, None], cols.shape)
+    return out.at[rows.reshape(-1), cols.reshape(-1)].add(
+        jnp.where(valid, coefficients, 0.0).reshape(-1)
+    )

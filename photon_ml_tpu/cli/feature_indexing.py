@@ -34,6 +34,9 @@ def build_parser() -> argparse.ArgumentParser:
     a("--feature-shard-id-to-feature-section-keys-map", dest="shard_sections", default=None)
     a("--feature-shard-id-to-intercept-map", dest="shard_intercepts", default=None)
     a("--add-intercept", default="true")
+    a("--format", dest="store_format", default="JSON", choices=["JSON", "OFFHEAP"],
+      help="JSON index file, or the native memory-mapped pmix store "
+      "(the PalDB-analogue off-heap format)")
     return p
 
 
@@ -54,32 +57,42 @@ def main(argv: Optional[List[str]] = None) -> List[str]:
     os.makedirs(ns.output_dir, exist_ok=True)
     add_intercept_default = str(ns.add_intercept).strip().lower() in ("true", "1", "yes")
 
+    offheap = ns.store_format == "OFFHEAP"
+    partitions = max(ns.partition_num, 1)
+
+    def emit(keys: List[str], add_intercept: bool, shard: Optional[str]) -> str:
+        if offheap:
+            from photon_ml_tpu.io.offheap import build_offheap_store
+
+            out = (
+                os.path.join(ns.output_dir, shard) if shard else ns.output_dir
+            )
+            build_offheap_store(out, keys, add_intercept, partitions)
+            count = len(keys) + int(add_intercept)
+        else:
+            imap = IndexMap.build(keys, add_intercept, partitions)
+            out = os.path.join(
+                ns.output_dir,
+                f"feature-index-{shard}.json" if shard else "feature-index.json",
+            )
+            imap.save(out)
+            count = len(imap)
+        label = f"shard {shard}: " if shard else ""
+        print(f"{label}{count} features -> {out}")
+        return out
+
     written: List[str] = []
     shard_sections = parse_shard_sections(ns.shard_sections)
     shard_intercepts = parse_shard_intercepts(ns.shard_intercepts)
     if shard_sections:
         for shard, sections in shard_sections.items():
             keys = avro_data.collect_feature_keys(paths, sections)
-            imap = IndexMap.build(
-                keys,
-                add_intercept=shard_intercepts.get(shard, add_intercept_default),
-                num_partitions=max(ns.partition_num, 1),
+            written.append(
+                emit(keys, shard_intercepts.get(shard, add_intercept_default), shard)
             )
-            out = os.path.join(ns.output_dir, f"feature-index-{shard}.json")
-            imap.save(out)
-            written.append(out)
-            print(f"shard {shard}: {len(imap)} features -> {out}")
     else:
         keys = avro_data.collect_feature_keys(paths)
-        imap = IndexMap.build(
-            keys,
-            add_intercept=add_intercept_default,
-            num_partitions=max(ns.partition_num, 1),
-        )
-        out = os.path.join(ns.output_dir, "feature-index.json")
-        imap.save(out)
-        written.append(out)
-        print(f"{len(imap)} features -> {out}")
+        written.append(emit(keys, add_intercept_default, None))
     return written
 
 

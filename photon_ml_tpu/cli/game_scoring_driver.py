@@ -68,8 +68,10 @@ class GameScoringDriver:
         paths = _input_files(p.input_dirs)
         for shard in shards:
             if p.offheap_indexmap_dir:
-                self.shard_index_maps[shard] = IndexMap.load(
-                    os.path.join(p.offheap_indexmap_dir, f"feature-index-{shard}.json")
+                from photon_ml_tpu.io.offheap import load_shard_index_map
+
+                self.shard_index_maps[shard] = load_shard_index_map(
+                    p.offheap_indexmap_dir, shard
                 )
             else:
                 sections = p.feature_shard_sections.get(shard) or ["features"]
@@ -96,6 +98,8 @@ class GameScoringDriver:
                 p.feature_shard_sections,
                 id_types,
                 shard_intercepts=p.feature_shard_intercepts or None,
+                # evaluators need labels; pure inference reads tolerate nulls
+                response_required=bool(p.evaluators),
             )
             self.logger.info(f"scoring {data.num_rows} rows")
 
@@ -118,23 +122,29 @@ class GameScoringDriver:
                 )
                 feats = data.shards[shard]
                 vocab = data.id_vocabs[re_id]
-                w = np.zeros((len(vocab), len(self.shard_index_maps[shard])))
-                has_model = np.zeros(len(vocab), bool)
-                for vi, raw in enumerate(vocab):
-                    if raw in entity_means:
-                        w[vi] = entity_means[raw]
-                        has_model[vi] = True
+                # entity-grouped scoring: one dense model row in memory at a
+                # time (never a (num_entities x num_features) matrix)
                 contrib = np.zeros(data.num_rows)
                 nnz_rows = np.repeat(np.arange(data.num_rows), np.diff(feats.indptr))
-                ent = data.ids[re_id][nnz_rows]
-                vals = w[ent, feats.indices] * feats.values
-                np.add.at(contrib, nnz_rows, vals)
-                # rows whose entity has no model score 0 (:129-158 semantics)
-                contrib[~has_model[data.ids[re_id]]] = 0.0
+                ent_of_nnz = data.ids[re_id][nnz_rows]
+                order = np.argsort(ent_of_nnz, kind="stable")
+                sorted_ent = ent_of_nnz[order]
+                bounds = np.searchsorted(
+                    sorted_ent, np.arange(len(vocab) + 1), side="left"
+                )
+                matched = 0
+                for vi, raw in enumerate(vocab):
+                    w_row = entity_means.get(raw)
+                    if w_row is None:
+                        continue  # rows of this entity score 0 (:129-158)
+                    matched += 1
+                    sel = order[bounds[vi]:bounds[vi + 1]]
+                    np.add.at(
+                        contrib, nnz_rows[sel], w_row[feats.indices[sel]] * feats.values[sel]
+                    )
                 total += contrib
                 self.logger.info(
-                    f"random effect {name!r}: {int(has_model.sum())}/{len(vocab)} "
-                    "entities matched"
+                    f"random effect {name!r}: {matched}/{len(vocab)} entities matched"
                 )
 
             self.scores = total.astype(np.float32)
@@ -158,9 +168,10 @@ class GameScoringDriver:
 
             def records(lo=lo, hi=hi):
                 for r in range(lo, hi):
+                    label = float(data.response[r])
                     yield {
                         "uid": str(r),
-                        "label": float(data.response[r]),
+                        "label": None if np.isnan(label) else label,
                         "modelId": p.game_model_id,
                         "predictionScore": float(self.scores[r]),
                         "weight": float(data.weight[r]),

@@ -207,7 +207,9 @@ class Driver:
     def _build_index_map(self) -> IndexMap:
         p = self.params
         if p.offheap_indexmap_dir:
-            return IndexMap.load(os.path.join(p.offheap_indexmap_dir, "feature-index.json"))
+            from photon_ml_tpu.io.offheap import load_index_map
+
+            return load_index_map(p.offheap_indexmap_dir)
         keys = avro_data.collect_feature_keys(self._input_paths(p.training_data_dir))
         selected = self._selected_features()
         if selected is not None:
@@ -402,9 +404,9 @@ class Driver:
         for lam, model in self.models:
             sections = []
             if p.diagnostic_mode.runs_validate and self.validation_batch is not None:
-                metrics = self.validation_metrics.get(
-                    lam, metrics_mod.evaluate(model, self.validation_batch)
-                )
+                metrics = self.validation_metrics.get(lam)
+                if metrics is None:
+                    metrics = metrics_mod.evaluate(model, self.validation_batch)
                 sections.append(
                     feature_importance.to_section(
                         feature_importance.diagnose(
@@ -432,17 +434,22 @@ class Driver:
             )
 
         if p.diagnostic_mode.runs_train and self.validation_batch is not None:
-            # dataset-level bootstrap on the best (or first) lambda
+            # dataset-level bootstrap at the best (or first) lambda
+            import dataclasses as _dc
+
             lam0 = self.best_reg_weight if self.best_reg_weight is not None else self.models[0][0]
-            boot = bootstrap_diagnostic.diagnose(
+            boot_problem = _dc.replace(
                 self.problem,
+                regularization=self.problem.regularization.with_weight(lam0),
+            )
+            boot = bootstrap_diagnostic.diagnose(
+                boot_problem,
                 self.train_batch,
                 self.norm,
                 self.validation_batch,
                 feature_names=feature_names,
             )
             model_reports[0].sections.append(bootstrap_diagnostic.to_section(boot))
-            del lam0
 
         doc = assemble_document(
             f"{p.job_name} model diagnostics",
@@ -473,11 +480,13 @@ def _concat_datasets(a: HostDataset, b: HostDataset) -> HostDataset:
     if a.dim != b.dim:
         raise ValueError(f"feature dims differ: {a.dim} vs {b.dim}")
 
-    def cat(x, y):
+    def cat(x, y, fill):
+        # fill must match to_batch's default for a missing column: offsets
+        # default to 0, weights default to 1
         if x is None and y is None:
             return None
-        x = x if x is not None else np.zeros(a.num_rows, np.float32)
-        y = y if y is not None else np.zeros(b.num_rows, np.float32)
+        x = x if x is not None else np.full(a.num_rows, fill, np.float32)
+        y = y if y is not None else np.full(b.num_rows, fill, np.float32)
         return np.concatenate([x, y])
 
     return HostDataset(
@@ -486,8 +495,8 @@ def _concat_datasets(a: HostDataset, b: HostDataset) -> HostDataset:
         indices=np.concatenate([a.indices, b.indices]),
         values=np.concatenate([a.values, b.values]),
         dim=a.dim,
-        offsets=cat(a.offsets, b.offsets),
-        weights=cat(a.weights, b.weights),
+        offsets=cat(a.offsets, b.offsets, 0.0),
+        weights=cat(a.weights, b.weights, 1.0),
     )
 
 

@@ -90,6 +90,7 @@ def read_game_data(
     id_types: Sequence[str],
     shard_intercepts: Optional[Dict[str, bool]] = None,
     id_vocabs: Optional[Dict[str, List[str]]] = None,
+    response_required: bool = True,
 ) -> GameData:
     """TrainingExampleAvro -> GameData with per-shard feature spaces.
 
@@ -112,7 +113,14 @@ def read_game_data(
         s: ([0], [], []) for s in shard_index_maps
     }
     for rec in _iter_records(paths):
-        labels.append(float(rec["label"]))
+        # response may be absent when scoring unlabeled data
+        # (cli/game/scoring/Driver.scala isResponseRequired=false :83)
+        label = rec.get("label", rec.get("response"))
+        if label is None:
+            if response_required:
+                raise ValueError(f"row {n}: label/response missing")
+            label = float("nan")
+        labels.append(float(label))
         offsets.append(float(rec.get("offset") or 0.0))
         weights.append(float(rec.get("weight") if rec.get("weight") is not None else 1.0))
         meta = rec.get("metadataMap") or {}

@@ -28,6 +28,22 @@ def feature_key(name: str, term: str = "") -> str:
     return f"{name}{DELIMITER}{term}"
 
 
+def partition_keys(feature_keys: Iterable[str], num_partitions: int) -> List[List[str]]:
+    """Canonical index-assignment order: dedup, drop the intercept key,
+    crc32-hash-partition, sort within each partition. BOTH index builders
+    (in-memory IndexMap.build and the off-heap pmix store) derive indices
+    from this one function, so they always agree (FeatureIndexingJob
+    hash-partition parity)."""
+    keys = set(feature_keys)
+    keys.discard(INTERCEPT_KEY)
+    parts: List[List[str]] = [[] for _ in range(num_partitions)]
+    for k in keys:
+        parts[zlib.crc32(k.encode()) % num_partitions].append(k)
+    for p in parts:
+        p.sort()
+    return parts
+
+
 @dataclasses.dataclass
 class IndexMap:
     """Two-way feature index. Immutable once built."""
@@ -57,14 +73,9 @@ class IndexMap:
               num_partitions: int = 1) -> "IndexMap":
         """Deterministic build: hash-partition names (FeatureIndexingJob
         parity), sort within partitions, concatenate with global offsets."""
-        keys = set(feature_keys)
-        keys.discard(INTERCEPT_KEY)
-        parts: List[List[str]] = [[] for _ in range(num_partitions)]
-        for k in keys:
-            parts[zlib.crc32(k.encode()) % num_partitions].append(k)
         ordered: List[str] = []
-        for p in parts:
-            ordered.extend(sorted(p))
+        for p in partition_keys(feature_keys, num_partitions):
+            ordered.extend(p)
         if add_intercept:
             ordered.append(INTERCEPT_KEY)
         return IndexMap({k: i for i, k in enumerate(ordered)}, ordered)

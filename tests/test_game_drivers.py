@@ -187,6 +187,43 @@ class TestGameScoring:
         assert "predictionScore" in recs[0]
 
 
+class TestUnlabeledScoring:
+    def test_score_without_labels(self, trained, tmp_path):
+        driver, out, dirs = trained
+        _, val_dir, _ = dirs
+        # re-write the validation rows with null labels (inference case)
+        schema = {**GAME_EXAMPLE_SCHEMA, "name": "UnlabeledExampleAvro",
+                  "fields": [
+                      {**f, "type": ["null", "double"], "default": None}
+                      if f["name"] == "label" else f
+                      for f in GAME_EXAMPLE_SCHEMA["fields"]
+                  ]}
+        recs = list(avro_io.read_directory(val_dir))
+        for r in recs:
+            r["label"] = None
+        unlabeled = tmp_path / "unlabeled"
+        unlabeled.mkdir()
+        avro_io.write_container(str(unlabeled / "p.avro"), recs, schema)
+
+        score_out = str(tmp_path / "score-out")
+        scorer = game_scoring_driver.main(
+            [
+                "--input-dirs", str(unlabeled),
+                "--game-model-input-dir", os.path.join(out, "best"),
+                "--output-dir", score_out,
+                "--feature-shard-id-to-feature-section-keys-map",
+                "global:fixedFeatures|per_user:userFeatures",
+                "--delete-output-dir-if-exists", "true",
+            ]
+        )
+        assert len(scorer.scores) == len(recs)
+        assert np.all(np.isfinite(scorer.scores))
+        out_recs = list(
+            avro_io.read_container(os.path.join(score_out, "scores", "part-00000.avro"))
+        )
+        assert out_recs[0]["label"] is None
+
+
 class TestFeatureIndexingJob:
     def test_per_shard_maps_and_offheap_training(self, game_avro_dirs):
         train_dir, val_dir, base = game_avro_dirs

@@ -1,0 +1,180 @@
+"""Off-heap (native memory-mapped) feature index store tests.
+
+(PalDBIndexMapTest analogue: global-offset lookup semantics, round-trips,
+cross-implementation parity between the C++ and pure-Python readers, and
+exact index agreement with the in-memory IndexMap.)
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from photon_ml_tpu.io import offheap
+from photon_ml_tpu.io.index_map import INTERCEPT_KEY, IndexMap, feature_key
+
+
+def _keys(n, seed=0):
+    rng = np.random.default_rng(seed)
+    return [
+        feature_key(f"name{rng.integers(0, 10_000_000)}", f"t{i % 7}")
+        for i in range(n)
+    ]
+
+
+class TestNativeLibrary:
+    def test_native_compiles(self):
+        # g++ is part of the environment contract; the native path must build
+        assert offheap.native_available()
+
+
+@pytest.fixture(scope="module", params=[False, True], ids=["native", "python"])
+def force_python(request):
+    if not request.param and not offheap.native_available():
+        pytest.skip("native lib unavailable")
+    return request.param
+
+
+class TestOffHeapStore:
+    def test_roundtrip_and_indexmap_parity(self, tmp_path, force_python):
+        keys = sorted(set(_keys(500, seed=1)))
+        store_dir = str(tmp_path / "store")
+        offheap.build_offheap_store(store_dir, keys, add_intercept=True, num_partitions=4)
+        store = offheap.OffHeapIndexMap(store_dir, force_python=force_python)
+        ref = IndexMap.build(keys, add_intercept=True, num_partitions=4)
+
+        assert len(store) == len(ref)
+        for k in keys:
+            assert store.get_index(k) == ref.get_index(k)
+        for i in range(len(ref)):
+            assert store.get_feature_name(i) == ref.get_feature_name(i)
+        assert store.intercept_index == ref.intercept_index
+        assert store.get_index(INTERCEPT_KEY) == ref.intercept_index
+        store.close()
+
+    def test_missing_keys(self, tmp_path, force_python):
+        store_dir = str(tmp_path / "store")
+        offheap.build_offheap_store(store_dir, ["a\x01", "b\x01"], add_intercept=False)
+        store = offheap.OffHeapIndexMap(store_dir, force_python=force_python)
+        assert store.get_index("zzz\x01") == -1
+        assert store.get_feature_name(99) is None
+        assert store.intercept_index == -1
+        assert "a\x01" in store and "zzz\x01" not in store
+        store.close()
+
+    def test_empty_partitions(self, tmp_path, force_python):
+        # more partitions than keys -> some partitions are empty
+        store_dir = str(tmp_path / "store")
+        offheap.build_offheap_store(store_dir, ["only\x01key"], num_partitions=8)
+        store = offheap.OffHeapIndexMap(store_dir, force_python=force_python)
+        assert store.get_index("only\x01key") == 0
+        assert store.get_feature_name(0) == "only\x01key"
+        store.close()
+
+    def test_unicode_keys(self, tmp_path, force_python):
+        keys = ["café\x01t", "日本\x01", "emoji\U0001f600\x01x"]
+        store_dir = str(tmp_path / "store")
+        offheap.build_offheap_store(store_dir, keys, add_intercept=False)
+        store = offheap.OffHeapIndexMap(store_dir, force_python=force_python)
+        for k in keys:
+            idx = store.get_index(k)
+            assert idx >= 0
+            assert store.get_feature_name(idx) == k
+        store.close()
+
+    def test_name_to_index_view(self, tmp_path, force_python):
+        keys = sorted(set(_keys(50, seed=3)))
+        store_dir = str(tmp_path / "store")
+        offheap.build_offheap_store(store_dir, keys, add_intercept=True)
+        store = offheap.OffHeapIndexMap(store_dir, force_python=force_python)
+        view = store.name_to_index
+        assert len(view) == len(store)
+        assert view[INTERCEPT_KEY] == store.intercept_index
+        store.close()
+
+
+class TestCrossImplementationParity:
+    def test_python_reads_native_build_and_vice_versa(self, tmp_path):
+        if not offheap.native_available():
+            pytest.skip("native lib unavailable")
+        keys = sorted(set(_keys(300, seed=2)))
+        store_dir = str(tmp_path / "store")
+        offheap.build_offheap_store(store_dir, keys, num_partitions=2)
+        native = offheap.OffHeapIndexMap(store_dir)
+        python = offheap.OffHeapIndexMap(store_dir, force_python=True)
+        for k in keys[:100]:
+            assert native.get_index(k) == python.get_index(k)
+        for i in range(0, len(keys), 7):
+            assert native.get_feature_name(i) == python.get_feature_name(i)
+        native.close()
+        python.close()
+
+
+class TestDriverIntegration:
+    def test_load_index_map_autodetect(self, tmp_path):
+        keys = ["f1\x01", "f2\x01"]
+        store_dir = str(tmp_path / "store")
+        offheap.build_offheap_store(store_dir, keys)
+        m = offheap.load_index_map(store_dir)
+        assert isinstance(m, offheap.OffHeapIndexMap)
+
+        json_dir = tmp_path / "json"
+        json_dir.mkdir()
+        IndexMap.build(keys).save(str(json_dir / "feature-index.json"))
+        m2 = offheap.load_index_map(str(json_dir))
+        assert isinstance(m2, IndexMap)
+        assert m.get_index("f1\x01") == m2.get_index("f1\x01")
+
+    def test_feature_indexing_job_offheap_and_game_training(self, tmp_path):
+        # end-to-end: indexing job writes OFFHEAP stores; GAME training
+        # consumes them via --offheap-indexmap-dir
+        import sys
+
+        sys.path.insert(0, os.path.dirname(__file__))
+        from game_test_utils import make_glmix_data
+        from test_game_drivers import COMMON_FLAGS, _write_game_avro
+
+        from photon_ml_tpu.cli import feature_indexing, game_training_driver
+
+        rng = np.random.default_rng(5)
+        gd, truth = make_glmix_data(
+            rng, num_users=8, rows_per_user_range=(20, 40), d_fixed=4, d_random=3
+        )
+        data = {
+            "y": gd.response,
+            "x_fixed": truth["x_fixed"],
+            "x_random": truth["x_random"],
+            "user_raw": [gd.id_vocabs["userId"][i] for i in gd.ids["userId"]],
+        }
+        train_dir = tmp_path / "train"
+        train_dir.mkdir()
+        _write_game_avro(str(train_dir / "p.avro"), data, range(gd.num_rows))
+
+        idx_dir = str(tmp_path / "idx")
+        written = feature_indexing.main(
+            [
+                "--data-input-dirs", str(train_dir),
+                "--output-dir", idx_dir,
+                "--partition-num", "2",
+                "--format", "OFFHEAP",
+                "--feature-shard-id-to-feature-section-keys-map",
+                "global:fixedFeatures|per_user:userFeatures",
+            ]
+        )
+        assert len(written) == 2
+        assert offheap.is_offheap_store(os.path.join(idx_dir, "global"))
+
+        driver = game_training_driver.main(
+            [
+                "--train-input-dirs", str(train_dir),
+                "--output-dir", str(tmp_path / "out"),
+                "--num-iterations", "1",
+                "--offheap-indexmap-dir", idx_dir,
+                "--model-output-mode", "NONE",
+            ]
+            + COMMON_FLAGS
+        )
+        # trained against the offheap maps; objective must be finite + improving
+        _, result, _ = driver.results[driver.best_index]
+        assert np.isfinite(result.objective_history[-1])
+        assert result.objective_history[-1] < result.objective_history[0]
