@@ -16,12 +16,15 @@ from __future__ import annotations
 
 import dataclasses
 import time
-from typing import Callable, Dict, List, Optional, Tuple
+from typing import TYPE_CHECKING, Callable, Dict, List, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
 
 from photon_ml_tpu.evaluation.evaluators import Evaluator
+
+if TYPE_CHECKING:  # pragma: no cover
+    from photon_ml_tpu.checkpoint import CoordinateDescentCheckpointer
 
 Array = jax.Array
 
@@ -73,17 +76,41 @@ class CoordinateDescent:
             name: jax.jit(lambda w, c=coord: c.score(w)) for name, coord in coordinates.items()
         }
 
-    def run(self, num_iterations: int, num_rows: int) -> CoordinateDescentResult:
+    def run(
+        self,
+        num_iterations: int,
+        num_rows: int,
+        checkpointer: Optional["CoordinateDescentCheckpointer"] = None,
+    ) -> CoordinateDescentResult:
+        """Run the descent; with a ``checkpointer``, state is saved after
+        every coordinate update and a restart resumes from the last complete
+        step (photon_ml_tpu.checkpoint — a designed upgrade, SURVEY.md §5.4:
+        the reference has no mid-run checkpointing)."""
         names = list(self.coordinates)
         params = {n: self.coordinates[n].initial_coefficients() for n in names}
         scores = {n: jnp.zeros((num_rows,), jnp.float32) for n in names}
         objective_history: List[float] = []
         validation_history: List[Dict[str, float]] = []
         timings = {n: 0.0 for n in names}
-
         total = jnp.zeros((num_rows,), jnp.float32)
+
+        start_step = 0
+        if checkpointer is not None:
+            restored = checkpointer.restore(params, scores, total)
+            if restored is not None:
+                start_step = restored.step
+                params = restored.params
+                scores = restored.scores
+                total = restored.total_scores
+                objective_history = restored.objective_history
+                validation_history = restored.validation_history
+
+        step = 0
         for it in range(num_iterations):
             for name in names:
+                step += 1
+                if step <= start_step:
+                    continue  # already completed before the restart
                 coord = self.coordinates[name]
                 partial = total - scores[name]  # sum of the OTHER coordinates
                 t0 = time.perf_counter()
@@ -108,6 +135,23 @@ class CoordinateDescent:
                         for key, (ev, kw) in self.validation_evaluators.items()
                     }
                     validation_history.append(metrics)
+
+                is_last = it == num_iterations - 1 and name == names[-1]
+                if checkpointer is not None and (
+                    step % checkpointer.save_every == 0 or is_last
+                ):
+                    from photon_ml_tpu.checkpoint import CheckpointState
+
+                    checkpointer.save(
+                        CheckpointState(
+                            step=step,
+                            params=params,
+                            scores=scores,
+                            total_scores=total,
+                            objective_history=objective_history,
+                            validation_history=validation_history,
+                        )
+                    )
 
         return CoordinateDescentResult(
             coefficients=params,

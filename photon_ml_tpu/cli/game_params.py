@@ -283,6 +283,9 @@ class GameTrainingParams:
     evaluators: List[Tuple[EvaluatorType, Optional[int], Optional[str]]] = dataclasses.field(
         default_factory=list
     )
+    # step-checkpoint directory (designed upgrade — the reference has no
+    # mid-run checkpointing, SURVEY.md §5.4); resume is automatic
+    checkpoint_dir: Optional[str] = None
 
     def validate(self) -> None:
         errors = []
@@ -342,6 +345,7 @@ def build_training_parser() -> argparse.ArgumentParser:
     a("--application-name", default="photon-ml-tpu-game")
     a("--offheap-indexmap-dir", default=None)
     a("--evaluator-type", dest="evaluators", default=None)
+    a("--checkpoint-dir", default=None)
     return p
 
 
@@ -376,6 +380,7 @@ def parse_training_params(argv: Optional[List[str]] = None) -> GameTrainingParam
         application_name=ns.application_name,
         offheap_indexmap_dir=ns.offheap_indexmap_dir,
         evaluators=parse_evaluators(ns.evaluators),
+        checkpoint_dir=ns.checkpoint_dir,
     )
     params.validate()
     return params

@@ -104,8 +104,10 @@ class GameTrainingDriver:
         paths = _input_files(p.train_input_dirs)
         for shard in self._shard_ids():
             if p.offheap_indexmap_dir:
-                self.shard_index_maps[shard] = IndexMap.load(
-                    os.path.join(p.offheap_indexmap_dir, f"feature-index-{shard}.json")
+                from photon_ml_tpu.io.offheap import load_shard_index_map
+
+                self.shard_index_maps[shard] = load_shard_index_map(
+                    p.offheap_indexmap_dir, shard
                 )
             else:
                 sections = p.feature_shard_sections.get(shard) or ["features"]
@@ -118,9 +120,11 @@ class GameTrainingDriver:
 
     # ------------------------------------------------------------------
     def _id_types(self) -> List[str]:
-        return sorted(
-            {cfg.random_effect_id for cfg in self.params.random_effect_data_configs.values()}
-        )
+        """Random-effect grouping ids + any id column an evaluator needs
+        (e.g. PRECISION@K:documentId)."""
+        ids = {cfg.random_effect_id for cfg in self.params.random_effect_data_configs.values()}
+        ids |= {id_name for _, _, id_name in self.params.evaluators if id_name}
+        return sorted(ids)
 
     def prepare_datasets(self) -> None:
         p = self.params
@@ -328,9 +332,31 @@ class GameTrainingDriver:
                 evaluators = self._validation_evaluators()
                 if primary is None and evaluators:
                     primary = next(iter(evaluators))
+            checkpointer = None
+            if p.checkpoint_dir:
+                from photon_ml_tpu.checkpoint import (
+                    CoordinateDescentCheckpointer,
+                    fingerprint,
+                )
+
+                checkpointer = CoordinateDescentCheckpointer(
+                    os.path.join(p.checkpoint_dir, f"combo-{i}"),
+                    # num_iterations intentionally excluded: extending a
+                    # finished run with more iterations IS the resume case
+                    run_fingerprint=fingerprint(
+                        {
+                            "coordinates": p.updating_sequence,
+                            "num_rows": self.train_data.num_rows,
+                            "combo": i,
+                            "configs": {k: str(v) for k, v in opt_configs.items()},
+                        }
+                    ),
+                )
             cd = CoordinateDescent(coords, loss_fn, scorer, evaluators)
             with self.timer.measure(f"combo-{i}"):
-                result = cd.run(p.num_iterations, self.train_data.num_rows)
+                result = cd.run(
+                    p.num_iterations, self.train_data.num_rows, checkpointer
+                )
             metrics = result.validation_history[-1] if result.validation_history else {}
             self.results.append((opt_configs, result, metrics))
             self.logger.info(
@@ -347,18 +373,14 @@ class GameTrainingDriver:
     # ------------------------------------------------------------------
     def _entity_means_global(self, name: str, coefficients) -> Dict[str, np.ndarray]:
         """Stacked coefficients -> {raw entity id: dense global-space row}."""
+        from photon_ml_tpu.algorithm.random_effect import global_coefficients
+
         cfg = self.params.random_effect_data_configs[name]
-        coord_obj = None  # re-derive global coefficients without a coordinate
         ds = self.re_datasets[name]
         if isinstance(coefficients, FactoredState):
             wg = np.asarray(coefficients.v @ coefficients.matrix)
         else:
-            # reuse RandomEffectCoordinate.global_coefficients logic
-            from photon_ml_tpu.algorithm.random_effect import RandomEffectCoordinate
-
-            helper = RandomEffectCoordinate(ds, self.params.task_type)
-            wg = np.asarray(helper.global_coefficients(jnp.asarray(coefficients)))
-        del coord_obj
+            wg = np.asarray(global_coefficients(ds, jnp.asarray(coefficients)))
         pos_of_vocab = self._entity_position_of_vocab(name)
         vocab = self.train_data.id_vocabs[cfg.random_effect_id]
         out: Dict[str, np.ndarray] = {}

@@ -1,0 +1,189 @@
+"""Checkpoint/resume tests for coordinate descent (SURVEY.md §5.4 upgrade)."""
+
+import os
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from photon_ml_tpu.algorithm import (
+    CoordinateDescent,
+    FixedEffectCoordinate,
+    RandomEffectCoordinate,
+)
+from photon_ml_tpu.checkpoint import (
+    CheckpointState,
+    CoordinateDescentCheckpointer,
+    fingerprint,
+)
+from photon_ml_tpu.data.game import RandomEffectDataConfig, build_fixed_effect_batch, build_random_effect_dataset
+from photon_ml_tpu.ops import losses
+from photon_ml_tpu.optim.common import OptimizerConfig
+from photon_ml_tpu.optim.problem import GLMOptimizationProblem
+from photon_ml_tpu.ops.regularization import RegularizationContext
+from photon_ml_tpu.types import OptimizerType, TaskType
+
+from game_test_utils import make_glmix_data
+
+
+@pytest.fixture(scope="module")
+def glmix():
+    rng = np.random.default_rng(11)
+    return make_glmix_data(rng, num_users=8, rows_per_user_range=(15, 35),
+                           d_fixed=4, d_random=3)
+
+
+def _build_cd(data):
+    fixed = FixedEffectCoordinate(
+        build_fixed_effect_batch(data, "global", dense=True),
+        GLMOptimizationProblem(
+            TaskType.LOGISTIC_REGRESSION,
+            OptimizerType.LBFGS,
+            OptimizerConfig(max_iterations=30, tolerance=1e-7),
+            RegularizationContext.l2(1e-2),
+        ),
+    )
+    random = RandomEffectCoordinate(
+        build_random_effect_dataset(data, RandomEffectDataConfig("userId", "per_user")),
+        TaskType.LOGISTIC_REGRESSION,
+        OptimizerType.LBFGS,
+        OptimizerConfig(max_iterations=25, tolerance=1e-6),
+        RegularizationContext.l2(1e-1),
+    )
+    labels = jnp.asarray(data.response)
+    loss_fn = lambda scores: jnp.sum(losses.logistic.loss(scores, labels))
+    return CoordinateDescent({"fixed": fixed, "random": random}, loss_fn)
+
+
+class TestCheckpointer:
+    def test_save_restore_roundtrip(self, tmp_path):
+        ckpt = CoordinateDescentCheckpointer(str(tmp_path), "fp1")
+        params = {"a": jnp.arange(4.0), "b": jnp.ones((2, 3))}
+        scores = {"a": jnp.zeros(5), "b": jnp.ones(5)}
+        total = jnp.full(5, 2.0)
+        ckpt.save(CheckpointState(3, params, scores, total, [1.0, 0.5], [{"AUC": 0.7}]))
+
+        restored = ckpt.restore(params, scores, total)
+        assert restored.step == 3
+        np.testing.assert_array_equal(np.asarray(restored.params["a"]), np.arange(4.0))
+        np.testing.assert_array_equal(np.asarray(restored.total_scores), np.full(5, 2.0))
+        assert restored.objective_history == [1.0, 0.5]
+        assert restored.validation_history == [{"AUC": 0.7}]
+
+    def test_latest_wins_and_retention(self, tmp_path):
+        ckpt = CoordinateDescentCheckpointer(str(tmp_path), "fp", keep=2)
+        params = {"a": jnp.zeros(2)}
+        scores = {"a": jnp.zeros(2)}
+        for step in (1, 2, 3, 4):
+            ckpt.save(
+                CheckpointState(step, {"a": jnp.full(2, float(step))}, scores,
+                                jnp.zeros(2), [], [])
+            )
+        assert ckpt.latest_step() == 4
+        # retention keeps only the last 2
+        dirs = [d for d in os.listdir(tmp_path) if d.startswith("step-")]
+        assert sorted(dirs) == ["step-3", "step-4"]
+        restored = ckpt.restore(params, scores, jnp.zeros(2))
+        np.testing.assert_array_equal(np.asarray(restored.params["a"]), [4.0, 4.0])
+
+    def test_fingerprint_mismatch_refuses(self, tmp_path):
+        ckpt = CoordinateDescentCheckpointer(str(tmp_path), "fpA")
+        params = {"a": jnp.zeros(2)}
+        ckpt.save(CheckpointState(1, params, params, jnp.zeros(2), [], []))
+        other = CoordinateDescentCheckpointer(str(tmp_path), "fpB")
+        with pytest.raises(ValueError, match="fingerprint"):
+            other.restore(params, params, jnp.zeros(2))
+
+    def test_empty_dir_returns_none(self, tmp_path):
+        ckpt = CoordinateDescentCheckpointer(str(tmp_path), "fp")
+        assert ckpt.restore({}, {}, jnp.zeros(1)) is None
+        assert ckpt.latest_step() is None
+
+    def test_fingerprint_stability(self):
+        a = fingerprint({"coords": ["x", "y"], "n": 10})
+        b = fingerprint({"n": 10, "coords": ["x", "y"]})  # key order irrelevant
+        c = fingerprint({"coords": ["x", "y"], "n": 11})
+        assert a == b and a != c
+
+
+class TestCoordinateDescentResume:
+    def test_resume_matches_uninterrupted_run(self, glmix, tmp_path):
+        data, _ = glmix
+        n = data.num_rows
+
+        # uninterrupted 2-iteration run
+        full = _build_cd(data).run(2, n)
+
+        # run 1 iteration with checkpointing ("crash" after iteration 1)...
+        ckpt_dir = str(tmp_path / "ckpt")
+        ckpt1 = CoordinateDescentCheckpointer(ckpt_dir, "run")
+        _build_cd(data).run(1, n, ckpt1)
+        assert ckpt1.latest_step() == 2  # 1 iteration x 2 coordinates
+
+        # ...then resume asking for the full 2 iterations
+        ckpt2 = CoordinateDescentCheckpointer(ckpt_dir, "run")
+        resumed = _build_cd(data).run(2, n, ckpt2)
+
+        np.testing.assert_allclose(
+            np.asarray(resumed.total_scores), np.asarray(full.total_scores),
+            rtol=1e-5, atol=1e-5,
+        )
+        np.testing.assert_allclose(
+            np.asarray(resumed.coefficients["fixed"]),
+            np.asarray(full.coefficients["fixed"]),
+            rtol=1e-5, atol=1e-5,
+        )
+        assert len(resumed.objective_history) == len(full.objective_history)
+        assert resumed.objective_history[-1] == pytest.approx(
+            full.objective_history[-1], rel=1e-5
+        )
+
+    def test_completed_run_resumes_to_noop(self, glmix, tmp_path):
+        data, _ = glmix
+        n = data.num_rows
+        ckpt_dir = str(tmp_path / "ckpt")
+        first = _build_cd(data).run(1, n, CoordinateDescentCheckpointer(ckpt_dir, "r"))
+        again = _build_cd(data).run(1, n, CoordinateDescentCheckpointer(ckpt_dir, "r"))
+        np.testing.assert_array_equal(
+            np.asarray(first.total_scores), np.asarray(again.total_scores)
+        )
+        # no additional objective evaluations happened on the no-op resume
+        assert again.objective_history == first.objective_history
+
+
+class TestDriverCheckpointFlag:
+    def test_game_driver_checkpoint_dir(self, tmp_path):
+        import sys
+
+        sys.path.insert(0, os.path.dirname(__file__))
+        from test_game_drivers import COMMON_FLAGS, _write_game_avro
+        from game_test_utils import make_glmix_data as mk
+
+        from photon_ml_tpu.cli import game_training_driver
+
+        rng = np.random.default_rng(13)
+        gd, truth = mk(rng, num_users=6, rows_per_user_range=(15, 30),
+                       d_fixed=3, d_random=2)
+        data = {
+            "y": gd.response,
+            "x_fixed": truth["x_fixed"],
+            "x_random": truth["x_random"],
+            "user_raw": [gd.id_vocabs["userId"][i] for i in gd.ids["userId"]],
+        }
+        train_dir = tmp_path / "train"
+        train_dir.mkdir()
+        _write_game_avro(str(train_dir / "p.avro"), data, range(gd.num_rows))
+
+        ckpt_dir = str(tmp_path / "ckpt")
+        args = [
+            "--train-input-dirs", str(train_dir),
+            "--output-dir", str(tmp_path / "out"),
+            "--num-iterations", "1",
+            "--checkpoint-dir", ckpt_dir,
+            "--model-output-mode", "NONE",
+        ] + COMMON_FLAGS
+        d1 = game_training_driver.main(args)
+        assert os.path.isdir(os.path.join(ckpt_dir, "combo-0"))
+        # second run resumes from the complete checkpoint: same final objective
+        d2 = game_training_driver.main(args)
+        assert d2.results[0][1].objective_history == d1.results[0][1].objective_history
