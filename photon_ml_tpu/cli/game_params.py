@@ -258,6 +258,11 @@ class GameTrainingParams:
     output_dir: str = ""
     updating_sequence: List[str] = dataclasses.field(default_factory=list)
     validate_input_dirs: Optional[List[str]] = None
+    # daily/yyyy/MM/dd input discovery (IOUtils.scala:85-130); range XOR days-ago
+    train_date_range: Optional[str] = None
+    train_date_range_days_ago: Optional[str] = None
+    validate_date_range: Optional[str] = None
+    validate_date_range_days_ago: Optional[str] = None
     feature_shard_sections: Dict[str, List[str]] = dataclasses.field(default_factory=dict)
     feature_shard_intercepts: Dict[str, bool] = dataclasses.field(default_factory=dict)
     num_iterations: int = 1
@@ -305,6 +310,14 @@ class GameTrainingParams:
                 errors.append(f"coordinate {name!r} has no data configuration")
         if self.num_iterations < 1:
             errors.append("--num-iterations must be >= 1")
+        if self.train_date_range and self.train_date_range_days_ago:
+            errors.append(
+                "--train-date-range and --train-date-range-days-ago are exclusive"
+            )
+        if self.validate_date_range and self.validate_date_range_days_ago:
+            errors.append(
+                "--validate-date-range and --validate-date-range-days-ago are exclusive"
+            )
         if errors:
             raise ValueError("; ".join(errors))
 
@@ -330,6 +343,10 @@ def build_training_parser() -> argparse.ArgumentParser:
     a("--output-dir", required=True)
     a("--updating-sequence", required=True, help="comma-separated coordinate names")
     a("--validate-input-dirs", default=None)
+    a("--train-date-range", default=None, help="yyyyMMdd-yyyyMMdd")
+    a("--train-date-range-days-ago", default=None, help="e.g. 90-1")
+    a("--validate-date-range", default=None)
+    a("--validate-date-range-days-ago", default=None)
     a("--feature-shard-id-to-feature-section-keys-map", dest="shard_sections", default=None)
     a("--feature-shard-id-to-intercept-map", dest="shard_intercepts", default=None)
     a("--num-iterations", type=int, default=1)
@@ -365,6 +382,10 @@ def parse_training_params(argv: Optional[List[str]] = None) -> GameTrainingParam
             if ns.validate_input_dirs
             else None
         ),
+        train_date_range=ns.train_date_range,
+        train_date_range_days_ago=ns.train_date_range_days_ago,
+        validate_date_range=ns.validate_date_range,
+        validate_date_range_days_ago=ns.validate_date_range_days_ago,
         feature_shard_sections=parse_shard_sections(ns.shard_sections),
         feature_shard_intercepts=parse_shard_intercepts(ns.shard_intercepts),
         num_iterations=ns.num_iterations,
@@ -394,6 +415,8 @@ class GameScoringParams:
     game_model_input_dir: str = ""
     output_dir: str = ""
     game_model_id: str = ""
+    date_range: Optional[str] = None
+    date_range_days_ago: Optional[str] = None
     random_effect_id_types: List[str] = dataclasses.field(default_factory=list)
     feature_shard_sections: Dict[str, List[str]] = dataclasses.field(default_factory=dict)
     feature_shard_intercepts: Dict[str, bool] = dataclasses.field(default_factory=dict)
@@ -413,6 +436,8 @@ class GameScoringParams:
             errors.append("--game-model-input-dir is required")
         if not self.output_dir:
             errors.append("--output-dir is required")
+        if self.date_range and self.date_range_days_ago:
+            errors.append("--date-range and --date-range-days-ago are exclusive")
         if errors:
             raise ValueError("; ".join(errors))
 
@@ -426,6 +451,8 @@ def build_scoring_parser() -> argparse.ArgumentParser:
     a("--game-model-input-dir", required=True)
     a("--output-dir", required=True)
     a("--game-model-id", default="")
+    a("--date-range", default=None, help="yyyyMMdd-yyyyMMdd")
+    a("--date-range-days-ago", default=None, help="e.g. 90-1")
     a("--random-effect-id-set", dest="re_id_set", default=None)
     a("--feature-shard-id-to-feature-section-keys-map", dest="shard_sections", default=None)
     a("--feature-shard-id-to-intercept-map", dest="shard_intercepts", default=None)
@@ -444,6 +471,8 @@ def parse_scoring_params(argv: Optional[List[str]] = None) -> GameScoringParams:
         game_model_input_dir=ns.game_model_input_dir,
         output_dir=ns.output_dir,
         game_model_id=ns.game_model_id,
+        date_range=ns.date_range,
+        date_range_days_ago=ns.date_range_days_ago,
         random_effect_id_types=(
             [t.strip() for t in ns.re_id_set.split(",") if t.strip()]
             if ns.re_id_set

@@ -17,7 +17,7 @@ import numpy as np
 import jax.numpy as jnp
 
 from photon_ml_tpu.cli.game_params import GameScoringParams, parse_scoring_params
-from photon_ml_tpu.cli.game_training_driver import _input_files
+from photon_ml_tpu.cli.game_training_driver import _input_files, resolve_date_range_dirs
 from photon_ml_tpu.evaluation.evaluators import evaluator_for
 from photon_ml_tpu.io import avro as avro_io
 from photon_ml_tpu.io import avro_data, model_io, schemas
@@ -39,6 +39,16 @@ class GameScoringDriver:
         self.shard_index_maps: Dict[str, IndexMap] = {}
         self.scores: Optional[np.ndarray] = None
         self.metrics: Dict[str, float] = {}
+        # resolved once (date-range expansion walks the daily tree)
+        self._input_paths: Optional[List[str]] = None
+
+    def _resolved_input_paths(self) -> List[str]:
+        if self._input_paths is None:
+            p = self.params
+            self._input_paths = _input_files(
+                resolve_date_range_dirs(p.input_dirs, p.date_range, p.date_range_days_ago)
+            )
+        return self._input_paths
 
     # ------------------------------------------------------------------
     def _load_model_layout(self):
@@ -65,7 +75,7 @@ class GameScoringDriver:
 
     def _prepare_feature_maps(self, shards: List[str]) -> None:
         p = self.params
-        paths = _input_files(p.input_dirs)
+        paths = self._resolved_input_paths()
         for shard in shards:
             if p.offheap_indexmap_dir:
                 from photon_ml_tpu.io.offheap import load_shard_index_map
@@ -93,7 +103,7 @@ class GameScoringDriver:
                 set(p.random_effect_id_types) | {rid for _, rid, _ in random if rid}
             )
             data = avro_data.read_game_data(
-                _input_files(p.input_dirs),
+                self._resolved_input_paths(),
                 self.shard_index_maps,
                 p.feature_shard_sections,
                 id_types,

@@ -70,6 +70,35 @@ def _input_files(dirs: List[str]) -> List[str]:
     return files
 
 
+def resolve_date_range_dirs(
+    dirs: List[str],
+    date_range: Optional[str],
+    days_ago: Optional[str],
+) -> List[str]:
+    """Expand input dirs into their daily/yyyy/MM/dd subdirs within the
+    requested range (IOUtils.scala:85-130 discovery); no range -> unchanged."""
+    if not date_range and not days_ago:
+        return dirs
+    from photon_ml_tpu.utils.date_range import DateRange, expand_date_range_paths
+
+    dr = (
+        DateRange.from_string(date_range)
+        if date_range
+        else DateRange.from_days_ago(days_ago)
+    )
+    out: List[str] = []
+    for d in dirs:
+        try:
+            out.extend(expand_date_range_paths(d, dr))
+        except FileNotFoundError:
+            pass  # error only if the union over ALL dirs is empty (IOUtils parity)
+    if not out:
+        raise FileNotFoundError(
+            f"no daily inputs under any of {dirs} within {dr.start}..{dr.end}"
+        )
+    return out
+
+
 class GameTrainingDriver:
     """Builds coordinates from params + data, runs the grid, saves models."""
 
@@ -97,11 +126,25 @@ class GameTrainingDriver:
         shards |= {cfg.feature_shard_id for cfg in p.random_effect_data_configs.values()}
         return sorted(shards)
 
+    def _train_dirs(self) -> List[str]:
+        p = self.params
+        return resolve_date_range_dirs(
+            p.train_input_dirs, p.train_date_range, p.train_date_range_days_ago
+        )
+
+    def _validate_dirs(self) -> List[str]:
+        p = self.params
+        return resolve_date_range_dirs(
+            p.validate_input_dirs or [],
+            p.validate_date_range,
+            p.validate_date_range_days_ago,
+        )
+
     def prepare_feature_maps(self) -> None:
         """GAMEDriver.prepareFeatureMaps parity (offheap load :76-82 or
         whole-dataset scan :49-69)."""
         p = self.params
-        paths = _input_files(p.train_input_dirs)
+        paths = _input_files(self._train_dirs())
         for shard in self._shard_ids():
             if p.offheap_indexmap_dir:
                 from photon_ml_tpu.io.offheap import load_shard_index_map
@@ -129,7 +172,7 @@ class GameTrainingDriver:
     def prepare_datasets(self) -> None:
         p = self.params
         self.train_data = avro_data.read_game_data(
-            _input_files(p.train_input_dirs),
+            _input_files(self._train_dirs()),
             self.shard_index_maps,
             p.feature_shard_sections,
             self._id_types(),
@@ -138,7 +181,7 @@ class GameTrainingDriver:
         self.logger.info(f"training rows: {self.train_data.num_rows}")
         if p.validate_input_dirs:
             self.validation_data = avro_data.read_game_data(
-                _input_files(p.validate_input_dirs),
+                _input_files(self._validate_dirs()),
                 self.shard_index_maps,
                 p.feature_shard_sections,
                 self._id_types(),
@@ -231,7 +274,10 @@ class GameTrainingDriver:
         entity_pos = np.asarray(ds.entity_pos)
         vocab_size = len(self.train_data.id_vocabs[cfg.random_effect_id])
         pos = np.full(vocab_size, -1, np.int32)
-        pos[ids] = entity_pos
+        # only rows that carry a real tensor position: dropped-passive rows
+        # have entity_pos -1 and must not clobber their entity's mapping
+        known = entity_pos >= 0
+        pos[ids[known]] = entity_pos[known]
         return pos
 
     def _validation_scorer(self, coords: Dict[str, object]):

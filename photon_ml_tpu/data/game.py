@@ -471,6 +471,16 @@ def _assemble_random_effect_tensors(
 
     # ---- scoring tensors (all rows) ---------------------------------------
     entity_pos_all = tensor_pos[ids].astype(np.int32)
+    if config.passive_lower_bound is not None:
+        # keep passive rows only for entities with more than lower-bound
+        # passive points (RandomEffectDataSet.generatePassiveData:344-351);
+        # dropped rows get entity_pos -1 and score 0 for this coordinate
+        passive_mask = ~active_mask
+        passive_counts = np.bincount(
+            ids[passive_mask], minlength=num_entities_raw
+        )
+        keep_entity = passive_counts > config.passive_lower_bound
+        entity_pos_all[passive_mask & ~keep_entity[ids]] = -1
     sc_idx, sc_val = project_rows(np.arange(n, dtype=np.int64))
 
     # local_to_global above is indexed by RAW entity id; the tensors are laid

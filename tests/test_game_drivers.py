@@ -255,6 +255,144 @@ class TestFeatureIndexingJob:
         assert metrics["AUC"] > 0.75
 
 
+class TestDateRangeDiscovery:
+    def test_training_with_daily_layout(self, game_avro_dirs, tmp_path):
+        import shutil
+
+        train_dir, _, _ = game_avro_dirs
+        # lay the training file out as <root>/daily/2026/07/{27,28}/
+        root = tmp_path / "daily-root"
+        for day in ("27", "28"):
+            dest = root / "daily" / "2026" / "07" / day
+            dest.mkdir(parents=True)
+            shutil.copy(os.path.join(train_dir, "part-0.avro"), dest / "part-0.avro")
+        out = str(tmp_path / "out")
+        driver = game_training_driver.main(
+            [
+                "--train-input-dirs", str(root),
+                "--train-date-range", "20260727-20260727",
+                "--output-dir", out,
+                "--num-iterations", "1",
+                "--model-output-mode", "NONE",
+            ]
+            + COMMON_FLAGS
+        )
+        # only one day selected -> one file's worth of rows
+        one_day_rows = driver.train_data.num_rows
+        driver2 = game_training_driver.main(
+            [
+                "--train-input-dirs", str(root),
+                "--train-date-range", "20260727-20260728",
+                "--output-dir", out,
+                "--num-iterations", "1",
+                "--model-output-mode", "NONE",
+            ]
+            + COMMON_FLAGS
+        )
+        assert driver2.train_data.num_rows == 2 * one_day_rows
+
+    def test_missing_range_raises(self, game_avro_dirs, tmp_path):
+        with pytest.raises(FileNotFoundError):
+            game_training_driver.main(
+                [
+                    "--train-input-dirs", str(tmp_path),
+                    "--train-date-range", "20000101-20000102",
+                    "--output-dir", str(tmp_path / "o"),
+                ]
+                + COMMON_FLAGS
+            )
+
+    def test_exclusive_range_flags_rejected(self):
+        from photon_ml_tpu.cli.game_params import parse_training_params
+
+        with pytest.raises(ValueError, match="exclusive"):
+            parse_training_params(
+                [
+                    "--train-input-dirs", "/x",
+                    "--train-date-range", "20260101-20260102",
+                    "--train-date-range-days-ago", "9-1",
+                    "--output-dir", "/y",
+                ]
+                + COMMON_FLAGS
+            )
+
+
+class TestPassiveDataBound:
+    def test_passive_lower_bound_drops_small_entities(self):
+        from photon_ml_tpu.data.game import (
+            RandomEffectDataConfig,
+            build_random_effect_dataset,
+        )
+        from game_test_utils import make_glmix_data
+
+        rng = np.random.default_rng(21)
+        data, _ = make_glmix_data(
+            rng, num_users=10, rows_per_user_range=(10, 30), d_fixed=3, d_random=2
+        )
+        # active cap of 5 -> every entity has passive rows (count - 5)
+        cfg = RandomEffectDataConfig(
+            "userId", "per_user", active_upper_bound=5, passive_lower_bound=12
+        )
+        ds = build_random_effect_dataset(data, cfg)
+        ids = data.ids["userId"]
+        counts = np.bincount(ids, minlength=10)
+        entity_pos = np.asarray(ds.entity_pos)
+        row_index = np.asarray(ds.row_index)
+        active_rows = set(row_index[row_index >= 0].tolist())
+        for e in range(10):
+            passive_count = counts[e] - min(counts[e], 5)
+            rows = np.nonzero(ids == e)[0]
+            for r in rows:
+                if int(r) in active_rows:
+                    assert entity_pos[r] >= 0  # active rows always scored
+                elif passive_count > 12:
+                    assert entity_pos[r] >= 0  # passive kept
+                else:
+                    assert entity_pos[r] == -1  # passive dropped -> scores 0
+
+    def test_driver_entity_mapping_survives_passive_drop(self, game_avro_dirs, tmp_path):
+        # dropped-passive rows (entity_pos -1) must not clobber the
+        # entity -> tensor-position mapping used for saving/validation
+        train_dir, _, _ = game_avro_dirs
+        out = str(tmp_path / "out")
+        flags = [f if f != "per-user:userId,per_user,1,-1,-1,-1,INDEX_MAP"
+                 else "per-user:userId,per_user,1,5,1000000,-1,INDEX_MAP"
+                 for f in COMMON_FLAGS]
+        driver = game_training_driver.main(
+            [
+                "--train-input-dirs", train_dir,
+                "--output-dir", out,
+                "--num-iterations", "1",
+            ]
+            + flags
+        )
+        # every entity trained (has active rows) -> must have a position
+        pos = driver._entity_position_of_vocab("per-user")
+        assert np.all(pos >= 0), pos
+        # and the saved model must cover all 12 users
+        from photon_ml_tpu.io import model_io
+
+        entity_means, _, _, _ = model_io.load_random_effect(
+            os.path.join(out, "best"), "per-user",
+            driver.shard_index_maps["per_user"],
+        )
+        assert len(entity_means) == 12
+
+    def test_no_bound_keeps_everything(self):
+        from photon_ml_tpu.data.game import (
+            RandomEffectDataConfig,
+            build_random_effect_dataset,
+        )
+        from game_test_utils import make_glmix_data
+
+        rng = np.random.default_rng(22)
+        data, _ = make_glmix_data(rng, num_users=5, rows_per_user_range=(8, 15))
+        ds = build_random_effect_dataset(
+            data, RandomEffectDataConfig("userId", "per_user", active_upper_bound=4)
+        )
+        assert np.all(np.asarray(ds.entity_pos) >= 0)
+
+
 class TestGameConfigParsing:
     def test_opt_config(self):
         cfg = CoordinateOptConfig.parse("20,1e-5,0.5,0.8,TRON,L2")
