@@ -43,7 +43,13 @@ Array = jax.Array
 @jax.tree_util.register_pytree_node_class
 @dataclasses.dataclass
 class DenseFeatures:
-    """Dense (N, D) feature matrix."""
+    """Dense (N, D) feature matrix.
+
+    The matrix may be stored in bfloat16 — the HBM-bandwidth lever for the
+    GLM hot loop (the matvec is memory-bound; bf16 storage halves traffic).
+    All contractions accumulate in float32 on the MXU and return float32
+    regardless of storage dtype.
+    """
 
     matrix: Array  # (N, D)
 
@@ -56,19 +62,30 @@ class DenseFeatures:
         return self.matrix.shape[1]
 
     def matvec(self, w: Array) -> Array:
-        return self.matrix @ w
+        return jnp.dot(
+            self.matrix, w.astype(self.matrix.dtype),
+            preferred_element_type=jnp.float32,
+        )
 
     def rmatvec(self, d: Array) -> Array:
-        return d @ self.matrix
+        return jnp.dot(
+            d.astype(self.matrix.dtype), self.matrix,
+            preferred_element_type=jnp.float32,
+        )
 
     def sq_rmatvec(self, d: Array) -> Array:
-        return d @ jnp.square(self.matrix)
+        sq = jnp.square(self.matrix.astype(jnp.float32))
+        return jnp.dot(d, sq, preferred_element_type=jnp.float32)
 
     def row_sq_norms(self) -> Array:
-        return jnp.sum(jnp.square(self.matrix), axis=-1)
+        return jnp.sum(jnp.square(self.matrix.astype(jnp.float32)), axis=-1)
 
     def to_dense(self) -> Array:
-        return self.matrix
+        return self.matrix.astype(jnp.float32)
+
+    def astype(self, dtype) -> "DenseFeatures":
+        """Re-store the matrix in another dtype (bf16 for bandwidth)."""
+        return DenseFeatures(self.matrix.astype(dtype))
 
     # -- pytree protocol ----------------------------------------------------
     def tree_flatten(self):
@@ -91,7 +108,8 @@ class SparseFeatures:
     """
 
     indices: Array  # (N, K) int32
-    values: Array  # (N, K)
+    values: Array  # (N, K) — may be stored bfloat16; accumulation is f32
+
     dim: int = dataclasses.field(metadata={"static": True})
 
     @property
@@ -99,28 +117,35 @@ class SparseFeatures:
         return self.indices.shape[0]
 
     def matvec(self, w: Array) -> Array:
-        return jnp.sum(w[self.indices] * self.values, axis=-1)
+        prods = w[self.indices].astype(jnp.float32) * self.values.astype(jnp.float32)
+        return jnp.sum(prods, axis=-1)
 
     def rmatvec(self, d: Array) -> Array:
-        contrib = self.values * d[:, None]  # (N, K)
-        return jnp.zeros((self.dim,), contrib.dtype).at[self.indices.reshape(-1)].add(
+        contrib = self.values.astype(jnp.float32) * d.astype(jnp.float32)[:, None]
+        return jnp.zeros((self.dim,), jnp.float32).at[self.indices.reshape(-1)].add(
             contrib.reshape(-1)
         )
 
     def sq_rmatvec(self, d: Array) -> Array:
-        contrib = jnp.square(self.values) * d[:, None]
-        return jnp.zeros((self.dim,), contrib.dtype).at[self.indices.reshape(-1)].add(
+        contrib = jnp.square(self.values.astype(jnp.float32)) * d.astype(jnp.float32)[:, None]
+        return jnp.zeros((self.dim,), jnp.float32).at[self.indices.reshape(-1)].add(
             contrib.reshape(-1)
         )
 
     def row_sq_norms(self) -> Array:
-        return jnp.sum(jnp.square(self.values), axis=-1)
+        return jnp.sum(jnp.square(self.values.astype(jnp.float32)), axis=-1)
 
     def to_dense(self) -> Array:
         n, k = self.indices.shape
-        out = jnp.zeros((n, self.dim), self.values.dtype)
+        out = jnp.zeros((n, self.dim), jnp.float32)
         rows = jnp.broadcast_to(jnp.arange(n)[:, None], (n, k))
-        return out.at[rows.reshape(-1), self.indices.reshape(-1)].add(self.values.reshape(-1))
+        return out.at[rows.reshape(-1), self.indices.reshape(-1)].add(
+            self.values.reshape(-1).astype(jnp.float32)
+        )
+
+    def astype(self, dtype) -> "SparseFeatures":
+        """Re-store the values in another dtype (bf16 for bandwidth)."""
+        return SparseFeatures(self.indices, self.values.astype(dtype), self.dim)
 
     # -- pytree protocol ----------------------------------------------------
     def tree_flatten(self):
