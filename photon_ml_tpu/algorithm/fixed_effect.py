@@ -49,23 +49,17 @@ class FixedEffectCoordinate:
         (Coordinate.updateModel = addScoresToOffsets -> solve,
         Coordinate.scala:43-49.)
         """
+        from photon_ml_tpu.data.sampler import maybe_down_sample
+
         batch = GLMBatch(
             self.batch.features,
             self.batch.labels,
             self.batch.offsets + residual_offsets,
             self.batch.weights,
         )
-        if self.down_sampling_rate is not None and self.down_sampling_rate < 1.0:
-            from photon_ml_tpu.data.sampler import down_sample_binary, down_sample_default
-            from photon_ml_tpu.types import TaskType
-
-            key = jax.random.PRNGKey(self.seed)
-            sampler = (
-                down_sample_binary
-                if self.problem.task == TaskType.LOGISTIC_REGRESSION
-                else down_sample_default
-            )
-            batch = sampler(batch, self.down_sampling_rate, key)
+        batch = maybe_down_sample(
+            batch, self.problem.task, self.down_sampling_rate, self.seed
+        )
         model, result = self.problem.run(batch, self.norm, init_coefficients)
         return model.coefficients.means, result
 

@@ -291,6 +291,9 @@ class GameTrainingParams:
     # step-checkpoint directory (designed upgrade — the reference has no
     # mid-run checkpointing, SURVEY.md §5.4); resume is automatic
     checkpoint_dir: Optional[str] = None
+    # shard fixed-effect rows + random-effect entities over all visible
+    # devices (jax.sharding Mesh; collectives ride ICI)
+    distributed: bool = False
 
     def validate(self) -> None:
         errors = []
@@ -363,6 +366,7 @@ def build_training_parser() -> argparse.ArgumentParser:
     a("--offheap-indexmap-dir", default=None)
     a("--evaluator-type", dest="evaluators", default=None)
     a("--checkpoint-dir", default=None)
+    a("--distributed", default="false")
     return p
 
 
@@ -402,6 +406,7 @@ def parse_training_params(argv: Optional[List[str]] = None) -> GameTrainingParam
         offheap_indexmap_dir=ns.offheap_indexmap_dir,
         evaluators=parse_evaluators(ns.evaluators),
         checkpoint_dir=ns.checkpoint_dir,
+        distributed=_truthy(ns.distributed),
     )
     params.validate()
     return params

@@ -204,15 +204,28 @@ class GameTrainingDriver:
             self.re_datasets[name] = build_random_effect_dataset(self.train_data, cfg)
 
     # ------------------------------------------------------------------
+    def _mesh_context(self):
+        """One MeshContext over all visible devices (lazy; --distributed)."""
+        if not hasattr(self, "_mesh_ctx"):
+            from photon_ml_tpu.parallel import MeshContext, data_mesh
+
+            self._mesh_ctx = MeshContext(data_mesh())
+            self.logger.info(
+                f"distributed: {self._mesh_ctx.num_devices}-device mesh"
+            )
+        return self._mesh_ctx
+
     def _build_coordinates(self, opt_configs: Dict[str, CoordinateOptConfig]) -> Dict[str, object]:
         """Coordinate objects per updating sequence
-        (cli/game/training/Driver.scala:344-402)."""
+        (cli/game/training/Driver.scala:344-402). With --distributed, fixed
+        effects solve row-sharded and random effects entity-sharded over the
+        device mesh; factored coordinates stay single-device."""
         p = self.params
         coords: Dict[str, object] = {}
         for name in p.updating_sequence:
             cfg = opt_configs.get(name, CoordinateOptConfig())
             if name in p.fixed_effect_data_configs:
-                coords[name] = FixedEffectCoordinate(
+                fe = FixedEffectCoordinate(
                     self.fe_batches[name],
                     GLMOptimizationProblem(
                         task=p.task_type,
@@ -225,6 +238,13 @@ class GameTrainingDriver:
                         cfg.down_sampling_rate if cfg.down_sampling_rate < 1.0 else None
                     ),
                 )
+                if p.distributed:
+                    from photon_ml_tpu.parallel.distributed import (
+                        DistributedFixedEffectCoordinate,
+                    )
+
+                    fe = DistributedFixedEffectCoordinate(fe, self._mesh_context())
+                coords[name] = fe
             elif name in p.factored_configs:
                 spec = p.factored_configs[name]
                 coords[name] = FactoredRandomEffectCoordinate(
@@ -241,13 +261,20 @@ class GameTrainingDriver:
                     latent_regularization=spec.latent_factor.regularization_context(),
                 )
             else:
-                coords[name] = RandomEffectCoordinate(
+                re = RandomEffectCoordinate(
                     self.re_datasets[name],
                     p.task_type,
                     optimizer=cfg.optimizer,
                     optimizer_config=cfg.optimizer_config(),
                     regularization=cfg.regularization_context(),
                 )
+                if p.distributed:
+                    from photon_ml_tpu.parallel.distributed import (
+                        DistributedRandomEffectSolver,
+                    )
+
+                    re = DistributedRandomEffectSolver(re, self._mesh_context())
+                coords[name] = re
         return coords
 
     # ------------------------------------------------------------------
@@ -322,17 +349,20 @@ class GameTrainingDriver:
                 )
 
         def scorer(params_map):
+            from photon_ml_tpu.algorithm.random_effect import global_coefficients
+
             total = jnp.zeros((nv,), jnp.float32)
             for name in p.updating_sequence:
                 w = params_map[name]
                 if name in fe_feats:
                     total = total + fe_feats[name].matvec(w)
                 else:
-                    coord = coords[name]
+                    ds = self.re_datasets[name]
                     if isinstance(w, FactoredState):
                         wg = w.v @ w.matrix  # (E, D_global): IDENTITY local space
                     else:
-                        wg = coord.global_coefficients(w)
+                        # distributed solves pad the entity axis; slice back
+                        wg = global_coefficients(ds, w[: ds.num_entities])
                     cols, vals, ent_pos = re_info[name]
                     safe_pos = jnp.maximum(ent_pos, 0)
                     safe_cols = jnp.maximum(cols, 0)
@@ -426,7 +456,9 @@ class GameTrainingDriver:
         if isinstance(coefficients, FactoredState):
             wg = np.asarray(coefficients.v @ coefficients.matrix)
         else:
-            wg = np.asarray(global_coefficients(ds, jnp.asarray(coefficients)))
+            # distributed solves pad the entity axis; slice back to E
+            coeffs = jnp.asarray(coefficients)[: ds.num_entities]
+            wg = np.asarray(global_coefficients(ds, coeffs))
         pos_of_vocab = self._entity_position_of_vocab(name)
         vocab = self.train_data.id_vocabs[cfg.random_effect_id]
         out: Dict[str, np.ndarray] = {}

@@ -37,3 +37,17 @@ def down_sample_default(batch: GLMBatch, rate: float | Array, key: Array) -> GLM
     keep = u < rate
     new_w = jnp.where(keep, batch.weights / rate, 0.0)
     return GLMBatch(batch.features, batch.labels, batch.offsets, new_w)
+
+
+def maybe_down_sample(batch: GLMBatch, task, rate, seed: int) -> GLMBatch:
+    """Task-dispatching down-sample (GeneralizedLinearOptimizationProblem.
+    downSample hook): binary-classification sampler for logistic tasks,
+    uniform otherwise; no-op when rate is None or >= 1."""
+    if rate is None or rate >= 1.0:
+        return batch
+    from photon_ml_tpu.types import TaskType
+
+    sampler = (
+        down_sample_binary if task == TaskType.LOGISTIC_REGRESSION else down_sample_default
+    )
+    return sampler(batch, rate, jax.random.PRNGKey(seed))

@@ -223,3 +223,65 @@ class DistributedRandomEffectSolver:
 
     def regularization_term(self, coefficients: Array) -> Array:
         return self.coordinate.regularization_term(coefficients)
+
+
+@dataclasses.dataclass
+class DistributedFixedEffectCoordinate:
+    """Coordinate-protocol wrapper: a fixed-effect coordinate whose solve
+    runs row-sharded over the mesh (drop-in for CoordinateDescent).
+
+    The batch is padded to a device multiple (weight-0 rows) and sharded
+    once at construction; update pads the residual vector to match and
+    score slices back to the true row count.
+    """
+
+    inner: object  # algorithm.fixed_effect.FixedEffectCoordinate
+    ctx: MeshContext
+
+    def __post_init__(self):
+        self.solver = DistributedFixedEffectSolver(self.inner.problem, self.ctx)
+        self._true_rows = self.inner.batch.num_rows
+        batch = pad_rows(self.inner.batch, self.ctx.num_devices)
+        self._batch = self.ctx.put_sharded(batch)
+        self._pad = batch.num_rows - self._true_rows
+        # drop the unsharded copy — the FE batch is the biggest object in a
+        # run; keeping both would double the footprint (update/score use
+        # only the sharded copy)
+        self.inner.batch = None
+
+    @property
+    def dim(self) -> int:
+        return self._batch.dim
+
+    def initial_coefficients(self) -> Array:
+        return jnp.zeros((self.dim,), jnp.float32)
+
+    def update(self, residual_offsets: Array, init_coefficients: Array
+               ) -> Tuple[Array, OptResult]:
+        residuals = jnp.concatenate(
+            [residual_offsets, jnp.zeros((self._pad,), residual_offsets.dtype)]
+        ) if self._pad else residual_offsets
+        batch = GLMBatch(
+            self._batch.features,
+            self._batch.labels,
+            self._batch.offsets + residuals,
+            self._batch.weights,
+        )
+        from photon_ml_tpu.data.sampler import maybe_down_sample
+
+        batch = maybe_down_sample(
+            batch,
+            self.inner.problem.task,
+            getattr(self.inner, "down_sampling_rate", None),
+            self.inner.seed,
+        )
+        model, result = self.solver.run(batch, self.inner.norm, init_coefficients)
+        return model.coefficients.means, result
+
+    def score(self, coefficients: Array) -> Array:
+        w_eff = self.inner.norm.effective_coefficients(coefficients)
+        scores = self._batch.features.matvec(w_eff) + self.inner.norm.margin_shift(w_eff)
+        return scores[: self._true_rows]
+
+    def regularization_term(self, coefficients: Array) -> Array:
+        return self.inner.regularization_term(coefficients)

@@ -255,6 +255,47 @@ class TestFeatureIndexingJob:
         assert metrics["AUC"] > 0.75
 
 
+class TestDistributedTraining:
+    def test_distributed_matches_local(self, trained, game_avro_dirs, tmp_path):
+        """--distributed shards FE rows + RE entities over the 8-device CPU
+        mesh; results must match the local run."""
+        local_driver, _, _ = trained
+        train_dir, val_dir, _ = game_avro_dirs
+        driver = game_training_driver.main(
+            [
+                "--train-input-dirs", train_dir,
+                "--validate-input-dirs", val_dir,
+                "--output-dir", str(tmp_path / "out"),
+                "--num-iterations", "2",
+                "--distributed", "true",
+            ]
+            + COMMON_FLAGS
+        )
+        _, result, metrics = driver.results[driver.best_index]
+        _, local_result, local_metrics = local_driver.results[local_driver.best_index]
+        assert metrics["AUC"] == pytest.approx(local_metrics["AUC"], abs=5e-3)
+        assert result.objective_history[-1] == pytest.approx(
+            local_result.objective_history[-1], rel=1e-3
+        )
+        # saved model parity: per-entity coefficients match the local run
+        from photon_ml_tpu.io import model_io
+
+        _, local_out, _ = trained
+        dist_means, _, _, _ = model_io.load_random_effect(
+            str(tmp_path / "out" / "best"), "per-user",
+            driver.shard_index_maps["per_user"],
+        )
+        local_means, _, _, _ = model_io.load_random_effect(
+            os.path.join(local_out, "best"), "per-user",
+            local_driver.shard_index_maps["per_user"],
+        )
+        assert set(dist_means) == set(local_means)
+        for eid in dist_means:
+            np.testing.assert_allclose(
+                dist_means[eid], local_means[eid], rtol=1e-3, atol=1e-3
+            )
+
+
 class TestDateRangeDiscovery:
     def test_training_with_daily_layout(self, game_avro_dirs, tmp_path):
         import shutil
