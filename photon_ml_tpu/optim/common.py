@@ -65,6 +65,10 @@ class OptResult(NamedTuple):
     reason: Array  # () int32 ConvergenceReason code
     value_history: Array  # (max_iter + 1,) — NaN beyond `iterations`
     grad_norm_history: Array  # (max_iter + 1,) — NaN beyond `iterations`
+    # per-iteration coefficient snapshots (max_iter + 1, D) when the solve
+    # was run with track_coefficients (the ModelTracker analogue,
+    # supervised/model/ModelTracker.scala); None otherwise
+    coefficient_history: Optional[Array] = None
 
 
 def summarize_result(res: OptResult) -> str:

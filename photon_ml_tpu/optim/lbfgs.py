@@ -86,6 +86,7 @@ class _State(NamedTuple):
     reason: Array
     value_history: Array
     grad_norm_history: Array
+    w_history: Array  # (max_iter + 1, D) if tracking, else (1, 1) dummy
 
 
 @functools.partial(jax.jit, static_argnames=("value_and_grad_fn", "config"))
@@ -111,8 +112,13 @@ def lbfgs_minimize_(
     config: OptimizerConfig,
     l1_weight: Array | float = 0.0,
     bounds: Optional[Tuple[Array, Array]] = None,
+    track_coefficients: bool = False,
 ) -> OptResult:
-    """Non-jitted body (callable from inside other jitted code / vmap)."""
+    """Non-jitted body (callable from inside other jitted code / vmap).
+
+    ``track_coefficients`` carries per-iteration coefficient snapshots
+    through the while_loop ((max_iter+1, D) extra memory — the ModelTracker
+    analogue for validate-per-iteration)."""
     m = config.num_corrections
     max_iter = config.max_iterations
     tol = config.tolerance
@@ -142,6 +148,10 @@ def lbfgs_minimize_(
     pg0_norm = jnp.linalg.norm(pg0)
 
     hist0 = jnp.full((max_iter + 1,), jnp.nan, dtype)
+    if track_coefficients:
+        w_hist0 = jnp.zeros((max_iter + 1, dim), dtype).at[0].set(w0)
+    else:
+        w_hist0 = jnp.zeros((1, 1), dtype)
     state = _State(
         w=w0,
         f=f0,
@@ -158,6 +168,7 @@ def lbfgs_minimize_(
         ),
         value_history=hist0.at[0].set(F0),
         grad_norm_history=hist0.at[0].set(pg0_norm),
+        w_history=w_hist0,
     )
 
     def orthant_project(w_trial, xi):
@@ -263,6 +274,9 @@ def lbfgs_minimize_(
             reason=reason,
             value_history=s.value_history.at[it].set(F_out),
             grad_norm_history=s.grad_norm_history.at[it].set(pg_norm),
+            w_history=(
+                s.w_history.at[it].set(w_out) if track_coefficients else s.w_history
+            ),
         )
 
     final = lax.while_loop(cond, body, state)
@@ -274,4 +288,5 @@ def lbfgs_minimize_(
         reason=final.reason,
         value_history=final.value_history,
         grad_norm_history=final.grad_norm_history,
+        coefficient_history=final.w_history if track_coefficients else None,
     )

@@ -104,6 +104,7 @@ class _State(NamedTuple):
     reason: Array
     value_history: Array
     grad_norm_history: Array
+    w_history: Array  # (max_iter + 1, D) if tracking, else (1, 1) dummy
 
 
 @functools.partial(jax.jit, static_argnames=("value_and_grad_fn", "hvp_fn", "config"))
@@ -118,9 +119,13 @@ def tron_minimize(
 
 
 def tron_minimize_(
-    value_and_grad_fn, hvp_fn, w0, config: OptimizerConfig, bounds=None
+    value_and_grad_fn, hvp_fn, w0, config: OptimizerConfig, bounds=None,
+    track_coefficients: bool = False,
 ) -> OptResult:
-    """Non-jitted body (callable from inside jit / vmap / shard_map)."""
+    """Non-jitted body (callable from inside jit / vmap / shard_map).
+
+    ``track_coefficients`` carries per-iteration coefficient snapshots
+    ((max_iter+1, D) extra memory — the ModelTracker analogue)."""
     dtype = w0.dtype
     max_iter = config.max_iterations
     tol = config.tolerance
@@ -140,6 +145,10 @@ def tron_minimize_(
     f0, g0 = value_and_grad_fn(w0)
     g0_norm = jnp.linalg.norm(reduced_grad(w0, g0))
     hist0 = jnp.full((max_iter + 1,), jnp.nan, dtype)
+    if track_coefficients:
+        w_hist0 = jnp.zeros((max_iter + 1, w0.shape[0]), dtype).at[0].set(w0)
+    else:
+        w_hist0 = jnp.zeros((1, 1), dtype)
     s0 = _State(
         w=w0,
         f=f0,
@@ -152,6 +161,7 @@ def tron_minimize_(
         ),
         value_history=hist0.at[0].set(f0),
         grad_norm_history=hist0.at[0].set(g0_norm),
+        w_history=w_hist0,
     )
 
     def cond(s: _State):
@@ -244,6 +254,9 @@ def tron_minimize_(
             reason=reason,
             value_history=s.value_history.at[it].set(f_out),
             grad_norm_history=s.grad_norm_history.at[it].set(g_norm),
+            w_history=(
+                s.w_history.at[it].set(w_out) if track_coefficients else s.w_history
+            ),
         )
 
     final = lax.while_loop(cond, body, s0)
@@ -255,4 +268,5 @@ def tron_minimize_(
         reason=final.reason,
         value_history=final.value_history,
         grad_norm_history=final.grad_norm_history,
+        coefficient_history=final.w_history if track_coefficients else None,
     )
