@@ -21,7 +21,7 @@ from photon_ml_tpu.types import (
 )
 
 DEFAULT_MAX_ITERATIONS = 80
-DEFAULT_TOLERANCE = 1e-6
+DEFAULT_TOLERANCE = 1e-6  # Params.scala:74 driver default (optimizer-class default is 1e-7)
 
 
 class InputFormatType:

@@ -37,6 +37,8 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+from photon_ml_tpu.types import real_dtype
+
 Array = jax.Array
 
 
@@ -323,7 +325,7 @@ def build_random_effect_dataset(
                 feats.indices[flat_ptr].astype(np.int64), feats.values[flat_ptr], row_splits
             )
             out_idx = np.tile(np.arange(d_loc, dtype=np.int32), (len(row_sel), 1))
-            return out_idx, dense.astype(np.float32)
+            return out_idx, dense.astype(real_dtype())
 
         return _assemble_random_effect_tensors(
             data, config, ids, feats, n, num_entities_raw, active_mask, active_counts,
@@ -383,7 +385,7 @@ def build_random_effect_dataset(
         k = int(sub_nnz_counts.max()) if len(row_sel) and sub_nnz_counts.size else 1
         k = max(k, 1)
         out_idx = np.full((len(row_sel), k), -1, np.int32)
-        out_val = np.zeros((len(row_sel), k), np.float32)
+        out_val = np.zeros((len(row_sel), k), real_dtype())
         # gather nnz of selected rows
         starts = feats.indptr[row_sel]
         ends = feats.indptr[row_sel + 1]
@@ -449,7 +451,7 @@ def _assemble_random_effect_tensors(
     valid_slot = flat_sel >= 0
     sel_rows = flat_sel[valid_slot].astype(np.int64)
     pidx, pval = project_rows(sel_rows)
-    x = np.zeros((e_padded * m, d_loc), np.float32)
+    x = np.zeros((e_padded * m, d_loc), real_dtype())
     rr = np.repeat(np.arange(len(sel_rows)), pidx.shape[1])
     cc = pidx.reshape(-1)
     vv = pval.reshape(-1)
@@ -459,7 +461,7 @@ def _assemble_random_effect_tensors(
     x = x.reshape(e_padded, m, d_loc)
 
     def scatter_col(src, fill=0.0):
-        out = np.full((e_padded, m), fill, np.float32)
+        out = np.full((e_padded, m), fill, real_dtype())
         out.reshape(-1)[valid_slot] = src[sel_rows]
         return out
 
@@ -467,7 +469,7 @@ def _assemble_random_effect_tensors(
     offsets_t = scatter_col(data.offset)
     weights_t = scatter_col(data.weight)
     # re-scale active weights where the entity was capped
-    weights_t.reshape(-1)[valid_slot] *= scale[ids[sel_rows]].astype(np.float32)
+    weights_t.reshape(-1)[valid_slot] *= scale[ids[sel_rows]].astype(real_dtype())
 
     # ---- scoring tensors (all rows) ---------------------------------------
     entity_pos_all = tensor_pos[ids].astype(np.int32)

@@ -15,6 +15,8 @@ from typing import Dict, Iterable, List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from photon_ml_tpu.types import real_dtype
+
 from photon_ml_tpu.data.game import GameData, HostFeatures
 from photon_ml_tpu.io import avro as avro_io
 from photon_ml_tpu.io import schemas
@@ -73,13 +75,13 @@ def read_training_examples(
             values.append(1.0)
         indptr.append(len(indices))
     return HostDataset(
-        labels=np.asarray(labels, np.float32),
+        labels=np.asarray(labels, real_dtype()),
         indptr=np.asarray(indptr, np.int64),
         indices=np.asarray(indices, np.int32),
-        values=np.asarray(values, np.float32),
+        values=np.asarray(values, real_dtype()),
         dim=len(index_map),
-        offsets=np.asarray(offsets, np.float32),
-        weights=np.asarray(weights, np.float32),
+        offsets=np.asarray(offsets, real_dtype()),
+        weights=np.asarray(weights, real_dtype()),
     )
 
 
@@ -177,15 +179,15 @@ def read_game_data(
         s: HostFeatures(
             np.asarray(ptr, np.int64),
             np.asarray(idx, np.int32),
-            np.asarray(val, np.float32),
+            np.asarray(val, real_dtype()),
             len(shard_index_maps[s]),
         )
         for s, (ptr, idx, val) in per_shard.items()
     }
     return GameData(
-        response=np.asarray(labels, np.float32),
-        offset=np.asarray(offsets, np.float32),
-        weight=np.asarray(weights, np.float32),
+        response=np.asarray(labels, real_dtype()),
+        offset=np.asarray(offsets, real_dtype()),
+        weight=np.asarray(weights, real_dtype()),
         ids=ids,
         id_vocabs=vocabs,
         shards=shards,

@@ -18,6 +18,7 @@ import numpy as np
 import jax.numpy as jnp
 
 from photon_ml_tpu.ops.features import DenseFeatures, SparseFeatures
+from photon_ml_tpu.types import real_dtype
 from photon_ml_tpu.ops.objective import GLMBatch
 
 
@@ -64,19 +65,19 @@ def read_libsvm(path: str, dim: Optional[int] = None, add_intercept: bool = True
                 values.append(float(v_s))
                 max_idx = max(max_idx, i)
             indptr.append(len(indices))
-    y = np.asarray(labels, np.float32)
+    y = np.asarray(labels, real_dtype())
     uniq = np.unique(y)
     if set(uniq.tolist()) <= {-1.0, 1.0}:
-        y = (y > 0).astype(np.float32)
+        y = (y > 0).astype(real_dtype())
     d = dim if dim is not None else max_idx + 1
     ind = np.asarray(indices, np.int32)
-    val = np.asarray(values, np.float32)
+    val = np.asarray(values, real_dtype())
     ptr = np.asarray(indptr, np.int64)
     if add_intercept:
         # append intercept column (index d) to every row — vectorized insert
         n = len(y)
         ind = np.insert(ind, ptr[1:], np.full(n, d, np.int32))
-        val = np.insert(val, ptr[1:], np.ones(n, np.float32))
+        val = np.insert(val, ptr[1:], np.ones(n, real_dtype()))
         ptr = ptr + np.arange(n + 1, dtype=np.int64)
         d += 1
     return HostDataset(y, ptr, ind, val, d)
@@ -93,14 +94,14 @@ def to_batch(ds: HostDataset, dense: bool = False, pad_rows_to: int = 8) -> GLMB
     """
     n, d = ds.num_rows, ds.dim
     n_pad = _round_up(max(n, 1), pad_rows_to)
-    weights = ds.weights if ds.weights is not None else np.ones(n, np.float32)
-    offsets = ds.offsets if ds.offsets is not None else np.zeros(n, np.float32)
+    weights = ds.weights if ds.weights is not None else np.ones(n, real_dtype())
+    offsets = ds.offsets if ds.offsets is not None else np.zeros(n, real_dtype())
 
-    labels = np.zeros(n_pad, np.float32)
+    labels = np.zeros(n_pad, real_dtype())
     labels[:n] = ds.labels
-    w = np.zeros(n_pad, np.float32)
+    w = np.zeros(n_pad, real_dtype())
     w[:n] = weights
-    off = np.zeros(n_pad, np.float32)
+    off = np.zeros(n_pad, real_dtype())
     off[:n] = offsets
 
     # vectorized CSR -> (row, slot) scatter coordinates
@@ -108,13 +109,13 @@ def to_batch(ds: HostDataset, dense: bool = False, pad_rows_to: int = 8) -> GLMB
     rows = np.repeat(np.arange(n, dtype=np.int64), row_nnz)
     slots = np.arange(len(ds.indices), dtype=np.int64) - np.repeat(ds.indptr[:-1], row_nnz)
     if dense:
-        x = np.zeros((n_pad, d), np.float32)
+        x = np.zeros((n_pad, d), real_dtype())
         x[rows, ds.indices] = ds.values
         feats = DenseFeatures(jnp.asarray(x))
     else:
         k = int(row_nnz.max()) if n else 1
         idx = np.zeros((n_pad, k), np.int32)
-        val = np.zeros((n_pad, k), np.float32)
+        val = np.zeros((n_pad, k), real_dtype())
         idx[rows, slots] = ds.indices
         val[rows, slots] = ds.values
         feats = SparseFeatures(jnp.asarray(idx), jnp.asarray(val), d)

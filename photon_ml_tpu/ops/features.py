@@ -40,6 +40,17 @@ import jax.numpy as jnp
 Array = jax.Array
 
 
+def _acc_dtype(storage_dtype) -> jnp.dtype:
+    """Accumulation dtype for contractions over a given storage dtype.
+
+    f32 accumulation on the MXU for f32/bf16 storage (the TPU path);
+    f64 when the framework runs in reference-precision float64 mode
+    (PHOTON_ML_TPU_DTYPE=float64 on CPU) so the matvec does not silently
+    round the trajectory back to f32.
+    """
+    return jnp.float64 if storage_dtype == jnp.float64 else jnp.float32
+
+
 @jax.tree_util.register_pytree_node_class
 @dataclasses.dataclass
 class DenseFeatures:
@@ -62,26 +73,30 @@ class DenseFeatures:
         return self.matrix.shape[1]
 
     def matvec(self, w: Array) -> Array:
+        acc = _acc_dtype(self.matrix.dtype)
         return jnp.dot(
             self.matrix, w.astype(self.matrix.dtype),
-            preferred_element_type=jnp.float32,
+            preferred_element_type=acc,
         )
 
     def rmatvec(self, d: Array) -> Array:
+        acc = _acc_dtype(self.matrix.dtype)
         return jnp.dot(
             d.astype(self.matrix.dtype), self.matrix,
-            preferred_element_type=jnp.float32,
+            preferred_element_type=acc,
         )
 
     def sq_rmatvec(self, d: Array) -> Array:
-        sq = jnp.square(self.matrix.astype(jnp.float32))
-        return jnp.dot(d, sq, preferred_element_type=jnp.float32)
+        acc = _acc_dtype(self.matrix.dtype)
+        sq = jnp.square(self.matrix.astype(acc))
+        return jnp.dot(d, sq, preferred_element_type=acc)
 
     def row_sq_norms(self) -> Array:
-        return jnp.sum(jnp.square(self.matrix.astype(jnp.float32)), axis=-1)
+        acc = _acc_dtype(self.matrix.dtype)
+        return jnp.sum(jnp.square(self.matrix.astype(acc)), axis=-1)
 
     def to_dense(self) -> Array:
-        return self.matrix.astype(jnp.float32)
+        return self.matrix.astype(_acc_dtype(self.matrix.dtype))
 
     def astype(self, dtype) -> "DenseFeatures":
         """Re-store the matrix in another dtype (bf16 for bandwidth)."""
@@ -117,30 +132,35 @@ class SparseFeatures:
         return self.indices.shape[0]
 
     def matvec(self, w: Array) -> Array:
-        prods = w[self.indices].astype(jnp.float32) * self.values.astype(jnp.float32)
+        acc = _acc_dtype(self.values.dtype)
+        prods = w[self.indices].astype(acc) * self.values.astype(acc)
         return jnp.sum(prods, axis=-1)
 
     def rmatvec(self, d: Array) -> Array:
-        contrib = self.values.astype(jnp.float32) * d.astype(jnp.float32)[:, None]
-        return jnp.zeros((self.dim,), jnp.float32).at[self.indices.reshape(-1)].add(
+        acc = _acc_dtype(self.values.dtype)
+        contrib = self.values.astype(acc) * d.astype(acc)[:, None]
+        return jnp.zeros((self.dim,), acc).at[self.indices.reshape(-1)].add(
             contrib.reshape(-1)
         )
 
     def sq_rmatvec(self, d: Array) -> Array:
-        contrib = jnp.square(self.values.astype(jnp.float32)) * d.astype(jnp.float32)[:, None]
-        return jnp.zeros((self.dim,), jnp.float32).at[self.indices.reshape(-1)].add(
+        acc = _acc_dtype(self.values.dtype)
+        contrib = jnp.square(self.values.astype(acc)) * d.astype(acc)[:, None]
+        return jnp.zeros((self.dim,), acc).at[self.indices.reshape(-1)].add(
             contrib.reshape(-1)
         )
 
     def row_sq_norms(self) -> Array:
-        return jnp.sum(jnp.square(self.values.astype(jnp.float32)), axis=-1)
+        acc = _acc_dtype(self.values.dtype)
+        return jnp.sum(jnp.square(self.values.astype(acc)), axis=-1)
 
     def to_dense(self) -> Array:
+        acc = _acc_dtype(self.values.dtype)
         n, k = self.indices.shape
-        out = jnp.zeros((n, self.dim), jnp.float32)
+        out = jnp.zeros((n, self.dim), acc)
         rows = jnp.broadcast_to(jnp.arange(n)[:, None], (n, k))
         return out.at[rows.reshape(-1), self.indices.reshape(-1)].add(
-            self.values.reshape(-1).astype(jnp.float32)
+            self.values.reshape(-1).astype(acc)
         )
 
     def astype(self, dtype) -> "SparseFeatures":

@@ -22,6 +22,7 @@ from typing import Optional, Tuple
 import jax
 import jax.numpy as jnp
 
+from photon_ml_tpu.types import real_dtype
 from photon_ml_tpu.models.glm import Coefficients, GeneralizedLinearModel
 from photon_ml_tpu.ops import losses as losses_mod
 from photon_ml_tpu.ops.normalization import NormalizationContext
@@ -115,7 +116,7 @@ class GLMOptimizationProblem:
         w0 = (
             init_coefficients
             if init_coefficients is not None
-            else jnp.zeros((batch.dim,), jnp.float32)
+            else jnp.zeros((batch.dim,), real_dtype())
         )
         vg = lambda w: obj.value_and_grad(w, batch, norm, l2)
         bounds = (

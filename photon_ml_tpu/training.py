@@ -19,6 +19,7 @@ from typing import Dict, List, Optional, Sequence, Tuple
 import jax
 import jax.numpy as jnp
 
+from photon_ml_tpu.types import real_dtype
 from photon_ml_tpu.models.glm import GeneralizedLinearModel
 from photon_ml_tpu.ops.normalization import NormalizationContext
 from photon_ml_tpu.ops.objective import GLMBatch
@@ -78,11 +79,11 @@ def train_glm_grid(
         max_lambda = max(warm_start_models.keys())
         w = warm_start_models[max_lambda].coefficients.means
     else:
-        w = jnp.zeros((batch.dim,), jnp.float32)
+        w = jnp.zeros((batch.dim,), real_dtype())
 
     weights, models, results = [], [], []
     for lam in sorted_weights:
-        model, res = solve(w, jnp.float32(lam))
+        model, res = solve(w, jnp.asarray(lam, real_dtype()))
         w = model.coefficients.means
         weights.append(lam)
         models.append(model)
