@@ -68,3 +68,19 @@ class ProjectorType(enum.Enum):
     RANDOM = "RANDOM"
     INDEX_MAP = "INDEX_MAP"
     IDENTITY = "IDENTITY"
+
+
+def real_dtype():
+    """Framework-wide real dtype for features/labels/coefficients.
+
+    float32 (the TPU-native width) by default. Set PHOTON_ML_TPU_DTYPE=float64
+    (with jax_enable_x64) for reference-precision CPU runs — the reference is
+    JVM doubles throughout, and exact tolerance-for-tolerance optimizer parity
+    (AbstractOptimizer.scala:54-55 check at tol 1e-7) needs f64 arithmetic.
+    """
+    import os
+
+    import numpy as np
+
+    name = os.environ.get("PHOTON_ML_TPU_DTYPE", "float32")
+    return np.dtype(name)
