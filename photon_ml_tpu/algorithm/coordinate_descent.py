@@ -54,6 +54,7 @@ class CoordinateDescent:
         training_loss: Callable[[Array], Array],
         validation_scorer: Optional[Callable[[Dict[str, Array]], Array]] = None,
         validation_evaluators: Optional[Dict[str, Tuple[Evaluator, dict]]] = None,
+        collect_timings: bool = False,
     ):
         """``training_loss(total_scores) -> scalar`` is the loss-evaluator
         analogue used for the objective value (the training counterpart of
@@ -62,11 +63,18 @@ class CoordinateDescent:
         ``validation_scorer(coefficients) -> (Nv,)`` maps current params to
         validation scores; each validation evaluator is (Evaluator, kwargs
         for evaluate, e.g. labels/weights arrays).
+
+        ``collect_timings=True`` blocks on every coordinate's result so the
+        per-coordinate ``timings`` are real solve seconds; the default keeps
+        the whole descent async — objective/validation values stay on device
+        until the end of the run, so dispatch is never serialized on a host
+        round-trip per update (important over a remote device tunnel).
         """
         self.coordinates = coordinates
         self.training_loss = training_loss
         self.validation_scorer = validation_scorer
         self.validation_evaluators = validation_evaluators or {}
+        self.collect_timings = collect_timings
         # jit the per-coordinate update+score once per coordinate
         self._update_fns = {
             name: jax.jit(lambda off, w0, c=coord: c.update(off, w0))
@@ -89,6 +97,12 @@ class CoordinateDescent:
         names = list(self.coordinates)
         params = {n: self.coordinates[n].initial_coefficients() for n in names}
         scores = {n: jnp.zeros((num_rows,), jnp.float32) for n in names}
+        # device scalars until the end of the run — converting per update
+        # would serialize every dispatch on a host round-trip (weak over a
+        # remote device tunnel); the reference pays the same sync as a Spark
+        # reduce per update, we don't have to
+        objective_dev: List[Array] = []
+        validation_dev: List[Dict[str, Array]] = []
         objective_history: List[float] = []
         validation_history: List[Dict[str, float]] = []
         timings = {n: 0.0 for n in names}
@@ -105,36 +119,49 @@ class CoordinateDescent:
                 objective_history = restored.objective_history
                 validation_history = restored.validation_history
 
+        def _drain():
+            """Pull accumulated device scalars to host (one batched transfer)."""
+            if objective_dev:
+                objective_history.extend(float(v) for v in jax.device_get(objective_dev))
+                objective_dev.clear()
+            if validation_dev:
+                host = jax.device_get(validation_dev)
+                validation_history.extend(
+                    {k: float(v) for k, v in m.items()} for m in host
+                )
+                validation_dev.clear()
+
         step = 0
         for it in range(num_iterations):
             for name in names:
                 step += 1
                 if step <= start_step:
                     continue  # already completed before the restart
-                coord = self.coordinates[name]
                 partial = total - scores[name]  # sum of the OTHER coordinates
                 t0 = time.perf_counter()
                 params[name], _ = self._update_fns[name](partial, params[name])
                 new_score = self._score_fns[name](params[name])
-                new_score.block_until_ready()
+                if self.collect_timings:
+                    new_score.block_until_ready()
                 timings[name] += time.perf_counter() - t0
                 total = partial + new_score
                 scores[name] = new_score
 
                 # objective = loss(total scores) + sum of reg terms
-                # (CoordinateDescent.scala:172-178)
-                obj = float(self.training_loss(total)) + sum(
-                    float(self.coordinates[n].regularization_term(params[n])) for n in names
+                # (CoordinateDescent.scala:172-178) — stays on device
+                obj = self.training_loss(total) + sum(
+                    self.coordinates[n].regularization_term(params[n]) for n in names
                 )
-                objective_history.append(obj)
+                objective_dev.append(obj)
 
                 if self.validation_scorer is not None:
                     v_scores = self.validation_scorer(params)
-                    metrics = {
-                        key: float(ev.evaluate(v_scores, **kw))
-                        for key, (ev, kw) in self.validation_evaluators.items()
-                    }
-                    validation_history.append(metrics)
+                    validation_dev.append(
+                        {
+                            key: ev.evaluate(v_scores, **kw)
+                            for key, (ev, kw) in self.validation_evaluators.items()
+                        }
+                    )
 
                 is_last = it == num_iterations - 1 and name == names[-1]
                 if checkpointer is not None and (
@@ -142,6 +169,7 @@ class CoordinateDescent:
                 ):
                     from photon_ml_tpu.checkpoint import CheckpointState
 
+                    _drain()
                     checkpointer.save(
                         CheckpointState(
                             step=step,
@@ -153,6 +181,7 @@ class CoordinateDescent:
                         )
                     )
 
+        _drain()
         return CoordinateDescentResult(
             coefficients=params,
             total_scores=total,

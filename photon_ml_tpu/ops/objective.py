@@ -92,12 +92,20 @@ class GLMObjective:
     jit with sharded-array inputs, leave it None and XLA inserts the
     collectives itself.
 
+    ``fused_block_rows``: when set (by the runtime autotune,
+    ``ops.fused_glm.select_fused_block_rows``) and the batch is dense,
+    ``value_and_grad`` runs the single-pass Pallas kernel — one HBM stream
+    of X instead of the two-pass XLA pipeline — with the normalization and
+    regularization algebra folded around it here, identically to the XLA
+    path.
+
     All methods take ``l2_weight`` as a (traceable) scalar so a lambda-grid
     sweep does not retrigger compilation.
     """
 
     loss: PointwiseLoss
     axis_name: Optional[str] = None
+    fused_block_rows: Optional[int] = None
 
     # -- margins ------------------------------------------------------------
     def margins(self, w: Array, batch: GLMBatch, norm: NormalizationContext) -> Array:
@@ -114,18 +122,39 @@ class GLMObjective:
     # -- value + gradient (one fused pass) ----------------------------------
     def value_and_grad(self, w, batch, norm, l2_weight=0.0) -> Tuple[Array, Array]:
         w_eff = norm.effective_coefficients(w)
-        z = batch.features.matvec(w_eff) + norm.margin_shift(w_eff) + batch.offsets
-        lv = jnp.sum(_wmul(batch.weights, self.loss.loss(z, batch.labels)))
-        d = _wmul(batch.weights, self.loss.d1(z, batch.labels))  # (N,)
-        grad_eff = batch.features.rmatvec(d)
-        if norm.shifts is not None:
-            grad_eff = grad_eff - norm.shifts * jnp.sum(d)
+        if self._use_fused(batch):
+            from photon_ml_tpu.ops import fused_glm
+
+            offsets = batch.offsets + norm.margin_shift(w_eff)
+            lv, grad_eff, sum_d = fused_glm.fused_value_grad_parts(
+                self.loss, batch.features.matrix, batch.labels, batch.weights,
+                offsets, w_eff, block_rows=self.fused_block_rows,
+            )
+            if norm.shifts is not None:
+                grad_eff = grad_eff - norm.shifts * sum_d
+        else:
+            z = batch.features.matvec(w_eff) + norm.margin_shift(w_eff) + batch.offsets
+            lv = jnp.sum(_wmul(batch.weights, self.loss.loss(z, batch.labels)))
+            d = _wmul(batch.weights, self.loss.d1(z, batch.labels))  # (N,)
+            grad_eff = batch.features.rmatvec(d)
+            if norm.shifts is not None:
+                grad_eff = grad_eff - norm.shifts * jnp.sum(d)
         lv = _maybe_psum(lv, self.axis_name)
         grad_eff = _maybe_psum(grad_eff, self.axis_name)
         grad = grad_eff * norm.factors if norm.factors is not None else grad_eff
         value = lv + 0.5 * l2_weight * jnp.sum(jnp.square(w))
         grad = grad + l2_weight * w
         return value, grad
+
+    def _use_fused(self, batch: GLMBatch) -> bool:
+        """Static (trace-time) dispatch to the single-pass Pallas kernel."""
+        from photon_ml_tpu.ops.features import DenseFeatures
+
+        return (
+            self.fused_block_rows is not None
+            and isinstance(batch.features, DenseFeatures)
+            and batch.features.matrix.dtype != jnp.float64
+        )
 
     def grad(self, w, batch, norm, l2_weight=0.0) -> Array:
         return self.value_and_grad(w, batch, norm, l2_weight)[1]

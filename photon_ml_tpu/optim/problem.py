@@ -68,6 +68,9 @@ class GLMOptimizationProblem:
     # box constraints on coefficients (OptimizationUtils.projectCoefficientsToHypercube);
     # densified (lower, upper) arrays — see optim/constraints.py
     constraints: Optional["BoxConstraints"] = None
+    # single-pass Pallas value+grad kernel block size, set by the runtime
+    # autotune (ops.fused_glm.select_fused_block_rows); None = XLA two-pass
+    fused_block_rows: Optional[int] = None
 
     def __post_init__(self):
         if self.optimizer_config is None:
@@ -95,7 +98,9 @@ class GLMOptimizationProblem:
 
     @property
     def objective(self) -> GLMObjective:
-        return GLMObjective(losses_mod.for_task(self.task), self.axis_name)
+        return GLMObjective(
+            losses_mod.for_task(self.task), self.axis_name, self.fused_block_rows
+        )
 
     # ------------------------------------------------------------------
     def run(

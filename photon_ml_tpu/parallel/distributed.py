@@ -50,6 +50,30 @@ class DistributedFixedEffectSolver:
         if self.problem.axis_name != self.ctx.axis:
             self.problem = dataclasses.replace(self.problem, axis_name=self.ctx.axis)
         self._jitted = None
+        self._fused_tuned = False
+
+    def _maybe_autotune_fused(self, batch: GLMBatch) -> None:
+        """Race the single-pass Pallas kernel vs. XLA on the per-device shard
+        shape and adopt it if it wins (no-op off TPU / for sparse layouts)."""
+        if self._fused_tuned:
+            return
+        self._fused_tuned = True
+        from photon_ml_tpu.ops import losses as losses_mod
+        from photon_ml_tpu.ops.features import DenseFeatures
+        from photon_ml_tpu.ops.fused_glm import select_fused_block_rows
+
+        if self.problem.fused_block_rows is not None or not isinstance(
+            batch.features, DenseFeatures
+        ):
+            return
+        block = select_fused_block_rows(
+            losses_mod.for_task(self.problem.task),
+            batch.num_rows // self.ctx.num_devices,
+            batch.dim,
+            batch.features.matrix.dtype,
+        )
+        if block is not None:
+            self.problem = dataclasses.replace(self.problem, fused_block_rows=block)
 
     def _build(self, norm: NormalizationContext):
         problem = self.problem
@@ -79,6 +103,7 @@ class DistributedFixedEffectSolver:
         """
         n_dev = self.ctx.num_devices
         batch = pad_rows(batch, n_dev)
+        self._maybe_autotune_fused(batch)
         batch = self.ctx.put_sharded(batch)
         if init_coefficients is None:
             init_coefficients = jnp.zeros((batch.dim,), jnp.float32)

@@ -64,6 +64,22 @@ def train_glm_grid(
     """
     sorted_weights = sorted(reg_weights, reverse=True)
 
+    from photon_ml_tpu.ops import losses as losses_mod
+    from photon_ml_tpu.ops.features import DenseFeatures
+    from photon_ml_tpu.ops.fused_glm import select_fused_block_rows
+
+    if problem.fused_block_rows is None and isinstance(batch.features, DenseFeatures):
+        # adopt the single-pass Pallas kernel where the live-device autotune
+        # says it beats XLA (returns None off TPU / when XLA wins)
+        block = select_fused_block_rows(
+            losses_mod.for_task(problem.task),
+            batch.num_rows,
+            batch.dim,
+            batch.features.matrix.dtype,
+        )
+        if block is not None:
+            problem = dataclasses.replace(problem, fused_block_rows=block)
+
     try:
         # module-level jit: repeat calls with the same problem + shapes (e.g.
         # the fitting diagnostic's 9 prefix solves, which differ only by a
