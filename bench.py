@@ -140,19 +140,28 @@ def _numpy_baseline(x, y, w, iters=3):
     return x.shape[0] / dt, float(val), g
 
 
-def _scan_throughput(value_and_grad, w0, n_rows, iters=SCAN_ITERS):
-    """examples/sec with iterations serialized on-chip via lax.scan."""
+def _scan_throughput(value_and_grad, w0, n_rows, batch, iters=SCAN_ITERS):
+    """examples/sec with iterations serialized on-chip via lax.scan.
+
+    ``batch`` MUST flow in as a jit argument, never a closure capture: a
+    captured array is inlined into the HLO as a literal constant, and over
+    the remote-compile tunnel a 256 MB feature matrix in the request body
+    gets rejected with HTTP 413 (observed r3) — args stay device-side.
+    """
     import jax
     from jax import lax
 
-    def step(w, _):
-        v, g = value_and_grad(w)
-        return w - STEP * g, v
+    def run(w, b):
+        def step(w, _):
+            v, g = value_and_grad(w, b)
+            return w - STEP * g, v
 
-    scan = jax.jit(lambda w: lax.scan(step, w, None, length=iters))
-    jax.block_until_ready(scan(w0))  # compile + warm
+        return lax.scan(step, w, None, length=iters)
+
+    scan = jax.jit(run)
+    jax.block_until_ready(scan(w0, batch))  # compile + warm
     t0 = time.perf_counter()
-    jax.block_until_ready(scan(w0))
+    jax.block_until_ready(scan(w0, batch))
     dt = (time.perf_counter() - t0) / iters
     return n_rows / dt
 
@@ -195,9 +204,10 @@ def _bench_dense(extra, x_h, y_h):
     obj = GLMObjective(losses.logistic, fused_block_rows=block)
     batch = GLMBatch.create(feats_bf16, labels)
 
-    # fused-path parity gate before trusting its throughput
+    # fused-path parity gate before trusting its throughput (batch as a jit
+    # ARG — a closure capture would inline 256 MB into the HLO, HTTP 413)
     if block is not None:
-        vF, gF = jax.jit(lambda w: obj.value_and_grad(w, batch, norm, 0.1))(w_probe)
+        vF, gF = jax.jit(lambda w, b: obj.value_and_grad(w, b, norm, 0.1))(w_probe, batch)
         rel_vf = abs(float(vF) - float(v32)) / max(abs(float(v32)), 1e-12)
         rel_gf = float(jnp.linalg.norm(gF - g32) / jnp.maximum(jnp.linalg.norm(g32), 1e-12))
         _log(f"fused parity (block={block}): value rel {rel_vf:.2e}, grad rel {rel_gf:.2e}")
@@ -207,9 +217,10 @@ def _bench_dense(extra, x_h, y_h):
             obj = obj_plain
 
     eps = _scan_throughput(
-        lambda w: obj.value_and_grad(w, batch, norm, 0.1),
+        lambda w, b: obj.value_and_grad(w, b, norm, 0.1),
         jnp.zeros((d,), jnp.float32),
         n,
+        batch,
     )
     _log(f"dense: {eps:.3e} ex/s (path={'fused' if extra['fused_block_rows'] else 'xla'})")
     return eps
@@ -237,9 +248,10 @@ def _bench_sparse(extra, on_tpu):
     norm = NormalizationContext.identity()
 
     eps = _scan_throughput(
-        lambda w: obj.value_and_grad(w, batch, norm, 0.1),
+        lambda w, b: obj.value_and_grad(w, b, norm, 0.1),
         jnp.zeros((D_SPARSE,), jnp.float32),
         n_sparse,
+        batch,
         iters=10,
     )
     _log(f"sparse-wide (D={D_SPARSE}, nnz/row={K_SPARSE}): {eps:.3e} ex/s")

@@ -22,6 +22,7 @@ import jax
 import jax.numpy as jnp
 
 from photon_ml_tpu.evaluation.evaluators import Evaluator
+from photon_ml_tpu.types import real_dtype
 
 if TYPE_CHECKING:  # pragma: no cover
     from photon_ml_tpu.checkpoint import CoordinateDescentCheckpointer
@@ -96,7 +97,7 @@ class CoordinateDescent:
         the reference has no mid-run checkpointing)."""
         names = list(self.coordinates)
         params = {n: self.coordinates[n].initial_coefficients() for n in names}
-        scores = {n: jnp.zeros((num_rows,), jnp.float32) for n in names}
+        scores = {n: jnp.zeros((num_rows,), real_dtype()) for n in names}
         # device scalars until the end of the run — converting per update
         # would serialize every dispatch on a host round-trip (weak over a
         # remote device tunnel); the reference pays the same sync as a Spark
@@ -106,7 +107,7 @@ class CoordinateDescent:
         objective_history: List[float] = []
         validation_history: List[Dict[str, float]] = []
         timings = {n: 0.0 for n in names}
-        total = jnp.zeros((num_rows,), jnp.float32)
+        total = jnp.zeros((num_rows,), real_dtype())
 
         start_step = 0
         if checkpointer is not None:

@@ -41,7 +41,7 @@ from photon_ml_tpu.optim.lbfgs import lbfgs_minimize_
 from photon_ml_tpu.optim.tron import tron_minimize_
 from photon_ml_tpu.ops.regularization import RegularizationContext
 from photon_ml_tpu.projectors import gaussian_random_projection_matrix
-from photon_ml_tpu.types import OptimizerType, TaskType
+from photon_ml_tpu.types import OptimizerType, TaskType, real_dtype
 
 Array = jax.Array
 
@@ -102,6 +102,10 @@ class FactoredRandomEffectCoordinate:
         default_factory=RegularizationContext.none
     )
     seed: int = 1234567890
+    # set under shard_map (entity-sharded dataset): the latent fit's
+    # value/grad/Hv become psum reductions over the mesh axis so every
+    # device runs the identical replicated-M optimizer trajectory
+    axis_name: Optional[str] = None
 
     def __post_init__(self):
         ds = self.dataset
@@ -140,7 +144,7 @@ class FactoredRandomEffectCoordinate:
         m0 = gaussian_random_projection_matrix(
             self.latent_dim, ds.local_dim, keep_intercept=False, seed=self.seed
         )
-        v0 = jnp.zeros((ds.num_entities, self.latent_dim), jnp.float32)
+        v0 = jnp.zeros((ds.num_entities, self.latent_dim), real_dtype())
         return FactoredState(v=v0, matrix=jnp.asarray(m0))
 
     # ------------------------------------------------------------------
@@ -183,21 +187,32 @@ class FactoredRandomEffectCoordinate:
 
             return jax.vmap(solve_one)(xp, ds.labels, off, ds.weights, v0)
 
-        def latent_value_and_grad(m_flat, v):
-            def value(mf):
-                mat = mf.reshape(self.latent_dim, d)
-                # margin_n = <M, v_{e(n)} x_n^T> = sum_k (x_n M^T)_k * v_k
-                v_rows = jnp.repeat(v, m_cap, axis=0)  # (E*M, k)
-                margins = jnp.sum((x_rows @ mat.T) * v_rows, axis=-1) + off_rows
-                per = loss.loss(margins, y_rows) * w_rows
-                f = jnp.sum(per) + 0.5 * lat_l2 * jnp.sum(jnp.square(mf))
-                return f
+        def _latent_data_value(mf, v):
+            mat = mf.reshape(self.latent_dim, d)
+            # margin_n = <M, v_{e(n)} x_n^T> = sum_k (x_n M^T)_k * v_k
+            v_rows = jnp.repeat(v, m_cap, axis=0)  # (E*M, k)
+            margins = jnp.sum((x_rows @ mat.T) * v_rows, axis=-1) + off_rows
+            per = loss.loss(margins, y_rows) * w_rows
+            return jnp.sum(per)
 
-            return jax.value_and_grad(value)(m_flat)
+        def latent_value_and_grad(m_flat, v):
+            # data term locally, psum across entity shards (axis_name set),
+            # THEN the reg term once on the replicated M — the exact psum
+            # placement GLMObjective uses (ops/objective.py:119-143)
+            f, g = jax.value_and_grad(_latent_data_value)(m_flat, v)
+            if self.axis_name is not None:
+                f = jax.lax.psum(f, self.axis_name)
+                g = jax.lax.psum(g, self.axis_name)
+            f = f + 0.5 * lat_l2 * jnp.sum(jnp.square(m_flat))
+            g = g + lat_l2 * m_flat
+            return f, g
 
         def latent_hvp(m_flat, tangent, v):
-            g = lambda mf: latent_value_and_grad(mf, v)[1]
-            return jax.jvp(g, (m_flat,), (tangent,))[1]
+            g_data = lambda mf: jax.value_and_grad(_latent_data_value)(mf, v)[1]
+            hv = jax.jvp(g_data, (m_flat,), (tangent,))[1]
+            if self.axis_name is not None:
+                hv = jax.lax.psum(hv, self.axis_name)
+            return hv + lat_l2 * tangent
 
         v, mat = state.v, state.matrix
         results = None

@@ -21,6 +21,7 @@ from photon_ml_tpu.ops.normalization import NormalizationContext
 from photon_ml_tpu.ops.objective import GLMBatch
 from photon_ml_tpu.optim.common import OptResult
 from photon_ml_tpu.optim.problem import GLMOptimizationProblem
+from photon_ml_tpu.types import real_dtype
 
 Array = jax.Array
 
@@ -40,7 +41,7 @@ class FixedEffectCoordinate:
         return self.batch.dim
 
     def initial_coefficients(self) -> Array:
-        return jnp.zeros((self.dim,), jnp.float32)
+        return jnp.zeros((self.dim,), real_dtype())
 
     def update(self, residual_offsets: Array, init_coefficients: Array
                ) -> Tuple[Array, OptResult]:

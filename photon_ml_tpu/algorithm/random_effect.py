@@ -34,7 +34,7 @@ from photon_ml_tpu.optim.common import OptimizerConfig, OptResult
 from photon_ml_tpu.optim.lbfgs import lbfgs_minimize_
 from photon_ml_tpu.optim.tron import tron_minimize_
 from photon_ml_tpu.ops.regularization import RegularizationContext
-from photon_ml_tpu.types import OptimizerType, TaskType
+from photon_ml_tpu.types import OptimizerType, TaskType, real_dtype
 
 Array = jax.Array
 
@@ -68,7 +68,7 @@ class RandomEffectCoordinate:
         return self.dataset.local_dim
 
     def initial_coefficients(self) -> Array:
-        return jnp.zeros((self.num_entities, self.local_dim), jnp.float32)
+        return jnp.zeros((self.num_entities, self.local_dim), real_dtype())
 
     # ------------------------------------------------------------------
     def update(self, residual_offsets: Array, init_coefficients: Array

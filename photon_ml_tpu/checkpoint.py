@@ -41,6 +41,18 @@ def fingerprint(parts: Dict[str, Any]) -> str:
     return hashlib.sha256(blob).hexdigest()[:16]
 
 
+def _leaf_to_host(leaf) -> np.ndarray:
+    """Device leaf -> host ndarray. A multi-host-sharded array is not fully
+    addressable from one process; every process participates in an
+    all-gather (a COLLECTIVE — all hosts must flatten together) so the
+    coordinator can write the complete state."""
+    if isinstance(leaf, jax.Array) and not leaf.is_fully_addressable:
+        from jax.experimental import multihost_utils
+
+        return np.asarray(multihost_utils.process_allgather(leaf, tiled=True))
+    return np.asarray(leaf)
+
+
 def _flatten_state(state: Dict[str, Any]) -> Tuple[Dict[str, np.ndarray], Dict[str, Any]]:
     """Pytree state dict -> (flat arrays, structure description)."""
     arrays: Dict[str, np.ndarray] = {}
@@ -52,7 +64,7 @@ def _flatten_state(state: Dict[str, Any]) -> Tuple[Dict[str, np.ndarray], Dict[s
             "treedef": str(treedef),  # compared against the template on restore
         }
         for i, leaf in enumerate(leaves):
-            arrays[f"{name}.{i}"] = np.asarray(leaf)
+            arrays[f"{name}.{i}"] = _leaf_to_host(leaf)
     return arrays, structure
 
 
@@ -103,15 +115,24 @@ class CoordinateDescentCheckpointer:
         run_fingerprint: str = "",
         keep: int = 2,
         save_every: int = 1,
+        multihost=None,
     ):
         """``save_every``: checkpoint every k-th coordinate update (the final
         update of a run is always saved) — bounds blocking host I/O when
-        per-coordinate solves are fast."""
+        per-coordinate solves are fast.
+
+        ``multihost``: a parallel.multihost.MultihostContext. When set, saves
+        are multihost-safe: all hosts flatten (the sharded-leaf all-gather is
+        a collective), ONLY the coordinator writes, and barriers fence the
+        write so no host races past an incomplete checkpoint. ``directory``
+        is assumed to be shared (or only read back on the coordinator)."""
         self.directory = directory
         self.run_fingerprint = run_fingerprint
         self.keep = max(keep, 1)
         self.save_every = max(save_every, 1)
-        os.makedirs(directory, exist_ok=True)
+        self.multihost = multihost
+        if multihost is None or multihost.coordinator_only_io():
+            os.makedirs(directory, exist_ok=True)
 
     # ------------------------------------------------------------------
     def _step_dirs(self) -> List[Tuple[int, str]]:
@@ -133,9 +154,14 @@ class CoordinateDescentCheckpointer:
 
     # ------------------------------------------------------------------
     def save(self, state: CheckpointState) -> str:
+        # collective: every host participates in the sharded-leaf all-gather
         arrays, structure = _flatten_state(
             {"params": state.params, "scores": state.scores, "total": state.total_scores}
         )
+        if self.multihost is not None and not self.multihost.coordinator_only_io():
+            # non-coordinators just fence the coordinator's write
+            self.multihost.barrier("ckpt-write")
+            return os.path.join(self.directory, f"{STEP_PREFIX}{state.step}")
         meta = {
             "step": state.step,
             "fingerprint": self.run_fingerprint,
@@ -146,16 +172,24 @@ class CoordinateDescentCheckpointer:
         final_dir = os.path.join(self.directory, f"{STEP_PREFIX}{state.step}")
         tmp_dir = tempfile.mkdtemp(prefix=".ckpt-", dir=self.directory)
         try:
-            np.savez(os.path.join(tmp_dir, ARRAYS_FILE), **arrays)
-            with open(os.path.join(tmp_dir, META_FILE), "w") as f:
-                json.dump(meta, f)
-            if os.path.exists(final_dir):
-                shutil.rmtree(final_dir)
-            os.replace(tmp_dir, final_dir)
-        except Exception:
-            shutil.rmtree(tmp_dir, ignore_errors=True)
-            raise
-        self._retire()
+            try:
+                np.savez(os.path.join(tmp_dir, ARRAYS_FILE), **arrays)
+                with open(os.path.join(tmp_dir, META_FILE), "w") as f:
+                    json.dump(meta, f)
+                if os.path.exists(final_dir):
+                    shutil.rmtree(final_dir)
+                os.replace(tmp_dir, final_dir)
+            except Exception:
+                shutil.rmtree(tmp_dir, ignore_errors=True)
+                raise
+            self._retire()
+        finally:
+            # barrier even when the write fails: non-coordinators are already
+            # blocked in their "ckpt-write" barrier — skipping ours would
+            # deadlock the whole job until the heartbeat timeout instead of
+            # surfacing the coordinator's exception
+            if self.multihost is not None:
+                self.multihost.barrier("ckpt-write")
         return final_dir
 
     def _retire(self) -> None:

@@ -432,6 +432,7 @@ class GameScoringParams:
     evaluators: List[Tuple[EvaluatorType, Optional[int], Optional[str]]] = dataclasses.field(
         default_factory=list
     )
+    host_scoring: bool = False  # NumPy oracle path (device path is default)
 
     def validate(self) -> None:
         errors = []
@@ -466,6 +467,8 @@ def build_scoring_parser() -> argparse.ArgumentParser:
     a("--application-name", default="photon-ml-tpu-game-scoring")
     a("--offheap-indexmap-dir", default=None)
     a("--evaluator-type", dest="evaluators", default=None)
+    a("--host-scoring", default="false",
+      help="force the NumPy host scoring path (device scoring's parity oracle)")
     return p
 
 
@@ -490,6 +493,7 @@ def parse_scoring_params(argv: Optional[List[str]] = None) -> GameScoringParams:
         application_name=ns.application_name,
         offheap_indexmap_dir=ns.offheap_indexmap_dir,
         evaluators=parse_evaluators(ns.evaluators),
+        host_scoring=_truthy(ns.host_scoring),
     )
     params.validate()
     return params

@@ -512,6 +512,27 @@ def _assemble_random_effect_tensors(
 # ---------------------------------------------------------------------------
 
 
+def padded_row_coo(feats: "HostFeatures", pad_col: int = -1):
+    """CSR -> padded per-row COO: (cols (N, K), vals (N, K)), K = max nnz/row.
+
+    Padding slots carry ``pad_col`` with value 0. ``pad_col=-1`` pairs with a
+    validity mask (cols >= 0); ``pad_col=0`` makes padding a gather-safe
+    no-op (value 0). The one conversion shared by validation scoring
+    (cli/game_training_driver.py) and device scoring
+    (cli/game_scoring_driver.py).
+    """
+    n = feats.num_rows
+    row_nnz = np.diff(feats.indptr)
+    k = max(int(row_nnz.max()) if n else 1, 1)
+    cols = np.full((n, k), pad_col, np.int32)
+    vals = np.zeros((n, k), feats.values.dtype)
+    rows = np.repeat(np.arange(n), row_nnz)
+    slots = np.arange(len(feats.indices)) - np.repeat(feats.indptr[:-1], row_nnz)
+    cols[rows, slots] = feats.indices
+    vals[rows, slots] = feats.values
+    return cols, vals
+
+
 def build_fixed_effect_batch(data: GameData, feature_shard_id: str, dense: bool = True):
     """(data/FixedEffectDataSet.scala:31-105 analogue.)"""
     from photon_ml_tpu.io.libsvm import HostDataset, to_batch

@@ -58,8 +58,9 @@ class DenseFeatures:
 
     The matrix may be stored in bfloat16 — the HBM-bandwidth lever for the
     GLM hot loop (the matvec is memory-bound; bf16 storage halves traffic).
-    All contractions accumulate in float32 on the MXU and return float32
-    regardless of storage dtype.
+    Contractions accumulate in and return ``_acc_dtype``: float32 on the
+    MXU regardless of (bf16/f32) storage, or float64 when the storage dtype
+    is float64 (the PHOTON_ML_TPU_DTYPE=float64 reference-precision mode).
     """
 
     matrix: Array  # (N, D)

@@ -232,20 +232,27 @@ def reference_logistic_value_and_grad(x, y, weights, w, l2: float = 0.0):
 _autotune_cache: dict = {}
 
 
-def _time_value_and_grad(vg_fn, w0, iters: int = 16) -> float:
+def _time_value_and_grad(vg_fn, w0, data, iters: int = 16) -> float:
     """Seconds per value+grad pass, serialized on-chip via lax.scan (host
-    timing over an RPC tunnel pipelines dispatches and lies otherwise)."""
+    timing over an RPC tunnel pipelines dispatches and lies otherwise).
 
-    def step(w, _):
-        v, g = vg_fn(w)
-        return w - 1e-6 * g, v
+    ``data`` (the probe arrays) flows in as a jit ARGUMENT: a closure
+    capture would inline the feature matrix into the HLO as a literal and
+    a remote-compile tunnel rejects >~100 MB request bodies (HTTP 413)."""
 
-    scan = jax.jit(lambda w: lax.scan(step, w, None, length=iters))
-    jax.block_until_ready(scan(w0))  # compile + warm
+    def run(w, d):
+        def step(w, _):
+            v, g = vg_fn(w, d)
+            return w - 1e-6 * g, v
+
+        return lax.scan(step, w, None, length=iters)
+
+    scan = jax.jit(run)
+    jax.block_until_ready(scan(w0, data))  # compile + warm
     best = float("inf")
     for _ in range(3):
         t0 = time.perf_counter()
-        jax.block_until_ready(scan(w0))
+        jax.block_until_ready(scan(w0, data))
         best = min(best, (time.perf_counter() - t0) / iters)
     return best
 
@@ -290,25 +297,28 @@ def select_fused_block_rows(
     off = jnp.zeros((n_probe,), jnp.float32)
     w0 = jnp.zeros((d,), jnp.float32)
 
-    def xla_vg(w):
-        z = jnp.dot(x, w.astype(x.dtype), preferred_element_type=jnp.float32) + off
-        val = jnp.sum(jnp.where(wt > 0, wt * loss.loss(z, y), 0.0))
-        dvec = jnp.where(wt > 0, wt * loss.d1(z, y), 0.0)
-        g = jnp.dot(dvec.astype(x.dtype), x, preferred_element_type=jnp.float32)
+    def xla_vg(w, data):
+        xx, yy, wwt, ooff = data
+        z = jnp.dot(xx, w.astype(xx.dtype), preferred_element_type=jnp.float32) + ooff
+        val = jnp.sum(jnp.where(wwt > 0, wwt * loss.loss(z, yy), 0.0))
+        dvec = jnp.where(wwt > 0, wwt * loss.d1(z, yy), 0.0)
+        g = jnp.dot(dvec.astype(xx.dtype), xx, preferred_element_type=jnp.float32)
         return val, g
 
+    probe_data = (x, y, wt, off)
     timings = {}
     if mode != "1":
-        timings[None] = _time_value_and_grad(xla_vg, w0)
+        timings[None] = _time_value_and_grad(xla_vg, w0, probe_data)
     interpret = not _on_tpu()
     for block in candidates:
         if block > n_probe:
             continue
         try:
-            fn = lambda w, b=block: fused_value_grad_parts(
-                loss, x, y, wt, off, w, block_rows=b, interpret=interpret
+            fn = lambda w, data, b=block: fused_value_grad_parts(
+                loss, data[0], data[1], data[2], data[3], w,
+                block_rows=b, interpret=interpret,
             )[:2]
-            timings[block] = _time_value_and_grad(fn, w0)
+            timings[block] = _time_value_and_grad(fn, w0, probe_data)
         except Exception:
             continue  # a block config that fails to compile is just not a candidate
     if not timings:

@@ -19,7 +19,9 @@ from photon_ml_tpu.parallel.mesh import (
     pad_rows,
     pad_leading,
 )
+from photon_ml_tpu.parallel import multihost
 from photon_ml_tpu.parallel.distributed import (
+    DistributedFactoredRandomEffectCoordinate,
     DistributedFixedEffectSolver,
     DistributedRandomEffectSolver,
 )
@@ -29,6 +31,8 @@ __all__ = [
     "data_mesh",
     "pad_rows",
     "pad_leading",
+    "multihost",
+    "DistributedFactoredRandomEffectCoordinate",
     "DistributedFixedEffectSolver",
     "DistributedRandomEffectSolver",
 ]

@@ -1,0 +1,151 @@
+"""Multi-host execution: jax.distributed bring-up, per-host ingest, global
+array assembly, and coordinator-gated side effects.
+
+Reference analogue — the driver/executor split (SURVEY.md §3.5,
+cli/game/training/Driver.scala:537): Spark's driver JVM partitions input
+paths across executors, broadcasts small state, and reduces over the
+cluster. TPU-native multi-host is SPMD instead: every host runs the SAME
+program under ``jax.distributed``, reads ONLY its slice of the input
+(:func:`host_shard_paths` / :func:`host_row_slice`), and assembles globally
+sharded arrays with ``jax.make_array_from_process_local_data``. Cross-host
+reductions are the same ``psum``s the single-host path uses — XLA routes
+them over ICI within a host and DCN across hosts, so no solver code changes
+between 1 and N hosts.
+
+Bring-up matrix (initialize()):
+  * TPU pods: zero-config — the TPU runtime publishes coordinator/topology
+    env vars and ``jax.distributed.initialize()`` discovers them.
+  * CPU/GPU clusters (and the 2-process CPU test harness): pass
+    coordinator_address/num_processes/process_id explicitly; collectives go
+    through the PJRT CPU Gloo backend.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+import jax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from photon_ml_tpu.parallel.mesh import DATA_AXIS, MeshContext, data_mesh
+
+Array = jax.Array
+
+
+def initialize(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+    local_device_count: Optional[int] = None,
+) -> "MultihostContext":
+    """Bring up jax.distributed (idempotent) and return the process context.
+
+    With no arguments, relies on the TPU pod runtime's automatic discovery;
+    on CPU/GPU test clusters pass all three of coordinator/num/process-id.
+    """
+    if (num_processes is not None and num_processes > 1) or coordinator_address:
+        if not jax.distributed.is_initialized():
+            kwargs = {}
+            if local_device_count is not None:
+                # spelled local_device_ids in this jax version
+                kwargs["local_device_ids"] = list(range(local_device_count))
+            jax.distributed.initialize(
+                coordinator_address=coordinator_address,
+                num_processes=num_processes,
+                process_id=process_id,
+                **kwargs,
+            )
+    return MultihostContext(
+        process_id=jax.process_index(), num_processes=jax.process_count()
+    )
+
+
+@dataclasses.dataclass(frozen=True)
+class MultihostContext:
+    """This process's coordinates in the job + global-array assembly."""
+
+    process_id: int
+    num_processes: int
+
+    @property
+    def is_coordinator(self) -> bool:
+        return self.process_id == 0
+
+    # -- topology ------------------------------------------------------
+    def mesh_context(self, axis: str = DATA_AXIS) -> MeshContext:
+        """MeshContext over ALL global devices (local + remote): the mesh's
+        device order is process-major, so a P(axis) sharding assigns each
+        host a contiguous row block — exactly the block host_row_slice
+        ingests."""
+        return MeshContext(data_mesh(axis=axis))
+
+    # -- per-host ingest -----------------------------------------------
+    def host_shard_paths(self, paths: Sequence[str]) -> List[str]:
+        """Deterministic round-robin assignment of input files to hosts
+        (the analogue of Spark assigning HDFS splits to executors)."""
+        return [p for i, p in enumerate(sorted(paths)) if i % self.num_processes == self.process_id]
+
+    def rows_per_host(self, n_global: int, ctx: Optional[MeshContext] = None) -> int:
+        """Uniform per-host row-block size: ceil over hosts, then rounded up
+        to a multiple of this host's local device count (so the global
+        sharding divides evenly). The tail host's shortfall is covered by
+        weight-0 padding in :meth:`global_row_sharded`."""
+        per = -(-n_global // self.num_processes)
+        if ctx is not None:
+            local = max(ctx.num_devices // self.num_processes, 1)
+            per = -(-per // local) * local
+        return per
+
+    def host_row_slice(self, n_global: int, ctx: Optional[MeshContext] = None) -> slice:
+        """This host's contiguous row block of a conceptually global
+        (n_global, ...) dataset. Blocks are uniform-size (rows_per_host);
+        the tail host's slice may be SHORT — global_row_sharded pads it
+        back to uniform with zero rows (mark them weight 0)."""
+        per = self.rows_per_host(n_global, ctx)
+        lo = min(self.process_id * per, n_global)
+        hi = min(lo + per, n_global)
+        return slice(lo, hi)
+
+    # -- global array assembly -----------------------------------------
+    def global_row_sharded(
+        self,
+        host_local: np.ndarray,
+        ctx: MeshContext,
+        n_global: Optional[int] = None,
+    ) -> Array:
+        """Assemble a globally row-sharded jax.Array from this host's local
+        rows. Every host contributes its block; no host ever materializes
+        the global array. Local row counts must be uniform across hosts —
+        pass ``n_global`` to zero-pad a short tail block (from
+        host_row_slice on a non-divisible n) up to rows_per_host; padding
+        rows must carry weight 0 so they contribute nothing."""
+        if n_global is not None:
+            per = self.rows_per_host(n_global, ctx)
+            short = per - host_local.shape[0]
+            if short > 0:
+                pad = np.zeros((short,) + host_local.shape[1:], host_local.dtype)
+                host_local = np.concatenate([host_local, pad])
+        sharding = NamedSharding(ctx.mesh, P(ctx.axis))
+        return jax.make_array_from_process_local_data(sharding, host_local)
+
+    def global_replicated(self, host_local: np.ndarray, ctx: MeshContext) -> Array:
+        """Replicate identical per-host data globally (Spark broadcast)."""
+        sharding = NamedSharding(ctx.mesh, P())
+        return jax.make_array_from_process_local_data(sharding, host_local)
+
+    # -- coordination ----------------------------------------------------
+    def barrier(self, name: str = "photon-ml-tpu-barrier") -> None:
+        """Block until every process reaches this point (checkpoint fences,
+        output-dir creation). No-op single-process."""
+        if self.num_processes > 1:
+            from jax.experimental import multihost_utils
+
+            multihost_utils.sync_global_devices(name)
+
+    def coordinator_only_io(self) -> bool:
+        """True when this process should perform global side effects (model
+        save, log upload) — the PhotonLogger-on-driver analogue."""
+        return self.is_coordinator

