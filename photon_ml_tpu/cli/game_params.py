@@ -265,6 +265,9 @@ class GameTrainingParams:
     validate_date_range_days_ago: Optional[str] = None
     feature_shard_sections: Dict[str, List[str]] = dataclasses.field(default_factory=dict)
     feature_shard_intercepts: Dict[str, bool] = dataclasses.field(default_factory=dict)
+    # deprecated NameAndTerm vocabulary path (GAMEDriver.scala:49-69 default
+    # path; off-heap maps are preferred — io/name_and_term.py)
+    feature_name_and_term_set_path: Optional[str] = None
     num_iterations: int = 1
     fixed_effect_opt_grid: List[Dict[str, CoordinateOptConfig]] = dataclasses.field(
         default_factory=lambda: [{}]
@@ -352,6 +355,9 @@ def build_training_parser() -> argparse.ArgumentParser:
     a("--validate-date-range-days-ago", default=None)
     a("--feature-shard-id-to-feature-section-keys-map", dest="shard_sections", default=None)
     a("--feature-shard-id-to-intercept-map", dest="shard_intercepts", default=None)
+    a("--feature-name-and-term-set-path", dest="name_and_term_path", default=None,
+      help="deprecated NameAndTerm vocabulary dir (one text subdir per "
+           "section); overrides the whole-dataset feature scan")
     a("--num-iterations", type=int, default=1)
     a("--fixed-effect-optimization-configurations", dest="fe_opt", default=None)
     a("--random-effect-optimization-configurations", dest="re_opt", default=None)
@@ -392,6 +398,7 @@ def parse_training_params(argv: Optional[List[str]] = None) -> GameTrainingParam
         validate_date_range_days_ago=ns.validate_date_range_days_ago,
         feature_shard_sections=parse_shard_sections(ns.shard_sections),
         feature_shard_intercepts=parse_shard_intercepts(ns.shard_intercepts),
+        feature_name_and_term_set_path=ns.name_and_term_path,
         num_iterations=ns.num_iterations,
         fixed_effect_opt_grid=parse_coordinate_config_grid(ns.fe_opt),
         random_effect_opt_grid=parse_coordinate_config_grid(ns.re_opt),

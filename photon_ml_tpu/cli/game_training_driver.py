@@ -142,16 +142,39 @@ class GameTrainingDriver:
         )
 
     def prepare_feature_maps(self) -> None:
-        """GAMEDriver.prepareFeatureMaps parity (offheap load :76-82 or
-        whole-dataset scan :49-69)."""
+        """GAMEDriver.prepareFeatureMaps parity: offheap load (:76-82), the
+        deprecated NameAndTerm vocabulary path, or whole-dataset scan
+        (:49-69) — in that priority order."""
         p = self.params
         paths = _input_files(self._train_dirs())
+        nt_container = None
+        if p.feature_name_and_term_set_path and not p.offheap_indexmap_dir:
+            from photon_ml_tpu.io.name_and_term import NameAndTermFeatureSetContainer
+
+            # resolve sections PER SHARD (incl. the "features" default for
+            # unconfigured shards) so no shard silently gets an empty vocab
+            all_sections = sorted(
+                {
+                    s
+                    for shard in self._shard_ids()
+                    for s in (p.feature_shard_sections.get(shard) or ["features"])
+                }
+            )
+            nt_container = NameAndTermFeatureSetContainer.read_from_text(
+                p.feature_name_and_term_set_path, all_sections
+            )
         for shard in self._shard_ids():
             if p.offheap_indexmap_dir:
                 from photon_ml_tpu.io.offheap import load_shard_index_map
 
                 self.shard_index_maps[shard] = load_shard_index_map(
                     p.offheap_indexmap_dir, shard
+                )
+            elif nt_container is not None:
+                sections = p.feature_shard_sections.get(shard) or ["features"]
+                add_intercept = p.feature_shard_intercepts.get(shard, True)
+                self.shard_index_maps[shard] = nt_container.index_map(
+                    sections, add_intercept
                 )
             else:
                 sections = p.feature_shard_sections.get(shard) or ["features"]

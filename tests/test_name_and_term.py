@@ -1,0 +1,150 @@
+"""NameAndTermFeatureSetContainer — the deprecated whole-dataset vocabulary
+path (avro/data/NameAndTermFeatureSetContainer.scala:38-260; VERDICT r2
+missing #4): generation CLI, text round-trip, section-union index maps, and
+GAME-driver integration via --feature-name-and-term-set-path.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from photon_ml_tpu.io import avro as avro_io
+from photon_ml_tpu.io import schemas
+from photon_ml_tpu.io.index_map import feature_key
+from photon_ml_tpu.io.name_and_term import (
+    INTERCEPT_NAME_AND_TERM,
+    NameAndTermFeatureSetContainer,
+    main as nt_main,
+)
+
+SCHEMA = {
+    "name": "Row",
+    "namespace": "t",
+    "type": "record",
+    "fields": [
+        {"name": "label", "type": "double"},
+        {"name": "features", "type": {"type": "array", "items": schemas.FEATURE}},
+        {
+            "name": "userFeatures",
+            "type": {
+                "type": "array",
+                "items": "com.linkedin.photon.avro.generated.FeatureAvro",
+            },
+        },
+    ],
+}
+
+
+@pytest.fixture
+def avro_dir(tmp_path):
+    recs = [
+        {
+            "label": 1.0,
+            "features": [
+                {"name": "age", "term": "", "value": 1.0},
+                {"name": "geo", "term": "us", "value": 1.0},
+            ],
+            "userFeatures": [{"name": "u", "term": "0", "value": 0.5}],
+        },
+        {
+            "label": 0.0,
+            "features": [{"name": "geo", "term": "de", "value": 1.0}],
+            "userFeatures": [{"name": "u", "term": "1", "value": 0.25}],
+        },
+    ]
+    d = tmp_path / "data"
+    d.mkdir()
+    avro_io.write_container(str(d / "p.avro"), recs, SCHEMA)
+    return str(d)
+
+
+class TestContainer:
+    def test_generate_save_read_round_trip(self, avro_dir, tmp_path):
+        out = str(tmp_path / "nt")
+        container = nt_main(
+            [
+                "--data-input-directory", avro_dir,
+                "--feature-name-and-term-set-output-dir", out,
+                "--feature-section-keys", "features,userFeatures",
+            ]
+        )
+        assert container.feature_sets["features"] == {
+            ("age", ""), ("geo", "us"), ("geo", "de"),
+        }
+        assert container.feature_sets["userFeatures"] == {("u", "0"), ("u", "1")}
+        # text layout: one subdir per section, name\tterm lines
+        lines = open(os.path.join(out, "features", "part-00000")).read().splitlines()
+        assert "geo\tus" in lines and "age\t" in lines
+
+        back = NameAndTermFeatureSetContainer.read_from_text(
+            out, ["features", "userFeatures"]
+        )
+        assert back.feature_sets == container.feature_sets
+
+    def test_union_index_map_with_intercept(self, avro_dir, tmp_path):
+        out = str(tmp_path / "nt")
+        container = nt_main(
+            [
+                "--data-input-directory", avro_dir,
+                "--feature-name-and-term-set-output-dir", out,
+                "--feature-section-keys", "features,userFeatures",
+            ]
+        )
+        m = container.feature_name_and_term_to_index_map(
+            ["features", "userFeatures"], add_intercept=True
+        )
+        assert len(m) == 6  # 5 features + intercept
+        assert m[INTERCEPT_NAME_AND_TERM] == 5  # intercept appended last
+        assert set(m.values()) == set(range(6))
+
+        imap = container.index_map(["features"], add_intercept=False)
+        assert len(imap) == 3
+        assert imap.get_index(feature_key("geo", "us")) >= 0
+        assert imap.get_index(feature_key("u", "0")) < 0  # other section
+
+    def test_malformed_line_raises(self, tmp_path):
+        d = tmp_path / "nt" / "features"
+        d.mkdir(parents=True)
+        (d / "part-00000").write_text("a\tb\tc\n")
+        with pytest.raises(ValueError, match="tab-separated"):
+            NameAndTermFeatureSetContainer.read_from_text(str(tmp_path / "nt"), ["features"])
+
+
+class TestGameDriverIntegration:
+    def test_driver_uses_name_and_term_vocab(self, avro_dir, tmp_path):
+        """Training with --feature-name-and-term-set-path must build shard
+        maps from the saved vocabulary, not a dataset scan: a feature absent
+        from the vocab (but present in data) gets no index."""
+        from photon_ml_tpu.cli import game_training_driver
+
+        nt_dir = str(tmp_path / "nt")
+        nt_main(
+            [
+                "--data-input-directory", avro_dir,
+                "--feature-name-and-term-set-output-dir", nt_dir,
+                "--feature-section-keys", "features,userFeatures",
+            ]
+        )
+        # drop one feature from the saved vocab to prove the vocab governs
+        feats_file = os.path.join(nt_dir, "features", "part-00000")
+        kept = [l for l in open(feats_file).read().splitlines() if not l.startswith("age")]
+        open(feats_file, "w").write("\n".join(kept) + "\n")
+
+        driver = game_training_driver.main(
+            [
+                "--train-input-dirs", avro_dir,
+                "--output-dir", str(tmp_path / "out"),
+                "--task-type", "LOGISTIC_REGRESSION",
+                "--updating-sequence", "fixed",
+                "--feature-shard-id-to-feature-section-keys-map", "global:features",
+                "--feature-name-and-term-set-path", nt_dir,
+                "--fixed-effect-data-configurations", "fixed:global,1",
+                "--fixed-effect-optimization-configurations", "fixed:5,1e-4,1,1,LBFGS,L2",
+                "--delete-output-dir-if-exists", "true",
+            ]
+        )
+        imap = driver.shard_index_maps["global"]
+        assert imap.get_index(feature_key("geo", "us")) >= 0
+        assert imap.get_index(feature_key("age", "")) < 0  # dropped from vocab
+        assert len(imap) == 3  # geo:us, geo:de + intercept
