@@ -47,7 +47,9 @@ DEFAULT_BLOCK_ROWS = 1024
 # (1, BN) x (BN, D) gradient matmul's low MXU occupancy and cut grid
 # overhead; the ceiling is VMEM (BN x D x 2B for bf16 plus the f32
 # scalars), so 8192 x 512 bf16 = 8 MiB stays comfortably under budget.
-AUTOTUNE_CANDIDATES = (1024, 2048, 4096, 8192, 16384)
+# NEGATIVE candidates select the manual double-buffered variant (explicit
+# chunked async DMA for all row streams) at |size| rows per chunk.
+AUTOTUNE_CANDIDATES = (1024, 2048, 4096, 8192, 16384, -2048, -4096, -8192)
 
 _FUSED_ENV = "PHOTON_ML_TPU_FUSED"  # "auto" (default) | "0" (off) | "1" (force)
 
@@ -106,6 +108,23 @@ def _make_kernel(loss: PointwiseLoss):
     return _kernel
 
 
+def _marshal_inputs(x, y, weights, offsets, w):
+    """Common calling convention of both kernel families: row vectors as
+    (N, 1) f32 columns, coefficients as a (D, 1) f32 column."""
+    n, d = x.shape
+    return (
+        x,
+        y.reshape(n, 1).astype(jnp.float32),
+        weights.reshape(n, 1).astype(jnp.float32),
+        offsets.reshape(n, 1).astype(jnp.float32),
+        w.reshape(d, 1).astype(jnp.float32),
+    )
+
+
+def _unpack_outputs(loss_sum, grad, sumd):
+    return loss_sum[0, 0], grad[0], sumd[0, 0]
+
+
 @functools.lru_cache(maxsize=64)
 def _fused_fn(loss: PointwiseLoss, block_rows: int, interpret: bool):
     """Jitted single-pass (loss_sum, grad, sum_d) for one loss/block config."""
@@ -143,14 +162,125 @@ def _fused_fn(loss: PointwiseLoss, block_rows: int, interpret: bool):
             # the grid axis is a pure reduction: no ordering constraint
             compiler_params=pltpu.CompilerParams(dimension_semantics=("arbitrary",)),
             interpret=interpret,
-        )(
-            x,
-            y.reshape(n, 1).astype(jnp.float32),
-            weights.reshape(n, 1).astype(jnp.float32),
-            offsets.reshape(n, 1).astype(jnp.float32),
-            w.reshape(d, 1).astype(jnp.float32),
+        )(*_marshal_inputs(x, y, weights, offsets, w))
+        return _unpack_outputs(loss_sum, grad, sumd)
+
+    return call
+
+
+# ---------------------------------------------------------------------------
+# manual double-buffered variant: every row stream (x AND y/wt/off) chunked
+# from HBM with explicit async copies (2-slot rotation), so VMEM use is
+# bounded by the chunk size at ANY dataset size. A structurally different
+# pipeline from the automatic grid pipeline above — raced against it by the
+# autotuner (encoded as NEGATIVE block sizes).
+# ---------------------------------------------------------------------------
+
+
+def _make_manual_kernel(loss: PointwiseLoss, block_rows: int):
+    def kernel(x_hbm, y_hbm, wt_hbm, off_hbm, w_ref,
+               loss_out, grad_out, sumd_out):
+        n = y_hbm.shape[0]
+        num_chunks = n // block_rows
+
+        def body(xbuf, ybuf, wtbuf, offbuf, acc_grad, sem):
+            # ALL row streams (x + the aux vectors) are chunked: nothing in
+            # VMEM scales with N, so a probe-time winner stays valid at any
+            # training-set size (the aux arrays resident would pin (N,1)x3
+            # f32 and blow VMEM for N in the millions)
+            def dmas(slot, chunk):
+                sl = pl.ds(chunk * block_rows, block_rows)
+                return (
+                    pltpu.make_async_copy(x_hbm.at[sl], xbuf.at[slot], sem.at[slot, 0]),
+                    pltpu.make_async_copy(y_hbm.at[sl], ybuf.at[slot], sem.at[slot, 1]),
+                    pltpu.make_async_copy(wt_hbm.at[sl], wtbuf.at[slot], sem.at[slot, 2]),
+                    pltpu.make_async_copy(off_hbm.at[sl], offbuf.at[slot], sem.at[slot, 3]),
+                )
+
+            for dma in dmas(0, 0):
+                dma.start()
+
+            def loop_body(chunk, carry):
+                acc_loss, acc_sumd = carry
+                slot = chunk % 2
+
+                @pl.when(chunk + 1 < num_chunks)
+                def _():
+                    for dma in dmas((chunk + 1) % 2, chunk + 1):
+                        dma.start()
+
+                for dma in dmas(slot, chunk):
+                    dma.wait()
+                x = xbuf[slot]  # (BN, D) storage dtype
+                yv = ybuf[slot]
+                wt = wtbuf[slot]
+                off = offbuf[slot]
+                w = w_ref[:]
+                z = jnp.dot(x, w.astype(x.dtype),
+                            preferred_element_type=jnp.float32) + off
+                lv = loss.loss(z, yv)
+                wl = jnp.where(wt > 0.0, wt * lv, 0.0)
+                dd = jnp.where(wt > 0.0, wt * loss.d1(z, yv), 0.0)
+                acc_grad[:] += jnp.dot(
+                    dd.astype(x.dtype).T, x, preferred_element_type=jnp.float32
+                )
+                return (
+                    acc_loss + jnp.sum(wl, keepdims=True).reshape(1, 1),
+                    acc_sumd + jnp.sum(dd, keepdims=True).reshape(1, 1),
+                )
+
+            acc_grad[:] = jnp.zeros_like(acc_grad)
+            acc_loss, acc_sumd = jax.lax.fori_loop(
+                0, num_chunks, loop_body,
+                (jnp.zeros((1, 1), jnp.float32), jnp.zeros((1, 1), jnp.float32)),
+            )
+            loss_out[:] = acc_loss
+            sumd_out[:] = acc_sumd
+            grad_out[:] = acc_grad[:]
+
+        d = x_hbm.shape[1]
+        pl.run_scoped(
+            body,
+            xbuf=pltpu.VMEM((2, block_rows, d), x_hbm.dtype),
+            ybuf=pltpu.VMEM((2, block_rows, 1), jnp.float32),
+            wtbuf=pltpu.VMEM((2, block_rows, 1), jnp.float32),
+            offbuf=pltpu.VMEM((2, block_rows, 1), jnp.float32),
+            acc_grad=pltpu.VMEM((1, d), jnp.float32),
+            sem=pltpu.SemaphoreType.DMA((2, 4)),
         )
-        return loss_sum[0, 0], grad[0], sumd[0, 0]
+
+    return kernel
+
+
+@functools.lru_cache(maxsize=64)
+def _fused_fn_manual(loss: PointwiseLoss, block_rows: int, interpret: bool):
+    kernel = _make_manual_kernel(loss, block_rows)
+
+    @jax.jit
+    def call(x, y, weights, offsets, w):
+        n, d = x.shape
+        loss_sum, grad, sumd = pl.pallas_call(
+            kernel,
+            in_specs=[
+                pl.BlockSpec(memory_space=pltpu.ANY),  # x stays in HBM
+                pl.BlockSpec(memory_space=pltpu.VMEM),
+                pl.BlockSpec(memory_space=pltpu.VMEM),
+                pl.BlockSpec(memory_space=pltpu.VMEM),
+                pl.BlockSpec(memory_space=pltpu.VMEM),
+            ],
+            out_specs=[
+                pl.BlockSpec(memory_space=pltpu.VMEM),
+                pl.BlockSpec(memory_space=pltpu.VMEM),
+                pl.BlockSpec(memory_space=pltpu.VMEM),
+            ],
+            out_shape=[
+                jax.ShapeDtypeStruct((1, 1), jnp.float32),
+                jax.ShapeDtypeStruct((1, d), jnp.float32),
+                jax.ShapeDtypeStruct((1, 1), jnp.float32),
+            ],
+            interpret=interpret,
+        )(*_marshal_inputs(x, y, weights, offsets, w))
+        return _unpack_outputs(loss_sum, grad, sumd)
 
     return call
 
@@ -171,18 +301,24 @@ def fused_value_grad_parts(
     (``GLMObjective.value_and_grad`` folds shifts/factors/L2 around these).
     ``x``: (N, D), any float dtype — bfloat16 recommended for bandwidth.
     Rows are padded (weight 0) up to a block multiple.
+
+    ``block_rows``: positive = automatic grid pipeline; NEGATIVE = the
+    manual double-buffered variant with |block_rows| rows per chunk (the
+    autotuner races both families and encodes its choice in the sign).
     """
     if interpret is None:
         interpret = not _on_tpu()
+    manual = block_rows < 0
+    block = min(abs(block_rows), max(x.shape[0], 1))
     n, d = x.shape
-    block_rows = min(block_rows, max(n, 1))
-    pad = (-n) % block_rows
+    pad = (-n) % block
     if pad:
         x = jnp.concatenate([x, jnp.zeros((pad, d), x.dtype)])
         y = jnp.concatenate([y, jnp.zeros((pad,), y.dtype)])
         weights = jnp.concatenate([weights, jnp.zeros((pad,), weights.dtype)])
         offsets = jnp.concatenate([offsets, jnp.zeros((pad,), offsets.dtype)])
-    return _fused_fn(loss, block_rows, interpret)(x, y, weights, offsets, w)
+    fn = _fused_fn_manual if manual else _fused_fn
+    return fn(loss, block, interpret)(x, y, weights, offsets, w)
 
 
 def fused_logistic_value_and_grad(
@@ -311,7 +447,7 @@ def select_fused_block_rows(
         timings[None] = _time_value_and_grad(xla_vg, w0, probe_data)
     interpret = not _on_tpu()
     for block in candidates:
-        if block > n_probe:
+        if abs(block) > n_probe:
             continue
         try:
             fn = lambda w, data, b=block: fused_value_grad_parts(

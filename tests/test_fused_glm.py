@@ -147,3 +147,47 @@ class TestFusedLogistic:
         v, g = fused_logistic_value_and_grad(x, y, wt, w, l2=0.3, block_rows=128)
         assert float(v) == pytest.approx(float(v_obj), rel=1e-5)
         np.testing.assert_allclose(np.asarray(g), np.asarray(g_obj), rtol=1e-4, atol=1e-4)
+
+
+class TestManualDoubleBufferedVariant:
+    """NEGATIVE block sizes select the explicit-DMA double-buffered kernel
+    (x chunks streamed from HBM, y/wt/off resident in VMEM) — the autotune's
+    second pipeline family. Must agree with the oracle and the grid-pipeline
+    kernel bit-for-bit in f32 interpreter mode."""
+
+    @pytest.mark.parametrize("loss_name", ["logistic", "squared", "poisson"])
+    def test_matches_grid_pipeline_and_oracle(self, rng, loss_name):
+        from photon_ml_tpu.ops import fused_glm, losses
+
+        loss = getattr(losses, loss_name)
+        x, y, wt, w, _ = _data(rng, 700, 128)  # non-multiple of block
+        off = jnp.asarray(np.random.default_rng(5).normal(size=700).astype(np.float32) * 0.1)
+        if loss_name == "poisson":
+            y = jnp.abs(y) * 2.0  # counts
+        v_a, g_a, s_a = fused_glm.fused_value_grad_parts(
+            loss, x, y, wt, off, w, block_rows=256, interpret=True
+        )
+        v_m, g_m, s_m = fused_glm.fused_value_grad_parts(
+            loss, x, y, wt, off, w, block_rows=-256, interpret=True
+        )
+        assert float(v_m) == pytest.approx(float(v_a), rel=1e-6)
+        assert float(s_m) == pytest.approx(float(s_a), rel=1e-5, abs=1e-6)
+        np.testing.assert_allclose(np.asarray(g_m), np.asarray(g_a), rtol=1e-5, atol=1e-6)
+
+        # oracle: plain f32 dense computation
+        z = x @ w + off
+        lv = float(jnp.sum(wt * loss.loss(z, y)))
+        d = wt * loss.d1(z, y)
+        assert float(v_m) == pytest.approx(lv, rel=1e-5)
+        np.testing.assert_allclose(
+            np.asarray(g_m), np.asarray(d @ x), rtol=1e-4, atol=1e-4
+        )
+
+    def test_autotune_accepts_negative_candidates(self, monkeypatch):
+        from photon_ml_tpu.ops import fused_glm, losses
+
+        monkeypatch.setenv("PHOTON_ML_TPU_FUSED", "1")
+        block = fused_glm.select_fused_block_rows(
+            losses.logistic, 1024, 128, candidates=(-512,)
+        )
+        assert block == -512
