@@ -56,6 +56,7 @@ class CoordinateDescent:
         validation_scorer: Optional[Callable[[Dict[str, Array]], Array]] = None,
         validation_evaluators: Optional[Dict[str, Tuple[Evaluator, dict]]] = None,
         collect_timings: bool = False,
+        fused_cycle: bool = False,
     ):
         """``training_loss(total_scores) -> scalar`` is the loss-evaluator
         analogue used for the objective value (the training counterpart of
@@ -70,12 +71,23 @@ class CoordinateDescent:
         the whole descent async — objective/validation values stay on device
         until the end of the run, so dispatch is never serialized on a host
         round-trip per update (important over a remote device tunnel).
+
+        ``fused_cycle=True`` compiles ONE XLA program per full descent
+        iteration — every coordinate's update + rescore + objective (+
+        validation metrics) unrolled into a single jitted cycle. The host
+        dispatches once per iteration instead of ~4x per coordinate, which
+        matters over a remote device tunnel and lets XLA overlap across
+        coordinate boundaries. Trade-offs: checkpoints land at iteration
+        (not per-update) granularity, and per-coordinate wall timings
+        collapse into one '(fused-cycle)' entry.
         """
         self.coordinates = coordinates
         self.training_loss = training_loss
         self.validation_scorer = validation_scorer
         self.validation_evaluators = validation_evaluators or {}
         self.collect_timings = collect_timings
+        self.fused_cycle = fused_cycle
+        self._cycle_fn = None
         # jit the per-coordinate update+score once per coordinate
         self._update_fns = {
             name: jax.jit(lambda off, w0, c=coord: c.update(off, w0))
@@ -84,6 +96,40 @@ class CoordinateDescent:
         self._score_fns = {
             name: jax.jit(lambda w, c=coord: c.score(w)) for name, coord in coordinates.items()
         }
+
+    # ------------------------------------------------------------------
+    def _build_cycle(self):
+        """One traced function for a FULL iteration over all coordinates
+        (unrolled at trace time; coordinate objects are closed over as
+        static structure, arrays flow through as traced pytrees)."""
+        names = list(self.coordinates)
+
+        def cycle(params, scores, total):
+            objs = []
+            vals = []
+            for name in names:
+                coord = self.coordinates[name]
+                partial = total - scores[name]
+                new_params, _ = coord.update(partial, params[name])
+                params = {**params, name: new_params}
+                new_score = coord.score(new_params)
+                total = partial + new_score
+                scores = {**scores, name: new_score}
+                obj = self.training_loss(total) + sum(
+                    self.coordinates[n].regularization_term(params[n]) for n in names
+                )
+                objs.append(obj)
+                if self.validation_scorer is not None:
+                    v_scores = self.validation_scorer(params)
+                    vals.append(
+                        {
+                            key: ev.evaluate(v_scores, **kw)
+                            for key, (ev, kw) in self.validation_evaluators.items()
+                        }
+                    )
+            return params, scores, total, objs, vals
+
+        return jax.jit(cycle)
 
     def run(
         self,
@@ -106,7 +152,9 @@ class CoordinateDescent:
         validation_dev: List[Dict[str, Array]] = []
         objective_history: List[float] = []
         validation_history: List[Dict[str, float]] = []
-        timings = {n: 0.0 for n in names}
+        # per-coordinate entries only where they are actually measured (the
+        # fused path measures whole cycles, not coordinates)
+        timings = {} if self.fused_cycle else {n: 0.0 for n in names}
         total = jnp.zeros((num_rows,), real_dtype())
 
         start_step = 0
@@ -131,6 +179,59 @@ class CoordinateDescent:
                     {k: float(v) for k, v in m.items()} for m in host
                 )
                 validation_dev.clear()
+
+        if self.fused_cycle:
+            n_coords = len(names)
+            if start_step % n_coords != 0:
+                raise ValueError(
+                    f"fused_cycle resume requires an iteration-aligned "
+                    f"checkpoint; restored step {start_step} is mid-iteration "
+                    f"(coordinates={n_coords}). Re-run unfused to finish the "
+                    "partial iteration first."
+                )
+            if self._cycle_fn is None:
+                self._cycle_fn = self._build_cycle()
+            for it in range(num_iterations):
+                step = (it + 1) * n_coords
+                if step <= start_step:
+                    continue
+                t0 = time.perf_counter()
+                params, scores, total, objs, vals = self._cycle_fn(params, scores, total)
+                if self.collect_timings:
+                    jax.block_until_ready(total)
+                timings["(fused-cycle)"] = (
+                    timings.get("(fused-cycle)", 0.0) + time.perf_counter() - t0
+                )
+                objective_dev.extend(objs)
+                validation_dev.extend(vals)
+                is_last = it == num_iterations - 1
+                # steps advance n_coords at a time here: fire whenever a
+                # save_every boundary was CROSSED this iteration, not only
+                # when step lands exactly on a multiple
+                if checkpointer is not None and (
+                    step % checkpointer.save_every < n_coords or is_last
+                ):
+                    from photon_ml_tpu.checkpoint import CheckpointState
+
+                    _drain()
+                    checkpointer.save(
+                        CheckpointState(
+                            step=step,
+                            params=params,
+                            scores=scores,
+                            total_scores=total,
+                            objective_history=objective_history,
+                            validation_history=validation_history,
+                        )
+                    )
+            _drain()
+            return CoordinateDescentResult(
+                coefficients=params,
+                total_scores=total,
+                objective_history=objective_history,
+                validation_history=validation_history,
+                timings=timings,
+            )
 
         step = 0
         for it in range(num_iterations):

@@ -297,6 +297,9 @@ class GameTrainingParams:
     # shard fixed-effect rows + random-effect entities over all visible
     # devices (jax.sharding Mesh; collectives ride ICI)
     distributed: bool = False
+    # compile each full coordinate-descent iteration as one XLA program
+    # (fewer host dispatches; iteration-granular checkpoints)
+    fused_cycle: bool = False
 
     def validate(self) -> None:
         errors = []
@@ -373,6 +376,9 @@ def build_training_parser() -> argparse.ArgumentParser:
     a("--evaluator-type", dest="evaluators", default=None)
     a("--checkpoint-dir", default=None)
     a("--distributed", default="false")
+    a("--fused-cycle", default="false",
+      help="compile each full coordinate-descent iteration as ONE XLA "
+           "program (fewer host dispatches; iteration-granular checkpoints)")
     return p
 
 
@@ -414,6 +420,7 @@ def parse_training_params(argv: Optional[List[str]] = None) -> GameTrainingParam
         evaluators=parse_evaluators(ns.evaluators),
         checkpoint_dir=ns.checkpoint_dir,
         distributed=_truthy(ns.distributed),
+        fused_cycle=_truthy(ns.fused_cycle),
     )
     params.validate()
     return params

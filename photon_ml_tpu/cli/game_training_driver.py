@@ -454,8 +454,12 @@ class GameTrainingDriver:
                         }
                     ),
                 )
-            cd = CoordinateDescent(coords, loss_fn, scorer, evaluators)
-            with self.timer.measure(f"combo-{i}"):
+            cd = CoordinateDescent(
+                coords, loss_fn, scorer, evaluators, fused_cycle=p.fused_cycle
+            )
+            from photon_ml_tpu.utils.profiling import maybe_trace
+
+            with self.timer.measure(f"combo-{i}"), maybe_trace(f"game-combo-{i}"):
                 result = cd.run(
                     p.num_iterations, self.train_data.num_rows, checkpointer
                 )

@@ -335,9 +335,12 @@ class Driver:
             compute_variance=p.compute_variance,
             constraints=self._constraints(),
         )
-        self.trained = train_glm_grid(
-            self.problem, self.train_batch, self.norm, p.regularization_weights
-        )
+        from photon_ml_tpu.utils.profiling import maybe_trace
+
+        with maybe_trace("glm-train"):
+            self.trained = train_glm_grid(
+                self.problem, self.train_batch, self.norm, p.regularization_weights
+            )
         self.models = [
             (lam, self._to_raw_space(m))
             for lam, m in zip(self.trained.weights, self.trained.models)

@@ -1,0 +1,55 @@
+"""Native profiler hooks (SURVEY.md §5.1 designed upgrade).
+
+The reference's tracing is wall-clock Timers + per-iteration state
+trackers (util/Timer.scala:32-235, OptimizationStatesTracker.scala:31-100)
+— both reproduced here (utils/timer.py, optim/common.py histories). On TPU
+the missing piece is a DEVICE-side trace: set
+
+    PHOTON_ML_TPU_PROFILE=/path/to/tracedir
+
+and every CLI driver wraps its train stage in a ``jax.profiler`` trace
+(viewable in XProf/TensorBoard — per-kernel HBM/MXU timelines), with
+training phases annotated via ``TraceAnnotation``. No env var -> zero
+overhead no-ops.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+from typing import Iterator, Optional
+
+PROFILE_ENV = "PHOTON_ML_TPU_PROFILE"
+
+
+def profile_dir() -> Optional[str]:
+    return os.environ.get(PROFILE_ENV) or None
+
+
+@contextlib.contextmanager
+def maybe_trace(stage: str) -> Iterator[None]:
+    """Device trace of ``stage`` into $PHOTON_ML_TPU_PROFILE/<stage>/ when
+    the env var is set; otherwise a no-op."""
+    base = profile_dir()
+    if not base:
+        yield
+        return
+    import jax
+
+    out = os.path.join(base, stage)
+    os.makedirs(out, exist_ok=True)
+    jax.profiler.start_trace(out)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+
+
+@contextlib.contextmanager
+def annotate(name: str) -> Iterator[None]:
+    """Named sub-span inside an active trace (TraceAnnotation); no-op
+    without an active trace but cheap enough to leave on."""
+    import jax
+
+    with jax.profiler.TraceAnnotation(name):
+        yield

@@ -391,6 +391,48 @@ class TestDistributedTraining:
                 dist_means[eid], local_means[eid], rtol=1e-3, atol=1e-3
             )
 
+    def test_distributed_factored_through_driver(self, game_avro_dirs, tmp_path):
+        """--distributed with a FACTORED coordinate (the r2 exclusion now
+        lifted): entity-sharded alternation + psum'd latent refit must match
+        the single-device driver run, incl. the persisted latent structure."""
+        from photon_ml_tpu.io import model_io
+
+        train_dir, val_dir, _ = game_avro_dirs
+        flags = [f for f in COMMON_FLAGS]
+        i = flags.index("--random-effect-optimization-configurations")
+        del flags[i : i + 2]
+        factored = [
+            "--factored-random-effect-optimization-configurations",
+            "per-user:20,1e-7,0.1,1,LBFGS,L2:20,1e-7,0.1,1,LBFGS,L2:1,2",
+            "--num-iterations", "1",
+        ]
+        runs = {}
+        for mode in ("local", "dist"):
+            driver = game_training_driver.main(
+                [
+                    "--train-input-dirs", train_dir,
+                    "--validate-input-dirs", val_dir,
+                    "--output-dir", str(tmp_path / mode),
+                    "--distributed", str(mode == "dist").lower(),
+                ]
+                + factored
+                + flags
+            )
+            runs[mode] = driver
+        m_local = runs["local"].results[0][2]
+        m_dist = runs["dist"].results[0][2]
+        assert m_dist["AUC"] == pytest.approx(m_local["AUC"], abs=5e-3)
+        fac_l, mat_l, _, _ = model_io.load_factored_random_effect(
+            str(tmp_path / "local" / "best"), "per-user"
+        )
+        fac_d, mat_d, _, _ = model_io.load_factored_random_effect(
+            str(tmp_path / "dist" / "best"), "per-user"
+        )
+        np.testing.assert_allclose(mat_d, mat_l, rtol=5e-3, atol=1e-3)
+        assert set(fac_d) == set(fac_l)
+        for eid in fac_d:
+            np.testing.assert_allclose(fac_d[eid], fac_l[eid], rtol=5e-3, atol=1e-3)
+
 
 class TestDateRangeDiscovery:
     def test_training_with_daily_layout(self, game_avro_dirs, tmp_path):
