@@ -1,0 +1,557 @@
+// Native Avro container decoder — the ingest fast path.
+//
+// The reference ingests Avro on JVM executors (AvroUtils.scala:53,
+// DataProcessingUtils.scala:33-200); the byte-level decode there is
+// generated-class Java. This build's equivalent native runtime piece walks
+// the Avro 1.x container wire format in C++ — block framing + raw-deflate
+// (zlib) + zigzag varints — and emits COLUMNS for a schema described by a
+// compact descriptor, handed to Python via ctypes. Anything the descriptor
+// grammar cannot express makes avd_parse return an error and Python falls
+// back to the pure codec (io/avro.py), which stays the source of truth.
+//
+// Descriptor grammar (recursive, byte codes):
+//   0x01 double   0x02 float   0x03 long   0x04 int   0x05 string
+//   0x06 boolean  0x07 null
+//   0x10 union:  [u8 n][n branch descriptors]
+//   0x20 array:  [item descriptor]
+//   0x30 map:    [value descriptor]
+//   0x40 record: [u8 n_fields][field descriptors]
+// The TOP-LEVEL descriptor must be a record; its fields become columns.
+//
+// Column layouts (per top-level field, queried by index):
+//   numeric/boolean (or union with null): f64 data + u8 present mask
+//   string (or union with null):          byte heap + i64 offsets + mask
+//   array<...>:  per-record counts + the item's columns flattened
+//   map<string>: per-record counts + key heap/offsets + value heap/offsets
+//   record{...}: its fields' columns flattened (fixed offset into the
+//                child column list)
+//
+// C API: see avd_* prototypes below. All getters copy into caller buffers.
+
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <string>
+#include <vector>
+
+#include <zlib.h>
+
+namespace {
+
+// ---------------------------------------------------------------- reader --
+struct Reader {
+  const uint8_t* p;
+  const uint8_t* end;
+  bool ok = true;
+
+  bool need(size_t n) {
+    if (static_cast<size_t>(end - p) < n) {
+      ok = false;
+      return false;
+    }
+    return true;
+  }
+  int64_t read_long() {  // zigzag varint
+    uint64_t acc = 0;
+    int shift = 0;
+    while (true) {
+      if (!need(1)) return 0;
+      uint8_t b = *p++;
+      acc |= static_cast<uint64_t>(b & 0x7f) << shift;
+      if (!(b & 0x80)) break;
+      shift += 7;
+      if (shift > 63) {
+        ok = false;
+        return 0;
+      }
+    }
+    return static_cast<int64_t>(acc >> 1) ^ -static_cast<int64_t>(acc & 1);
+  }
+  double read_double() {
+    if (!need(8)) return 0.0;
+    double v;
+    std::memcpy(&v, p, 8);
+    p += 8;
+    return v;
+  }
+  float read_float() {
+    if (!need(4)) return 0.0f;
+    float v;
+    std::memcpy(&v, p, 4);
+    p += 4;
+    return v;
+  }
+  bool read_bytes(const uint8_t** out, int64_t* len) {
+    int64_t n = read_long();
+    if (!ok || n < 0 || !need(static_cast<size_t>(n))) {
+      ok = false;
+      return false;
+    }
+    *out = p;
+    *len = n;
+    p += n;
+    return true;
+  }
+};
+
+// ------------------------------------------------------------ descriptor --
+enum Code : uint8_t {
+  D_DOUBLE = 0x01,
+  D_FLOAT = 0x02,
+  D_LONG = 0x03,
+  D_INT = 0x04,
+  D_STRING = 0x05,
+  D_BOOL = 0x06,
+  D_NULL = 0x07,
+  D_UNION = 0x10,
+  D_ARRAY = 0x20,
+  D_MAP = 0x30,
+  D_RECORD = 0x40,
+};
+
+struct Node {
+  uint8_t code;
+  std::vector<Node> children;  // union branches / array item / map value /
+                               // record fields
+  // column storage (filled during decode); which members are used depends
+  // on code — see header comment
+  std::vector<double> nums;
+  std::vector<uint8_t> present;
+  std::vector<uint8_t> heap;       // string bytes
+  std::vector<int64_t> offsets;    // string end-offsets into heap
+  std::vector<int64_t> counts;     // array/map: items per parent entry
+  std::vector<uint8_t> kheap;      // map keys
+  std::vector<int64_t> koffsets;
+  std::vector<uint8_t> kinds;      // union: chosen branch index per entry
+  bool lossy_long = false;         // a long exceeded 2^53 (f64-exact range)
+};
+
+bool parse_descriptor(const uint8_t*& d, const uint8_t* dend, Node* out) {
+  if (d >= dend) return false;
+  out->code = *d++;
+  switch (out->code) {
+    case D_DOUBLE: case D_FLOAT: case D_LONG: case D_INT:
+    case D_STRING: case D_BOOL: case D_NULL:
+      return true;
+    case D_UNION: case D_RECORD: {
+      if (d >= dend) return false;
+      uint8_t n = *d++;
+      out->children.resize(n);
+      for (uint8_t i = 0; i < n; ++i)
+        if (!parse_descriptor(d, dend, &out->children[i])) return false;
+      return true;
+    }
+    case D_ARRAY: case D_MAP: {
+      out->children.resize(1);
+      return parse_descriptor(d, dend, &out->children[0]);
+    }
+    default:
+      return false;
+  }
+}
+
+// -------------------------------------------------------------- decoding --
+// Decodes ONE datum of type `node`, appending to the node's columns.
+bool decode_datum(Reader& r, Node& node) {
+  switch (node.code) {
+    case D_DOUBLE:
+      node.nums.push_back(r.read_double());
+      node.present.push_back(1);
+      return r.ok;
+    case D_FLOAT:
+      node.nums.push_back(static_cast<double>(r.read_float()));
+      node.present.push_back(1);
+      return r.ok;
+    case D_LONG:
+    case D_INT: {
+      int64_t v = r.read_long();
+      // columns carry f64: a long outside +/-2^53 would silently round
+      // (id collapse); flag it so the whole file falls back to the exact
+      // python codec
+      if (v > (1ll << 53) || v < -(1ll << 53)) node.lossy_long = true;
+      node.nums.push_back(static_cast<double>(v));
+      node.present.push_back(1);
+      return r.ok;
+    }
+    case D_BOOL: {
+      if (!r.need(1)) return false;
+      node.nums.push_back(*r.p++ ? 1.0 : 0.0);
+      node.present.push_back(1);
+      return true;
+    }
+    case D_NULL:
+      node.nums.push_back(0.0);
+      node.present.push_back(0);
+      return true;
+    case D_STRING: {
+      const uint8_t* s;
+      int64_t len;
+      if (!r.read_bytes(&s, &len)) return false;
+      node.heap.insert(node.heap.end(), s, s + len);
+      node.offsets.push_back(static_cast<int64_t>(node.heap.size()));
+      node.present.push_back(1);
+      return true;
+    }
+    case D_UNION: {
+      int64_t branch = r.read_long();
+      if (!r.ok || branch < 0 ||
+          branch >= static_cast<int64_t>(node.children.size()))
+        return false;
+      Node& b = node.children[static_cast<size_t>(branch)];
+      // union columns live on the UNION node itself: kinds records the
+      // chosen branch per entry; nums/present are entry-aligned; offsets
+      // advance only on string entries (python ranks them via kinds);
+      // nested branches decode into their own child node.
+      node.kinds.push_back(static_cast<uint8_t>(branch));
+      if (b.code == D_NULL) {
+        node.nums.push_back(0.0);
+        node.present.push_back(0);
+        return true;
+      }
+      switch (b.code) {
+        case D_DOUBLE:
+          node.nums.push_back(r.read_double());
+          node.present.push_back(1);
+          return r.ok;
+        case D_FLOAT:
+          node.nums.push_back(static_cast<double>(r.read_float()));
+          node.present.push_back(1);
+          return r.ok;
+        case D_LONG:
+        case D_INT: {
+          int64_t v = r.read_long();
+          if (v > (1ll << 53) || v < -(1ll << 53)) node.lossy_long = true;
+          node.nums.push_back(static_cast<double>(v));
+          node.present.push_back(1);
+          return r.ok;
+        }
+        case D_BOOL: {
+          if (!r.need(1)) return false;
+          node.nums.push_back(*r.p++ ? 1.0 : 0.0);
+          node.present.push_back(1);
+          return true;
+        }
+        case D_STRING: {
+          const uint8_t* s;
+          int64_t len;
+          if (!r.read_bytes(&s, &len)) return false;
+          node.heap.insert(node.heap.end(), s, s + len);
+          node.offsets.push_back(static_cast<int64_t>(node.heap.size()));
+          node.nums.push_back(0.0);
+          node.present.push_back(1);
+          return true;
+        }
+        case D_MAP:
+        case D_ARRAY:
+        case D_RECORD: {
+          node.nums.push_back(0.0);
+          node.present.push_back(1);
+          return decode_datum(r, b);
+        }
+        default:
+          return false;
+      }
+    }
+    case D_ARRAY: {
+      int64_t total = 0;
+      while (true) {
+        int64_t n = r.read_long();
+        if (!r.ok) return false;
+        if (n == 0) break;
+        if (n < 0) {  // block with byte size prefix
+          n = -n;
+          r.read_long();  // byte length, unused
+          if (!r.ok) return false;
+        }
+        for (int64_t i = 0; i < n; ++i)
+          if (!decode_datum(r, node.children[0])) return false;
+        total += n;
+      }
+      node.counts.push_back(total);
+      return true;
+    }
+    case D_MAP: {
+      int64_t total = 0;
+      while (true) {
+        int64_t n = r.read_long();
+        if (!r.ok) return false;
+        if (n == 0) break;
+        if (n < 0) {
+          n = -n;
+          r.read_long();
+          if (!r.ok) return false;
+        }
+        for (int64_t i = 0; i < n; ++i) {
+          const uint8_t* s;
+          int64_t len;
+          if (!r.read_bytes(&s, &len)) return false;
+          node.kheap.insert(node.kheap.end(), s, s + len);
+          node.koffsets.push_back(static_cast<int64_t>(node.kheap.size()));
+          if (!decode_datum(r, node.children[0])) return false;
+        }
+        total += n;
+      }
+      node.counts.push_back(total);
+      return true;
+    }
+    case D_RECORD: {
+      for (auto& f : node.children)
+        if (!decode_datum(r, f)) return false;
+      return true;
+    }
+    default:
+      return false;
+  }
+}
+
+}  // namespace
+
+// ------------------------------------------------------------------ state --
+struct Decoded {
+  Node root;
+  int64_t num_records = 0;
+  std::string error;
+};
+
+extern "C" {
+
+void* avd_parse(const uint8_t* file_bytes, long file_len,
+                const uint8_t* descriptor, long desc_len);
+long avd_num_records(void* h);
+const char* avd_error(void* h);
+void avd_free(void* h);
+
+// column accessors: `path`/`path_len` is a sequence of child indices from
+// the root record (u32 each); returns sizes first, then fills.
+long avd_col_size_nums(void* h, const uint32_t* path, long path_len);
+long avd_col_size_heap(void* h, const uint32_t* path, long path_len);
+long avd_col_size_counts(void* h, const uint32_t* path, long path_len);
+long avd_col_size_kheap(void* h, const uint32_t* path, long path_len);
+long avd_col_size_offsets(void* h, const uint32_t* path, long path_len);
+long avd_col_size_present(void* h, const uint32_t* path, long path_len);
+long avd_col_size_koffsets(void* h, const uint32_t* path, long path_len);
+int avd_col_fetch(void* h, const uint32_t* path, long path_len,
+                  double* nums, uint8_t* present, uint8_t* heap,
+                  int64_t* offsets, int64_t* counts, uint8_t* kheap,
+                  int64_t* koffsets);
+}
+
+namespace {
+
+bool any_lossy(const Node& n) {
+  if (n.lossy_long) return true;
+  for (const auto& c : n.children)
+    if (any_lossy(c)) return true;
+  return false;
+}
+
+Node* walk(Decoded* d, const uint32_t* path, long path_len) {
+  Node* n = &d->root;
+  for (long i = 0; i < path_len; ++i) {
+    if (path[i] >= n->children.size()) return nullptr;
+    n = &n->children[path[i]];
+  }
+  return n;
+}
+
+}  // namespace
+
+extern "C" {
+
+void* avd_parse(const uint8_t* file_bytes, long file_len,
+                const uint8_t* descriptor, long desc_len) {
+  auto* d = new Decoded();
+  const uint8_t* dp = descriptor;
+  if (!parse_descriptor(dp, descriptor + desc_len, &d->root) ||
+      d->root.code != D_RECORD) {
+    d->error = "bad descriptor";
+    return d;
+  }
+
+  Reader r{file_bytes, file_bytes + file_len};
+  // header: magic
+  if (!r.need(4) || std::memcmp(r.p, "Obj\x01", 4) != 0) {
+    d->error = "bad magic";
+    return d;
+  }
+  r.p += 4;
+  // metadata map: we need avro.codec; schema compatibility is the CALLER's
+  // responsibility (python passes a descriptor derived from the file's own
+  // schema)
+  std::string codec = "null";
+  while (true) {
+    int64_t n = r.read_long();
+    if (!r.ok) {
+      d->error = "bad metadata";
+      return d;
+    }
+    if (n == 0) break;
+    if (n < 0) {
+      n = -n;
+      r.read_long();
+    }
+    for (int64_t i = 0; i < n; ++i) {
+      const uint8_t* k;
+      int64_t klen;
+      const uint8_t* v;
+      int64_t vlen;
+      if (!r.read_bytes(&k, &klen) || !r.read_bytes(&v, &vlen)) {
+        d->error = "bad metadata entry";
+        return d;
+      }
+      if (klen == 10 && std::memcmp(k, "avro.codec", 10) == 0)
+        codec.assign(reinterpret_cast<const char*>(v),
+                     static_cast<size_t>(vlen));
+    }
+  }
+  if (codec != "null" && codec != "deflate") {
+    d->error = "unsupported codec: " + codec;
+    return d;
+  }
+  if (!r.need(16)) {
+    d->error = "missing sync";
+    return d;
+  }
+  uint8_t sync[16];
+  std::memcpy(sync, r.p, 16);
+  r.p += 16;
+
+  std::vector<uint8_t> inflated;
+  while (r.p < r.end) {
+    int64_t count = r.read_long();
+    if (!r.ok) {
+      d->error = "bad block count";
+      return d;
+    }
+    const uint8_t* payload;
+    int64_t plen;
+    if (!r.read_bytes(&payload, &plen)) {
+      d->error = "bad block payload";
+      return d;
+    }
+    Reader br{payload, payload + plen};
+    if (codec == "deflate") {
+      // raw deflate (no zlib header), unknown output size: grow-and-retry
+      inflated.clear();
+      size_t cap = static_cast<size_t>(plen) * 4 + 1024;
+      int ret;
+      do {
+        inflated.resize(cap);
+        z_stream zs;
+        std::memset(&zs, 0, sizeof(zs));
+        if (inflateInit2(&zs, -15) != Z_OK) {
+          d->error = "inflateInit failed";
+          return d;
+        }
+        zs.next_in = const_cast<uint8_t*>(payload);
+        zs.avail_in = static_cast<uInt>(plen);
+        zs.next_out = inflated.data();
+        zs.avail_out = static_cast<uInt>(cap);
+        ret = inflate(&zs, Z_FINISH);
+        size_t produced = cap - zs.avail_out;
+        inflateEnd(&zs);
+        if (ret == Z_STREAM_END) {
+          inflated.resize(produced);
+          break;
+        }
+        cap *= 2;
+      } while (ret == Z_BUF_ERROR && cap < (1ull << 33));
+      if (ret != Z_STREAM_END) {
+        d->error = "inflate failed";
+        return d;
+      }
+      br = Reader{inflated.data(), inflated.data() + inflated.size()};
+    }
+    for (int64_t i = 0; i < count; ++i) {
+      if (!decode_datum(br, d->root)) {
+        d->error = "record decode failed";
+        return d;
+      }
+    }
+    d->num_records += count;
+    if (!r.need(16) || std::memcmp(r.p, sync, 16) != 0) {
+      d->error = "sync mismatch";
+      return d;
+    }
+    r.p += 16;
+  }
+  if (any_lossy(d->root)) d->error = "long value exceeds 2^53";
+  return d;
+}
+
+long avd_num_records(void* h) { return static_cast<Decoded*>(h)->num_records; }
+
+const char* avd_error(void* h) {
+  auto* d = static_cast<Decoded*>(h);
+  return d->error.empty() ? nullptr : d->error.c_str();
+}
+
+void avd_free(void* h) { delete static_cast<Decoded*>(h); }
+
+long avd_col_size_nums(void* h, const uint32_t* path, long path_len) {
+  Node* n = walk(static_cast<Decoded*>(h), path, path_len);
+  return n ? static_cast<long>(n->nums.size()) : -1;
+}
+long avd_col_size_heap(void* h, const uint32_t* path, long path_len) {
+  Node* n = walk(static_cast<Decoded*>(h), path, path_len);
+  return n ? static_cast<long>(n->heap.size()) : -1;
+}
+long avd_col_size_counts(void* h, const uint32_t* path, long path_len) {
+  Node* n = walk(static_cast<Decoded*>(h), path, path_len);
+  return n ? static_cast<long>(n->counts.size()) : -1;
+}
+long avd_col_size_kheap(void* h, const uint32_t* path, long path_len) {
+  Node* n = walk(static_cast<Decoded*>(h), path, path_len);
+  return n ? static_cast<long>(n->kheap.size()) : -1;
+}
+long avd_col_size_offsets(void* h, const uint32_t* path, long path_len) {
+  Node* n = walk(static_cast<Decoded*>(h), path, path_len);
+  return n ? static_cast<long>(n->offsets.size()) : -1;
+}
+long avd_col_size_present(void* h, const uint32_t* path, long path_len) {
+  Node* n = walk(static_cast<Decoded*>(h), path, path_len);
+  return n ? static_cast<long>(n->present.size()) : -1;
+}
+long avd_col_size_koffsets(void* h, const uint32_t* path, long path_len) {
+  Node* n = walk(static_cast<Decoded*>(h), path, path_len);
+  return n ? static_cast<long>(n->koffsets.size()) : -1;
+}
+long avd_col_size_kinds(void* h, const uint32_t* path, long path_len) {
+  Node* n = walk(static_cast<Decoded*>(h), path, path_len);
+  return n ? static_cast<long>(n->kinds.size()) : -1;
+}
+int avd_col_fetch_kinds(void* h, const uint32_t* path, long path_len,
+                        uint8_t* kinds) {
+  Node* n = walk(static_cast<Decoded*>(h), path, path_len);
+  if (!n) return -1;
+  if (kinds && !n->kinds.empty())
+    std::memcpy(kinds, n->kinds.data(), n->kinds.size());
+  return 0;
+}
+
+int avd_col_fetch(void* h, const uint32_t* path, long path_len,
+                  double* nums, uint8_t* present, uint8_t* heap,
+                  int64_t* offsets, int64_t* counts, uint8_t* kheap,
+                  int64_t* koffsets) {
+  Node* n = walk(static_cast<Decoded*>(h), path, path_len);
+  if (!n) return -1;
+  if (nums && !n->nums.empty())
+    std::memcpy(nums, n->nums.data(), n->nums.size() * sizeof(double));
+  if (present && !n->present.empty())
+    std::memcpy(present, n->present.data(), n->present.size());
+  if (heap && !n->heap.empty())
+    std::memcpy(heap, n->heap.data(), n->heap.size());
+  if (offsets && !n->offsets.empty())
+    std::memcpy(offsets, n->offsets.data(),
+                n->offsets.size() * sizeof(int64_t));
+  if (counts && !n->counts.empty())
+    std::memcpy(counts, n->counts.data(), n->counts.size() * sizeof(int64_t));
+  if (kheap && !n->kheap.empty())
+    std::memcpy(kheap, n->kheap.data(), n->kheap.size());
+  if (koffsets && !n->koffsets.empty())
+    std::memcpy(koffsets, n->koffsets.data(),
+                n->koffsets.size() * sizeof(int64_t));
+  return 0;
+}
+
+}  // extern "C"

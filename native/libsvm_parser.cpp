@@ -1,0 +1,140 @@
+// Native LIBSVM text parser — the data-loader fast path.
+//
+// The reference's ingest runs on JVM executors (io/LibSVMInputDataFormat
+// .scala:31, GLMSuite.scala:295-340 text parsing); this build's equivalent
+// "native runtime" piece parses LIBSVM text in C++ (single pass over a
+// read()-buffered file, strtod/strtol scanning) and hands CSR arrays back
+// to Python through ctypes. Semantics are byte-for-byte those of
+// photon_ml_tpu.io.libsvm.read_libsvm: '#' starts a comment, blank lines
+// skipped, first token is the label, "idx:val" pairs follow, indices
+// 1-based unless zero_based. Label {-1,1}->{0,1} remapping and the
+// intercept append stay in Python (they need whole-dataset views).
+//
+// C API (ctypes):
+//   void* lsv_parse(const char* path, int zero_based)  NULL on I/O error
+//   long  lsv_rows(void*)
+//   long  lsv_nnz(void*)
+//   long  lsv_max_index(void*)    // -1 when the file has no features
+//   int   lsv_ok(void*)           // 0 when a malformed token was seen
+//   void  lsv_fill(void*, double* labels, long long* indptr,
+//                  int* indices, double* values)
+//   void  lsv_free(void*)
+
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <string>
+#include <vector>
+
+namespace {
+
+struct Parsed {
+  std::vector<double> labels;
+  std::vector<long long> indptr;  // rows + 1
+  std::vector<int> indices;
+  std::vector<double> values;
+  long long max_index = -1;
+  bool ok = true;
+};
+
+}  // namespace
+
+extern "C" {
+
+void* lsv_parse(const char* path, int zero_based) {
+  FILE* f = std::fopen(path, "rb");
+  if (!f) return nullptr;
+  std::fseek(f, 0, SEEK_END);
+  long size = std::ftell(f);
+  std::fseek(f, 0, SEEK_SET);
+  std::string buf;
+  buf.resize(static_cast<size_t>(size));
+  if (size > 0 && std::fread(&buf[0], 1, static_cast<size_t>(size), f) !=
+                      static_cast<size_t>(size)) {
+    std::fclose(f);
+    return nullptr;
+  }
+  std::fclose(f);
+
+  auto* out = new Parsed();
+  out->indptr.push_back(0);
+  const int base = zero_based ? 0 : 1;
+
+  const char* p = buf.c_str();
+  const char* end = p + buf.size();
+  while (p < end) {
+    // one line: up to '\n'; '#' cuts the rest
+    const char* nl = static_cast<const char*>(std::memchr(p, '\n', end - p));
+    const char* line_end = nl ? nl : end;
+    const char* hash = static_cast<const char*>(std::memchr(p, '#', line_end - p));
+    const char* stop = hash ? hash : line_end;
+
+    // skip leading whitespace
+    while (p < stop && (*p == ' ' || *p == '\t' || *p == '\r')) ++p;
+    if (p < stop) {
+      char* after = nullptr;
+      double label = std::strtod(p, &after);
+      if (after == p) {
+        out->ok = false;  // malformed label
+      } else {
+        out->labels.push_back(label);
+        p = after;
+        // idx:val tokens
+        while (p < stop) {
+          while (p < stop && (*p == ' ' || *p == '\t' || *p == '\r')) ++p;
+          if (p >= stop) break;
+          char* a1 = nullptr;
+          long idx = std::strtol(p, &a1, 10);
+          if (a1 == p || a1 >= stop || *a1 != ':') {
+            out->ok = false;
+            break;
+          }
+          const char* vstart = a1 + 1;
+          // the python parser rejects 'idx:' with whitespace/EOL after the
+          // colon; strtod would skip it and steal the NEXT number — guard
+          if (vstart >= stop || *vstart == ' ' || *vstart == '\t' ||
+              *vstart == '\r' || *vstart == '\n') {
+            out->ok = false;
+            break;
+          }
+          char* a2 = nullptr;
+          double val = std::strtod(vstart, &a2);
+          if (a2 == vstart || a2 > stop) {
+            out->ok = false;
+            break;
+          }
+          long adj = idx - base;
+          if (adj > 2147483647L || adj < -2147483648L) {
+            out->ok = false;  // python raises OverflowError on int32 cast
+            break;
+          }
+          out->indices.push_back(static_cast<int>(adj));
+          out->values.push_back(val);
+          if (adj > out->max_index) out->max_index = adj;
+          p = a2;
+        }
+        out->indptr.push_back(static_cast<long long>(out->indices.size()));
+      }
+    }
+    p = nl ? nl + 1 : end;
+  }
+  return out;
+}
+
+long lsv_rows(void* h) { return static_cast<Parsed*>(h)->labels.size(); }
+long lsv_nnz(void* h) { return static_cast<Parsed*>(h)->indices.size(); }
+long lsv_max_index(void* h) { return static_cast<Parsed*>(h)->max_index; }
+int lsv_ok(void* h) { return static_cast<Parsed*>(h)->ok ? 1 : 0; }
+
+void lsv_fill(void* h, double* labels, long long* indptr, int* indices,
+              double* values) {
+  auto* d = static_cast<Parsed*>(h);
+  std::memcpy(labels, d->labels.data(), d->labels.size() * sizeof(double));
+  std::memcpy(indptr, d->indptr.data(), d->indptr.size() * sizeof(long long));
+  std::memcpy(indices, d->indices.data(), d->indices.size() * sizeof(int));
+  std::memcpy(values, d->values.data(), d->values.size() * sizeof(double));
+}
+
+void lsv_free(void* h) { delete static_cast<Parsed*>(h); }
+
+}  // extern "C"

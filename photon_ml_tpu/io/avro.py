@@ -322,11 +322,20 @@ def read_container(path: str) -> Iterator[Any]:
                 raise ValueError(f"{path}: sync marker mismatch")
 
 
+def list_part_files(path: str) -> list:
+    """Part-file discovery shared by read_directory and the native fast
+    path (io/avro_data._native_columns) — one definition so the two ingest
+    paths can never read different file sets."""
+    if os.path.isfile(path):
+        return [path]
+    return [
+        os.path.join(path, name)
+        for name in sorted(os.listdir(path))
+        if name.endswith(".avro")
+    ]
+
+
 def read_directory(path: str) -> Iterator[Any]:
     """Read all part files of an avro output directory (part-*.avro)."""
-    if os.path.isfile(path):
-        yield from read_container(path)
-        return
-    for name in sorted(os.listdir(path)):
-        if name.endswith(".avro"):
-            yield from read_container(os.path.join(path, name))
+    for f in list_part_files(path):
+        yield from read_container(f)

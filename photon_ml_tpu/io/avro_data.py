@@ -29,12 +29,137 @@ def _iter_records(paths: Sequence[str]) -> Iterable[dict]:
         yield from avro_io.read_directory(p)
 
 
+def _expand_part_files(paths: Sequence[str]) -> List[str]:
+    """Part files in read_directory order (one shared definition)."""
+    out: List[str] = []
+    for p in paths:
+        out.extend(avro_io.list_part_files(p))
+    return out
+
+
+def _native_columns(paths: Sequence[str]):
+    """NativeColumns per part file, or None if ANY file can't take the
+    native fast path (all-or-nothing keeps the assembly uniform)."""
+    from photon_ml_tpu.io import avro_native
+
+    cols = []
+    for f in _expand_part_files(paths):
+        c = avro_native.read_columns(f)
+        if c is None:
+            return None
+        cols.append(c)
+    return cols or None
+
+
+def _padded_matrix(heap: bytes, offsets: np.ndarray, total: int) -> Tuple[np.ndarray, np.ndarray]:
+    """(total, maxlen) u8 matrix of zero-padded strings + (total,) lengths,
+    built fully vectorized from the byte heap."""
+    buf = np.frombuffer(heap, np.uint8)
+    starts = offsets[:total]
+    lengths = (offsets[1 : total + 1] - starts).astype(np.int64)
+    maxlen = int(lengths.max()) if total else 1
+    maxlen = max(maxlen, 1)
+    pos = starts[:, None] + np.arange(maxlen)[None, :]
+    mask = np.arange(maxlen)[None, :] < lengths[:, None]
+    safe = np.clip(pos, 0, max(len(buf) - 1, 0))
+    mat = np.where(mask, buf[safe] if len(buf) else 0, 0).astype(np.uint8)
+    return mat, lengths
+
+
+_NONE_BYTES = np.frombuffer(b"None", np.uint8)
+
+
+def _ntv_keys_to_indices(raw: dict, index_map: IndexMap,
+                         return_keys: bool = False):
+    """Vectorized feature-key -> index over a raw NTV column bundle: build
+    padded (name, term) byte matrices, dedupe rows with np.unique, and touch
+    python strings only once per UNIQUE key (IndexMap probe)."""
+    total = raw["total"]
+    if total == 0:
+        empty = np.zeros(0, np.int64)
+        return (empty, []) if return_keys else empty
+    name_mat, name_len = _padded_matrix(raw["name_heap"], raw["name_off"], total)
+    term = raw["term"]
+    if term[0] == "strings":
+        term_mat, term_len = _padded_matrix(term[1], term[2], total)
+    elif term[0] == "union":
+        _, heap, off_str, str_mask = term
+        n_str = int(str_mask.sum())
+        smat, slen = _padded_matrix(heap, off_str, n_str)
+        width = max(smat.shape[1], 4)  # room for the literal "None"
+        term_mat = np.zeros((total, width), np.uint8)
+        term_len = np.empty(total, np.int64)
+        term_mat[str_mask, : smat.shape[1]] = smat
+        term_len[str_mask] = slen
+        # python-codec parity: feature_key(name, None) stringifies None
+        term_mat[~str_mask, :4] = _NONE_BYTES
+        term_len[~str_mask] = 4
+    else:  # "empty"
+        term_mat = np.zeros((total, 1), np.uint8)
+        term_len = np.zeros(total, np.int64)
+
+    combined = np.concatenate(
+        [
+            name_len[:, None].view(np.uint8).reshape(total, 8),
+            term_len[:, None].view(np.uint8).reshape(total, 8),
+            name_mat,
+            term_mat,
+        ],
+        axis=1,
+    )
+    rows = np.ascontiguousarray(combined).view(
+        np.dtype((np.void, combined.shape[1]))
+    ).ravel()
+    uniq, first, inverse = np.unique(rows, return_index=True, return_inverse=True)
+
+    nbuf = raw["name_heap"]
+    keys = []
+    for i in first:
+        nm = nbuf[raw["name_off"][i] : raw["name_off"][i + 1]].decode("utf-8")
+        tl = int(term_len[i])
+        tm = term_mat[i, :tl].tobytes().decode("utf-8")
+        keys.append(feature_key(nm, tm))
+    mapped = np.fromiter(
+        (index_map.get_index(k) for k in keys), dtype=np.int64, count=len(keys)
+    )
+    idx = mapped[inverse]
+    return (idx, keys) if return_keys else idx
+
+
 def collect_feature_keys(
     paths: Sequence[str], sections: Sequence[str] = ("features",)
 ) -> List[str]:
     """Whole-dataset feature vocabulary (NameAndTermFeatureSetContainer
     analogue). ``sections`` are the record fields holding FeatureAvro arrays
-    (the reference's feature sections/bags)."""
+    (the reference's feature sections/bags). Columnar through the native
+    decoder when the files support it."""
+    native = _native_columns(paths)
+    if native is not None:
+        keys = set()
+        supported = True
+
+        class _AllKeys:
+            """Index-map stand-in: _ntv_keys_to_indices probes once per
+            unique key; we only want the keys."""
+
+            @staticmethod
+            def get_index(_k):
+                return -1
+
+        for cols in native:
+            for section in sections:
+                if not cols.has_field(section):
+                    continue
+                ntv = cols.ntv_array_raw(section)
+                if ntv is None:
+                    supported = False
+                    break
+                _, uniq_keys = _ntv_keys_to_indices(ntv, _AllKeys, return_keys=True)
+                keys.update(uniq_keys)
+            if not supported:
+                break
+        if supported:
+            return sorted(keys)
     keys = set()
     for rec in _iter_records(paths):
         for section in sections:
@@ -53,7 +178,17 @@ def read_training_examples(
 
     ``label_field``: "label" for TRAINING_EXAMPLE records, "response" for
     RESPONSE_PREDICTION ones (io/FieldNamesType.scala parity).
+
+    Runs columnar through the native decoder when the files support it
+    (identical output; PHOTON_ML_TPU_NATIVE=0 forces the python row loop).
     """
+    native = _native_columns(paths)
+    if native is not None:
+        fast = _read_training_examples_columnar(
+            native, index_map, add_intercept, label_field
+        )
+        if fast is not None:
+            return fast
     labels: List[float] = []
     offsets: List[float] = []
     weights: List[float] = []
@@ -85,6 +220,62 @@ def read_training_examples(
     )
 
 
+def _read_training_examples_columnar(
+    cols_list, index_map: IndexMap, add_intercept: bool, label_field: str
+) -> Optional[HostDataset]:
+    """Vectorized assembly from native columns; None -> caller falls back."""
+    parts = []
+    intercept_idx = index_map.intercept_index
+    for cols in cols_list:
+        lab = cols.scalar(label_field)
+        feats = cols.ntv_array_raw("features")
+        if lab is None or feats is None or not lab[1].all():
+            return None
+        labels, _ = lab
+        counts, values = feats["counts"], feats["values"]
+        off = cols.scalar("offset")
+        wt = cols.scalar("weight")
+        n = cols.n
+        # rec.get("offset") or 0.0 / weight None -> 1.0 (python-loop parity)
+        offsets = np.where(off[1].astype(bool), off[0], 0.0) if off else np.zeros(n)
+        weights = np.where(wt[1].astype(bool), wt[0], 1.0) if wt else np.ones(n)
+
+        idx = _ntv_keys_to_indices(feats, index_map)
+        keep = idx >= 0
+        row_of_item = np.repeat(np.arange(n, dtype=np.int64), counts)
+        kept_rows = row_of_item[keep]
+        kept_idx = idx[keep].astype(np.int32)
+        kept_vals = values[keep]
+        per_row = np.bincount(kept_rows, minlength=n).astype(np.int64)
+        order = np.argsort(kept_rows, kind="stable")
+        kept_idx, kept_vals = kept_idx[order], kept_vals[order]
+        if add_intercept and intercept_idx >= 0:
+            ptr = np.zeros(n + 1, np.int64)
+            np.cumsum(per_row, out=ptr[1:])
+            kept_idx = np.insert(kept_idx, ptr[1:], np.full(n, intercept_idx, np.int32))
+            kept_vals = np.insert(kept_vals, ptr[1:], np.ones(n))
+            per_row = per_row + 1
+        parts.append((labels, offsets, weights, per_row, kept_idx, kept_vals))
+
+    labels = np.concatenate([p[0] for p in parts])
+    offsets = np.concatenate([p[1] for p in parts])
+    weights = np.concatenate([p[2] for p in parts])
+    per_row = np.concatenate([p[3] for p in parts])
+    indices = np.concatenate([p[4] for p in parts])
+    values = np.concatenate([p[5] for p in parts])
+    indptr = np.zeros(len(labels) + 1, np.int64)
+    np.cumsum(per_row, out=indptr[1:])
+    return HostDataset(
+        labels=labels.astype(real_dtype()),
+        indptr=indptr,
+        indices=indices.astype(np.int32),
+        values=values.astype(real_dtype()),
+        dim=len(index_map),
+        offsets=offsets.astype(real_dtype()),
+        weights=weights.astype(real_dtype()),
+    )
+
+
 def read_game_data(
     paths: Sequence[str],
     shard_index_maps: Dict[str, IndexMap],
@@ -104,8 +295,19 @@ def read_game_data(
 
     Entity ids are read from ``metadataMap`` (DataProcessingUtils.scala:
     90-114: field or metadata map lookup).
+
+    Runs columnar through the native decoder when the files support it
+    (identical output; PHOTON_ML_TPU_NATIVE=0 forces the python row loop).
     """
     shard_intercepts = shard_intercepts or {s: True for s in shard_index_maps}
+    native = _native_columns(paths)
+    if native is not None:
+        fast = _read_game_data_columnar(
+            native, shard_index_maps, shard_sections, id_types,
+            shard_intercepts, id_vocabs, response_required,
+        )
+        if fast is not None:
+            return fast
     n = 0
     labels: List[float] = []
     offsets: List[float] = []
@@ -188,6 +390,182 @@ def read_game_data(
         response=np.asarray(labels, real_dtype()),
         offset=np.asarray(offsets, real_dtype()),
         weight=np.asarray(weights, real_dtype()),
+        ids=ids,
+        id_vocabs=vocabs,
+        shards=shards,
+    )
+
+
+def _read_game_data_columnar(
+    cols_list,
+    shard_index_maps: Dict[str, IndexMap],
+    shard_sections: Dict[str, List[str]],
+    id_types: Sequence[str],
+    shard_intercepts: Dict[str, bool],
+    id_vocabs: Optional[Dict[str, List[str]]],
+    response_required: bool,
+) -> Optional[GameData]:
+    """Vectorized GAME ingest from native columns; None -> python loop."""
+    all_labels, all_offsets, all_weights = [], [], []
+    raw_ids: Dict[str, List[str]] = {t: [] for t in id_types}
+    shard_parts: Dict[str, list] = {s: [] for s in shard_index_maps}
+
+    for cols in cols_list:
+        n = cols.n
+        lab = cols.scalar("label") or cols.scalar("response")
+        if lab is None:
+            if cols.has_field("label") or cols.has_field("response"):
+                return None  # exotic label type -> python loop semantics
+            if response_required:
+                return None  # python loop raises the canonical error
+            labels = np.full(n, np.nan)
+        else:
+            vals, present = lab
+            if present.all():
+                labels = vals.copy()
+            elif response_required:
+                return None
+            else:
+                labels = np.where(present.astype(bool), vals, np.nan)
+        off = cols.scalar("offset")
+        wt = cols.scalar("weight")
+        all_labels.append(labels)
+        all_offsets.append(
+            np.where(off[1].astype(bool), off[0], 0.0) if off else np.zeros(n)
+        )
+        all_weights.append(
+            np.where(wt[1].astype(bool), wt[0], 1.0) if wt else np.ones(n)
+        )
+
+        # ids: record field first, metadataMap PER RECORD otherwise
+        # (DataProcessingUtils.scala:90-114 lookup order; the python loop's
+        # `t in rec and rec[t] is not None` is a per-record decision)
+        meta = None
+        meta_tried = False
+
+        def _meta_lookup(i, t):
+            nonlocal meta, meta_tried
+            if not meta_tried:
+                meta_tried = True
+                m = cols.string_map("metadataMap")
+                if m is not None:
+                    mcounts, mkeys, mvals, mpresent = m
+                    mstarts = np.zeros(len(mcounts) + 1, np.int64)
+                    np.cumsum(mcounts, out=mstarts[1:])
+                    mdense = np.cumsum(mpresent.astype(np.int64)) - 1
+                    meta = (mstarts, mkeys, mvals, mpresent, mdense)
+            if meta is None:
+                return None
+            mstarts, mkeys, mvals, mpresent, mdense = meta
+            if not mpresent[i]:
+                return None
+            di = int(mdense[i])
+            for j in range(int(mstarts[di]), int(mstarts[di + 1])):
+                if mkeys[j] == t:
+                    return mvals[j]
+            return None
+
+        for t in id_types:
+            ftype = cols.field_type(t)
+            field_vals = None  # list with None where the field value is null
+            if ftype in ("int", "long"):
+                sc = cols.scalar(t)
+                field_vals = [
+                    str(int(v)) if pr else None for v, pr in zip(sc[0], sc[1])
+                ]
+            elif ftype is not None:
+                st = cols.strings(t)
+                if st is not None:
+                    field_vals = list(st[0])
+                else:
+                    return None  # exotic id field type -> python loop
+            got = []
+            for i in range(n):
+                v = field_vals[i] if field_vals is not None else None
+                if v is None:
+                    v = _meta_lookup(i, t)
+                if v is None:
+                    return None  # missing id -> python loop raises the error
+                got.append(v)
+            raw_ids[t].extend(got)
+
+        # per-shard features: union of the shard's sections
+        section_cache: Dict[str, tuple] = {}
+        for s, imap in shard_index_maps.items():
+            per_row = np.zeros(n, np.int64)
+            idx_parts, val_parts, row_parts = [], [], []
+            for section in shard_sections.get(s) or ["features"]:
+                if section not in section_cache:
+                    if not cols.has_field(section):
+                        section_cache[section] = None
+                    else:
+                        ntv = cols.ntv_array_raw(section)
+                        if ntv is None:
+                            return None
+                        rows = np.repeat(
+                            np.arange(n, dtype=np.int64), ntv["counts"]
+                        )
+                        section_cache[section] = (rows, ntv)
+                cached = section_cache[section]
+                if cached is None:
+                    continue  # absent section == no features (python parity)
+                rows, ntv = cached
+                values = ntv["values"]
+                idx = _ntv_keys_to_indices(ntv, imap)
+                keep = idx >= 0
+                row_parts.append(rows[keep])
+                idx_parts.append(idx[keep].astype(np.int32))
+                val_parts.append(values[keep])
+            if row_parts:
+                rows_k = np.concatenate(row_parts)
+                idx_k = np.concatenate(idx_parts)
+                vals_k = np.concatenate(val_parts)
+                order = np.argsort(rows_k, kind="stable")
+                rows_k, idx_k, vals_k = rows_k[order], idx_k[order], vals_k[order]
+                per_row = np.bincount(rows_k, minlength=n).astype(np.int64)
+            else:
+                idx_k = np.zeros(0, np.int32)
+                vals_k = np.zeros(0)
+            if shard_intercepts.get(s, True) and imap.intercept_index >= 0:
+                ptr = np.zeros(n + 1, np.int64)
+                np.cumsum(per_row, out=ptr[1:])
+                idx_k = np.insert(
+                    idx_k, ptr[1:], np.full(n, imap.intercept_index, np.int32)
+                )
+                vals_k = np.insert(vals_k, ptr[1:], np.ones(n))
+                per_row = per_row + 1
+            shard_parts[s].append((per_row, idx_k, vals_k))
+
+    labels = np.concatenate(all_labels) if all_labels else np.zeros(0)
+    n_total = len(labels)
+    ids: Dict[str, np.ndarray] = {}
+    vocabs: Dict[str, List[str]] = {}
+    for t in id_types:
+        if id_vocabs is not None and t in id_vocabs:
+            vocab = list(id_vocabs[t])
+            lookup = {v: i for i, v in enumerate(vocab)}
+            ids[t] = np.asarray([lookup.get(v, -1) for v in raw_ids[t]], np.int32)
+        else:
+            vocab = sorted(set(raw_ids[t]))
+            lookup = {v: i for i, v in enumerate(vocab)}
+            ids[t] = np.asarray([lookup[v] for v in raw_ids[t]], np.int32)
+        vocabs[t] = vocab
+
+    shards = {}
+    for s, parts in shard_parts.items():
+        per_row = np.concatenate([p[0] for p in parts]) if parts else np.zeros(0, np.int64)
+        indices = np.concatenate([p[1] for p in parts]) if parts else np.zeros(0, np.int32)
+        values = np.concatenate([p[2] for p in parts]) if parts else np.zeros(0)
+        indptr = np.zeros(n_total + 1, np.int64)
+        np.cumsum(per_row, out=indptr[1:])
+        shards[s] = HostFeatures(
+            indptr, indices.astype(np.int32), values.astype(real_dtype()),
+            len(shard_index_maps[s]),
+        )
+    return GameData(
+        response=labels.astype(real_dtype()),
+        offset=np.concatenate(all_offsets).astype(real_dtype()),
+        weight=np.concatenate(all_weights).astype(real_dtype()),
         ids=ids,
         id_vocabs=vocabs,
         shards=shards,

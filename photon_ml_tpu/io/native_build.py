@@ -1,0 +1,75 @@
+"""Lazy g++ build + ctypes load for the native runtime pieces.
+
+One cached .so per (source file, content hash) under the user cache dir;
+any failure (no compiler, bad toolchain) degrades to ``None`` so every
+native component keeps a pure-Python fallback. Set PHOTON_ML_TPU_NATIVE=0
+to force the fallbacks (useful for differential testing).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import tempfile
+import zlib
+from typing import Callable, Optional
+
+NATIVE_ENV = "PHOTON_ML_TPU_NATIVE"
+
+_REPO_NATIVE = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))),
+    "native",
+)
+
+_cache: dict = {}
+
+
+def native_enabled() -> bool:
+    return os.environ.get(NATIVE_ENV, "1") not in ("0", "false", "no")
+
+
+def load_native_lib(
+    source_name: str,
+    configure: Callable[[ctypes.CDLL], None],
+    extra_flags: tuple = (),
+) -> Optional[ctypes.CDLL]:
+    """Compile native/<source_name> once (content-hashed cache) and load it;
+    ``configure`` sets restype/argtypes. Returns None on any failure."""
+    key = source_name
+    if key in _cache:
+        return _cache[key]
+    if not native_enabled():
+        _cache[key] = None
+        return None
+    try:
+        source = os.path.join(_REPO_NATIVE, source_name)
+        with open(source, "rb") as f:
+            # tag covers source AND flags: a flag fix must invalidate the
+            # cached .so even when the source is unchanged
+            tag = f"{zlib.crc32(f.read() + repr(extra_flags).encode()):08x}"
+        stem = os.path.splitext(source_name)[0]
+        cache_dir = os.path.join(
+            os.environ.get("XDG_CACHE_HOME", os.path.expanduser("~/.cache")),
+            "photon_ml_tpu",
+        )
+        os.makedirs(cache_dir, exist_ok=True)
+        lib_path = os.path.join(cache_dir, f"lib{stem}-{tag}.so")
+        if not os.path.exists(lib_path):
+            with tempfile.TemporaryDirectory() as tmp:
+                tmp_lib = os.path.join(tmp, "out.so")
+                # libraries (-lz ...) must FOLLOW the source file or GNU ld
+                # drops them and the .so carries undefined symbols
+                subprocess.run(
+                    ["g++", "-O3", "-shared", "-fPIC", "-std=c++17",
+                     "-o", tmp_lib, source, *extra_flags],
+                    check=True,
+                    capture_output=True,
+                )
+                os.replace(tmp_lib, lib_path)
+        lib = ctypes.CDLL(lib_path)
+        configure(lib)
+        _cache[key] = lib
+    except Exception:  # noqa: BLE001 — fall back to pure Python
+        _cache[key] = None
+    return _cache[key]

@@ -1,0 +1,260 @@
+"""Native Avro decoder (native/avro_decoder.cpp + io/avro_native.py) —
+differential tests against the pure-Python codec (io/avro.py), which stays
+the source of truth. Covers record reconstruction, the columnar ingest fast
+paths in io/avro_data.py, and fallback behavior for unsupported shapes.
+"""
+
+import numpy as np
+import pytest
+
+from photon_ml_tpu.io import avro as avro_io
+from photon_ml_tpu.io import avro_data, avro_native, schemas
+from photon_ml_tpu.io.index_map import IndexMap, feature_key
+
+pytestmark = pytest.mark.skipif(
+    avro_native._load() is None, reason="no native toolchain"
+)
+
+
+TRAIN_SCHEMA = {
+    "name": "T", "namespace": "t", "type": "record", "fields": [
+        {"name": "uid", "type": ["null", "string"], "default": None},
+        {"name": "label", "type": "double"},
+        {"name": "features", "type": {"type": "array", "items": schemas.FEATURE}},
+        {"name": "offset", "type": ["null", "double"], "default": None},
+        {"name": "weight", "type": ["null", "double"], "default": None},
+        {
+            "name": "metadataMap",
+            "type": ["null", {"type": "map", "values": "string"}],
+            "default": None,
+        },
+        {"name": "count", "type": "long"},
+        {"name": "flag", "type": "boolean"},
+    ],
+}
+
+
+def _train_records(n=300, seed=0):
+    rng = np.random.default_rng(seed)
+    recs = []
+    for i in range(n):
+        recs.append({
+            "uid": None if i % 3 == 0 else f"u{i}",
+            "label": float(rng.normal()),
+            "features": [
+                {
+                    "name": f"f{j}",
+                    "term": "" if j % 2 else f"t{j}",
+                    "value": float(rng.normal()),
+                }
+                for j in range(int(rng.integers(0, 6)))
+            ],
+            "offset": None if i % 2 else float(rng.normal()),
+            "weight": None if i % 5 == 0 else float(i + 1),
+            "metadataMap": None if i % 4 == 0 else {"userId": f"user{i % 7}"},
+            "count": int(rng.integers(-10**12, 10**12)),
+            "flag": bool(i % 2),
+        })
+    return recs
+
+
+class TestRecordReconstruction:
+    def test_exact_match_training_shape(self, tmp_path):
+        recs = _train_records()
+        path = str(tmp_path / "t.avro")
+        avro_io.write_container(path, recs, TRAIN_SCHEMA)
+        nat = avro_native.iter_records(path)
+        assert nat is not None
+        assert nat == list(avro_io.read_container(path))
+
+    def test_exact_match_yahoo_music(self):
+        """Real reference data incl. a 6-branch scalar union response and a
+        (null,string) term union inside the features array."""
+        import os
+
+        y = ("/root/reference/photon-ml/src/integTest/resources/GameIntegTest/"
+             "input/test/yahoo-music-test.avro")
+        if not os.path.isfile(y):
+            pytest.skip("reference fixtures not mounted")
+        nat = avro_native.iter_records(y)
+        assert nat is not None
+        assert nat == list(avro_io.read_container(y))
+
+    def test_unsupported_shape_falls_back(self, tmp_path):
+        schema = {
+            "name": "E", "namespace": "t", "type": "record", "fields": [
+                {"name": "kind", "type": {
+                    "name": "K", "type": "enum", "symbols": ["A", "B"]}},
+            ],
+        }
+        path = str(tmp_path / "e.avro")
+        avro_io.write_container(path, [{"kind": "A"}], schema)
+        assert avro_native.iter_records(path) is None  # enum -> fallback
+        assert list(avro_io.read_container(path)) == [{"kind": "A"}]
+
+
+class TestColumnarIngestParity:
+    def _write(self, tmp_path, recs):
+        d = tmp_path / "data"
+        d.mkdir(exist_ok=True)
+        avro_io.write_container(str(d / "part-0.avro"), recs[: len(recs) // 2],
+                                TRAIN_SCHEMA)
+        avro_io.write_container(str(d / "part-1.avro"), recs[len(recs) // 2:],
+                                TRAIN_SCHEMA)
+        return str(d)
+
+    def _force_python(self, monkeypatch):
+        from photon_ml_tpu.io import native_build
+
+        monkeypatch.setenv(native_build.NATIVE_ENV, "0")
+        native_build._cache.clear()
+
+    def test_read_training_examples(self, tmp_path, monkeypatch):
+        recs = _train_records()
+        d = self._write(tmp_path, recs)
+        keys = avro_data.collect_feature_keys([d])
+        imap = IndexMap.build(keys, add_intercept=True)
+        fast = avro_data.read_training_examples([d], imap)
+
+        from photon_ml_tpu.io import native_build
+
+        self._force_python(monkeypatch)
+        slow = avro_data.read_training_examples([d], imap)
+        native_build._cache.clear()
+
+        np.testing.assert_array_equal(fast.labels, slow.labels)
+        np.testing.assert_array_equal(fast.indptr, slow.indptr)
+        np.testing.assert_array_equal(fast.indices, slow.indices)
+        np.testing.assert_array_equal(fast.values, slow.values)
+        np.testing.assert_array_equal(fast.offsets, slow.offsets)
+        np.testing.assert_array_equal(fast.weights, slow.weights)
+        assert fast.dim == slow.dim
+
+    def test_read_game_data(self, tmp_path, monkeypatch):
+        recs = _train_records()
+        # every record needs a userId: fill the metadataMap gaps by giving
+        # those records an id field via uid? -> use metadataMap only rows
+        for i, r in enumerate(recs):
+            if r["metadataMap"] is None:
+                r["metadataMap"] = {"userId": f"user{i % 5}"}
+        d = self._write(tmp_path, recs)
+        imaps = {"global": IndexMap.build(
+            avro_data.collect_feature_keys([d]), add_intercept=True)}
+        sections = {"global": ["features"]}
+
+        from photon_ml_tpu.io import native_build
+
+        fast = avro_data.read_game_data([d], imaps, sections, ["userId"])
+        self._force_python(monkeypatch)
+        slow = avro_data.read_game_data([d], imaps, sections, ["userId"])
+        native_build._cache.clear()
+
+        np.testing.assert_array_equal(fast.response, slow.response)
+        np.testing.assert_array_equal(fast.offset, slow.offset)
+        np.testing.assert_array_equal(fast.weight, slow.weight)
+        assert fast.id_vocabs == slow.id_vocabs
+        np.testing.assert_array_equal(fast.ids["userId"], slow.ids["userId"])
+        for s in imaps:
+            np.testing.assert_array_equal(fast.shards[s].indptr, slow.shards[s].indptr)
+            np.testing.assert_array_equal(fast.shards[s].indices, slow.shards[s].indices)
+            np.testing.assert_array_equal(fast.shards[s].values, slow.shards[s].values)
+
+    def test_read_game_data_id_field_and_vocab_reuse(self, tmp_path, monkeypatch):
+        """Numeric id FIELDS (yahoo style) + id_vocabs reuse (-1 for unseen)."""
+        schema = {
+            "name": "Y", "namespace": "t", "type": "record", "fields": [
+                {"name": "userId", "type": "int"},
+                {"name": "response", "type": "double"},
+                {"name": "features", "type": {"type": "array", "items": schemas.FEATURE}},
+            ],
+        }
+        rng = np.random.default_rng(3)
+        recs = [
+            {
+                "userId": int(rng.integers(0, 20)),
+                "response": float(rng.normal()),
+                "features": [{"name": "a", "term": "", "value": 1.0}],
+            }
+            for _ in range(100)
+        ]
+        d = tmp_path / "y"
+        d.mkdir()
+        avro_io.write_container(str(d / "p.avro"), recs, schema)
+        imaps = {"g": IndexMap.build([feature_key("a", "")], add_intercept=True)}
+        sections = {"g": ["features"]}
+        vocab = {"userId": ["1", "2", "3"]}
+
+        from photon_ml_tpu.io import native_build
+
+        fast = avro_data.read_game_data(
+            [str(d)], imaps, sections, ["userId"], id_vocabs=vocab)
+        self._force_python(monkeypatch)
+        slow = avro_data.read_game_data(
+            [str(d)], imaps, sections, ["userId"], id_vocabs=vocab)
+        native_build._cache.clear()
+        np.testing.assert_array_equal(fast.ids["userId"], slow.ids["userId"])
+        assert (fast.ids["userId"] == -1).any()  # unseen ids map to -1
+
+    def test_collect_feature_keys(self, tmp_path, monkeypatch):
+        recs = _train_records()
+        d = self._write(tmp_path, recs)
+        fast = avro_data.collect_feature_keys([d])
+        from photon_ml_tpu.io import native_build
+
+        self._force_python(monkeypatch)
+        slow = avro_data.collect_feature_keys([d])
+        native_build._cache.clear()
+        assert fast == slow
+
+
+class TestNativeGuards:
+    """The native fast paths must fail LOUDLY-or-fall-back, never silently
+    diverge from the python codecs (code-review r3 findings)."""
+
+    def test_long_beyond_2e53_falls_back_exactly(self, tmp_path):
+        schema = {
+            "name": "B", "namespace": "t", "type": "record", "fields": [
+                {"name": "bigId", "type": "long"},
+                {"name": "label", "type": "double"},
+            ],
+        }
+        recs = [{"bigId": (1 << 60) + 12345, "label": 1.0},
+                {"bigId": (1 << 60) + 12346, "label": 0.0}]
+        path = str(tmp_path / "b.avro")
+        avro_io.write_container(path, recs, schema)
+        # native decode must refuse (f64 would collapse the two ids)...
+        assert avro_native.iter_records(path) is None
+        # ...while the python codec stays exact
+        back = list(avro_io.read_container(path))
+        assert back[0]["bigId"] != back[1]["bigId"]
+        assert back == recs
+
+    def test_malformed_libsvm_value_falls_back_to_python_error(self, tmp_path):
+        from photon_ml_tpu.io import libsvm
+
+        f = tmp_path / "bad.txt"
+        f.write_text("1 2: 3.5\n")  # space after ':' — python raises
+        if libsvm._load_lsv_native() is None:
+            pytest.skip("no native toolchain")
+        with pytest.raises(ValueError):
+            libsvm.read_libsvm(str(f))
+
+    def test_libsvm_value_never_crosses_lines(self, tmp_path):
+        from photon_ml_tpu.io import libsvm
+
+        f = tmp_path / "cross.txt"
+        f.write_text("1 5:\n0 1:1.0\n")  # strtod must not steal line 2's label
+        if libsvm._load_lsv_native() is None:
+            pytest.skip("no native toolchain")
+        with pytest.raises(ValueError):
+            libsvm.read_libsvm(str(f))
+
+    def test_libsvm_index_overflow_raises(self, tmp_path):
+        from photon_ml_tpu.io import libsvm
+
+        f = tmp_path / "wide.txt"
+        f.write_text("1 3000000000:1.0\n")
+        if libsvm._load_lsv_native() is None:
+            pytest.skip("no native toolchain")
+        with pytest.raises(OverflowError):
+            libsvm.read_libsvm(str(f))

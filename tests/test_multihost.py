@@ -97,6 +97,52 @@ def test_two_process_fixed_effect_matches_single_process(tmp_path):
         coefs[0], np.asarray(model.coefficients.means), rtol=5e-4, atol=5e-5
     )
 
+    # entity parallelism across hosts: each host solved ITS 8-entity block;
+    # the per-host sums must match a single-process vmapped solve of the
+    # same seeded problem
+    re_stats = {}
+    for i, out in enumerate(outs):
+        line = [l for l in out.splitlines() if l.startswith("MHRE")][0]
+        re_stats[i] = {
+            kv.split("=")[0]: float(kv.split("=")[1])
+            for kv in line.split()[1:]
+            if kv.split("=")[0] in ("wsum", "ssum")
+        }
+
+    import jax.numpy as jnp2
+
+    from photon_ml_tpu.optim.common import OptimizerConfig
+    from photon_ml_tpu.optim.lbfgs import lbfgs_minimize_
+    from photon_ml_tpu.ops import losses as losses_mod
+    from photon_ml_tpu.ops.objective import GLMObjective
+
+    E, M, DR = 16, 6, 3
+    rng_re = np.random.default_rng(7)
+    x_all = rng_re.normal(size=(E, M, DR)).astype(np.float32)
+    w_true = rng_re.normal(size=(E, DR)).astype(np.float32)
+    z = np.einsum("emd,ed->em", x_all, w_true)
+    y_all = (1.0 / (1.0 + np.exp(-z)) > rng_re.random((E, M))).astype(np.float32)
+    obj = GLMObjective(losses_mod.logistic)
+    cfg = OptimizerConfig(max_iterations=25, tolerance=1e-9)
+
+    from photon_ml_tpu.ops.features import DenseFeatures
+    from photon_ml_tpu.ops.normalization import NormalizationContext
+    from photon_ml_tpu.ops.objective import GLMBatch
+
+    def solve_one(x_e, y_e):
+        batch = GLMBatch.create(DenseFeatures(x_e), y_e)
+        vg = lambda wt: obj.value_and_grad(wt, batch, NormalizationContext.identity(), 1.0)
+        return lbfgs_minimize_(vg, jnp2.zeros((DR,), jnp2.float32), cfg).coefficients
+
+    import jax
+
+    w_ref = np.asarray(jax.vmap(solve_one)(jnp2.asarray(x_all), jnp2.asarray(y_all)))
+    s_ref = np.einsum("emd,ed->em", x_all, w_ref)
+    for i in range(2):
+        sl = slice(i * 8, (i + 1) * 8)
+        assert re_stats[i]["wsum"] == pytest.approx(float(np.sum(w_ref[sl])), abs=2e-3)
+        assert re_stats[i]["ssum"] == pytest.approx(float(np.sum(s_ref[sl])), abs=2e-2)
+
 
 def test_single_process_context_defaults():
     """MultihostContext without jax.distributed: 1 process, coordinator,

@@ -195,3 +195,52 @@ class TestProfilerHooks:
         assert stage_dir.is_dir()
         # a trace run produces at least one artifact under the stage dir
         assert any(stage_dir.rglob("*")), "no profiler artifacts written"
+
+
+class TestNativeLibsvmParser:
+    """native/libsvm_parser.cpp fast path vs the pure-Python parser —
+    byte-identical CSR output (the data-loader half of the native runtime)."""
+
+    def _write(self, path):
+        path.write_text(
+            "1 1:0.5 3:-1.25 7:2e-3  # trailing comment\n"
+            "\n"
+            "-1 2:1.0\n"
+            "# full-line comment\n"
+            "1 1:3.5\n"
+            "-1 5:0.125 6:-0.5\n"
+        )
+
+    def test_differential_vs_python(self, tmp_path, monkeypatch):
+        import numpy as np
+
+        from photon_ml_tpu.io import libsvm, native_build
+
+        f = tmp_path / "data.txt"
+        self._write(f)
+        native_lib = libsvm._load_lsv_native()
+        if native_lib is None:
+            pytest.skip("no native toolchain")
+        ds_n = libsvm.read_libsvm(str(f))
+
+        monkeypatch.setenv(native_build.NATIVE_ENV, "0")
+        native_build._cache.clear()
+        ds_p = libsvm.read_libsvm(str(f))
+        native_build._cache.clear()  # don't leak the disabled state
+
+        np.testing.assert_array_equal(ds_n.labels, ds_p.labels)
+        np.testing.assert_array_equal(ds_n.indptr, ds_p.indptr)
+        np.testing.assert_array_equal(ds_n.indices, ds_p.indices)
+        np.testing.assert_array_equal(ds_n.values, ds_p.values)
+        assert ds_n.dim == ds_p.dim
+        # {-1,1} labels remapped to {0,1} on both paths
+        assert set(np.unique(ds_n.labels).tolist()) == {0.0, 1.0}
+
+    def test_zero_based_and_explicit_dim(self, tmp_path):
+        from photon_ml_tpu.io import libsvm
+
+        f = tmp_path / "zb.txt"
+        f.write_text("0 0:1.0 2:2.0\n1 1:3.0\n")
+        ds = libsvm.read_libsvm(str(f), zero_based=True, add_intercept=False, dim=5)
+        assert ds.dim == 5
+        assert ds.indices.tolist() == [0, 2, 1]
