@@ -289,6 +289,95 @@ def _bench_scoring(extra, on_tpu):
     extra["scoring_config"] = {"rows": n_rows, "entities": n_entities, "d": d, "nnz": k}
 
 
+def _bench_ingest(extra):
+    """Data-loader throughput: native C++ avro columnar ingest vs the pure
+    python codec on an identical synthetic GAME file (host-side; no
+    accelerator involved)."""
+    import os
+    import tempfile
+
+    from photon_ml_tpu.io import avro as avro_io
+    from photon_ml_tpu.io import avro_data, schemas
+    from photon_ml_tpu.io.index_map import IndexMap
+    from photon_ml_tpu.io import native_build
+
+    rng = np.random.default_rng(13)
+    n_rows, n_feats = 20000, 30
+    with tempfile.TemporaryDirectory() as tmp:
+        path = os.path.join(tmp, "part-0.avro")
+        feature_pool = [f"f{i}" for i in range(2000)]
+
+        def records():
+            for i in range(n_rows):
+                picks = rng.choice(2000, size=n_feats, replace=False)
+                yield {
+                    "uid": str(i),
+                    "label": float(rng.random() < 0.5),
+                    "features": [
+                        {"name": feature_pool[j], "term": "", "value": float(rng.normal())}
+                        for j in picks
+                    ],
+                    "offset": None,
+                    "weight": None,
+                    "metadataMap": {"userId": f"u{i % 500}"},
+                }
+
+        schema = {
+            "name": "Row", "namespace": "b", "type": "record", "fields": [
+                {"name": "uid", "type": ["null", "string"], "default": None},
+                {"name": "label", "type": "double"},
+                {"name": "features", "type": {"type": "array", "items": schemas.FEATURE}},
+                {"name": "offset", "type": ["null", "double"], "default": None},
+                {"name": "weight", "type": ["null", "double"], "default": None},
+                {"name": "metadataMap",
+                 "type": ["null", {"type": "map", "values": "string"}],
+                 "default": None},
+            ],
+        }
+        avro_io.write_container(path, records(), schema)
+        imaps = {"g": IndexMap.build(
+            avro_data.collect_feature_keys([path]), add_intercept=True)}
+        sections = {"g": ["features"]}
+
+        # the native path must actually be live (g++ built, columns decode)
+        # or the entry would silently report python-vs-python as a "native"
+        # result; the warm-up also keeps the one-time g++ compile of the
+        # decoder OUT of the timed region
+        from photon_ml_tpu.io import avro_native
+
+        if avro_native.read_columns(path) is None:
+            _log("ingest: native decoder unavailable; skipping ingest bench")
+            extra["ingest_native_unavailable"] = True
+            return
+
+        timings = {}
+        for mode in ("native", "python"):
+            prev = os.environ.pop("PHOTON_ML_TPU_NATIVE", None)
+            if mode == "python":
+                os.environ["PHOTON_ML_TPU_NATIVE"] = "0"
+            native_build._cache.clear()
+            try:
+                t0 = time.perf_counter()
+                gd = avro_data.read_game_data([path], imaps, sections, ["userId"])
+                timings[mode] = time.perf_counter() - t0
+            finally:
+                if prev is not None:
+                    os.environ["PHOTON_ML_TPU_NATIVE"] = prev
+                else:
+                    os.environ.pop("PHOTON_ML_TPU_NATIVE", None)
+                native_build._cache.clear()
+        rps = n_rows / timings["native"]
+        _log(
+            f"ingest: native {timings['native']:.2f}s vs python "
+            f"{timings['python']:.2f}s ({timings['python']/timings['native']:.1f}x), "
+            f"{rps:.0f} rows/s"
+        )
+        extra["ingest_rows_per_sec_native"] = round(rps, 1)
+        extra["ingest_speedup_vs_python"] = round(
+            timings["python"] / timings["native"], 2
+        )
+
+
 def _bench_game(extra, on_tpu):
     import jax.numpy as jnp
 
@@ -405,6 +494,10 @@ def main():
             _bench_scoring(extra, on_tpu)
         except Exception:
             errors["scoring"] = traceback.format_exc(limit=3)
+        try:
+            _bench_ingest(extra)
+        except Exception:
+            errors["ingest"] = traceback.format_exc(limit=3)
 
     payload = {
         "metric": METRIC,

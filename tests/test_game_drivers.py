@@ -434,6 +434,32 @@ class TestDistributedTraining:
             np.testing.assert_allclose(fac_d[eid], fac_l[eid], rtol=5e-3, atol=1e-3)
 
 
+class TestGridSearch:
+    def test_config_grid_selects_best_combo(self, game_avro_dirs, tmp_path):
+        """';'-separated optimization configs form a grid
+        (cli/game/training/Driver.scala:330-337): every combo trains, the
+        primary evaluator picks the best."""
+        train_dir, val_dir, _ = game_avro_dirs
+        flags = [f for f in COMMON_FLAGS]
+        i = flags.index("--fixed-effect-optimization-configurations")
+        # tiny vs huge fixed-effect regularization — the grid's best must
+        # beat (or tie) its worst
+        flags[i + 1] = "fixed:50,1e-7,0.01,1,LBFGS,L2;fixed:50,1e-7,1000,1,LBFGS,L2"
+        driver = game_training_driver.main(
+            [
+                "--train-input-dirs", train_dir,
+                "--validate-input-dirs", val_dir,
+                "--output-dir", str(tmp_path / "out"),
+                "--num-iterations", "1",
+            ]
+            + flags
+        )
+        assert len(driver.results) == 2
+        aucs = [m["AUC"] for _, _, m in driver.results]
+        assert driver.best_index == int(np.argmax(aucs))
+        assert aucs[0] > aucs[1] + 0.01  # lambda=1000 visibly hurts
+
+
 class TestDateRangeDiscovery:
     def test_training_with_daily_layout(self, game_avro_dirs, tmp_path):
         import shutil
