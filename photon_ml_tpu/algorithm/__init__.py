@@ -5,6 +5,17 @@ from photon_ml_tpu.algorithm.factored_random_effect import (
     MFOptimizationConfig,
 )
 from photon_ml_tpu.algorithm.fixed_effect import FixedEffectCoordinate
+from photon_ml_tpu.algorithm.bucketed_random_effect import (
+    BucketedRandomEffectCoordinate,
+)
 from photon_ml_tpu.algorithm.random_effect import RandomEffectCoordinate
 
-__all__ = ["CoordinateDescent", "FixedEffectCoordinate", "RandomEffectCoordinate"]
+__all__ = [
+    "BucketedRandomEffectCoordinate",
+    "CoordinateDescent",
+    "FactoredRandomEffectCoordinate",
+    "FactoredState",
+    "FixedEffectCoordinate",
+    "MFOptimizationConfig",
+    "RandomEffectCoordinate",
+]
