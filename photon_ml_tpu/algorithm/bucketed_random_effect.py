@@ -102,6 +102,46 @@ def partition_entities_by_size(
     ]
 
 
+@dataclasses.dataclass(frozen=True)
+class BucketedDatasetBundle:
+    """The device-independent, per-bucket dataset stacks — build ONCE per
+    (data, config) and share across grid combos (each combo's coordinate
+    only swaps optimizer/regularization around the same arrays)."""
+
+    buckets: List[np.ndarray]  # vocab-index entity sets, one per bucket
+    datasets: List[object]  # RandomEffectDataset per bucket
+    row_sels: List[np.ndarray]  # bucket rows -> global row index
+    dense_ids: List[np.ndarray]  # bucket rows -> dense (bucket-local) id
+    num_rows: int
+    vocab: List[str]
+
+    @staticmethod
+    def build(
+        data: GameData, config: RandomEffectDataConfig, max_buckets: int = 6
+    ) -> "BucketedDatasetBundle":
+        re_id = config.random_effect_id
+        ids = data.ids[re_id]
+        counts = np.bincount(ids, minlength=int(ids.max()) + 1 if len(ids) else 0)
+        buckets = partition_entities_by_size(counts, max_buckets)
+        datasets, row_sels, dense_ids = [], [], []
+        for entity_ids in buckets:
+            row_sel = np.nonzero(np.isin(ids, entity_ids))[0]
+            filtered = _filter_game_data(
+                data, re_id, config.feature_shard_id, row_sel, entity_ids
+            )
+            datasets.append(build_random_effect_dataset(filtered, config))
+            row_sels.append(row_sel)
+            dense_ids.append(filtered.ids[re_id])
+        return BucketedDatasetBundle(
+            buckets=buckets,
+            datasets=datasets,
+            row_sels=row_sels,
+            dense_ids=dense_ids,
+            num_rows=data.num_rows,
+            vocab=list(data.id_vocabs[re_id]),
+        )
+
+
 @dataclasses.dataclass
 class BucketedRandomEffectCoordinate:
     """Per-entity solves bucketed by entity size (coordinate protocol)."""
@@ -115,31 +155,68 @@ class BucketedRandomEffectCoordinate:
         default_factory=RegularizationContext.none
     )
     max_buckets: int = 6
+    bundle: Optional[BucketedDatasetBundle] = None  # prebuilt, shared
 
     def __post_init__(self):
-        re_id = self.config.random_effect_id
-        ids = self.data.ids[re_id]
-        counts = np.bincount(ids, minlength=int(ids.max()) + 1 if len(ids) else 0)
-        self.buckets = partition_entities_by_size(counts, self.max_buckets)
-        self._num_rows = self.data.num_rows
-        self._subs: List[RandomEffectCoordinate] = []
-        self._row_sels: List[np.ndarray] = []
-        for entity_ids in self.buckets:
-            row_sel = np.nonzero(np.isin(ids, entity_ids))[0]
-            filtered = _filter_game_data(
-                self.data, re_id, self.config.feature_shard_id, row_sel, entity_ids
+        if self.bundle is None:
+            self.bundle = BucketedDatasetBundle.build(
+                self.data, self.config, self.max_buckets
             )
-            ds = build_random_effect_dataset(filtered, self.config)
-            self._subs.append(
-                RandomEffectCoordinate(
-                    dataset=ds,
-                    task=self.task,
-                    optimizer=self.optimizer,
-                    optimizer_config=self.optimizer_config,
-                    regularization=self.regularization,
-                )
+        b = self.bundle
+        self.buckets = b.buckets
+        self._num_rows = b.num_rows
+        self._row_sels = b.row_sels
+        self._dense_ids = b.dense_ids
+        self._subs: List[RandomEffectCoordinate] = [
+            RandomEffectCoordinate(
+                dataset=ds,
+                task=self.task,
+                optimizer=self.optimizer,
+                optimizer_config=self.optimizer_config,
+                regularization=self.regularization,
             )
-            self._row_sels.append(row_sel)
+            for ds in b.datasets
+        ]
+
+    # -- exports for the driver (validation scoring / model save) -----------
+    def vocab_position_maps(self) -> Tuple[np.ndarray, np.ndarray]:
+        """Original id-vocab index -> (owning bucket, tensor position within
+        that bucket's stacked coefficients); -1/-1 where no model exists."""
+        v = len(self.data.id_vocabs[self.config.random_effect_id])
+        bucket_of = np.full(v, -1, np.int32)
+        pos_in_bucket = np.full(v, -1, np.int32)
+        for bi, (sub, entity_ids, dense_ids) in enumerate(
+            zip(self._subs, self.buckets, self._dense_ids)
+        ):
+            entity_pos = np.asarray(sub.dataset.entity_pos)
+            known = entity_pos >= 0
+            pos_of_dense = np.full(len(entity_ids), -1, np.int32)
+            pos_of_dense[dense_ids[known]] = entity_pos[known]
+            has = pos_of_dense >= 0
+            bucket_of[entity_ids[has]] = bi
+            pos_in_bucket[entity_ids[has]] = pos_of_dense[has]
+        return bucket_of, pos_in_bucket
+
+    def global_coefficient_stacks(self, state: Tuple[Array, ...]) -> List[Array]:
+        """Per-bucket (E_b, D_global) back-projected coefficient stacks
+        (RandomEffectModelInProjectedSpace.toRandomEffectModel per bucket)."""
+        from photon_ml_tpu.algorithm.random_effect import global_coefficients
+
+        return [
+            global_coefficients(sub.dataset, w)
+            for sub, w in zip(self._subs, state)
+        ]
+
+    def entity_means_by_raw_id(self, state: Tuple[Array, ...]):
+        """{raw entity id: dense global-space coefficient row} (model save)."""
+        vocab = self.bundle.vocab
+        bucket_of, pos_in_bucket = self.vocab_position_maps()
+        stacks = [np.asarray(s) for s in self.global_coefficient_stacks(state)]
+        out = {}
+        for vi, raw in enumerate(vocab):
+            if bucket_of[vi] >= 0:
+                out[raw] = stacks[bucket_of[vi]][pos_in_bucket[vi]]
+        return out
 
     # -- diagnostics --------------------------------------------------------
     @property

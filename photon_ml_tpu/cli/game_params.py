@@ -300,9 +300,17 @@ class GameTrainingParams:
     # compile each full coordinate-descent iteration as one XLA program
     # (fewer host dispatches; iteration-granular checkpoints)
     fused_cycle: bool = False
+    # size-bucketed per-entity solves (algorithm/bucketed_random_effect):
+    # per-bucket padding on skewed entity distributions; single-device only
+    bucketed_random_effects: bool = False
 
     def validate(self) -> None:
         errors = []
+        if self.bucketed_random_effects and self.distributed:
+            errors.append(
+                "--bucketed-random-effects is single-device only; it cannot "
+                "be combined with --distributed"
+            )
         if not self.train_input_dirs:
             errors.append("--train-input-dirs is required")
         if not self.output_dir:
@@ -379,6 +387,9 @@ def build_training_parser() -> argparse.ArgumentParser:
     a("--fused-cycle", default="false",
       help="compile each full coordinate-descent iteration as ONE XLA "
            "program (fewer host dispatches; iteration-granular checkpoints)")
+    a("--bucketed-random-effects", default="false",
+      help="partition random-effect entities into size buckets (per-bucket "
+           "padding instead of one global sample cap; single-device only)")
     return p
 
 
@@ -421,6 +432,7 @@ def parse_training_params(argv: Optional[List[str]] = None) -> GameTrainingParam
         checkpoint_dir=ns.checkpoint_dir,
         distributed=_truthy(ns.distributed),
         fused_cycle=_truthy(ns.fused_cycle),
+        bucketed_random_effects=_truthy(ns.bucketed_random_effects),
     )
     params.validate()
     return params

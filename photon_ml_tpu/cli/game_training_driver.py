@@ -115,9 +115,11 @@ class GameTrainingDriver:
         self.train_data: Optional[GameData] = None
         self.validation_data: Optional[GameData] = None
         self.re_datasets: Dict[str, object] = {}
+        self.bucketed_bundles: Dict[str, object] = {}  # --bucketed-random-effects
         self.fe_batches: Dict[str, object] = {}
         # combo results: (config map, CoordinateDescentResult, metrics)
         self.results: List[Tuple[Dict[str, CoordinateOptConfig], CoordinateDescentResult, Dict[str, float]]] = []
+        self.combo_coords: List[Dict[str, object]] = []  # per-combo coordinates
         self.best_index: int = 0
 
     # ------------------------------------------------------------------
@@ -225,6 +227,19 @@ class GameTrainingDriver:
                 cfg = RandomEffectDataConfig(
                     **{**cfg.__dict__, "projector": "IDENTITY"}
                 )
+            if p.bucketed_random_effects and name not in p.factored_configs:
+                # bucketed coordinates own per-bucket stacks — building the
+                # single globally-padded stack here would allocate exactly
+                # the memory bucketing exists to avoid. Build the shared
+                # bundle ONCE; combos reuse it.
+                from photon_ml_tpu.algorithm.bucketed_random_effect import (
+                    BucketedDatasetBundle,
+                )
+
+                self.bucketed_bundles[name] = BucketedDatasetBundle.build(
+                    self.train_data, cfg
+                )
+                continue
             self.re_datasets[name] = build_random_effect_dataset(self.train_data, cfg)
 
     # ------------------------------------------------------------------
@@ -294,6 +309,20 @@ class GameTrainingDriver:
                         fac, self._mesh_context()
                     )
                 coords[name] = fac
+            elif p.bucketed_random_effects:
+                from photon_ml_tpu.algorithm.bucketed_random_effect import (
+                    BucketedRandomEffectCoordinate,
+                )
+
+                coords[name] = BucketedRandomEffectCoordinate(
+                    self.train_data,
+                    p.random_effect_data_configs[name],
+                    p.task_type,
+                    optimizer=cfg.optimizer,
+                    optimizer_config=cfg.optimizer_config(),
+                    regularization=cfg.regularization_context(),
+                    bundle=self.bucketed_bundles[name],
+                )
             else:
                 re = RandomEffectCoordinate(
                     self.re_datasets[name],
@@ -365,14 +394,38 @@ class GameTrainingDriver:
                 cfg = p.random_effect_data_configs[name]
                 # padded per-row COO of validation rows in the GLOBAL space
                 cols, vals = padded_row_coo(vdata.shards[cfg.feature_shard_id])
-                pos_of_vocab = self._entity_position_of_vocab(name)
                 vocab_ids = vdata.ids[cfg.random_effect_id]
-                ent_pos = np.where(
-                    vocab_ids >= 0, pos_of_vocab[np.maximum(vocab_ids, 0)], -1
-                ).astype(np.int32)
-                re_info[name] = (
-                    jnp.asarray(cols), jnp.asarray(vals), jnp.asarray(ent_pos)
+                coord = coords.get(name)
+                from photon_ml_tpu.algorithm.bucketed_random_effect import (
+                    BucketedRandomEffectCoordinate,
                 )
+
+                if isinstance(coord, BucketedRandomEffectCoordinate):
+                    # map each validation row into the CONCATENATED stack:
+                    # bucket row offset + within-bucket tensor position
+                    bucket_of, pos_in_bucket = coord.vocab_position_maps()
+                    sizes = [s_.num_entities for s_ in coord._subs]
+                    offsets = np.concatenate([[0], np.cumsum(sizes)[:-1]]).astype(np.int32)
+                    safe_vid = np.maximum(vocab_ids, 0)
+                    b_of = bucket_of[safe_vid]
+                    p_in = pos_in_bucket[safe_vid]
+                    ent_pos = np.where(
+                        (vocab_ids >= 0) & (b_of >= 0) & (p_in >= 0),
+                        offsets[np.maximum(b_of, 0)] + p_in,
+                        -1,
+                    ).astype(np.int32)
+                    re_info[name] = (
+                        jnp.asarray(cols), jnp.asarray(vals),
+                        ("bucketed", coord, jnp.asarray(ent_pos)),
+                    )
+                else:
+                    pos_of_vocab = self._entity_position_of_vocab(name)
+                    ent_pos = np.where(
+                        vocab_ids >= 0, pos_of_vocab[np.maximum(vocab_ids, 0)], -1
+                    ).astype(np.int32)
+                    re_info[name] = (
+                        jnp.asarray(cols), jnp.asarray(vals), jnp.asarray(ent_pos)
+                    )
 
         def scorer(params_map):
             from photon_ml_tpu.algorithm.random_effect import global_coefficients
@@ -383,13 +436,23 @@ class GameTrainingDriver:
                 if name in fe_feats:
                     total = total + fe_feats[name].matvec(w)
                 else:
-                    ds = self.re_datasets[name]
-                    if isinstance(w, FactoredState):
-                        wg = w.v @ w.matrix  # (E, D_global): IDENTITY local space
+                    cols, vals, info = re_info[name]
+                    if isinstance(info, tuple) and info and info[0] == "bucketed":
+                        # concatenate the per-bucket stacks once: entity
+                        # position = bucket row offset + within-bucket pos,
+                        # then the SAME single gather as the plain path
+                        _, coord, ent_pos = info
+                        wg = jnp.concatenate(
+                            coord.global_coefficient_stacks(w), axis=0
+                        )
                     else:
-                        # distributed solves pad the entity axis; slice back
-                        wg = global_coefficients(ds, w[: ds.num_entities])
-                    cols, vals, ent_pos = re_info[name]
+                        ent_pos = info
+                        ds = self.re_datasets[name]
+                        if isinstance(w, FactoredState):
+                            wg = w.v @ w.matrix  # (E, D_global): IDENTITY local space
+                        else:
+                            # distributed solves pad the entity axis; slice back
+                            wg = global_coefficients(ds, w[: ds.num_entities])
                     safe_pos = jnp.maximum(ent_pos, 0)
                     safe_cols = jnp.maximum(cols, 0)
                     gathered = wg[safe_pos[:, None], safe_cols]
@@ -454,6 +517,7 @@ class GameTrainingDriver:
                         }
                     ),
                 )
+            self.combo_coords.append(coords)
             cd = CoordinateDescent(
                 coords, loss_fn, scorer, evaluators, fused_cycle=p.fused_cycle
             )
@@ -511,7 +575,8 @@ class GameTrainingDriver:
                 out[raw] = v[tp]
         return out
 
-    def save_models(self, output_dir: str, result: CoordinateDescentResult) -> None:
+    def save_models(self, output_dir: str, result: CoordinateDescentResult,
+                    combo_index: Optional[int] = None) -> None:
         p = self.params
         for name in p.updating_sequence:
             coeffs = result.coefficients[name]
@@ -526,12 +591,31 @@ class GameTrainingDriver:
                     feature_shard_id=spec.feature_shard_id,
                 )
             else:
+                from photon_ml_tpu.algorithm.bucketed_random_effect import (
+                    BucketedRandomEffectCoordinate,
+                )
+
+                if p.bucketed_random_effects:
+                    if combo_index is None or not (
+                        0 <= combo_index < len(self.combo_coords)
+                    ):
+                        raise ValueError(
+                            "save_models on a --bucketed-random-effects run "
+                            "needs the combo_index of the result being saved "
+                            "(the tuple-of-buckets coefficients are extracted "
+                            "through that combo's coordinate objects)"
+                        )
+                    coord = self.combo_coords[combo_index].get(name)
+                else:
+                    coord = None
                 cfg = p.random_effect_data_configs[name]
                 model_io.save_random_effect(
                     output_dir,
                     name,
                     p.task_type,
-                    self._entity_means_global(name, coeffs),
+                    coord.entity_means_by_raw_id(coeffs)
+                    if isinstance(coord, BucketedRandomEffectCoordinate)
+                    else self._entity_means_global(name, coeffs),
                     self.shard_index_maps[cfg.feature_shard_id],
                     random_effect_id=cfg.random_effect_id,
                     feature_shard_id=cfg.feature_shard_id,
@@ -566,14 +650,18 @@ class GameTrainingDriver:
                 self.train()
             if p.model_output_mode != ModelOutputMode.NONE:
                 best_dir = os.path.join(p.output_dir, BEST_MODEL_DIR)
-                self.save_models(best_dir, self.results[self.best_index][1])
+                self.save_models(
+                    best_dir, self.results[self.best_index][1], self.best_index
+                )
                 self.logger.info(
                     f"saved best model (combo {self.best_index}) to {best_dir}"
                 )
                 if p.model_output_mode == ModelOutputMode.ALL:
                     for i, (_, result, _) in enumerate(self.results):
                         self.save_models(
-                            os.path.join(p.output_dir, ALL_MODELS_DIR, str(i)), result
+                            os.path.join(p.output_dir, ALL_MODELS_DIR, str(i)),
+                            result,
+                            i,
                         )
             self.logger.info(self.timer.summary())
         finally:

@@ -144,3 +144,19 @@ class TestEquality:
         # converges in iteration 1; allow f32 jitter on the flat tail
         assert hist[-1] <= hist[0] * (1 + 1e-5)
         assert np.all(np.isfinite(np.asarray(result.total_scores)))
+
+
+def test_bucketed_plus_distributed_rejected():
+    """--bucketed-random-effects + --distributed must fail loudly at param
+    validation, not silently drop the bucketing."""
+    from photon_ml_tpu.cli.game_params import GameTrainingParams
+
+    params = GameTrainingParams(
+        train_input_dirs=["x"],
+        output_dir="y",
+        updating_sequence=["a"],
+        bucketed_random_effects=True,
+        distributed=True,
+    )
+    with pytest.raises(ValueError, match="single-device"):
+        params.validate()

@@ -434,6 +434,33 @@ class TestDistributedTraining:
             np.testing.assert_allclose(fac_d[eid], fac_l[eid], rtol=5e-3, atol=1e-3)
 
 
+class TestBucketedRandomEffects:
+    def test_bucketed_flag_matches_plain(self, trained, game_avro_dirs, tmp_path):
+        """--bucketed-random-effects: per-bucket entity stacks through the
+        full driver; metrics must match the plain per-entity path."""
+        from photon_ml_tpu.algorithm.bucketed_random_effect import (
+            BucketedRandomEffectCoordinate,
+        )
+
+        local_driver, _, _ = trained
+        train_dir, val_dir, _ = game_avro_dirs
+        driver = game_training_driver.main(
+            [
+                "--train-input-dirs", train_dir,
+                "--validate-input-dirs", val_dir,
+                "--output-dir", str(tmp_path / "out"),
+                "--num-iterations", "2",
+                "--bucketed-random-effects", "true",
+            ]
+            + COMMON_FLAGS
+        )
+        coords = driver._build_coordinates(driver.results[0][0])
+        assert isinstance(coords["per-user"], BucketedRandomEffectCoordinate)
+        _, _, metrics = driver.results[driver.best_index]
+        _, _, local_metrics = local_driver.results[local_driver.best_index]
+        assert metrics["AUC"] == pytest.approx(local_metrics["AUC"], abs=5e-3)
+
+
 class TestGridSearch:
     def test_config_grid_selects_best_combo(self, game_avro_dirs, tmp_path):
         """';'-separated optimization configs form a grid
