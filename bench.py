@@ -243,19 +243,37 @@ def _bench_sparse(extra, on_tpu):
     feats = SparseFeatures(
         jnp.asarray(indices), jnp.asarray(values, jnp.bfloat16), D_SPARSE
     )
-    batch = GLMBatch.create(feats, jnp.asarray(labels_h))
     obj = GLMObjective(losses.logistic)
     norm = NormalizationContext.identity()
+    labels = jnp.asarray(labels_h)
 
-    eps = _scan_throughput(
-        lambda w, b: obj.value_and_grad(w, b, norm, 0.1),
-        jnp.zeros((D_SPARSE,), jnp.float32),
-        n_sparse,
-        batch,
-        iters=10,
-    )
-    _log(f"sparse-wide (D={D_SPARSE}, nnz/row={K_SPARSE}): {eps:.3e} ex/s")
-    extra["sparse_wide_examples_per_sec"] = round(eps, 1)
+    # race the two transpose-action layouts: random scatter-add vs the
+    # sorted-segment-sum CSC view (with_transpose) — the scatter into a
+    # 2^20-wide gradient is the sparse regime's TPU-hostile op. The
+    # HEADLINE uses the layout PRODUCTION ingest picks (ops.features.
+    # auto_transpose: sorted on TPU in the wide regime, scatter elsewhere)
+    # so the recorded number is the rate the real driver achieves.
+    from photon_ml_tpu.ops.features import auto_transpose
+
+    auto_sorted = auto_transpose(feats).t_idx is not None
+    rates = {}
+    for layout, f in (("scatter", feats), ("sorted", feats.with_transpose())):
+        batch = GLMBatch.create(f, labels)
+        rates[layout] = _scan_throughput(
+            lambda w, b: obj.value_and_grad(w, b, norm, 0.1),
+            jnp.zeros((D_SPARSE,), jnp.float32),
+            n_sparse,
+            batch,
+            iters=10,
+        )
+        _log(
+            f"sparse-wide (D={D_SPARSE}, nnz/row={K_SPARSE}, {layout}): "
+            f"{rates[layout]:.3e} ex/s"
+        )
+    headline = rates["sorted" if auto_sorted else "scatter"]
+    extra["sparse_wide_examples_per_sec"] = round(headline, 1)
+    extra["sparse_wide_examples_per_sec_scatter"] = round(rates["scatter"], 1)
+    extra["sparse_wide_examples_per_sec_sorted"] = round(rates["sorted"], 1)
     extra["sparse_wide_config"] = {"n": n_sparse, "d": D_SPARSE, "nnz_per_row": K_SPARSE}
 
 

@@ -85,6 +85,9 @@ def _subsample(batch: GLMBatch, fraction: float, seed: int = 42) -> GLMBatch:
     else:
         from photon_ml_tpu.ops.features import SparseFeatures
 
+        # deliberately DROP any transpose layout: it covers the full row
+        # set, and this path row-SAMPLES (stale t_* would re-add dropped
+        # rows' contributions)
         feats = SparseFeatures(take(feats.indices), take(feats.values), feats.dim)
     return GLMBatch(feats, take(batch.labels), take(batch.offsets), take(batch.weights))
 

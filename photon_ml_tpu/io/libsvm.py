@@ -189,5 +189,7 @@ def to_batch(ds: HostDataset, dense: bool = False, pad_rows_to: int = 8) -> GLMB
         val = np.zeros((n_pad, k), real_dtype())
         idx[rows, slots] = ds.indices
         val[rows, slots] = ds.values
-        feats = SparseFeatures(jnp.asarray(idx), jnp.asarray(val), d)
+        from photon_ml_tpu.ops.features import auto_transpose
+
+        feats = auto_transpose(SparseFeatures(jnp.asarray(idx), jnp.asarray(val), d))
     return GLMBatch(feats, jnp.asarray(labels), jnp.asarray(off), jnp.asarray(w))

@@ -32,7 +32,7 @@ HessianVectorAggregator.scala:90-116 (re-derived algebra, batched here).
 from __future__ import annotations
 
 import dataclasses
-from typing import Union
+from typing import Optional, Union
 
 import jax
 import jax.numpy as jnp
@@ -128,9 +128,37 @@ class SparseFeatures:
 
     dim: int = dataclasses.field(metadata={"static": True})
 
+    # optional index-sorted transpose layout (``with_transpose()``): the
+    # gradient pass becomes a segment-sum over SORTED feature indices
+    # instead of a random scatter-add into a (dim,)-wide vector — the
+    # scatter is the TPU-hostile op in the sparse-wide regime (D ~ 2^20),
+    # a sorted segment sum lowers to sequential accumulation runs.
+    t_idx: Optional[Array] = None  # (nnz,) int32, sorted feature index
+    t_row: Optional[Array] = None  # (nnz,) int32, source row of each entry
+    t_val: Optional[Array] = None  # (nnz,) entry values in t_idx order
+
     @property
     def num_rows(self) -> int:
         return self.indices.shape[0]
+
+    def with_transpose(self) -> "SparseFeatures":
+        """Precompute the sorted transpose layout (host-side, once at
+        ingest — the analogue of building a CSC view)."""
+        import numpy as np
+
+        idx = np.asarray(self.indices).reshape(-1)
+        val = np.asarray(self.values).reshape(-1)
+        n, k = self.indices.shape
+        rows = np.repeat(np.arange(n, dtype=np.int32), k)
+        order = np.argsort(idx, kind="stable")
+        return SparseFeatures(
+            self.indices,
+            self.values,
+            self.dim,
+            t_idx=jnp.asarray(idx[order]),
+            t_row=jnp.asarray(rows[order]),
+            t_val=jnp.asarray(val[order]),
+        )
 
     def matvec(self, w: Array) -> Array:
         acc = _acc_dtype(self.values.dtype)
@@ -139,6 +167,12 @@ class SparseFeatures:
 
     def rmatvec(self, d: Array) -> Array:
         acc = _acc_dtype(self.values.dtype)
+        if self.t_idx is not None:
+            contrib = self.t_val.astype(acc) * d.astype(acc)[self.t_row]
+            return jax.ops.segment_sum(
+                contrib, self.t_idx, num_segments=self.dim,
+                indices_are_sorted=True,
+            )
         contrib = self.values.astype(acc) * d.astype(acc)[:, None]
         return jnp.zeros((self.dim,), acc).at[self.indices.reshape(-1)].add(
             contrib.reshape(-1)
@@ -146,6 +180,14 @@ class SparseFeatures:
 
     def sq_rmatvec(self, d: Array) -> Array:
         acc = _acc_dtype(self.values.dtype)
+        if self.t_idx is not None:
+            # Hessian-diagonal path (TRON/variance) rides the same sorted
+            # segment sum as rmatvec
+            contrib = jnp.square(self.t_val.astype(acc)) * d.astype(acc)[self.t_row]
+            return jax.ops.segment_sum(
+                contrib, self.t_idx, num_segments=self.dim,
+                indices_are_sorted=True,
+            )
         contrib = jnp.square(self.values.astype(acc)) * d.astype(acc)[:, None]
         return jnp.zeros((self.dim,), acc).at[self.indices.reshape(-1)].add(
             contrib.reshape(-1)
@@ -166,15 +208,22 @@ class SparseFeatures:
 
     def astype(self, dtype) -> "SparseFeatures":
         """Re-store the values in another dtype (bf16 for bandwidth)."""
-        return SparseFeatures(self.indices, self.values.astype(dtype), self.dim)
+        return SparseFeatures(
+            self.indices,
+            self.values.astype(dtype),
+            self.dim,
+            t_idx=self.t_idx,
+            t_row=self.t_row,
+            t_val=None if self.t_val is None else self.t_val.astype(dtype),
+        )
 
     # -- pytree protocol ----------------------------------------------------
     def tree_flatten(self):
-        return (self.indices, self.values), self.dim
+        return (self.indices, self.values, self.t_idx, self.t_row, self.t_val), self.dim
 
     @classmethod
     def tree_unflatten(cls, aux, children):
-        return cls(children[0], children[1], aux)
+        return cls(children[0], children[1], aux, *children[2:])
 
 
 Features = Union[DenseFeatures, SparseFeatures]
@@ -193,3 +242,19 @@ def from_scipy_like(rows, dim: int, dtype=jnp.float32) -> SparseFeatures:
         indices[i, : len(ix)] = ix
         values[i, : len(vs)] = vs
     return SparseFeatures(jnp.asarray(indices), jnp.asarray(values, dtype), dim)
+
+
+# production rule for the transpose layout: the sorted-segment-sum gradient
+# wins on TPU in the wide regime (random scatter into a 2^20-wide vector is
+# the hostile op there); on CPU the scatter is faster. Applied at ingest
+# (io/libsvm.to_batch) so drivers get it automatically.
+SPARSE_TRANSPOSE_MIN_DIM = 1 << 16
+
+
+def auto_transpose(feats: SparseFeatures) -> SparseFeatures:
+    """Build the CSC view when (wide feature space) and (running on TPU)."""
+    if feats.t_idx is not None or feats.dim < SPARSE_TRANSPOSE_MIN_DIM:
+        return feats
+    from photon_ml_tpu.ops.fused_glm import _on_tpu
+
+    return feats.with_transpose() if _on_tpu() else feats

@@ -104,10 +104,15 @@ def pad_rows(batch: GLMBatch, multiple: int) -> GLMBatch:
     if isinstance(feats, DenseFeatures):
         feats = DenseFeatures(_pad_array_leading(feats.matrix, target))
     elif isinstance(feats, SparseFeatures):
+        # the transpose layout stays valid unchanged: padding rows carry
+        # only zero values, which contribute nothing to the segment sums
         feats = SparseFeatures(
             _pad_array_leading(feats.indices, target, 0),
             _pad_array_leading(feats.values, target, 0.0),
             feats.dim,
+            t_idx=feats.t_idx,
+            t_row=feats.t_row,
+            t_val=feats.t_val,
         )
     else:
         raise TypeError(f"unsupported features type {type(feats)}")

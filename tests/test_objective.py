@@ -148,3 +148,63 @@ def test_normalization_back_transform(rng):
     w_raw = norm.model_to_original_space(w)
     margins_raw = obj.margins(w_raw, batch, NormalizationContext.identity())
     np.testing.assert_allclose(margins_normed, margins_raw, rtol=1e-4, atol=1e-4)
+
+
+class TestSortedTransposeLayout:
+    """SparseFeatures.with_transpose(): the sorted-segment-sum gradient
+    layout must match the scatter-add layout through the full objective."""
+
+    def test_value_and_grad_equal(self, rng):
+        import numpy as np
+
+        from photon_ml_tpu.ops import losses
+        from photon_ml_tpu.ops.features import SparseFeatures
+        from photon_ml_tpu.ops.normalization import NormalizationContext
+        from photon_ml_tpu.ops.objective import GLMBatch, GLMObjective
+
+        n, k, d = 400, 6, 5000
+        idx = jnp.asarray(rng.integers(0, d, size=(n, k)).astype(np.int32))
+        val = jnp.asarray(rng.normal(size=(n, k)).astype(np.float32))
+        y = jnp.asarray((rng.random(n) < 0.5).astype(np.float32))
+        w = jnp.asarray(rng.normal(size=d).astype(np.float32) * 0.1)
+        obj = GLMObjective(losses.logistic)
+        norm = NormalizationContext.identity()
+
+        plain = SparseFeatures(idx, val, d)
+        tr = plain.with_transpose()
+        v1, g1 = obj.value_and_grad(w, GLMBatch.create(plain, y), norm, 0.1)
+        v2, g2 = obj.value_and_grad(w, GLMBatch.create(tr, y), norm, 0.1)
+        assert float(v2) == pytest.approx(float(v1), rel=1e-6)
+        np.testing.assert_allclose(np.asarray(g2), np.asarray(g1), rtol=1e-5, atol=1e-6)
+
+    def test_solve_through_optimizer(self, rng):
+        import numpy as np
+
+        from photon_ml_tpu.ops.features import SparseFeatures
+        from photon_ml_tpu.ops.normalization import NormalizationContext
+        from photon_ml_tpu.ops.objective import GLMBatch
+        from photon_ml_tpu.ops.regularization import RegularizationContext
+        from photon_ml_tpu.optim.common import OptimizerConfig
+        from photon_ml_tpu.optim.problem import GLMOptimizationProblem
+        from photon_ml_tpu.types import OptimizerType, TaskType
+
+        n, k, d = 300, 5, 800
+        idx = jnp.asarray(rng.integers(0, d, size=(n, k)).astype(np.int32))
+        val = jnp.asarray(rng.normal(size=(n, k)).astype(np.float32))
+        y = jnp.asarray((rng.random(n) < 0.5).astype(np.float32))
+        problem = GLMOptimizationProblem(
+            TaskType.LOGISTIC_REGRESSION,
+            OptimizerType.LBFGS,
+            OptimizerConfig(max_iterations=25, tolerance=1e-9),
+            RegularizationContext.l2(1.0),
+        )
+        norm = NormalizationContext.identity()
+        m1, _ = problem.run(GLMBatch.create(SparseFeatures(idx, val, d), y), norm)
+        m2, _ = problem.run(
+            GLMBatch.create(SparseFeatures(idx, val, d).with_transpose(), y), norm
+        )
+        np.testing.assert_allclose(
+            np.asarray(m2.coefficients.means),
+            np.asarray(m1.coefficients.means),
+            rtol=1e-4, atol=1e-5,
+        )
