@@ -223,3 +223,35 @@ def test_fused_cycle_checkpoint_iteration_granularity(glmix, tmp_path):
     np.testing.assert_array_equal(
         np.asarray(resumed.total_scores), np.asarray(full.total_scores)
     )
+
+
+def test_fused_resume_rejects_mid_iteration_checkpoint(glmix, tmp_path):
+    """A per-update checkpoint taken MID-iteration cannot resume into
+    fused-cycle mode (which replays whole iterations): the guard must raise
+    with guidance instead of silently recomputing or skipping updates."""
+    import shutil
+
+    from photon_ml_tpu.checkpoint import CoordinateDescentCheckpointer
+
+    data, _ = glmix
+    n = data.num_rows
+    labels = jnp.asarray(data.response)
+    loss_fn = lambda scores: jnp.sum(losses.logistic.loss(scores, labels))
+
+    fixed, random = build_coordinates(data)
+    ck_dir = str(tmp_path / "ck")
+    cd = CoordinateDescent({"fixed": fixed, "random": random}, loss_fn)
+    cd.run(num_iterations=1, num_rows=n,
+           checkpointer=CoordinateDescentCheckpointer(ck_dir, run_fingerprint="x"))
+    # drop the iteration-final checkpoint so only the mid-iteration one
+    # (after coordinate 1 of 2) remains
+    shutil.rmtree(str(tmp_path / "ck" / "step-2"))
+    ck = CoordinateDescentCheckpointer(ck_dir, run_fingerprint="x")
+    assert ck.latest_step() == 1
+
+    fixed2, random2 = build_coordinates(data)
+    cd_fused = CoordinateDescent(
+        {"fixed": fixed2, "random": random2}, loss_fn, fused_cycle=True
+    )
+    with pytest.raises(ValueError, match="mid-iteration"):
+        cd_fused.run(num_iterations=1, num_rows=n, checkpointer=ck)
