@@ -54,7 +54,20 @@ def _re_gather_contrib_impl(slab, ent_pos, idx, vals):
     return jnp.sum(jnp.where(valid, gathered * vals, 0.0), axis=-1)
 
 
+def _factored_contrib_impl(latent, matrix, ent_pos, idx, vals):
+    """Factored scoring straight from the LATENT structure: xp_n = sum_j
+    val_nj * M[:, col_nj], score_n = xp_n . latent[ent_pos_n] — the (E, k)
+    factors + (k, D) matrix never get flattened to (E, D)
+    (FactoredRandomEffectCoordinate.score semantics over saved models)."""
+    safe_e = jnp.maximum(ent_pos, 0)
+    m_cols = matrix.T[idx]  # (N, K, k)
+    xp = jnp.sum(m_cols * vals[:, :, None], axis=1)  # (N, k)
+    contrib = jnp.sum(xp * latent[safe_e], axis=-1)
+    return jnp.where(ent_pos >= 0, contrib, 0.0)
+
+
 _re_gather_contrib = None  # jitted lazily (keeps module import off-device)
+_factored_contrib = None
 
 
 def _get_re_gather():
@@ -64,6 +77,35 @@ def _get_re_gather():
 
         _re_gather_contrib = jax.jit(_re_gather_contrib_impl)
     return _re_gather_contrib
+
+
+def _get_factored_contrib():
+    global _factored_contrib
+    if _factored_contrib is None:
+        import jax
+
+        _factored_contrib = jax.jit(_factored_contrib_impl)
+    return _factored_contrib
+
+
+def _entity_positions(vocab, by_raw_id, ids, fallback_width):
+    """Stack the per-entity vectors present in ``by_raw_id`` and map each
+    data row's vocab id to its stack position (-1 = no model, scores 0 —
+    RandomEffectModel.scala:129-158 semantics)."""
+    pos = np.full(len(vocab), -1, np.int32)
+    rows = []
+    for vi, raw in enumerate(vocab):
+        vec = by_raw_id.get(raw)
+        if vec is not None:
+            pos[vi] = len(rows)
+            rows.append(vec)
+    stacked = (
+        np.stack(rows).astype(np.float32)
+        if rows
+        else np.zeros((1, fallback_width), np.float32)
+    )
+    ent_pos = np.where(ids >= 0, pos[np.maximum(ids, 0)], -1).astype(np.int32)
+    return stacked, ent_pos, len(rows)
 
 
 class GameScoringDriver:
@@ -184,34 +226,67 @@ class GameScoringDriver:
             self.logger.info(f"fixed effect {name!r} applied (device)")
 
         for name, re_id, shard in random:
+            vocab = data.id_vocabs[re_id]
+            feats = _padded_sparse(data.shards[shard])
+            if model_io.is_factored_random_effect(p.game_model_input_dir, name):
+                # latent-native scoring: (E, k) factors + (k, D) matrix — the
+                # flattened (E, D) slab is never materialized. The matrix
+                # columns are positional in the TRAINING feature space;
+                # realign them by NAME to this run's index map (which may
+                # have been rebuilt from the scoring inputs).
+                factors, matrix, _, _ = model_io.load_factored_random_effect(
+                    p.game_model_input_dir, name
+                )
+                train_keys = model_io.load_latent_matrix_feature_keys(
+                    p.game_model_input_dir, name
+                )
+                imap = self.shard_index_maps[shard]
+                if train_keys is None:
+                    if len(imap) != matrix.shape[1]:
+                        raise ValueError(
+                            f"factored model {name!r} predates the "
+                            "latent-matrix feature binding and this run's "
+                            f"index map has {len(imap)} features vs the "
+                            f"matrix's {matrix.shape[1]} columns — cannot "
+                            "align; rebuild the model or pass the training "
+                            "offheap index maps"
+                        )
+                    matrix_aligned = matrix.astype(np.float32)
+                else:
+                    matrix_aligned = np.zeros(
+                        (matrix.shape[0], len(imap)), np.float32
+                    )
+                    for j, key in enumerate(train_keys):
+                        tgt = imap.get_index(key)
+                        if tgt < 0 and key.endswith("\x01"):
+                            # empty-term fallback, e.g. the (INTERCEPT)
+                            # pseudo-feature stored without a delimiter
+                            tgt = imap.get_index(key[:-1])
+                        if tgt >= 0:
+                            matrix_aligned[:, tgt] = matrix[:, j]
+                latent, ent_pos, matched = _entity_positions(
+                    vocab, factors, data.ids[re_id], matrix.shape[0]
+                )
+                total = total + _get_factored_contrib()(
+                    jnp.asarray(latent), jnp.asarray(matrix_aligned),
+                    jnp.asarray(ent_pos), feats.indices, feats.values,
+                )
+                self.logger.info(
+                    f"factored random effect {name!r}: {matched}/{len(vocab)} "
+                    "entities matched (device, latent-native)"
+                )
+                continue
             entity_means, _, _, _ = model_io.load_random_effect(
                 p.game_model_input_dir, name, self.shard_index_maps[shard]
             )
-            feats = _padded_sparse(data.shards[shard])
-            vocab = data.id_vocabs[re_id]
-            # stack per-entity models into an (E_matched, D) slab; entities
-            # without a model keep position -1 and their rows score 0
-            # (RandomEffectModel.scala:129-158 semantics)
-            pos = np.full(len(vocab), -1, np.int32)
-            rows = []
-            for vi, raw in enumerate(vocab):
-                w_row = entity_means.get(raw)
-                if w_row is not None:
-                    pos[vi] = len(rows)
-                    rows.append(w_row)
-            slab = (
-                np.stack(rows).astype(np.float32)
-                if rows
-                else np.zeros((1, feats.dim), np.float32)
+            slab, ent_pos, matched = _entity_positions(
+                vocab, entity_means, data.ids[re_id], feats.dim
             )
-            ent_pos = np.where(
-                data.ids[re_id] >= 0, pos[np.maximum(data.ids[re_id], 0)], -1
-            ).astype(np.int32)
             total = total + _get_re_gather()(
                 jnp.asarray(slab), jnp.asarray(ent_pos), feats.indices, feats.values
             )
             self.logger.info(
-                f"random effect {name!r}: {len(rows)}/{len(vocab)} entities "
+                f"random effect {name!r}: {matched}/{len(vocab)} entities "
                 "matched (device)"
             )
         return np.asarray(jax.device_get(total))

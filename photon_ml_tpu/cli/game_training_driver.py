@@ -635,6 +635,7 @@ class GameTrainingDriver:
                         random_effect_id=cfg.random_effect_id,
                         feature_shard_id=cfg.feature_shard_id,
                         num_files=p.num_output_files_re_model,
+                        index_map=self.shard_index_maps[cfg.feature_shard_id],
                     )
 
     # ------------------------------------------------------------------

@@ -233,6 +233,9 @@ def load_matrix_factorization(input_dir: str, row_effect_type: str,
     return load_latent_factors(row_path), load_latent_factors(col_path)
 
 
+LATENT_MATRIX_FEATURES = "latent-matrix-features"
+
+
 def save_factored_random_effect(
     output_dir: str,
     name: str,
@@ -241,6 +244,7 @@ def save_factored_random_effect(
     random_effect_id: str = "",
     feature_shard_id: str = "",
     num_files: int = 1,
+    index_map: Optional[IndexMap] = None,
 ) -> None:
     """Persist a factored random effect WITHOUT flattening: per-entity latent
     coefficients as LatentFactorAvro (effectId = raw entity id) plus the
@@ -258,6 +262,17 @@ def save_factored_random_effect(
         os.path.join(base, LATENT_MATRIX),
         {str(k): matrix[k] for k in range(matrix.shape[0])},
     )
+    if index_map is not None:
+        # the matrix columns are POSITIONAL in the training feature space;
+        # persist the column->feature-key binding so a consumer with a
+        # different index map (e.g. a scoring run that rebuilt its map from
+        # scoring inputs) can realign columns by NAME instead of silently
+        # reading the wrong ones
+        with open(os.path.join(base, LATENT_MATRIX_FEATURES), "w") as f:
+            for j in range(matrix.shape[1]):
+                key = index_map.get_feature_name(j) or str(j)
+                nm, term = _split_key(key)
+                f.write(f"{nm}\t{term}\n")
 
 
 def load_factored_random_effect(input_dir: str, name: str
@@ -272,6 +287,23 @@ def load_factored_random_effect(input_dir: str, name: str
     rows = load_latent_factors(os.path.join(base, LATENT_MATRIX))
     matrix = np.stack([rows[str(k)] for k in range(len(rows))])
     return factors, matrix, re_id, shard
+
+
+def load_latent_matrix_feature_keys(input_dir: str, name: str):
+    """Training-order feature keys of the latent matrix columns, or None
+    when the model predates the binding file."""
+    path = os.path.join(input_dir, RANDOM_EFFECT, name, LATENT_MATRIX_FEATURES)
+    if not os.path.isfile(path):
+        return None
+    keys = []
+    with open(path) as f:
+        for line in f:
+            nm, _, term = line.rstrip("\n").partition("\t")
+            # ALWAYS the delimiter form — feature_key(name, "") is
+            # "name\x01", not bare "name" (a bare key would miss every
+            # empty-term feature in the index map)
+            keys.append(f"{nm}{DELIMITER}{term}")
+    return keys
 
 
 def is_factored_random_effect(input_dir: str, name: str) -> bool:

@@ -198,6 +198,46 @@ class TestFactoredModelPersistence:
         np.testing.assert_allclose(s_loaded, s_mem, rtol=1e-6, atol=1e-6)
 
 
+class TestFactoredLatentScoring:
+    def test_device_latent_scoring_matches_host_flattened(
+        self, game_avro_dirs, tmp_path
+    ):
+        """Scoring a saved factored model: the device path consumes the
+        LATENT structure (factors + matrix, never flattened) and must equal
+        the host oracle that scores the projected-back coefficients."""
+        train_dir, val_dir, base = game_avro_dirs
+        out = os.path.join(base, "factored-for-scoring")
+        flags = [f for f in COMMON_FLAGS]
+        i = flags.index("--random-effect-optimization-configurations")
+        del flags[i : i + 2]
+        game_training_driver.main(
+            [
+                "--train-input-dirs", train_dir,
+                "--validate-input-dirs", val_dir,
+                "--output-dir", out,
+                "--num-iterations", "1",
+                "--factored-random-effect-optimization-configurations",
+                "per-user:20,1e-6,0.1,1,LBFGS,L2:20,1e-6,0.1,1,LBFGS,L2:2,2",
+            ]
+            + flags
+        )
+        common = [
+            "--input-dirs", val_dir,
+            "--game-model-input-dir", os.path.join(out, "best"),
+            "--feature-shard-id-to-feature-section-keys-map",
+            "global:fixedFeatures|per_user:userFeatures",
+            "--delete-output-dir-if-exists", "true",
+        ]
+        dev = game_scoring_driver.main(
+            ["--output-dir", str(tmp_path / "dev")] + common
+        )
+        host = game_scoring_driver.main(
+            ["--output-dir", str(tmp_path / "host"), "--host-scoring", "true"]
+            + common
+        )
+        np.testing.assert_allclose(dev.scores, host.scores, rtol=1e-4, atol=1e-5)
+
+
 class TestGameTraining:
     def test_validation_auc(self, trained):
         driver, _, _ = trained
@@ -670,3 +710,26 @@ class TestGameConfigParsing:
         evs = parse_evaluators("AUC,RMSE,PRECISION@5:documentId")
         assert evs[0][0].value == "AUC"
         assert evs[2][1] == 5 and evs[2][2] == "documentId"
+
+
+class TestCombinedModes:
+    def test_bucketed_plus_fused_cycle(self, trained, game_avro_dirs, tmp_path):
+        """--bucketed-random-effects composes with --fused-cycle: the whole
+        per-bucket update sequence traces into one XLA program per
+        iteration and still matches the plain run."""
+        local_driver, _, _ = trained
+        train_dir, val_dir, _ = game_avro_dirs
+        driver = game_training_driver.main(
+            [
+                "--train-input-dirs", train_dir,
+                "--validate-input-dirs", val_dir,
+                "--output-dir", str(tmp_path / "out"),
+                "--num-iterations", "2",
+                "--bucketed-random-effects", "true",
+                "--fused-cycle", "true",
+            ]
+            + COMMON_FLAGS
+        )
+        _, _, metrics = driver.results[driver.best_index]
+        _, _, local_metrics = local_driver.results[local_driver.best_index]
+        assert metrics["AUC"] == pytest.approx(local_metrics["AUC"], abs=5e-3)
