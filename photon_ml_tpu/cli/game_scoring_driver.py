@@ -251,6 +251,14 @@ class GameScoringDriver:
                             "align; rebuild the model or pass the training "
                             "offheap index maps"
                         )
+                    self.logger.warning(
+                        f"factored model {name!r} has no latent-matrix "
+                        "feature binding: assuming this run's index map "
+                        "matches the training map POSITIONALLY (same size "
+                        "only proves length, not order) — scores are wrong "
+                        "if the feature sets differ; rebuild the model to "
+                        "get the binding"
+                    )
                     matrix_aligned = matrix.astype(np.float32)
                 else:
                     matrix_aligned = np.zeros(

@@ -267,12 +267,16 @@ def save_factored_random_effect(
         # persist the column->feature-key binding so a consumer with a
         # different index map (e.g. a scoring run that rebuilt its map from
         # scoring inputs) can realign columns by NAME instead of silently
-        # reading the wrong ones
+        # reading the wrong ones. JSON: feature names/terms are arbitrary
+        # strings (tabs/newlines legal), so a line format would corrupt
+        pairs = []
+        for j in range(matrix.shape[1]):
+            key = index_map.get_feature_name(j) or str(j)
+            pairs.append(list(_split_key(key)))
+        import json as _json
+
         with open(os.path.join(base, LATENT_MATRIX_FEATURES), "w") as f:
-            for j in range(matrix.shape[1]):
-                key = index_map.get_feature_name(j) or str(j)
-                nm, term = _split_key(key)
-                f.write(f"{nm}\t{term}\n")
+            _json.dump({"columns": pairs}, f)
 
 
 def load_factored_random_effect(input_dir: str, name: str
@@ -292,18 +296,16 @@ def load_factored_random_effect(input_dir: str, name: str
 def load_latent_matrix_feature_keys(input_dir: str, name: str):
     """Training-order feature keys of the latent matrix columns, or None
     when the model predates the binding file."""
+    import json as _json
+
     path = os.path.join(input_dir, RANDOM_EFFECT, name, LATENT_MATRIX_FEATURES)
     if not os.path.isfile(path):
         return None
-    keys = []
     with open(path) as f:
-        for line in f:
-            nm, _, term = line.rstrip("\n").partition("\t")
-            # ALWAYS the delimiter form — feature_key(name, "") is
-            # "name\x01", not bare "name" (a bare key would miss every
-            # empty-term feature in the index map)
-            keys.append(f"{nm}{DELIMITER}{term}")
-    return keys
+        pairs = _json.load(f)["columns"]
+    # ALWAYS the delimiter form — feature_key(name, "") is "name\x01", not
+    # bare "name" (a bare key would miss every empty-term feature)
+    return [f"{nm}{DELIMITER}{term}" for nm, term in pairs]
 
 
 def is_factored_random_effect(input_dir: str, name: str) -> bool:

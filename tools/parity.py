@@ -549,12 +549,12 @@ def run_config_game(results, fast):
     train, val = _split_yahoo(tmp)
     lam_f, lam_re = 10.0, 1.0
     iters = 2
-    t0 = time.time()
-    driver = game_main([
+    # ONE base config shared by the plain and alternate-execution runs so
+    # the mode-invariance comparison can never drift onto different configs
+    base_args = [
         "--train-input-dirs", os.path.join(tmp, "train"),
         "--validate-input-dirs", os.path.join(tmp, "validation"),
         "--task-type", "LINEAR_REGRESSION",
-        "--output-dir", os.path.join(tmp, "output"),
         "--updating-sequence", "global,per-user,per-song",
         "--feature-shard-id-to-feature-section-keys-map",
         "shard1:features|shard2:userFeatures|shard3:songFeatures",
@@ -569,11 +569,29 @@ def run_config_game(results, fast):
         "per-song:songId,shard3,2,-1,0,-1,index_map",
         "--num-iterations", str(iters),
         "--delete-output-dir-if-exists", "true",
-    ])
+    ]
+    t0 = time.time()
+    driver = game_main(base_args + ["--output-dir", os.path.join(tmp, "output")])
     wall = time.time() - t0
     _, result, metrics = driver.results[driver.best_index]
     ours_obj = float(result.objective_history[-1])
     ours_rmse = float(metrics["RMSE"])
+
+    # the execution-mode flags must not change the math: re-run the SAME
+    # config through fused-cycle CD + size-bucketed random effects and hold
+    # both to the plain run at f64 tightness
+    alt = game_main(
+        base_args
+        + ["--output-dir", os.path.join(tmp, "output-alt"),
+           "--fused-cycle", "true", "--bucketed-random-effects", "true"]
+    )
+    _, alt_result, alt_metrics = alt.results[alt.best_index]
+    alt_obj = float(alt_result.objective_history[-1])
+    alt_rmse = float(alt_metrics["RMSE"])
+    # f64 tightness with room for bucketed reduction-order wiggle
+    assert abs(alt_obj - ours_obj) / abs(ours_obj) < 1e-7, (alt_obj, ours_obj)
+    assert abs(alt_rmse - ours_rmse) < 1e-6, (alt_rmse, ours_rmse)
+    print("fused-cycle + bucketed modes: objective/RMSE identical", flush=True)
 
     ref_obj, ref_rmse = _game_oracle(train, val, lam_f, lam_re, iters)
     results.append(dict(
