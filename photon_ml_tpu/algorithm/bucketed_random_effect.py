@@ -156,6 +156,10 @@ class BucketedRandomEffectCoordinate:
     )
     max_buckets: int = 6
     bundle: Optional[BucketedDatasetBundle] = None  # prebuilt, shared
+    # when set, every bucket's vmapped solve is ALSO entity-sharded over the
+    # mesh (DistributedRandomEffectSolver per bucket): bucketing handles the
+    # size skew, sharding handles the scale — the two axes compose
+    mesh_ctx: Optional[object] = None  # parallel.mesh.MeshContext
 
     def __post_init__(self):
         if self.bundle is None:
@@ -177,6 +181,16 @@ class BucketedRandomEffectCoordinate:
             )
             for ds in b.datasets
         ]
+        self._solvers = None
+        if self.mesh_ctx is not None:
+            from photon_ml_tpu.parallel.distributed import (
+                DistributedRandomEffectSolver,
+            )
+
+            self._solvers = [
+                DistributedRandomEffectSolver(sub, self.mesh_ctx)
+                for sub in self._subs
+            ]
 
     # -- exports for the driver (validation scoring / model save) -----------
     def vocab_position_maps(self) -> Tuple[np.ndarray, np.ndarray]:
@@ -199,11 +213,12 @@ class BucketedRandomEffectCoordinate:
 
     def global_coefficient_stacks(self, state: Tuple[Array, ...]) -> List[Array]:
         """Per-bucket (E_b, D_global) back-projected coefficient stacks
-        (RandomEffectModelInProjectedSpace.toRandomEffectModel per bucket)."""
+        (RandomEffectModelInProjectedSpace.toRandomEffectModel per bucket).
+        Distributed solves pad the entity axis; slice back to E_b first."""
         from photon_ml_tpu.algorithm.random_effect import global_coefficients
 
         return [
-            global_coefficients(sub.dataset, w)
+            global_coefficients(sub.dataset, w[: sub.dataset.num_entities])
             for sub, w in zip(self._subs, state)
         ]
 
@@ -229,8 +244,11 @@ class BucketedRandomEffectCoordinate:
         return sum(int(np.prod(s.dataset.x.shape)) for s in self._subs)
 
     # -- coordinate protocol ------------------------------------------------
+    def _units(self):
+        return self._solvers if self._solvers is not None else self._subs
+
     def initial_coefficients(self) -> Tuple[Array, ...]:
-        return tuple(s.initial_coefficients() for s in self._subs)
+        return tuple(u.initial_coefficients() for u in self._units())
 
     def update(
         self, residual_offsets: Array, state: Tuple[Array, ...]
@@ -240,21 +258,27 @@ class BucketedRandomEffectCoordinate:
         buckets are disjoint entity sets, so no cross-bucket coupling."""
         new_state = []
         results = []
-        for sub, row_sel, w0 in zip(self._subs, self._row_sels, state):
+        for unit, row_sel, w0 in zip(self._units(), self._row_sels, state):
             local_resid = residual_offsets[jnp.asarray(row_sel)]
-            coefs, res = sub.update(local_resid, w0)
+            coefs, res = unit.update(local_resid, w0)
             new_state.append(coefs)
             results.append(res)
         return tuple(new_state), tuple(results)
 
     def score(self, state: Tuple[Array, ...]) -> Array:
         total = jnp.zeros((self._num_rows,), real_dtype())
-        for sub, row_sel, w in zip(self._subs, self._row_sels, state):
-            total = total.at[jnp.asarray(row_sel)].set(sub.score(w))
+        for unit, row_sel, w in zip(self._units(), self._row_sels, state):
+            total = total.at[jnp.asarray(row_sel)].set(unit.score(w))
         return total
 
     def regularization_term(self, state: Tuple[Array, ...]) -> Array:
+        # slice distributed padding off: padded entities hold zeros, but
+        # slicing keeps the term exact by construction rather than by
+        # convergence
         return sum(
-            (s.regularization_term(w) for s, w in zip(self._subs, state)),
+            (
+                sub.regularization_term(w[: sub.dataset.num_entities])
+                for sub, w in zip(self._subs, state)
+            ),
             jnp.asarray(0.0, real_dtype()),
         )

@@ -301,16 +301,12 @@ class GameTrainingParams:
     # (fewer host dispatches; iteration-granular checkpoints)
     fused_cycle: bool = False
     # size-bucketed per-entity solves (algorithm/bucketed_random_effect):
-    # per-bucket padding on skewed entity distributions; single-device only
+    # per-bucket padding on skewed entity distributions; composes with
+    # --distributed (each bucket entity-shards over the mesh)
     bucketed_random_effects: bool = False
 
     def validate(self) -> None:
         errors = []
-        if self.bucketed_random_effects and self.distributed:
-            errors.append(
-                "--bucketed-random-effects is single-device only; it cannot "
-                "be combined with --distributed"
-            )
         if not self.train_input_dirs:
             errors.append("--train-input-dirs is required")
         if not self.output_dir:
@@ -389,7 +385,8 @@ def build_training_parser() -> argparse.ArgumentParser:
            "program (fewer host dispatches; iteration-granular checkpoints)")
     a("--bucketed-random-effects", default="false",
       help="partition random-effect entities into size buckets (per-bucket "
-           "padding instead of one global sample cap; single-device only)")
+           "padding on skewed entity distributions; composes with "
+           "--distributed)")
     return p
 
 

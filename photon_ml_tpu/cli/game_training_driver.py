@@ -322,6 +322,7 @@ class GameTrainingDriver:
                     optimizer_config=cfg.optimizer_config(),
                     regularization=cfg.regularization_context(),
                     bundle=self.bucketed_bundles[name],
+                    mesh_ctx=self._mesh_context() if p.distributed else None,
                 )
             else:
                 re = RandomEffectCoordinate(

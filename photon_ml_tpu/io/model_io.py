@@ -302,7 +302,18 @@ def load_latent_matrix_feature_keys(input_dir: str, name: str):
     if not os.path.isfile(path):
         return None
     with open(path) as f:
-        pairs = _json.load(f)["columns"]
+        text = f.read()
+    try:
+        pairs = _json.loads(text)["columns"]
+    except _json.JSONDecodeError:
+        # earlier binding files were 'name\tterm' lines (fragile for names
+        # containing tabs/newlines, which is why the format moved to JSON) —
+        # keep them loadable
+        pairs = [
+            line.partition("\t")[::2]
+            for line in text.splitlines()
+            if line
+        ]
     # ALWAYS the delimiter form — feature_key(name, "") is "name\x01", not
     # bare "name" (a bare key would miss every empty-term feature)
     return [f"{nm}{DELIMITER}{term}" for nm, term in pairs]

@@ -146,17 +146,41 @@ class TestEquality:
         assert np.all(np.isfinite(np.asarray(result.total_scores)))
 
 
-def test_bucketed_plus_distributed_rejected():
-    """--bucketed-random-effects + --distributed must fail loudly at param
-    validation, not silently drop the bucketing."""
-    from photon_ml_tpu.cli.game_params import GameTrainingParams
+def test_bucketed_composes_with_entity_sharding(rng):
+    """mesh_ctx set: every bucket entity-shards over the mesh (per-bucket
+    DistributedRandomEffectSolver) and must match the single-device
+    bucketed solve."""
+    from photon_ml_tpu.parallel import MeshContext, data_mesh
 
-    params = GameTrainingParams(
-        train_input_dirs=["x"],
-        output_dir="y",
-        updating_sequence=["a"],
-        bucketed_random_effects=True,
-        distributed=True,
+    sizes = [5, 7, 9, 40, 130]
+    data = _skewed_glmix(rng, sizes)
+    opt = OptimizerConfig(max_iterations=25, tolerance=1e-9)
+    reg = RegularizationContext.l2(0.5)
+    local = BucketedRandomEffectCoordinate(
+        data, CFG, TaskType.LOGISTIC_REGRESSION,
+        OptimizerType.LBFGS, opt, reg,
     )
-    with pytest.raises(ValueError, match="single-device"):
-        params.validate()
+    dist = BucketedRandomEffectCoordinate(
+        data, CFG, TaskType.LOGISTIC_REGRESSION,
+        OptimizerType.LBFGS, opt, reg,
+        bundle=local.bundle,  # identical per-bucket datasets
+        mesh_ctx=MeshContext(data_mesh(8)),
+    )
+    resid = jnp.zeros((data.num_rows,), jnp.float32)
+    st_l, _ = local.update(resid, local.initial_coefficients())
+    st_d, _ = dist.update(resid, dist.initial_coefficients())
+    np.testing.assert_allclose(
+        np.asarray(dist.score(st_d)), np.asarray(local.score(st_l)),
+        rtol=5e-4, atol=5e-4,
+    )
+    np.testing.assert_allclose(
+        float(dist.regularization_term(st_d)),
+        float(local.regularization_term(st_l)),
+        rtol=5e-4,
+    )
+    # model export agrees too (exercises the padded-entity slicing)
+    ml = local.entity_means_by_raw_id(st_l)
+    md = dist.entity_means_by_raw_id(st_d)
+    assert set(ml) == set(md)
+    for k in ml:
+        np.testing.assert_allclose(md[k], ml[k], rtol=5e-4, atol=5e-4)

@@ -733,3 +733,25 @@ class TestCombinedModes:
         _, _, metrics = driver.results[driver.best_index]
         _, _, local_metrics = local_driver.results[local_driver.best_index]
         assert metrics["AUC"] == pytest.approx(local_metrics["AUC"], abs=5e-3)
+
+
+class TestBucketedDistributedDriver:
+    def test_flags_compose_through_driver(self, trained, game_avro_dirs, tmp_path):
+        """--bucketed-random-effects + --distributed: per-bucket entity
+        sharding over the mesh through the full driver, matching metrics."""
+        local_driver, _, _ = trained
+        train_dir, val_dir, _ = game_avro_dirs
+        driver = game_training_driver.main(
+            [
+                "--train-input-dirs", train_dir,
+                "--validate-input-dirs", val_dir,
+                "--output-dir", str(tmp_path / "out"),
+                "--num-iterations", "2",
+                "--bucketed-random-effects", "true",
+                "--distributed", "true",
+            ]
+            + COMMON_FLAGS
+        )
+        _, _, metrics = driver.results[driver.best_index]
+        _, _, local_metrics = local_driver.results[local_driver.best_index]
+        assert metrics["AUC"] == pytest.approx(local_metrics["AUC"], abs=5e-3)
