@@ -39,6 +39,12 @@ class CoordinateDescentResult:
     objective_history: List[float]  # after every coordinate update
     validation_history: List[Dict[str, float]]  # per update, per evaluator
     timings: Dict[str, float]  # coordinate name -> cumulative solve seconds
+    # coordinate name -> the LAST update's OptResult (vmapped solves carry a
+    # leading entity axis; bucketed coordinates a tuple per bucket) — the
+    # raw material of the reference's OptimizationTracker summaries
+    # (RandomEffectOptimizationTracker.scala:62-95). Empty in fused-cycle
+    # mode (results stay inside the compiled cycle).
+    trackers: Dict[str, object] = dataclasses.field(default_factory=dict)
 
 
 class CoordinateDescent:
@@ -155,6 +161,7 @@ class CoordinateDescent:
         # per-coordinate entries only where they are actually measured (the
         # fused path measures whole cycles, not coordinates)
         timings = {} if self.fused_cycle else {n: 0.0 for n in names}
+        trackers: Dict[str, object] = {}
         total = jnp.zeros((num_rows,), real_dtype())
 
         start_step = 0
@@ -241,7 +248,9 @@ class CoordinateDescent:
                     continue  # already completed before the restart
                 partial = total - scores[name]  # sum of the OTHER coordinates
                 t0 = time.perf_counter()
-                params[name], _ = self._update_fns[name](partial, params[name])
+                params[name], trackers[name] = self._update_fns[name](
+                    partial, params[name]
+                )
                 new_score = self._score_fns[name](params[name])
                 if self.collect_timings:
                     new_score.block_until_ready()
@@ -290,4 +299,5 @@ class CoordinateDescent:
             objective_history=objective_history,
             validation_history=validation_history,
             timings=timings,
+            trackers=trackers,
         )

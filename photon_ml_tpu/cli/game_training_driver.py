@@ -57,6 +57,42 @@ BEST_MODEL_DIR = "best"
 ALL_MODELS_DIR = "all"
 
 
+def _summarize_tracker(tracker, true_entities=None) -> str:
+    """Per-coordinate convergence summary from the last update's OptResult
+    (the reference's per-coordinate OptimizationTracker logging,
+    CoordinateDescent.scala:150-156 / RandomEffectOptimizationTracker).
+
+    ``true_entities`` trims the padding lanes distributed solves add to the
+    entity axis (their zero-row pseudo-solves would skew every statistic).
+    """
+    import numpy as np
+
+    from photon_ml_tpu.optim.common import (
+        OptResult,
+        summarize_result,
+        summarize_stacked_results,
+    )
+
+    if tracker is None:
+        return ""
+    # OptResult IS a NamedTuple — test for it BEFORE the generic tuple
+    # (bucketed) case or every tracker would fall into the tuple branch
+    if isinstance(tracker, OptResult):
+        if np.asarray(tracker.reason).ndim >= 1:
+            if true_entities is not None:
+                import jax as _jax
+
+                tracker = _jax.tree_util.tree_map(
+                    lambda leaf: leaf[:true_entities], tracker
+                )
+            return summarize_stacked_results(tracker)
+        return summarize_result(tracker)
+    if isinstance(tracker, tuple):  # bucketed: one OptResult per bucket
+        parts = [_summarize_tracker(t) for t in tracker]
+        return " | ".join(f"bucket{j}: {s}" for j, s in enumerate(parts) if s)
+    return ""
+
+
 def _input_files(dirs: List[str]) -> List[str]:
     files = []
     for d in dirs:
@@ -534,6 +570,13 @@ class GameTrainingDriver:
                 f"combo {i}: objective={result.objective_history[-1]:.6g} "
                 + " ".join(f"{k}={v:.6g}" for k, v in metrics.items())
             )
+            for cname, tracker in result.trackers.items():
+                coord_obj = coords.get(cname)
+                summary = _summarize_tracker(
+                    tracker, getattr(coord_obj, "_true_entities", None)
+                )
+                if summary:
+                    self.logger.info(f"combo {i} [{cname}] {summary}")
             if primary is not None and metrics:
                 ev = evaluators[primary][0]
                 value = metrics[primary]

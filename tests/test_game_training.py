@@ -255,3 +255,64 @@ def test_fused_resume_rejects_mid_iteration_checkpoint(glmix, tmp_path):
     )
     with pytest.raises(ValueError, match="mid-iteration"):
         cd_fused.run(num_iterations=1, num_rows=n, checkpointer=ck)
+
+
+def test_trackers_surface_per_coordinate_convergence(glmix):
+    """CoordinateDescentResult.trackers: the last update's OptResult per
+    coordinate (per-entity stacked for random effects) — the reference's
+    OptimizationTracker raw material."""
+    from photon_ml_tpu.optim.common import OptResult
+    from photon_ml_tpu.types import ConvergenceReason
+
+    data, _ = glmix
+    n = data.num_rows
+    labels = jnp.asarray(data.response)
+    loss_fn = lambda scores: jnp.sum(losses.logistic.loss(scores, labels))
+    fixed, random = build_coordinates(data)
+    cd = CoordinateDescent({"fixed": fixed, "random": random}, loss_fn)
+    result = cd.run(num_iterations=1, num_rows=n)
+
+    assert set(result.trackers) == {"fixed", "random"}
+    fe = result.trackers["fixed"]
+    assert isinstance(fe, OptResult) and np.asarray(fe.reason).ndim == 0
+    assert int(fe.iterations) > 0
+    re = result.trackers["random"]
+    reasons = np.asarray(re.reason)
+    assert reasons.shape == (random.num_entities,)
+    valid = {r.value for r in ConvergenceReason}
+    assert set(np.unique(reasons).tolist()) <= valid
+
+    # fused mode documents empty trackers
+    fixed2, random2 = build_coordinates(data)
+    cd_f = CoordinateDescent(
+        {"fixed": fixed2, "random": random2}, loss_fn, fused_cycle=True
+    )
+    assert cd_f.run(num_iterations=1, num_rows=n).trackers == {}
+
+
+def test_summarize_tracker_formats_all_shapes(glmix):
+    """_summarize_tracker must actually emit text for every tracker shape
+    (OptResult is a NamedTuple, i.e. a tuple — the bucketed branch must not
+    shadow it) and trim distributed entity padding."""
+    from photon_ml_tpu.cli.game_training_driver import _summarize_tracker
+
+    data, _ = glmix
+    n = data.num_rows
+    labels = jnp.asarray(data.response)
+    loss_fn = lambda scores: jnp.sum(losses.logistic.loss(scores, labels))
+    fixed, random = build_coordinates(data)
+    cd = CoordinateDescent({"fixed": fixed, "random": random}, loss_fn)
+    result = cd.run(num_iterations=1, num_rows=n)
+
+    fe_summary = _summarize_tracker(result.trackers["fixed"])
+    assert "reason=" in fe_summary and "iters=" in fe_summary
+    re_summary = _summarize_tracker(result.trackers["random"])
+    assert "convergenceReasons=" in re_summary
+    assert f"entities={random.num_entities}" in re_summary
+    # trimming drops padded lanes from the stats
+    trimmed = _summarize_tracker(result.trackers["random"], true_entities=5)
+    assert "entities=5" in trimmed
+    # bucketed trackers: a tuple OF OptResults renders per bucket
+    both = _summarize_tracker((result.trackers["random"], result.trackers["random"]))
+    assert both.count("convergenceReasons=") == 2 and "bucket0:" in both
+    assert _summarize_tracker(None) == ""
