@@ -471,6 +471,14 @@ def _bench_game(extra, on_tpu):
     extra["game_coord_descent_sec_per_iter_unfused"] = round(per_iter[False], 4)
     extra["game_coord_descent_sec_per_iter_fused"] = round(per_iter[True], 4)
     extra["game_config"] = {"rows": n, "entities": num_users, "d_fixed": 32, "d_random": 8}
+    # the declared metric is "iter time @ fixed AUC" — record the AUC the
+    # timed model actually reaches so the timing is tied to model quality
+    # (full correctness gates live in PARITY.md; this is the in-bench tie)
+    from photon_ml_tpu.evaluation.evaluators import area_under_roc_curve
+
+    extra["game_train_auc"] = round(
+        float(area_under_roc_curve(result.total_scores, labels)), 4
+    )
 
 
 def main():
@@ -525,9 +533,39 @@ def main():
         "platform": platform,
         **extra,
     }
+    if platform != "tpu" and not (platform or "").startswith("axon"):
+        # degraded run (tunnel down / CPU fallback): attach the most recent
+        # preserved on-TPU self-capture so the round keeps a clearly-labelled
+        # TPU record even when the end-of-round tunnel is wedged
+        selfrun = _latest_tpu_selfrun()
+        if selfrun is not None:
+            payload["tpu_selfrun"] = selfrun
     if errors:
         payload["errors"] = errors
     _emit(payload)
+
+
+def _latest_tpu_selfrun():
+    """Most recent BENCH_SELFRUN_r*.json next to this script, if any."""
+    import glob
+    import os
+
+    here = os.path.dirname(os.path.abspath(__file__))
+    paths = glob.glob(os.path.join(here, "BENCH_SELFRUN_r*.json"))
+    if not paths:
+        return None
+    # most recent by mtime, not name (lexicographic breaks at r9 vs r10)
+    latest = max(paths, key=os.path.getmtime)
+    try:
+        with open(latest) as f:
+            data = json.load(f)
+        if not isinstance(data, dict) or data.get("platform") != "tpu":
+            # only a genuine on-TPU capture may stand in as the TPU record
+            return None
+        data["source_file"] = os.path.basename(latest)
+        return data
+    except Exception:  # noqa: BLE001 — a corrupt capture must not kill the emit
+        return None
 
 
 if __name__ == "__main__":

@@ -57,13 +57,14 @@ BEST_MODEL_DIR = "best"
 ALL_MODELS_DIR = "all"
 
 
-def _summarize_tracker(tracker, true_entities=None) -> str:
+def _summarize_tracker(tracker) -> str:
     """Per-coordinate convergence summary from the last update's OptResult
     (the reference's per-coordinate OptimizationTracker logging,
     CoordinateDescent.scala:150-156 / RandomEffectOptimizationTracker).
 
-    ``true_entities`` trims the padding lanes distributed solves add to the
-    entity axis (their zero-row pseudo-solves would skew every statistic).
+    Distributed solvers trim entity padding at the source
+    (``parallel.distributed.trim_entity_tracker``), so every tracker that
+    arrives here covers real entities only.
     """
     import numpy as np
 
@@ -79,12 +80,6 @@ def _summarize_tracker(tracker, true_entities=None) -> str:
     # (bucketed) case or every tracker would fall into the tuple branch
     if isinstance(tracker, OptResult):
         if np.asarray(tracker.reason).ndim >= 1:
-            if true_entities is not None:
-                import jax as _jax
-
-                tracker = _jax.tree_util.tree_map(
-                    lambda leaf: leaf[:true_entities], tracker
-                )
             return summarize_stacked_results(tracker)
         return summarize_result(tracker)
     if isinstance(tracker, tuple):  # bucketed: one OptResult per bucket
@@ -571,10 +566,7 @@ class GameTrainingDriver:
                 + " ".join(f"{k}={v:.6g}" for k, v in metrics.items())
             )
             for cname, tracker in result.trackers.items():
-                coord_obj = coords.get(cname)
-                summary = _summarize_tracker(
-                    tracker, getattr(coord_obj, "_true_entities", None)
-                )
+                summary = _summarize_tracker(tracker)
                 if summary:
                     self.logger.info(f"combo {i} [{cname}] {summary}")
             if primary is not None and metrics:

@@ -116,6 +116,24 @@ class DistributedFixedEffectSolver:
         return self._jitted(batch, w0, jnp.asarray(reg_weight, real_dtype()))
 
 
+def trim_entity_tracker(results, true_entities: int, padded_entities: int):
+    """Drop the padding lanes from an entity-stacked OptResult at the source.
+
+    Distributed solves pad the entity axis up to a device multiple; the
+    padding lanes are zero-row pseudo-solves whose convergence stats are
+    meaningless. Trimming here (not in consumers) means every downstream
+    reader — driver logging, tests, user code — sees only real entities.
+    The coefficient slab itself stays padded (the sharded carry shape)."""
+    if true_entities == padded_entities:
+        return results
+    return jax.tree_util.tree_map(
+        lambda l: l[:true_entities]
+        if getattr(l, "ndim", 0) >= 1 and l.shape[0] == padded_entities
+        else l,
+        results,
+    )
+
+
 def pad_and_shard_re_dataset(ds: RandomEffectDataset, ctx: MeshContext
                              ) -> RandomEffectDataset:
     """Pad the entity axis to a device multiple (weight-0/-1 padding) and
@@ -237,9 +255,12 @@ class DistributedRandomEffectSolver:
             self._jitted = self._build()
         ds = self._padded
         residuals = jax.device_put(residual_offsets, self.ctx.replicated())
-        return self._jitted(
+        coefs, results = self._jitted(
             ds.x, ds.labels, ds.base_offsets, ds.weights, ds.row_index,
             init_coefficients, residuals,
+        )
+        return coefs, trim_entity_tracker(
+            results, self._true_entities, self.padded_entities
         )
 
     def score(self, coefficients: Array) -> Array:
@@ -380,7 +401,9 @@ class DistributedFactoredRandomEffectCoordinate:
             ds.x, ds.labels, ds.base_offsets, ds.weights, ds.row_index,
             state.v, state.matrix, residuals,
         )
-        return FactoredState(v=v, matrix=mat), results
+        return FactoredState(v=v, matrix=mat), trim_entity_tracker(
+            results, self._true_entities, self.padded_entities
+        )
 
     def score(self, state) -> Array:
         """Owner-computes factored scoring: each device scores rows whose

@@ -293,7 +293,7 @@ def test_trackers_surface_per_coordinate_convergence(glmix):
 def test_summarize_tracker_formats_all_shapes(glmix):
     """_summarize_tracker must actually emit text for every tracker shape
     (OptResult is a NamedTuple, i.e. a tuple — the bucketed branch must not
-    shadow it) and trim distributed entity padding."""
+    shadow it)."""
     from photon_ml_tpu.cli.game_training_driver import _summarize_tracker
 
     data, _ = glmix
@@ -309,10 +309,41 @@ def test_summarize_tracker_formats_all_shapes(glmix):
     re_summary = _summarize_tracker(result.trackers["random"])
     assert "convergenceReasons=" in re_summary
     assert f"entities={random.num_entities}" in re_summary
-    # trimming drops padded lanes from the stats
-    trimmed = _summarize_tracker(result.trackers["random"], true_entities=5)
-    assert "entities=5" in trimmed
     # bucketed trackers: a tuple OF OptResults renders per bucket
     both = _summarize_tracker((result.trackers["random"], result.trackers["random"]))
     assert both.count("convergenceReasons=") == 2 and "bucket0:" in both
     assert _summarize_tracker(None) == ""
+
+
+def test_distributed_trackers_are_trimmed_at_source(glmix):
+    """Entity-sharded solvers must return trackers covering REAL entities
+    only — the padding pseudo-solves the mesh adds are trimmed before any
+    consumer sees them (trim_entity_tracker), so convergence logs are not
+    skewed by zero-row lanes."""
+    from photon_ml_tpu.algorithm.random_effect import RandomEffectCoordinate
+    from photon_ml_tpu.data.game import (
+        RandomEffectDataConfig,
+        build_random_effect_dataset,
+    )
+    from photon_ml_tpu.parallel import MeshContext, data_mesh
+    from photon_ml_tpu.parallel.distributed import DistributedRandomEffectSolver
+
+    data, _ = glmix
+    ds = build_random_effect_dataset(
+        data, RandomEffectDataConfig("userId", "per_user")
+    )
+    coord = RandomEffectCoordinate(
+        ds,
+        TaskType.LOGISTIC_REGRESSION,
+        OptimizerType.LBFGS,
+        OptimizerConfig(max_iterations=10, tolerance=1e-7),
+        RegularizationContext.l2(0.1),
+    )
+    solver = DistributedRandomEffectSolver(coord, MeshContext(data_mesh(8)))
+    assert solver.padded_entities > ds.num_entities  # padding actually happens
+    resid = jnp.zeros((data.num_rows,), jnp.float32)
+    coefs, tracker = solver.update(resid, solver.initial_coefficients())
+    # coefficients keep the padded sharded shape; the tracker does not
+    assert coefs.shape[0] == solver.padded_entities
+    assert np.asarray(tracker.reason).shape[0] == ds.num_entities
+    assert np.asarray(tracker.iterations).shape[0] == ds.num_entities

@@ -758,6 +758,14 @@ def main(argv=None):
     unknown = chosen - set(runners)
     if unknown:
         ap.error(f"unknown configs: {sorted(unknown)}")
+    if chosen != set(runners) and os.path.abspath(ns.out) == ap.get_default("out"):
+        # a subset run must never clobber the canonical full-run record:
+        # render() scopes all_pass to the configs actually run, so a
+        # 1-config smoke overwrite would present partial evidence as
+        # "ALL GATES PASS" for all six configs
+        ns.out = ns.out + ".partial"
+        print(f"subset run: writing to {ns.out} (canonical PARITY.md preserved)",
+              flush=True)
     results = []
     for key in ("heart", "a9a", "linear", "poisson", "game", "game5"):
         if key in chosen:

@@ -1,0 +1,23 @@
+#!/bin/bash
+# Patient single-client tunnel prober. NEVER kills a probe: a timeout-killed
+# probe orphans the server-side session claim and wedges the tunnel for every
+# later process (observed r3). A hung probe holds no claim — it is waiting for
+# one — so we leave it be; the moment the claim frees, the probe grabs it,
+# prints, and exits cleanly (releasing it again). Runs ONE probe at a time.
+# Success: writes the platform line to tools/tpu_probe_ok and exits.
+cd /root/repo
+rm -f tools/tpu_probe_ok
+i=0
+while true; do
+  i=$((i+1))
+  echo "$(date -u +%H:%M:%S) probe $i start" >> tools/tpu_probe.log
+  python -c "import jax; d=jax.devices()[0]; print(d.platform, d)" > tools/tpu_probe_ok.tmp 2>>tools/tpu_probe.log
+  rc=$?
+  if [ $rc -eq 0 ] && grep -qE "tpu|axon" tools/tpu_probe_ok.tmp; then
+    mv tools/tpu_probe_ok.tmp tools/tpu_probe_ok
+    echo "$(date -u +%H:%M:%S) probe $i SUCCESS: $(cat tools/tpu_probe_ok)" >> tools/tpu_probe.log
+    exit 0
+  fi
+  echo "$(date -u +%H:%M:%S) probe $i rc=$rc" >> tools/tpu_probe.log
+  sleep 60
+done
