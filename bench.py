@@ -552,20 +552,19 @@ def _latest_tpu_selfrun():
 
     here = os.path.dirname(os.path.abspath(__file__))
     paths = glob.glob(os.path.join(here, "BENCH_SELFRUN_r*.json"))
-    if not paths:
-        return None
-    # most recent by mtime, not name (lexicographic breaks at r9 vs r10)
-    latest = max(paths, key=os.path.getmtime)
-    try:
-        with open(latest) as f:
-            data = json.load(f)
-        if not isinstance(data, dict) or data.get("platform") != "tpu":
+    # newest-first by mtime (lexicographic breaks at r9 vs r10); fall back
+    # past corrupt or non-TPU captures to the first valid one
+    for path in sorted(paths, key=os.path.getmtime, reverse=True):
+        try:
+            with open(path) as f:
+                data = json.load(f)
             # only a genuine on-TPU capture may stand in as the TPU record
-            return None
-        data["source_file"] = os.path.basename(latest)
-        return data
-    except Exception:  # noqa: BLE001 — a corrupt capture must not kill the emit
-        return None
+            if isinstance(data, dict) and data.get("platform") == "tpu":
+                data["source_file"] = os.path.basename(path)
+                return data
+        except Exception:  # noqa: BLE001 — a corrupt capture must not kill the emit
+            continue
+    return None
 
 
 if __name__ == "__main__":

@@ -1,0 +1,96 @@
+"""One-shot TPU perf capture: autotune report, then the full bench — run as
+two SEQUENTIAL child processes that are never killed, so each holds the
+single-client tunnel claim alone and releases it by exiting cleanly (the
+axon tunnel wedges if a claim-holder is timeout-killed — never run any of
+this under ``timeout``).
+
+Writes:
+  - tools/autotune_report.json  — per-candidate timings of the fused kernel
+    race at the bench shape (and wider shapes), for kernel iteration;
+  - BENCH_SELFRUN_r03.json      — the bench JSON line, iff it ran on TPU.
+
+Usage:  python tools/tpu_capture.py             (orchestrator; no jax)
+        python tools/tpu_capture.py --autotune  (phase 1, internal)
+"""
+import json
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def log(msg):
+    print(f"[{time.strftime('%H:%M:%S')}] {msg}", file=sys.stderr, flush=True)
+
+
+def autotune_phase():
+    import jax
+
+    dev = jax.devices()[0]
+    log(f"device: {dev} ({dev.platform})")
+    if dev.platform not in ("tpu", "axon"):
+        log("not on TPU — aborting (this script is TPU-only)")
+        return 1
+
+    import jax.numpy as jnp
+
+    from photon_ml_tpu.ops import losses
+    from photon_ml_tpu.ops.fused_glm import autotune_report
+
+    reports = {}
+    for (n, d) in ((262144, 512), (131072, 1024), (131072, 2048)):
+        log(f"autotune race at N={n} D={d} bf16 ...")
+        t0 = time.time()
+        rep = autotune_report(losses.logistic, n, d, jnp.bfloat16)
+        log(f"  -> {rep} ({time.time() - t0:.0f}s)")
+        reports[f"{n}x{d}"] = rep
+    with open(os.path.join(REPO, "tools", "autotune_report.json"), "w") as f:
+        json.dump(reports, f, indent=1)
+    return 0
+
+
+def main():
+    # phase 1: autotune in a child that exits (and releases the claim)
+    rc = subprocess.run(
+        [sys.executable, os.path.abspath(__file__), "--autotune"]
+    ).returncode
+    if rc != 0:
+        log(f"autotune phase rc={rc}; continuing to bench anyway")
+
+    # phase 2: the full bench (its own claim; never killed)
+    log("running bench.py (child, unbounded) ...")
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py")],
+        capture_output=True, text=True,
+    )
+    sys.stderr.write(proc.stderr[-4000:])
+    line = proc.stdout.strip().splitlines()[-1] if proc.stdout.strip() else ""
+    print(line, flush=True)
+    try:
+        payload = json.loads(line)
+    except Exception:
+        log("bench emitted no JSON")
+        return 1
+    if payload.get("platform") in ("tpu", "axon"):
+        payload["platform"] = "tpu"  # the tunnel may report the plugin name
+        payload["note"] = (
+            "Self-captured on the live TPU via tools/tpu_capture.py "
+            f"({time.strftime('%Y-%m-%d %H:%M UTC', time.gmtime())}); "
+            "autotune candidates in tools/autotune_report.json."
+        )
+        out = os.path.join(REPO, "BENCH_SELFRUN_r03.json")
+        with open(out, "w") as f:
+            json.dump(payload, f, indent=1)
+        log(f"TPU capture preserved to {out}")
+        return 0
+    log(f"bench ran on {payload.get('platform')} — selfrun NOT updated")
+    return 1
+
+
+if __name__ == "__main__":
+    if "--autotune" in sys.argv:
+        sys.exit(autotune_phase())
+    sys.exit(main())
