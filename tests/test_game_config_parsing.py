@@ -1,0 +1,148 @@
+"""GAME CLI config-string grammar units.
+
+Reference specs: GLMOptimizationConfiguration.scala:41-75 (opt config
+string), RandomEffectDataConfiguration.scala:66-124 (data config string),
+MFOptimizationConfiguration.scala:23-55, grid via ';' separation
+(cli/game/training/Driver.scala:330-337), shard-section maps
+(cli/game/FeatureParams.scala).
+"""
+
+import pytest
+
+from photon_ml_tpu.cli.game_params import (
+    CoordinateOptConfig,
+    parse_coordinate_config_grid,
+    parse_coordinate_config_map,
+    parse_evaluators,
+    parse_factored_config_map,
+    parse_fixed_effect_data_configs,
+    parse_random_effect_data_configs,
+    parse_shard_intercepts,
+    parse_shard_sections,
+)
+from photon_ml_tpu.evaluation.evaluators import EvaluatorType
+from photon_ml_tpu.types import OptimizerType, RegularizationType
+
+
+class TestOptConfigGrammar:
+    def test_full_string(self):
+        c = CoordinateOptConfig.parse("50,1e-7,0.3,0.8,LBFGS,L2")
+        assert c.max_iterations == 50
+        assert c.tolerance == 1e-7
+        assert c.reg_weight == 0.3
+        assert c.down_sampling_rate == 0.8
+        assert c.optimizer == OptimizerType.LBFGS
+        assert c.reg_type == RegularizationType.L2
+
+    def test_reference_default_equivalent(self):
+        # GLMOptimizationConfiguration.scala:28 default: TRON(20, 1e-5), NONE
+        c = CoordinateOptConfig()
+        assert c.optimizer == OptimizerType.TRON
+        assert (c.max_iterations, c.tolerance) == (20, 1e-5)
+        assert c.reg_type == RegularizationType.NONE
+
+    @pytest.mark.parametrize("bad", [
+        "50,1e-7,0.3,0.8,LBFGS",          # 5 parts
+        "50,1e-7,0.3,0.8,LBFGS,L2,extra", # 7 parts
+        "50,1e-7,0.3,0,LBFGS,L2",         # rate 0
+        "50,1e-7,0.3,1.5,LBFGS,L2",       # rate > 1
+        "50,1e-7,0.3,1,SGD,L2",           # unknown optimizer
+        "50,1e-7,0.3,1,LBFGS,L3",         # unknown reg type
+    ])
+    def test_rejects(self, bad):
+        with pytest.raises(ValueError):
+            CoordinateOptConfig.parse(bad)
+
+    def test_case_insensitive_enums(self):
+        c = CoordinateOptConfig.parse("10,1e-5,0,1,lbfgs,l1")
+        assert c.optimizer == OptimizerType.LBFGS
+        assert c.reg_type == RegularizationType.L1
+
+    def test_map_and_grid(self):
+        m = parse_coordinate_config_map("a:10,1e-5,0,1,LBFGS,L2|b:20,1e-4,1,1,TRON,NONE")
+        assert set(m) == {"a", "b"}
+        assert m["b"].optimizer == OptimizerType.TRON
+        grid = parse_coordinate_config_grid(
+            "a:10,1e-5,0.1,1,LBFGS,L2;a:10,1e-5,1.0,1,LBFGS,L2"
+        )
+        assert len(grid) == 2
+        assert grid[0]["a"].reg_weight == 0.1 and grid[1]["a"].reg_weight == 1.0
+        assert parse_coordinate_config_grid(None) == [{}]
+        assert parse_coordinate_config_grid("") == [{}]
+
+    def test_regularization_context_elastic_net(self):
+        c = CoordinateOptConfig.parse("10,1e-5,2.0,1,LBFGS,ELASTIC_NET")
+        ctx = c.regularization_context()
+        # alpha-split of the total weight (RegularizationContext.scala)
+        assert ctx.l1_weight + ctx.l2_weight == pytest.approx(2.0)
+
+
+class TestDataConfigGrammar:
+    def test_fixed_effect(self):
+        m = parse_fixed_effect_data_configs("fixed:global,4|other:shardB,1")
+        assert m["fixed"].feature_shard_id == "global"
+        assert m["fixed"].min_partitions == 4  # accepted, obsolete
+        assert parse_fixed_effect_data_configs(None) == {}
+
+    def test_random_effect_full(self):
+        m = parse_random_effect_data_configs(
+            "per-user:userId,shardA,8,100,20,2.5,INDEX_MAP"
+        )
+        cfg = m["per-user"]
+        assert cfg.random_effect_id == "userId"
+        assert cfg.feature_shard_id == "shardA"
+        assert cfg.active_upper_bound == 100
+        assert cfg.passive_lower_bound == 20
+        assert cfg.features_to_samples_ratio == 2.5
+        assert cfg.projector == "INDEX_MAP"
+
+    def test_negative_bounds_mean_unbounded(self):
+        cfg = parse_random_effect_data_configs(
+            "r:userId,s,1,-1,-1,-1,IDENTITY"
+        )["r"]
+        assert cfg.active_upper_bound is None
+        assert cfg.passive_lower_bound is None
+        assert cfg.features_to_samples_ratio is None
+
+    def test_random_projector_dimension(self):
+        cfg = parse_random_effect_data_configs(
+            "r:userId,s,1,-1,-1,-1,RANDOM=16"
+        )["r"]
+        assert cfg.projector == "RANDOM" and cfg.random_projection_dim == 16
+        with pytest.raises(ValueError, match="RANDOM projector"):
+            parse_random_effect_data_configs("r:userId,s,1,-1,-1,-1,RANDOM")
+
+    def test_wrong_arity_rejected(self):
+        with pytest.raises(ValueError, match="expected reId"):
+            parse_random_effect_data_configs("r:userId,s,1,-1,-1,IDENTITY")
+
+    def test_factored_nested_configs(self):
+        m = parse_factored_config_map(
+            "mf:10,1e-5,0.5,1,LBFGS,L2:20,1e-6,1.0,1,LBFGS,L2:3,4"
+        )
+        spec = m["mf"]
+        assert spec.random_effect.reg_weight == 0.5
+        assert spec.latent_factor.max_iterations == 20
+        assert (spec.mf_num_iterations, spec.latent_dim) == (3, 4)
+        with pytest.raises(ValueError, match="mfIters,latentDim"):
+            parse_factored_config_map("mf:10,1e-5,0,1,LBFGS,L2:20,1e-6,0,1,LBFGS,L2:3")
+
+
+class TestShardAndEvaluatorGrammar:
+    def test_shard_sections(self):
+        m = parse_shard_sections("global:features,ctx|per_user:userFeatures")
+        assert m["global"] == ["features", "ctx"]
+        assert m["per_user"] == ["userFeatures"]
+        assert parse_shard_sections(None) == {}
+
+    def test_shard_intercepts(self):
+        m = parse_shard_intercepts("global:true|per_user:false")
+        assert m == {"global": True, "per_user": False}
+
+    def test_evaluators(self):
+        evs = parse_evaluators("AUC,RMSE,PRECISION@5:documentId,LOGISTIC_LOSS")
+        assert evs[0] == (EvaluatorType.AUC, None, None)
+        assert evs[2] == (EvaluatorType.PRECISION_AT_K, 5, "documentId")
+        assert parse_evaluators(None) == []
+        with pytest.raises(ValueError):
+            parse_evaluators("NOT_A_METRIC")

@@ -1,0 +1,180 @@
+"""GLM CLI param cross-validation matrix, date-range discovery edges, and
+model-selection criteria.
+
+Reference specs: Params.scala:175-197 (cross-field validation),
+util/DateRange.scala + IOUtils.scala:85-130 (daily/yyyy/MM/dd discovery),
+ModelSelection.scala:31-86 (per-task selection metric + direction).
+"""
+
+import datetime
+import os
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from photon_ml_tpu.cli import glm_params
+from photon_ml_tpu.utils.date_range import DateRange, expand_date_range_paths
+from photon_ml_tpu.types import TaskType
+
+
+def _parse(extra):
+    return glm_params.parse_from_command_line(
+        ["--training-data-directory", "/tmp/in",
+         "--output-directory", "/tmp/out",
+         "--task", "LOGISTIC_REGRESSION"] + extra
+    )
+
+
+class TestGLMParamsValidation:
+    def test_minimal_flags_parse(self):
+        p = _parse([])
+        assert p.task_type == TaskType.LOGISTIC_REGRESSION
+        assert p.regularization_weights == [0.1, 1.0, 10.0, 100.0]
+
+    @pytest.mark.parametrize("extra,msg", [
+        (["--optimizer", "TRON", "--regularization-type", "L1"], "TRON"),
+        (["--optimizer", "TRON", "--regularization-type", "ELASTIC_NET"], "TRON"),
+        (["--task", "SMOOTHED_HINGE_LOSS_LINEAR_SVM", "--optimizer", "TRON"],
+         "first-order"),
+        (["--regularization-type", "ELASTIC_NET", "--elastic-net-alpha", "1.5"],
+         "alpha"),
+        (["--regularization-weights", "1,-5"], "negative"),
+        (["--validate-per-iteration", "true"], "validating-data-directory"),
+        (["--diagnostic-mode", "ALL"], "validating-data-directory"),
+    ])
+    def test_invalid_combos_rejected(self, extra, msg):
+        with pytest.raises(ValueError, match=msg):
+            _parse(extra)
+
+    def test_valid_combos_accepted(self):
+        # TRON+L2 is the reference's GAME default; hinge+LBFGS is legal
+        _parse(["--optimizer", "TRON", "--regularization-type", "L2"])
+        _parse(["--task", "SMOOTHED_HINGE_LOSS_LINEAR_SVM", "--optimizer", "LBFGS"])
+        _parse(["--regularization-type", "ELASTIC_NET",
+                "--elastic-net-alpha", "0.5"])
+
+    def test_obsolete_spark_flags_accepted(self):
+        p = _parse(["--kryo", "true", "--min-partitions", "4",
+                    "--tree-aggregate-depth", "2"])
+        assert p.tree_aggregate_depth == 2  # parsed, ignored downstream
+
+
+class TestDateRange:
+    def test_from_string_and_days(self):
+        dr = DateRange.from_string("20260101-20260103")
+        assert dr.days() == [datetime.date(2026, 1, d) for d in (1, 2, 3)]
+
+    def test_inverted_range_rejected(self):
+        with pytest.raises(ValueError, match="invalid date range"):
+            DateRange.from_string("20260103-20260101")
+
+    def test_malformed_rejected(self):
+        with pytest.raises(ValueError):
+            DateRange.from_string("2026-01-01")
+
+    def test_from_days_ago_anchored(self):
+        today = datetime.date(2026, 7, 30)
+        dr = DateRange.from_days_ago("3-1", today=today)
+        assert dr.start == datetime.date(2026, 7, 27)
+        assert dr.end == datetime.date(2026, 7, 29)
+
+    def test_expand_skips_missing_days(self, tmp_path):
+        for d in (1, 3):
+            os.makedirs(tmp_path / "daily" / "2026" / "01" / f"{d:02d}")
+        got = expand_date_range_paths(
+            str(tmp_path), DateRange.from_string("20260101-20260104")
+        )
+        assert [p[-2:] for p in got] == ["01", "03"]
+
+    def test_expand_empty_raises(self, tmp_path):
+        with pytest.raises(FileNotFoundError):
+            expand_date_range_paths(
+                str(tmp_path), DateRange.from_string("20260101-20260102")
+            )
+
+    def test_expand_error_on_missing(self, tmp_path):
+        os.makedirs(tmp_path / "daily" / "2026" / "01" / "01")
+        with pytest.raises(FileNotFoundError):
+            expand_date_range_paths(
+                str(tmp_path), DateRange.from_string("20260101-20260102"),
+                error_on_missing=True,
+            )
+
+
+class TestModelSelection:
+    def _models(self, task, coef_list):
+        from photon_ml_tpu.models.glm import Coefficients, GeneralizedLinearModel
+
+        return [
+            (lam, GeneralizedLinearModel(Coefficients(jnp.asarray(c)), task))
+            for lam, c in coef_list
+        ]
+
+    def _batch(self, task):
+        from photon_ml_tpu.ops.features import DenseFeatures
+        from photon_ml_tpu.ops.objective import GLMBatch
+
+        rng = np.random.default_rng(0)
+        x = rng.normal(size=(500, 3)).astype(np.float32)
+        w = np.asarray([1.0, -2.0, 0.5], np.float32)
+        z = x @ w
+        if task == TaskType.LOGISTIC_REGRESSION:
+            y = (1 / (1 + np.exp(-z)) > rng.random(500)).astype(np.float32)
+        elif task == TaskType.POISSON_REGRESSION:
+            # small rates so exp(z) is well-calibrated for the true weights
+            y = rng.poisson(np.exp(0.3 * z)).astype(np.float32)
+        else:
+            y = (z + 0.1 * rng.normal(size=500)).astype(np.float32)
+        return GLMBatch(
+            DenseFeatures(jnp.asarray(x)), jnp.asarray(y),
+            jnp.zeros((500,)), jnp.ones((500,)),
+        )
+
+    def test_logistic_picks_highest_auc(self):
+        from photon_ml_tpu.model_selection import select_best_model
+
+        batch = self._batch(TaskType.LOGISTIC_REGRESSION)
+        good = [1.0, -2.0, 0.5]
+        bad = [-1.0, 2.0, -0.5]  # anti-correlated -> AUC < 0.5
+        best_lam, best_model, all_m = select_best_model(
+            self._models(TaskType.LOGISTIC_REGRESSION,
+                         [(0.1, bad), (1.0, good)]),
+            batch,
+        )
+        assert best_lam == 1.0
+        assert len(all_m) == 2
+
+    def test_linear_picks_lowest_rmse(self):
+        from photon_ml_tpu.model_selection import select_best_model
+
+        batch = self._batch(TaskType.LINEAR_REGRESSION)
+        best_lam, _, _ = select_best_model(
+            self._models(TaskType.LINEAR_REGRESSION,
+                         [(0.1, [0.0, 0.0, 0.0]), (1.0, [1.0, -2.0, 0.5])]),
+            batch,
+        )
+        assert best_lam == 1.0  # true weights -> smallest RMSE
+
+    def test_poisson_picks_highest_loglik(self):
+        from photon_ml_tpu.model_selection import select_best_model
+
+        batch = self._batch(TaskType.POISSON_REGRESSION)
+        best_lam, _, _ = select_best_model(
+            self._models(TaskType.POISSON_REGRESSION,
+                         [(0.1, [0.3, -0.6, 0.15]), (1.0, [0.0, 0.0, 0.0])]),
+            batch,
+        )
+        assert best_lam == 0.1
+
+    def test_empty_raises(self):
+        from photon_ml_tpu.model_selection import select_best_model
+
+        with pytest.raises(ValueError, match="no models"):
+            select_best_model([], self._batch(TaskType.LINEAR_REGRESSION))
+
+    def test_selection_metric_map_covers_all_tasks(self):
+        from photon_ml_tpu.model_selection import selection_metric_for
+
+        for t in TaskType:
+            assert isinstance(selection_metric_for(t), str)

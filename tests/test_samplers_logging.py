@@ -1,0 +1,178 @@
+"""Down-samplers, PhotonLogger, Timer, and CoefficientSummary units.
+
+Reference specs: sampler/BinaryClassificationDownSampler.scala:31-60,
+sampler/DefaultDownSampler.scala:26-45, util/PhotonLogger.scala:38-520
+(tmp file copied to output on close), util/Timer.scala:32-235,
+supervised/model/CoefficientSummary.scala.
+"""
+
+import os
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from photon_ml_tpu.data.sampler import (
+    down_sample_binary,
+    down_sample_default,
+    maybe_down_sample,
+)
+from photon_ml_tpu.ops.features import DenseFeatures
+from photon_ml_tpu.ops.objective import GLMBatch
+from photon_ml_tpu.types import TaskType
+
+
+def _batch(n=4000, seed=3):
+    rng = np.random.default_rng(seed)
+    x = DenseFeatures(jnp.asarray(rng.normal(size=(n, 4)).astype(np.float32)))
+    labels = jnp.asarray((rng.random(n) < 0.25).astype(np.float32))
+    return GLMBatch(x, labels, jnp.zeros((n,)), jnp.ones((n,)))
+
+
+class TestDownSamplers:
+    def test_binary_keeps_all_positives(self):
+        b = _batch()
+        out = down_sample_binary(b, 0.3, jax.random.PRNGKey(0))
+        pos = np.asarray(b.labels) > 0.5
+        w = np.asarray(out.weights)
+        # every positive survives with weight exactly 1 (never rescaled)
+        assert (w[pos] == 1.0).all()
+        # negatives are either dropped (0) or rescaled to 1/rate
+        neg_w = np.unique(w[~pos])
+        assert all(v == 0.0 or v == pytest.approx(1 / 0.3) for v in neg_w)
+
+    def test_binary_is_unbiased(self):
+        """E[sum of weights over negatives] must equal the original negative
+        mass (the 1/rate rescale, BinaryClassificationDownSampler.scala:48)."""
+        b = _batch(n=20000)
+        neg_mass = float(np.sum(np.asarray(b.labels) <= 0.5))
+        kept = np.mean([
+            float(jnp.sum(down_sample_binary(b, 0.4, jax.random.PRNGKey(s)).weights
+                          * (b.labels <= 0.5)))
+            for s in range(5)
+        ])
+        assert kept == pytest.approx(neg_mass, rel=0.05)
+
+    def test_default_uniform_unbiased(self):
+        b = _batch(n=20000)
+        out = down_sample_default(b, 0.5, jax.random.PRNGKey(1))
+        w = np.asarray(out.weights)
+        assert set(np.unique(w)).issubset({0.0, 2.0})
+        assert w.sum() == pytest.approx(b.labels.shape[0], rel=0.05)
+
+    def test_maybe_down_sample_dispatch_and_noop(self):
+        b = _batch()
+        # rate None / >= 1: identity (no-op hook, GeneralizedLinear
+        # OptimizationProblem.downSample)
+        assert maybe_down_sample(b, TaskType.LOGISTIC_REGRESSION, None, 7) is b
+        assert maybe_down_sample(b, TaskType.LOGISTIC_REGRESSION, 1.0, 7) is b
+        # logistic -> binary sampler (positives untouched)
+        out = maybe_down_sample(b, TaskType.LOGISTIC_REGRESSION, 0.5, 7)
+        pos = np.asarray(b.labels) > 0.5
+        assert (np.asarray(out.weights)[pos] == 1.0).all()
+        # linear -> uniform sampler (positives CAN be dropped)
+        out2 = maybe_down_sample(b, TaskType.LINEAR_REGRESSION, 0.5, 7)
+        assert (np.asarray(out2.weights)[pos] == 0.0).any()
+
+    def test_deterministic_under_same_seed(self):
+        b = _batch()
+        w1 = maybe_down_sample(b, TaskType.LOGISTIC_REGRESSION, 0.5, 11).weights
+        w2 = maybe_down_sample(b, TaskType.LOGISTIC_REGRESSION, 0.5, 11).weights
+        np.testing.assert_array_equal(np.asarray(w1), np.asarray(w2))
+
+    def test_training_with_downsampling_still_converges(self):
+        """The zero-weight representation must flow through a real solve."""
+        from photon_ml_tpu.optim.common import OptimizerConfig
+        from photon_ml_tpu.optim.problem import GLMOptimizationProblem
+        from photon_ml_tpu.ops.normalization import NormalizationContext
+        from photon_ml_tpu.ops.regularization import RegularizationContext
+        from photon_ml_tpu.types import OptimizerType
+
+        b = _batch(n=2000)
+        prob = GLMOptimizationProblem(
+            TaskType.LOGISTIC_REGRESSION,
+            OptimizerType.LBFGS,
+            OptimizerConfig(max_iterations=40, tolerance=1e-7),
+            RegularizationContext.l2(1e-2),
+        )
+        sampled = maybe_down_sample(b, TaskType.LOGISTIC_REGRESSION, 0.5, 3)
+        model, res = prob.run(sampled, NormalizationContext.identity())
+        assert np.isfinite(np.asarray(model.coefficients.means)).all()
+        assert res.iterations > 0
+
+
+class TestPhotonLogger:
+    def test_levels_and_close_copies_to_output(self, tmp_path):
+        from photon_ml_tpu.utils.logging import LEVEL_WARN, PhotonLogger
+
+        out = tmp_path / "logs" / "driver.log"  # parent does not exist yet
+        logger = PhotonLogger(str(out), level=LEVEL_WARN, echo=False)
+        tmp_file = logger._tmp_path
+        logger.info("below threshold — filtered")
+        logger.warn("warn line")
+        logger.error("error line")
+        logger.close()
+        text = out.read_text()
+        assert "warn line" in text and "error line" in text
+        assert "below threshold" not in text
+        assert "[WARN]" in text and "[ERROR]" in text
+        # tmp file removed; close is idempotent; writes after close dropped
+        assert not os.path.exists(tmp_file)
+        logger.close()
+        logger.error("after close")
+        assert "after close" not in out.read_text()
+
+    def test_context_manager_and_no_output_path(self):
+        from photon_ml_tpu.utils.logging import PhotonLogger
+
+        with PhotonLogger(None, echo=False) as logger:
+            logger.info("hello")
+            tmp_file = logger._tmp_path
+        assert not os.path.exists(tmp_file)
+
+
+class TestTimer:
+    def test_measure_and_summary(self):
+        from photon_ml_tpu.utils.timer import Timer
+
+        lines = []
+        t = Timer(log_fn=lines.append)
+        with t.measure("phase-a"):
+            pass
+        t.start("phase-b")
+        dt = t.stop("phase-b")
+        assert dt >= 0.0
+        s = t.summary()
+        assert "phase-a" in s and "phase-b" in s
+        assert any("phase-a" in l for l in lines)
+
+    def test_stop_without_start_raises(self):
+        from photon_ml_tpu.utils.timer import Timer
+
+        with pytest.raises(RuntimeError):
+            Timer().stop("never-started")
+        # double-start is rejected too
+        t = Timer()
+        t.start("x")
+        with pytest.raises(RuntimeError):
+            t.start("x")
+
+
+class TestCoefficientSummary:
+    def test_from_samples_quartiles(self):
+        from photon_ml_tpu.bootstrap import CoefficientSummary
+
+        s = CoefficientSummary.from_samples(np.asarray([1.0, 2.0, 3.0, 4.0, 5.0]))
+        assert (s.min, s.max, s.mean, s.median) == (1.0, 5.0, 3.0, 3.0)
+        assert s.q1 == 2.0 and s.q3 == 4.0
+        assert s.variance == pytest.approx(2.5)
+        assert not s.contains_zero()
+        z = CoefficientSummary.from_samples(np.asarray([-1.0, 1.0]))
+        assert z.contains_zero()
+
+    def test_single_sample_variance_zero(self):
+        from photon_ml_tpu.bootstrap import CoefficientSummary
+
+        s = CoefficientSummary.from_samples(np.asarray([2.5]))
+        assert s.variance == 0.0 and s.min == s.max == 2.5

@@ -47,8 +47,11 @@ def autotune_phase():
         rep = autotune_report(losses.logistic, n, d, jnp.bfloat16)
         log(f"  -> {rep} ({time.time() - t0:.0f}s)")
         reports[f"{n}x{d}"] = rep
-    with open(os.path.join(REPO, "tools", "autotune_report.json"), "w") as f:
+    # atomic write: a crash mid-dump must not leave a truncated report
+    out = os.path.join(REPO, "tools", "autotune_report.json")
+    with open(out + ".tmp", "w") as f:
         json.dump(reports, f, indent=1)
+    os.replace(out + ".tmp", out)
     return 0
 
 
