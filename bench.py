@@ -20,7 +20,10 @@ Sub-benchmarks:
      Driver.scala:334) — gather + segment-sum margins, scatter-add gradient.
   3. GAME coordinate descent: fixed + per-entity random effect logistic
      GLMix on synthetic data (20k entities), sec per coordinate-descent
-     iteration (CoordinateDescent.scala:112-203 analogue).
+     iteration (CoordinateDescent.scala:112-203 analogue), with the
+     training AUC the timed model reaches.
+  4. Full-GAME (BASELINE config-5 shape): fixed + per-user + per-item REs
+     + a factored per-artist MF coordinate through the fused cycle.
 
 Methodology: iterations are serialized ON-CHIP via ``lax.scan`` with a
 gradient-dependent weight update, so the measured time is real sequential
@@ -481,6 +484,62 @@ def _bench_game(extra, on_tpu):
     )
 
 
+def _bench_game5(extra, on_tpu):
+    """Full-GAME shape (BASELINE config 5): fixed + per-user RE + per-item
+    RE + factored per-artist MF coordinate, fused-cycle coordinate descent.
+    Reference analogue: cli/game/training/DriverTest full-model runs."""
+    import jax.numpy as jnp
+
+    sys.path.insert(0, "tests")
+    from game_test_utils import make_full_game_coords, make_full_game_data
+
+    from photon_ml_tpu.algorithm import CoordinateDescent
+    from photon_ml_tpu.evaluation.evaluators import area_under_roc_curve
+    from photon_ml_tpu.ops import losses
+
+    scale = 1 if on_tpu else 10  # CPU fallback: smaller
+    rng = np.random.default_rng(23)
+    data, _ = make_full_game_data(
+        rng,
+        num_users=10000 // scale,
+        num_items=2000 // scale,
+        num_artists=200 // scale,
+        rows_per_user_range=(8, 16),
+        d_fixed=32,
+        d_user=8,
+        d_item=8,
+        d_artist=16,
+    )
+    n = data.num_rows
+    _log(f"GAME5 bench: {n} rows, {10000 // scale} users, "
+         f"{2000 // scale} items, {200 // scale} artists")
+
+    # the same 4-coordinate wiring the correctness test validates
+    coords = make_full_game_coords(data, fe_iters=30, re_iters=20, latent_dim=4)
+    labels = jnp.asarray(data.response)
+    loss_fn = lambda scores: jnp.sum(losses.logistic.loss(scores, labels))
+
+    iters = 3
+    cd = CoordinateDescent(coords, loss_fn, fused_cycle=True)
+    cd.run(num_iterations=1, num_rows=n)  # compile + warm
+    t0 = time.perf_counter()
+    result = cd.run(num_iterations=iters, num_rows=n)
+    result.total_scores.block_until_ready()
+    per_iter = (time.perf_counter() - t0) / iters
+    _log(f"GAME5 coord-descent (fused cycle, 4 coords): {per_iter:.3f} s/iter")
+    extra["game5_coord_descent_sec_per_iter"] = round(per_iter, 4)
+    extra["game5_train_auc"] = round(
+        float(area_under_roc_curve(result.total_scores, labels)), 4
+    )
+    extra["game5_config"] = {
+        "rows": n,
+        "users": 10000 // scale,
+        "items": 2000 // scale,
+        "artists": 200 // scale,
+        "coords": "fixed+per-user+per-item+factored(latent=4)",
+    }
+
+
 def main():
     errors = {}
     extra = {}
@@ -516,6 +575,10 @@ def main():
             _bench_game(extra, on_tpu)
         except Exception:
             errors["game"] = traceback.format_exc(limit=3)
+        try:
+            _bench_game5(extra, on_tpu)
+        except Exception:
+            errors["game5"] = traceback.format_exc(limit=3)
         try:
             _bench_scoring(extra, on_tpu)
         except Exception:

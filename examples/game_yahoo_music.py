@@ -20,37 +20,11 @@ jax.config.update("jax_platforms", "cpu")
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
+sys.path.insert(0, os.path.join(REPO, "tools"))
 
-YAHOO = ("/root/reference/photon-ml/src/integTest/resources/GameIntegTest/"
-         "input/test/yahoo-music-test.avro")
-
-_NTV = {"type": "record", "name": "NameTermValueAvro", "fields": [
-    {"name": "name", "type": "string"},
-    {"name": "term", "type": "string"},
-    {"name": "value", "type": "double"}]}
-_YAHOO_SCHEMA = {"type": "record", "name": "YahooMusicRow", "fields": [
-    {"name": "userId", "type": "long"},
-    {"name": "songId", "type": "long"},
-    {"name": "artistId", "type": "long"},
-    {"name": "numFeatures", "type": "int"},
-    {"name": "response", "type": "double"},
-    {"name": "features", "type": {"type": "array", "items": _NTV}},
-    {"name": "userFeatures", "type": {"type": "array", "items": "NameTermValueAvro"}},
-    {"name": "songFeatures", "type": {"type": "array", "items": "NameTermValueAvro"}}]}
-
-
-def _split_yahoo(data_dir):
-    """Deterministic 80/20 split of the shipped yahoo-music avro into
-    train/validation container files (no parity-harness import: that module
-    forces CPU + float64 at import time, which would defeat this example's
-    float32 production path)."""
-    from photon_ml_tpu.io.avro import read_container, write_container
-
-    recs = list(read_container(YAHOO))
-    train = [r for i, r in enumerate(recs) if i % 5 != 4]
-    val = [r for i, r in enumerate(recs) if i % 5 == 4]
-    write_container(os.path.join(data_dir, "train", "data.avro"), train, _YAHOO_SCHEMA)
-    write_container(os.path.join(data_dir, "validation", "data.avro"), val, _YAHOO_SCHEMA)
+# import-clean shared helper (NOT the parity harness itself, which forces
+# CPU + float64 at import time and would defeat this f32 example)
+from yahoo_data import split_yahoo as _split_yahoo  # noqa: E402
 
 
 def main():

@@ -69,3 +69,164 @@ def make_glmix_data(
         "margin": margin,
     }
     return data, truth
+
+
+def make_full_game_data(
+    rng: np.random.Generator,
+    num_users: int = 50,
+    num_items: int = 30,
+    num_artists: int = 10,
+    rows_per_user_range: Tuple[int, int] = (5, 20),
+    d_fixed: int = 8,
+    d_user: int = 4,
+    d_item: int = 4,
+    d_artist: int = 6,
+    noise: float = 0.0,
+) -> Tuple[GameData, Dict[str, np.ndarray]]:
+    """Full-GAME logistic data (BASELINE config-5 shape): fixed effect +
+    per-user RE + per-item RE + a per-artist section for a factored/MF
+    coordinate, with each item owned by one artist (the yahoo-music
+    song->artist structure the reference's DriverTest exercises).
+    """
+    rows_per_user = rng.integers(*rows_per_user_range, size=num_users)
+    n = int(rows_per_user.sum())
+    user_of_row = np.repeat(np.arange(num_users, dtype=np.int32), rows_per_user)
+    perm = rng.permutation(n)
+    user_of_row = user_of_row[perm]
+    item_of_row = rng.integers(0, num_items, size=n).astype(np.int32)
+    artist_of_item = rng.integers(0, num_artists, size=num_items).astype(np.int32)
+    artist_of_row = artist_of_item[item_of_row]
+
+    x_fixed = rng.normal(size=(n, d_fixed)).astype(np.float32)
+    x_user = rng.normal(size=(n, d_user)).astype(np.float32)
+    x_item = rng.normal(size=(n, d_item)).astype(np.float32)
+    x_artist = rng.normal(size=(n, d_artist)).astype(np.float32)
+    w_fixed = rng.normal(size=d_fixed).astype(np.float32)
+    w_users = (rng.normal(size=(num_users, d_user)) * 1.2).astype(np.float32)
+    w_items = (rng.normal(size=(num_items, d_item)) * 1.2).astype(np.float32)
+    # low-rank per-artist structure so the factored coordinate has signal
+    rank = 2
+    w_artists = (
+        rng.normal(size=(num_artists, rank)) @ rng.normal(size=(rank, d_artist))
+    ).astype(np.float32)
+
+    margin = (
+        x_fixed @ w_fixed
+        + np.sum(x_user * w_users[user_of_row], axis=1)
+        + np.sum(x_item * w_items[item_of_row], axis=1)
+        + np.sum(x_artist * w_artists[artist_of_row], axis=1)
+    )
+    if noise:
+        margin = margin + rng.normal(size=n) * noise
+    y = (1.0 / (1.0 + np.exp(-margin)) > rng.random(n)).astype(np.float32)
+
+    data = GameData(
+        response=y,
+        offset=np.zeros(n, np.float32),
+        weight=np.ones(n, np.float32),
+        ids={
+            "userId": user_of_row,
+            "itemId": item_of_row,
+            "artistId": artist_of_row,
+        },
+        id_vocabs={
+            "userId": [f"u{i}" for i in range(num_users)],
+            "itemId": [f"i{i}" for i in range(num_items)],
+            "artistId": [f"a{i}" for i in range(num_artists)],
+        },
+        shards={
+            "global": dense_to_csr(x_fixed),
+            "per_user": dense_to_csr(x_user),
+            "per_item": dense_to_csr(x_item),
+            "per_artist": dense_to_csr(x_artist),
+        },
+    )
+    truth = {
+        "w_fixed": w_fixed,
+        "w_users": w_users,
+        "w_items": w_items,
+        "w_artists": w_artists,
+        "user_of_row": user_of_row,
+        "item_of_row": item_of_row,
+        "artist_of_row": artist_of_row,
+        "margin": margin,
+    }
+    return data, truth
+
+
+def make_full_game_coords(
+    data: GameData,
+    fe_iters: int = 30,
+    re_iters: int = 20,
+    mf_inner_iters: int = 1,
+    mf_re_iters: int = 10,
+    latent_dim: int = 4,
+):
+    """The 4-coordinate full-GAME stack (fixed + per-user RE + per-item RE
+    + factored per-artist MF) over :func:`make_full_game_data` output —
+    shared by the correctness test and bench.py so they exercise the SAME
+    model wiring. The factored coordinate requires IDENTITY projection
+    (local dim == global dim), passed explicitly rather than relying on
+    INDEX_MAP collapsing to identity on dense synthetic shards.
+    """
+    from photon_ml_tpu.algorithm import (
+        FixedEffectCoordinate,
+        RandomEffectCoordinate,
+    )
+    from photon_ml_tpu.algorithm.factored_random_effect import (
+        FactoredRandomEffectCoordinate,
+        MFOptimizationConfig,
+    )
+    from photon_ml_tpu.data.game import (
+        RandomEffectDataConfig,
+        build_fixed_effect_batch,
+        build_random_effect_dataset,
+    )
+    from photon_ml_tpu.optim.common import OptimizerConfig
+    from photon_ml_tpu.optim.problem import GLMOptimizationProblem
+    from photon_ml_tpu.ops.regularization import RegularizationContext
+    from photon_ml_tpu.types import OptimizerType, TaskType
+
+    def re_coord(id_name, shard):
+        return RandomEffectCoordinate(
+            build_random_effect_dataset(
+                data, RandomEffectDataConfig(id_name, shard)
+            ),
+            TaskType.LOGISTIC_REGRESSION,
+            OptimizerType.LBFGS,
+            OptimizerConfig(max_iterations=re_iters, tolerance=1e-6),
+            RegularizationContext.l2(1e-1),
+        )
+
+    return {
+        "fixed": FixedEffectCoordinate(
+            build_fixed_effect_batch(data, "global", dense=True),
+            GLMOptimizationProblem(
+                TaskType.LOGISTIC_REGRESSION,
+                OptimizerType.LBFGS,
+                OptimizerConfig(max_iterations=fe_iters, tolerance=1e-7),
+                RegularizationContext.l2(1e-2),
+            ),
+        ),
+        "per-user": re_coord("userId", "per_user"),
+        "per-item": re_coord("itemId", "per_item"),
+        "per-artist": FactoredRandomEffectCoordinate(
+            dataset=build_random_effect_dataset(
+                data,
+                RandomEffectDataConfig(
+                    "artistId", "per_artist", projector="IDENTITY"
+                ),
+            ),
+            task=TaskType.LOGISTIC_REGRESSION,
+            mf_config=MFOptimizationConfig(
+                num_inner_iterations=mf_inner_iters,
+                latent_space_dimension=latent_dim,
+            ),
+            re_optimizer_config=OptimizerConfig(
+                max_iterations=mf_re_iters, tolerance=1e-6
+            ),
+            latent_optimizer_config=OptimizerConfig(
+                max_iterations=mf_re_iters, tolerance=1e-6
+            ),
+        ),
+    }

@@ -1,0 +1,135 @@
+"""Diagnostic Avro report units: curve math against hand-computed oracles,
+consistency with the scalar AUC evaluator, and schema round-trips.
+
+Reference schemas: photon-avro-schemas/src/main/avro/{EvaluationResultAvro,
+Curve2DAvro, Point2DAvro, TrainingContextAvro,
+FeatureSummarizationResultAvro}.avsc.
+"""
+
+import numpy as np
+import pytest
+
+from photon_ml_tpu.diagnostics import avro_reports
+from photon_ml_tpu.io import avro as avro_io
+from photon_ml_tpu.io import schemas
+from photon_ml_tpu.types import ConvergenceReason, TaskType
+
+
+class TestCurves:
+    def test_roc_hand_computed(self):
+        # scores sorted desc: labels 1,0,1,0 -> sweep TP/FP:
+        # (1,0) (1,1) (2,1) (2,2); normalized by P=2, N=2; leading (0,0)
+        scores = np.asarray([0.9, 0.8, 0.7, 0.1])
+        labels = np.asarray([1.0, 0.0, 1.0, 0.0])
+        pts = avro_reports.roc_curve(scores, labels, max_points=100)
+        xy = [(p["x"], p["y"]) for p in pts]
+        assert xy == [(0.0, 0.0), (0.0, 0.5), (0.5, 0.5), (0.5, 1.0), (1.0, 1.0)]
+
+    def test_roc_area_matches_auc_evaluator(self):
+        """Trapezoid area under the persisted ROC must equal the exact
+        weighted Mann-Whitney AUC (same weighted sweep semantics)."""
+        from photon_ml_tpu.evaluation.evaluators import area_under_roc_curve
+
+        rng = np.random.default_rng(5)
+        n = 500
+        scores = rng.normal(size=n)
+        labels = (rng.random(n) < 0.4).astype(np.float64)
+        weights = rng.uniform(0.5, 2.0, size=n)
+        pts = avro_reports.roc_curve(scores, labels, weights, max_points=n + 1)
+        x = np.asarray([p["x"] for p in pts])
+        y = np.asarray([p["y"] for p in pts])
+        area = float(np.trapezoid(y, x))
+        exact = float(area_under_roc_curve(scores, labels, weights))
+        assert area == pytest.approx(exact, abs=2e-3)
+
+    def test_pr_curve_endpoints(self):
+        scores = np.asarray([0.9, 0.8, 0.7, 0.1])
+        labels = np.asarray([1.0, 0.0, 1.0, 0.0])
+        pts = avro_reports.pr_curve(scores, labels, max_points=100)
+        # first swept point: top-scored example is positive -> precision 1
+        assert pts[0]["y"] == pytest.approx(1.0)
+        # final recall is 1 by construction
+        assert pts[-1]["x"] == pytest.approx(1.0)
+
+    def test_weight_zero_rows_ignored(self):
+        scores = np.asarray([0.9, 0.5, 0.1])
+        labels = np.asarray([1.0, 1.0, 0.0])
+        w = np.asarray([1.0, 0.0, 1.0])  # middle row is padding
+        pts = avro_reports.roc_curve(scores, labels, w, max_points=10)
+        pts_ref = avro_reports.roc_curve(
+            scores[[0, 2]], labels[[0, 2]], max_points=10
+        )
+        # a zero-weight row adds only a duplicate sweep point (tp/fp both
+        # unchanged) — the curve is geometrically identical
+        assert {(p["x"], p["y"]) for p in pts} == {
+            (q["x"], q["y"]) for q in pts_ref
+        }
+
+    def test_subsampling_caps_points(self):
+        rng = np.random.default_rng(0)
+        pts = avro_reports.roc_curve(
+            rng.normal(size=5000), (rng.random(5000) < 0.5).astype(float),
+            max_points=200,
+        )
+        assert len(pts) <= 200
+
+
+class TestRecordsRoundTrip:
+    def _record(self, with_curves):
+        rng = np.random.default_rng(1)
+        scores = rng.normal(size=50)
+        labels = (rng.random(50) < 0.5).astype(float)
+        ctx = avro_reports.training_context(
+            TaskType.LOGISTIC_REGRESSION, 0.0, 1.0, True, "LBFGS", 1e-7, 23,
+            ConvergenceReason.FUNCTION_VALUES_CONVERGED, "/data/train",
+        )
+        return avro_reports.evaluation_result(
+            "model-1", "/models/1", "/data/val", ctx,
+            {"AUC": 0.7, "RMSE": 1.2},
+            scores=scores, labels=labels, with_curves=with_curves,
+        )
+
+    def test_evaluation_result_roundtrip(self, tmp_path):
+        rec = self._record(with_curves=True)
+        path = avro_reports.write_evaluation_results(str(tmp_path), [rec])
+        back = list(avro_io.read_container(path))
+        assert len(back) == 1
+        got = back[0]
+        assert got["scalarMetrics"]["AUC"] == pytest.approx(0.7)
+        tc = got["evaluationContext"]["modelTrainingContext"]
+        assert tc["trainingTask"] == "LOGISTIC_REGRESSION"
+        assert tc["convergenceReason"] == "FUNCTION_VALUES_CONVERGED"
+        assert set(got["curves"]) == {"roc", "precisionRecall"}
+        assert got["curves"]["roc"]["points"][0].keys() == {"x", "y"}
+
+    def test_no_curves_mode(self, tmp_path):
+        rec = self._record(with_curves=False)
+        path = avro_reports.write_evaluation_results(str(tmp_path), [rec])
+        assert list(avro_io.read_container(path))[0]["curves"] == {}
+
+    def test_svm_task_maps_to_nearest_enum(self):
+        # TrainingTaskTypeAvro has no SVM symbol; the writer must not emit
+        # an invalid enum value
+        ctx = avro_reports.training_context(
+            TaskType.SMOOTHED_HINGE_LOSS_LINEAR_SVM, 0.0, 1.0, False,
+            "LBFGS", 1e-7, 5, None, "/d",
+        )
+        assert ctx["trainingTask"] == "LOGISTIC_REGRESSION"
+        assert ctx["convergenceReason"] is None
+
+    def test_feature_summaries_roundtrip(self, tmp_path):
+        recs = [{
+            "featureName": "age", "featureTerm": "",
+            "metrics": {"mean": 0.5, "variance": 1.25, "max": 9.0},
+        }]
+        path = avro_reports.write_feature_summaries(str(tmp_path), recs)
+        back = list(avro_io.read_container(path))
+        assert back[0]["featureName"] == "age"
+        assert back[0]["metrics"]["variance"] == pytest.approx(1.25)
+
+    def test_schema_namespace_matches_reference(self):
+        # offline consumers resolve records by full name
+        assert schemas.EVALUATION_RESULT["namespace"] == (
+            "com.linkedin.photon.avro.generated"
+        )
+        assert schemas.EVALUATION_RESULT["name"] == "EvaluationResultAvro"

@@ -408,35 +408,9 @@ def run_config_heart(results, fast):
 # dataset (DriverTest.scala:44-393 trains fixed/random-effect models on it)
 # ---------------------------------------------------------------------------
 
-YAHOO = ("/root/reference/photon-ml/src/integTest/resources/GameIntegTest/"
-         "input/test/yahoo-music-test.avro")
-
-_NTV = {"type": "record", "name": "NameTermValueAvro", "fields": [
-    {"name": "name", "type": "string"},
-    {"name": "term", "type": "string"},
-    {"name": "value", "type": "double"}]}
-_YAHOO_SCHEMA = {"type": "record", "name": "YahooMusicRow", "fields": [
-    {"name": "userId", "type": "long"},
-    {"name": "songId", "type": "long"},
-    {"name": "artistId", "type": "long"},
-    {"name": "numFeatures", "type": "int"},
-    {"name": "response", "type": "double"},
-    {"name": "features", "type": {"type": "array", "items": _NTV}},
-    {"name": "userFeatures", "type": {"type": "array", "items": "NameTermValueAvro"}},
-    {"name": "songFeatures", "type": {"type": "array", "items": "NameTermValueAvro"}}]}
-
-
-def _split_yahoo(tmp):
-    """Deterministic 80/20 split of the shipped yahoo-music avro into
-    train/validation container files readable by the GAME driver."""
-    from photon_ml_tpu.io.avro import read_container, write_container
-
-    recs = list(read_container(YAHOO))
-    train = [r for i, r in enumerate(recs) if i % 5 != 4]
-    val = [r for i, r in enumerate(recs) if i % 5 == 4]
-    write_container(os.path.join(tmp, "train", "data.avro"), train, _YAHOO_SCHEMA)
-    write_container(os.path.join(tmp, "validation", "data.avro"), val, _YAHOO_SCHEMA)
-    return train, val
+# shared with examples/game_yahoo_music.py (import-clean module: hoisted so
+# the example and the parity harness can never train on diverging splits)
+from yahoo_data import split_yahoo as _split_yahoo  # noqa: E402
 
 
 def _ridge_solve_sparse(X, r, lam):

@@ -1,0 +1,44 @@
+"""Shared yahoo-music dataset access (import-clean: no jax config, no env
+mutation) — used by both the parity harness (tools/parity.py) and the
+runnable example (examples/game_yahoo_music.py) so they train on the SAME
+split of the same data.
+
+The dataset is the reference's own shipped GAME e2e fixture
+(GameIntegTest/input/test, trained by cli/game/training/DriverTest).
+"""
+
+import os
+
+YAHOO = ("/root/reference/photon-ml/src/integTest/resources/GameIntegTest/"
+         "input/test/yahoo-music-test.avro")
+
+NTV_SCHEMA = {"type": "record", "name": "NameTermValueAvro", "fields": [
+    {"name": "name", "type": "string"},
+    {"name": "term", "type": "string"},
+    {"name": "value", "type": "double"}]}
+
+YAHOO_SCHEMA = {"type": "record", "name": "YahooMusicRow", "fields": [
+    {"name": "userId", "type": "long"},
+    {"name": "songId", "type": "long"},
+    {"name": "artistId", "type": "long"},
+    {"name": "numFeatures", "type": "int"},
+    {"name": "response", "type": "double"},
+    {"name": "features", "type": {"type": "array", "items": NTV_SCHEMA}},
+    {"name": "userFeatures", "type": {"type": "array", "items": "NameTermValueAvro"}},
+    {"name": "songFeatures", "type": {"type": "array", "items": "NameTermValueAvro"}}]}
+
+
+def split_yahoo(out_dir):
+    """Deterministic 80/20 split of the shipped yahoo-music avro into
+    ``<out_dir>/train/data.avro`` and ``<out_dir>/validation/data.avro``.
+    Returns (train_records, val_records)."""
+    from photon_ml_tpu.io.avro import read_container, write_container
+
+    recs = list(read_container(YAHOO))
+    train = [r for i, r in enumerate(recs) if i % 5 != 4]
+    val = [r for i, r in enumerate(recs) if i % 5 == 4]
+    write_container(os.path.join(out_dir, "train", "data.avro"), train, YAHOO_SCHEMA)
+    write_container(
+        os.path.join(out_dir, "validation", "data.avro"), val, YAHOO_SCHEMA
+    )
+    return train, val
