@@ -39,11 +39,16 @@ faster than baseline.
 """
 
 import json
+import os
 import sys
 import time
 import traceback
 
 import numpy as np
+
+# test-fixture generators (game_test_utils) are imported by the GAME
+# benches; anchor to this file so bench.py runs from any cwd
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), "tests"))
 
 SCAN_ITERS = 50
 STEP = 1e-6
@@ -402,7 +407,6 @@ def _bench_ingest(extra):
 def _bench_game(extra, on_tpu):
     import jax.numpy as jnp
 
-    sys.path.insert(0, "tests")
     from game_test_utils import make_glmix_data
 
     from photon_ml_tpu.algorithm import (
@@ -490,7 +494,6 @@ def _bench_game5(extra, on_tpu):
     Reference analogue: cli/game/training/DriverTest full-model runs."""
     import jax.numpy as jnp
 
-    sys.path.insert(0, "tests")
     from game_test_utils import make_full_game_coords, make_full_game_data
 
     from photon_ml_tpu.algorithm import CoordinateDescent

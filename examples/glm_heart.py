@@ -41,7 +41,8 @@ def main():
         "--delete-output-dirs-if-exist", "true",
     ])
 
-    print("\nstages:", " -> ".join(s.name for s in driver.stage_history))
+    stages = [s.name for s in driver.stage_history] + [driver.stage.name]
+    print("\nstages:", " -> ".join(stages))
     for lam, metrics in sorted(driver.validation_metrics.items()):
         print(f"lambda={lam:<8g} AUROC={metrics['Area under ROC']:.4f}")
     print("best lambda:", driver.best_reg_weight)

@@ -366,6 +366,7 @@ def reference_logistic_value_and_grad(x, y, weights, w, l2: float = 0.0):
 # ---------------------------------------------------------------------------
 
 _autotune_cache: dict = {}
+_autotune_timings: dict = {}  # key -> {candidate: sec/pass} from the race
 
 
 def _time_value_and_grad(vg_fn, w0, data, iters: int = 16) -> float:
@@ -457,6 +458,7 @@ def select_fused_block_rows(
             timings[block] = _time_value_and_grad(fn, w0, probe_data)
         except Exception:
             continue  # a block config that fails to compile is just not a candidate
+    _autotune_timings[key] = dict(timings)
     if not timings:
         _autotune_cache[key] = None
         return None
@@ -466,11 +468,22 @@ def select_fused_block_rows(
 
 
 def autotune_report(loss: PointwiseLoss, n: int, d: int, dtype=jnp.bfloat16) -> dict:
-    """Run the autotune and return {candidate: sec/pass} plus the winner —
-    diagnostic surface for bench.py."""
+    """Run the autotune and return the winner plus the full per-candidate
+    race — sec/pass, examples/sec, and the implied HBM read bandwidth of a
+    single X stream (GB/s; the two-pass XLA entry, key "xla", reads X twice
+    so its effective traffic is 2x the listed figure). Diagnostic surface
+    for bench.py / tools/tpu_capture.py."""
     select_fused_block_rows(loss, n, d, dtype)  # populate cache
     mode = os.environ.get(_FUSED_ENV, "auto")
     platform = jax.devices()[0].platform
     n_probe = min(n, 1 << 17)
     key = (loss.name, n_probe, d, jnp.dtype(dtype).name, platform, mode)
-    return {"winner": _autotune_cache.get(key)}
+    x_bytes = n_probe * d * jnp.dtype(dtype).itemsize
+    candidates = {}
+    for cand, sec in _autotune_timings.get(key, {}).items():
+        candidates["xla" if cand is None else str(cand)] = {
+            "sec_per_pass": round(sec, 6),
+            "examples_per_sec": round(n_probe / sec, 1),
+            "one_stream_gb_per_sec": round(x_bytes / sec / 1e9, 1),
+        }
+    return {"winner": _autotune_cache.get(key), "candidates": candidates}
