@@ -224,14 +224,47 @@ class BucketedRandomEffectCoordinate:
 
     def entity_means_by_raw_id(self, state: Tuple[Array, ...]):
         """{raw entity id: dense global-space coefficient row} (model save)."""
+        return self.entity_export_by_raw_id(state)[0]
+
+    def entity_export_by_raw_id(
+        self, state: Tuple[Array, ...], residual_offsets: Optional[Array] = None
+    ):
+        """(means, variances) dicts keyed by raw entity id in ONE vocab
+        pass. ``variances`` is None unless ``residual_offsets`` is given, in
+        which case it holds per-bucket 1/H_jj at the final coefficients
+        (RandomEffectOptimizationProblem isComputingVariance parity)
+        scattered to global space like the means."""
+        from photon_ml_tpu.algorithm.random_effect import global_coefficients
+
+        mean_stacks = [np.asarray(s) for s in self.global_coefficient_stacks(state)]
+        var_stacks = None
+        if residual_offsets is not None:
+            var_stacks = []
+            for sub, row_sel, w in zip(self._subs, self._row_sels, state):
+                if sub.dataset.projection_matrix is not None:
+                    # back-projecting a diagonal variance through a dense
+                    # random projection is not a diagonal — no per-feature
+                    # variance exists in global space
+                    raise ValueError(
+                        "per-entity variances are not defined in global "
+                        "space for RANDOM-projected datasets"
+                    )
+                local_resid = residual_offsets[jnp.asarray(row_sel)]
+                var = sub.coefficient_variances(
+                    w[: sub.dataset.num_entities], local_resid
+                )
+                var_stacks.append(np.asarray(global_coefficients(sub.dataset, var)))
+
         vocab = self.bundle.vocab
         bucket_of, pos_in_bucket = self.vocab_position_maps()
-        stacks = [np.asarray(s) for s in self.global_coefficient_stacks(state)]
-        out = {}
+        means, variances = {}, ({} if var_stacks is not None else None)
         for vi, raw in enumerate(vocab):
-            if bucket_of[vi] >= 0:
-                out[raw] = stacks[bucket_of[vi]][pos_in_bucket[vi]]
-        return out
+            b = bucket_of[vi]
+            if b >= 0:
+                means[raw] = mean_stacks[b][pos_in_bucket[vi]]
+                if variances is not None:
+                    variances[raw] = var_stacks[b][pos_in_bucket[vi]]
+        return means, variances
 
     # -- diagnostics --------------------------------------------------------
     @property

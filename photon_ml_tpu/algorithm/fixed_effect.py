@@ -70,6 +70,27 @@ class FixedEffectCoordinate:
         w_eff = self.norm.effective_coefficients(coefficients)
         return self.batch.features.matvec(w_eff) + self.norm.margin_shift(w_eff)
 
+    def coefficient_variances(self, coefficients: Array,
+                              residual_offsets: Array) -> Array:
+        """variances = 1/diag(H) at the final coefficients on the
+        residual-offset batch (the computeVariances the reference's
+        problem runs when isComputingVariance,
+        LogisticRegressionOptimizationProblem.scala:109-124) — computed at
+        save time from the final state, one Hessian-diagonal pass."""
+        from photon_ml_tpu.optim.problem import variances_from_hessian_diag
+
+        batch = GLMBatch(
+            self.batch.features,
+            self.batch.labels,
+            self.batch.offsets + residual_offsets,
+            self.batch.weights,
+        )
+        l2 = self.problem.regularization.l2_weight
+        diag = self.problem.objective.hessian_diagonal(
+            coefficients, batch, self.norm, l2
+        )
+        return variances_from_hessian_diag(diag)
+
     def regularization_term(self, coefficients: Array) -> Array:
         return self.problem.regularization_term_value(coefficients)
 

@@ -108,6 +108,38 @@ class RandomEffectCoordinate:
         return results.coefficients, results
 
     # ------------------------------------------------------------------
+    def coefficient_variances(self, coefficients: Array,
+                              residual_offsets: Array) -> Array:
+        """Per-entity coefficient variances = 1 / Hessian-diagonal at the
+        final coefficients, vmapped over entities -> (E, D_loc).
+
+        Parity: RandomEffectOptimizationProblem builds its per-entity
+        problems with the driver's isComputingVariance flag
+        (optimization/game/RandomEffectOptimizationProblem.scala:110-124),
+        each computing variance = 1/H_jj like the fixed effect
+        (LogisticRegressionOptimizationProblem.scala:109-124). Computed
+        lazily at save time (one vmapped pass), not per update.
+        """
+        ds = self.dataset
+        loss = losses_mod.for_task(self.task)
+        obj = GLMObjective(loss)
+        norm = NormalizationContext.identity()
+        l2 = self.regularization.l2_weight
+
+        safe_rows = jnp.maximum(ds.row_index, 0)
+        gathered = residual_offsets[safe_rows]
+        off = ds.base_offsets + jnp.where(ds.row_index >= 0, gathered, 0.0)
+
+        def diag_one(x, y, off_e, w_e, w):
+            batch = GLMBatch(DenseFeatures(x), y, off_e, w_e)
+            return obj.hessian_diagonal(w, batch, norm, l2)
+
+        from photon_ml_tpu.optim.problem import variances_from_hessian_diag
+
+        diag = jax.vmap(diag_one)(ds.x, ds.labels, off, ds.weights, coefficients)
+        return variances_from_hessian_diag(diag)
+
+    # ------------------------------------------------------------------
     def score(self, coefficients: Array) -> Array:
         """Global (N,) scores for ALL rows (active + passive).
 

@@ -303,7 +303,9 @@ class GameTrainingDriver:
                         optimizer=cfg.optimizer,
                         optimizer_config=cfg.optimizer_config(),
                         regularization=cfg.regularization_context(),
-                        compute_variance=p.compute_variance,
+                        # variance is computed ONCE at save time from the
+                        # final state (coefficient_variances), not per
+                        # update inside the CD loop
                     ),
                     down_sampling_rate=(
                         cfg.down_sampling_rate if cfg.down_sampling_rate < 1.0 else None
@@ -585,17 +587,23 @@ class GameTrainingDriver:
         ds = self.re_datasets[name]
         if isinstance(coefficients, FactoredState):
             wg = np.asarray(coefficients.v @ coefficients.matrix)
-        else:
-            # distributed solves pad the entity axis; slice back to E
-            coeffs = jnp.asarray(coefficients)[: ds.num_entities]
-            wg = np.asarray(global_coefficients(ds, coeffs))
+            return self._rows_by_raw_id(name, wg)
+        # distributed solves pad the entity axis; slice back to E
+        coeffs = jnp.asarray(coefficients)[: ds.num_entities]
+        return self._rows_by_raw_id(
+            name, np.asarray(global_coefficients(ds, coeffs))
+        )
+
+    def _rows_by_raw_id(self, name: str, rows: np.ndarray) -> Dict[str, np.ndarray]:
+        """(E, D_global) stack -> {raw entity id: row} via the vocab map."""
+        cfg = self.params.random_effect_data_configs[name]
         pos_of_vocab = self._entity_position_of_vocab(name)
         vocab = self.train_data.id_vocabs[cfg.random_effect_id]
         out: Dict[str, np.ndarray] = {}
         for vi, raw in enumerate(vocab):
             tp = pos_of_vocab[vi]
             if tp >= 0:
-                out[raw] = wg[tp]
+                out[raw] = rows[tp]
         return out
 
     def _entity_latent_factors(self, name: str, state: FactoredState) -> Dict[str, np.ndarray]:
@@ -614,16 +622,45 @@ class GameTrainingDriver:
     def save_models(self, output_dir: str, result: CoordinateDescentResult,
                     combo_index: Optional[int] = None) -> None:
         p = self.params
+
+        def _wants_variances(name) -> bool:
+            """THE --compute-variance gate, shared by every save branch
+            (RandomEffectOptimizationProblem isComputingVariance parity)."""
+            if not p.compute_variance or combo_index is None:
+                return False
+            cfg = p.random_effect_data_configs.get(name)
+            if cfg is not None and cfg.projector == "RANDOM":
+                # a diagonal variance does not survive a dense random
+                # back-projection; the reference has the same limitation
+                self.logger.warn(
+                    f"[{name}] variances skipped: RANDOM-projected space"
+                )
+                return False
+            return True
+
+        def _variances_for(name, coeffs):
+            """Per-coordinate 1/H_jj at the final state; residual = total
+            minus this coordinate's own score."""
+            if not _wants_variances(name):
+                return None
+            coord = self.combo_coords[combo_index].get(name)
+            if coord is None or not hasattr(coord, "coefficient_variances"):
+                return None
+            resid = result.total_scores - coord.score(coeffs)
+            return coord.coefficient_variances(coeffs, resid)
+
         for name in p.updating_sequence:
             coeffs = result.coefficients[name]
             if name in p.fixed_effect_data_configs:
                 spec = p.fixed_effect_data_configs[name]
+                fe_var = _variances_for(name, coeffs)
                 model_io.save_fixed_effect(
                     output_dir,
                     name,
                     p.task_type,
                     np.asarray(coeffs),
                     self.shard_index_maps[spec.feature_shard_id],
+                    variances=None if fe_var is None else np.asarray(fe_var),
                     feature_shard_id=spec.feature_shard_id,
                 )
             else:
@@ -645,17 +682,40 @@ class GameTrainingDriver:
                 else:
                     coord = None
                 cfg = p.random_effect_data_configs[name]
+                entity_variances = None
+                if isinstance(coord, BucketedRandomEffectCoordinate):
+                    resid = (
+                        result.total_scores - coord.score(coeffs)
+                        if _wants_variances(name)
+                        else None
+                    )
+                    entity_means, entity_variances = coord.entity_export_by_raw_id(
+                        coeffs, resid
+                    )
+                else:
+                    entity_means = self._entity_means_global(name, coeffs)
+                    if not isinstance(coeffs, FactoredState):
+                        re_var = _variances_for(name, coeffs)
+                        if re_var is not None:
+                            from photon_ml_tpu.algorithm.random_effect import (
+                                global_coefficients,
+                            )
+
+                            ds = self.re_datasets[name]
+                            entity_variances = self._rows_by_raw_id(
+                                name,
+                                np.asarray(global_coefficients(ds, re_var)),
+                            )
                 model_io.save_random_effect(
                     output_dir,
                     name,
                     p.task_type,
-                    coord.entity_means_by_raw_id(coeffs)
-                    if isinstance(coord, BucketedRandomEffectCoordinate)
-                    else self._entity_means_global(name, coeffs),
+                    entity_means,
                     self.shard_index_maps[cfg.feature_shard_id],
                     random_effect_id=cfg.random_effect_id,
                     feature_shard_id=cfg.feature_shard_id,
                     num_files=p.num_output_files_re_model,
+                    entity_variances=entity_variances,
                 )
                 if isinstance(coeffs, FactoredState):
                     # persist the factored STRUCTURE too (latent coefficients

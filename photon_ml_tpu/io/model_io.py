@@ -141,8 +141,11 @@ def save_random_effect(
     random_effect_id: str = "",
     feature_shard_id: str = "",
     num_files: int = 1,
+    entity_variances: Optional[Dict[str, np.ndarray]] = None,
 ) -> None:
-    """(num_files = numberOfOutputFilesForRandomEffectModel parity.)"""
+    """(num_files = numberOfOutputFilesForRandomEffectModel parity;
+    entity_variances fills the BayesianLinearModelAvro variances list when
+    the driver ran with --compute-variance.)"""
     base = os.path.join(output_dir, RANDOM_EFFECT, name)
     os.makedirs(os.path.join(base, COEFFICIENTS), exist_ok=True)
     with open(os.path.join(base, ID_INFO), "w") as f:
@@ -150,7 +153,8 @@ def save_random_effect(
     items = sorted(entity_means.items())
     shards: List[List[dict]] = [[] for _ in range(max(num_files, 1))]
     for i, (eid, means) in enumerate(items):
-        shards[i % len(shards)].append(_model_record(eid, task, means, None, index_map))
+        var = entity_variances.get(eid) if entity_variances else None
+        shards[i % len(shards)].append(_model_record(eid, task, means, var, index_map))
     for i, recs in enumerate(shards):
         avro_io.write_container(
             os.path.join(base, COEFFICIENTS, f"part-{i:05d}.avro"),
@@ -159,8 +163,12 @@ def save_random_effect(
         )
 
 
-def load_random_effect(input_dir: str, name: str, index_map: IndexMap
-                       ) -> Tuple[Dict[str, np.ndarray], TaskType, str, str]:
+def load_random_effect(
+    input_dir: str, name: str, index_map: IndexMap,
+    variances_out: Optional[Dict[str, np.ndarray]] = None,
+) -> Tuple[Dict[str, np.ndarray], TaskType, str, str]:
+    """Pass ``variances_out`` (a dict) to also collect per-entity variance
+    rows for records that carry them."""
     base = os.path.join(input_dir, RANDOM_EFFECT, name)
     with open(os.path.join(base, ID_INFO)) as f:
         lines = f.read().splitlines()
@@ -169,8 +177,10 @@ def load_random_effect(input_dir: str, name: str, index_map: IndexMap
     out: Dict[str, np.ndarray] = {}
     task = TaskType.LOGISTIC_REGRESSION
     for rec in avro_io.read_directory(os.path.join(base, COEFFICIENTS)):
-        means, _ = _record_to_dense(rec, index_map)
+        means, variances = _record_to_dense(rec, index_map)
         out[rec["modelId"]] = means
+        if variances_out is not None and variances is not None:
+            variances_out[rec["modelId"]] = variances
         if rec.get("modelClass") in schemas.TASK_BY_MODEL_CLASS:
             task = TaskType(schemas.TASK_BY_MODEL_CLASS[rec["modelClass"]])
     return out, task, re_id, shard

@@ -37,6 +37,13 @@ from photon_ml_tpu.types import OptimizerType, RegularizationType, TaskType
 Array = jax.Array
 
 
+def variances_from_hessian_diag(diag: Array) -> Array:
+    """variance = 1/H_jj with the shared numerical floor — THE formula for
+    every coefficient-variance producer (fixed/random/distributed), so the
+    floor cannot drift between call sites."""
+    return 1.0 / jnp.maximum(diag, 1e-12)
+
+
 def _split_reg_weight(reg: RegularizationContext, reg_weight):
     """Split a total regularization weight into (l1, l2) per the context's
     type; ``reg_weight=None`` uses the context's own weight."""
@@ -140,7 +147,7 @@ class GLMOptimizationProblem:
         variances = None
         if self.compute_variance:
             diag = obj.hessian_diagonal(w, batch, norm, l2)
-            variances = 1.0 / jnp.maximum(diag, 1e-12)
+            variances = variances_from_hessian_diag(diag)
         model = GeneralizedLinearModel(Coefficients(w, variances), self.task)
         return model, result
 

@@ -263,6 +263,14 @@ class DistributedRandomEffectSolver:
             results, self._true_entities, self.padded_entities
         )
 
+    def coefficient_variances(self, coefficients: Array,
+                              residual_offsets: Array) -> Array:
+        """Per-entity variances on the REAL entities (padding sliced off);
+        delegates to the unpadded coordinate — a single vmapped
+        Hessian-diagonal pass at save time, not a per-step cost."""
+        trimmed = coefficients[: self._true_entities]
+        return self.coordinate.coefficient_variances(trimmed, residual_offsets)
+
     def score(self, coefficients: Array) -> Array:
         """Global (N,) scores via owner-computes partial reduction.
 
@@ -477,17 +485,22 @@ class DistributedFixedEffectCoordinate:
     def initial_coefficients(self) -> Array:
         return jnp.zeros((self.dim,), real_dtype())
 
-    def update(self, residual_offsets: Array, init_coefficients: Array
-               ) -> Tuple[Array, OptResult]:
+    def _residual_batch(self, residual_offsets: Array) -> GLMBatch:
+        """Sharded batch with the (padded) residuals folded into offsets —
+        the ONE place training and variance offsets are assembled."""
         residuals = jnp.concatenate(
             [residual_offsets, jnp.zeros((self._pad,), residual_offsets.dtype)]
         ) if self._pad else residual_offsets
-        batch = GLMBatch(
+        return GLMBatch(
             self._batch.features,
             self._batch.labels,
             self._batch.offsets + residuals,
             self._batch.weights,
         )
+
+    def update(self, residual_offsets: Array, init_coefficients: Array
+               ) -> Tuple[Array, OptResult]:
+        batch = self._residual_batch(residual_offsets)
         from photon_ml_tpu.data.sampler import maybe_down_sample
 
         batch = maybe_down_sample(
@@ -503,6 +516,19 @@ class DistributedFixedEffectCoordinate:
         w_eff = self.inner.norm.effective_coefficients(coefficients)
         scores = self._batch.features.matvec(w_eff) + self.inner.norm.margin_shift(w_eff)
         return scores[: self._true_rows]
+
+    def coefficient_variances(self, coefficients: Array,
+                              residual_offsets: Array) -> Array:
+        """1/diag(H) on the sharded batch (padding rows carry weight 0 and
+        contribute nothing to the diagonal)."""
+        from photon_ml_tpu.optim.problem import variances_from_hessian_diag
+
+        batch = self._residual_batch(residual_offsets)
+        l2 = self.inner.problem.regularization.l2_weight
+        diag = self.inner.problem.objective.hessian_diagonal(
+            coefficients, batch, self.inner.norm, l2
+        )
+        return variances_from_hessian_diag(diag)
 
     def regularization_term(self, coefficients: Array) -> Array:
         return self.inner.regularization_term(coefficients)

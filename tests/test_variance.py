@@ -124,3 +124,112 @@ def test_variance_roundtrips_through_avro_model_layout(tmp_path):
     np.testing.assert_allclose(got_means, means)
     np.testing.assert_allclose(got_vars, variances)
     assert task == TaskType.LOGISTIC_REGRESSION
+
+
+def _glmix_small(seed=11):
+    import sys
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    from game_test_utils import make_glmix_data
+
+    rng = np.random.default_rng(seed)
+    return make_glmix_data(
+        rng, num_users=10, rows_per_user_range=(15, 30), d_fixed=4, d_random=3
+    )
+
+
+def test_random_effect_per_entity_variance_vs_numpy():
+    """coefficient_variances == 1/diag(H_e) per entity, H_e computed
+    independently in numpy over that entity's own rows."""
+    from photon_ml_tpu.algorithm.random_effect import RandomEffectCoordinate
+    from photon_ml_tpu.data.game import (
+        RandomEffectDataConfig,
+        build_random_effect_dataset,
+    )
+
+    lam = 0.4
+    data, truth = _glmix_small()
+    ds = build_random_effect_dataset(
+        data, RandomEffectDataConfig("userId", "per_user")
+    )
+    coord = RandomEffectCoordinate(
+        ds, TaskType.LOGISTIC_REGRESSION, OptimizerType.LBFGS,
+        OptimizerConfig(max_iterations=60, tolerance=1e-9),
+        RegularizationContext.l2(lam),
+    )
+    resid = jnp.zeros((data.num_rows,))
+    coefs, _ = coord.update(resid, coord.initial_coefficients())
+    var = np.asarray(coord.coefficient_variances(coefs, resid))
+    assert var.shape == (ds.num_entities, ds.local_dim)
+
+    # independent oracle for one entity: rows of user u in original order
+    x_all = truth["x_random"].astype(np.float64)
+    user_of_row = truth["user_of_row"]
+    vocab_idx = {raw: i for i, raw in enumerate(data.id_vocabs["userId"])}
+    entity_pos = np.asarray(ds.entity_pos)
+    w_all = np.asarray(coord.global_coefficients(coefs), np.float64)
+    checked = 0
+    for u in range(3):
+        rows = np.where(user_of_row == u)[0]
+        # tensor position of this user's model
+        tp = entity_pos[rows[0]]
+        if tp < 0:
+            continue
+        xu = x_all[rows]
+        wu = w_all[tp]
+        s = 1 / (1 + np.exp(-(xu @ wu)))
+        h = np.sum((s * (1 - s))[:, None] * xu**2, axis=0) + lam
+        # local_to_global maps local dims; here dims are identity-ordered
+        np.testing.assert_allclose(var[tp], 1.0 / h, rtol=5e-3)
+        checked += 1
+    assert checked >= 2
+
+
+def test_game_driver_persists_re_variances(tmp_path):
+    """--compute-variance true through the GAME driver: BOTH the fixed and
+    the per-entity random-effect avro records carry variances, and they
+    round-trip through load_random_effect."""
+    import sys
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    from test_game_drivers import COMMON_FLAGS, _write_game_avro
+    from game_test_utils import make_glmix_data
+    from photon_ml_tpu.cli import game_training_driver
+    from photon_ml_tpu.io import model_io
+
+    rng = np.random.default_rng(5)
+    gd, truth = make_glmix_data(
+        rng, num_users=8, rows_per_user_range=(20, 30), d_fixed=4, d_random=3
+    )
+    data = {
+        "y": gd.response,
+        "x_fixed": truth["x_fixed"],
+        "x_random": truth["x_random"],
+        "user_raw": [gd.id_vocabs["userId"][i] for i in gd.ids["userId"]],
+    }
+    base = tmp_path / "game"
+    (base / "train").mkdir(parents=True)
+    _write_game_avro(str(base / "train" / "part-0.avro"), data, range(gd.num_rows))
+
+    out = str(base / "out")
+    driver = game_training_driver.main([
+        "--train-input-dirs", str(base / "train"),
+        "--output-dir", out,
+        "--num-iterations", "2",
+        "--compute-variance", "true",
+    ] + COMMON_FLAGS)
+
+    imap = driver.shard_index_maps["per_user"]
+    variances = {}
+    means, task, re_id, shard = model_io.load_random_effect(
+        os.path.join(out, "best"), "per-user", imap, variances_out=variances
+    )
+    assert means and variances, "RE records must carry variances"
+    assert set(variances) == set(means)
+    for eid, v in variances.items():
+        vv = v[v != 0]
+        assert (vv > 0).all() and np.isfinite(vv).all()
+
+    fe_imap = driver.shard_index_maps["global"]
+    _, fe_vars, _, _ = model_io.load_fixed_effect(
+        os.path.join(out, "best"), "fixed", fe_imap
+    )
+    assert fe_vars is not None and (np.asarray(fe_vars) > 0).any()
