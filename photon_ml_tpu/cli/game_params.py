@@ -377,7 +377,11 @@ def build_training_parser() -> argparse.ArgumentParser:
     a("--delete-output-dir-if-exists", default="false")
     a("--application-name", default="photon-ml-tpu-game")
     a("--offheap-indexmap-dir", default=None)
+    a("--offheap-indexmap-num-partitions", type=int, default=1)
     a("--evaluator-type", dest="evaluators", default=None)
+    # accepted-but-obsolete Spark partitioning knob (Params.scala:229-233):
+    # parsed for spark-submit command compatibility, ignored on TPU
+    a("--min-partitions-for-validation", type=int, default=1)
     a("--checkpoint-dir", default=None)
     a("--distributed", default="false")
     a("--fused-cycle", default="false",
@@ -489,7 +493,11 @@ def build_scoring_parser() -> argparse.ArgumentParser:
     a("--delete-output-dir-if-exists", default="false")
     a("--application-name", default="photon-ml-tpu-game-scoring")
     a("--offheap-indexmap-dir", default=None)
+    a("--offheap-indexmap-num-partitions", type=int, default=1)
     a("--evaluator-type", dest="evaluators", default=None)
+    # accepted-but-obsolete Spark partitioning knob (scoring Params.scala):
+    # parsed for spark-submit command compatibility, ignored on TPU
+    a("--min-partitions-for-random-effect-model", type=int, default=1)
     a("--host-scoring", default="false",
       help="force the NumPy host scoring path (device scoring's parity oracle)")
     return p

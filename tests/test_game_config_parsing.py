@@ -146,3 +146,33 @@ class TestShardAndEvaluatorGrammar:
         assert parse_evaluators(None) == []
         with pytest.raises(ValueError):
             parse_evaluators("NOT_A_METRIC")
+
+
+class TestObsoleteSparkFlags:
+    def test_training_parser_accepts_spark_era_flags(self):
+        """A reference spark-submit command migrated verbatim must parse:
+        partitioning knobs are accepted (and ignored on TPU), Appendix A.2."""
+        from photon_ml_tpu.cli.game_params import parse_training_params
+
+        p = parse_training_params([
+            "--train-input-dirs", "/in",
+            "--task-type", "LOGISTIC_REGRESSION",
+            "--output-dir", "/out",
+            "--updating-sequence", "fixed",
+            "--fixed-effect-data-configurations", "fixed:global,4",
+            "--min-partitions-for-validation", "8",
+            "--offheap-indexmap-num-partitions", "2",
+        ])
+        assert p.updating_sequence == ["fixed"]
+
+    def test_scoring_parser_accepts_spark_era_flags(self):
+        from photon_ml_tpu.cli.game_params import parse_scoring_params
+
+        p = parse_scoring_params([
+            "--input-dirs", "/in",
+            "--game-model-input-dir", "/model",
+            "--output-dir", "/out",
+            "--min-partitions-for-random-effect-model", "16",
+            "--offheap-indexmap-num-partitions", "2",
+        ])
+        assert p.output_dir == "/out"
