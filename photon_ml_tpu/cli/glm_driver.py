@@ -114,6 +114,8 @@ class Driver:
         self.best_reg_weight: Optional[float] = None
         self.best_model: Optional[GeneralizedLinearModel] = None
         self.validation_metrics: Dict[float, Dict[str, float]] = {}
+        # lambda -> [metric map per completed iteration] (validate-per-iteration)
+        self.per_iteration_metrics: Dict[float, List[Dict[str, float]]] = {}
         self.problem: Optional[GLMOptimizationProblem] = None
 
     # ------------------------------------------------------------------
@@ -334,6 +336,9 @@ class Driver:
             regularization=self._regularization_context(),
             compute_variance=p.compute_variance,
             constraints=self._constraints(),
+            # per-iteration coefficient snapshots back the ModelTracker-style
+            # validate-per-iteration pass (Driver.scala:292-361)
+            track_coefficients=p.validate_per_iteration,
         )
         from photon_ml_tpu.utils.profiling import maybe_trace
 
@@ -376,6 +381,8 @@ class Driver:
         for lam in sorted(all_metrics):
             for name, value in sorted(all_metrics[lam].items()):
                 self.logger.info(f"lambda={lam:g} {name}: {value:.6g}")
+        if self.params.validate_per_iteration:
+            self._validate_per_iteration()
         self.logger.info(f"best model: lambda={best_lam:g}")
         write_models_in_text(
             [(best_lam, best_model)],
@@ -383,6 +390,37 @@ class Driver:
             self.index_map,
         )
         self._advance(DriverStage.VALIDATED)
+
+    def _validate_per_iteration(self) -> None:
+        """Validation metrics for EVERY iteration's model snapshot
+        (Driver.scala:292-361: computeAndLogModelMetrics over the
+        ModelTrackers). Snapshots live in the solve results'
+        coefficient_history (row 0 = w0, row k = after iteration k);
+        results land in ``self.per_iteration_metrics[lambda]`` as one
+        metric map per completed iteration, and the per-task selection
+        metric is logged per iteration."""
+        from photon_ml_tpu.model_selection import selection_metric_for
+
+        p = self.params
+        sel_metric = selection_metric_for(p.task_type)
+        self.per_iteration_metrics = {}
+        for lam, res in zip(self.trained.weights, self.trained.results):
+            hist = res.coefficient_history
+            if hist is None:
+                continue
+            iters = int(res.iterations)
+            per_iter = []
+            for it in range(1, iters + 1):
+                snap = GeneralizedLinearModel(Coefficients(hist[it]), p.task_type)
+                m = metrics_mod.evaluate(
+                    self._to_raw_space(snap), self.validation_batch
+                )
+                per_iter.append(m)
+                self.logger.info(
+                    f"lambda={lam:g} iteration {it}/{iters} "
+                    f"{sel_metric}: {m[sel_metric]:.6g}"
+                )
+            self.per_iteration_metrics[lam] = per_iter
 
     # ------------------------------------------------------------------
     # stage: diagnose
@@ -395,10 +433,17 @@ class Driver:
         ]
         model_reports: List[ModelDiagnosticReport] = []
 
+        import dataclasses as _dc
+
+        # diagnostics never read coefficient histories — don't let a
+        # --validate-per-iteration run carry (max_iter+1, D) tracking
+        # buffers through every prefix/bootstrap solve
+        diag_problem = _dc.replace(self.problem, track_coefficients=False)
+
         fitting_reports = {}
         if p.diagnostic_mode.runs_train:
             fitting_reports = fitting.diagnose(
-                self.problem,
+                diag_problem,
                 self.train_batch,
                 self.norm,
                 p.regularization_weights,
@@ -491,11 +536,9 @@ class Driver:
 
         if p.diagnostic_mode.runs_train and self.validation_batch is not None:
             # dataset-level bootstrap at the best (or first) lambda
-            import dataclasses as _dc
-
             lam0 = self.best_reg_weight if self.best_reg_weight is not None else self.models[0][0]
             boot_problem = _dc.replace(
-                self.problem,
+                diag_problem,
                 regularization=self.problem.regularization.with_weight(lam0),
             )
             boot = bootstrap_diagnostic.diagnose(

@@ -78,6 +78,10 @@ class GLMOptimizationProblem:
     # single-pass Pallas value+grad kernel block size, set by the runtime
     # autotune (ops.fused_glm.select_fused_block_rows); None = XLA two-pass
     fused_block_rows: Optional[int] = None
+    # carry per-iteration coefficient snapshots through the solve (the
+    # ModelTracker analogue backing --validate-per-iteration; costs
+    # (max_iter+1, D) extra carry memory)
+    track_coefficients: bool = False
 
     def __post_init__(self):
         if self.optimizer_config is None:
@@ -139,9 +143,15 @@ class GLMOptimizationProblem:
 
         if self.optimizer == OptimizerType.TRON:
             hvp = lambda w, v: obj.hessian_vector(w, v, batch, norm, l2)
-            result = tron_minimize_(vg, hvp, w0, self.optimizer_config, bounds=bounds)
+            result = tron_minimize_(
+                vg, hvp, w0, self.optimizer_config, bounds=bounds,
+                track_coefficients=self.track_coefficients,
+            )
         else:
-            result = lbfgs_minimize_(vg, w0, self.optimizer_config, l1_weight=l1, bounds=bounds)
+            result = lbfgs_minimize_(
+                vg, w0, self.optimizer_config, l1_weight=l1, bounds=bounds,
+                track_coefficients=self.track_coefficients,
+            )
 
         w = result.coefficients
         variances = None

@@ -438,3 +438,37 @@ class TestCommandLine:
             ]
         )
         assert driver.stage == DriverStage.TRAINED
+
+
+class TestValidatePerIteration:
+    def test_per_iteration_metrics_logged_and_stored(self, libsvm_dirs):
+        """--validate-per-iteration: validation metrics for EVERY
+        iteration's model snapshot (Driver.scala:292-361 ModelTracker
+        pass); the final iteration's metrics equal the final model's."""
+        train, val, out = libsvm_dirs
+        driver = Driver(_base_params(
+            train, out,
+            validating_data_dir=val,
+            validate_per_iteration=True,
+            regularization_weights=[1.0],
+        ))
+        driver.run()
+        assert 1.0 in driver.per_iteration_metrics
+        per_iter = driver.per_iteration_metrics[1.0]
+        assert len(per_iter) >= 2  # converged over several iterations
+        final = per_iter[-1]["Area under ROC"]
+        assert final == pytest.approx(
+            driver.validation_metrics[1.0]["Area under ROC"], abs=1e-6
+        )
+        # the trajectory's AUC improves from the first snapshot to the last
+        assert final >= per_iter[0]["Area under ROC"] - 1e-6
+
+    def test_off_by_default(self, libsvm_dirs):
+        train, val, out = libsvm_dirs
+        driver = Driver(_base_params(
+            train, out, validating_data_dir=val, regularization_weights=[1.0]
+        ))
+        driver.run()
+        assert driver.per_iteration_metrics == {}
+        # and no tracking memory was carried
+        assert driver.trained.results[0].coefficient_history is None
