@@ -24,4 +24,42 @@ from photon_ml_tpu.types import TaskType
 
 __version__ = "0.1.0"
 
-__all__ = ["TaskType", "__version__"]
+# lazy convenience exports (PEP 562): the common entry points are reachable
+# as photon_ml_tpu.<Name> without paying their import cost (jax tracing,
+# optimizer kernels) at package-import time — CLI startup stays light
+_LAZY = {
+    "OptimizerType": "photon_ml_tpu.types",
+    "ConvergenceReason": "photon_ml_tpu.types",
+    "OptimizerConfig": "photon_ml_tpu.optim.common",
+    "GLMOptimizationProblem": "photon_ml_tpu.optim.problem",
+    "RegularizationContext": "photon_ml_tpu.ops.regularization",
+    "NormalizationContext": "photon_ml_tpu.ops.normalization",
+    "GLMBatch": "photon_ml_tpu.ops.objective",
+    "DenseFeatures": "photon_ml_tpu.ops.features",
+    "SparseFeatures": "photon_ml_tpu.ops.features",
+    "GeneralizedLinearModel": "photon_ml_tpu.models.glm",
+    "Coefficients": "photon_ml_tpu.models.glm",
+    "CoordinateDescent": "photon_ml_tpu.algorithm",
+    "FixedEffectCoordinate": "photon_ml_tpu.algorithm",
+    "RandomEffectCoordinate": "photon_ml_tpu.algorithm",
+    "area_under_roc_curve": "photon_ml_tpu.evaluation",
+    "read_libsvm": "photon_ml_tpu.io.libsvm",
+    "to_batch": "photon_ml_tpu.io.libsvm",
+    "train_glm_grid": "photon_ml_tpu.training",
+    "MeshContext": "photon_ml_tpu.parallel",
+    "data_mesh": "photon_ml_tpu.parallel",
+}
+
+__all__ = ["TaskType", "__version__", *sorted(_LAZY)]
+
+
+def __getattr__(name: str):
+    if name in _LAZY:
+        import importlib
+
+        return getattr(importlib.import_module(_LAZY[name]), name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+
+
+def __dir__():
+    return sorted(set(globals()) | set(_LAZY))

@@ -411,10 +411,17 @@ class Driver:
             iters = int(res.iterations)
             per_iter = []
             for it in range(1, iters + 1):
-                snap = GeneralizedLinearModel(Coefficients(hist[it]), p.task_type)
-                m = metrics_mod.evaluate(
-                    self._to_raw_space(snap), self.validation_batch
-                )
+                if it == iters and lam in self.validation_metrics:
+                    # hist[iters] IS the final model — its metrics were
+                    # already computed during model selection
+                    m = self.validation_metrics[lam]
+                else:
+                    snap = GeneralizedLinearModel(
+                        Coefficients(hist[it]), p.task_type
+                    )
+                    m = metrics_mod.evaluate(
+                        self._to_raw_space(snap), self.validation_batch
+                    )
                 per_iter.append(m)
                 self.logger.info(
                     f"lambda={lam:g} iteration {it}/{iters} "

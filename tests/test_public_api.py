@@ -1,0 +1,42 @@
+"""Package-level public API: lazy exports resolve and the quickstart flow
+works through them alone (the MIGRATION.md Python-API example)."""
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+import photon_ml_tpu as pml
+
+
+def test_every_lazy_export_resolves():
+    for name in pml.__all__:
+        assert getattr(pml, name) is not None, name
+    with pytest.raises(AttributeError):
+        pml.does_not_exist
+
+
+def test_quickstart_through_package_namespace(tmp_path):
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(300, 4)).astype(np.float32)
+    w = np.asarray([1.0, -1.5, 0.5, 2.0], np.float32)
+    y = (1 / (1 + np.exp(-(x @ w))) > rng.random(300)).astype(int)
+    path = tmp_path / "train.txt"
+    with open(path, "w") as f:
+        for i in range(300):
+            feats = " ".join(f"{j+1}:{x[i,j]:.5f}" for j in range(4))
+            f.write(f"{2*y[i]-1} {feats}\n")
+
+    batch = pml.to_batch(pml.read_libsvm(str(path)), dense=True)
+    prob = pml.GLMOptimizationProblem(
+        pml.TaskType.LOGISTIC_REGRESSION,
+        pml.OptimizerType.LBFGS,
+        pml.OptimizerConfig.lbfgs_default(),
+        pml.RegularizationContext.l2(1.0),
+    )
+    model, res = prob.run(batch, pml.NormalizationContext.identity())
+    auc = float(pml.area_under_roc_curve(
+        model.compute_mean_functions(batch), batch.labels, batch.weights
+    ))
+    assert auc > 0.85
+    assert res.iterations > 0
+    assert "GLMOptimizationProblem" in dir(pml)
