@@ -558,6 +558,37 @@ def main():
     base_eps, _, _ = _numpy_baseline(x_h, y_h, np.zeros(D_DENSE, np.float32))
     _log(f"baseline(numpy): {base_eps:.3e} ex/s")
 
+    partial_path = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "bench_partial.json"
+    )
+    try:
+        # a STALE checkpoint from a prior run must never masquerade as this
+        # run's crash state
+        os.unlink(partial_path)
+    except OSError:
+        pass
+
+    def _save_partial():
+        """Checkpoint progress to a side file after every section: if an
+        external supervisor kills this process mid-run (observed risk: a
+        long autotune race over a slow tunnel), the completed sections
+        survive for post-mortem even though the stdout line never printed."""
+        try:
+            snap = {
+                "partial": True,
+                "value": round(value, 1),
+                "vs_baseline": round(vs_baseline, 3),
+                "platform": platform,
+                **extra,
+            }
+            if errors:
+                snap["errors"] = {k: str(v)[:500] for k, v in errors.items()}
+            with open(partial_path + ".tmp", "w") as f:
+                json.dump(snap, f, indent=1)
+            os.replace(partial_path + ".tmp", partial_path)
+        except Exception:  # noqa: BLE001 — never let bookkeeping kill the bench
+            pass
+
     devices = _init_backend(errors)
     if devices is not None:
         from photon_ml_tpu.ops.fused_glm import _on_tpu
@@ -570,26 +601,32 @@ def main():
         except Exception:
             errors["dense"] = traceback.format_exc(limit=3)
         del x_h, y_h
+        _save_partial()
         try:
             _bench_sparse(extra, on_tpu)
         except Exception:
             errors["sparse"] = traceback.format_exc(limit=3)
+        _save_partial()
         try:
             _bench_game(extra, on_tpu)
         except Exception:
             errors["game"] = traceback.format_exc(limit=3)
+        _save_partial()
         try:
             _bench_game5(extra, on_tpu)
         except Exception:
             errors["game5"] = traceback.format_exc(limit=3)
+        _save_partial()
         try:
             _bench_scoring(extra, on_tpu)
         except Exception:
             errors["scoring"] = traceback.format_exc(limit=3)
+        _save_partial()
         try:
             _bench_ingest(extra)
         except Exception:
             errors["ingest"] = traceback.format_exc(limit=3)
+        _save_partial()
 
     payload = {
         "metric": METRIC,

@@ -2,7 +2,6 @@
 works through them alone (the MIGRATION.md Python-API example)."""
 
 import numpy as np
-import jax.numpy as jnp
 import pytest
 
 import photon_ml_tpu as pml
