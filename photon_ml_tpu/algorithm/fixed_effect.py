@@ -43,12 +43,14 @@ class FixedEffectCoordinate:
     def initial_coefficients(self) -> Array:
         return jnp.zeros((self.dim,), real_dtype())
 
-    def update(self, residual_offsets: Array, init_coefficients: Array
-               ) -> Tuple[Array, OptResult]:
+    def update(self, residual_offsets: Array, init_coefficients: Array,
+               reg_weight: Optional[Array] = None) -> Tuple[Array, OptResult]:
         """Solve on residuals: offsets = base + other coordinates' scores.
 
         (Coordinate.updateModel = addScoresToOffsets -> solve,
-        Coordinate.scala:43-49.)
+        Coordinate.scala:43-49.) ``reg_weight`` overrides the problem's
+        total regularization weight as a TRACED scalar — the lambda-grid
+        vmap axis (updateObjective analogue).
         """
         from photon_ml_tpu.data.sampler import maybe_down_sample
 
@@ -61,7 +63,9 @@ class FixedEffectCoordinate:
         batch = maybe_down_sample(
             batch, self.problem.task, self.down_sampling_rate, self.seed
         )
-        model, result = self.problem.run(batch, self.norm, init_coefficients)
+        model, result = self.problem.run(
+            batch, self.norm, init_coefficients, reg_weight=reg_weight
+        )
         return model.coefficients.means, result
 
     def score(self, coefficients: Array) -> Array:
@@ -91,8 +95,9 @@ class FixedEffectCoordinate:
         )
         return variances_from_hessian_diag(diag)
 
-    def regularization_term(self, coefficients: Array) -> Array:
-        return self.problem.regularization_term_value(coefficients)
+    def regularization_term(self, coefficients: Array,
+                            reg_weight: Optional[Array] = None) -> Array:
+        return self.problem.regularization_term_value(coefficients, reg_weight)
 
     def model(self, coefficients: Array) -> GeneralizedLinearModel:
         from photon_ml_tpu.models.glm import Coefficients

@@ -71,25 +71,28 @@ class RandomEffectCoordinate:
         return jnp.zeros((self.num_entities, self.local_dim), real_dtype())
 
     # ------------------------------------------------------------------
-    def update(self, residual_offsets: Array, init_coefficients: Array
-               ) -> Tuple[Array, OptResult]:
+    def update(self, residual_offsets: Array, init_coefficients: Array,
+               reg_weight: Optional[Array] = None) -> Tuple[Array, OptResult]:
         """Solve every entity's local problem (vmapped).
 
         ``residual_offsets`` is the global (N,) residual-score vector from
         the other coordinates; it is gathered into the entity-major layout
         (the addScoresToOffsets of RandomEffectDataSet.scala:57-74, as a
-        gather instead of a join).
+        gather instead of a join). ``reg_weight`` overrides the context's
+        total regularization weight as a TRACED scalar (the lambda-grid
+        vmap axis).
 
         Returns stacked coefficients (E, D_loc) and the vmapped OptResult
         (every field gains a leading entity axis — this is the
         RandomEffectOptimizationTracker's raw material).
         """
+        from photon_ml_tpu.optim.problem import _split_reg_weight
+
         ds = self.dataset
         loss = losses_mod.for_task(self.task)
         obj = GLMObjective(loss)
         norm = NormalizationContext.identity()
-        l1 = self.regularization.l1_weight
-        l2 = self.regularization.l2_weight
+        l1, l2 = _split_reg_weight(self.regularization, reg_weight)
         cfg = self.optimizer_config
 
         safe_rows = jnp.maximum(ds.row_index, 0)
@@ -154,11 +157,13 @@ class RandomEffectCoordinate:
         return jnp.sum(jnp.where(valid, coefs * ds.feat_val, 0.0), axis=-1)
 
     # ------------------------------------------------------------------
-    def regularization_term(self, coefficients: Array) -> Array:
+    def regularization_term(self, coefficients: Array,
+                            reg_weight: Optional[Array] = None) -> Array:
         """Sum of per-entity regularization terms
         (RandomEffectOptimizationProblem.getRegularizationTermValue)."""
-        l1 = self.regularization.l1_weight
-        l2 = self.regularization.l2_weight
+        from photon_ml_tpu.optim.problem import _split_reg_weight
+
+        l1, l2 = _split_reg_weight(self.regularization, reg_weight)
         return l1 * jnp.sum(jnp.abs(coefficients)) + 0.5 * l2 * jnp.sum(
             jnp.square(coefficients)
         )

@@ -304,6 +304,11 @@ class GameTrainingParams:
     # per-bucket padding on skewed entity distributions; composes with
     # --distributed (each bucket entity-shards over the mesh)
     bucketed_random_effects: bool = False
+    # train every lambda combo of the grid simultaneously as a vmap axis
+    # over the descent cycle (CoordinateDescent.run_grid); falls back to
+    # the sequential grid when combos differ beyond lambda or the run uses
+    # distributed/bucketed/factored coordinates, checkpoints, or variance
+    vmapped_grid: bool = False
 
     def validate(self) -> None:
         errors = []
@@ -391,6 +396,10 @@ def build_training_parser() -> argparse.ArgumentParser:
       help="partition random-effect entities into size buckets (per-bucket "
            "padding on skewed entity distributions; composes with "
            "--distributed)")
+    a("--vmapped-grid", default="false",
+      help="train every lambda combo of the grid simultaneously (one vmapped "
+           "descent instead of sequential combos; lambda-only grids on plain "
+           "fixed/random coordinates)")
     return p
 
 
@@ -434,6 +443,7 @@ def parse_training_params(argv: Optional[List[str]] = None) -> GameTrainingParam
         distributed=_truthy(ns.distributed),
         fused_cycle=_truthy(ns.fused_cycle),
         bucketed_random_effects=_truthy(ns.bucketed_random_effects),
+        vmapped_grid=_truthy(ns.vmapped_grid),
     )
     params.validate()
     return params

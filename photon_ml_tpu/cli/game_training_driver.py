@@ -47,7 +47,7 @@ from photon_ml_tpu.io import model_io
 from photon_ml_tpu.io.index_map import IndexMap
 from photon_ml_tpu.ops import losses as losses_mod
 from photon_ml_tpu.optim.problem import GLMOptimizationProblem
-from photon_ml_tpu.types import ModelOutputMode, OptimizerType, TaskType
+from photon_ml_tpu.types import ModelOutputMode, OptimizerType, TaskType, real_dtype
 from photon_ml_tpu.utils.io_utils import prepare_output_dir
 from photon_ml_tpu.utils.logging import PhotonLogger
 from photon_ml_tpu.utils.timer import Timer
@@ -515,12 +515,99 @@ class GameTrainingDriver:
         return out
 
     # ------------------------------------------------------------------
+    def _vmapped_grid_blocker(self, combos) -> Optional[str]:
+        """Why --vmapped-grid cannot apply, or None when it can: the grid
+        must vary ONLY per-coordinate lambda on plain fixed/random
+        coordinates, with no orthogonal machinery that cannot nest under
+        vmap (sharding) or that needs per-combo static coordinates."""
+        p = self.params
+        if len(combos) < 2:
+            return "grid has a single combo"
+        if p.distributed:
+            return "--distributed (shard_map cannot nest under the combo vmap)"
+        if p.bucketed_random_effects:
+            return "--bucketed-random-effects (static per-bucket lambdas)"
+        if p.factored_configs:
+            return "factored coordinates (lambda lives in nested configs)"
+        if p.compute_variance:
+            return "--compute-variance (save-time Hessians need per-combo statics)"
+        if p.checkpoint_dir:
+            return "--checkpoint-dir (no per-update checkpoints in a vmapped grid)"
+        import dataclasses as _dc
+
+        for name in p.updating_sequence:
+            # compare configs with lambda zeroed: any OTHER field differing
+            # blocks the vmap (and a future CoordinateOptConfig field
+            # automatically participates in this check)
+            non_lambda = {
+                _dc.replace(c.get(name, CoordinateOptConfig()), reg_weight=0.0)
+                for c in combos
+            }
+            if len(non_lambda) > 1:
+                return f"combos vary beyond lambda for coordinate {name!r}"
+        return None
+
+    def _train_vmapped_grid(self, combos, loss_fn) -> None:
+        """All grid combos in ONE vmapped descent (CoordinateDescent.
+        run_grid); results and best_index land in self.results exactly
+        like the sequential path."""
+        p = self.params
+        coords = self._build_coordinates(combos[0])
+        scorer = None
+        evaluators = None
+        primary = None
+        if self.validation_data is not None:
+            scorer = self._validation_scorer(coords)
+            evaluators = self._validation_evaluators()
+            if evaluators:
+                primary = next(iter(evaluators))
+        lam = {
+            name: jnp.asarray(
+                [c.get(name, CoordinateOptConfig()).reg_weight for c in combos],
+                real_dtype(),
+            )
+            for name in p.updating_sequence
+        }
+        cd = CoordinateDescent(coords, loss_fn, scorer, evaluators)
+        from photon_ml_tpu.utils.profiling import maybe_trace
+
+        with self.timer.measure("vmapped-grid"), maybe_trace("game-vmapped-grid"):
+            grid_results = cd.run_grid(
+                lam, p.num_iterations, self.train_data.num_rows
+            )
+        best_value: Optional[float] = None
+        for i, (opt_configs, result) in enumerate(zip(combos, grid_results)):
+            metrics = result.validation_history[-1] if result.validation_history else {}
+            self.combo_coords.append(coords)
+            self.results.append((opt_configs, result, metrics))
+            self.logger.info(
+                f"combo {i} (vmapped): objective={result.objective_history[-1]:.6g} "
+                + " ".join(f"{k}={v:.6g}" for k, v in metrics.items())
+            )
+            if primary is not None and metrics:
+                ev = evaluators[primary][0]
+                value = metrics[primary]
+                if best_value is None or ev.better_than(value, best_value):
+                    best_value = value
+                    self.best_index = i
+
+    # ------------------------------------------------------------------
     def train(self) -> None:
         p = self.params
         loss_fn = self._training_loss_fn()
         combos = p.config_grid()
         primary: Optional[str] = None
         best_value: Optional[float] = None
+
+        if p.vmapped_grid:
+            blocker = self._vmapped_grid_blocker(combos)
+            if blocker is None:
+                self._train_vmapped_grid(combos, loss_fn)
+                return
+            self.logger.warn(
+                f"--vmapped-grid requested but falling back to the "
+                f"sequential grid: {blocker}"
+            )
 
         for i, opt_configs in enumerate(combos):
             coords = self._build_coordinates(opt_configs)

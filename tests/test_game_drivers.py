@@ -527,6 +527,71 @@ class TestGridSearch:
         assert aucs[0] > aucs[1] + 0.01  # lambda=1000 visibly hurts
 
 
+class TestVmappedGrid:
+    def test_vmapped_grid_matches_sequential(self, game_avro_dirs, tmp_path):
+        """--vmapped-grid trains every lambda combo in one vmapped descent;
+        per-combo metrics and the selected best match the sequential grid."""
+        train_dir, val_dir, _ = game_avro_dirs
+        flags = [f for f in COMMON_FLAGS]
+        i = flags.index("--fixed-effect-optimization-configurations")
+        flags[i + 1] = "fixed:50,1e-7,0.01,1,LBFGS,L2;fixed:50,1e-7,1000,1,LBFGS,L2"
+        base_args = [
+            "--train-input-dirs", train_dir,
+            "--validate-input-dirs", val_dir,
+            "--num-iterations", "1",
+        ]
+        seq = game_training_driver.main(
+            base_args + ["--output-dir", str(tmp_path / "seq")] + flags
+        )
+        vm = game_training_driver.main(
+            base_args
+            + ["--output-dir", str(tmp_path / "vm"), "--vmapped-grid", "true"]
+            + flags
+        )
+        assert len(vm.results) == len(seq.results) == 2
+        assert vm.best_index == seq.best_index
+        for (_, rv, mv), (_, rs, ms) in zip(vm.results, seq.results):
+            assert mv["AUC"] == pytest.approx(ms["AUC"], abs=5e-4)
+            np.testing.assert_allclose(
+                np.asarray(rv.objective_history),
+                np.asarray(rs.objective_history),
+                rtol=1e-4,
+            )
+        assert "(vmapped-grid)" in vm.results[0][1].timings
+        # the saved best model matches the sequential best
+        from photon_ml_tpu.io import model_io
+
+        imap = vm.shard_index_maps["global"]
+        mv_means, *_ = model_io.load_fixed_effect(
+            str(tmp_path / "vm" / "best"), "fixed", imap
+        )
+        ms_means, *_ = model_io.load_fixed_effect(
+            str(tmp_path / "seq" / "best"), "fixed", imap
+        )
+        np.testing.assert_allclose(mv_means, ms_means, rtol=2e-3, atol=2e-4)
+
+    def test_vmapped_grid_falls_back_when_ineligible(self, game_avro_dirs, tmp_path):
+        """Combos varying beyond lambda -> sequential fallback (logged),
+        same results structure."""
+        train_dir, val_dir, _ = game_avro_dirs
+        flags = [f for f in COMMON_FLAGS]
+        i = flags.index("--fixed-effect-optimization-configurations")
+        # optimizer differs between combos -> not a lambda-only grid
+        flags[i + 1] = "fixed:50,1e-7,0.01,1,LBFGS,L2;fixed:15,1e-5,0.01,1,TRON,L2"
+        driver = game_training_driver.main(
+            [
+                "--train-input-dirs", train_dir,
+                "--validate-input-dirs", val_dir,
+                "--output-dir", str(tmp_path / "out"),
+                "--num-iterations", "1",
+                "--vmapped-grid", "true",
+            ]
+            + flags
+        )
+        assert len(driver.results) == 2  # sequential path still ran the grid
+        assert "(vmapped-grid)" not in driver.results[0][1].timings
+
+
 class TestDateRangeDiscovery:
     def test_training_with_daily_layout(self, game_avro_dirs, tmp_path):
         import shutil

@@ -1,0 +1,157 @@
+"""Vmapped lambda-grid coordinate descent: all combos in one batched
+program, matching per-combo sequential descents.
+
+(The GAME analogue of train_glm_grid_vmapped; the reference re-runs the
+whole driver per grid combo, cli/game/training/Driver.scala:330-337.)
+"""
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from photon_ml_tpu.algorithm import (
+    CoordinateDescent,
+    FixedEffectCoordinate,
+    RandomEffectCoordinate,
+)
+from photon_ml_tpu.data.game import (
+    RandomEffectDataConfig,
+    build_fixed_effect_batch,
+    build_random_effect_dataset,
+)
+from photon_ml_tpu.evaluation.evaluators import EvaluatorType, evaluator_for
+from photon_ml_tpu.ops import losses
+from photon_ml_tpu.optim.common import OptimizerConfig
+from photon_ml_tpu.optim.problem import GLMOptimizationProblem
+from photon_ml_tpu.ops.regularization import RegularizationContext
+from photon_ml_tpu.types import OptimizerType, TaskType
+
+from game_test_utils import make_glmix_data
+
+
+@pytest.fixture(scope="module")
+def setup():
+    rng = np.random.default_rng(21)
+    data, _ = make_glmix_data(
+        rng, num_users=12, rows_per_user_range=(15, 35), d_fixed=5, d_random=3
+    )
+    labels = jnp.asarray(data.response)
+    loss_fn = lambda s: jnp.sum(losses.logistic.loss(s, labels))
+    return data, labels, loss_fn
+
+
+def _coords(data, fe_lam, re_lam):
+    fixed = FixedEffectCoordinate(
+        build_fixed_effect_batch(data, "global", dense=True),
+        GLMOptimizationProblem(
+            TaskType.LOGISTIC_REGRESSION, OptimizerType.LBFGS,
+            OptimizerConfig(max_iterations=25, tolerance=1e-8),
+            RegularizationContext.l2(fe_lam),
+        ),
+    )
+    random = RandomEffectCoordinate(
+        build_random_effect_dataset(
+            data, RandomEffectDataConfig("userId", "per_user")
+        ),
+        TaskType.LOGISTIC_REGRESSION, OptimizerType.LBFGS,
+        OptimizerConfig(max_iterations=20, tolerance=1e-7),
+        RegularizationContext.l2(re_lam),
+    )
+    return {"fixed": fixed, "random": random}
+
+
+def test_grid_matches_sequential_runs(setup):
+    data, labels, loss_fn = setup
+    n = data.num_rows
+    fe_lams = [0.01, 0.1, 1.0]
+    re_lams = [0.05, 0.5, 5.0]
+
+    # vmapped grid: base coordinates at combo-0 lambdas, overridden per lane
+    cd = CoordinateDescent(_coords(data, fe_lams[0], re_lams[0]), loss_fn)
+    grid_results = cd.run_grid(
+        {"fixed": jnp.asarray(fe_lams), "random": jnp.asarray(re_lams)},
+        num_iterations=2, num_rows=n,
+    )
+    assert len(grid_results) == 3
+
+    for g, (fl, rl) in enumerate(zip(fe_lams, re_lams)):
+        seq = CoordinateDescent(_coords(data, fl, rl), loss_fn).run(
+            num_iterations=2, num_rows=n
+        )
+        np.testing.assert_allclose(
+            np.asarray(grid_results[g].objective_history),
+            np.asarray(seq.objective_history),
+            rtol=1e-4,
+        )
+        for name in ("fixed", "random"):
+            np.testing.assert_allclose(
+                np.asarray(grid_results[g].coefficients[name]),
+                np.asarray(seq.coefficients[name]),
+                rtol=2e-3, atol=2e-4,
+            )
+        np.testing.assert_allclose(
+            np.asarray(grid_results[g].total_scores),
+            np.asarray(seq.total_scores),
+            rtol=2e-3, atol=2e-3,
+        )
+
+
+def test_grid_validation_evaluators(setup):
+    data, labels, loss_fn = setup
+    n = data.num_rows
+    # validation = training data here (wiring test, not generalization)
+    auc = evaluator_for(EvaluatorType.AUC)
+    cd = CoordinateDescent(
+        _coords(data, 0.01, 0.1), loss_fn,
+        validation_scorer=lambda params: sum(
+            cd_coords[name].score(params[name]) for name in cd_coords
+        ),
+        validation_evaluators={"AUC": (auc, {"labels": labels})},
+    )
+    cd_coords = cd.coordinates
+    results = cd.run_grid(
+        {"fixed": jnp.asarray([0.01, 10.0]), "random": jnp.asarray([0.1, 10.0])},
+        num_iterations=1, num_rows=n,
+    )
+    # 2 updates per iteration -> 2 validation entries each
+    for r in results:
+        assert len(r.validation_history) == 2
+        assert 0.4 < r.validation_history[-1]["AUC"] <= 1.0
+    # the lightly-regularized combo must fit better than lambda=10
+    assert (
+        results[0].validation_history[-1]["AUC"]
+        > results[1].validation_history[-1]["AUC"]
+    )
+
+
+def test_grid_rejects_unsupported_coordinates(setup):
+    data, labels, loss_fn = setup
+
+    class NoGridCoord:
+        def initial_coefficients(self):
+            return jnp.zeros((3,))
+
+        def update(self, off, w0):  # no reg_weight
+            return w0, None
+
+        def score(self, w):
+            return jnp.zeros((10,))
+
+        def regularization_term(self, w):
+            return jnp.asarray(0.0)
+
+    cd = CoordinateDescent({"c": NoGridCoord()}, loss_fn)
+    with pytest.raises(ValueError, match="reg_weight"):
+        cd.run_grid({"c": jnp.asarray([1.0])}, num_iterations=1, num_rows=10)
+
+
+def test_grid_shape_validation(setup):
+    data, labels, loss_fn = setup
+    cd = CoordinateDescent(_coords(data, 0.1, 0.1), loss_fn)
+    with pytest.raises(ValueError, match="keys"):
+        cd.run_grid({"fixed": jnp.asarray([1.0])}, 1, data.num_rows)
+    with pytest.raises(ValueError, match=r"\(G,\)"):
+        cd.run_grid(
+            {"fixed": jnp.asarray([1.0, 2.0]), "random": jnp.asarray([1.0])},
+            1, data.num_rows,
+        )
