@@ -487,6 +487,42 @@ def _bench_game(extra, on_tpu):
         float(area_under_roc_curve(result.total_scores, labels)), 4
     )
 
+    # lambda-grid: all G combos as ONE vmapped descent vs G sequential
+    # descents (CoordinateDescent.run_grid; the reference re-runs its
+    # driver per combo). WARM-vs-WARM comparison: both sides pre-compiled,
+    # so the speedup is the batched-arithmetic win alone (the sequential
+    # grid additionally pays one compile per combo in real drivers, which
+    # the vmapped path also eliminates — not counted here).
+    import jax
+
+    g_lams = [0.01, 0.1, 1.0, 10.0]
+    cd_g = CoordinateDescent({"fixed": fixed, "random": random_c}, loss_fn)
+    lam = {
+        "fixed": jnp.asarray(g_lams),
+        "random": jnp.asarray([0.1] * len(g_lams)),
+    }
+    cd_g.run_grid(lam, num_iterations=1, num_rows=n)  # compile + warm
+    t0 = time.perf_counter()
+    grid_results = cd_g.run_grid(lam, num_iterations=2, num_rows=n)
+    jax.block_until_ready(grid_results[-1].total_scores)
+    t_vmapped = time.perf_counter() - t0
+
+    seq_cd = CoordinateDescent({"fixed": fixed, "random": random_c}, loss_fn)
+    lam1 = lambda gl: {"fixed": jnp.asarray([gl]), "random": jnp.asarray([0.1])}
+    seq_cd.run_grid(lam1(g_lams[0]), num_iterations=1, num_rows=n)  # warm
+    t0 = time.perf_counter()
+    for gl in g_lams:
+        r = seq_cd.run_grid(lam1(gl), num_iterations=2, num_rows=n)
+    jax.block_until_ready(r[-1].total_scores)
+    t_seq = time.perf_counter() - t0
+    _log(
+        f"GAME lambda-grid x{len(g_lams)}: vmapped {t_vmapped:.3f}s vs "
+        f"sequential(warm) {t_seq:.3f}s ({t_seq / t_vmapped:.2f}x)"
+    )
+    extra["game_grid_vmapped_sec"] = round(t_vmapped, 3)
+    extra["game_grid_sequential_warm_sec"] = round(t_seq, 3)
+    extra["game_grid_speedup"] = round(t_seq / t_vmapped, 2)
+
 
 def _bench_game5(extra, on_tpu):
     """Full-GAME shape (BASELINE config 5): fixed + per-user RE + per-item
