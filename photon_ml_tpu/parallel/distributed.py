@@ -134,29 +134,37 @@ def trim_entity_tracker(results, true_entities: int, padded_entities: int):
     )
 
 
+def pad_re_dataset_entities(ds: RandomEffectDataset, n_dev: int
+                            ) -> RandomEffectDataset:
+    """Pad the entity axis to a device multiple (weight-0/-1 padding lanes);
+    pure host-side pad, no placement — THE one place the pad fills live
+    (single-host sharding and the multi-host slab assembler both use it)."""
+    e = ds.num_entities
+    target = ((e + n_dev - 1) // n_dev) * n_dev
+    if target == e:
+        return ds
+    return RandomEffectDataset(
+        row_index=pad_leading(ds.row_index, n_dev, -1),
+        x=pad_leading(ds.x, n_dev, 0.0),
+        labels=pad_leading(ds.labels, n_dev, 0.0),
+        base_offsets=pad_leading(ds.base_offsets, n_dev, 0.0),
+        weights=pad_leading(ds.weights, n_dev, 0.0),  # weight 0 = pad
+        entity_pos=ds.entity_pos,
+        feat_idx=ds.feat_idx,
+        feat_val=ds.feat_val,
+        local_to_global=pad_leading(ds.local_to_global, n_dev, -1),
+        num_entities=target,
+        global_dim=ds.global_dim,
+        projection_matrix=ds.projection_matrix,
+    )
+
+
 def pad_and_shard_re_dataset(ds: RandomEffectDataset, ctx: MeshContext
                              ) -> RandomEffectDataset:
     """Pad the entity axis to a device multiple (weight-0/-1 padding) and
     device_put: entity-major training tensors sharded on the mesh axis,
     global-row scoring tensors + projection matrix replicated."""
-    n_dev = ctx.num_devices
-    e = ds.num_entities
-    target = ((e + n_dev - 1) // n_dev) * n_dev
-    if target != e:
-        ds = RandomEffectDataset(
-            row_index=pad_leading(ds.row_index, n_dev, -1),
-            x=pad_leading(ds.x, n_dev, 0.0),
-            labels=pad_leading(ds.labels, n_dev, 0.0),
-            base_offsets=pad_leading(ds.base_offsets, n_dev, 0.0),
-            weights=pad_leading(ds.weights, n_dev, 0.0),  # weight 0 = pad
-            entity_pos=ds.entity_pos,
-            feat_idx=ds.feat_idx,
-            feat_val=ds.feat_val,
-            local_to_global=pad_leading(ds.local_to_global, n_dev, -1),
-            num_entities=target,
-            global_dim=ds.global_dim,
-            projection_matrix=ds.projection_matrix,
-        )
+    ds = pad_re_dataset_entities(ds, ctx.num_devices)
     sharded = ctx.sharded()
     repl = ctx.replicated()
     put = jax.device_put
@@ -192,13 +200,24 @@ class DistributedRandomEffectSolver:
 
     coordinate: object  # algorithm.random_effect.RandomEffectCoordinate
     ctx: MeshContext
+    # pre-sharded dataset override: multi-host runs assemble globally
+    # entity-sharded tensors with jax.make_array_from_process_local_data
+    # (parallel.multihost.multihost_re_dataset — each process CONTRIBUTES
+    # only its slab to device memory, though the current assembler slices
+    # those slabs out of a replicated host-side build), bypassing the
+    # single-process pad+device_put below
+    padded_dataset: Optional[RandomEffectDataset] = None
 
     def __post_init__(self):
         self._jitted = None
         self._score_fn = None
         ds = self.coordinate.dataset
         self._true_entities = ds.num_entities
-        self._padded = self._pad_dataset(ds)
+        self._padded = (
+            self.padded_dataset
+            if self.padded_dataset is not None
+            else self._pad_dataset(ds)
+        )
 
     def _pad_dataset(self, ds: RandomEffectDataset) -> RandomEffectDataset:
         return pad_and_shard_re_dataset(ds, self.ctx)

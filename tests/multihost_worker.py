@@ -176,3 +176,47 @@ print(
     f"ssum={float(np.sum(scores_local)):.6f}",
     flush=True,
 )
+
+# -- the PRODUCTION random-effect stack across hosts: RandomEffectDataset
+# assembled from per-host entity slabs (multihost_re_dataset) through the
+# real DistributedRandomEffectSolver — not just the raw shard_map above ------
+from game_test_utils import make_glmix_data  # noqa: E402
+from photon_ml_tpu.algorithm.random_effect import RandomEffectCoordinate  # noqa: E402
+from photon_ml_tpu.data.game import (  # noqa: E402
+    RandomEffectDataConfig,
+    build_random_effect_dataset,
+)
+from photon_ml_tpu.parallel.distributed import DistributedRandomEffectSolver  # noqa: E402
+from photon_ml_tpu.parallel.multihost import multihost_re_dataset  # noqa: E402
+
+rng_g = np.random.default_rng(31)  # identical on every host (seeded ingest)
+gdata, _ = make_glmix_data(
+    rng_g, num_users=14, rows_per_user_range=(10, 25), d_fixed=4, d_random=3
+)
+re_ds = build_random_effect_dataset(
+    gdata, RandomEffectDataConfig("userId", "per_user")
+)
+coord = RandomEffectCoordinate(
+    re_ds,
+    TaskType.LOGISTIC_REGRESSION,
+    OptimizerType.LBFGS,
+    OptimizerConfig(max_iterations=30, tolerance=1e-9),
+    RegularizationContext.l2(0.3),
+)
+global_ds = multihost_re_dataset(re_ds, mh, ctx)
+solver = DistributedRandomEffectSolver(coord, ctx, padded_dataset=global_ds)
+resid0 = mh.global_replicated(np.zeros(gdata.num_rows, np.float32), ctx)
+coefs_re, tracker = solver.update(resid0, solver.initial_coefficients())
+# tracker trimmed to REAL entities even across hosts
+assert tracker.reason.shape[0] == re_ds.num_entities
+from jax.experimental import multihost_utils  # noqa: E402
+
+coefs_full = np.asarray(multihost_utils.process_allgather(coefs_re, tiled=True))
+scores_dev = solver.score(coefs_re)  # psum-merged -> replicated, addressable
+scores_re = np.asarray(jax.device_get(scores_dev))
+mh.barrier("solver-re-done")
+if outdir and mh.coordinator_only_io():
+    np.save(os.path.join(outdir, "re_coefs.npy"), coefs_full[: re_ds.num_entities])
+    np.save(os.path.join(outdir, "re_scores.npy"), scores_re)
+mh.barrier("solver-re-saved")
+print(f"MHRESOLVER proc={proc_id} csum={float(np.sum(coefs_full)):.6f}", flush=True)

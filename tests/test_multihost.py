@@ -143,6 +143,46 @@ def test_two_process_fixed_effect_matches_single_process(tmp_path):
         assert re_stats[i]["wsum"] == pytest.approx(float(np.sum(w_ref[sl])), abs=2e-3)
         assert re_stats[i]["ssum"] == pytest.approx(float(np.sum(s_ref[sl])), abs=2e-2)
 
+    # the PRODUCTION random-effect stack across hosts: multihost_re_dataset
+    # + DistributedRandomEffectSolver must reproduce the local
+    # RandomEffectCoordinate solve of the same (seeded) glmix dataset
+    for out in outs:
+        assert any(l.startswith("MHRESOLVER") for l in out.splitlines())
+    import sys as _sys
+
+    _sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    from game_test_utils import make_glmix_data
+    from photon_ml_tpu.algorithm.random_effect import RandomEffectCoordinate
+    from photon_ml_tpu.data.game import (
+        RandomEffectDataConfig,
+        build_random_effect_dataset,
+    )
+    from photon_ml_tpu.types import TaskType as TT, OptimizerType as OT
+
+    rng_g = np.random.default_rng(31)
+    gdata, _ = make_glmix_data(
+        rng_g, num_users=14, rows_per_user_range=(10, 25), d_fixed=4, d_random=3
+    )
+    re_ds = build_random_effect_dataset(
+        gdata, RandomEffectDataConfig("userId", "per_user")
+    )
+    local = RandomEffectCoordinate(
+        re_ds, TT.LOGISTIC_REGRESSION, OT.LBFGS,
+        OptimizerConfig(max_iterations=30, tolerance=1e-9),
+        RegularizationContext.l2(0.3),
+    )
+    w_local, _ = local.update(
+        jnp2.zeros((gdata.num_rows,), jnp2.float32), local.initial_coefficients()
+    )
+    got_coefs = np.load(tmp_path / "re_coefs.npy")
+    np.testing.assert_allclose(
+        got_coefs, np.asarray(w_local), rtol=5e-4, atol=5e-5
+    )
+    got_scores = np.load(tmp_path / "re_scores.npy")
+    np.testing.assert_allclose(
+        got_scores, np.asarray(local.score(w_local)), rtol=5e-4, atol=5e-4
+    )
+
 
 def test_single_process_context_defaults():
     """MultihostContext without jax.distributed: 1 process, coordinator,
