@@ -15,7 +15,7 @@ bootstrap fits cost k optimizer runs on identical MXU-friendly shapes.
 from __future__ import annotations
 
 import dataclasses
-from typing import Callable, Dict, List, Optional, Tuple
+from typing import Callable, Dict, List, Optional
 
 import jax
 import jax.numpy as jnp

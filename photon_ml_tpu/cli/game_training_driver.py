@@ -47,7 +47,7 @@ from photon_ml_tpu.io import model_io
 from photon_ml_tpu.io.index_map import IndexMap
 from photon_ml_tpu.ops import losses as losses_mod
 from photon_ml_tpu.optim.problem import GLMOptimizationProblem
-from photon_ml_tpu.types import ModelOutputMode, OptimizerType, TaskType, real_dtype
+from photon_ml_tpu.types import ModelOutputMode, TaskType, real_dtype
 from photon_ml_tpu.utils.io_utils import prepare_output_dir
 from photon_ml_tpu.utils.logging import PhotonLogger
 from photon_ml_tpu.utils.timer import Timer

@@ -59,7 +59,6 @@ from photon_ml_tpu.optim.constraints import BoxConstraints, parse_constraint_str
 from photon_ml_tpu.optim.problem import GLMOptimizationProblem
 from photon_ml_tpu.training import TrainedModelList, train_glm_grid
 from photon_ml_tpu.types import (
-    DataValidationType,
     NormalizationType,
     RegularizationType,
     TaskType,

@@ -19,7 +19,7 @@ from __future__ import annotations
 import dataclasses
 import html as _html
 import io
-from typing import Dict, List, Optional, Sequence, Tuple, Union
+from typing import Dict, List, Sequence, Tuple, Union
 
 
 # ---------------------------------------------------------------------------

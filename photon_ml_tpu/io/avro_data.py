@@ -20,7 +20,7 @@ from photon_ml_tpu.types import real_dtype
 from photon_ml_tpu.data.game import GameData, HostFeatures
 from photon_ml_tpu.io import avro as avro_io
 from photon_ml_tpu.io import schemas
-from photon_ml_tpu.io.index_map import INTERCEPT_KEY, IndexMap, feature_key
+from photon_ml_tpu.io.index_map import IndexMap, feature_key
 from photon_ml_tpu.io.libsvm import HostDataset
 
 

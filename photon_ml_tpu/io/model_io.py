@@ -16,7 +16,7 @@ IndexMap (feature key = "name\\x01term").
 from __future__ import annotations
 
 import os
-from typing import Dict, Iterable, List, Optional, Tuple
+from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 

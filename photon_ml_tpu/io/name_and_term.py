@@ -23,7 +23,7 @@ from __future__ import annotations
 
 import argparse
 import os
-from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+from typing import Dict, List, Optional, Sequence, Set, Tuple
 
 from photon_ml_tpu.io import avro_data
 from photon_ml_tpu.io.index_map import IndexMap, feature_key

@@ -9,7 +9,6 @@ normalization factory and the diagnostics summary tables.
 from __future__ import annotations
 
 import dataclasses
-from typing import Optional
 
 import jax
 import jax.numpy as jnp

@@ -21,7 +21,6 @@ import dataclasses
 from typing import NamedTuple, Optional
 
 import jax
-import jax.numpy as jnp
 
 from photon_ml_tpu.types import ConvergenceReason
 

@@ -16,7 +16,7 @@ Two axes cover the reference's parallelism vocabulary (SURVEY.md §2.4):
 from __future__ import annotations
 
 import dataclasses
-from typing import Optional, Sequence
+from typing import Optional
 
 import jax
 import jax.numpy as jnp

@@ -21,7 +21,7 @@ are built; IDENTITY is the absence of projection.
 from __future__ import annotations
 
 import dataclasses
-from typing import Optional, Tuple
+from typing import Optional
 
 import jax
 import jax.numpy as jnp
