@@ -567,11 +567,33 @@ def run_config_game(results, fast):
     assert abs(alt_rmse - ours_rmse) < 1e-6, (alt_rmse, ours_rmse)
     print("fused-cycle + bucketed modes: objective/RMSE identical", flush=True)
 
+    # --vmapped-grid: a 2-combo lambda grid whose FIRST combo equals the
+    # plain run must reproduce its objective/RMSE through the batched
+    # descent (real-data gate for CoordinateDescent.run_grid)
+    grid_args = list(base_args)
+    gi = grid_args.index("--fixed-effect-optimization-configurations")
+    grid_args[gi + 1] = (
+        f"global:200,1e-12,{lam_f:g},1,LBFGS,l2;"
+        f"global:200,1e-12,{10 * lam_f:g},1,LBFGS,l2"
+    )
+    vg = game_main(
+        grid_args
+        + ["--output-dir", os.path.join(tmp, "output-vgrid"),
+           "--vmapped-grid", "true"]
+    )
+    assert "(vmapped-grid)" in vg.results[0][1].timings, "vmapped path did not engage"
+    vg_obj = float(vg.results[0][1].objective_history[-1])
+    vg_rmse = float(vg.results[0][2]["RMSE"])
+    assert abs(vg_obj - ours_obj) / abs(ours_obj) < 1e-7, (vg_obj, ours_obj)
+    assert abs(vg_rmse - ours_rmse) < 1e-6, (vg_rmse, ours_rmse)
+    print("vmapped-grid mode: objective/RMSE identical", flush=True)
+
     ref_obj, ref_rmse = _game_oracle(train, val, lam_f, lam_re, iters)
     results.append(dict(
         config=(f"4: GAME GLMix on yahoo-music (reference GameIntegTest data, "
                 f"{len(train)}/{len(val)} rows, fixed + per-user + per-song RE, "
-                f"{iters} CD iterations)"),
+                f"{iters} CD iterations; execution-mode gates passed: "
+                f"fused-cycle+bucketed and vmapped-grid identical to plain)"),
         optimizer="LBFGS", wall_sec=wall, best_lambda=lam_f,
         rows=[dict(lam=lam_f, ours_rmse=ours_rmse, ref_rmse=ref_rmse,
                    rmse_diff=abs(ours_rmse - ref_rmse),
