@@ -1,0 +1,189 @@
+"""Property-based invariants for the core math (hypothesis).
+
+These encode the contracts the rest of the framework leans on: loss
+derivatives match finite differences, convexity of twice-diff losses,
+normalization folding is exact, sparse and dense feature layouts are the
+same linear operator, and the feature index is a deterministic bijection.
+"""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+from hypothesis import given, settings, strategies as st
+
+from photon_ml_tpu.ops import losses as losses_mod
+from photon_ml_tpu.ops.features import DenseFeatures, SparseFeatures, from_scipy_like
+
+SET = settings(max_examples=25, deadline=None)
+
+finite_f = st.floats(
+    min_value=-20.0, max_value=20.0, allow_nan=False, allow_infinity=False
+)
+
+
+class TestLossProperties:
+    @pytest.mark.parametrize("loss", [
+        losses_mod.logistic, losses_mod.squared, losses_mod.poisson,
+        losses_mod.smoothed_hinge,
+    ])
+    @SET
+    @given(z=finite_f, y=st.sampled_from([0.0, 1.0]))
+    def test_d1_matches_finite_difference(self, loss, z, y):
+        if loss is losses_mod.poisson and z > 10:
+            z = 10.0  # keep exp(z) in a numerically testable range
+        eps = 1e-4
+        za = jnp.asarray(z, jnp.float64) if jax.config.jax_enable_x64 else jnp.asarray(z)
+        ya = jnp.asarray(y)
+        num = (float(loss.loss(za + eps, ya)) - float(loss.loss(za - eps, ya))) / (2 * eps)
+        ana = float(loss.d1(za, ya))
+        scale = max(1.0, abs(ana))
+        assert abs(num - ana) / scale < 5e-2, (num, ana)
+
+    @pytest.mark.parametrize("loss", [
+        losses_mod.logistic, losses_mod.squared, losses_mod.poisson,
+    ])
+    @SET
+    @given(z=finite_f, y=st.sampled_from([0.0, 1.0, 3.0]))
+    def test_twice_diff_losses_are_convex(self, loss, z, y):
+        if loss is losses_mod.poisson and z > 10:
+            z = 10.0
+        assert float(loss.d2(jnp.asarray(z), jnp.asarray(y))) >= 0.0
+
+    @SET
+    @given(z=st.floats(min_value=-1e4, max_value=1e4, allow_nan=False))
+    def test_logistic_stable_at_extreme_margins(self, z):
+        for y in (0.0, 1.0):
+            v = float(losses_mod.logistic.loss(jnp.asarray(z), jnp.asarray(y)))
+            d = float(losses_mod.logistic.d1(jnp.asarray(z), jnp.asarray(y)))
+            assert np.isfinite(v) and v >= 0.0
+            assert np.isfinite(d) and -1.0 <= d <= 1.0
+
+
+class TestFeatureLayoutEquivalence:
+    @SET
+    @given(
+        n=st.integers(2, 12),
+        d=st.integers(2, 9),
+        seed=st.integers(0, 2**16),
+    )
+    def test_sparse_equals_dense_operator(self, n, d, seed):
+        rng = np.random.default_rng(seed)
+        x = rng.normal(size=(n, d)).astype(np.float32)
+        x[rng.random((n, d)) < 0.5] = 0.0  # genuine sparsity
+        rows = [
+            (np.nonzero(x[i])[0].tolist(), x[i][np.nonzero(x[i])[0]].tolist())
+            for i in range(n)
+        ]
+        sp = from_scipy_like(rows, d)
+        dn = DenseFeatures(jnp.asarray(x))
+        w = jnp.asarray(rng.normal(size=d).astype(np.float32))
+        v = jnp.asarray(rng.normal(size=n).astype(np.float32))
+        np.testing.assert_allclose(
+            np.asarray(sp.matvec(w)), np.asarray(dn.matvec(w)), rtol=1e-5, atol=1e-5
+        )
+        np.testing.assert_allclose(
+            np.asarray(sp.rmatvec(v)), np.asarray(dn.rmatvec(v)), rtol=1e-5, atol=1e-5
+        )
+        np.testing.assert_allclose(
+            np.asarray(sp.sq_rmatvec(v)), np.asarray(dn.sq_rmatvec(v)),
+            rtol=1e-4, atol=1e-4,
+        )
+        # the sorted-transpose (CSC) layout is the same operator again
+        spt = sp.with_transpose()
+        np.testing.assert_allclose(
+            np.asarray(spt.rmatvec(v)), np.asarray(dn.rmatvec(v)), rtol=1e-5, atol=1e-5
+        )
+
+
+class TestNormalizationFolding:
+    @SET
+    @given(n=st.integers(3, 10), d=st.integers(2, 6), seed=st.integers(0, 2**16))
+    def test_folded_objective_equals_explicit_transform(self, n, d, seed):
+        """value/grad with normalization folded into the margin algebra ==
+        value/grad on explicitly pre-normalized data (the aggregator trick,
+        ValueAndGradientAggregator.scala:87-113)."""
+        from photon_ml_tpu.ops.normalization import NormalizationContext
+        from photon_ml_tpu.ops.objective import GLMBatch, GLMObjective
+
+        rng = np.random.default_rng(seed)
+        x = rng.normal(size=(n, d)).astype(np.float32)
+        y = (rng.random(n) < 0.5).astype(np.float32)
+        factors = rng.uniform(0.5, 2.0, size=d).astype(np.float32)
+        shifts = rng.normal(size=d).astype(np.float32) * 0.5
+        w = jnp.asarray(rng.normal(size=d).astype(np.float32))
+
+        obj = GLMObjective(losses_mod.logistic)
+        norm = NormalizationContext(
+            factors=jnp.asarray(factors), shifts=jnp.asarray(shifts)
+        )
+        batch_raw = GLMBatch(
+            DenseFeatures(jnp.asarray(x)), jnp.asarray(y),
+            jnp.zeros((n,)), jnp.ones((n,)),
+        )
+        v1, g1 = obj.value_and_grad(w, batch_raw, norm, 0.3)
+
+        x_t = (x - shifts) * factors
+        batch_t = GLMBatch(
+            DenseFeatures(jnp.asarray(x_t)), jnp.asarray(y),
+            jnp.zeros((n,)), jnp.ones((n,)),
+        )
+        v2, g2 = obj.value_and_grad(
+            w, batch_t, NormalizationContext.identity(), 0.3
+        )
+        np.testing.assert_allclose(float(v1), float(v2), rtol=1e-4)
+        np.testing.assert_allclose(np.asarray(g1), np.asarray(g2), rtol=1e-3, atol=1e-4)
+
+
+class TestRegularizationSplit:
+    @SET
+    @given(
+        lam=st.floats(min_value=0.0, max_value=1e3, allow_nan=False),
+        alpha=st.floats(min_value=0.0, max_value=1.0, allow_nan=False),
+    )
+    def test_elastic_net_split_conserves_total(self, lam, alpha):
+        from photon_ml_tpu.ops.regularization import RegularizationContext
+
+        ctx = RegularizationContext.elastic_net(lam, alpha)
+        assert ctx.l1_weight + ctx.l2_weight == pytest.approx(lam, rel=1e-6, abs=1e-9)
+        assert ctx.l1_weight == pytest.approx(alpha * lam, rel=1e-6, abs=1e-9)
+
+    @SET
+    @given(lam=st.floats(min_value=1e-6, max_value=1e3, allow_nan=False))
+    def test_with_weight_rescales(self, lam):
+        from photon_ml_tpu.ops.regularization import RegularizationContext
+
+        base = RegularizationContext.elastic_net(1.0, 0.25)
+        re = base.with_weight(lam)
+        assert re.l1_weight + re.l2_weight == pytest.approx(lam, rel=1e-6)
+        # split ratio preserved
+        assert re.l1_weight == pytest.approx(0.25 * lam, rel=1e-6)
+
+
+class TestIndexMapProperties:
+    @SET
+    @given(
+        keys=st.lists(
+            st.text(
+                alphabet=st.characters(
+                    whitelist_categories=("Ll", "Lu", "Nd"), max_codepoint=0x7F
+                ),
+                min_size=1, max_size=8,
+            ),
+            min_size=1, max_size=30, unique=True,
+        ),
+        parts=st.integers(1, 4),
+    )
+    def test_build_is_deterministic_bijection(self, keys, parts):
+        from photon_ml_tpu.io.index_map import IndexMap
+
+        m1 = IndexMap.build(keys, add_intercept=True, num_partitions=parts)
+        m2 = IndexMap.build(list(reversed(keys)), add_intercept=True, num_partitions=parts)
+        # input order must not matter (deterministic ingest contract)
+        assert m1.name_to_index == m2.name_to_index
+        # bijection over keys + intercept
+        assert len(m1) == len(set(keys) | {m1.index_to_name[m1.intercept_index]})
+        for k in keys:
+            idx = m1.get_index(k)
+            assert idx >= 0
+            assert m1.get_feature_name(idx) == k
