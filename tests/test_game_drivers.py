@@ -323,6 +323,76 @@ class TestDeviceScoringParity:
         np.testing.assert_allclose(dev.scores, host.scores, rtol=1e-5, atol=1e-6)
 
 
+class TestColdStartScoring:
+    def test_unseen_entities_score_fixed_effect_only(self, trained, tmp_path):
+        """Rows whose entity has NO per-entity model must score exactly the
+        fixed-effect contribution — the RE adds 0 (RandomEffectModel.scala:
+        129-158: datum with no model -> score 0) — on BOTH scoring paths."""
+        driver, out, dirs = trained
+        train_dir, _, _ = dirs
+        recs = list(
+            avro_io.read_container(os.path.join(train_dir, "part-0.avro"))
+        )
+        # half the rows get brand-new user ids the model never saw
+        cold = [dict(r) for r in recs[:40]]
+        for i, r in enumerate(cold):
+            if i % 2 == 0:
+                r["userId"] = f"cold-user-{i}"
+        cold_dir = tmp_path / "cold"
+        cold_dir.mkdir()
+        schema = {
+            "type": "record", "name": "GameRow", "fields": [
+                {"name": "label", "type": "double"},
+                {"name": "userId", "type": "string"},
+                {"name": "fixedFeatures", "type": {"type": "array", "items": {
+                    "type": "record", "name": "NTV", "fields": [
+                        {"name": "name", "type": "string"},
+                        {"name": "term", "type": "string"},
+                        {"name": "value", "type": "double"}]}}},
+                {"name": "userFeatures", "type": {"type": "array", "items": "NTV"}},
+            ],
+        }
+        avro_io.write_container(
+            str(cold_dir / "part-0.avro"), cold, schema
+        )
+        common = [
+            "--input-dirs", str(cold_dir),
+            "--game-model-input-dir", os.path.join(out, "best"),
+            "--feature-shard-id-to-feature-section-keys-map",
+            "global:fixedFeatures|per_user:userFeatures",
+            "--delete-output-dir-if-exists", "true",
+        ]
+        dev = game_scoring_driver.main(
+            ["--output-dir", str(tmp_path / "dev")] + common
+        )
+        host = game_scoring_driver.main(
+            ["--output-dir", str(tmp_path / "host"), "--host-scoring", "true"]
+            + common
+        )
+        np.testing.assert_allclose(dev.scores, host.scores, rtol=1e-5, atol=1e-6)
+
+        # fixed-effect-only oracle for the cold rows
+        from photon_ml_tpu.io import model_io
+
+        imap = dev.shard_index_maps["global"]
+        fe_means, _, _, _ = model_io.load_fixed_effect(
+            os.path.join(out, "best"), "fixed", imap
+        )
+        for i, r in enumerate(cold):
+            if i % 2 != 0:
+                continue
+            expected = sum(
+                fe_means[imap.get_index(f"{ntv['name']}\x01{ntv['term']}")]
+                for ntv in r["fixedFeatures"]
+                if imap.get_index(f"{ntv['name']}\x01{ntv['term']}") >= 0
+            )
+            # + intercept if the model has one
+            icpt = imap.intercept_index
+            if icpt >= 0:
+                expected += fe_means[icpt]
+            assert dev.scores[i] == pytest.approx(expected, abs=1e-4), i
+
+
 class TestUnlabeledScoring:
     def test_score_without_labels(self, trained, tmp_path):
         driver, out, dirs = trained
