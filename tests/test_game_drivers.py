@@ -333,27 +333,18 @@ class TestColdStartScoring:
         recs = list(
             avro_io.read_container(os.path.join(train_dir, "part-0.avro"))
         )
-        # half the rows get brand-new user ids the model never saw
+        # half the rows get brand-new user ids the model never saw; the
+        # fixture keeps entity ids in metadataMap (DataProcessingUtils.scala:
+        # 90-114: id looked up from field OR metadataMap), so mutate there
         cold = [dict(r) for r in recs[:40]]
         for i, r in enumerate(cold):
             if i % 2 == 0:
-                r["userId"] = f"cold-user-{i}"
+                r["metadataMap"] = dict(r["metadataMap"] or {})
+                r["metadataMap"]["userId"] = f"cold-user-{i}"
         cold_dir = tmp_path / "cold"
         cold_dir.mkdir()
-        schema = {
-            "type": "record", "name": "GameRow", "fields": [
-                {"name": "label", "type": "double"},
-                {"name": "userId", "type": "string"},
-                {"name": "fixedFeatures", "type": {"type": "array", "items": {
-                    "type": "record", "name": "NTV", "fields": [
-                        {"name": "name", "type": "string"},
-                        {"name": "term", "type": "string"},
-                        {"name": "value", "type": "double"}]}}},
-                {"name": "userFeatures", "type": {"type": "array", "items": "NTV"}},
-            ],
-        }
         avro_io.write_container(
-            str(cold_dir / "part-0.avro"), cold, schema
+            str(cold_dir / "part-0.avro"), cold, GAME_EXAMPLE_SCHEMA
         )
         common = [
             "--input-dirs", str(cold_dir),
@@ -382,7 +373,8 @@ class TestColdStartScoring:
             if i % 2 != 0:
                 continue
             expected = sum(
-                fe_means[imap.get_index(f"{ntv['name']}\x01{ntv['term']}")]
+                ntv["value"]
+                * fe_means[imap.get_index(f"{ntv['name']}\x01{ntv['term']}")]
                 for ntv in r["fixedFeatures"]
                 if imap.get_index(f"{ntv['name']}\x01{ntv['term']}") >= 0
             )
