@@ -57,6 +57,7 @@ UNIT = "examples/sec/chip"
 
 N_DENSE, D_DENSE = 262144, 512
 N_SPARSE, D_SPARSE, K_SPARSE = 131072, 1 << 20, 64
+HBM_PEAK_GB_S = 819  # TPU v5e HBM bandwidth (public spec)
 
 
 def _emit(payload):
@@ -70,29 +71,51 @@ def _log(msg):
 
 
 def _probe_backend(errors, timeout_s):
-    """Try backend init in a THROWAWAY subprocess with a hard timeout.
+    """Try backend init in a THROWAWAY subprocess — and NEVER kill it.
 
-    A flaky tunnel can HANG inside PJRT client creation (not just raise), and
-    a hang in-process is unrecoverable — so the accelerator is only touched
-    in-process after a subprocess proved it comes up. Returns the platform
-    string or None."""
+    A flaky tunnel can HANG inside PJRT client creation (not just raise),
+    and a hang in-process is unrecoverable — so the accelerator is only
+    touched in-process after a subprocess proved it comes up. CRITICAL
+    (r3 postmortem): a timeout-KILLED probe can orphan the single-client
+    tunnel's server-side session claim and wedge the tunnel for every later
+    process. So on deadline the probe is DETACHED, not killed — it exits on
+    its own (hung claims resolve server-side in ~25 min) and releases
+    whatever it held. Returns the platform string or None."""
     import subprocess
+    import tempfile
 
-    try:
-        out = subprocess.run(
-            [sys.executable, "-c", "import jax; print(jax.devices()[0].platform)"],
-            timeout=timeout_s,
-            capture_output=True,
-            text=True,
+    out_f = tempfile.NamedTemporaryFile(
+        mode="w+", suffix=".out", prefix="tpu-probe-", delete=False
+    )
+    proc = subprocess.Popen(
+        [sys.executable, "-c", "import jax; print(jax.devices()[0].platform)"],
+        stdout=out_f,
+        stderr=subprocess.STDOUT,
+        start_new_session=True,  # survives the bench; never reparented-killed
+    )
+    deadline = time.time() + timeout_s
+    while time.time() < deadline:
+        if proc.poll() is not None:
+            break
+        time.sleep(2)
+    if proc.poll() is None:
+        # keep the file: the detached child is still writing to it
+        out_f.close()
+        errors.setdefault("backend_attempts", []).append(
+            f"no answer in {timeout_s}s; probe left running (pid {proc.pid}, "
+            "never killed — see r3 claim-orphan postmortem)"
         )
-    except subprocess.TimeoutExpired:
-        errors.setdefault("backend_attempts", []).append(f"hang >{timeout_s}s")
         return None
-    if out.returncode != 0:
-        tail = (out.stderr or "").strip().splitlines()[-3:]
-        errors.setdefault("backend_attempts", []).append(" | ".join(tail))
+    out_f.seek(0)
+    text = out_f.read().strip()
+    out_f.close()
+    os.unlink(out_f.name)
+    if proc.returncode != 0:
+        errors.setdefault("backend_attempts", []).append(
+            " | ".join(text.splitlines()[-3:])
+        )
         return None
-    lines = out.stdout.strip().splitlines()
+    lines = [l for l in text.splitlines() if l.strip()]
     return lines[-1] if lines else None
 
 
@@ -174,7 +197,7 @@ def _scan_throughput(value_and_grad, w0, n_rows, batch, iters=SCAN_ITERS):
     return n_rows / dt
 
 
-def _bench_dense(extra, x_h, y_h):
+def _bench_dense(extra, x_h, y_h, on_tpu=True):
     import jax
     import jax.numpy as jnp
 
@@ -231,6 +254,26 @@ def _bench_dense(extra, x_h, y_h):
         batch,
     )
     _log(f"dense: {eps:.3e} ex/s (path={'fused' if extra['fused_block_rows'] else 'xla'})")
+
+    # roofline accounting (VERDICT r3 #2): this kernel is bandwidth-bound
+    # (~2 FLOP per feature byte). The dominant traffic is the bf16 X matrix
+    # from HBM: once per pass for the fused single-pass kernel, twice for
+    # the two-pass XLA pipeline (matvec margins + rmatvec gradient). Vector
+    # traffic (y, w, z, d) is < 1% at D=512 and is ignored. TPU-only: the
+    # 819 GB/s peak is the v5e HBM spec, meaningless against a CPU run.
+    x_passes = 1 if extra["fused_block_rows"] else 2
+    bytes_per_example = d * 2 * x_passes  # bf16 storage
+    achieved_gbs = eps * bytes_per_example / 1e9
+    extra["dense_achieved_gb_s"] = round(achieved_gbs, 1)
+    if on_tpu:
+        extra["dense_hbm_peak_gb_s"] = HBM_PEAK_GB_S
+        extra["dense_pct_of_hbm_roofline"] = round(
+            100.0 * achieved_gbs / HBM_PEAK_GB_S, 1
+        )
+        _log(
+            f"roofline: {achieved_gbs:.0f} GB/s of ~{HBM_PEAK_GB_S} GB/s v5e HBM "
+            f"({extra['dense_pct_of_hbm_roofline']:.1f}%, {x_passes}-pass X traffic)"
+        )
     return eps
 
 
@@ -313,6 +356,65 @@ def _bench_scoring(extra, on_tpu):
     _log(f"scoring: {n_rows} rows x {n_entities} entities -> {rps:.3e} rows/s")
     extra["scoring_rows_per_sec"] = round(rps, 1)
     extra["scoring_config"] = {"rows": n_rows, "entities": n_entities, "d": d, "nnz": k}
+
+
+def _bench_streaming(extra, on_tpu):
+    """Out-of-core fixed-effect solve (optim/streaming.py, VERDICT r3 #5):
+    rows/sec through one chunk-streamed value+grad pass (mmap'd npz chunks,
+    host->device per chunk) vs the in-memory pass — the cost of training
+    when data >> device+host memory."""
+    import shutil
+    import tempfile
+
+    import jax
+    import jax.numpy as jnp
+
+    from photon_ml_tpu.ops import losses
+    from photon_ml_tpu.ops.features import DenseFeatures
+    from photon_ml_tpu.ops.normalization import NormalizationContext
+    from photon_ml_tpu.ops.objective import GLMBatch, GLMObjective
+    from photon_ml_tpu.optim.streaming import (
+        ChunkedGLMSource,
+        make_streaming_value_and_grad,
+        write_npz_chunks,
+    )
+
+    n = 262144 if on_tpu else 32768
+    d = 256
+    rng = np.random.default_rng(5)
+    x = rng.normal(size=(n, d)).astype(np.float32)
+    w_true = rng.normal(size=d).astype(np.float32) * 0.1
+    y = (1.0 / (1.0 + np.exp(-x @ w_true)) > rng.random(n)).astype(np.float32)
+
+    tmp = tempfile.mkdtemp(prefix="bench-stream-")
+    try:
+        write_npz_chunks(tmp, x, y, chunk_rows=32768)
+        src = ChunkedGLMSource.from_npz_dir(tmp)
+        obj = GLMObjective(losses.logistic)
+        norm = NormalizationContext.identity()
+        vg = make_streaming_value_and_grad(src, obj, norm, l2_weight=0.1)
+        w = jnp.zeros((d,), jnp.float32)
+        jax.block_until_ready(vg(w))  # compile + warm
+        t0 = time.perf_counter()
+        jax.block_until_ready(vg(w))
+        t_stream = time.perf_counter() - t0
+
+        batch = GLMBatch.create(DenseFeatures(jnp.asarray(x)), jnp.asarray(y))
+        mem = jax.jit(lambda w, b: obj.value_and_grad(w, b, norm, 0.1))
+        jax.block_until_ready(mem(w, batch))
+        t0 = time.perf_counter()
+        jax.block_until_ready(mem(w, batch))
+        t_mem = time.perf_counter() - t0
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+    extra["streaming_rows_per_sec"] = round(n / t_stream, 1)
+    extra["streaming_overhead_vs_in_memory"] = round(t_stream / max(t_mem, 1e-9), 2)
+    extra["streaming_config"] = {"rows": n, "d": d, "chunk_rows": 32768}
+    _log(
+        f"streaming pass: {n / t_stream:.3e} rows/s "
+        f"({t_stream / max(t_mem, 1e-9):.1f}x the in-memory pass)"
+    )
 
 
 def _bench_ingest(extra):
@@ -522,6 +624,14 @@ def _bench_game(extra, on_tpu):
     extra["game_grid_vmapped_sec"] = round(t_vmapped, 3)
     extra["game_grid_sequential_warm_sec"] = round(t_seq, 3)
     extra["game_grid_speedup"] = round(t_seq / t_vmapped, 2)
+    # the driver's --vmapped-grid auto races exactly this pair and picks the
+    # winner (game_training_driver grid auto-select), so the effective grid
+    # cost is min(...) whichever side wins on this platform/shape
+    extra["game_grid_auto_pick"] = "vmapped" if t_vmapped < t_seq else "sequential"
+    extra["game_grid_auto_sec"] = round(min(t_vmapped, t_seq), 3)
+    extra["game_grid_auto_speedup_vs_sequential"] = round(
+        t_seq / min(t_vmapped, t_seq), 2
+    )
 
 
 def _bench_game5(extra, on_tpu):
@@ -632,7 +742,7 @@ def main():
         platform = devices[0].platform
         on_tpu = _on_tpu()
         try:
-            value = _bench_dense(extra, x_h, y_h)
+            value = _bench_dense(extra, x_h, y_h, on_tpu)
             vs_baseline = value / base_eps
         except Exception:
             errors["dense"] = traceback.format_exc(limit=3)
@@ -652,6 +762,11 @@ def main():
             _bench_game5(extra, on_tpu)
         except Exception:
             errors["game5"] = traceback.format_exc(limit=3)
+        _save_partial()
+        try:
+            _bench_streaming(extra, on_tpu)
+        except Exception:
+            errors["streaming"] = traceback.format_exc(limit=3)
         _save_partial()
         try:
             _bench_scoring(extra, on_tpu)

@@ -304,14 +304,27 @@ class GameTrainingParams:
     # per-bucket padding on skewed entity distributions; composes with
     # --distributed (each bucket entity-shards over the mesh)
     bucketed_random_effects: bool = False
-    # train every lambda combo of the grid simultaneously as a vmap axis
-    # over the descent cycle (CoordinateDescent.run_grid); falls back to
-    # the sequential grid when combos differ beyond lambda or the run uses
-    # distributed/bucketed/factored coordinates, checkpoints, or variance
-    vmapped_grid: bool = False
+    # "true": train every lambda combo of the grid simultaneously as a vmap
+    # axis over the descent cycle (CoordinateDescent.run_grid); "auto":
+    # time one warm iteration of each strategy and pick the faster (the
+    # batched grid reads data once per iteration for all combos but pays
+    # the slowest lane's while_loop — platform-dependent, so measure);
+    # "false": sequential combos. Non-false falls back to sequential when
+    # combos differ beyond lambda or the run uses distributed/bucketed/
+    # factored coordinates, checkpoints, or variance.
+    vmapped_grid: str = "false"
 
     def validate(self) -> None:
         errors = []
+        # normalize the vmapped_grid mode (bool accepted for backcompat with
+        # programmatic construction; anything else must be a known mode)
+        if isinstance(self.vmapped_grid, bool):
+            self.vmapped_grid = "true" if self.vmapped_grid else "false"
+        if self.vmapped_grid not in ("false", "true", "auto"):
+            errors.append(
+                f"vmapped_grid must be 'false', 'true', or 'auto', "
+                f"got {self.vmapped_grid!r}"
+            )
         if not self.train_input_dirs:
             errors.append("--train-input-dirs is required")
         if not self.output_dir:
@@ -399,7 +412,9 @@ def build_training_parser() -> argparse.ArgumentParser:
     a("--vmapped-grid", default="false",
       help="train every lambda combo of the grid simultaneously (one vmapped "
            "descent instead of sequential combos; lambda-only grids on plain "
-           "fixed/random coordinates)")
+           "fixed/random coordinates). 'auto' times one iteration of each "
+           "strategy and picks the faster; truthy values ('true', '1', "
+           "'yes') enable the vmapped grid unconditionally")
     return p
 
 
@@ -443,7 +458,10 @@ def parse_training_params(argv: Optional[List[str]] = None) -> GameTrainingParam
         distributed=_truthy(ns.distributed),
         fused_cycle=_truthy(ns.fused_cycle),
         bucketed_random_effects=_truthy(ns.bucketed_random_effects),
-        vmapped_grid=_truthy(ns.vmapped_grid),
+        vmapped_grid=(
+            "auto" if str(ns.vmapped_grid).lower() == "auto"
+            else "true" if _truthy(ns.vmapped_grid) else "false"
+        ),
     )
     params.validate()
     return params

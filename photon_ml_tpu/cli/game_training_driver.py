@@ -547,11 +547,10 @@ class GameTrainingDriver:
                 return f"combos vary beyond lambda for coordinate {name!r}"
         return None
 
-    def _train_vmapped_grid(self, combos, loss_fn) -> None:
-        """All grid combos in ONE vmapped descent (CoordinateDescent.
-        run_grid); results and best_index land in self.results exactly
-        like the sequential path."""
-        p = self.params
+    def _grid_cd(self, combos, loss_fn):
+        """(coords, CoordinateDescent, evaluators, primary) for the grid —
+        built ONCE and shared between the auto-race and the training run so
+        the G-lane cycle compiles a single time."""
         coords = self._build_coordinates(combos[0])
         scorer = None
         evaluators = None
@@ -561,14 +560,25 @@ class GameTrainingDriver:
             evaluators = self._validation_evaluators()
             if evaluators:
                 primary = next(iter(evaluators))
-        lam = {
+        cd = CoordinateDescent(coords, loss_fn, scorer, evaluators)
+        return coords, cd, evaluators, primary
+
+    def _grid_lambdas(self, combos):
+        return {
             name: jnp.asarray(
                 [c.get(name, CoordinateOptConfig()).reg_weight for c in combos],
                 real_dtype(),
             )
-            for name in p.updating_sequence
+            for name in self.params.updating_sequence
         }
-        cd = CoordinateDescent(coords, loss_fn, scorer, evaluators)
+
+    def _train_vmapped_grid(self, combos, loss_fn, prebuilt=None) -> None:
+        """All grid combos in ONE vmapped descent (CoordinateDescent.
+        run_grid); results and best_index land in self.results exactly
+        like the sequential path."""
+        p = self.params
+        coords, cd, evaluators, primary = prebuilt or self._grid_cd(combos, loss_fn)
+        lam = self._grid_lambdas(combos)
         from photon_ml_tpu.utils.profiling import maybe_trace
 
         with self.timer.measure("vmapped-grid"), maybe_trace("game-vmapped-grid"):
@@ -599,15 +609,35 @@ class GameTrainingDriver:
         primary: Optional[str] = None
         best_value: Optional[float] = None
 
-        if p.vmapped_grid:
+        if p.vmapped_grid in ("true", "auto"):
             blocker = self._vmapped_grid_blocker(combos)
             if blocker is None:
-                self._train_vmapped_grid(combos, loss_fn)
-                return
-            self.logger.warn(
-                f"--vmapped-grid requested but falling back to the "
-                f"sequential grid: {blocker}"
-            )
+                pick = "vmapped"
+                prebuilt = None
+                if p.vmapped_grid == "auto":
+                    # measure, don't guess: one warm iteration of each
+                    # strategy decides (burn-in discarded; results identical
+                    # either way). The raced CoordinateDescent is REUSED by
+                    # the training run, so the G-lane cycle compiles once.
+                    # Reference grid: Driver.scala:330-337.
+                    prebuilt = self._grid_cd(combos, loss_fn)
+                    with self.timer.measure("grid-race"):
+                        pick, t_vm, t_seq = prebuilt[1].race_grid(
+                            self._grid_lambdas(combos), self.train_data.num_rows
+                        )
+                    self.logger.info(
+                        f"grid auto-select: vmapped {t_vm:.3f}s/iter vs "
+                        f"sequential {t_seq:.3f}s/iter (all "
+                        f"{len(combos)} combos) -> {pick}"
+                    )
+                if pick == "vmapped":
+                    self._train_vmapped_grid(combos, loss_fn, prebuilt)
+                    return
+            else:
+                self.logger.warn(
+                    f"--vmapped-grid requested but falling back to the "
+                    f"sequential grid: {blocker}"
+                )
 
         for i, opt_configs in enumerate(combos):
             coords = self._build_coordinates(opt_configs)

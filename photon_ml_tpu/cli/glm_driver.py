@@ -104,6 +104,8 @@ class Driver:
         self.index_map: Optional[IndexMap] = None
         self.train_ds: Optional[HostDataset] = None
         self.train_batch: Optional[GLMBatch] = None
+        # out-of-core mode: chunk source replaces train_batch
+        self.streaming_source = None
         self.validation_batch: Optional[GLMBatch] = None
         self.summary: Optional[BasicStatisticalSummary] = None
         self.norm: NormalizationContext = NormalizationContext.identity()
@@ -221,9 +223,165 @@ class Driver:
             num_partitions=max(p.offheap_indexmap_num_partitions, 1),
         )
 
+    def _preprocess_streaming(self) -> None:
+        """Out-of-core preprocess: decode input FILE BY FILE, spill dense
+        row chunks to <output>/stream-chunks/, never materializing the full
+        batch (the DISK_ONLY persistence analogue, StorageLevel.scala:22-24).
+        Per-file sanity checks replace the whole-batch pass; the colStats
+        summary accumulates over chunks (optim/streaming.py).
+
+        Peak host memory is O(largest single input file + one chunk) — the
+        decode granularity is the file, exactly like the reference's
+        per-partition decode (DataProcessingUtils.scala:57-80); split huge
+        inputs into more part files to bound it. Rows are re-chunked ACROSS
+        file boundaries so all chunks but the final tail share one shape
+        (one XLA executable for the whole stream)."""
+        p = self.params
+        from photon_ml_tpu.optim.streaming import (
+            ChunkedGLMSource,
+            streaming_summarize,
+        )
+
+        paths = self._input_paths(p.training_data_dir)
+        if p.input_file_format == InputFormatType.LIBSVM:
+            dim = p.feature_dimension if p.feature_dimension > 0 else None
+            first = read_libsvm(paths[0], dim=dim, add_intercept=p.add_intercept)
+            names = [str(i) for i in range(first.dim - int(p.add_intercept))]
+            if p.add_intercept:
+                names.append(INTERCEPT_KEY)
+            self.index_map = IndexMap({k: i for i, k in enumerate(names)}, names)
+            read_file = lambda path: read_libsvm(
+                path, dim=first.dim - int(p.add_intercept),
+                add_intercept=p.add_intercept,
+            )
+            file_ds = {paths[0]: first}
+        else:
+            self.index_map = self._build_index_map()
+            label_field = (
+                "response"
+                if p.field_names_type == FieldNamesType.RESPONSE_PREDICTION
+                else "label"
+            )
+            read_file = lambda path: avro_data.read_training_examples(
+                [path], self.index_map,
+                add_intercept=p.add_intercept, label_field=label_field,
+            )
+            file_ds = {}
+
+        dim = len(self.index_map)
+        if dim > DENSE_DIM_THRESHOLD:
+            raise ValueError(
+                f"--streaming-chunk-rows spills DENSE chunks; {dim} features "
+                f"exceeds the dense threshold ({DENSE_DIM_THRESHOLD}). The "
+                "wide-sparse regime streams through the in-memory sparse "
+                "layout instead (sparse chunk spilling is not implemented)."
+            )
+        chunk_dir = os.path.join(p.output_dir, "stream-chunks")
+        os.makedirs(chunk_dir, exist_ok=True)
+        chunk_i = 0
+        total_rows = 0
+        # carry rows across file boundaries so every chunk except the final
+        # tail has EXACTLY chunk_rows rows -> one jitted executable
+        buf: List[dict] = []
+        buf_rows = 0
+
+        def _flush(final=False):
+            nonlocal chunk_i, buf, buf_rows
+            while buf_rows >= p.streaming_chunk_rows or (final and buf_rows > 0):
+                take = min(buf_rows, p.streaming_chunk_rows)
+                parts: List[dict] = []
+                got = 0
+                while got < take:
+                    head = buf[0]
+                    n_h = len(head["y"])
+                    if got + n_h <= take:
+                        parts.append(buf.pop(0))
+                        got += n_h
+                    else:
+                        split = take - got
+                        parts.append({k: v[:split] for k, v in head.items()})
+                        buf[0] = {k: v[split:] for k, v in head.items()}
+                        got = take
+                payload = {
+                    k: np.concatenate([q[k] for q in parts])
+                    for k in parts[0]
+                }
+                np.savez(
+                    os.path.join(chunk_dir, f"chunk-{chunk_i:05d}.npz"), **payload
+                )
+                chunk_i += 1
+                buf_rows -= take
+
+        for path in paths:
+            ds = file_ds.pop(path, None) or read_file(path)
+            batch = to_batch(ds, dense=True)
+            sanity_check_data(batch, p.task_type, p.data_validation_type)
+            # uniform keys across files (a file without offsets/weights must
+            # still concatenate with one that has them)
+            piece = {
+                "x": np.asarray(batch.features.matrix)[: ds.num_rows],
+                "y": np.asarray(ds.labels),
+                "offsets": (
+                    np.asarray(ds.offsets)
+                    if ds.offsets is not None
+                    else np.zeros(ds.num_rows, np.float32)
+                ),
+                "weights": (
+                    np.asarray(ds.weights)
+                    if ds.weights is not None
+                    else np.ones(ds.num_rows, np.float32)
+                ),
+            }
+            buf.append(piece)
+            buf_rows += ds.num_rows
+            total_rows += ds.num_rows
+            _flush()
+        _flush(final=True)
+        self.streaming_source = ChunkedGLMSource.from_npz_dir(chunk_dir)
+        self.logger.info(
+            f"streaming mode: {total_rows} rows x {dim} features spilled to "
+            f"{chunk_i} chunks of {p.streaming_chunk_rows} rows (+ tail)"
+        )
+
+        needs_summary = (
+            p.normalization_type != NormalizationType.NONE
+            or p.summarization_output_dir is not None
+        )
+        if needs_summary:
+            self.summary = streaming_summarize(self.streaming_source)
+            if p.summarization_output_dir:
+                write_basic_statistics(
+                    self.summary, p.summarization_output_dir, self.index_map
+                )
+        if p.normalization_type != NormalizationType.NONE:
+            intercept = self.index_map.intercept_index
+            self.norm = NormalizationContext.build(
+                p.normalization_type,
+                mean=self.summary.mean,
+                std=self.summary.std,
+                max_magnitude=self.summary.max_magnitude,
+                intercept_id=intercept if intercept >= 0 else None,
+            )
+
+        if p.validating_data_dir:
+            if p.input_file_format == InputFormatType.LIBSVM:
+                vds = read_libsvm(
+                    self._input_paths(p.validating_data_dir)[0],
+                    dim=len(self.index_map) - int(p.add_intercept),
+                    add_intercept=p.add_intercept,
+                )
+            else:
+                vds = self._read_avro(p.validating_data_dir)
+            self.validation_batch = to_batch(vds, dense=True)
+            sanity_check_data(self.validation_batch, p.task_type, p.data_validation_type)
+        self._advance(DriverStage.PREPROCESSED)
+
     def preprocess(self) -> None:
         self._assert_stage(DriverStage.INIT)
         p = self.params
+        if p.streaming_chunk_rows > 0:
+            self._preprocess_streaming()
+            return
 
         if p.input_file_format == InputFormatType.LIBSVM:
             paths = self._input_paths(p.training_data_dir)
@@ -342,9 +500,25 @@ class Driver:
         from photon_ml_tpu.utils.profiling import maybe_trace
 
         with maybe_trace("glm-train"):
-            self.trained = train_glm_grid(
-                self.problem, self.train_batch, self.norm, p.regularization_weights
-            )
+            if self.streaming_source is not None:
+                from photon_ml_tpu.training import train_glm_grid_streaming
+
+                self.trained = train_glm_grid_streaming(
+                    self.problem, self.streaming_source, self.norm,
+                    p.regularization_weights,
+                )
+                # the spilled chunks are dead weight once training completes
+                import shutil
+
+                shutil.rmtree(
+                    os.path.join(p.output_dir, "stream-chunks"),
+                    ignore_errors=True,
+                )
+            else:
+                self.trained = train_glm_grid(
+                    self.problem, self.train_batch, self.norm,
+                    p.regularization_weights,
+                )
         self.models = [
             (lam, self._to_raw_space(m))
             for lam, m in zip(self.trained.weights, self.trained.models)

@@ -68,6 +68,10 @@ class GLMParams:
     input_file_format: str = InputFormatType.AVRO
     feature_dimension: int = -1
     compute_variance: bool = False
+    # out-of-core training: spill the ingested batch to row chunks of this
+    # size and stream them through the optimizer (optim/streaming.py — the
+    # StorageLevel.scala:22-24 DISK_ONLY answer); 0 = in-memory (default)
+    streaming_chunk_rows: int = 0
     # obsolete on TPU (treeAggregate depth, kryo, min partitions) — accepted
     # for CLI compatibility, ignored with a note
     tree_aggregate_depth: int = 1
@@ -102,6 +106,22 @@ class GLMParams:
                 errors.append(f"negative regularization weight {w}")
         if self.validate_per_iteration and self.validating_data_dir is None:
             errors.append("--validate-per-iteration requires --validating-data-directory")
+        if self.streaming_chunk_rows > 0:
+            if self.optimizer_type == OptimizerType.TRON:
+                errors.append(
+                    "--streaming-chunk-rows supports LBFGS/OWL-QN only (TRON's "
+                    "CG would stream one full pass per Hessian-vector product)"
+                )
+            if self.validate_per_iteration:
+                errors.append(
+                    "--streaming-chunk-rows does not keep per-iteration "
+                    "coefficient snapshots (--validate-per-iteration)"
+                )
+            if self.diagnostic_mode != DiagnosticMode.NONE:
+                errors.append(
+                    "--streaming-chunk-rows does not support --diagnostic-mode "
+                    "(diagnostics need the in-memory batch)"
+                )
         if self.diagnostic_mode.runs_validate and self.validating_data_dir is None:
             errors.append(
                 f"diagnostic mode {self.diagnostic_mode.value} requires "
@@ -166,6 +186,9 @@ def build_parser() -> argparse.ArgumentParser:
     a("--kryo", dest="use_kryo", type=_bool_flag, default=True)
     a("--min-partitions", dest="min_num_partitions", type=int, default=1)
     a("--tree-aggregate-depth", dest="tree_aggregate_depth", type=int, default=1)
+    a("--streaming-chunk-rows", dest="streaming_chunk_rows", type=int, default=0,
+      help="spill the training batch to row chunks of this size and stream "
+           "them through the optimizer (out-of-core; 0 = in-memory)")
     return p
 
 
@@ -199,6 +222,7 @@ def parse_from_command_line(argv: Optional[List[str]] = None) -> GLMParams:
         input_file_format=ns.input_file_format,
         feature_dimension=ns.feature_dimension,
         compute_variance=ns.compute_variance,
+        streaming_chunk_rows=ns.streaming_chunk_rows,
         use_kryo=ns.use_kryo,
         min_num_partitions=ns.min_num_partitions,
         tree_aggregate_depth=ns.tree_aggregate_depth,

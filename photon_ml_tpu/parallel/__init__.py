@@ -19,11 +19,17 @@ from photon_ml_tpu.parallel.mesh import (
     pad_rows,
     pad_leading,
 )
-from photon_ml_tpu.parallel import multihost
+from photon_ml_tpu.parallel import multihost, shuffle
 from photon_ml_tpu.parallel.distributed import (
     DistributedFactoredRandomEffectCoordinate,
     DistributedFixedEffectSolver,
     DistributedRandomEffectSolver,
+)
+from photon_ml_tpu.parallel.perhost_ingest import (
+    HostRows,
+    PerHostRandomEffectSolver,
+    ShardedREData,
+    per_host_re_dataset,
 )
 
 __all__ = [
@@ -32,7 +38,12 @@ __all__ = [
     "pad_rows",
     "pad_leading",
     "multihost",
+    "shuffle",
     "DistributedFactoredRandomEffectCoordinate",
     "DistributedFixedEffectSolver",
     "DistributedRandomEffectSolver",
+    "HostRows",
+    "PerHostRandomEffectSolver",
+    "ShardedREData",
+    "per_host_re_dataset",
 ]

@@ -200,12 +200,11 @@ class DistributedRandomEffectSolver:
 
     coordinate: object  # algorithm.random_effect.RandomEffectCoordinate
     ctx: MeshContext
-    # pre-sharded dataset override: multi-host runs assemble globally
-    # entity-sharded tensors with jax.make_array_from_process_local_data
-    # (parallel.multihost.multihost_re_dataset — each process CONTRIBUTES
-    # only its slab to device memory, though the current assembler slices
-    # those slabs out of a replicated host-side build), bypassing the
-    # single-process pad+device_put below
+    # pre-sharded dataset override (globally entity-sharded tensors built
+    # elsewhere), bypassing the single-process pad+device_put below. The
+    # multi-host path with true per-host ingest is parallel.perhost_ingest's
+    # PerHostRandomEffectSolver; this solver remains the single-process
+    # entity-sharded engine.
     padded_dataset: Optional[RandomEffectDataset] = None
 
     def __post_init__(self):

@@ -151,47 +151,9 @@ class MultihostContext:
         return self.is_coordinator
 
 
-def multihost_re_dataset(ds, mh: "MultihostContext", ctx: MeshContext):
-    """Assemble a globally entity-sharded RandomEffectDataset from per-host
-    entity slabs — the GAME analogue of :meth:`global_row_sharded`.
-
-    ``ds`` holds this host's view of the ENTITY-MAJOR tensors: in the test
-    harness every host builds the same full dataset (seeded) and this
-    function slices out the host's slab; a real per-host ingest would build
-    only the slab. Entity axis is padded to a device multiple (weight-0
-    lanes), then each host contributes ``entities_per_host`` consecutive
-    entities via ``jax.make_array_from_process_local_data``; the global-row
-    scoring tensors (entity_pos/feat_idx/feat_val) are replicated. Feed the
-    result to ``DistributedRandomEffectSolver(..., padded_dataset=...)``.
-    """
-    from photon_ml_tpu.data.game import RandomEffectDataset
-    from photon_ml_tpu.parallel.distributed import pad_re_dataset_entities
-
-    padded = pad_re_dataset_entities(ds, ctx.num_devices)
-    per_host = padded.num_entities // mh.num_processes
-    sl = slice(mh.process_id * per_host, (mh.process_id + 1) * per_host)
-    sharding = NamedSharding(ctx.mesh, P(ctx.axis))
-
-    def shard(a):
-        return jax.make_array_from_process_local_data(
-            sharding, np.asarray(a)[sl]
-        )
-
-    return RandomEffectDataset(
-        row_index=shard(padded.row_index),
-        x=shard(padded.x),
-        labels=shard(padded.labels),
-        base_offsets=shard(padded.base_offsets),
-        weights=shard(padded.weights),
-        entity_pos=mh.global_replicated(np.asarray(padded.entity_pos), ctx),
-        feat_idx=mh.global_replicated(np.asarray(padded.feat_idx), ctx),
-        feat_val=mh.global_replicated(np.asarray(padded.feat_val), ctx),
-        local_to_global=shard(padded.local_to_global),
-        num_entities=padded.num_entities,
-        global_dim=padded.global_dim,
-        projection_matrix=(
-            mh.global_replicated(np.asarray(padded.projection_matrix), ctx)
-            if padded.projection_matrix is not None
-            else None
-        ),
-    )
+# Multi-host RANDOM-EFFECT ingest lives in photon_ml_tpu.parallel
+# .perhost_ingest: each host decodes only its input partitions and the
+# collective shuffle (parallel.shuffle) regroups rows by entity owner —
+# no host ever builds the global dataset. (The earlier multihost_re_dataset
+# helper, which sliced per-host slabs out of a replicated host-side build,
+# was deleted when the true per-host path landed.)

@@ -108,6 +108,62 @@ def train_glm_grid(
     return TrainedModelList(weights, models, results)
 
 
+def train_glm_grid_streaming(
+    problem: GLMOptimizationProblem,
+    source,
+    norm: NormalizationContext,
+    reg_weights: Sequence[float],
+) -> TrainedModelList:
+    """Warm-started lambda grid over CHUNK-STREAMED data (out-of-core):
+    same high-to-low warm-start chain as :func:`train_glm_grid`, but each
+    solve is the host-driven streaming LBFGS — data >> device+host memory
+    trains (the StorageLevel.scala:22-24 DISK_ONLY answer, VERDICT r3 #5).
+
+    LBFGS/OWL-QN only (TRON's CG would need one streamed pass per
+    Hessian-vector product; reject rather than silently crawl).
+    """
+    from photon_ml_tpu.optim.problem import _split_reg_weight, variances_from_hessian_diag
+    from photon_ml_tpu.optim.streaming import (
+        lbfgs_minimize_streaming,
+        make_streaming_value_and_grad,
+        streaming_hessian_diagonal,
+    )
+    from photon_ml_tpu.types import OptimizerType
+    from photon_ml_tpu.models.glm import Coefficients
+
+    if problem.optimizer == OptimizerType.TRON:
+        raise ValueError("streaming training supports LBFGS/OWL-QN only")
+    obj = problem.objective
+    bounds = (
+        (problem.constraints.lower, problem.constraints.upper)
+        if problem.constraints is not None
+        else None
+    )
+    w = jnp.zeros((source.dim,), real_dtype())
+    # ONE factory for the whole grid: l2 rides through as an argument, so
+    # the per-chunk kernel compiles once (the streaming counterpart of the
+    # in-memory path's module-level jitted _solve)
+    vg_base = make_streaming_value_and_grad(source, obj, norm)
+    weights, models, results = [], [], []
+    for lam in sorted(reg_weights, reverse=True):
+        l1, l2 = _split_reg_weight(problem.regularization, lam)
+        vg = lambda wt, l2=l2: vg_base(wt, l2_weight=float(l2))
+        res = lbfgs_minimize_streaming(
+            vg, w, problem.optimizer_config, l1_weight=float(l1), bounds=bounds
+        )
+        w = res.coefficients
+        variances = None
+        if problem.compute_variance:
+            diag = streaming_hessian_diagonal(source, obj, norm, w, float(l2))
+            variances = variances_from_hessian_diag(diag)
+        models.append(
+            GeneralizedLinearModel(Coefficients(w, variances), problem.task)
+        )
+        weights.append(lam)
+        results.append(res)
+    return TrainedModelList(weights, models, results)
+
+
 def train_glm_grid_vmapped(
     problem: GLMOptimizationProblem,
     batch: GLMBatch,

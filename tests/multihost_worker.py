@@ -177,46 +177,92 @@ print(
     flush=True,
 )
 
-# -- the PRODUCTION random-effect stack across hosts: RandomEffectDataset
-# assembled from per-host entity slabs (multihost_re_dataset) through the
-# real DistributedRandomEffectSolver — not just the raw shard_map above ------
-from game_test_utils import make_glmix_data  # noqa: E402
-from photon_ml_tpu.algorithm.random_effect import RandomEffectCoordinate  # noqa: E402
-from photon_ml_tpu.data.game import (  # noqa: E402
-    RandomEffectDataConfig,
-    build_random_effect_dataset,
-)
-from photon_ml_tpu.parallel.distributed import DistributedRandomEffectSolver  # noqa: E402
-from photon_ml_tpu.parallel.multihost import multihost_re_dataset  # noqa: E402
+# -- the PRODUCTION random-effect stack across hosts, with TRUE per-host
+# ingest: each host converts only ITS row block to HostRows, the collective
+# shuffle routes rows to entity owners, and each host builds only its slab
+# (parallel.perhost_ingest — no replicated host-side build anywhere) --------
+import tracemalloc  # noqa: E402
 
-rng_g = np.random.default_rng(31)  # identical on every host (seeded ingest)
+from game_test_utils import make_glmix_data  # noqa: E402
+from photon_ml_tpu.parallel.perhost_ingest import (  # noqa: E402
+    HostRows,
+    PerHostRandomEffectSolver,
+    per_host_re_dataset,
+)
+
+rng_g = np.random.default_rng(31)  # the DATASET is seeded; the DECODE is per host
 gdata, _ = make_glmix_data(
-    rng_g, num_users=14, rows_per_user_range=(10, 25), d_fixed=4, d_random=3
+    rng_g, num_users=1500, rows_per_user_range=(8, 20), d_fixed=4, d_random=6
 )
-re_ds = build_random_effect_dataset(
-    gdata, RandomEffectDataConfig("userId", "per_user")
+n_rows_g = gdata.num_rows
+# simulate this host's Avro partition decode: keep ONLY the host's row block
+lo = proc_id * (n_rows_g // nprocs)
+hi = n_rows_g if proc_id == nprocs - 1 else (proc_id + 1) * (n_rows_g // nprocs)
+feats_g = gdata.shards["per_user"]
+nnz = np.diff(feats_g.indptr)[lo:hi]
+k_loc = max(int(nnz.max()) if len(nnz) else 1, 1)
+fi_h = np.full((hi - lo, k_loc), -1, np.int32)
+fv_h = np.zeros((hi - lo, k_loc), np.float32)
+for r in range(hi - lo):
+    s, e = feats_g.indptr[lo + r], feats_g.indptr[lo + r + 1]
+    fi_h[r, : e - s] = feats_g.indices[s:e]
+    fv_h[r, : e - s] = feats_g.values[s:e]
+vocab_g = gdata.id_vocabs["userId"]
+host_rows = HostRows(
+    entity_raw_ids=[vocab_g[i] for i in gdata.ids["userId"][lo:hi]],
+    row_index=np.arange(lo, hi, dtype=np.int64),
+    labels=gdata.response[lo:hi].astype(np.float32),
+    weights=gdata.weight[lo:hi].astype(np.float32),
+    offsets=gdata.offset[lo:hi].astype(np.float32),
+    feat_idx=fi_h,
+    feat_val=fv_h,
+    global_dim=feats_g.dim,
 )
-coord = RandomEffectCoordinate(
-    re_ds,
+global_dim_g = feats_g.dim
+del gdata, feats_g, fi_h, fv_h  # the full build must never exist on a host
+
+tracemalloc.start()
+sharded_ds = per_host_re_dataset(host_rows, ctx, nprocs, proc_id)
+_, ingest_peak = tracemalloc.get_traced_memory()
+tracemalloc.stop()
+
+solver = PerHostRandomEffectSolver(
+    sharded_ds,
     TaskType.LOGISTIC_REGRESSION,
     OptimizerType.LBFGS,
     OptimizerConfig(max_iterations=30, tolerance=1e-9),
     RegularizationContext.l2(0.3),
+    ctx,
 )
-global_ds = multihost_re_dataset(re_ds, mh, ctx)
-solver = DistributedRandomEffectSolver(coord, ctx, padded_dataset=global_ds)
-resid0 = mh.global_replicated(np.zeros(gdata.num_rows, np.float32), ctx)
+resid0 = mh.global_replicated(np.zeros(n_rows_g, np.float32), ctx)
 coefs_re, tracker = solver.update(resid0, solver.initial_coefficients())
-# tracker trimmed to REAL entities even across hosts
-assert tracker.reason.shape[0] == re_ds.num_entities
+scores_dev = solver.score(coefs_re)  # psum-merged -> replicated, addressable
+scores_re = np.asarray(jax.device_get(scores_dev))
 from jax.experimental import multihost_utils  # noqa: E402
 
 coefs_full = np.asarray(multihost_utils.process_allgather(coefs_re, tiled=True))
-scores_dev = solver.score(coefs_re)  # psum-merged -> replicated, addressable
-scores_re = np.asarray(jax.device_get(scores_dev))
+keys_full = np.asarray(
+    multihost_utils.process_allgather(sharded_ds.entity_keys, tiled=True)
+)
+mask_full = np.asarray(
+    multihost_utils.process_allgather(sharded_ds.entity_mask, tiled=True)
+)
+l2g_full = np.asarray(
+    multihost_utils.process_allgather(sharded_ds.local_to_global, tiled=True)
+)
 mh.barrier("solver-re-done")
 if outdir and mh.coordinator_only_io():
-    np.save(os.path.join(outdir, "re_coefs.npy"), coefs_full[: re_ds.num_entities])
+    np.savez(
+        os.path.join(outdir, "re_perhost.npz"),
+        coefs=coefs_full, keys=keys_full, mask=mask_full, l2g=l2g_full,
+        global_dim=global_dim_g,
+    )
     np.save(os.path.join(outdir, "re_scores.npy"), scores_re)
 mh.barrier("solver-re-saved")
-print(f"MHRESOLVER proc={proc_id} csum={float(np.sum(coefs_full)):.6f}", flush=True)
+csum = float(np.sum(coefs_full[mask_full]))
+# ingest_peak BEFORE csum: __graft_entry__ parses csum as the LAST token to
+# assert cross-host agreement, and the peaks legitimately differ per host
+print(
+    f"MHRESOLVER proc={proc_id} ingest_peak={ingest_peak} csum={csum:.6f}",
+    flush=True,
+)

@@ -143,45 +143,68 @@ def test_two_process_fixed_effect_matches_single_process(tmp_path):
         assert re_stats[i]["wsum"] == pytest.approx(float(np.sum(w_ref[sl])), abs=2e-3)
         assert re_stats[i]["ssum"] == pytest.approx(float(np.sum(s_ref[sl])), abs=2e-2)
 
-    # the PRODUCTION random-effect stack across hosts: multihost_re_dataset
-    # + DistributedRandomEffectSolver must reproduce the local
-    # RandomEffectCoordinate solve of the same (seeded) glmix dataset
+    # the PRODUCTION random-effect stack across hosts, built by TRUE
+    # per-host ingest (each worker converted only its row block; the
+    # collective shuffle regrouped by entity): must reproduce the
+    # single-process per-host path bit-for-bit (partitioning invariance)
+    # AND the per-host ingest peak memory must shrink vs one host doing
+    # all rows — the property that makes multi-host ingest worth having
     for out in outs:
         assert any(l.startswith("MHRESOLVER") for l in out.splitlines())
     import sys as _sys
 
     _sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    import tracemalloc
+
     from game_test_utils import make_glmix_data
-    from photon_ml_tpu.algorithm.random_effect import RandomEffectCoordinate
-    from photon_ml_tpu.data.game import (
-        RandomEffectDataConfig,
-        build_random_effect_dataset,
+    from photon_ml_tpu.parallel.mesh import MeshContext, data_mesh
+    from photon_ml_tpu.parallel.perhost_ingest import (
+        PerHostRandomEffectSolver,
+        per_host_re_dataset,
     )
+    from test_perhost_ingest import _host_rows_from_game
     from photon_ml_tpu.types import TaskType as TT, OptimizerType as OT
 
     rng_g = np.random.default_rng(31)
     gdata, _ = make_glmix_data(
-        rng_g, num_users=14, rows_per_user_range=(10, 25), d_fixed=4, d_random=3
+        rng_g, num_users=1500, rows_per_user_range=(8, 20), d_fixed=4, d_random=6
     )
-    re_ds = build_random_effect_dataset(
-        gdata, RandomEffectDataConfig("userId", "per_user")
-    )
-    local = RandomEffectCoordinate(
-        re_ds, TT.LOGISTIC_REGRESSION, OT.LBFGS,
+    ctx1 = MeshContext(data_mesh())  # 8 devices, same n_dev as 2x4 workers
+    rows_all = _host_rows_from_game(gdata, 0, gdata.num_rows)
+    tracemalloc.start()
+    sd1 = per_host_re_dataset(rows_all, ctx1)
+    _, single_peak = tracemalloc.get_traced_memory()
+    tracemalloc.stop()
+    solver1 = PerHostRandomEffectSolver(
+        sd1, TT.LOGISTIC_REGRESSION, OT.LBFGS,
         OptimizerConfig(max_iterations=30, tolerance=1e-9),
-        RegularizationContext.l2(0.3),
+        RegularizationContext.l2(0.3), ctx1,
     )
-    w_local, _ = local.update(
-        jnp2.zeros((gdata.num_rows,), jnp2.float32), local.initial_coefficients()
+    w1, _ = solver1.update(
+        jnp2.zeros((gdata.num_rows,), jnp2.float32), solver1.initial_coefficients()
     )
-    got_coefs = np.load(tmp_path / "re_coefs.npy")
+    scores1 = np.asarray(solver1.score(w1))
+
+    got = np.load(tmp_path / "re_perhost.npz")
+    # same device count on both sides -> identical owner map -> the slab
+    # layout, keys and coefficients must agree lane-for-lane
+    np.testing.assert_array_equal(got["keys"], np.asarray(sd1.entity_keys))
+    np.testing.assert_array_equal(got["mask"], np.asarray(sd1.entity_mask))
+    np.testing.assert_array_equal(got["l2g"], np.asarray(sd1.local_to_global))
     np.testing.assert_allclose(
-        got_coefs, np.asarray(w_local), rtol=5e-4, atol=5e-5
+        got["coefs"], np.asarray(w1), rtol=5e-4, atol=5e-5
     )
     got_scores = np.load(tmp_path / "re_scores.npy")
-    np.testing.assert_allclose(
-        got_scores, np.asarray(local.score(w_local)), rtol=5e-4, atol=5e-4
-    )
+    np.testing.assert_allclose(got_scores, scores1, rtol=5e-4, atol=5e-4)
+
+    # per-host ingest peak memory shrinks with host count (~1/2 here, with
+    # slack for fixed overheads): the replicated-build antipattern would
+    # put BOTH workers at >= the single-host peak
+    worker_peaks = []
+    for out in outs:
+        line = [l for l in out.splitlines() if l.startswith("MHRESOLVER")][0]
+        worker_peaks.append(int(line.split("ingest_peak=")[1].split()[0]))
+    assert max(worker_peaks) < 0.75 * single_peak, (worker_peaks, single_peak)
 
 
 def test_single_process_context_defaults():

@@ -1,0 +1,121 @@
+"""Profile the vmapped lambda grid vs sequential warm descents (VERDICT r3 #6).
+
+Replicates bench.py's _bench_game grid setup at CPU scale, instruments
+per-lane LBFGS iteration counts, and times three strategies:
+  1. vmapped cold (what bench measured: 0.85x vs sequential)
+  2. sequential warm (the thing to beat)
+  3. vmapped warm-started from one pre-solve at the heaviest lambda
+Run: JAX_PLATFORMS=cpu python tools/grid_profile.py
+"""
+
+import time
+
+import numpy as np
+
+import sys
+sys.path.insert(0, ".")
+sys.path.insert(0, "tests")
+
+import jax
+
+# env JAX_PLATFORMS=cpu is NOT enough: the axon register hook still tries the
+# tunnel and blocks if it is wedged — the explicit config update is what
+# keeps this process off the single-client claim (same as tests/conftest.py)
+jax.config.update("jax_platforms", "cpu")
+
+import jax.numpy as jnp
+
+from game_test_utils import make_glmix_data
+
+from photon_ml_tpu.algorithm import (
+    CoordinateDescent,
+    FixedEffectCoordinate,
+    RandomEffectCoordinate,
+)
+from photon_ml_tpu.data.game import (
+    RandomEffectDataConfig,
+    build_fixed_effect_batch,
+    build_random_effect_dataset,
+)
+from photon_ml_tpu.ops import losses
+from photon_ml_tpu.optim.common import OptimizerConfig
+from photon_ml_tpu.optim.problem import GLMOptimizationProblem
+from photon_ml_tpu.ops.regularization import RegularizationContext
+from photon_ml_tpu.types import OptimizerType, TaskType
+
+
+def build(num_users=2000):
+    rng = np.random.default_rng(11)
+    data, _ = make_glmix_data(
+        rng, num_users=num_users, rows_per_user_range=(8, 16), d_fixed=32, d_random=8
+    )
+    n = data.num_rows
+    fixed = FixedEffectCoordinate(
+        build_fixed_effect_batch(data, "global", dense=True),
+        GLMOptimizationProblem(
+            TaskType.LOGISTIC_REGRESSION,
+            OptimizerType.LBFGS,
+            OptimizerConfig(max_iterations=30, tolerance=1e-7),
+            RegularizationContext.l2(1e-2),
+        ),
+    )
+    re_ds = build_random_effect_dataset(data, RandomEffectDataConfig("userId", "per_user"))
+    random_c = RandomEffectCoordinate(
+        re_ds,
+        TaskType.LOGISTIC_REGRESSION,
+        OptimizerType.LBFGS,
+        OptimizerConfig(max_iterations=20, tolerance=1e-6),
+        RegularizationContext.l2(1e-1),
+    )
+    labels = jnp.asarray(data.response)
+    loss_fn = lambda scores: jnp.sum(losses.logistic.loss(scores, labels))
+    return fixed, random_c, loss_fn, n
+
+
+def main():
+    t_start = time.perf_counter()
+
+    def log(msg):
+        print(f"[{time.perf_counter() - t_start:7.1f}s] {msg}", flush=True)
+
+    fixed, random_c, loss_fn, n = build()
+    log(f"data built, n={n}")
+    g_lams = [0.01, 0.1, 1.0, 10.0]
+    lam = {"fixed": jnp.asarray(g_lams), "random": jnp.asarray([0.1] * len(g_lams))}
+    lam1 = lambda gl: {"fixed": jnp.asarray([gl]), "random": jnp.asarray([0.1])}
+
+    # per-lambda iteration counts for the FIXED coordinate (the grid axis):
+    # solve each lambda independently and read the OptResult iteration count
+    upd = jax.jit(lambda off, w0, rw: fixed.update(off, w0, reg_weight=rw))
+    for gl in g_lams:
+        w0 = fixed.initial_coefficients()
+        params, res = upd(jnp.zeros((n,)), w0, jnp.asarray(gl))
+        log(f"lambda={gl}: fixed-coordinate iters={int(res.iterations)}")
+
+    cd = CoordinateDescent({"fixed": fixed, "random": random_c}, loss_fn)
+
+    # 1. vmapped cold
+    log("compiling vmapped grid...")
+    cd.run_grid(lam, num_iterations=1, num_rows=n)
+    log("vmapped grid compiled")
+    t0 = time.perf_counter()
+    r = cd.run_grid(lam, num_iterations=2, num_rows=n)
+    jax.block_until_ready(r[-1].total_scores)
+    t_vm = time.perf_counter() - t0
+    print(f"vmapped cold: {t_vm:.3f}s")
+
+    # 2. sequential warm (bench's comparison arm)
+    seq = CoordinateDescent({"fixed": fixed, "random": random_c}, loss_fn)
+    log("compiling sequential (G=1) grid...")
+    seq.run_grid(lam1(g_lams[0]), num_iterations=1, num_rows=n)
+    log("sequential grid compiled")
+    t0 = time.perf_counter()
+    for gl in g_lams:
+        r = seq.run_grid(lam1(gl), num_iterations=2, num_rows=n)
+    jax.block_until_ready(r[-1].total_scores)
+    t_seq = time.perf_counter() - t0
+    print(f"sequential warm: {t_seq:.3f}s  (vmapped/seq speedup {t_seq / t_vm:.2f}x)")
+
+
+if __name__ == "__main__":
+    main()

@@ -603,21 +603,165 @@ def run_config_game(results, fast):
     ))
 
 
+def _game5_oracle(train, val, lam_f, lam_re, iters, shard3_imap,
+                  latent_dim=2, inner=2, seed=1234567890):
+    """Independent float64 alternating fit of the FULL config-5 objective
+    (VERDICT r3 #8): the config-4 ridge coordinate descent plus the factored
+    per-artist coordinate — per-entity latent ridge solves alternating with
+    an exact latent-matrix ridge refit over Kronecker features
+    (FactoredRandomEffectCoordinate.scala:218-253 semantics: margin_n =
+    vec(M) . (v_{e(n)} ⊗ x_n)), all in closed form (squared loss + L2).
+
+    Two deliberate, documented couplings to the driver — neither imports a
+    trained value:
+      * the artist design uses the driver's shard3 COLUMN ORDER
+        (``shard3_imap``), because the Gaussian init of M assigns values by
+        column index and the alternation is non-convex — both sides must
+        start at the same point to land on the same optimum;
+      * M0 comes from the same seeded Gaussian
+        (projectors.gaussian_random_projection_matrix), the framework's
+        deterministic init (FactoredRandomEffectCoordinate.scala:195-201
+        analogue). Every SOLVE here is numpy/scipy.
+    """
+    from photon_ml_tpu.projectors import gaussian_random_projection_matrix
+
+    n = len(train)
+    y = np.asarray([r["response"] for r in train])
+
+    fkeys = sorted({(f["name"], f["term"]) for r in train for f in r["features"]})
+    fpos = {k: j for j, k in enumerate(fkeys)}
+    dF = len(fkeys) + 1
+    rows, cols, vals = [], [], []
+    for i, r in enumerate(train):
+        for f in r["features"]:
+            rows.append(i); cols.append(fpos[(f["name"], f["term"])]); vals.append(f["value"])
+        rows.append(i); cols.append(dF - 1); vals.append(1.0)
+    Xf = scipy.sparse.csr_matrix((vals, (rows, cols)), shape=(n, dF))
+
+    Au, ugroups, dU = _entity_design(train, "userFeatures", "userId")
+    As, sgroups, dS = _entity_design(train, "songFeatures", "songId")
+
+    # artist design over shard3 in the DRIVER's column order (alignment with
+    # the seeded M0; IDENTITY projector = full shard space incl. intercept)
+    d3 = len(shard3_imap)
+    A3 = np.zeros((n, d3))
+    icpt3 = shard3_imap.intercept_index
+    for i, r in enumerate(train):
+        for f in r["songFeatures"]:
+            j = shard3_imap.get_index(f"{f['name']}\x01{f['term']}")
+            if j >= 0:
+                A3[i, j] = f["value"]
+        if icpt3 >= 0:
+            A3[i, icpt3] = 1.0
+    agroups = {}
+    for i, r in enumerate(train):
+        agroups.setdefault(r["artistId"], []).append(i)
+    agroups = {e: np.asarray(rr) for e, rr in agroups.items()}
+
+    M = gaussian_random_projection_matrix(
+        latent_dim, d3, keep_intercept=False, seed=seed
+    ).astype(np.float64)
+    V = {e: np.zeros(latent_dim) for e in agroups}
+
+    sf = np.zeros(n); su = np.zeros(n); ss = np.zeros(n); sa = np.zeros(n)
+    wf = np.zeros(dF)
+    Wu = {e: np.zeros(dU) for e in ugroups}
+    Ws = {e: np.zeros(dS) for e in sgroups}
+    for _ in range(iters):
+        wf = _ridge_solve_sparse(Xf, y - su - ss - sa, lam_f)
+        sf = Xf @ wf
+        for e, rr in ugroups.items():
+            A = Au[rr]
+            w = np.linalg.solve(
+                A.T @ A + lam_re * np.eye(dU), A.T @ (y[rr] - sf[rr] - ss[rr] - sa[rr])
+            )
+            Wu[e] = w
+            su[rr] = A @ w
+        for e, rr in sgroups.items():
+            A = As[rr]
+            w = np.linalg.solve(
+                A.T @ A + lam_re * np.eye(dS), A.T @ (y[rr] - sf[rr] - su[rr] - sa[rr])
+            )
+            Ws[e] = w
+            ss[rr] = A @ w
+        # factored per-artist coordinate on the residual of the other three
+        resid = y - sf - su - ss
+        for _ in range(inner):
+            # (a) per-entity latent ridge in the space projected by M
+            Xp = A3 @ M.T  # (n, k)
+            for e, rr in agroups.items():
+                B = Xp[rr]
+                V[e] = np.linalg.solve(
+                    B.T @ B + lam_re * np.eye(latent_dim), B.T @ resid[rr]
+                )
+            # (b) exact latent-matrix ridge refit over Kronecker features:
+            # margin_n = vec(M) . (v_{e(n)} ⊗ x_n)
+            v_rows = np.zeros((n, latent_dim))
+            for e, rr in agroups.items():
+                v_rows[rr] = V[e]
+            K = np.einsum("nk,nd->nkd", v_rows, A3).reshape(n, latent_dim * d3)
+            m = np.linalg.solve(
+                K.T @ K + lam_re * np.eye(latent_dim * d3), K.T @ resid
+            )
+            M = m.reshape(latent_dim, d3)
+        Xp = A3 @ M.T
+        for e, rr in agroups.items():
+            sa[rr] = Xp[rr] @ V[e]
+
+    total = sf + su + ss + sa
+    obj = (0.5 * np.sum((total - y) ** 2)
+           + 0.5 * lam_f * np.sum(wf ** 2)
+           + 0.5 * lam_re * sum(np.sum(w ** 2) for w in Wu.values())
+           + 0.5 * lam_re * sum(np.sum(w ** 2) for w in Ws.values())
+           + 0.5 * lam_re * sum(np.sum(v ** 2) for v in V.values())
+           + 0.5 * lam_re * np.sum(M ** 2))
+
+    # validation scoring (unseen entities score 0)
+    nv = len(val)
+    yv = np.asarray([r["response"] for r in val])
+    score = np.zeros(nv)
+    for i, r in enumerate(val):
+        for f in r["features"]:
+            j = fpos.get((f["name"], f["term"]))
+            if j is not None:
+                score[i] += wf[j] * f["value"]
+        score[i] += wf[dF - 1]
+    Auv, vug, _ = _entity_design(val, "userFeatures", "userId")
+    Asv, vsg, _ = _entity_design(val, "songFeatures", "songId")
+    for e, rr in vug.items():
+        if e in Wu:
+            score[rr] += Auv[rr] @ Wu[e]
+    for e, rr in vsg.items():
+        if e in Ws:
+            score[rr] += Asv[rr] @ Ws[e]
+    A3v = np.zeros((nv, d3))
+    for i, r in enumerate(val):
+        for f in r["songFeatures"]:
+            j = shard3_imap.get_index(f"{f['name']}\x01{f['term']}")
+            if j >= 0:
+                A3v[i, j] = f["value"]
+        if icpt3 >= 0:
+            A3v[i, icpt3] = 1.0
+    Xpv = A3v @ M.T
+    for i, r in enumerate(val):
+        v = V.get(r["artistId"])
+        if v is not None:
+            score[i] += Xpv[i] @ v
+    rmse = float(np.sqrt(np.mean((score - yv) ** 2)))
+    return obj, rmse
+
+
 def run_config_game5(results, fast):
     """Config 5 (full GAME): config 4 + a FACTORED per-artist coordinate
     (latent dim 2 — the MF/FactoredRandomEffectCoordinate path,
     FactoredRandomEffectCoordinate.scala:36-285) on yahoo-music.
 
-    The factored alternation is non-convex, so there is no closed-form
-    oracle; the reference's own e2e suite (DriverTest.scala) never trains a
-    factored coordinate either. Gates here are consistency gates:
-      * Δmetric = max(0, RMSE_full - RMSE_config4_oracle): adding the
-        factored coordinate must not degrade the exactly-verified config-4
-        fit (gate 0.02);
-      * rel Δobj = the largest relative objective INCREASE across coordinate
-        updates (Armijo line searches only accept decreases, so the descent
-        must be monotone; gate absorbs float noise);
-      * the latent structure must round-trip from disk (LatentFactorAvro).
+    Gated against :func:`_game5_oracle` — an INDEPENDENT float64 alternating
+    ridge fit of the identical factored objective (exact per-entity latent
+    solves + exact Kronecker latent-matrix refits) started from the same
+    seeded M0, held to the standard OBJ_GATE/METRIC_GATE. Two consistency
+    gates ride along: monotone objective descent across updates, and the
+    latent structure round-tripping from disk (LatentFactorAvro).
     """
     from photon_ml_tpu.cli.game_training_driver import main as game_main
     from photon_ml_tpu.io import model_io
@@ -666,19 +810,28 @@ def run_config_game5(results, fast):
     factors, matrix, re_id, _ = model_io.load_factored_random_effect(best, "per-artist")
     assert re_id == "artistId" and matrix.shape[0] == 2 and len(factors) > 0
 
-    _, rmse4_oracle = _game_oracle(train, val, lam_f, lam_re, iters)
+    assert worst_increase < 1e-6, f"objective not monotone: {worst_increase}"
+
+    # INDEPENDENT oracle of the identical full objective (VERDICT r3 #8):
+    # alternating closed-form ridge fit incl. the Kronecker latent refit,
+    # from the same seeded M0 — replaces the old self-referential
+    # config-4-regression gate
+    ref_obj, ref_rmse = _game5_oracle(
+        train, val, lam_f, lam_re, iters, driver.shard_index_maps["shard3"]
+    )
     results.append(dict(
         config=(f"5: full GAME on yahoo-music (+ FACTORED per-artist MF "
-                f"coordinate, latent dim 2; {len(train)}/{len(val)} rows). "
-                "Δmetric = RMSE regression vs the config-4 oracle; rel Δobj = "
-                "worst objective increase across updates (monotone descent)"),
+                f"coordinate, latent dim 2; {len(train)}/{len(val)} rows), "
+                "vs an independent float64 alternating ridge fit of the "
+                "identical factored objective (exact per-entity latent + "
+                "Kronecker latent-matrix solves) from the same seeded M0; "
+                "monotone-descent gate also enforced"),
         optimizer="LBFGS", wall_sec=wall, best_lambda=lam_f,
-        rows=[dict(lam=lam_f, ours_rmse=rmse_full, ref_rmse=rmse4_oracle,
-                   rmse_diff=max(0.0, rmse_full - rmse4_oracle),
-                   ours_obj=obj_hist[-1], ref_obj=obj_hist[0],
-                   obj_rel=worst_increase)],
+        rows=[dict(lam=lam_f, ours_rmse=rmse_full, ref_rmse=ref_rmse,
+                   rmse_diff=abs(rmse_full - ref_rmse),
+                   ours_obj=obj_hist[-1], ref_obj=ref_obj,
+                   obj_rel=abs(obj_hist[-1] - ref_obj) / abs(ref_obj))],
         metric="RMSE",
-        metric_gate=0.02,
     ))
 
 
