@@ -87,10 +87,14 @@ def _probe_backend(errors, timeout_s):
     out_f = tempfile.NamedTemporaryFile(
         mode="w+", suffix=".out", prefix="tpu-probe-", delete=False
     )
+    err_f = tempfile.NamedTemporaryFile(
+        mode="w+", suffix=".err", prefix="tpu-probe-", delete=False
+    )
     proc = subprocess.Popen(
         [sys.executable, "-c", "import jax; print(jax.devices()[0].platform)"],
         stdout=out_f,
-        stderr=subprocess.STDOUT,
+        stderr=err_f,  # separate: teardown/warning logs must not be read
+        # as the platform name (stdout's last line is the contract)
         start_new_session=True,  # survives the bench; never reparented-killed
     )
     deadline = time.time() + timeout_s
@@ -99,8 +103,9 @@ def _probe_backend(errors, timeout_s):
             break
         time.sleep(2)
     if proc.poll() is None:
-        # keep the file: the detached child is still writing to it
+        # keep the files: the detached child is still writing to them
         out_f.close()
+        err_f.close()
         errors.setdefault("backend_attempts", []).append(
             f"no answer in {timeout_s}s; probe left running (pid {proc.pid}, "
             "never killed — see r3 claim-orphan postmortem)"
@@ -108,11 +113,15 @@ def _probe_backend(errors, timeout_s):
         return None
     out_f.seek(0)
     text = out_f.read().strip()
+    err_f.seek(0)
+    err_text = err_f.read().strip()
     out_f.close()
+    err_f.close()
     os.unlink(out_f.name)
+    os.unlink(err_f.name)
     if proc.returncode != 0:
         errors.setdefault("backend_attempts", []).append(
-            " | ".join(text.splitlines()[-3:])
+            " | ".join(err_text.splitlines()[-3:] or text.splitlines()[-3:])
         )
         return None
     lines = [l for l in text.splitlines() if l.strip()]
@@ -229,9 +238,11 @@ def _bench_dense(extra, x_h, y_h, on_tpu=True):
     if rel_v > 5e-2 or rel_g > 5e-2:
         raise AssertionError(f"bf16 storage diverged from f32 path ({rel_v}, {rel_g})")
 
-    # runtime autotune: single-pass Pallas kernel vs two-pass XLA
+    # runtime autotune: single-pass Pallas kernel families vs two-pass XLA
     block = fused_glm.select_fused_block_rows(losses.logistic, n, d, jnp.bfloat16)
     extra["fused_block_rows"] = block  # None = XLA two-pass won (or off-TPU)
+    if block is not None:
+        extra["fused_family"] = "{}:{}".format(*fused_glm._decode_block(block))
     obj = GLMObjective(losses.logistic, fused_block_rows=block)
     batch = GLMBatch.create(feats_bf16, labels)
 
@@ -376,7 +387,7 @@ def _bench_streaming(extra, on_tpu):
     from photon_ml_tpu.optim.streaming import (
         ChunkedGLMSource,
         make_streaming_value_and_grad,
-        write_npz_chunks,
+        write_chunk_files,
     )
 
     n = 262144 if on_tpu else 32768
@@ -388,8 +399,8 @@ def _bench_streaming(extra, on_tpu):
 
     tmp = tempfile.mkdtemp(prefix="bench-stream-")
     try:
-        write_npz_chunks(tmp, x, y, chunk_rows=32768)
-        src = ChunkedGLMSource.from_npz_dir(tmp)
+        write_chunk_files(tmp, x, y, chunk_rows=32768)
+        src = ChunkedGLMSource.from_chunk_dir(tmp)
         obj = GLMObjective(losses.logistic)
         norm = NormalizationContext.identity()
         vg = make_streaming_value_and_grad(src, obj, norm, l2_weight=0.1)

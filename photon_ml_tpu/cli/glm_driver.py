@@ -277,6 +277,10 @@ class Driver:
                 "layout instead (sparse chunk spilling is not implemented)."
             )
         chunk_dir = os.path.join(p.output_dir, "stream-chunks")
+        # stale chunks from an aborted prior run must never be trained on
+        import shutil
+
+        shutil.rmtree(chunk_dir, ignore_errors=True)
         os.makedirs(chunk_dir, exist_ok=True)
         chunk_i = 0
         total_rows = 0
@@ -306,9 +310,9 @@ class Driver:
                     k: np.concatenate([q[k] for q in parts])
                     for k in parts[0]
                 }
-                np.savez(
-                    os.path.join(chunk_dir, f"chunk-{chunk_i:05d}.npz"), **payload
-                )
+                from photon_ml_tpu.optim.streaming import write_chunk
+
+                write_chunk(chunk_dir, chunk_i, payload)
                 chunk_i += 1
                 buf_rows -= take
 
@@ -337,7 +341,7 @@ class Driver:
             total_rows += ds.num_rows
             _flush()
         _flush(final=True)
-        self.streaming_source = ChunkedGLMSource.from_npz_dir(chunk_dir)
+        self.streaming_source = ChunkedGLMSource.from_chunk_dir(chunk_dir)
         self.logger.info(
             f"streaming mode: {total_rows} rows x {dim} features spilled to "
             f"{chunk_i} chunks of {p.streaming_chunk_rows} rows (+ tail)"

@@ -43,13 +43,35 @@ from photon_ml_tpu.ops.losses import PointwiseLoss, logistic
 
 DEFAULT_BLOCK_ROWS = 1024
 
-# Candidate row-block sizes for the autotuner. Bigger blocks amortize the
-# (1, BN) x (BN, D) gradient matmul's low MXU occupancy and cut grid
-# overhead; the ceiling is VMEM (BN x D x 2B for bf16 plus the f32
-# scalars), so 8192 x 512 bf16 = 8 MiB stays comfortably under budget.
-# NEGATIVE candidates select the manual double-buffered variant (explicit
-# chunked async DMA for all row streams) at |size| rows per chunk.
-AUTOTUNE_CANDIDATES = (1024, 2048, 4096, 8192, 16384, -2048, -4096, -8192)
+# Candidate encodings for the autotuner (decoded by _decode_block):
+#   positive < VPU_MARK  — automatic grid pipeline, MXU matmuls;
+#   negative             — manual double-buffered variant (explicit chunked
+#                          async DMA for all row streams), |size| rows/chunk;
+#   VPU_MARK + rows      — the VPU formulation: both contractions as
+#                          elementwise multiply + reduction instead of M=1
+#                          matmuls. Rationale: at one output column the MXU
+#                          still pays BN*D/128 cycles per contraction, which
+#                          makes the GEVM pair COMPUTE-bound (~1.2e8 ex/s at
+#                          D=512 — right where the r3 capture landed), while
+#                          the VPU's elementwise throughput can keep pace
+#                          with full HBM bandwidth.
+# Bigger blocks amortize grid overhead; the ceiling is VMEM (BN x D x 2B
+# for bf16 plus the f32 scalars), so 8192 x 512 bf16 = 8 MiB stays
+# comfortably under budget.
+VPU_MARK = 1 << 20
+AUTOTUNE_CANDIDATES = (
+    1024, 2048, 4096, 8192, 16384, -2048, -4096, -8192,
+    VPU_MARK + 2048, VPU_MARK + 4096, VPU_MARK + 8192, VPU_MARK + 16384,
+)
+
+
+def _decode_block(block_rows: int) -> Tuple[str, int]:
+    """(family, rows) from the encoded autotune candidate."""
+    if block_rows >= VPU_MARK:
+        return "vpu", block_rows - VPU_MARK
+    if block_rows < 0:
+        return "manual", -block_rows
+    return "grid", block_rows
 
 _FUSED_ENV = "PHOTON_ML_TPU_FUSED"  # "auto" (default) | "0" (off) | "1" (force)
 
@@ -125,15 +147,69 @@ def _unpack_outputs(loss_sum, grad, sumd):
     return loss_sum[0, 0], grad[0], sumd[0, 0]
 
 
+def _make_vpu_kernel(loss: PointwiseLoss):
+    """Grid kernel with BOTH contractions as elementwise multiply +
+    reduction on the VPU (no matmuls): z via a lane reduction over D,
+    the gradient via a sublane reduction over the row block. Escapes the
+    M=1 MXU GEVM ceiling (see AUTOTUNE_CANDIDATES) at the cost of f32
+    elementwise work the VPU can sustain at full HBM rate."""
+
+    def _kernel(
+        x_ref, y_ref, wt_ref, off_ref, w_ref,
+        loss_out, grad_out, sumd_out,
+        acc_grad, acc_loss, acc_sumd,
+    ):
+        i = pl.program_id(0)
+
+        @pl.when(i == 0)
+        def _():
+            acc_grad[:] = jnp.zeros_like(acc_grad)
+            acc_loss[:] = jnp.zeros_like(acc_loss)
+            acc_sumd[:] = jnp.zeros_like(acc_sumd)
+
+        x = x_ref[:].astype(jnp.float32)  # (BN, D)
+        w_row = w_ref[:]  # (1, D) f32 — marshalled row-major for the VPU
+        y = y_ref[:]
+        wt = wt_ref[:]
+        off = off_ref[:]
+
+        z = jnp.sum(x * w_row, axis=1, keepdims=True) + off  # (BN, 1)
+        lv = loss.loss(z, y)
+        wl = jnp.where(wt > 0.0, wt * lv, 0.0)
+        d = jnp.where(wt > 0.0, wt * loss.d1(z, y), 0.0)  # (BN, 1)
+
+        acc_loss[:] += jnp.sum(wl, keepdims=True).reshape(1, 1)
+        acc_sumd[:] += jnp.sum(d, keepdims=True).reshape(1, 1)
+        acc_grad[:] += jnp.sum(x * d, axis=0, keepdims=True)  # (1, D)
+
+        @pl.when(i == pl.num_programs(0) - 1)
+        def _():
+            loss_out[:] = acc_loss[:]
+            grad_out[:] = acc_grad[:]
+            sumd_out[:] = acc_sumd[:]
+
+    return _kernel
+
+
 @functools.lru_cache(maxsize=64)
-def _fused_fn(loss: PointwiseLoss, block_rows: int, interpret: bool):
+def _fused_fn(loss: PointwiseLoss, block_rows: int, interpret: bool, vpu: bool = False):
     """Jitted single-pass (loss_sum, grad, sum_d) for one loss/block config."""
-    kernel = _make_kernel(loss)
+    kernel = _make_vpu_kernel(loss) if vpu else _make_kernel(loss)
 
     @jax.jit
     def call(x, y, weights, offsets, w):
         n, d = x.shape
         grid = n // block_rows
+        inputs = _marshal_inputs(x, y, weights, offsets, w)
+        # the VPU formulation wants w row-major (1, D) so the broadcast
+        # multiply needs no in-kernel relayout
+        w_spec = (
+            pl.BlockSpec((1, d), lambda i: (0, 0))
+            if vpu
+            else pl.BlockSpec((d, 1), lambda i: (0, 0))
+        )
+        if vpu:
+            inputs = inputs[:4] + (inputs[4].reshape(1, d),)
         loss_sum, grad, sumd = pl.pallas_call(
             kernel,
             grid=(grid,),
@@ -142,7 +218,7 @@ def _fused_fn(loss: PointwiseLoss, block_rows: int, interpret: bool):
                 pl.BlockSpec((block_rows, 1), lambda i: (i, 0)),
                 pl.BlockSpec((block_rows, 1), lambda i: (i, 0)),
                 pl.BlockSpec((block_rows, 1), lambda i: (i, 0)),
-                pl.BlockSpec((d, 1), lambda i: (0, 0)),
+                w_spec,
             ],
             out_specs=[
                 pl.BlockSpec((1, 1), lambda i: (0, 0)),
@@ -162,7 +238,7 @@ def _fused_fn(loss: PointwiseLoss, block_rows: int, interpret: bool):
             # the grid axis is a pure reduction: no ordering constraint
             compiler_params=pltpu.CompilerParams(dimension_semantics=("arbitrary",)),
             interpret=interpret,
-        )(*_marshal_inputs(x, y, weights, offsets, w))
+        )(*inputs)
         return _unpack_outputs(loss_sum, grad, sumd)
 
     return call
@@ -302,14 +378,16 @@ def fused_value_grad_parts(
     ``x``: (N, D), any float dtype — bfloat16 recommended for bandwidth.
     Rows are padded (weight 0) up to a block multiple.
 
-    ``block_rows``: positive = automatic grid pipeline; NEGATIVE = the
-    manual double-buffered variant with |block_rows| rows per chunk (the
-    autotuner races both families and encodes its choice in the sign).
+    ``block_rows``: an encoded (family, rows) candidate — positive =
+    automatic grid pipeline (MXU matmuls), negative = the manual
+    double-buffered variant with |block_rows| rows per chunk, >= VPU_MARK
+    = the VPU elementwise formulation (see _decode_block; the autotuner
+    races all three families and returns the winning encoding).
     """
     if interpret is None:
         interpret = not _on_tpu()
-    manual = block_rows < 0
-    block = min(abs(block_rows), max(x.shape[0], 1))
+    family, rows = _decode_block(block_rows)
+    block = min(rows, max(x.shape[0], 1))
     n, d = x.shape
     pad = (-n) % block
     if pad:
@@ -317,8 +395,11 @@ def fused_value_grad_parts(
         y = jnp.concatenate([y, jnp.zeros((pad,), y.dtype)])
         weights = jnp.concatenate([weights, jnp.zeros((pad,), weights.dtype)])
         offsets = jnp.concatenate([offsets, jnp.zeros((pad,), offsets.dtype)])
-    fn = _fused_fn_manual if manual else _fused_fn
-    return fn(loss, block, interpret)(x, y, weights, offsets, w)
+    if family == "manual":
+        fn = _fused_fn_manual(loss, block, interpret)
+    else:
+        fn = _fused_fn(loss, block, interpret, vpu=family == "vpu")
+    return fn(x, y, weights, offsets, w)
 
 
 def fused_logistic_value_and_grad(
@@ -448,7 +529,7 @@ def select_fused_block_rows(
         timings[None] = _time_value_and_grad(xla_vg, w0, probe_data)
     interpret = not _on_tpu()
     for block in candidates:
-        if abs(block) > n_probe:
+        if _decode_block(block)[1] > n_probe:
             continue
         try:
             fn = lambda w, data, b=block: fused_value_grad_parts(
@@ -481,7 +562,12 @@ def autotune_report(loss: PointwiseLoss, n: int, d: int, dtype=jnp.bfloat16) -> 
     x_bytes = n_probe * d * jnp.dtype(dtype).itemsize
     candidates = {}
     for cand, sec in _autotune_timings.get(key, {}).items():
-        candidates["xla" if cand is None else str(cand)] = {
+        name = (
+            "xla"
+            if cand is None
+            else "{}:{}".format(*_decode_block(cand))
+        )
+        candidates[name] = {
             "sec_per_pass": round(sec, 6),
             "examples_per_sec": round(n_probe / sec, 1),
             "one_stream_gb_per_sec": round(x_bytes / sec / 1e9, 1),

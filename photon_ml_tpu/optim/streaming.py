@@ -53,8 +53,8 @@ class ChunkedGLMSource:
     ``loaders`` yield host numpy dicts with keys x (n_c, D), y (n_c,), and
     optional offsets/weights — one chunk at a time, so only one chunk is
     ever resident. Build with :meth:`from_arrays` (in-memory split, for
-    tests/benches) or :meth:`from_npz_dir` (one .npz per chunk, opened with
-    mmap so the OS page cache is the disk tier).
+    tests/benches) or :meth:`from_chunk_dir` (per-stream .npy files,
+    genuinely mmap'd so the OS page cache is the disk tier).
     """
 
     loaders: Sequence[Callable[[], dict]]
@@ -87,30 +87,36 @@ class ChunkedGLMSource:
         return cls(loaders=loaders, dim=x.shape[1], num_rows=n)
 
     @classmethod
-    def from_npz_dir(cls, path: str) -> "ChunkedGLMSource":
-        """Each ``chunk-*.npz`` holds one chunk's x/y(/offsets/weights)."""
-        files = sorted(
-            os.path.join(path, f)
+    def from_chunk_dir(cls, path: str) -> "ChunkedGLMSource":
+        """Chunks as per-stream .npy files (``chunk-NNNNN.x.npy`` etc.):
+        .npy supports REAL mmap (np.load ignores mmap_mode inside .npz
+        zips), so construction reads only headers and a pass touches only
+        the pages it streams — the page cache genuinely is the disk tier."""
+        stems = sorted(
+            f[: -len(".x.npy")]
             for f in os.listdir(path)
-            if f.startswith("chunk-") and f.endswith(".npz")
+            if f.startswith("chunk-") and f.endswith(".x.npy")
         )
-        if not files:
-            raise ValueError(f"no chunk-*.npz files under {path}")
+        if not stems:
+            raise ValueError(f"no chunk-*.x.npy files under {path}")
         dim = None
         num_rows = 0
-        for f in files:
-            with np.load(f, mmap_mode="r") as z:
-                dim = int(z["x"].shape[1])
-                num_rows += int(z["x"].shape[0])
+        for s in stems:
+            hdr = np.load(os.path.join(path, s + ".x.npy"), mmap_mode="r")
+            dim = int(hdr.shape[1])
+            num_rows += int(hdr.shape[0])
         loaders = []
-        for f in files:
+        for s in stems:
 
-            def load(f=f):
-                z = np.load(f, mmap_mode="r")
-                out = {"x": z["x"], "y": z["y"]}
+            def load(s=s):
+                out = {
+                    "x": np.load(os.path.join(path, s + ".x.npy"), mmap_mode="r"),
+                    "y": np.load(os.path.join(path, s + ".y.npy"), mmap_mode="r"),
+                }
                 for k in ("offsets", "weights"):
-                    if k in z.files:
-                        out[k] = z[k]
+                    f = os.path.join(path, f"{s}.{k}.npy")
+                    if os.path.exists(f):
+                        out[k] = np.load(f, mmap_mode="r")
                 return out
 
             loaders.append(load)
@@ -121,18 +127,24 @@ class ChunkedGLMSource:
             yield load()
 
 
-def write_npz_chunks(
+def write_chunk(path: str, index: int, payload: dict) -> None:
+    """One chunk as per-stream .npy files (mmap-able; see from_chunk_dir)."""
+    for k, v in payload.items():
+        np.save(os.path.join(path, f"chunk-{index:05d}.{k}.npy"), v)
+
+
+def write_chunk_files(
     path: str,
     x: np.ndarray,
     y: np.ndarray,
     chunk_rows: int,
     offsets: Optional[np.ndarray] = None,
     weights: Optional[np.ndarray] = None,
-) -> List[str]:
+) -> int:
     """Spill an in-memory batch to chunk files (test/bench helper; real
-    ingest writes chunks directly from the Avro decode)."""
+    ingest writes chunks directly from the Avro decode). Returns the count."""
     os.makedirs(path, exist_ok=True)
-    out = []
+    count = 0
     for i, lo in enumerate(range(0, len(y), chunk_rows)):
         hi = min(lo + chunk_rows, len(y))
         payload = {"x": x[lo:hi], "y": y[lo:hi]}
@@ -140,10 +152,9 @@ def write_npz_chunks(
             payload["offsets"] = offsets[lo:hi]
         if weights is not None:
             payload["weights"] = weights[lo:hi]
-        f = os.path.join(path, f"chunk-{i:05d}.npz")
-        np.savez(f, **payload)
-        out.append(f)
-    return out
+        write_chunk(path, i, payload)
+        count += 1
+    return count
 
 
 # ---------------------------------------------------------------------------

@@ -191,3 +191,42 @@ class TestManualDoubleBufferedVariant:
             losses.logistic, 1024, 128, candidates=(-512,)
         )
         assert block == -512
+
+
+class TestVpuFamily:
+    """The VPU elementwise formulation (encoded VPU_MARK + rows) must match
+    the MXU grid kernel and the XLA oracle exactly — interpreter-mode
+    equivalence; the perf race happens on real hardware."""
+
+    def test_vpu_kernel_matches_oracle(self, rng):
+        import jax.numpy as jnp
+
+        from photon_ml_tpu.ops.fused_glm import (
+            VPU_MARK,
+            fused_value_grad_parts,
+            reference_logistic_value_and_grad,
+        )
+        from photon_ml_tpu.ops import losses
+
+        n, d = 512, 256
+        x = jnp.asarray(rng.normal(size=(n, d)).astype(np.float32))
+        y = jnp.asarray((rng.random(n) < 0.5).astype(np.float32))
+        wt = jnp.asarray(rng.uniform(0.5, 2.0, n).astype(np.float32))
+        off = jnp.asarray(rng.normal(scale=0.2, size=n).astype(np.float32))
+        w = jnp.asarray(rng.normal(size=d).astype(np.float32) * 0.1)
+        lv, g, sumd = fused_value_grad_parts(
+            losses.logistic, x, y, wt, off, w, block_rows=VPU_MARK + 128
+        )
+        lv2, g2, sumd2 = fused_value_grad_parts(
+            losses.logistic, x, y, wt, off, w, block_rows=128
+        )
+        np.testing.assert_allclose(float(lv), float(lv2), rtol=1e-5)
+        np.testing.assert_allclose(np.asarray(g), np.asarray(g2), rtol=2e-3, atol=1e-4)
+        np.testing.assert_allclose(float(sumd), float(sumd2), rtol=1e-4, atol=1e-5)
+
+    def test_decode_block(self):
+        from photon_ml_tpu.ops.fused_glm import VPU_MARK, _decode_block
+
+        assert _decode_block(4096) == ("grid", 4096)
+        assert _decode_block(-2048) == ("manual", 2048)
+        assert _decode_block(VPU_MARK + 8192) == ("vpu", 8192)

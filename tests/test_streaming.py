@@ -21,7 +21,7 @@ from photon_ml_tpu.optim.streaming import (
     ChunkedGLMSource,
     lbfgs_minimize_streaming,
     make_streaming_value_and_grad,
-    write_npz_chunks,
+    write_chunk_files,
 )
 
 
@@ -114,11 +114,12 @@ class TestStreamingLBFGS:
         np.testing.assert_array_equal(s == 0.0, k == 0.0)
         np.testing.assert_allclose(s, k, rtol=2e-3, atol=2e-4)
 
-    def test_npz_dir_source(self, problem, tmp_path):
-        """Disk-backed chunks (mmap'd npz files) train identically."""
+    def test_chunk_dir_source(self, problem, tmp_path):
+        """Disk-backed chunks (mmap'd per-stream .npy files) train
+        identically, and construction reads only headers."""
         x, y, offs, wts = problem
-        write_npz_chunks(str(tmp_path), x, y, 640, offsets=offs, weights=wts)
-        src = ChunkedGLMSource.from_npz_dir(str(tmp_path))
+        write_chunk_files(str(tmp_path), x, y, 640, offsets=offs, weights=wts)
+        src = ChunkedGLMSource.from_chunk_dir(str(tmp_path))
         assert src.num_rows == len(y) and src.dim == x.shape[1]
         st_disk = _streaming_result(problem, 0, source=src)
         st_mem = _streaming_result(problem, chunk_rows=640)

@@ -84,7 +84,7 @@ def main():
             f"({time.strftime('%Y-%m-%d %H:%M UTC', time.gmtime())}); "
             "autotune candidates in tools/autotune_report.json."
         )
-        out = os.path.join(REPO, "BENCH_SELFRUN_r03.json")
+        out = os.path.join(REPO, "BENCH_SELFRUN_r04.json")
         with open(out, "w") as f:
             json.dump(payload, f, indent=1)
         log(f"TPU capture preserved to {out}")

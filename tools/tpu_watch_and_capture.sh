@@ -1,0 +1,14 @@
+#!/bin/bash
+# Waits for the patient prober (tpu_probe_loop.sh) to report a healthy
+# tunnel, then runs the one-shot capture (autotune race + full bench on the
+# real chip). Runs everything to natural completion — NOTHING here is ever
+# killed (r3 claim-orphan postmortem). Start detached:
+#     nohup bash tools/tpu_watch_and_capture.sh >> tools/tpu_watch.log 2>&1 &
+cd /root/repo
+echo "$(date -u +%H:%M:%S) watcher start"
+while [ ! -f tools/tpu_probe_ok ]; do
+  sleep 30
+done
+echo "$(date -u +%H:%M:%S) tunnel healthy ($(cat tools/tpu_probe_ok)); capturing"
+python tools/tpu_capture.py
+echo "$(date -u +%H:%M:%S) capture done rc=$?"
