@@ -371,7 +371,7 @@ def _bench_scoring(extra, on_tpu):
 
 def _bench_streaming(extra, on_tpu):
     """Out-of-core fixed-effect solve (optim/streaming.py, VERDICT r3 #5):
-    rows/sec through one chunk-streamed value+grad pass (mmap'd npz chunks,
+    rows/sec through one chunk-streamed value+grad pass (mmap'd per-stream .npy chunks,
     host->device per chunk) vs the in-memory pass — the cost of training
     when data >> device+host memory."""
     import shutil

@@ -277,11 +277,15 @@ class Driver:
                 "layout instead (sparse chunk spilling is not implemented)."
             )
         chunk_dir = os.path.join(p.output_dir, "stream-chunks")
-        # stale chunks from an aborted prior run must never be trained on
+        # stale chunks from an aborted prior run must never be trained on —
+        # and a FAILED purge must be loud, not a silent mixed-data model
         import shutil
 
-        shutil.rmtree(chunk_dir, ignore_errors=True)
-        os.makedirs(chunk_dir, exist_ok=True)
+        if os.path.exists(chunk_dir):
+            shutil.rmtree(chunk_dir)
+        os.makedirs(chunk_dir)
+        if os.listdir(chunk_dir):
+            raise RuntimeError(f"could not purge stale stream chunks in {chunk_dir}")
         chunk_i = 0
         total_rows = 0
         # carry rows across file boundaries so every chunk except the final

@@ -453,3 +453,105 @@ class PerHostRandomEffectSolver:
         return l1 * jnp.sum(jnp.abs(coefficients)) + 0.5 * l2 * jnp.sum(
             jnp.square(coefficients)
         )
+
+
+# ---------------------------------------------------------------------------
+# per-host Avro decode (the DataProcessingUtils per-partition analogue)
+# ---------------------------------------------------------------------------
+
+
+def host_rows_from_avro(
+    host_files: Sequence[str],
+    file_ordinals: Sequence[int],
+    index_map,
+    random_effect_id: str,
+    shard_id: str,
+    shard_sections: Sequence[str],
+    intercept: bool = True,
+    row_stride: int = 1 << 22,
+) -> HostRows:
+    """Decode ONLY this host's Avro part files into :class:`HostRows`.
+
+    The real-driver entry to per-host ingest (DataProcessingUtils.scala:
+    57-80 semantics): ``host_files`` is this host's slice of the input
+    (``MultihostContext.host_shard_paths``), ``file_ordinals`` their
+    positions in the GLOBAL sorted file list — global row ids are
+    ``ordinal * row_stride + row_in_file``, unique without any cross-host
+    coordination as long as every file holds < row_stride rows. The feature
+    index map is consulted per decoded record; with the off-heap store
+    (io/offheap.py) the backing is mmap'd, so each host faults in only the
+    index pages its own partitions touch — per-partition index-map
+    instantiation without explicit partition files.
+    """
+    from photon_ml_tpu.io.avro_data import read_game_data
+
+    file_ordinals = list(file_ordinals)
+    if len(host_files) != len(file_ordinals):
+        raise ValueError(
+            f"{len(host_files)} files but {len(file_ordinals)} ordinals — "
+            "a mismatch would silently drop input files"
+        )
+    max_ord = max(file_ordinals) if file_ordinals else 0
+    if (max_ord + 1) * row_stride >= 2**31:
+        raise ValueError(
+            f"file ordinal {max_ord} x stride {row_stride} overflows the "
+            "int32 row-id space; lower row_stride or merge input files"
+        )
+    parts: List[HostRows] = []
+    for path, ordinal in zip(host_files, file_ordinals):
+        gd = read_game_data(
+            [path],
+            {shard_id: index_map},
+            {shard_id: list(shard_sections)},
+            [random_effect_id],
+            shard_intercepts={shard_id: intercept},
+        )
+        feats = gd.shards[shard_id]
+        n = gd.num_rows
+        nnz = np.diff(feats.indptr)
+        k = max(int(nnz.max()) if n else 1, 1)
+        fi = np.full((n, k), -1, np.int32)
+        fv = np.zeros((n, k), np.float32)
+        rows_rep = np.repeat(np.arange(n), nnz)
+        slots = np.arange(len(feats.indices)) - np.repeat(feats.indptr[:-1], nnz)
+        fi[rows_rep, slots] = feats.indices
+        fv[rows_rep, slots] = feats.values
+        vocab = gd.id_vocabs[random_effect_id]
+        if n >= row_stride:
+            raise ValueError(f"{path}: {n} rows exceeds row_stride {row_stride}")
+        parts.append(
+            HostRows(
+                entity_raw_ids=[vocab[i] for i in gd.ids[random_effect_id]],
+                row_index=ordinal * row_stride + np.arange(n, dtype=np.int64),
+                labels=gd.response.astype(np.float32),
+                weights=gd.weight.astype(np.float32),
+                offsets=gd.offset.astype(np.float32),
+                feat_idx=fi,
+                feat_val=fv,
+                global_dim=feats.dim,
+            )
+        )
+    if not parts:
+        return HostRows(
+            entity_raw_ids=[], row_index=np.zeros(0, np.int64),
+            labels=np.zeros(0, np.float32), weights=np.zeros(0, np.float32),
+            offsets=np.zeros(0, np.float32),
+            feat_idx=np.full((0, 1), -1, np.int32),
+            feat_val=np.zeros((0, 1), np.float32),
+            global_dim=len(index_map),
+        )
+    k_max = max(p.feat_idx.shape[1] for p in parts)
+    return HostRows(
+        entity_raw_ids=[r for p in parts for r in p.entity_raw_ids],
+        row_index=np.concatenate([p.row_index for p in parts]),
+        labels=np.concatenate([p.labels for p in parts]),
+        weights=np.concatenate([p.weights for p in parts]),
+        offsets=np.concatenate([p.offsets for p in parts]),
+        feat_idx=np.concatenate(
+            [_pad_to(p.feat_idx.T, k_max, -1).T for p in parts]
+        ),
+        feat_val=np.concatenate(
+            [_pad_to(p.feat_val.T, k_max, 0.0).T for p in parts]
+        ),
+        global_dim=parts[0].global_dim,
+    )

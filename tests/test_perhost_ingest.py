@@ -230,3 +230,144 @@ class TestPerHostIngestEquivalence:
             e = key_to_entity[int(_unpack_u64(keys[lane, :1], keys[lane, 1:2])[0])]
             total = data.weight[ids == e].sum()
             np.testing.assert_allclose(w[lane].sum(), total, rtol=1e-4)
+
+
+class TestAvroPerHostDecode:
+    def test_avro_host_rows_match_direct_build(self, glmix, ctx, tmp_path):
+        """host_rows_from_avro over a host's file subset -> the same slabs
+        as the direct in-memory HostRows (partitioning invariance across
+        BOTH the file assignment and the decode path)."""
+        import os
+
+        from photon_ml_tpu.io import avro as avro_io
+        from photon_ml_tpu.io import schemas
+        from photon_ml_tpu.io.index_map import IndexMap
+        from photon_ml_tpu.parallel.perhost_ingest import host_rows_from_avro
+
+        data = glmix
+        feats = data.shards["per_user"]
+        vocab = data.id_vocabs["userId"]
+        schema = {
+            "name": "PerHostAvro", "type": "record", "namespace": "t",
+            "fields": [
+                {"name": "label", "type": "double"},
+                {"name": "userFeatures",
+                 "type": {"type": "array", "items": schemas.FEATURE}},
+                {"name": "metadataMap",
+                 "type": ["null", {"type": "map", "values": "string"}],
+                 "default": None},
+            ],
+        }
+        # split rows into 3 part files (the global sorted file list)
+        n = data.num_rows
+        bounds = [0, n // 3, 2 * (n // 3), n]
+        for p in range(3):
+            lo, hi = bounds[p], bounds[p + 1]
+
+            def records():
+                for r in range(lo, hi):
+                    s, e = feats.indptr[r], feats.indptr[r + 1]
+                    yield {
+                        "label": float(data.response[r]),
+                        "userFeatures": [
+                            {"name": f"u{j}", "term": "", "value": float(v)}
+                            for j, v in zip(feats.indices[s:e], feats.values[s:e])
+                        ],
+                        "metadataMap": {"userId": vocab[data.ids["userId"][r]]},
+                    }
+
+            avro_io.write_container(
+                str(tmp_path / f"part-{p}.avro"), records(), schema
+            )
+        # index map matching the in-memory feature space (u<j> -> j), no
+        # intercept so dims align with the raw CSR
+        imap = IndexMap(
+            {f"u{j}\x01": j for j in range(feats.dim)},
+            [f"u{j}\x01" for j in range(feats.dim)],
+        )
+        rows_avro = host_rows_from_avro(
+            [str(tmp_path / f"part-{p}.avro") for p in range(3)],
+            [0, 1, 2],
+            imap, "userId", "per_user", ["userFeatures"],
+            intercept=False, row_stride=1 << 22,
+        )
+        assert rows_avro.num_rows == n and rows_avro.global_dim == feats.dim
+        sd_avro = per_host_re_dataset(rows_avro, ctx)
+
+        rows_mem = _host_rows_from_game(data, 0, n)
+        # same rows under different GLOBAL ids -> same entity grouping and
+        # training tensors modulo the row_index values themselves
+        sd_mem = per_host_re_dataset(rows_mem, ctx)
+        np.testing.assert_array_equal(
+            np.asarray(sd_avro.entity_keys), np.asarray(sd_mem.entity_keys)
+        )
+        np.testing.assert_array_equal(
+            np.asarray(sd_avro.local_to_global), np.asarray(sd_mem.local_to_global)
+        )
+        # per-entity x slabs hold the same row payloads (order within an
+        # entity may differ: priorities hash the row ids, which differ)
+        xa = np.asarray(sd_avro.x)
+        xm = np.asarray(sd_mem.x)
+        for lane in np.nonzero(np.asarray(sd_mem.entity_mask))[0]:
+            sa = xa[lane][np.lexsort(xa[lane].T)]
+            sm = xm[lane][np.lexsort(xm[lane].T)]
+            np.testing.assert_allclose(sa, sm, rtol=1e-6, err_msg=str(lane))
+
+
+class TestPerHostCoordinateDescent:
+    def test_full_descent_with_perhost_coordinate(self, glmix, ctx):
+        """PerHostRandomEffectSolver as a CoordinateDescent coordinate:
+        fixed + per-host RE descent must match the plain (unsharded)
+        two-coordinate descent — objectives AND final scores."""
+        import jax.numpy as jnp
+
+        from photon_ml_tpu.algorithm import (
+            CoordinateDescent,
+            FixedEffectCoordinate,
+        )
+        from photon_ml_tpu.data.game import build_fixed_effect_batch
+        from photon_ml_tpu.ops import losses
+        from photon_ml_tpu.optim.problem import GLMOptimizationProblem
+
+        data = glmix
+        labels = jnp.asarray(data.response)
+        loss_fn = lambda s: jnp.sum(losses.logistic.loss(s, labels))
+        cfg = OptimizerConfig(max_iterations=25, tolerance=1e-9)
+        reg = RegularizationContext.l2(0.3)
+
+        def fixed():
+            return FixedEffectCoordinate(
+                build_fixed_effect_batch(data, "global", dense=True),
+                GLMOptimizationProblem(
+                    TaskType.LOGISTIC_REGRESSION, OptimizerType.LBFGS, cfg,
+                    RegularizationContext.l2(0.05),
+                ),
+            )
+
+        rows = _host_rows_from_game(data, 0, data.num_rows)
+        sd = per_host_re_dataset(rows, ctx)
+        perhost = PerHostRandomEffectSolver(
+            sd, TaskType.LOGISTIC_REGRESSION, OptimizerType.LBFGS, cfg, reg, ctx
+        )
+        cd_sharded = CoordinateDescent({"fixed": fixed(), "re": perhost}, loss_fn)
+        r_sharded = cd_sharded.run(num_iterations=2, num_rows=data.num_rows)
+
+        re_ds = build_random_effect_dataset(
+            data, RandomEffectDataConfig("userId", "per_user")
+        )
+        plain = RandomEffectCoordinate(
+            re_ds, TaskType.LOGISTIC_REGRESSION, OptimizerType.LBFGS, cfg, reg
+        )
+        cd_plain = CoordinateDescent({"fixed": fixed(), "re": plain}, loss_fn)
+        r_plain = cd_plain.run(num_iterations=2, num_rows=data.num_rows)
+
+        np.testing.assert_allclose(
+            np.asarray(r_sharded.objective_history),
+            np.asarray(r_plain.objective_history),
+            rtol=5e-4,
+        )
+        np.testing.assert_allclose(
+            np.asarray(r_sharded.total_scores),
+            np.asarray(r_plain.total_scores),
+            rtol=5e-3, atol=5e-4,
+        )
