@@ -95,13 +95,21 @@ class CoordinateDescent:
         self.fused_cycle = fused_cycle
         self._cycle_fn = None
         self._grid_cycle_fn = None  # jitted vmap(_cycle_body), built once
-        # jit the per-coordinate update+score once per coordinate
+        # jit the per-coordinate update+score once per coordinate. A
+        # coordinate may opt OUT (class attr cd_jit=False) when its arrays
+        # span non-addressable devices under multihost SPMD — closing over
+        # them in an outer jit is illegal; such coordinates jit internally
+        # with the global arrays as ARGUMENTS (shard_map calls).
+        def _maybe_jit(fn, coord):
+            return jax.jit(fn) if getattr(coord, "cd_jit", True) else fn
+
         self._update_fns = {
-            name: jax.jit(lambda off, w0, c=coord: c.update(off, w0))
+            name: _maybe_jit(lambda off, w0, c=coord: c.update(off, w0), coord)
             for name, coord in coordinates.items()
         }
         self._score_fns = {
-            name: jax.jit(lambda w, c=coord: c.score(w)) for name, coord in coordinates.items()
+            name: _maybe_jit(lambda w, c=coord: c.score(w), coord)
+            for name, coord in coordinates.items()
         }
 
     # ------------------------------------------------------------------
@@ -145,7 +153,22 @@ class CoordinateDescent:
                 )
         return params, scores, total, objs, vals
 
+    def _require_jittable_coordinates(self, mode: str) -> None:
+        """fused_cycle / run_grid wrap EVERY coordinate in one outer jit; a
+        cd_jit=False coordinate (multihost-sharded arrays) would be traced
+        with non-addressable constants — fail with a clear message instead
+        of JAX's opaque trace error."""
+        bad = [n for n, c in self.coordinates.items()
+               if not getattr(c, "cd_jit", True)]
+        if bad:
+            raise ValueError(
+                f"{mode} compiles all coordinates into one jitted program, "
+                f"but {bad} hold multihost-sharded arrays that cannot be "
+                "closed over (cd_jit=False) — use the per-update run() path"
+            )
+
     def _build_cycle(self):
+        self._require_jittable_coordinates("fused_cycle")
         return jax.jit(self._cycle_body)
 
     def run_grid(
@@ -173,6 +196,7 @@ class CoordinateDescent:
         """
         import inspect
 
+        self._require_jittable_coordinates("run_grid")
         names = list(self.coordinates)
         for name in names:
             coord = self.coordinates[name]

@@ -1,0 +1,463 @@
+"""Multi-host SPMD GAME training driver.
+
+Every host runs this SAME program under ``jax.distributed``: it decodes
+ONLY its slice of the input part files (per-partition decode with the
+shared mmap'd feature index, DataProcessingUtils.scala:57-80 semantics),
+ingests per host — the collective shuffle regroups random-effect rows by
+entity owner (parallel/shuffle.py), fixed-effect rows stay host-local as
+uniform row blocks — trains the coordinate descent over multihost-sharded
+coordinates, and each host writes its OWN part file of the random-effect
+model (the coefficient slab is never gathered); the coordinator writes the
+fixed-effect model and metadata.
+
+This is the driver-contract completion of the reference's cluster driver
+(cli/game/training/Driver.scala:537 on Spark executors): same flag
+grammar, SPMD instead of driver/executor. Scope (v1, documented): a single
+grid combo, plain fixed + random-effect coordinates, and prebuilt feature
+index maps (``--offheap-indexmap-dir`` or a name-and-term path) — index
+vocabularies must not require a full-data scan on every host.
+
+Run (one process per host):
+
+    python -m photon_ml_tpu.cli.game_multihost_driver \\
+        --multihost-coordinator HOST:PORT --multihost-num-processes N \\
+        --multihost-process-id I  <game training flags...>
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import shard_map
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from photon_ml_tpu.cli.game_params import (
+    CoordinateOptConfig,
+    parse_training_params,
+)
+from photon_ml_tpu.io import model_io
+from photon_ml_tpu.io.avro_data import read_game_data
+from photon_ml_tpu.ops import losses as losses_mod
+from photon_ml_tpu.ops.features import DenseFeatures
+from photon_ml_tpu.ops.objective import GLMBatch
+from photon_ml_tpu.ops.normalization import NormalizationContext
+from photon_ml_tpu.optim.common import OptResult
+from photon_ml_tpu.optim.problem import GLMOptimizationProblem
+from photon_ml_tpu.parallel import multihost
+from photon_ml_tpu.parallel.distributed import DistributedFixedEffectSolver
+from photon_ml_tpu.parallel.mesh import MeshContext
+from photon_ml_tpu.parallel.perhost_ingest import (
+    HostRows,
+    PerHostRandomEffectSolver,
+    _unpack_u64,
+    csr_to_padded,
+    per_host_re_dataset,
+)
+from photon_ml_tpu.parallel.shuffle import collective_sum
+from photon_ml_tpu.types import real_dtype
+from photon_ml_tpu.utils.logging import PhotonLogger
+
+Array = jax.Array
+
+
+class MultihostFixedEffectCoordinate:
+    """Fixed-effect coordinate over per-host row blocks (drop-in for
+    CoordinateDescent): rows stay where they were decoded; the solve is the
+    psum-in-kernel data-parallel GLM; scoring scatters this host's margins
+    into the global (N,) vector and one psum merges (owner-computes, like
+    the random-effect side — the broadcast model IS the replicated w)."""
+
+    cd_jit = False  # arrays span hosts: CoordinateDescent must not re-jit
+
+    def __init__(self, x, labels, offsets, weights, row_ids, num_rows: int,
+                 problem: GLMOptimizationProblem, ctx: MeshContext,
+                 mh: "multihost.MultihostContext"):
+        self.ctx = ctx
+        self.num_rows = num_rows
+        self.problem = problem
+        self.norm = NormalizationContext.identity()
+        self.solver = DistributedFixedEffectSolver(problem, ctx)
+        self._score_fn = None
+        self._fold_fn = jax.jit(
+            lambda base, ids, resid: base
+            + jnp.where(ids >= 0, resid[jnp.maximum(ids, 0)], 0.0)
+        )
+        local = max(ctx.num_devices // mh.num_processes, 1)
+        n_loc = x.shape[0]
+        from photon_ml_tpu.parallel.shuffle import collective_max
+
+        r_max = int(collective_max(np.asarray([n_loc], np.int64), ctx,
+                                   mh.num_processes)[0])
+        r_max = -(-r_max // local) * local  # device multiple
+
+        def pad(a, fill=0.0):
+            if a.shape[0] == r_max:
+                return a
+            p = np.full((r_max - a.shape[0],) + a.shape[1:], fill, a.dtype)
+            return np.concatenate([a, p])
+
+        sharding = NamedSharding(ctx.mesh, P(ctx.axis))
+        self.x = jax.make_array_from_process_local_data(
+            sharding, pad(x.astype(np.float32))
+        )
+        self.labels = jax.make_array_from_process_local_data(
+            sharding, pad(labels.astype(np.float32))
+        )
+        self.base_offsets = jax.make_array_from_process_local_data(
+            sharding, pad(offsets.astype(np.float32))
+        )
+        self.weights = jax.make_array_from_process_local_data(
+            sharding, pad(weights.astype(np.float32), 0.0)  # pad weight 0
+        )
+        self.row_ids = jax.make_array_from_process_local_data(
+            sharding, pad(row_ids.astype(np.int32), -1)
+        )
+
+    @property
+    def dim(self) -> int:
+        return self.x.shape[1]
+
+    def initial_coefficients(self) -> Array:
+        return jnp.zeros((self.dim,), real_dtype())
+
+    def update(self, residual_offsets: Array,
+               init_coefficients: Array) -> Tuple[Array, OptResult]:
+        # residuals arrive in GLOBAL row order; gather this shard's rows
+        offs = self._fold_fn(self.base_offsets, self.row_ids, residual_offsets)
+        batch = GLMBatch(DenseFeatures(self.x), self.labels, offs, self.weights)
+        model, result = self.solver.run(batch, self.norm, init_coefficients)
+        return model.coefficients.means, result
+
+    def score(self, coefficients: Array) -> Array:
+        if self._score_fn is None:
+            axis = self.ctx.axis
+            n = self.num_rows
+
+            def score_shard(w, x, ids):
+                s = x @ w  # (R_loc,)
+                out = jnp.zeros((n,), s.dtype).at[jnp.maximum(ids, 0)].add(
+                    jnp.where(ids >= 0, s, 0.0)
+                )
+                return jax.lax.psum(out, axis)
+
+            self._score_fn = jax.jit(
+                shard_map(
+                    score_shard, mesh=self.ctx.mesh,
+                    in_specs=(P(), P(self.ctx.axis), P(self.ctx.axis)),
+                    out_specs=P(),
+                )
+            )
+        return self._score_fn(coefficients, self.x, self.row_ids)
+
+    def regularization_term(self, coefficients: Array) -> Array:
+        return self.problem.regularization_term_value(coefficients)
+
+
+def _add_multihost_flags(argv: List[str]) -> Tuple[dict, List[str]]:
+    """Strip the --multihost-* flags; the rest is the normal game grammar."""
+    mh_args = {"coordinator": None, "num_processes": None, "process_id": None}
+    rest: List[str] = []
+    i = 0
+    while i < len(argv):
+        a = argv[i]
+        if a == "--multihost-coordinator":
+            mh_args["coordinator"] = argv[i + 1]; i += 2
+        elif a == "--multihost-num-processes":
+            mh_args["num_processes"] = int(argv[i + 1]); i += 2
+        elif a == "--multihost-process-id":
+            mh_args["process_id"] = int(argv[i + 1]); i += 2
+        else:
+            rest.append(a); i += 1
+    return mh_args, rest
+
+
+def main(argv: Optional[List[str]] = None) -> dict:
+    import sys
+
+    mh_args, rest = _add_multihost_flags(
+        list(argv) if argv is not None else sys.argv[1:]
+    )
+    p = parse_training_params(rest)
+    mh = multihost.initialize(
+        coordinator_address=mh_args["coordinator"],
+        num_processes=mh_args["num_processes"],
+        process_id=mh_args["process_id"],
+    )
+    ctx = mh.mesh_context()
+    os.makedirs(p.output_dir, exist_ok=True)
+    logger = PhotonLogger(
+        os.path.join(p.output_dir, f"photon-ml-tpu-mh-{mh.process_id}.log")
+    )
+
+    if len(p.config_grid()) != 1:
+        raise ValueError("multihost driver v1 trains a single grid combo")
+    if p.factored_configs or p.bucketed_random_effects:
+        raise ValueError("multihost driver v1: plain fixed + RE coordinates only")
+    combo = p.config_grid()[0]
+
+    # ---- feature maps: prebuilt, shared, mmap'd ---------------------------
+    shard_maps = {}
+    needed_shards = {c.feature_shard_id for c in p.fixed_effect_data_configs.values()}
+    needed_shards |= {c.feature_shard_id for c in p.random_effect_data_configs.values()}
+    for shard in needed_shards:
+        if p.offheap_indexmap_dir:
+            from photon_ml_tpu.io.offheap import load_shard_index_map
+
+            shard_maps[shard] = load_shard_index_map(p.offheap_indexmap_dir, shard)
+        elif p.feature_name_and_term_set_path:
+            from photon_ml_tpu.io.name_and_term import NameAndTermFeatureSetContainer
+
+            all_sections = sorted(
+                {s for secs in p.feature_shard_sections.values() for s in secs}
+            )
+            nt = NameAndTermFeatureSetContainer.read_from_text(
+                p.feature_name_and_term_set_path, all_sections
+            )
+            shard_maps[shard] = nt.index_map(
+                p.feature_shard_sections.get(shard) or ["features"],
+                p.feature_shard_intercepts.get(shard, True),
+            )
+        else:
+            raise ValueError(
+                "multihost ingest needs prebuilt feature maps: pass "
+                "--offheap-indexmap-dir (FeatureIndexingJob output) or "
+                "--feature-name-and-term-set-path"
+            )
+
+    # ---- per-host decode --------------------------------------------------
+    from photon_ml_tpu.cli.game_training_driver import (
+        _input_files,
+        resolve_date_range_dirs,
+    )
+
+    all_files = sorted(_input_files(resolve_date_range_dirs(
+        p.train_input_dirs, p.train_date_range, p.train_date_range_days_ago
+    )))
+    host_files = [(f, i) for i, f in enumerate(all_files)
+                  if i % mh.num_processes == mh.process_id]
+    id_types = sorted({c.random_effect_id
+                       for c in p.random_effect_data_configs.values()})
+    gds = []
+    for f, ordinal in host_files:
+        gd = read_game_data(
+            [f], shard_maps,
+            {s: p.feature_shard_sections.get(s) or ["features"]
+             for s in needed_shards},
+            id_types,
+            shard_intercepts={
+                s: p.feature_shard_intercepts.get(s, True) for s in needed_shards
+            },
+        )
+        gds.append((ordinal, gd))
+    # dense global row ids: exclusive prefix over per-file counts (agreed
+    # collectively — each host contributes only its files' counts)
+    counts = np.zeros(len(all_files), np.int64)
+    for ordinal, gd in gds:
+        counts[ordinal] = gd.num_rows
+    g_counts = collective_sum(counts, ctx, mh.num_processes)
+    file_base = np.concatenate([[0], np.cumsum(g_counts)[:-1]])
+    n_global = int(g_counts.sum())
+    logger.info(
+        f"host {mh.process_id}: {len(host_files)}/{len(all_files)} files, "
+        f"{sum(gd.num_rows for _, gd in gds)}/{n_global} rows"
+    )
+
+    # replicated (N,) label/weight vectors for the training objective:
+    # scatter own rows, one psum merges (these are O(N) scalars — the same
+    # footprint as the score vectors the descent already carries)
+    def assemble_global(vec_per_gd):
+        local = np.zeros(n_global, np.float32)
+        for ordinal, gd in gds:
+            ids = file_base[ordinal] + np.arange(gd.num_rows)
+            local[ids] = vec_per_gd(gd)
+        block_local = np.zeros(
+            (max(ctx.num_devices // mh.num_processes, 1), n_global), np.float32
+        )
+        block_local[0] = local
+        sharding = NamedSharding(ctx.mesh, P(ctx.axis))
+        g = jax.make_array_from_process_local_data(sharding, block_local)
+        return jax.jit(
+            lambda a: jnp.sum(a, axis=0),
+            out_shardings=NamedSharding(ctx.mesh, P()),
+        )(g)
+
+    labels_g = assemble_global(lambda gd: gd.response.astype(np.float32))
+    weights_g = assemble_global(lambda gd: gd.weight.astype(np.float32))
+
+    # ---- build coordinates ------------------------------------------------
+    coords: Dict[str, object] = {}
+    for name in p.updating_sequence:
+        cfg = combo.get(name, CoordinateOptConfig())
+        if name in p.fixed_effect_data_configs:
+            spec = p.fixed_effect_data_configs[name]
+            feats_parts, y_parts, o_parts, w_parts, id_parts = [], [], [], [], []
+            dim = len(shard_maps[spec.feature_shard_id])
+            for ordinal, gd in gds:
+                f = gd.shards[spec.feature_shard_id]
+                dense = np.zeros((gd.num_rows, dim), np.float32)
+                nnz = np.diff(f.indptr)
+                rows_rep = np.repeat(np.arange(gd.num_rows), nnz)
+                dense[rows_rep, f.indices] = f.values
+                feats_parts.append(dense)
+                y_parts.append(gd.response)
+                o_parts.append(gd.offset)
+                w_parts.append(gd.weight)
+                id_parts.append(file_base[ordinal] + np.arange(gd.num_rows))
+            problem = GLMOptimizationProblem(
+                p.task_type, cfg.optimizer, cfg.optimizer_config(),
+                cfg.regularization_context(),
+            )
+            coords[name] = MultihostFixedEffectCoordinate(
+                np.concatenate(feats_parts) if feats_parts else np.zeros((0, dim), np.float32),
+                np.concatenate(y_parts) if y_parts else np.zeros(0),
+                np.concatenate(o_parts) if o_parts else np.zeros(0),
+                np.concatenate(w_parts) if w_parts else np.zeros(0),
+                np.concatenate(id_parts) if id_parts else np.zeros(0, np.int64),
+                n_global, problem, ctx, mh,
+            )
+        else:
+            dc = p.random_effect_data_configs[name]
+            parts = []
+            for ordinal, gd in gds:
+                f = gd.shards[dc.feature_shard_id]
+                fi, fv = csr_to_padded(f, gd.num_rows)
+                vocab = gd.id_vocabs[dc.random_effect_id]
+                parts.append(HostRows(
+                    entity_raw_ids=[vocab[i] for i in gd.ids[dc.random_effect_id]],
+                    row_index=file_base[ordinal] + np.arange(gd.num_rows, dtype=np.int64),
+                    labels=gd.response.astype(np.float32),
+                    weights=gd.weight.astype(np.float32),
+                    offsets=gd.offset.astype(np.float32),
+                    feat_idx=fi, feat_val=fv,
+                    global_dim=f.dim,
+                ))
+            k_max = max(pp.feat_idx.shape[1] for pp in parts) if parts else 1
+            def padk(a, k_max, fill):
+                if a.shape[1] == k_max:
+                    return a
+                p2 = np.full((a.shape[0], k_max - a.shape[1]), fill, a.dtype)
+                return np.concatenate([a, p2], axis=1)
+            rows = HostRows(
+                entity_raw_ids=[r for pp in parts for r in pp.entity_raw_ids],
+                row_index=np.concatenate([pp.row_index for pp in parts])
+                if parts else np.zeros(0, np.int64),
+                labels=np.concatenate([pp.labels for pp in parts])
+                if parts else np.zeros(0, np.float32),
+                weights=np.concatenate([pp.weights for pp in parts])
+                if parts else np.zeros(0, np.float32),
+                offsets=np.concatenate([pp.offsets for pp in parts])
+                if parts else np.zeros(0, np.float32),
+                feat_idx=np.concatenate([padk(pp.feat_idx, k_max, -1) for pp in parts])
+                if parts else np.full((0, 1), -1, np.int32),
+                feat_val=np.concatenate([padk(pp.feat_val, k_max, 0.0) for pp in parts])
+                if parts else np.zeros((0, 1), np.float32),
+                global_dim=len(shard_maps[dc.feature_shard_id]),
+            )
+            sd = per_host_re_dataset(
+                rows, ctx, mh.num_processes, mh.process_id,
+                active_upper_bound=dc.active_upper_bound,
+            )
+            coords[name] = PerHostRandomEffectSolver(
+                sd, p.task_type, cfg.optimizer, cfg.optimizer_config(),
+                cfg.regularization_context(), ctx,
+            )
+
+    # ---- descent ----------------------------------------------------------
+    from photon_ml_tpu.algorithm.coordinate_descent import CoordinateDescent
+
+    loss = losses_mod.for_task(p.task_type)
+    loss_fn = lambda scores: jnp.sum(weights_g * loss.loss(scores, labels_g))
+    cd = CoordinateDescent(coords, loss_fn)
+    result = cd.run(num_iterations=p.num_iterations, num_rows=n_global)
+    logger.info(
+        f"objective history: "
+        + " ".join(f"{v:.6g}" for v in result.objective_history)
+    )
+
+    # ---- save (reference layout; RE parts written per host) ---------------
+    out = os.path.join(p.output_dir, "best")
+    mh.barrier("pre-save")
+    if mh.coordinator_only_io():
+        os.makedirs(out, exist_ok=True)
+    mh.barrier("outdir")
+    for name in p.updating_sequence:
+        coord = coords[name]
+        w = result.coefficients[name]
+        if isinstance(coord, MultihostFixedEffectCoordinate):
+            if mh.coordinator_only_io():
+                spec = p.fixed_effect_data_configs[name]
+                model_io.save_fixed_effect(
+                    out, name, p.task_type,
+                    np.asarray(jax.device_get(w)),
+                    shard_maps[spec.feature_shard_id],
+                    feature_shard_id=spec.feature_shard_id,
+                )
+        else:
+            dc = p.random_effect_data_configs[name]
+            _save_random_effect_parts(
+                out, name, p, dc, coord, w, shard_maps[dc.feature_shard_id], mh
+            )
+        mh.barrier(f"saved-{name}")
+    logger.info(f"model saved to {out}")
+    logger.close()
+    return {
+        "objective_history": result.objective_history,
+        "num_rows": n_global,
+        "process_id": mh.process_id,
+        "output": out,
+    }
+
+
+def _save_random_effect_parts(out, name, p, dc, coord, w, imap, mh):
+    """Each host writes ONE part file with ITS devices' entities — the
+    coefficient slab never crosses hosts (ModelProcessingUtils.scala:205-219
+    writes per-partition part files the same way). Raw entity ids come from
+    the host's own decode (key -> raw id map built during ingest)."""
+    from photon_ml_tpu.io import avro as avro_io
+    from photon_ml_tpu.io import schemas
+    from photon_ml_tpu.io.model_io import (
+        COEFFICIENTS,
+        ID_INFO,
+        RANDOM_EFFECT,
+        _model_record,
+    )
+
+    sd = coord.data
+    base = os.path.join(out, RANDOM_EFFECT, name)
+    if mh.coordinator_only_io():
+        os.makedirs(os.path.join(base, COEFFICIENTS), exist_ok=True)
+        with open(os.path.join(base, ID_INFO), "w") as f:
+            f.write(f"{dc.random_effect_id}\n{dc.feature_shard_id}\n")
+    mh.barrier(f"re-dir-{name}")
+    # this host's slab rows (addressable shards of the sharded arrays);
+    # raw ids rode the exchange (ShardedREData.raw_ids_by_key), so the
+    # OWNER can name every entity it holds without any model gather
+    local = {}
+    for arr, field in ((w, "w"), (sd.entity_keys, "keys"),
+                       (sd.entity_mask, "mask"), (sd.local_to_global, "l2g")):
+        local[field] = np.concatenate(
+            [np.asarray(s.data) for s in arr.addressable_shards]
+        )
+    records = []
+    mask = local["mask"].astype(bool)
+    for lane in np.nonzero(mask)[0]:
+        key = int(_unpack_u64(local["keys"][lane, :1], local["keys"][lane, 1:2])[0])
+        raw = sd.raw_ids_by_key[key]
+        dense = np.zeros(sd.global_dim, np.float32)
+        valid = local["l2g"][lane] >= 0
+        dense[local["l2g"][lane][valid]] = local["w"][lane][valid]
+        records.append(_model_record(raw, p.task_type, dense, None, imap))
+    avro_io.write_container(
+        os.path.join(base, COEFFICIENTS, f"part-{mh.process_id:05d}.avro"),
+        records,
+        schemas.BAYESIAN_LINEAR_MODEL,
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -282,10 +282,8 @@ class Driver:
         import shutil
 
         if os.path.exists(chunk_dir):
-            shutil.rmtree(chunk_dir)
+            shutil.rmtree(chunk_dir)  # raises loudly if the purge fails
         os.makedirs(chunk_dir)
-        if os.listdir(chunk_dir):
-            raise RuntimeError(f"could not purge stale stream chunks in {chunk_dir}")
         chunk_i = 0
         total_rows = 0
         # carry rows across file boundaries so every chunk except the final

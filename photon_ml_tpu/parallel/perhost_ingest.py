@@ -43,6 +43,15 @@ from photon_ml_tpu.types import real_dtype
 
 Array = jax.Array
 
+# raw entity-id strings ride the exchange as fixed-width UTF-8 so the OWNER
+# of an entity (who may never have ingested any of its rows) can write the
+# model with real ids; 48 bytes covers every photon id format in the wild.
+# Known tradeoff: the id words ship on EVERY row (they widen the all_to_all
+# payload by 12 int32 columns); a narrower secondary exchange of one id per
+# (source host, entity) would cut shuffle bytes for very sparse rows at the
+# cost of a second collective — revisit if the exchange shows up in profiles.
+RAW_ID_BYTES = 48
+
 
 @dataclasses.dataclass
 class HostRows:
@@ -92,6 +101,10 @@ class ShardedREData:
     rows_per_device: int  # padded scoring rows R_tot / n_dev
     num_rows: int  # global N
     global_dim: int
+    # HOST-LOCAL: raw id per entity key for the entities owned by THIS
+    # host's devices (decoded from the exchanged fixed-width id bytes) —
+    # what model save needs, never a device array
+    raw_ids_by_key: Dict[int, str] = dataclasses.field(default_factory=dict)
 
     @property
     def local_dim(self) -> int:
@@ -108,6 +121,20 @@ def _unpack_u64(hi: np.ndarray, lo: np.ndarray) -> np.ndarray:
     return (hi.view(np.uint32).astype(np.uint64) << np.uint64(32)) | lo.view(
         np.uint32
     ).astype(np.uint64)
+
+
+def csr_to_padded(feats, n: int) -> Tuple[np.ndarray, np.ndarray]:
+    """CSR shard -> row-major padded (feat_idx (n, K) int32 with -1 mask,
+    feat_val (n, K) f32) — the HostRows feature encoding."""
+    nnz = np.diff(feats.indptr)
+    k = max(int(nnz.max()) if n else 1, 1)
+    fi = np.full((n, k), -1, np.int32)
+    fv = np.zeros((n, k), np.float32)
+    rows_rep = np.repeat(np.arange(n), nnz)
+    slots = np.arange(len(feats.indices)) - np.repeat(feats.indptr[:-1], nnz)
+    fi[rows_rep, slots] = feats.indices
+    fv[rows_rep, slots] = feats.values
+    return fi, fv
 
 
 def _pad_to(a: np.ndarray, rows: int, fill) -> np.ndarray:
@@ -146,8 +173,19 @@ def per_host_re_dataset(
 
     # ---- pack + exchange --------------------------------------------------
     hi, lo = _pack_u64(keys)
+    raw_words = RAW_ID_BYTES // 4
+    raw_bytes = np.zeros((rows.num_rows, RAW_ID_BYTES), np.uint8)
+    for i, rid in enumerate(rows.entity_raw_ids):
+        b = rid.encode("utf-8")
+        if len(b) > RAW_ID_BYTES:
+            raise ValueError(
+                f"entity id {rid!r} exceeds {RAW_ID_BYTES} UTF-8 bytes"
+            )
+        raw_bytes[i, : len(b)] = np.frombuffer(b, np.uint8)
+    raw_i32 = raw_bytes.view(np.int32)  # (n, raw_words)
     int_payload = np.concatenate(
-        [rows.row_index.astype(np.int32)[:, None], hi[:, None], lo[:, None], fi], axis=1
+        [rows.row_index.astype(np.int32)[:, None], hi[:, None], lo[:, None],
+         raw_i32, fi], axis=1
     )
     flt_payload = np.concatenate(
         [
@@ -171,7 +209,8 @@ def per_host_re_dataset(
         # then row id as final key for full determinism)
         order = np.lexsort((orow, prio, okeys))
         okeys, orow, prio = okeys[order], orow[order], prio[order]
-        ofi, ofv = bi[order, 3:], bf[order, 3:]
+        oraw = bi[order, 3 : 3 + raw_words]
+        ofi, ofv = bi[order, 3 + raw_words :], bf[order, 3:]
         olab, owgt, ooff = bf[order, 0], bf[order, 1], bf[order, 2]
         uniq, ent_start, inv = np.unique(okeys, return_index=True, return_inverse=True)
         e_d = len(uniq)
@@ -192,11 +231,16 @@ def per_host_re_dataset(
         pair_e = (pair // rows.global_dim).astype(np.int64)
         pair_f = (pair % rows.global_dim).astype(np.int64)
         dims = np.bincount(pair_e, minlength=e_d)
+        raw_ids = {}
+        for e, first in enumerate(ent_start):
+            b = np.ascontiguousarray(oraw[first]).view(np.uint8).tobytes()
+            raw_ids[int(uniq[e])] = b.rstrip(b"\x00").decode("utf-8")
         per_dev.append(
             dict(
                 keys=uniq, row=orow, inv=inv, rank=rank, active=active,
                 fi=ofi, fv=ofv, lab=olab, wgt=wgt_eff, off=ooff, cnt=cnt,
                 pair_e=pair_e, pair_f=pair_f, dims=dims, cap=cap,
+                raw_ids=raw_ids,
             )
         )
 
@@ -325,6 +369,9 @@ def per_host_re_dataset(
         rows_per_device=r_max,
         num_rows=n_global,
         global_dim=rows.global_dim,
+        raw_ids_by_key={
+            k: v for d in per_dev for k, v in d["raw_ids"].items()
+        },
     )
 
 
@@ -344,6 +391,10 @@ class PerHostRandomEffectSolver:
     owner-computes: each device scores its OWN rows from its OWN slab and one
     psum merges the (N,) partials (coefficients never move; scores do —
     the transpose of RandomEffectCoordinate.scala:139-146's model collect)."""
+
+    # arrays span hosts under multihost SPMD: CoordinateDescent must call
+    # update/score raw (they jit internally with global arrays as ARGS)
+    cd_jit = False
 
     data: ShardedREData
     task: "TaskType"
@@ -508,14 +559,7 @@ def host_rows_from_avro(
         )
         feats = gd.shards[shard_id]
         n = gd.num_rows
-        nnz = np.diff(feats.indptr)
-        k = max(int(nnz.max()) if n else 1, 1)
-        fi = np.full((n, k), -1, np.int32)
-        fv = np.zeros((n, k), np.float32)
-        rows_rep = np.repeat(np.arange(n), nnz)
-        slots = np.arange(len(feats.indices)) - np.repeat(feats.indptr[:-1], nnz)
-        fi[rows_rep, slots] = feats.indices
-        fv[rows_rep, slots] = feats.values
+        fi, fv = csr_to_padded(feats, n)
         vocab = gd.id_vocabs[random_effect_id]
         if n >= row_stride:
             raise ValueError(f"{path}: {n} rows exceeds row_stride {row_stride}")

@@ -217,3 +217,152 @@ def test_single_process_context_defaults():
     assert mh.host_row_slice(100) == slice(0, 100)
     assert mh.host_shard_paths(["b", "a", "c"]) == ["a", "b", "c"]
     mh.barrier("noop")  # must not require a distributed client
+
+
+@pytest.mark.slow
+def test_multihost_game_driver_matches_single_process(tmp_path):
+    """The multi-host GAME training CLI driver (2 processes x 4 devices,
+    per-host decode + collective shuffle) must reproduce the single-process
+    game_training_driver's model on the same data: fixed-effect means close,
+    per-entity random-effect means matched by RAW id (ids ride the
+    exchange), every part written by its owner host."""
+    import sys as _sys
+
+    _sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+
+    from game_test_utils import make_glmix_data
+    from photon_ml_tpu.cli import feature_indexing, game_training_driver
+    from photon_ml_tpu.io import avro as avro_io
+    from photon_ml_tpu.io import schemas
+    from photon_ml_tpu.io import model_io
+    from photon_ml_tpu.io.offheap import load_shard_index_map
+
+    rng = np.random.default_rng(21)
+    data, _ = make_glmix_data(
+        rng, num_users=18, rows_per_user_range=(8, 20), d_fixed=4, d_random=3
+    )
+    schema = {
+        "name": "MhAvro", "type": "record", "namespace": "t",
+        "fields": [
+            {"name": "label", "type": "double"},
+            {"name": "fixedFeatures",
+             "type": {"type": "array", "items": schemas.FEATURE}},
+            {"name": "userFeatures",
+             "type": {"type": "array",
+                      "items": "com.linkedin.photon.avro.generated.FeatureAvro"}},
+            {"name": "metadataMap",
+             "type": ["null", {"type": "map", "values": "string"}],
+             "default": None},
+        ],
+    }
+    train_dir = tmp_path / "train"
+    train_dir.mkdir()
+    n = data.num_rows
+    ff, uf = data.shards["global"], data.shards["per_user"]
+    vocab = data.id_vocabs["userId"]
+    bounds = np.linspace(0, n, 5).astype(int)  # 4 part files
+    for pi in range(4):
+        lo, hi = bounds[pi], bounds[pi + 1]
+
+        def feats(f, r):
+            s, e = f.indptr[r], f.indptr[r + 1]
+            return [
+                {"name": f"c{j}", "term": "", "value": float(v)}
+                for j, v in zip(f.indices[s:e], f.values[s:e])
+            ]
+
+        avro_io.write_container(
+            str(train_dir / f"part-{pi}.avro"),
+            ({"label": float(data.response[r]),
+              "fixedFeatures": feats(ff, r),
+              "userFeatures": feats(uf, r),
+              "metadataMap": {"userId": vocab[data.ids["userId"][r]]}}
+             for r in range(lo, hi)),
+            schema,
+        )
+
+    idx_dir = str(tmp_path / "index")
+    feature_indexing.main([
+        "--data-input-dirs", str(train_dir),
+        "--output-dir", idx_dir,
+        "--partition-num", "1",
+        "--feature-shard-id-to-feature-section-keys-map",
+        "global:fixedFeatures|per_user:userFeatures",
+    ])
+
+    flags = [
+        "--train-input-dirs", str(train_dir),
+        "--task-type", "LOGISTIC_REGRESSION",
+        "--updating-sequence", "fixed,per-user",
+        "--feature-shard-id-to-feature-section-keys-map",
+        "global:fixedFeatures|per_user:userFeatures",
+        "--fixed-effect-optimization-configurations",
+        "fixed:40,1e-9,0.1,1,LBFGS,L2",
+        "--fixed-effect-data-configurations", "fixed:global,2",
+        "--random-effect-optimization-configurations",
+        "per-user:30,1e-9,0.5,1,LBFGS,L2",
+        "--random-effect-data-configurations",
+        "per-user:userId,per_user,2,-1,0,-1,index_map",
+        "--num-iterations", "2",
+        "--offheap-indexmap-dir", idx_dir,
+        "--delete-output-dir-if-exists", "true",
+    ]
+
+    port = _free_port()
+    launcher = (
+        "import jax; jax.config.update('jax_platforms','cpu'); "
+        "from photon_ml_tpu.cli.game_multihost_driver import main; "
+        "import sys; main(sys.argv[1:])"
+    )
+    procs = []
+    for pid in range(2):
+        env = dict(os.environ)
+        env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+        procs.append(subprocess.Popen(
+            [sys.executable, "-c", launcher,
+             "--multihost-coordinator", f"127.0.0.1:{port}",
+             "--multihost-num-processes", "2",
+             "--multihost-process-id", str(pid),
+             "--output-dir", str(tmp_path / "mh-out")] + flags,
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+            cwd=REPO, env=env,
+        ))
+    for p in procs:
+        out, err = p.communicate(timeout=600)
+        assert p.returncode == 0, f"mh driver failed:\n{out[-1500:]}\n{err[-2500:]}"
+
+    # single-process oracle through the standard driver
+    sp = game_training_driver.main(
+        ["--output-dir", str(tmp_path / "sp-out")] + flags
+    )
+    imap_g = load_shard_index_map(idx_dir, "global")
+    imap_u = load_shard_index_map(idx_dir, "per_user")
+    fe_mh, _, _, _ = model_io.load_fixed_effect(
+        str(tmp_path / "mh-out" / "best"), "fixed", imap_g
+    )
+    fe_sp, _, _, _ = model_io.load_fixed_effect(
+        str(tmp_path / "sp-out" / "best"), "fixed", imap_g
+    )
+    np.testing.assert_allclose(fe_mh, fe_sp, rtol=5e-3, atol=5e-4)
+
+    re_mh, _, re_id, _ = model_io.load_random_effect(
+        str(tmp_path / "mh-out" / "best"), "per-user", imap_u
+    )
+    re_sp, _, _, _ = model_io.load_random_effect(
+        str(tmp_path / "sp-out" / "best"), "per-user", imap_u
+    )
+    assert re_id == "userId"
+    assert set(re_mh) == set(re_sp)  # every entity present, REAL raw ids
+    for eid in re_sp:
+        np.testing.assert_allclose(
+            re_mh[eid], re_sp[eid], rtol=5e-3, atol=5e-4, err_msg=eid
+        )
+    # the random-effect model was written as per-host parts (2 hosts)
+    parts = os.listdir(
+        tmp_path / "mh-out" / "best" / "random-effect" / "per-user" / "coefficients"
+    )
+    assert len(parts) == 2
