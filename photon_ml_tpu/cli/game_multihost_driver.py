@@ -165,12 +165,18 @@ def _add_multihost_flags(argv: List[str]) -> Tuple[dict, List[str]]:
     i = 0
     while i < len(argv):
         a = argv[i]
-        if a == "--multihost-coordinator":
-            mh_args["coordinator"] = argv[i + 1]; i += 2
-        elif a == "--multihost-num-processes":
-            mh_args["num_processes"] = int(argv[i + 1]); i += 2
-        elif a == "--multihost-process-id":
-            mh_args["process_id"] = int(argv[i + 1]); i += 2
+        if a in ("--multihost-coordinator", "--multihost-num-processes",
+                 "--multihost-process-id"):
+            if i + 1 >= len(argv):
+                raise ValueError(f"{a} requires a value")
+            value = argv[i + 1]
+            if a == "--multihost-coordinator":
+                mh_args["coordinator"] = value
+            elif a == "--multihost-num-processes":
+                mh_args["num_processes"] = int(value)
+            else:
+                mh_args["process_id"] = int(value)
+            i += 2
         else:
             rest.append(a); i += 1
     return mh_args, rest
@@ -189,7 +195,14 @@ def main(argv: Optional[List[str]] = None) -> dict:
         process_id=mh_args["process_id"],
     )
     ctx = mh.mesh_context()
-    os.makedirs(p.output_dir, exist_ok=True)
+    # the coordinator owns the output dir lifecycle (incl. purge — stale
+    # per-host RE part files from a previous topology must never be merged
+    # into a reloaded model); everyone else waits
+    if mh.coordinator_only_io():
+        from photon_ml_tpu.utils.io_utils import prepare_output_dir
+
+        prepare_output_dir(p.output_dir, p.delete_output_dir_if_exists)
+    mh.barrier("output-dir")
     logger = PhotonLogger(
         os.path.join(p.output_dir, f"photon-ml-tpu-mh-{mh.process_id}.log")
     )
@@ -198,6 +211,19 @@ def main(argv: Optional[List[str]] = None) -> dict:
         raise ValueError("multihost driver v1 trains a single grid combo")
     if p.factored_configs or p.bucketed_random_effects:
         raise ValueError("multihost driver v1: plain fixed + RE coordinates only")
+    unsupported = [
+        flag for flag, on in (
+            ("--validate-input-dirs", bool(p.validate_input_dirs)),
+            ("--compute-variance", p.compute_variance),
+            ("--fused-cycle", p.fused_cycle),
+            ("--vmapped-grid", p.vmapped_grid != "false"),
+        ) if on
+    ]
+    if unsupported:
+        raise ValueError(
+            f"multihost driver v1 does not implement {unsupported} — "
+            "rejecting rather than silently ignoring"
+        )
     combo = p.config_grid()[0]
 
     # ---- feature maps: prebuilt, shared, mmap'd ---------------------------
@@ -275,16 +301,8 @@ def main(argv: Optional[List[str]] = None) -> dict:
         for ordinal, gd in gds:
             ids = file_base[ordinal] + np.arange(gd.num_rows)
             local[ids] = vec_per_gd(gd)
-        block_local = np.zeros(
-            (max(ctx.num_devices // mh.num_processes, 1), n_global), np.float32
-        )
-        block_local[0] = local
-        sharding = NamedSharding(ctx.mesh, P(ctx.axis))
-        g = jax.make_array_from_process_local_data(sharding, block_local)
-        return jax.jit(
-            lambda a: jnp.sum(a, axis=0),
-            out_shardings=NamedSharding(ctx.mesh, P()),
-        )(g)
+        merged = collective_sum(local, ctx, mh.num_processes)
+        return jax.device_put(merged, NamedSharding(ctx.mesh, P()))
 
     labels_g = assemble_global(lambda gd: gd.response.astype(np.float32))
     weights_g = assemble_global(lambda gd: gd.weight.astype(np.float32))
@@ -373,7 +391,28 @@ def main(argv: Optional[List[str]] = None) -> dict:
     loss = losses_mod.for_task(p.task_type)
     loss_fn = lambda scores: jnp.sum(weights_g * loss.loss(scores, labels_g))
     cd = CoordinateDescent(coords, loss_fn)
-    result = cd.run(num_iterations=p.num_iterations, num_rows=n_global)
+    checkpointer = None
+    if p.checkpoint_dir:
+        from photon_ml_tpu.checkpoint import (
+            CoordinateDescentCheckpointer,
+            fingerprint,
+        )
+
+        # multihost-safe: sharded leaves are allgathered for the write, the
+        # coordinator writes, barriers fence (checkpoint.py multihost mode)
+        checkpointer = CoordinateDescentCheckpointer(
+            p.checkpoint_dir,
+            run_fingerprint=fingerprint({
+                "multihost": mh.num_processes,
+                "coordinates": p.updating_sequence,
+                "num_rows": n_global,
+            }),
+            multihost=mh,
+        )
+    result = cd.run(
+        num_iterations=p.num_iterations, num_rows=n_global,
+        checkpointer=checkpointer,
+    )
     logger.info(
         f"objective history: "
         + " ".join(f"{v:.6g}" for v in result.objective_history)

@@ -465,7 +465,32 @@ class PerHostRandomEffectSolver:
         )
         return self._update_fn(
             d.x, d.labels, d.base_offsets, d.weights, d.row_index,
-            init_coefficients, residuals,
+            self._sharded_init(init_coefficients), residuals,
+        )
+
+    def _sharded_init(self, w0) -> Array:
+        """Accept either an already entity-sharded array or a HOST-side
+        global array (e.g. a restored checkpoint): multihost jit cannot
+        commit host data to a cross-process sharding implicitly, so slice
+        this host's slab and contribute it explicitly."""
+        if isinstance(w0, jax.Array):
+            # already device-resident: device_put is a no-op when the
+            # sharding matches (never round-trip the slab through the host)
+            if not w0.is_fully_addressable:
+                return w0
+            return jax.device_put(
+                w0, NamedSharding(self.ctx.mesh, P(self.ctx.axis))
+            )
+        host = np.asarray(w0)
+        n_proc = jax.process_count()
+        if n_proc == 1:
+            return jax.device_put(
+                host, NamedSharding(self.ctx.mesh, P(self.ctx.axis))
+            )
+        per = host.shape[0] // n_proc
+        sl = slice(jax.process_index() * per, (jax.process_index() + 1) * per)
+        return jax.make_array_from_process_local_data(
+            NamedSharding(self.ctx.mesh, P(self.ctx.axis)), host[sl]
         )
 
     def score(self, coefficients: Array) -> Array:

@@ -312,28 +312,36 @@ def test_multihost_game_driver_matches_single_process(tmp_path):
         "--delete-output-dir-if-exists", "true",
     ]
 
-    port = _free_port()
     launcher = (
         "import jax; jax.config.update('jax_platforms','cpu'); "
         "from photon_ml_tpu.cli.game_multihost_driver import main; "
         "import sys; main(sys.argv[1:])"
     )
-    procs = []
-    for pid in range(2):
-        env = dict(os.environ)
-        env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
-        procs.append(subprocess.Popen(
-            [sys.executable, "-c", launcher,
-             "--multihost-coordinator", f"127.0.0.1:{port}",
-             "--multihost-num-processes", "2",
-             "--multihost-process-id", str(pid),
-             "--output-dir", str(tmp_path / "mh-out")] + flags,
-            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
-            cwd=REPO, env=env,
-        ))
-    for p in procs:
-        out, err = p.communicate(timeout=600)
-        assert p.returncode == 0, f"mh driver failed:\n{out[-1500:]}\n{err[-2500:]}"
+
+    def launch(extra):
+        port = _free_port()
+        procs = []
+        for pid in range(2):
+            env = dict(os.environ)
+            env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+            procs.append(subprocess.Popen(
+                [sys.executable, "-c", launcher,
+                 "--multihost-coordinator", f"127.0.0.1:{port}",
+                 "--multihost-num-processes", "2",
+                 "--multihost-process-id", str(pid),
+                 "--output-dir", str(tmp_path / "mh-out")] + flags + extra,
+                stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+                cwd=REPO, env=env,
+            ))
+        for p in procs:
+            out, err = p.communicate(timeout=600)
+            assert p.returncode == 0, f"mh driver failed:\n{out[-1500:]}\n{err[-2500:]}"
+
+    ckpt_dir = tmp_path / "mh-ckpt"
+    launch(["--checkpoint-dir", str(ckpt_dir)])
+    # multihost-safe checkpoints (retention keeps the last 2 of the 4
+    # updates: 2 iters x 2 coordinates), written by the coordinator only
+    assert sorted(os.listdir(ckpt_dir)) == ["step-3", "step-4"]
 
     # single-process oracle through the standard driver
     sp = game_training_driver.main(
@@ -366,3 +374,22 @@ def test_multihost_game_driver_matches_single_process(tmp_path):
         tmp_path / "mh-out" / "best" / "random-effect" / "per-user" / "coefficients"
     )
     assert len(parts) == 2
+
+    # RESUME: extend the checkpointed run by one descent iteration — the
+    # first 4 updates restore (host-side arrays re-sharded into the mesh),
+    # only steps 5-6 run, and the extended model matches a fresh 3-iteration
+    # single-process fit
+    flags[flags.index("--num-iterations") + 1] = "3"
+    launch(["--checkpoint-dir", str(ckpt_dir)])
+    steps_resumed = sorted(os.listdir(ckpt_dir))
+    assert steps_resumed == ["step-5", "step-6"]  # resumed, not re-run
+    sp3 = game_training_driver.main(
+        ["--output-dir", str(tmp_path / "sp3-out")] + flags
+    )
+    fe_mh3, _, _, _ = model_io.load_fixed_effect(
+        str(tmp_path / "mh-out" / "best"), "fixed", imap_g
+    )
+    fe_sp3, _, _, _ = model_io.load_fixed_effect(
+        str(tmp_path / "sp3-out" / "best"), "fixed", imap_g
+    )
+    np.testing.assert_allclose(fe_mh3, fe_sp3, rtol=5e-3, atol=5e-4)
