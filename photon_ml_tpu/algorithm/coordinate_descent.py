@@ -176,6 +176,7 @@ class CoordinateDescent:
         reg_weights: Dict[str, "jnp.ndarray"],
         num_iterations: int,
         num_rows: int,
+        init_params: Optional[Dict[str, Array]] = None,
     ) -> List[CoordinateDescentResult]:
         """Train EVERY lambda combo of a grid simultaneously: the combo axis
         becomes a ``vmap`` axis over the fused descent cycle, so a G-point
@@ -191,6 +192,12 @@ class CoordinateDescent:
         random-effect coordinates do; factored, bucketed, and distributed
         coordinates do not (their lambda lives in nested static configs),
         and sharded solves cannot nest under vmap anyway.
+
+        ``init_params`` (coordinate name -> unbatched params) warm-starts
+        EVERY lane's solver from the same point (e.g. a cheap pre-solve at
+        one lambda): under vmap all lanes pay the slowest lane's while_loop
+        iterations, so cutting every lane's iteration count from a shared
+        good init directly shrinks the batched grid's dominant cost.
 
         Returns one CoordinateDescentResult per combo, in input order.
         """
@@ -225,7 +232,11 @@ class CoordinateDescent:
         dt = real_dtype()
         params = {
             n: jnp.broadcast_to(
-                (w0 := self.coordinates[n].initial_coefficients()), (g,) + w0.shape
+                (w0 := (
+                    init_params[n]
+                    if init_params is not None
+                    else self.coordinates[n].initial_coefficients()
+                )), (g,) + w0.shape
             )
             for n in names
         }

@@ -406,6 +406,9 @@ def main(argv: Optional[List[str]] = None) -> dict:
                 "multihost": mh.num_processes,
                 "coordinates": p.updating_sequence,
                 "num_rows": n_global,
+                # a config change must NOT silently resume the old run
+                # (same rule as the single-process driver's fingerprint)
+                "configs": {k: str(v) for k, v in combo.items()},
             }),
             multihost=mh,
         )

@@ -155,3 +155,25 @@ def test_grid_shape_validation(setup):
             {"fixed": jnp.asarray([1.0, 2.0]), "random": jnp.asarray([1.0])},
             1, data.num_rows,
         )
+
+
+def test_grid_warm_start_reaches_same_optima(setup):
+    """init_params warm-starts every lane from a shared point; final
+    objectives must match the cold grid (same optima, different path)."""
+    data, labels, loss_fn = setup
+    coords = _coords(data, 0.1, 0.1)
+    cd = CoordinateDescent(coords, loss_fn)
+    lam = {"fixed": jnp.asarray([0.05, 0.5]), "random": jnp.asarray([0.1, 0.1])}
+    cold = cd.run_grid(lam, num_iterations=2, num_rows=data.num_rows)
+    pre = cd.run_grid(
+        {"fixed": jnp.asarray([0.5]), "random": jnp.asarray([0.1])},
+        num_iterations=1, num_rows=data.num_rows,
+    )
+    warm = cd.run_grid(
+        lam, num_iterations=2, num_rows=data.num_rows,
+        init_params=pre[0].coefficients,
+    )
+    for c, w in zip(cold, warm):
+        assert w.objective_history[-1] == pytest.approx(
+            c.objective_history[-1], rel=1e-4
+        )

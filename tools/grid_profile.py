@@ -117,5 +117,42 @@ def main():
     print(f"sequential warm: {t_seq:.3f}s  (vmapped/seq speedup {t_seq / t_vm:.2f}x)")
 
 
+def warm_start_experiment():
+    """vmapped-cold vs vmapped warm-started from one median-lambda descent
+    iteration: under vmap every lane pays the slowest lane's while_loop, so
+    a shared good init should cut the batched grid's dominant cost."""
+    t_start = time.perf_counter()
+
+    def log(msg):
+        print(f"[{time.perf_counter() - t_start:7.1f}s] {msg}", flush=True)
+
+    fixed, random_c, loss_fn, n = build()
+    g_lams = [0.01, 0.1, 1.0, 10.0]
+    lam = {"fixed": jnp.asarray(g_lams), "random": jnp.asarray([0.1] * len(g_lams))}
+    lam_mid = {"fixed": jnp.asarray([1.0]), "random": jnp.asarray([0.1])}
+
+    cd = CoordinateDescent({"fixed": fixed, "random": random_c}, loss_fn)
+    cd.run_grid(lam, num_iterations=1, num_rows=n)  # compile G=4
+    cd.run_grid(lam_mid, num_iterations=1, num_rows=n)  # compile G=1
+    log("compiled")
+
+    t0 = time.perf_counter()
+    r = cd.run_grid(lam, num_iterations=2, num_rows=n)
+    jax.block_until_ready(r[-1].total_scores)
+    t_cold = time.perf_counter() - t0
+    log(f"vmapped cold: {t_cold:.3f}s (final objectives "
+        f"{[round(x.objective_history[-1], 2) for x in r]})")
+
+    t0 = time.perf_counter()
+    pre = cd.run_grid(lam_mid, num_iterations=1, num_rows=n)
+    init = {k: v for k, v in pre[0].coefficients.items()}
+    r2 = cd.run_grid(lam, num_iterations=2, num_rows=n, init_params=init)
+    jax.block_until_ready(r2[-1].total_scores)
+    t_warm = time.perf_counter() - t0
+    log(f"vmapped warm (incl. pre-solve): {t_warm:.3f}s (final objectives "
+        f"{[round(x.objective_history[-1], 2) for x in r2]})")
+    log(f"warm/cold: {t_cold / t_warm:.2f}x")
+
+
 if __name__ == "__main__":
-    main()
+    warm_start_experiment() if "--warm" in sys.argv else main()

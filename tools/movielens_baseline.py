@@ -76,7 +76,7 @@ def synthesize(rows, rng):
     return users, movies, x, label
 
 
-def write_avro(dirpath, users, x, label, rows_slice, parts=4):
+def write_avro(dirpath, users, movies, x, label, rows_slice, parts=4):
     from photon_ml_tpu.io import avro as avro_io
     from photon_ml_tpu.io import schemas
 
@@ -111,7 +111,10 @@ def write_avro(dirpath, users, x, label, rows_slice, parts=4):
                     "label": float(label[r]),
                     "movieFeatures": feats,
                     "userMovieFeatures": feats,
-                    "metadataMap": {"userId": f"u{users[r]}"},
+                    "metadataMap": {
+                        "userId": f"u{users[r]}",
+                        "movieId": f"m{movies[r]}",
+                    },
                 }
 
         avro_io.write_container(
@@ -125,6 +128,9 @@ def main():
     ap.add_argument("--out", default="/tmp/ml1m_baseline")
     ap.add_argument("--iterations", type=int, default=2)
     ap.add_argument("--active-cap", type=int, default=512)
+    ap.add_argument("--full-game", action="store_true",
+                    help="BASELINE config-5 shape: + per-movie RE + factored "
+                         "MF coordinate (latent 4)")
     ns = ap.parse_args()
 
     rng = np.random.default_rng(20260730)
@@ -135,36 +141,56 @@ def main():
     log(f"writing avro ({n_train:,} train / {ns.rows - n_train:,} validation rows)")
     if os.path.exists(ns.out):
         shutil.rmtree(ns.out)
-    write_avro(os.path.join(ns.out, "train"), users, x, label, slice(0, n_train))
+    write_avro(os.path.join(ns.out, "train"), users, movies, x, label,
+               slice(0, n_train))
     write_avro(
-        os.path.join(ns.out, "validate"), users, x, label, slice(n_train, ns.rows),
-        parts=1,
+        os.path.join(ns.out, "validate"), users, movies, x, label,
+        slice(n_train, ns.rows), parts=1,
     )
     t_data = time.time() - t0
     log(f"data ready in {t_data:.0f}s")
 
     from photon_ml_tpu.cli.game_training_driver import main as game_main
 
-    t0 = time.time()
-    driver = game_main([
+    args = [
         "--train-input-dirs", os.path.join(ns.out, "train"),
         "--validate-input-dirs", os.path.join(ns.out, "validate"),
         "--task-type", "LOGISTIC_REGRESSION",
         "--output-dir", os.path.join(ns.out, "model"),
-        "--updating-sequence", "global,per-user",
         "--feature-shard-id-to-feature-section-keys-map",
         "global:movieFeatures|per_user:userMovieFeatures",
         "--fixed-effect-optimization-configurations",
         "global:60,1e-9,1.0,1,LBFGS,l2",
         "--fixed-effect-data-configurations", "global:global,4",
-        "--random-effect-optimization-configurations",
-        "per-user:40,1e-8,1.0,1,LBFGS,l2",
-        "--random-effect-data-configurations",
-        f"per-user:userId,per_user,4,{ns.active_cap},0,-1,index_map",
         "--num-iterations", str(ns.iterations),
         "--evaluator-type", "AUC",
         "--delete-output-dir-if-exists", "true",
-    ])
+    ]
+    if ns.full_game:
+        # config-5 shape: fixed + per-user RE + per-movie RE + factored MF
+        # (per-movie latent over the shared feature space, latent dim 4)
+        args += [
+            "--updating-sequence", "global,per-user,per-movie,mf",
+            "--random-effect-optimization-configurations",
+            "per-user:40,1e-8,1.0,1,LBFGS,l2|"
+            "per-movie:40,1e-8,1.0,1,LBFGS,l2",
+            "--random-effect-data-configurations",
+            f"per-user:userId,per_user,4,{ns.active_cap},0,-1,index_map|"
+            f"per-movie:movieId,per_user,4,{ns.active_cap},0,-1,index_map|"
+            f"mf:movieId,per_user,4,{ns.active_cap},0,-1,IDENTITY",
+            "--factored-random-effect-optimization-configurations",
+            "mf:30,1e-8,1.0,1,LBFGS,l2:30,1e-8,1.0,1,LBFGS,l2:2,4",
+        ]
+    else:
+        args += [
+            "--updating-sequence", "global,per-user",
+            "--random-effect-optimization-configurations",
+            "per-user:40,1e-8,1.0,1,LBFGS,l2",
+            "--random-effect-data-configurations",
+            f"per-user:userId,per_user,4,{ns.active_cap},0,-1,index_map",
+        ]
+    t0 = time.time()
+    driver = game_main(args)
     wall = time.time() - t0
     _, result, metrics = driver.results[driver.best_index]
     auc = float(metrics["AUC"])
@@ -177,14 +203,22 @@ def main():
     baseline_path = os.path.join(REPO, "BASELINE.json")
     with open(baseline_path) as f:
         baseline = json.load(f)
-    baseline.setdefault("published", {})["config4_movielens1m_scale"] = {
+    entry_key = (
+        "config5_full_game_movielens1m_scale" if ns.full_game
+        else "config4_movielens1m_scale"
+    )
+    baseline.setdefault("published", {})[entry_key] = {
         "dataset": (
             f"synthetic MovieLens-1M-scale GLMix (zero-egress environment: "
             f"real ML-1M unavailable; same shape/skew: {ns.rows:,} ratings, "
             f"{N_USERS:,} users, {N_MOVIES:,} movies, planted fixed+per-user "
             "logistic model)"
         ),
-        "model": "fixed effect (movie features) + per-user random effect",
+        "model": (
+            "fixed + per-user RE + per-movie RE + factored MF (latent 4)"
+            if ns.full_game
+            else "fixed effect (movie features) + per-user random effect"
+        ),
         "auc": round(auc, 4),
         "sec_per_cd_iteration": round(sec_per_iter, 2),
         "cd_iterations": ns.iterations,
