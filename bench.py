@@ -369,6 +369,75 @@ def _bench_scoring(extra, on_tpu):
     extra["scoring_config"] = {"rows": n_rows, "entities": n_entities, "d": d, "nnz": k}
 
 
+def _bench_perhost(extra, on_tpu):
+    """Per-host ingest shuffle (parallel/shuffle + perhost_ingest): rows/sec
+    through the full collective regroup — bucket-count psum, balanced owner
+    map, all_to_all row exchange, owner-side slab build — plus the
+    entity-sharded solve. The Spark partitionBy/groupByKey analogue's cost."""
+    import jax
+    import jax.numpy as jnp
+
+    from game_test_utils import make_glmix_data
+
+    from photon_ml_tpu.optim.common import OptimizerConfig
+    from photon_ml_tpu.ops.regularization import RegularizationContext
+    from photon_ml_tpu.parallel.mesh import MeshContext, data_mesh
+    from photon_ml_tpu.parallel.perhost_ingest import (
+        HostRows,
+        PerHostRandomEffectSolver,
+        per_host_re_dataset,
+    )
+    from photon_ml_tpu.types import OptimizerType, TaskType
+
+    num_users = 20000 if on_tpu else 2000
+    rng = np.random.default_rng(13)
+    data, _ = make_glmix_data(
+        rng, num_users=num_users, rows_per_user_range=(8, 16),
+        d_fixed=8, d_random=8,
+    )
+    from photon_ml_tpu.parallel.perhost_ingest import csr_to_padded
+
+    n = data.num_rows
+    feats = data.shards["per_user"]
+    fi, fv = csr_to_padded(feats, n)
+    vocab = data.id_vocabs["userId"]
+    rows = HostRows(
+        entity_raw_ids=[vocab[i] for i in data.ids["userId"]],
+        row_index=np.arange(n, dtype=np.int64),
+        labels=data.response.astype(np.float32),
+        weights=data.weight.astype(np.float32),
+        offsets=data.offset.astype(np.float32),
+        feat_idx=fi, feat_val=fv, global_dim=feats.dim,
+    )
+    ctx = MeshContext(data_mesh())
+    # warm the shuffle collectives (shard_map all_to_all + count psums)
+    # so the timed window measures throughput, not first-call compiles
+    per_host_re_dataset(rows, ctx)
+    t0 = time.perf_counter()
+    sd = per_host_re_dataset(rows, ctx)
+    jax.block_until_ready(sd.x)
+    t_ingest = time.perf_counter() - t0
+    solver = PerHostRandomEffectSolver(
+        sd, TaskType.LOGISTIC_REGRESSION, OptimizerType.LBFGS,
+        OptimizerConfig(max_iterations=15, tolerance=1e-7),
+        RegularizationContext.l2(0.1), ctx,
+    )
+    resid = jnp.zeros((n,), jnp.float32)
+    w, _ = solver.update(resid, solver.initial_coefficients())  # compile
+    jax.block_until_ready(w)
+    t0 = time.perf_counter()
+    w, _ = solver.update(resid, solver.initial_coefficients())
+    jax.block_until_ready(w)
+    t_solve = time.perf_counter() - t0
+    extra["perhost_shuffle_rows_per_sec"] = round(n / t_ingest, 1)
+    extra["perhost_solve_sec"] = round(t_solve, 3)
+    extra["perhost_config"] = {"rows": n, "entities": num_users}
+    _log(
+        f"per-host shuffle ingest: {n / t_ingest:.3e} rows/s "
+        f"({num_users} entities); entity-sharded solve {t_solve:.3f}s"
+    )
+
+
 def _bench_streaming(extra, on_tpu):
     """Out-of-core fixed-effect solve (optim/streaming.py, VERDICT r3 #5):
     rows/sec through one chunk-streamed value+grad pass (mmap'd per-stream .npy chunks,
@@ -778,6 +847,11 @@ def main():
             _bench_streaming(extra, on_tpu)
         except Exception:
             errors["streaming"] = traceback.format_exc(limit=3)
+        _save_partial()
+        try:
+            _bench_perhost(extra, on_tpu)
+        except Exception:
+            errors["perhost"] = traceback.format_exc(limit=3)
         _save_partial()
         try:
             _bench_scoring(extra, on_tpu)

@@ -392,10 +392,6 @@ class PerHostRandomEffectSolver:
     psum merges the (N,) partials (coefficients never move; scores do —
     the transpose of RandomEffectCoordinate.scala:139-146's model collect)."""
 
-    # arrays span hosts under multihost SPMD: CoordinateDescent must call
-    # update/score raw (they jit internally with global arrays as ARGS)
-    cd_jit = False
-
     data: ShardedREData
     task: "TaskType"
     optimizer: "OptimizerType"
@@ -406,6 +402,12 @@ class PerHostRandomEffectSolver:
     def __post_init__(self):
         self._update_fn = None
         self._score_fn = None
+        # under multihost SPMD the sharded arrays are non-addressable and
+        # CANNOT be closed over by an outer jit — CoordinateDescent must
+        # call update/score raw (they jit internally with the global arrays
+        # as ARGS). Single-process, everything is addressable and the
+        # coordinate composes with fused_cycle / run_grid like any other.
+        self.cd_jit = jax.process_count() == 1
 
     @property
     def local_dim(self) -> int:
@@ -473,6 +475,8 @@ class PerHostRandomEffectSolver:
         global array (e.g. a restored checkpoint): multihost jit cannot
         commit host data to a cross-process sharding implicitly, so slice
         this host's slab and contribute it explicitly."""
+        if isinstance(w0, jax.core.Tracer):
+            return w0  # inside an outer jit (fused_cycle) — already placed
         if isinstance(w0, jax.Array):
             # already device-resident: device_put is a no-op when the
             # sharding matches (never round-trip the slab through the host)

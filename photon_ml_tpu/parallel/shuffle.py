@@ -230,11 +230,14 @@ def exchange_rows(
     # this host's devices are process-major: [process_id*local, ...+local)
     for ld in range(local):
         d = process_id * local + ld
+        # an unpartitioned dim (1-device mesh) reports index slice(None)
         bi = np.asarray(
-            [s.data for s in r_int.addressable_shards if s.index[1].start == d]
+            [s.data for s in r_int.addressable_shards
+             if (s.index[1].start or 0) == d]
         ).reshape(n_dev, m, wi)
         bf = np.asarray(
-            [s.data for s in r_flt.addressable_shards if s.index[1].start == d]
+            [s.data for s in r_flt.addressable_shards
+             if (s.index[1].start or 0) == d]
         ).reshape(n_dev, m, wf)
         keep = bi[:, :, 0] != _PAD
         int_rows.append(bi[keep])
