@@ -55,6 +55,7 @@ from photon_ml_tpu.parallel.perhost_ingest import (
     HostRows,
     PerHostRandomEffectSolver,
     _unpack_u64,
+    concat_host_rows,
     csr_to_padded,
     per_host_re_dataset,
 )
@@ -213,7 +214,6 @@ def main(argv: Optional[List[str]] = None) -> dict:
         raise ValueError("multihost driver v1: plain fixed + RE coordinates only")
     unsupported = [
         flag for flag, on in (
-            ("--validate-input-dirs", bool(p.validate_input_dirs)),
             ("--compute-variance", p.compute_variance),
             ("--fused-cycle", p.fused_cycle),
             ("--vmapped-grid", p.vmapped_grid != "false"),
@@ -354,27 +354,8 @@ def main(argv: Optional[List[str]] = None) -> dict:
                     feat_idx=fi, feat_val=fv,
                     global_dim=f.dim,
                 ))
-            k_max = max(pp.feat_idx.shape[1] for pp in parts) if parts else 1
-            def padk(a, k_max, fill):
-                if a.shape[1] == k_max:
-                    return a
-                p2 = np.full((a.shape[0], k_max - a.shape[1]), fill, a.dtype)
-                return np.concatenate([a, p2], axis=1)
-            rows = HostRows(
-                entity_raw_ids=[r for pp in parts for r in pp.entity_raw_ids],
-                row_index=np.concatenate([pp.row_index for pp in parts])
-                if parts else np.zeros(0, np.int64),
-                labels=np.concatenate([pp.labels for pp in parts])
-                if parts else np.zeros(0, np.float32),
-                weights=np.concatenate([pp.weights for pp in parts])
-                if parts else np.zeros(0, np.float32),
-                offsets=np.concatenate([pp.offsets for pp in parts])
-                if parts else np.zeros(0, np.float32),
-                feat_idx=np.concatenate([padk(pp.feat_idx, k_max, -1) for pp in parts])
-                if parts else np.full((0, 1), -1, np.int32),
-                feat_val=np.concatenate([padk(pp.feat_val, k_max, 0.0) for pp in parts])
-                if parts else np.zeros((0, 1), np.float32),
-                global_dim=len(shard_maps[dc.feature_shard_id]),
+            rows = concat_host_rows(
+                parts, len(shard_maps[dc.feature_shard_id])
             )
             sd = per_host_re_dataset(
                 rows, ctx, mh.num_processes, mh.process_id,
@@ -421,6 +402,14 @@ def main(argv: Optional[List[str]] = None) -> dict:
         + " ".join(f"{v:.6g}" for v in result.objective_history)
     )
 
+    # ---- validation metrics (per-host decode + routed scoring) ------------
+    metrics: Dict[str, float] = {}
+    if p.validate_input_dirs:
+        metrics = _validate(
+            p, mh, ctx, shard_maps, needed_shards, id_types,
+            coords=coords, result=result, logger=logger,
+        )
+
     # ---- save (reference layout; RE parts written per host) ---------------
     out = os.path.join(p.output_dir, "best")
     mh.barrier("pre-save")
@@ -449,6 +438,7 @@ def main(argv: Optional[List[str]] = None) -> dict:
     logger.close()
     return {
         "objective_history": result.objective_history,
+        "validation_metrics": metrics,
         "num_rows": n_global,
         "process_id": mh.process_id,
         "output": out,
@@ -499,6 +489,120 @@ def _save_random_effect_parts(out, name, p, dc, coord, w, imap, mh):
         records,
         schemas.BAYESIAN_LINEAR_MODEL,
     )
+
+
+def _validate(p, mh, ctx, shard_maps, needed_shards, id_types, coords,
+              result, logger):
+    """Validation metrics under multihost: each host decodes only its slice
+    of the validation files; fixed-effect margins are computed locally (the
+    model is replicated) and random-effect rows are ROUTED to their
+    entity's owner with the training shuffle's agreed owner map
+    (score_routed_rows) — cold entities/features contribute 0. Scores merge
+    with one collective sum; every host computes the same metric values and
+    the coordinator logs them."""
+    from photon_ml_tpu.cli.game_training_driver import (
+        _default_evaluators,
+        _input_files,
+        resolve_date_range_dirs,
+    )
+    from photon_ml_tpu.evaluation.evaluators import evaluator_for
+    from photon_ml_tpu.parallel.perhost_ingest import score_routed_rows
+
+    val_files = sorted(_input_files(resolve_date_range_dirs(
+        p.validate_input_dirs, p.validate_date_range,
+        p.validate_date_range_days_ago,
+    )))
+    host_files = [(f, i) for i, f in enumerate(val_files)
+                  if i % mh.num_processes == mh.process_id]
+    vgds = []
+    for f, ordinal in host_files:
+        gd = read_game_data(
+            [f], shard_maps,
+            {s: p.feature_shard_sections.get(s) or ["features"]
+             for s in needed_shards},
+            id_types,
+            shard_intercepts={
+                s: p.feature_shard_intercepts.get(s, True) for s in needed_shards
+            },
+        )
+        vgds.append((ordinal, gd))
+    counts = np.zeros(len(val_files), np.int64)
+    for ordinal, gd in vgds:
+        counts[ordinal] = gd.num_rows
+    g_counts = collective_sum(counts, ctx, mh.num_processes)
+    file_base = np.concatenate([[0], np.cumsum(g_counts)[:-1]])
+    nv = int(g_counts.sum())
+
+    def merge(vec_per_gd):
+        local = np.zeros(nv, np.float32)
+        for ordinal, gd in vgds:
+            local[file_base[ordinal] + np.arange(gd.num_rows)] = vec_per_gd(gd)
+        return collective_sum(local, ctx, mh.num_processes)
+
+    labels_v = merge(lambda gd: gd.response.astype(np.float32))
+    weights_v = merge(lambda gd: gd.weight.astype(np.float32))
+    offsets_v = merge(lambda gd: gd.offset.astype(np.float32))
+
+    scores = offsets_v.astype(np.float64).copy()
+    for name in p.updating_sequence:
+        coord = coords[name]
+        w = result.coefficients[name]
+        if isinstance(coord, MultihostFixedEffectCoordinate):
+            spec = p.fixed_effect_data_configs[name]
+            w_host = np.asarray(jax.device_get(w))
+            local = np.zeros(nv, np.float32)
+            for ordinal, gd in vgds:
+                f = gd.shards[spec.feature_shard_id]
+                fi, fv = csr_to_padded(f, gd.num_rows)
+                sel = np.where(fi >= 0, w_host[np.maximum(fi, 0)], 0.0)
+                local[file_base[ordinal] + np.arange(gd.num_rows)] = np.sum(
+                    sel * fv, axis=1
+                )
+            scores += collective_sum(local, ctx, mh.num_processes)
+        else:
+            dc = p.random_effect_data_configs[name]
+            parts = []
+            for ordinal, gd in vgds:
+                f = gd.shards[dc.feature_shard_id]
+                fi, fv = csr_to_padded(f, gd.num_rows)
+                vocab = gd.id_vocabs[dc.random_effect_id]
+                parts.append(HostRows(
+                    entity_raw_ids=[vocab[i] for i in gd.ids[dc.random_effect_id]],
+                    row_index=file_base[ordinal] + np.arange(gd.num_rows, dtype=np.int64),
+                    labels=gd.response.astype(np.float32),
+                    weights=gd.weight.astype(np.float32),
+                    offsets=gd.offset.astype(np.float32),
+                    feat_idx=fi, feat_val=fv,
+                    global_dim=f.dim,
+                ))
+            vrows = concat_host_rows(
+                parts, len(shard_maps[dc.feature_shard_id])
+            )
+            scores += score_routed_rows(
+                coord.data, w, vrows, nv, ctx, mh.num_processes, mh.process_id
+            )
+
+    metrics: Dict[str, float] = {}
+    specs = p.evaluators or _default_evaluators(p.task_type)
+    grouped = [etype.value for etype, _, id_name in specs if id_name is not None]
+    if grouped:
+        raise ValueError(
+            f"multihost validation does not implement grouped evaluators "
+            f"{grouped} (replicated id columns; v2) — rejecting rather than "
+            "silently ignoring"
+        )
+    s = jnp.asarray(scores.astype(np.float32))
+    for etype, k, id_name in specs:
+        ev = evaluator_for(etype, k or 10)
+        key = etype.value if k is None else f"{etype.value}@{k}"
+        metrics[key] = float(ev.evaluate(
+            s, labels=jnp.asarray(labels_v), weights=jnp.asarray(weights_v)
+        ))
+    if mh.coordinator_only_io():
+        logger.info(
+            "validation: " + " ".join(f"{k}={v:.6g}" for k, v in metrics.items())
+        )
+    return metrics
 
 
 if __name__ == "__main__":

@@ -105,6 +105,11 @@ class ShardedREData:
     # host's devices (decoded from the exchanged fixed-width id bytes) —
     # what model save needs, never a device array
     raw_ids_by_key: Dict[int, str] = dataclasses.field(default_factory=dict)
+    # the agreed bucket->device owner map (identical on every host): what
+    # SCORING-time row routing needs so validation/inference rows reach the
+    # device that holds their entity's model
+    bucket_owners: Optional[np.ndarray] = None
+    num_buckets: int = 0
 
     @property
     def local_dim(self) -> int:
@@ -142,6 +147,38 @@ def _pad_to(a: np.ndarray, rows: int, fill) -> np.ndarray:
         return a
     pad = np.full((rows - a.shape[0],) + a.shape[1:], fill, a.dtype)
     return np.concatenate([a, pad])
+
+
+def concat_host_rows(parts: Sequence[HostRows], global_dim: int) -> HostRows:
+    """Concatenate per-file HostRows into one block, padding the feature
+    width to the widest part (the per-file decode's K varies)."""
+    if not parts:
+        return HostRows(
+            entity_raw_ids=[], row_index=np.zeros(0, np.int64),
+            labels=np.zeros(0, np.float32), weights=np.zeros(0, np.float32),
+            offsets=np.zeros(0, np.float32),
+            feat_idx=np.full((0, 1), -1, np.int32),
+            feat_val=np.zeros((0, 1), np.float32),
+            global_dim=global_dim,
+        )
+    k_max = max(p.feat_idx.shape[1] for p in parts)
+
+    def padk(a, fill):
+        if a.shape[1] == k_max:
+            return a
+        ext = np.full((a.shape[0], k_max - a.shape[1]), fill, a.dtype)
+        return np.concatenate([a, ext], axis=1)
+
+    return HostRows(
+        entity_raw_ids=[r for p in parts for r in p.entity_raw_ids],
+        row_index=np.concatenate([p.row_index for p in parts]),
+        labels=np.concatenate([p.labels for p in parts]),
+        weights=np.concatenate([p.weights for p in parts]),
+        offsets=np.concatenate([p.offsets for p in parts]),
+        feat_idx=np.concatenate([padk(p.feat_idx, -1) for p in parts]),
+        feat_val=np.concatenate([padk(p.feat_val, 0.0) for p in parts]),
+        global_dim=global_dim,
+    )
 
 
 def per_host_re_dataset(
@@ -372,6 +409,8 @@ def per_host_re_dataset(
         raw_ids_by_key={
             k: v for d in per_dev for k, v in d["raw_ids"].items()
         },
+        bucket_owners=owners,
+        num_buckets=num_buckets,
     )
 
 
@@ -604,27 +643,105 @@ def host_rows_from_avro(
                 global_dim=feats.dim,
             )
         )
-    if not parts:
-        return HostRows(
-            entity_raw_ids=[], row_index=np.zeros(0, np.int64),
-            labels=np.zeros(0, np.float32), weights=np.zeros(0, np.float32),
-            offsets=np.zeros(0, np.float32),
-            feat_idx=np.full((0, 1), -1, np.int32),
-            feat_val=np.zeros((0, 1), np.float32),
-            global_dim=len(index_map),
-        )
-    k_max = max(p.feat_idx.shape[1] for p in parts)
-    return HostRows(
-        entity_raw_ids=[r for p in parts for r in p.entity_raw_ids],
-        row_index=np.concatenate([p.row_index for p in parts]),
-        labels=np.concatenate([p.labels for p in parts]),
-        weights=np.concatenate([p.weights for p in parts]),
-        offsets=np.concatenate([p.offsets for p in parts]),
-        feat_idx=np.concatenate(
-            [_pad_to(p.feat_idx.T, k_max, -1).T for p in parts]
-        ),
-        feat_val=np.concatenate(
-            [_pad_to(p.feat_val.T, k_max, 0.0).T for p in parts]
-        ),
-        global_dim=parts[0].global_dim,
+    return concat_host_rows(parts, len(index_map))
+
+
+# ---------------------------------------------------------------------------
+# scoring-time row routing (validation / inference over per-host models)
+# ---------------------------------------------------------------------------
+
+
+def score_routed_rows(
+    sd: ShardedREData,
+    coefficients: Array,
+    rows: HostRows,
+    num_rows_out: int,
+    ctx: MeshContext,
+    num_processes: int = 1,
+    process_id: int = 0,
+) -> np.ndarray:
+    """Score rows THIS host ingested against entity models that may live on
+    any device: route each row to its entity's owner with the same shuffle
+    the training ingest used (``sd.bucket_owners``), have the owner project
+    into the entity's local space and dot with its slab row, then merge the
+    per-host (num_rows_out,) partials with one collective sum.
+
+    Cold-start semantics: a row whose entity has no model, or a feature the
+    entity never saw in training, contributes 0
+    (RandomEffectModel.scala:129-158). Returns the replicated host-side
+    (num_rows_out,) score vector (identical on every host).
+    """
+    if sd.bucket_owners is None:
+        raise ValueError("dataset was built without bucket_owners")
+    keys = stable_entity_keys(rows.entity_raw_ids)
+    dest = sd.bucket_owners[bucket_of(keys, sd.num_buckets)]
+    # all hosts must pack the SAME record width (the training path's rule)
+    k = int(collective_max(
+        np.asarray([rows.feat_idx.shape[1]]), ctx, num_processes
+    )[0])
+    fi_p = (_pad_to(rows.feat_idx.astype(np.int32).T, k, -1).T
+            if rows.feat_idx.shape[1] != k else rows.feat_idx.astype(np.int32))
+    fv_p = (_pad_to(rows.feat_val.astype(np.float32).T, k, 0.0).T
+            if rows.feat_val.shape[1] != k else rows.feat_val.astype(np.float32))
+    hi, lo = _pack_u64(keys)
+    int_payload = np.concatenate(
+        [rows.row_index.astype(np.int32)[:, None], hi[:, None], lo[:, None],
+         fi_p], axis=1
     )
+    ex = exchange_rows(dest, int_payload, fv_p, ctx, num_processes, process_id)
+
+    local = max(ctx.num_devices // num_processes, 1)
+    scores_local = np.zeros(num_rows_out, np.float64)
+    w_host = [np.asarray(s.data) for s in coefficients.addressable_shards]
+    k_host = [np.asarray(s.data) for s in sd.entity_keys.addressable_shards]
+    m_host = [np.asarray(s.data) for s in sd.entity_mask.addressable_shards]
+    l_host = [np.asarray(s.data) for s in sd.local_to_global.addressable_shards]
+    for ld in range(local):
+        bi, bf = ex.int_rows[ld], ex.float_rows[ld]
+        if not len(bi):
+            continue
+        w_d, k_d, m_d, l_d = w_host[ld], k_host[ld], m_host[ld], l_host[ld]
+        okeys = _unpack_u64(bi[:, 1], bi[:, 2])
+        slab_keys = _unpack_u64(k_d[:, 0], k_d[:, 1])
+        # key -> slot lookup over THIS device's (masked) lanes
+        order = np.argsort(slab_keys, kind="stable")
+        sk = slab_keys[order]
+        pos = np.searchsorted(sk, okeys)
+        pos_c = np.clip(pos, 0, max(len(sk) - 1, 0))
+        hit = (sk[pos_c] == okeys) & m_d[order][pos_c]
+        slot = np.where(hit, order[pos_c], -1)
+        fi = bi[:, 3:]
+        fv = bf
+        # vectorized per-entity global->local projection: a slab row's
+        # valid local_to_global prefix is sorted ascending (built from the
+        # sorted (entity, feature) pairs), so local index = searchsorted
+        keep = slot >= 0
+        if not keep.any():
+            continue
+        rr = np.nonzero(keep)[0]
+        l2g_rows = l_d[slot[rr]]  # (R, D_loc), -1 pad AFTER the valid prefix
+        big = np.int64(np.iinfo(np.int32).max)
+        l2g_sorted = np.where(l2g_rows >= 0, l2g_rows, big).astype(np.int64)
+        gidx = fi[rr].astype(np.int64)  # (R, K)
+        safe_g = np.where(gidx >= 0, gidx, 0)
+        # row-wise searchsorted via the flattened-offset trick (int64 so the
+        # per-row stride never overflows)
+        d_loc = l2g_sorted.shape[1]
+        stride = big + 1
+        flat = (l2g_sorted + np.arange(len(rr))[:, None] * stride).reshape(-1)
+        targets = safe_g + np.arange(len(rr))[:, None] * stride
+        j = np.searchsorted(flat, targets.reshape(-1)).reshape(len(rr), -1)
+        j_local = j - np.arange(len(rr))[:, None] * d_loc
+        j_c = np.clip(j_local, 0, d_loc - 1)
+        found = (
+            (gidx >= 0)
+            & (j_local < d_loc)
+            & (np.take_along_axis(l2g_rows, j_c, axis=1) == gidx)
+        )
+        wsel = w_d[slot[rr][:, None], j_c]  # (R, K)
+        contrib = np.sum(np.where(found, wsel * fv[rr], 0.0), axis=1)
+        np.add.at(scores_local, bi[rr, 0], contrib)
+    merged = collective_sum(
+        scores_local.astype(np.float32), ctx, num_processes
+    )
+    return np.asarray(merged, np.float32)

@@ -260,28 +260,39 @@ def test_multihost_game_driver_matches_single_process(tmp_path):
         ],
     }
     train_dir = tmp_path / "train"
+    val_dir = tmp_path / "validate"
     train_dir.mkdir()
-    n = data.num_rows
+    val_dir.mkdir()
+    n_all = data.num_rows
+    n = int(n_all * 0.85)
     ff, uf = data.shards["global"], data.shards["per_user"]
     vocab = data.id_vocabs["userId"]
-    bounds = np.linspace(0, n, 5).astype(int)  # 4 part files
+
+    def feats(f, r):
+        s, e = f.indptr[r], f.indptr[r + 1]
+        return [
+            {"name": f"c{j}", "term": "", "value": float(v)}
+            for j, v in zip(f.indices[s:e], f.values[s:e])
+        ]
+
+    def record(r):
+        return {"label": float(data.response[r]),
+                "fixedFeatures": feats(ff, r),
+                "userFeatures": feats(uf, r),
+                "metadataMap": {"userId": vocab[data.ids["userId"][r]]}}
+
+    bounds = np.linspace(0, n, 5).astype(int)  # 4 train part files
     for pi in range(4):
-        lo, hi = bounds[pi], bounds[pi + 1]
-
-        def feats(f, r):
-            s, e = f.indptr[r], f.indptr[r + 1]
-            return [
-                {"name": f"c{j}", "term": "", "value": float(v)}
-                for j, v in zip(f.indices[s:e], f.values[s:e])
-            ]
-
         avro_io.write_container(
             str(train_dir / f"part-{pi}.avro"),
-            ({"label": float(data.response[r]),
-              "fixedFeatures": feats(ff, r),
-              "userFeatures": feats(uf, r),
-              "metadataMap": {"userId": vocab[data.ids["userId"][r]]}}
-             for r in range(lo, hi)),
+            (record(r) for r in range(bounds[pi], bounds[pi + 1])),
+            schema,
+        )
+    vb = np.linspace(n, n_all, 3).astype(int)  # 2 validation part files
+    for pi in range(2):
+        avro_io.write_container(
+            str(val_dir / f"part-{pi}.avro"),
+            (record(r) for r in range(vb[pi], vb[pi + 1])),
             schema,
         )
 
@@ -296,6 +307,8 @@ def test_multihost_game_driver_matches_single_process(tmp_path):
 
     flags = [
         "--train-input-dirs", str(train_dir),
+        "--validate-input-dirs", str(val_dir),
+        "--evaluator-type", "AUC",
         "--task-type", "LOGISTIC_REGRESSION",
         "--updating-sequence", "fixed,per-user",
         "--feature-shard-id-to-feature-section-keys-map",
@@ -315,7 +328,8 @@ def test_multihost_game_driver_matches_single_process(tmp_path):
     launcher = (
         "import jax; jax.config.update('jax_platforms','cpu'); "
         "from photon_ml_tpu.cli.game_multihost_driver import main; "
-        "import sys; main(sys.argv[1:])"
+        "import sys, json; res = main(sys.argv[1:]); "
+        "print('MHVAL', json.dumps(res['validation_metrics']))"
     )
 
     def launch(extra):
@@ -333,12 +347,25 @@ def test_multihost_game_driver_matches_single_process(tmp_path):
                 stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
                 cwd=REPO, env=env,
             ))
+        outs = []
         for p in procs:
             out, err = p.communicate(timeout=600)
             assert p.returncode == 0, f"mh driver failed:\n{out[-1500:]}\n{err[-2500:]}"
+            outs.append(out)
+        import json as _json
+
+        return [
+            _json.loads(line.split("MHVAL ", 1)[1])
+            for o in outs
+            for line in o.splitlines()
+            if line.startswith("MHVAL")
+        ]
 
     ckpt_dir = tmp_path / "mh-ckpt"
-    launch(["--checkpoint-dir", str(ckpt_dir)])
+    mh_metrics = launch(["--checkpoint-dir", str(ckpt_dir)])
+    # both hosts computed identical validation metrics (routed RE scoring +
+    # collective merge is SPMD-deterministic)
+    assert len(mh_metrics) == 2 and mh_metrics[0] == mh_metrics[1]
     # multihost-safe checkpoints (retention keeps the last 2 of the 4
     # updates: 2 iters x 2 coordinates), written by the coordinator only
     assert sorted(os.listdir(ckpt_dir)) == ["step-3", "step-4"]
@@ -347,6 +374,9 @@ def test_multihost_game_driver_matches_single_process(tmp_path):
     sp = game_training_driver.main(
         ["--output-dir", str(tmp_path / "sp-out")] + flags
     )
+    # routed validation scoring matches the single-process evaluator
+    sp_auc = sp.results[sp.best_index][2]["AUC"]
+    assert mh_metrics[0]["AUC"] == pytest.approx(sp_auc, abs=2e-3)
     imap_g = load_shard_index_map(idx_dir, "global")
     imap_u = load_shard_index_map(idx_dir, "per_user")
     fe_mh, _, _, _ = model_io.load_fixed_effect(

@@ -187,3 +187,71 @@ class TestIndexMapProperties:
             idx = m1.get_index(k)
             assert idx >= 0
             assert m1.get_feature_name(idx) == k
+
+
+class TestShuffleProperties:
+    """Invariants of the collective-shuffle core (parallel/shuffle.py) the
+    per-host ingest leans on: delivery is exactly-once, owner maps are a
+    pure function of the global counts, and the reservoir priority is a
+    pure function of (entity, row) — never of partitioning."""
+
+    @SET
+    @given(
+        n=st.integers(min_value=0, max_value=300),
+        seed=st.integers(min_value=0, max_value=2**31 - 1),
+    )
+    def test_exchange_exactly_once(self, n, seed):
+        from photon_ml_tpu.parallel.mesh import MeshContext, data_mesh
+        from photon_ml_tpu.parallel import shuffle as sh
+
+        ctx = MeshContext(data_mesh())
+        rng = np.random.default_rng(seed)
+        dest = rng.integers(0, ctx.num_devices, size=n).astype(np.int64)
+        ints = np.stack(
+            [np.arange(n), rng.integers(0, 9, n)], axis=1
+        ).astype(np.int64) if n else np.zeros((0, 2), np.int64)
+        flts = rng.normal(size=(n, 2)).astype(np.float32)
+        ex = sh.exchange_rows(dest, ints, flts, ctx, 1, 0)
+        got = np.concatenate([b[:, 0] for b in ex.int_rows]) if n else np.zeros(0)
+        assert sorted(got.tolist()) == list(range(n))
+        # each row landed at exactly its destination device
+        for d, bi in enumerate(ex.int_rows):
+            if len(bi):
+                np.testing.assert_array_equal(dest[bi[:, 0]], d)
+
+    @SET
+    @given(
+        counts=st.lists(
+            st.integers(min_value=0, max_value=1000), min_size=8, max_size=64
+        ),
+        n_dev=st.sampled_from([2, 4, 8]),
+    )
+    def test_balanced_owners_deterministic_and_bounded(self, counts, n_dev):
+        from photon_ml_tpu.parallel import shuffle as sh
+
+        c = np.asarray(counts, np.int64)
+        o1 = sh.balanced_bucket_owners(c, n_dev)
+        o2 = sh.balanced_bucket_owners(c.copy(), n_dev)
+        np.testing.assert_array_equal(o1, o2)  # pure function of counts
+        assert o1.min() >= 0 and o1.max() < n_dev
+        loads = np.bincount(o1, weights=c, minlength=n_dev)
+        # greedy bin-packing bound: max load exceeds min by at most one item
+        assert loads.max() - loads.min() <= (c.max() if len(c) else 0)
+
+    @SET
+    @given(
+        ids=st.lists(
+            st.text(min_size=1, max_size=20), min_size=1, max_size=50, unique=True
+        ),
+        rows=st.integers(min_value=1, max_value=100),
+    )
+    def test_priority_partitioning_invariant(self, ids, rows):
+        from photon_ml_tpu.parallel import shuffle as sh
+
+        keys = sh.stable_entity_keys(ids * rows)[: len(ids) * min(rows, 3)]
+        ridx = np.arange(len(keys), dtype=np.int64)
+        p_full = sh.stable_row_priority(keys, ridx)
+        # any subset/order of rows produces the identical per-row priority
+        perm = np.random.default_rng(0).permutation(len(keys))
+        p_perm = sh.stable_row_priority(keys[perm], ridx[perm])
+        np.testing.assert_array_equal(p_full[perm], p_perm)
