@@ -261,9 +261,12 @@ def main(argv: Optional[List[str]] = None) -> dict:
         resolve_date_range_dirs,
     )
 
-    all_files = sorted(_input_files(resolve_date_range_dirs(
+    # _input_files is deterministic (per-dir sorted, dirs in argument
+    # order) and identical on every host — no global re-sort, matching the
+    # single-process driver's row order
+    all_files = _input_files(resolve_date_range_dirs(
         p.train_input_dirs, p.train_date_range, p.train_date_range_days_ago
-    )))
+    ))
     host_files = [(f, i) for i, f in enumerate(all_files)
                   if i % mh.num_processes == mh.process_id]
     id_types = sorted({c.random_effect_id

@@ -1,0 +1,292 @@
+"""Multi-host SPMD GAME scoring driver: score datasets against models that
+NO single host ever holds.
+
+Every host runs the same program under ``jax.distributed``: it loads only
+its share of the random-effect model's part files
+(ModelProcessingUtils.scala:205-219 layout — the same per-partition model
+files the multihost TRAINING driver writes), routes each model record to
+its entity's owner device with the stable-hash shuffle, decodes only its
+slice of the input rows, routes them to the owners for scoring
+(parallel.perhost_ingest.score_routed_rows), and writes its own scores
+part file. The fixed-effect model is small and replicated (the broadcast
+analogue). This is how a "hundreds of billions of coefficients" model
+(reference README.md:73) is SCORED: coefficients stay sharded end to end
+— loaded sharded, stored sharded, applied sharded.
+
+Scope (v1): AVRO inputs, prebuilt feature maps (--offheap-indexmap-dir),
+fixed + plain random-effect coordinates (no factored/MF models).
+
+Run (one process per host):
+
+    python -m photon_ml_tpu.cli.game_multihost_scoring_driver \\
+        --multihost-coordinator HOST:PORT --multihost-num-processes N \\
+        --multihost-process-id I  <game scoring flags...>
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Dict, List, Optional
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from photon_ml_tpu.cli.game_multihost_driver import _add_multihost_flags
+from photon_ml_tpu.cli.game_params import parse_scoring_params
+from photon_ml_tpu.cli.game_scoring_driver import SCORES_DIR
+from photon_ml_tpu.cli.game_training_driver import (
+    _input_files,
+    resolve_date_range_dirs,
+)
+from photon_ml_tpu.io import avro as avro_io
+from photon_ml_tpu.io import model_io, schemas
+from photon_ml_tpu.io.avro_data import read_game_data
+from photon_ml_tpu.parallel import multihost
+from photon_ml_tpu.parallel.perhost_ingest import (
+    concat_host_rows,
+    csr_to_padded,
+    HostRows,
+    per_host_model_slabs,
+    score_routed_rows,
+)
+from photon_ml_tpu.parallel.shuffle import collective_sum
+from photon_ml_tpu.utils.io_utils import prepare_output_dir
+from photon_ml_tpu.utils.logging import PhotonLogger
+
+
+def _load_re_model_rows(base: str, part_files: List[str], index_map):
+    """Decode THIS host's share of one RE model's part files into sparse
+    per-entity coefficient rows (global indices)."""
+    ids: List[str] = []
+    idx_rows: List[np.ndarray] = []
+    val_rows: List[np.ndarray] = []
+    for f in part_files:
+        for rec in avro_io.read_container(os.path.join(base, f)):
+            cols, vals = [], []
+            for ntv in rec["means"]:
+                j = model_io.ntv_index(ntv, index_map)
+                if j >= 0:
+                    cols.append(j)
+                    vals.append(ntv["value"])
+            ids.append(rec["modelId"])
+            idx_rows.append(np.asarray(cols, np.int32))
+            val_rows.append(np.asarray(vals, np.float32))
+    k = max((len(c) for c in idx_rows), default=1)
+    k = max(k, 1)
+    fi = np.full((len(ids), k), -1, np.int32)
+    fv = np.zeros((len(ids), k), np.float32)
+    for i, (c, v) in enumerate(zip(idx_rows, val_rows)):
+        fi[i, : len(c)] = c
+        fv[i, : len(c)] = v
+    return ids, fi, fv
+
+
+def main(argv: Optional[List[str]] = None) -> dict:
+    import sys
+
+    mh_args, rest = _add_multihost_flags(
+        list(argv) if argv is not None else sys.argv[1:]
+    )
+    p = parse_scoring_params(rest)
+    mh = multihost.initialize(
+        coordinator_address=mh_args["coordinator"],
+        num_processes=mh_args["num_processes"],
+        process_id=mh_args["process_id"],
+    )
+    ctx = mh.mesh_context()
+    if mh.coordinator_only_io():
+        prepare_output_dir(p.output_dir, p.delete_output_dir_if_exists)
+    mh.barrier("output-dir")
+    logger = PhotonLogger(
+        os.path.join(p.output_dir, f"photon-ml-tpu-mh-scoring-{mh.process_id}.log")
+    )
+    if not p.offheap_indexmap_dir:
+        raise ValueError(
+            "multihost scoring needs prebuilt feature maps: pass "
+            "--offheap-indexmap-dir (a full-data vocabulary scan per host "
+            "defeats per-host ingest)"
+        )
+
+    # ---- model layout -----------------------------------------------------
+    layout = model_io.list_game_model(p.game_model_input_dir)
+    fixed, random = [], []
+    for name in layout[model_io.FIXED_EFFECT]:
+        base = os.path.join(p.game_model_input_dir, model_io.FIXED_EFFECT, name)
+        with open(os.path.join(base, model_io.ID_INFO)) as f:
+            fixed.append((name, f.read().strip()))
+    for name in layout[model_io.RANDOM_EFFECT]:
+        base = os.path.join(p.game_model_input_dir, model_io.RANDOM_EFFECT, name)
+        if model_io.is_factored_random_effect(p.game_model_input_dir, name):
+            raise ValueError(
+                f"multihost scoring v1 does not support factored models ({name})"
+            )
+        with open(os.path.join(base, model_io.ID_INFO)) as f:
+            lines = f.read().splitlines()
+        random.append((name, lines[0], lines[1] if len(lines) > 1 else ""))
+
+    from photon_ml_tpu.io.offheap import load_shard_index_map
+
+    shards = sorted({s for _, s in fixed if s} | {s for _, _, s in random if s})
+    shard_maps = {s: load_shard_index_map(p.offheap_indexmap_dir, s) for s in shards}
+    id_types = sorted(
+        set(p.random_effect_id_types) | {rid for _, rid, _ in random if rid}
+    )
+
+    # ---- per-host input decode -------------------------------------------
+    # _input_files is deterministic (per-dir sorted, dirs in argument
+    # order) and identical on every host — NO global re-sort, so uid/row
+    # order matches the single-process scoring driver exactly
+    all_files = _input_files(
+        resolve_date_range_dirs(p.input_dirs, p.date_range, p.date_range_days_ago)
+    )
+    host_files = [(f, i) for i, f in enumerate(all_files)
+                  if i % mh.num_processes == mh.process_id]
+    gds = []
+    for f, ordinal in host_files:
+        gd = read_game_data(
+            [f], shard_maps, p.feature_shard_sections, id_types,
+            shard_intercepts=p.feature_shard_intercepts or None,
+            # evaluators need labels; pure inference tolerates nulls (the
+            # single-process driver's rule)
+            response_required=bool(p.evaluators),
+        )
+        gds.append((ordinal, gd))
+    counts = np.zeros(len(all_files), np.int64)
+    for ordinal, gd in gds:
+        counts[ordinal] = gd.num_rows
+    g_counts = collective_sum(counts, ctx, mh.num_processes)
+    file_base = np.concatenate([[0], np.cumsum(g_counts)[:-1]])
+    n_global = int(g_counts.sum())
+    logger.info(
+        f"host {mh.process_id}: scoring {sum(gd.num_rows for _, gd in gds)}"
+        f"/{n_global} rows ({len(host_files)}/{len(all_files)} files)"
+    )
+
+    def merge(vec_per_gd):
+        local = np.zeros(n_global, np.float32)
+        for ordinal, gd in gds:
+            local[file_base[ordinal] + np.arange(gd.num_rows)] = vec_per_gd(gd)
+        return collective_sum(local, ctx, mh.num_processes)
+
+    scores = merge(lambda gd: gd.offset.astype(np.float32)).astype(np.float64)
+
+    # ---- fixed effects: replicated model, local margins -------------------
+    for name, shard in fixed:
+        means, _, _, _ = model_io.load_fixed_effect(
+            p.game_model_input_dir, name, shard_maps[shard]
+        )
+        local = np.zeros(n_global, np.float32)
+        for ordinal, gd in gds:
+            f = gd.shards[shard]
+            fi, fv = csr_to_padded(f, gd.num_rows)
+            sel = np.where(fi >= 0, means[np.maximum(fi, 0)], 0.0)
+            local[file_base[ordinal] + np.arange(gd.num_rows)] = np.sum(
+                sel * fv, axis=1
+            )
+        scores += collective_sum(local, ctx, mh.num_processes)
+
+    # ---- random effects: per-host model parts -> owner slabs -> routing ---
+    for name, re_id, shard in random:
+        base = os.path.join(
+            p.game_model_input_dir, model_io.RANDOM_EFFECT, name,
+            model_io.COEFFICIENTS,
+        )
+        parts = sorted(f for f in os.listdir(base) if f.endswith(".avro"))
+        my_parts = [f for i, f in enumerate(parts)
+                    if i % mh.num_processes == mh.process_id]
+        ids, fi_m, fv_m = _load_re_model_rows(base, my_parts, shard_maps[shard])
+        logger.info(
+            f"random effect {name!r}: host {mh.process_id} loaded "
+            f"{len(ids)} of the model's entities "
+            f"({len(my_parts)}/{len(parts)} part files)"
+        )
+        sd, w = per_host_model_slabs(
+            ids, fi_m, fv_m, len(shard_maps[shard]), ctx,
+            mh.num_processes, mh.process_id,
+        )
+        row_parts = []
+        for ordinal, gd in gds:
+            f = gd.shards[shard]
+            fi, fv = csr_to_padded(f, gd.num_rows)
+            vocab = gd.id_vocabs[re_id]
+            row_parts.append(HostRows(
+                entity_raw_ids=[vocab[i] for i in gd.ids[re_id]],
+                row_index=file_base[ordinal] + np.arange(gd.num_rows, dtype=np.int64),
+                labels=np.nan_to_num(gd.response).astype(np.float32),
+                weights=gd.weight.astype(np.float32),
+                offsets=gd.offset.astype(np.float32),
+                feat_idx=fi, feat_val=fv,
+                global_dim=f.dim,
+            ))
+        vrows = concat_host_rows(row_parts, len(shard_maps[shard]))
+        scores += score_routed_rows(
+            sd, w, vrows, n_global, ctx, mh.num_processes, mh.process_id
+        )
+
+    scores = scores.astype(np.float32)
+
+    # ---- save: each host writes its own scores part files -----------------
+    out = os.path.join(p.output_dir, SCORES_DIR)
+    if mh.coordinator_only_io():
+        os.makedirs(out, exist_ok=True)
+    mh.barrier("scores-dir")
+    for ordinal, gd in gds:
+        base_id = int(file_base[ordinal])
+
+        def records():
+            for r in range(gd.num_rows):
+                label = float(gd.response[r])
+                yield {
+                    "uid": str(base_id + r),
+                    "label": None if np.isnan(label) else label,
+                    "modelId": p.game_model_id,
+                    "predictionScore": float(scores[base_id + r]),
+                    "weight": float(gd.weight[r]),
+                    "metadataMap": None,
+                }
+
+        avro_io.write_container(
+            os.path.join(out, f"part-{ordinal:05d}.avro"),
+            records(),
+            schemas.SCORING_RESULT,
+        )
+    mh.barrier("scores-written")
+
+    # ---- optional evaluators (replicated labels/weights) ------------------
+    metrics: Dict[str, float] = {}
+    if p.evaluators:
+        from photon_ml_tpu.cli.game_training_driver import _default_evaluators
+        from photon_ml_tpu.evaluation.evaluators import evaluator_for
+
+        labels = merge(lambda gd: gd.response.astype(np.float32))
+        weights = merge(lambda gd: gd.weight.astype(np.float32))
+        grouped = [e.value for e, _, idn in p.evaluators if idn is not None]
+        if grouped:
+            raise ValueError(
+                f"multihost scoring does not implement grouped evaluators {grouped}"
+            )
+        for etype, k, _ in p.evaluators:
+            ev = evaluator_for(etype, k or 10)
+            key = etype.value if k is None else f"{etype.value}@{k}"
+            metrics[key] = float(ev.evaluate(
+                jnp.asarray(scores), labels=jnp.asarray(labels),
+                weights=jnp.asarray(weights),
+            ))
+        if mh.coordinator_only_io():
+            logger.info(
+                "metrics: " + " ".join(f"{k}={v:.6g}" for k, v in metrics.items())
+            )
+    logger.info(f"wrote scores to {out}")
+    logger.close()
+    return {
+        "num_rows": n_global,
+        "metrics": metrics,
+        "process_id": mh.process_id,
+        "scores_dir": out,
+    }
+
+
+if __name__ == "__main__":
+    main()

@@ -71,14 +71,20 @@ def _model_record(model_id: str, task: TaskType, means: np.ndarray,
     }
 
 
+def ntv_index(ntv: dict, index_map: IndexMap) -> int:
+    """BayesianLinearModelAvro name/term -> feature index, with the bare-name
+    fallback for termless keys like (INTERCEPT); -1 when absent."""
+    idx = index_map.get_index(f"{ntv['name']}{DELIMITER}{ntv['term']}")
+    if idx < 0 and ntv["term"] == "":
+        idx = index_map.get_index(ntv["name"])
+    return idx
+
+
 def _record_to_dense(rec: dict, index_map: IndexMap) -> Tuple[np.ndarray, Optional[np.ndarray]]:
     d = len(index_map)
 
     def lookup(ntv) -> int:
-        idx = index_map.get_index(f"{ntv['name']}{DELIMITER}{ntv['term']}")
-        if idx < 0 and ntv["term"] == "":
-            idx = index_map.get_index(ntv["name"])  # e.g. (INTERCEPT)
-        return idx
+        return ntv_index(ntv, index_map)
 
     means = np.zeros(d, np.float32)
     for ntv in rec["means"]:

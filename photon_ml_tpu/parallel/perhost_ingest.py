@@ -745,3 +745,68 @@ def score_routed_rows(
         scores_local.astype(np.float32), ctx, num_processes
     )
     return np.asarray(merged, np.float32)
+
+
+# ---------------------------------------------------------------------------
+# per-host MODEL ingest (SPMD scoring: no host ever holds the full model)
+# ---------------------------------------------------------------------------
+
+
+def per_host_model_slabs(
+    entity_ids: Sequence[str],
+    coef_idx: np.ndarray,
+    coef_val: np.ndarray,
+    global_dim: int,
+    ctx: MeshContext,
+    num_processes: int = 1,
+    process_id: int = 0,
+    num_buckets: int = 4096,
+) -> Tuple[ShardedREData, Array]:
+    """Build entity-sharded MODEL slabs from the per-entity coefficient
+    records THIS host loaded (its share of the random-effect model's
+    part files, ModelProcessingUtils.scala:205-219 layout): each record is
+    routed to its entity's owner device with the same stable-hash shuffle
+    as training ingest, the owner builds (E_loc, D_loc) slabs + sparse
+    local maps, and scoring routes rows to owners (score_routed_rows) — a
+    model larger than any single host's memory scores without ever being
+    gathered.
+
+    ``coef_idx``/``coef_val``: (n_models, K) sparse global coefficients,
+    -1-masked. Returns (a ShardedREData view carrying the slab/lookup/owner
+    state score_routed_rows needs, the sharded (E_tot, D_loc) coefficient
+    array)."""
+    rows = HostRows(
+        entity_raw_ids=list(entity_ids),
+        # one "row" per model record; ids only need to be unique per record
+        row_index=np.arange(len(entity_ids), dtype=np.int64),
+        labels=np.zeros(len(entity_ids), np.float32),
+        weights=np.ones(len(entity_ids), np.float32),
+        offsets=np.zeros(len(entity_ids), np.float32),
+        feat_idx=coef_idx.astype(np.int32),
+        feat_val=coef_val.astype(np.float32),
+        global_dim=global_dim,
+    )
+    # each entity has exactly ONE record-row, so the training-ingest build
+    # produces slabs whose single active sample IS the coefficient vector
+    # in the entity's local space — read it back out as the model
+    sd = per_host_re_dataset(
+        rows, ctx, num_processes, process_id, num_buckets=num_buckets
+    )
+    sharding = NamedSharding(ctx.mesh, P(ctx.axis))
+    local_blocks = []
+    for xs, rs in zip(sd.x.addressable_shards, sd.row_index.addressable_shards):
+        x_d = np.asarray(xs.data)  # (E_loc, S=1..., D_loc)
+        r_d = np.asarray(rs.data)
+        # the record's coefficient vector sits at its (single) active slot
+        has = (r_d >= 0).any(axis=1)
+        first = np.argmax(r_d >= 0, axis=1)
+        w_d = np.where(
+            has[:, None],
+            np.take_along_axis(x_d, first[:, None, None], axis=1)[:, 0, :],
+            0.0,
+        ).astype(np.float32)
+        local_blocks.append(w_d)
+    w = jax.make_array_from_process_local_data(
+        sharding, np.concatenate(local_blocks, axis=0)
+    )
+    return sd, w

@@ -423,3 +423,145 @@ def test_multihost_game_driver_matches_single_process(tmp_path):
         str(tmp_path / "sp3-out" / "best"), "fixed", imap_g
     )
     np.testing.assert_allclose(fe_mh3, fe_sp3, rtol=5e-3, atol=5e-4)
+
+
+@pytest.mark.slow
+def test_multihost_scoring_driver_matches_single_process(tmp_path):
+    """SPMD scoring against a model no host fully holds: train multihost
+    (per-host RE model part files), then score multihost — each host loads
+    only its model parts, records route to owner devices, input rows route
+    for scoring — and the written scores must match the single-process
+    scoring driver reading the same model."""
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import sys as _sys
+
+    _sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    import numpy as np
+
+    from game_test_utils import make_glmix_data
+    from photon_ml_tpu.cli import feature_indexing, game_scoring_driver
+    from photon_ml_tpu.io import avro as avro_io
+    from photon_ml_tpu.io import schemas
+
+    rng = np.random.default_rng(33)
+    data, _ = make_glmix_data(
+        rng, num_users=16, rows_per_user_range=(6, 14), d_fixed=4, d_random=3
+    )
+    schema = {
+        "name": "MhScoreAvro", "type": "record", "namespace": "t",
+        "fields": [
+            {"name": "label", "type": "double"},
+            {"name": "fixedFeatures",
+             "type": {"type": "array", "items": schemas.FEATURE}},
+            {"name": "userFeatures",
+             "type": {"type": "array",
+                      "items": "com.linkedin.photon.avro.generated.FeatureAvro"}},
+            {"name": "metadataMap",
+             "type": ["null", {"type": "map", "values": "string"}],
+             "default": None},
+        ],
+    }
+    ff, uf = data.shards["global"], data.shards["per_user"]
+    vocab = data.id_vocabs["userId"]
+
+    def feats(f, r):
+        s, e = f.indptr[r], f.indptr[r + 1]
+        return [{"name": f"c{j}", "term": "", "value": float(v)}
+                for j, v in zip(f.indices[s:e], f.values[s:e])]
+
+    def write_parts(dirpath, row_range, n_parts):
+        dirpath.mkdir()
+        bounds = np.linspace(row_range.start, row_range.stop, n_parts + 1).astype(int)
+        for pi in range(n_parts):
+            avro_io.write_container(
+                str(dirpath / f"part-{pi}.avro"),
+                ({"label": float(data.response[r]),
+                  "fixedFeatures": feats(ff, r),
+                  "userFeatures": feats(uf, r),
+                  "metadataMap": {"userId": vocab[data.ids["userId"][r]]}}
+                 for r in range(bounds[pi], bounds[pi + 1])),
+                schema,
+            )
+
+    n = data.num_rows
+    write_parts(tmp_path / "train", range(0, int(n * 0.8)), 4)
+    write_parts(tmp_path / "score-in", range(int(n * 0.8), n), 3)
+
+    idx_dir = str(tmp_path / "index")
+    feature_indexing.main([
+        "--data-input-dirs", str(tmp_path / "train"),
+        "--output-dir", idx_dir, "--partition-num", "1",
+        "--feature-shard-id-to-feature-section-keys-map",
+        "global:fixedFeatures|per_user:userFeatures",
+    ])
+
+    def launch(module, extra):
+        port = _free_port()
+        launcher = (
+            "import jax; jax.config.update('jax_platforms','cpu'); "
+            f"from photon_ml_tpu.cli.{module} import main; "
+            "import sys; main(sys.argv[1:])"
+        )
+        procs = []
+        for pid in range(2):
+            env = dict(os.environ)
+            env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+            procs.append(subprocess.Popen(
+                [sys.executable, "-c", launcher,
+                 "--multihost-coordinator", f"127.0.0.1:{port}",
+                 "--multihost-num-processes", "2",
+                 "--multihost-process-id", str(pid)] + extra,
+                stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+                cwd=REPO, env=env,
+            ))
+        for pr in procs:
+            out, err = pr.communicate(timeout=600)
+            assert pr.returncode == 0, f"{module} failed:\n{out[-1200:]}\n{err[-2500:]}"
+
+    launch("game_multihost_driver", [
+        "--output-dir", str(tmp_path / "model"),
+        "--train-input-dirs", str(tmp_path / "train"),
+        "--task-type", "LOGISTIC_REGRESSION",
+        "--updating-sequence", "fixed,per-user",
+        "--feature-shard-id-to-feature-section-keys-map",
+        "global:fixedFeatures|per_user:userFeatures",
+        "--fixed-effect-optimization-configurations",
+        "fixed:30,1e-9,0.1,1,LBFGS,L2",
+        "--fixed-effect-data-configurations", "fixed:global,2",
+        "--random-effect-optimization-configurations",
+        "per-user:25,1e-9,0.5,1,LBFGS,L2",
+        "--random-effect-data-configurations",
+        "per-user:userId,per_user,2,-1,0,-1,index_map",
+        "--num-iterations", "2",
+        "--offheap-indexmap-dir", idx_dir,
+        "--delete-output-dir-if-exists", "true",
+    ])
+
+    launch("game_multihost_scoring_driver", [
+        "--input-dirs", str(tmp_path / "score-in"),
+        "--game-model-input-dir", str(tmp_path / "model" / "best"),
+        "--output-dir", str(tmp_path / "mh-scores"),
+        "--feature-shard-id-to-feature-section-keys-map",
+        "global:fixedFeatures|per_user:userFeatures",
+        "--offheap-indexmap-dir", idx_dir,
+        "--delete-output-dir-if-exists", "true",
+    ])
+
+    sp = game_scoring_driver.main([
+        "--input-dirs", str(tmp_path / "score-in"),
+        "--game-model-input-dir", str(tmp_path / "model" / "best"),
+        "--output-dir", str(tmp_path / "sp-scores"),
+        "--feature-shard-id-to-feature-section-keys-map",
+        "global:fixedFeatures|per_user:userFeatures",
+        "--offheap-indexmap-dir", idx_dir,
+        "--delete-output-dir-if-exists", "true",
+    ])
+    got = {}
+    for f in sorted(os.listdir(tmp_path / "mh-scores" / "scores")):
+        for rec in avro_io.read_container(str(tmp_path / "mh-scores" / "scores" / f)):
+            got[int(rec["uid"])] = rec["predictionScore"]
+    assert len(got) == len(sp.scores)
+    mh_scores = np.asarray([got[r] for r in range(len(sp.scores))])
+    np.testing.assert_allclose(mh_scores, sp.scores, rtol=2e-4, atol=2e-5)
