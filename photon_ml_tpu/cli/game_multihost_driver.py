@@ -57,6 +57,9 @@ from photon_ml_tpu.parallel.perhost_ingest import (
     _unpack_u64,
     concat_host_rows,
     csr_to_padded,
+    global_row_layout,
+    host_file_share,
+    merge_row_vectors,
     per_host_re_dataset,
 )
 from photon_ml_tpu.parallel.shuffle import collective_sum
@@ -267,8 +270,7 @@ def main(argv: Optional[List[str]] = None) -> dict:
     all_files = _input_files(resolve_date_range_dirs(
         p.train_input_dirs, p.train_date_range, p.train_date_range_days_ago
     ))
-    host_files = [(f, i) for i, f in enumerate(all_files)
-                  if i % mh.num_processes == mh.process_id]
+    host_files = host_file_share(all_files, mh.num_processes, mh.process_id)
     id_types = sorted({c.random_effect_id
                        for c in p.random_effect_data_configs.values()})
     gds = []
@@ -283,14 +285,9 @@ def main(argv: Optional[List[str]] = None) -> dict:
             },
         )
         gds.append((ordinal, gd))
-    # dense global row ids: exclusive prefix over per-file counts (agreed
-    # collectively — each host contributes only its files' counts)
-    counts = np.zeros(len(all_files), np.int64)
-    for ordinal, gd in gds:
-        counts[ordinal] = gd.num_rows
-    g_counts = collective_sum(counts, ctx, mh.num_processes)
-    file_base = np.concatenate([[0], np.cumsum(g_counts)[:-1]])
-    n_global = int(g_counts.sum())
+    file_base, n_global = global_row_layout(
+        len(all_files), gds, ctx, mh.num_processes
+    )
     logger.info(
         f"host {mh.process_id}: {len(host_files)}/{len(all_files)} files, "
         f"{sum(gd.num_rows for _, gd in gds)}/{n_global} rows"
@@ -300,11 +297,9 @@ def main(argv: Optional[List[str]] = None) -> dict:
     # scatter own rows, one psum merges (these are O(N) scalars — the same
     # footprint as the score vectors the descent already carries)
     def assemble_global(vec_per_gd):
-        local = np.zeros(n_global, np.float32)
-        for ordinal, gd in gds:
-            ids = file_base[ordinal] + np.arange(gd.num_rows)
-            local[ids] = vec_per_gd(gd)
-        merged = collective_sum(local, ctx, mh.num_processes)
+        merged = merge_row_vectors(
+            gds, file_base, n_global, ctx, mh.num_processes, vec_per_gd
+        )
         return jax.device_put(merged, NamedSharding(ctx.mesh, P()))
 
     labels_g = assemble_global(lambda gd: gd.response.astype(np.float32))
@@ -494,6 +489,32 @@ def _save_random_effect_parts(out, name, p, dc, coord, w, imap, mh):
     )
 
 
+
+
+def merge_group_ids(gds, file_base, n_rows, id_name, ctx, mh):
+    """Globally consistent dense group ids for grouped evaluators: each
+    host hashes ITS rows' raw ids (64-bit stable keys), the (hi, lo) int32
+    vectors merge exactly with one collective sum each, and every host
+    ranks the identical reconstructed keys into dense int32 groups."""
+    from photon_ml_tpu.parallel.perhost_ingest import _pack_u64, _unpack_u64
+    from photon_ml_tpu.parallel.shuffle import stable_entity_keys
+
+    hi_l = np.zeros(n_rows, np.int32)
+    lo_l = np.zeros(n_rows, np.int32)
+    for ordinal, gd in gds:
+        vocab = gd.id_vocabs[id_name]
+        keys = stable_entity_keys([vocab[i] for i in gd.ids[id_name]])
+        hi, lo = _pack_u64(keys)
+        ids = file_base[ordinal] + np.arange(gd.num_rows)
+        hi_l[ids] = hi
+        lo_l[ids] = lo
+    hi_g = collective_sum(hi_l, ctx, mh.num_processes).astype(np.int32)
+    lo_g = collective_sum(lo_l, ctx, mh.num_processes).astype(np.int32)
+    keys_g = _unpack_u64(hi_g, lo_g)
+    _, dense = np.unique(keys_g, return_inverse=True)
+    return dense.astype(np.int32)
+
+
 def _validate(p, mh, ctx, shard_maps, needed_shards, id_types, coords,
               result, logger):
     """Validation metrics under multihost: each host decodes only its slice
@@ -511,12 +532,14 @@ def _validate(p, mh, ctx, shard_maps, needed_shards, id_types, coords,
     from photon_ml_tpu.evaluation.evaluators import evaluator_for
     from photon_ml_tpu.parallel.perhost_ingest import score_routed_rows
 
-    val_files = sorted(_input_files(resolve_date_range_dirs(
+    specs = p.evaluators or _default_evaluators(p.task_type)
+    grouped_ids = sorted({idn for _, _, idn in specs if idn is not None})
+    id_types = sorted(set(id_types) | set(grouped_ids))
+    val_files = _input_files(resolve_date_range_dirs(
         p.validate_input_dirs, p.validate_date_range,
         p.validate_date_range_days_ago,
-    )))
-    host_files = [(f, i) for i, f in enumerate(val_files)
-                  if i % mh.num_processes == mh.process_id]
+    ))
+    host_files = host_file_share(val_files, mh.num_processes, mh.process_id)
     vgds = []
     for f, ordinal in host_files:
         gd = read_game_data(
@@ -529,18 +552,14 @@ def _validate(p, mh, ctx, shard_maps, needed_shards, id_types, coords,
             },
         )
         vgds.append((ordinal, gd))
-    counts = np.zeros(len(val_files), np.int64)
-    for ordinal, gd in vgds:
-        counts[ordinal] = gd.num_rows
-    g_counts = collective_sum(counts, ctx, mh.num_processes)
-    file_base = np.concatenate([[0], np.cumsum(g_counts)[:-1]])
-    nv = int(g_counts.sum())
+    file_base, nv = global_row_layout(
+        len(val_files), vgds, ctx, mh.num_processes
+    )
 
     def merge(vec_per_gd):
-        local = np.zeros(nv, np.float32)
-        for ordinal, gd in vgds:
-            local[file_base[ordinal] + np.arange(gd.num_rows)] = vec_per_gd(gd)
-        return collective_sum(local, ctx, mh.num_processes)
+        return merge_row_vectors(
+            vgds, file_base, nv, ctx, mh.num_processes, vec_per_gd
+        )
 
     labels_v = merge(lambda gd: gd.response.astype(np.float32))
     weights_v = merge(lambda gd: gd.weight.astype(np.float32))
@@ -586,21 +605,19 @@ def _validate(p, mh, ctx, shard_maps, needed_shards, id_types, coords,
             )
 
     metrics: Dict[str, float] = {}
-    specs = p.evaluators or _default_evaluators(p.task_type)
-    grouped = [etype.value for etype, _, id_name in specs if id_name is not None]
-    if grouped:
-        raise ValueError(
-            f"multihost validation does not implement grouped evaluators "
-            f"{grouped} (replicated id columns; v2) — rejecting rather than "
-            "silently ignoring"
-        )
     s = jnp.asarray(scores.astype(np.float32))
+    # one hash-merge per distinct id column, shared across evaluators
+    group_cols = {
+        idn: jnp.asarray(merge_group_ids(vgds, file_base, nv, idn, ctx, mh))
+        for idn in grouped_ids
+    }
     for etype, k, id_name in specs:
         ev = evaluator_for(etype, k or 10)
+        kwargs = {"labels": jnp.asarray(labels_v), "weights": jnp.asarray(weights_v)}
+        if id_name is not None:
+            kwargs["group_ids"] = group_cols[id_name]
         key = etype.value if k is None else f"{etype.value}@{k}"
-        metrics[key] = float(ev.evaluate(
-            s, labels=jnp.asarray(labels_v), weights=jnp.asarray(weights_v)
-        ))
+        metrics[key] = float(ev.evaluate(s, **kwargs))
     if mh.coordinator_only_io():
         logger.info(
             "validation: " + " ".join(f"{k}={v:.6g}" for k, v in metrics.items())

@@ -47,7 +47,10 @@ from photon_ml_tpu.parallel import multihost
 from photon_ml_tpu.parallel.perhost_ingest import (
     concat_host_rows,
     csr_to_padded,
+    global_row_layout,
+    host_file_share,
     HostRows,
+    merge_row_vectors,
     per_host_model_slabs,
     score_routed_rows,
 )
@@ -130,8 +133,11 @@ def main(argv: Optional[List[str]] = None) -> dict:
 
     shards = sorted({s for _, s in fixed if s} | {s for _, _, s in random if s})
     shard_maps = {s: load_shard_index_map(p.offheap_indexmap_dir, s) for s in shards}
+    grouped_ids = sorted({idn for _, _, idn in (p.evaluators or []) if idn})
     id_types = sorted(
-        set(p.random_effect_id_types) | {rid for _, rid, _ in random if rid}
+        set(p.random_effect_id_types)
+        | {rid for _, rid, _ in random if rid}
+        | set(grouped_ids)
     )
 
     # ---- per-host input decode -------------------------------------------
@@ -141,8 +147,7 @@ def main(argv: Optional[List[str]] = None) -> dict:
     all_files = _input_files(
         resolve_date_range_dirs(p.input_dirs, p.date_range, p.date_range_days_ago)
     )
-    host_files = [(f, i) for i, f in enumerate(all_files)
-                  if i % mh.num_processes == mh.process_id]
+    host_files = host_file_share(all_files, mh.num_processes, mh.process_id)
     gds = []
     for f, ordinal in host_files:
         gd = read_game_data(
@@ -153,22 +158,18 @@ def main(argv: Optional[List[str]] = None) -> dict:
             response_required=bool(p.evaluators),
         )
         gds.append((ordinal, gd))
-    counts = np.zeros(len(all_files), np.int64)
-    for ordinal, gd in gds:
-        counts[ordinal] = gd.num_rows
-    g_counts = collective_sum(counts, ctx, mh.num_processes)
-    file_base = np.concatenate([[0], np.cumsum(g_counts)[:-1]])
-    n_global = int(g_counts.sum())
+    file_base, n_global = global_row_layout(
+        len(all_files), gds, ctx, mh.num_processes
+    )
     logger.info(
         f"host {mh.process_id}: scoring {sum(gd.num_rows for _, gd in gds)}"
         f"/{n_global} rows ({len(host_files)}/{len(all_files)} files)"
     )
 
     def merge(vec_per_gd):
-        local = np.zeros(n_global, np.float32)
-        for ordinal, gd in gds:
-            local[file_base[ordinal] + np.arange(gd.num_rows)] = vec_per_gd(gd)
-        return collective_sum(local, ctx, mh.num_processes)
+        return merge_row_vectors(
+            gds, file_base, n_global, ctx, mh.num_processes, vec_per_gd
+        )
 
     scores = merge(lambda gd: gd.offset.astype(np.float32)).astype(np.float64)
 
@@ -257,23 +258,25 @@ def main(argv: Optional[List[str]] = None) -> dict:
     # ---- optional evaluators (replicated labels/weights) ------------------
     metrics: Dict[str, float] = {}
     if p.evaluators:
-        from photon_ml_tpu.cli.game_training_driver import _default_evaluators
+        from photon_ml_tpu.cli.game_multihost_driver import merge_group_ids
         from photon_ml_tpu.evaluation.evaluators import evaluator_for
 
         labels = merge(lambda gd: gd.response.astype(np.float32))
         weights = merge(lambda gd: gd.weight.astype(np.float32))
-        grouped = [e.value for e, _, idn in p.evaluators if idn is not None]
-        if grouped:
-            raise ValueError(
-                f"multihost scoring does not implement grouped evaluators {grouped}"
-            )
-        for etype, k, _ in p.evaluators:
-            ev = evaluator_for(etype, k or 10)
-            key = etype.value if k is None else f"{etype.value}@{k}"
-            metrics[key] = float(ev.evaluate(
-                jnp.asarray(scores), labels=jnp.asarray(labels),
-                weights=jnp.asarray(weights),
+        group_cols = {
+            idn: jnp.asarray(merge_group_ids(
+                gds, file_base, n_global, idn, ctx, mh
             ))
+            for idn in grouped_ids
+        }
+        for etype, k, id_name in p.evaluators:
+            ev = evaluator_for(etype, k or 10)
+            kwargs = {"labels": jnp.asarray(labels),
+                      "weights": jnp.asarray(weights)}
+            if id_name is not None:
+                kwargs["group_ids"] = group_cols[id_name]
+            key = etype.value if k is None else f"{etype.value}@{k}"
+            metrics[key] = float(ev.evaluate(jnp.asarray(scores), **kwargs))
         if mh.coordinator_only_io():
             logger.info(
                 "metrics: " + " ".join(f"{k}={v:.6g}" for k, v in metrics.items())

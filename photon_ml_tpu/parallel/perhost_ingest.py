@@ -810,3 +810,39 @@ def per_host_model_slabs(
         sharding, np.concatenate(local_blocks, axis=0)
     )
     return sd, w
+
+
+# ---------------------------------------------------------------------------
+# per-host file-partition bookkeeping shared by the multihost drivers
+# ---------------------------------------------------------------------------
+
+
+def host_file_share(all_files: Sequence[str], num_processes: int,
+                    process_id: int) -> List[Tuple[str, int]]:
+    """Deterministic round-robin (file, global ordinal) share for this host."""
+    return [(f, i) for i, f in enumerate(all_files)
+            if i % num_processes == process_id]
+
+
+def global_row_layout(num_files: int, decoded, ctx: MeshContext,
+                      num_processes: int) -> Tuple[np.ndarray, int]:
+    """(file_base, n_global): dense global row ids = exclusive prefix over
+    per-file counts, agreed collectively (each host contributes only its
+    files' counts). ``decoded`` is [(ordinal, obj-with-num_rows)]."""
+    counts = np.zeros(num_files, np.int64)
+    for ordinal, gd in decoded:
+        counts[ordinal] = gd.num_rows
+    g_counts = collective_sum(counts, ctx, num_processes)
+    file_base = np.concatenate([[0], np.cumsum(g_counts)[:-1]])
+    return file_base, int(g_counts.sum())
+
+
+def merge_row_vectors(decoded, file_base: np.ndarray, n_global: int,
+                      ctx: MeshContext, num_processes: int, vec_per_gd):
+    """Replicated (n_global,) vector from per-host row values: each host
+    scatters its rows into a zero vector, one collective sum merges (every
+    global row is written by exactly one host, so the sum is exact)."""
+    local = np.zeros(n_global, np.float32)
+    for ordinal, gd in decoded:
+        local[file_base[ordinal] + np.arange(gd.num_rows)] = vec_per_gd(gd)
+    return collective_sum(local, ctx, num_processes)

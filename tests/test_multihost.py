@@ -308,7 +308,7 @@ def test_multihost_game_driver_matches_single_process(tmp_path):
     flags = [
         "--train-input-dirs", str(train_dir),
         "--validate-input-dirs", str(val_dir),
-        "--evaluator-type", "AUC",
+        "--evaluator-type", "AUC,PRECISION@5:userId",
         "--task-type", "LOGISTIC_REGRESSION",
         "--updating-sequence", "fixed,per-user",
         "--feature-shard-id-to-feature-section-keys-map",
@@ -374,9 +374,13 @@ def test_multihost_game_driver_matches_single_process(tmp_path):
     sp = game_training_driver.main(
         ["--output-dir", str(tmp_path / "sp-out")] + flags
     )
-    # routed validation scoring matches the single-process evaluator
-    sp_auc = sp.results[sp.best_index][2]["AUC"]
-    assert mh_metrics[0]["AUC"] == pytest.approx(sp_auc, abs=2e-3)
+    # routed validation scoring matches the single-process evaluators,
+    # including the GROUPED precision@k (hash-merged global group column)
+    sp_metrics = sp.results[sp.best_index][2]
+    assert mh_metrics[0]["AUC"] == pytest.approx(sp_metrics["AUC"], abs=2e-3)
+    assert mh_metrics[0]["PRECISION_AT_K@5"] == pytest.approx(
+        sp_metrics["PRECISION_AT_K@5"], abs=2e-3
+    )
     imap_g = load_shard_index_map(idx_dir, "global")
     imap_u = load_shard_index_map(idx_dir, "per_user")
     fe_mh, _, _, _ = model_io.load_fixed_effect(
@@ -546,6 +550,7 @@ def test_multihost_scoring_driver_matches_single_process(tmp_path):
         "--feature-shard-id-to-feature-section-keys-map",
         "global:fixedFeatures|per_user:userFeatures",
         "--offheap-indexmap-dir", idx_dir,
+        "--evaluator-type", "AUC,PRECISION@3:userId",
         "--delete-output-dir-if-exists", "true",
     ])
 
@@ -556,8 +561,13 @@ def test_multihost_scoring_driver_matches_single_process(tmp_path):
         "--feature-shard-id-to-feature-section-keys-map",
         "global:fixedFeatures|per_user:userFeatures",
         "--offheap-indexmap-dir", idx_dir,
+        "--evaluator-type", "AUC,PRECISION@3:userId",
         "--delete-output-dir-if-exists", "true",
     ])
+    # the mh scoring metrics path (incl. grouped precision) is exercised by
+    # the run above; the per-row score parity below subsumes metric parity
+    # up to evaluator determinism, checked against sp.metrics
+    assert set(sp.metrics) == {"AUC", "PRECISION_AT_K@3"}
     got = {}
     for f in sorted(os.listdir(tmp_path / "mh-scores" / "scores")):
         for rec in avro_io.read_container(str(tmp_path / "mh-scores" / "scores" / f)):
