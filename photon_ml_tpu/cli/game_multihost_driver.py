@@ -59,6 +59,7 @@ from photon_ml_tpu.parallel.perhost_ingest import (
     csr_to_padded,
     global_row_layout,
     host_file_share,
+    merge_group_ids,
     merge_row_vectors,
     per_host_re_dataset,
 )
@@ -491,30 +492,6 @@ def _save_random_effect_parts(out, name, p, dc, coord, w, imap, mh):
 
 
 
-def merge_group_ids(gds, file_base, n_rows, id_name, ctx, mh):
-    """Globally consistent dense group ids for grouped evaluators: each
-    host hashes ITS rows' raw ids (64-bit stable keys), the (hi, lo) int32
-    vectors merge exactly with one collective sum each, and every host
-    ranks the identical reconstructed keys into dense int32 groups."""
-    from photon_ml_tpu.parallel.perhost_ingest import _pack_u64, _unpack_u64
-    from photon_ml_tpu.parallel.shuffle import stable_entity_keys
-
-    hi_l = np.zeros(n_rows, np.int32)
-    lo_l = np.zeros(n_rows, np.int32)
-    for ordinal, gd in gds:
-        vocab = gd.id_vocabs[id_name]
-        keys = stable_entity_keys([vocab[i] for i in gd.ids[id_name]])
-        hi, lo = _pack_u64(keys)
-        ids = file_base[ordinal] + np.arange(gd.num_rows)
-        hi_l[ids] = hi
-        lo_l[ids] = lo
-    hi_g = collective_sum(hi_l, ctx, mh.num_processes).astype(np.int32)
-    lo_g = collective_sum(lo_l, ctx, mh.num_processes).astype(np.int32)
-    keys_g = _unpack_u64(hi_g, lo_g)
-    _, dense = np.unique(keys_g, return_inverse=True)
-    return dense.astype(np.int32)
-
-
 def _validate(p, mh, ctx, shard_maps, needed_shards, id_types, coords,
               result, logger):
     """Validation metrics under multihost: each host decodes only its slice
@@ -608,7 +585,7 @@ def _validate(p, mh, ctx, shard_maps, needed_shards, id_types, coords,
     s = jnp.asarray(scores.astype(np.float32))
     # one hash-merge per distinct id column, shared across evaluators
     group_cols = {
-        idn: jnp.asarray(merge_group_ids(vgds, file_base, nv, idn, ctx, mh))
+        idn: jnp.asarray(merge_group_ids(vgds, file_base, nv, idn, ctx, mh.num_processes))
         for idn in grouped_ids
     }
     for etype, k, id_name in specs:

@@ -13,8 +13,12 @@ analogue). This is how a "hundreds of billions of coefficients" model
 (reference README.md:73) is SCORED: coefficients stay sharded end to end
 — loaded sharded, stored sharded, applied sharded.
 
-Scope (v1): AVRO inputs, prebuilt feature maps (--offheap-indexmap-dir),
-fixed + plain random-effect coordinates (no factored/MF models).
+Factored/MF models score latent-native: the shared (k, D) matrix is
+replicated (it is tiny), each host loads its share of the latent-factor
+part files, rows are projected into the k-dim latent space host-side and
+routed exactly like a plain random effect in a k-dim feature space.
+
+Scope (v1): AVRO inputs, prebuilt feature maps (--offheap-indexmap-dir).
 
 Run (one process per host):
 
@@ -121,22 +125,23 @@ def main(argv: Optional[List[str]] = None) -> dict:
             fixed.append((name, f.read().strip()))
     for name in layout[model_io.RANDOM_EFFECT]:
         base = os.path.join(p.game_model_input_dir, model_io.RANDOM_EFFECT, name)
-        if model_io.is_factored_random_effect(p.game_model_input_dir, name):
-            raise ValueError(
-                f"multihost scoring v1 does not support factored models ({name})"
-            )
         with open(os.path.join(base, model_io.ID_INFO)) as f:
             lines = f.read().splitlines()
-        random.append((name, lines[0], lines[1] if len(lines) > 1 else ""))
+        random.append((
+            name, lines[0], lines[1] if len(lines) > 1 else "",
+            model_io.is_factored_random_effect(p.game_model_input_dir, name),
+        ))
 
     from photon_ml_tpu.io.offheap import load_shard_index_map
 
-    shards = sorted({s for _, s in fixed if s} | {s for _, _, s in random if s})
+    shards = sorted(
+        {s for _, s in fixed if s} | {s for _, _, s, _ in random if s}
+    )
     shard_maps = {s: load_shard_index_map(p.offheap_indexmap_dir, s) for s in shards}
     grouped_ids = sorted({idn for _, _, idn in (p.evaluators or []) if idn})
     id_types = sorted(
         set(p.random_effect_id_types)
-        | {rid for _, rid, _ in random if rid}
+        | {rid for _, rid, _, _ in random if rid}
         | set(grouped_ids)
     )
 
@@ -189,14 +194,84 @@ def main(argv: Optional[List[str]] = None) -> dict:
         scores += collective_sum(local, ctx, mh.num_processes)
 
     # ---- random effects: per-host model parts -> owner slabs -> routing ---
-    for name, re_id, shard in random:
+    for name, re_id, shard, factored in random:
+        if factored:
+            # latent-native: v_e (k,) per entity + shared (k, D) matrix.
+            # Each host loads its share of the latent-factor part files;
+            # the tiny matrix is replicated and rows are PROJECTED into the
+            # k-dim latent space host-side before routing — after that the
+            # scoring math is identical to a plain RE in a k-dim space.
+            fbase = os.path.join(
+                p.game_model_input_dir, model_io.RANDOM_EFFECT, name,
+            )
+            _, matrix, _, _ = model_io.load_factored_random_effect(
+                p.game_model_input_dir, name
+            )
+            matrix_aligned = model_io.aligned_latent_matrix(
+                p.game_model_input_dir, name, shard_maps[shard],
+                matrix, warn=logger.warn,
+            )
+            lat_dir = os.path.join(fbase, model_io.LATENT_FACTORS)
+            parts = sorted(f for f in os.listdir(lat_dir) if f.endswith(".avro"))
+            my_parts = [f for f, _ in host_file_share(
+                parts, mh.num_processes, mh.process_id
+            )]
+            ids, vecs = [], []
+            for f in my_parts:
+                for rec in avro_io.read_container(os.path.join(lat_dir, f)):
+                    ids.append(rec["effectId"])
+                    vecs.append(np.asarray(rec["latentFactor"], np.float32))
+            k_lat = matrix.shape[0]
+            fv_m = (np.stack(vecs) if vecs
+                    else np.zeros((0, k_lat), np.float32))
+            fi_m = np.tile(np.arange(k_lat, dtype=np.int32), (len(ids), 1))
+            logger.info(
+                f"factored effect {name!r}: host {mh.process_id} loaded "
+                f"{len(ids)} latent factors "
+                f"({len(my_parts)}/{len(parts)} part files)"
+            )
+            sd, w = per_host_model_slabs(
+                ids, fi_m, fv_m, k_lat, ctx, mh.num_processes, mh.process_id,
+            )
+            vparts = []
+            for ordinal, gd in gds:
+                f = gd.shards[shard]
+                fi, fv = csr_to_padded(f, gd.num_rows)
+                # xp = x @ M^T via the padded sparse encoding, accumulated
+                # one padded column at a time: O(n*k) memory (a (k, n, K)
+                # gather would be k*n*K floats — the memory-scaling the
+                # driver exists to avoid). csr_to_padded zero-fills padding
+                # values, so masked-column contributions are exact 0s.
+                xp = np.zeros((gd.num_rows, matrix_aligned.shape[0]), np.float32)
+                for j in range(fi.shape[1]):
+                    xp += fv[:, j, None] * matrix_aligned[:, np.maximum(fi[:, j], 0)].T
+                vocab = gd.id_vocabs[re_id]
+                vparts.append(HostRows(
+                    entity_raw_ids=[vocab[i] for i in gd.ids[re_id]],
+                    row_index=file_base[ordinal]
+                    + np.arange(gd.num_rows, dtype=np.int64),
+                    labels=np.nan_to_num(gd.response).astype(np.float32),
+                    weights=gd.weight.astype(np.float32),
+                    offsets=gd.offset.astype(np.float32),
+                    feat_idx=np.tile(
+                        np.arange(k_lat, dtype=np.int32), (gd.num_rows, 1)
+                    ),
+                    feat_val=xp.astype(np.float32),
+                    global_dim=k_lat,
+                ))
+            vrows = concat_host_rows(vparts, k_lat)
+            scores += score_routed_rows(
+                sd, w, vrows, n_global, ctx, mh.num_processes, mh.process_id
+            )
+            continue
         base = os.path.join(
             p.game_model_input_dir, model_io.RANDOM_EFFECT, name,
             model_io.COEFFICIENTS,
         )
         parts = sorted(f for f in os.listdir(base) if f.endswith(".avro"))
-        my_parts = [f for i, f in enumerate(parts)
-                    if i % mh.num_processes == mh.process_id]
+        my_parts = [f for f, _ in host_file_share(
+            parts, mh.num_processes, mh.process_id
+        )]
         ids, fi_m, fv_m = _load_re_model_rows(base, my_parts, shard_maps[shard])
         logger.info(
             f"random effect {name!r}: host {mh.process_id} loaded "
@@ -258,14 +333,14 @@ def main(argv: Optional[List[str]] = None) -> dict:
     # ---- optional evaluators (replicated labels/weights) ------------------
     metrics: Dict[str, float] = {}
     if p.evaluators:
-        from photon_ml_tpu.cli.game_multihost_driver import merge_group_ids
         from photon_ml_tpu.evaluation.evaluators import evaluator_for
+        from photon_ml_tpu.parallel.perhost_ingest import merge_group_ids
 
         labels = merge(lambda gd: gd.response.astype(np.float32))
         weights = merge(lambda gd: gd.weight.astype(np.float32))
         group_cols = {
             idn: jnp.asarray(merge_group_ids(
-                gds, file_base, n_global, idn, ctx, mh
+                gds, file_base, n_global, idn, ctx, mh.num_processes
             ))
             for idn in grouped_ids
         }

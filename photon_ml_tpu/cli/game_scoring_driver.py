@@ -237,41 +237,11 @@ class GameScoringDriver:
                 factors, matrix, _, _ = model_io.load_factored_random_effect(
                     p.game_model_input_dir, name
                 )
-                train_keys = model_io.load_latent_matrix_feature_keys(
-                    p.game_model_input_dir, name
+                matrix_aligned = model_io.aligned_latent_matrix(
+                    p.game_model_input_dir, name,
+                    self.shard_index_maps[shard], matrix,
+                    warn=self.logger.warn,
                 )
-                imap = self.shard_index_maps[shard]
-                if train_keys is None:
-                    if len(imap) != matrix.shape[1]:
-                        raise ValueError(
-                            f"factored model {name!r} predates the "
-                            "latent-matrix feature binding and this run's "
-                            f"index map has {len(imap)} features vs the "
-                            f"matrix's {matrix.shape[1]} columns — cannot "
-                            "align; rebuild the model or pass the training "
-                            "offheap index maps"
-                        )
-                    self.logger.warning(
-                        f"factored model {name!r} has no latent-matrix "
-                        "feature binding: assuming this run's index map "
-                        "matches the training map POSITIONALLY (same size "
-                        "only proves length, not order) — scores are wrong "
-                        "if the feature sets differ; rebuild the model to "
-                        "get the binding"
-                    )
-                    matrix_aligned = matrix.astype(np.float32)
-                else:
-                    matrix_aligned = np.zeros(
-                        (matrix.shape[0], len(imap)), np.float32
-                    )
-                    for j, key in enumerate(train_keys):
-                        tgt = imap.get_index(key)
-                        if tgt < 0 and key.endswith("\x01"):
-                            # empty-term fallback, e.g. the (INTERCEPT)
-                            # pseudo-feature stored without a delimiter
-                            tgt = imap.get_index(key[:-1])
-                        if tgt >= 0:
-                            matrix_aligned[:, tgt] = matrix[:, j]
                 latent, ent_pos, matched = _entity_positions(
                     vocab, factors, data.ids[re_id], matrix.shape[0]
                 )

@@ -353,3 +353,42 @@ def list_game_model(input_dir: str) -> Dict[str, List[str]]:
         if os.path.isdir(d):
             out[kind] = sorted(os.listdir(d))
     return out
+
+
+def aligned_latent_matrix(input_dir: str, name: str, index_map: IndexMap,
+                          matrix: np.ndarray,
+                          warn=None) -> np.ndarray:
+    """Realign a factored model's (k, D_train) latent matrix columns to the
+    CURRENT index map by feature NAME (the columns are positional in the
+    training feature space; a scoring run may have rebuilt its map). Falls
+    back to positional when the model predates the binding file — warns
+    when that assumption is unprovable."""
+    train_keys = load_latent_matrix_feature_keys(input_dir, name)
+    if train_keys is None:
+        if len(index_map) != matrix.shape[1]:
+            raise ValueError(
+                f"factored model {name!r} predates the latent-matrix "
+                f"feature binding and this run's index map has "
+                f"{len(index_map)} features vs the matrix's "
+                f"{matrix.shape[1]} columns — cannot align; rebuild the "
+                "model or pass the training offheap index maps"
+            )
+        if warn is not None:
+            warn(
+                f"factored model {name!r} has no latent-matrix feature "
+                "binding: assuming this run's index map matches the "
+                "training map POSITIONALLY (same size only proves length, "
+                "not order) — scores are wrong if the feature sets differ; "
+                "rebuild the model to get the binding"
+            )
+        return matrix.astype(np.float32)
+    aligned = np.zeros((matrix.shape[0], len(index_map)), np.float32)
+    for j, key in enumerate(train_keys):
+        tgt = index_map.get_index(key)
+        if tgt < 0 and key.endswith(DELIMITER):
+            # empty-term fallback, e.g. the (INTERCEPT) pseudo-feature
+            # stored without a delimiter
+            tgt = index_map.get_index(key[: -len(DELIMITER)])
+        if tgt >= 0:
+            aligned[:, tgt] = matrix[:, j]
+    return aligned

@@ -846,3 +846,25 @@ def merge_row_vectors(decoded, file_base: np.ndarray, n_global: int,
     for ordinal, gd in decoded:
         local[file_base[ordinal] + np.arange(gd.num_rows)] = vec_per_gd(gd)
     return collective_sum(local, ctx, num_processes)
+
+
+def merge_group_ids(gds, file_base, n_rows, id_name, ctx,
+                    num_processes: int):
+    """Globally consistent dense group ids for grouped evaluators: each
+    host hashes ITS rows' raw ids (64-bit stable keys), the (hi, lo) int32
+    vectors merge exactly with one collective sum each, and every host
+    ranks the identical reconstructed keys into dense int32 groups."""
+    hi_l = np.zeros(n_rows, np.int32)
+    lo_l = np.zeros(n_rows, np.int32)
+    for ordinal, gd in gds:
+        vocab = gd.id_vocabs[id_name]
+        keys = stable_entity_keys([vocab[i] for i in gd.ids[id_name]])
+        hi, lo = _pack_u64(keys)
+        ids = file_base[ordinal] + np.arange(gd.num_rows)
+        hi_l[ids] = hi
+        lo_l[ids] = lo
+    hi_g = collective_sum(hi_l, ctx, num_processes).astype(np.int32)
+    lo_g = collective_sum(lo_l, ctx, num_processes).astype(np.int32)
+    keys_g = _unpack_u64(hi_g, lo_g)
+    _, dense = np.unique(keys_g, return_inverse=True)
+    return dense.astype(np.int32)

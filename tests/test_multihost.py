@@ -506,7 +506,8 @@ def test_multihost_scoring_driver_matches_single_process(tmp_path):
         launcher = (
             "import jax; jax.config.update('jax_platforms','cpu'); "
             f"from photon_ml_tpu.cli.{module} import main; "
-            "import sys; main(sys.argv[1:])"
+            "import sys, json; res = main(sys.argv[1:]); "
+            "print('MHRES', json.dumps(res.get('metrics') or {}))"
         )
         procs = []
         for pid in range(2):
@@ -520,9 +521,18 @@ def test_multihost_scoring_driver_matches_single_process(tmp_path):
                 stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
                 cwd=REPO, env=env,
             ))
+        import json as _json
+
+        all_metrics = []
         for pr in procs:
             out, err = pr.communicate(timeout=600)
             assert pr.returncode == 0, f"{module} failed:\n{out[-1200:]}\n{err[-2500:]}"
+            all_metrics.extend(
+                _json.loads(line.split("MHRES ", 1)[1])
+                for line in out.splitlines()
+                if line.startswith("MHRES")
+            )
+        return all_metrics
 
     launch("game_multihost_driver", [
         "--output-dir", str(tmp_path / "model"),
@@ -543,7 +553,7 @@ def test_multihost_scoring_driver_matches_single_process(tmp_path):
         "--delete-output-dir-if-exists", "true",
     ])
 
-    launch("game_multihost_scoring_driver", [
+    mh_run_metrics = launch("game_multihost_scoring_driver", [
         "--input-dirs", str(tmp_path / "score-in"),
         "--game-model-input-dir", str(tmp_path / "model" / "best"),
         "--output-dir", str(tmp_path / "mh-scores"),
@@ -564,14 +574,156 @@ def test_multihost_scoring_driver_matches_single_process(tmp_path):
         "--evaluator-type", "AUC,PRECISION@3:userId",
         "--delete-output-dir-if-exists", "true",
     ])
-    # the mh scoring metrics path (incl. grouped precision) is exercised by
-    # the run above; the per-row score parity below subsumes metric parity
-    # up to evaluator determinism, checked against sp.metrics
+    # mh metrics (incl. the GROUPED precision over hash-merged ids) must
+    # equal the single-process scorer's
     assert set(sp.metrics) == {"AUC", "PRECISION_AT_K@3"}
+    assert mh_run_metrics and mh_run_metrics[0].keys() == sp.metrics.keys()
+    for key, val in sp.metrics.items():
+        assert mh_run_metrics[0][key] == pytest.approx(val, abs=2e-3), key
     got = {}
     for f in sorted(os.listdir(tmp_path / "mh-scores" / "scores")):
         for rec in avro_io.read_container(str(tmp_path / "mh-scores" / "scores" / f)):
             got[int(rec["uid"])] = rec["predictionScore"]
     assert len(got) == len(sp.scores)
+    mh_scores = np.asarray([got[r] for r in range(len(sp.scores))])
+    np.testing.assert_allclose(mh_scores, sp.scores, rtol=2e-4, atol=2e-5)
+
+
+@pytest.mark.slow
+def test_multihost_scoring_factored_model(tmp_path):
+    """Latent-native SPMD scoring of a factored/MF model: the matrix is
+    replicated, latent factors route to owners, rows are projected into
+    the latent space before routing — scores match the single-process
+    scorer on the same model."""
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import sys as _sys
+
+    _sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    import numpy as np
+
+    from game_test_utils import make_glmix_data
+    from photon_ml_tpu.cli import (
+        feature_indexing,
+        game_scoring_driver,
+        game_training_driver,
+    )
+    from photon_ml_tpu.io import avro as avro_io
+    from photon_ml_tpu.io import schemas
+
+    rng = np.random.default_rng(44)
+    data, _ = make_glmix_data(
+        rng, num_users=12, rows_per_user_range=(8, 16), d_fixed=4, d_random=3
+    )
+    schema = {
+        "name": "MhFacAvro", "type": "record", "namespace": "t",
+        "fields": [
+            {"name": "label", "type": "double"},
+            {"name": "fixedFeatures",
+             "type": {"type": "array", "items": schemas.FEATURE}},
+            {"name": "userFeatures",
+             "type": {"type": "array",
+                      "items": "com.linkedin.photon.avro.generated.FeatureAvro"}},
+            {"name": "metadataMap",
+             "type": ["null", {"type": "map", "values": "string"}],
+             "default": None},
+        ],
+    }
+    ff, uf = data.shards["global"], data.shards["per_user"]
+    vocab = data.id_vocabs["userId"]
+
+    def feats(f, r):
+        s, e = f.indptr[r], f.indptr[r + 1]
+        return [{"name": f"c{j}", "term": "", "value": float(v)}
+                for j, v in zip(f.indices[s:e], f.values[s:e])]
+
+    def write_parts(dirpath, row_range, n_parts):
+        dirpath.mkdir()
+        bounds = np.linspace(
+            row_range.start, row_range.stop, n_parts + 1
+        ).astype(int)
+        for pi in range(n_parts):
+            avro_io.write_container(
+                str(dirpath / f"part-{pi}.avro"),
+                ({"label": float(data.response[r]),
+                  "fixedFeatures": feats(ff, r),
+                  "userFeatures": feats(uf, r),
+                  "metadataMap": {"userId": vocab[data.ids["userId"][r]]}}
+                 for r in range(bounds[pi], bounds[pi + 1])),
+                schema,
+            )
+
+    n = data.num_rows
+    write_parts(tmp_path / "train", range(0, int(n * 0.8)), 2)
+    write_parts(tmp_path / "score-in", range(int(n * 0.8), n), 2)
+    idx_dir = str(tmp_path / "index")
+    feature_indexing.main([
+        "--data-input-dirs", str(tmp_path / "train"),
+        "--output-dir", idx_dir, "--partition-num", "1",
+        "--feature-shard-id-to-feature-section-keys-map",
+        "global:fixedFeatures|per_user:userFeatures",
+    ])
+
+    # train a model WITH a factored coordinate (single-process driver)
+    game_training_driver.main([
+        "--train-input-dirs", str(tmp_path / "train"),
+        "--task-type", "LOGISTIC_REGRESSION",
+        "--output-dir", str(tmp_path / "model"),
+        "--updating-sequence", "fixed,mf",
+        "--feature-shard-id-to-feature-section-keys-map",
+        "global:fixedFeatures|per_user:userFeatures",
+        "--fixed-effect-optimization-configurations",
+        "fixed:25,1e-9,0.1,1,LBFGS,L2",
+        "--fixed-effect-data-configurations", "fixed:global,2",
+        "--random-effect-data-configurations",
+        "mf:userId,per_user,2,-1,0,-1,IDENTITY",
+        "--factored-random-effect-optimization-configurations",
+        "mf:20,1e-8,0.5,1,LBFGS,l2:20,1e-8,0.5,1,LBFGS,l2:2,2",
+        "--num-iterations", "1",
+        "--offheap-indexmap-dir", idx_dir,
+        "--delete-output-dir-if-exists", "true",
+    ])
+
+    score_flags = [
+        "--input-dirs", str(tmp_path / "score-in"),
+        "--game-model-input-dir", str(tmp_path / "model" / "best"),
+        "--feature-shard-id-to-feature-section-keys-map",
+        "global:fixedFeatures|per_user:userFeatures",
+        "--offheap-indexmap-dir", idx_dir,
+        "--delete-output-dir-if-exists", "true",
+    ]
+    port = _free_port()
+    launcher = (
+        "import jax; jax.config.update('jax_platforms','cpu'); "
+        "from photon_ml_tpu.cli.game_multihost_scoring_driver import main; "
+        "import sys; main(sys.argv[1:])"
+    )
+    procs = []
+    for pid in range(2):
+        env = dict(os.environ)
+        env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+        procs.append(subprocess.Popen(
+            [sys.executable, "-c", launcher,
+             "--multihost-coordinator", f"127.0.0.1:{port}",
+             "--multihost-num-processes", "2",
+             "--multihost-process-id", str(pid),
+             "--output-dir", str(tmp_path / "mh-scores")] + score_flags,
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+            cwd=REPO, env=env,
+        ))
+    for pr in procs:
+        out, err = pr.communicate(timeout=600)
+        assert pr.returncode == 0, f"mh factored scoring failed:\n{err[-2500:]}"
+
+    sp = game_scoring_driver.main(
+        ["--output-dir", str(tmp_path / "sp-scores")] + score_flags
+    )
+    got = {}
+    for f in sorted(os.listdir(tmp_path / "mh-scores" / "scores")):
+        for rec in avro_io.read_container(
+            str(tmp_path / "mh-scores" / "scores" / f)
+        ):
+            got[int(rec["uid"])] = rec["predictionScore"]
     mh_scores = np.asarray([got[r] for r in range(len(sp.scores))])
     np.testing.assert_allclose(mh_scores, sp.scores, rtol=2e-4, atol=2e-5)
