@@ -204,9 +204,9 @@ def main(argv: Optional[List[str]] = None) -> dict:
             fbase = os.path.join(
                 p.game_model_input_dir, model_io.RANDOM_EFFECT, name,
             )
-            _, matrix, _, _ = model_io.load_factored_random_effect(
-                p.game_model_input_dir, name
-            )
+            # ONLY the tiny matrix is loaded whole; the per-entity latent
+            # factors are read per host below (sharded end to end)
+            matrix = model_io.load_latent_matrix(p.game_model_input_dir, name)
             matrix_aligned = model_io.aligned_latent_matrix(
                 p.game_model_input_dir, name, shard_maps[shard],
                 matrix, warn=logger.warn,

@@ -295,6 +295,15 @@ def save_factored_random_effect(
             _json.dump({"columns": pairs}, f)
 
 
+def load_latent_matrix(input_dir: str, name: str) -> np.ndarray:
+    """ONLY the shared (k, D) latent matrix — what SPMD scoring replicates;
+    the per-entity factors stay in their part files for per-host loading."""
+    rows = load_latent_factors(
+        os.path.join(input_dir, RANDOM_EFFECT, name, LATENT_MATRIX)
+    )
+    return np.stack([rows[str(k)] for k in range(len(rows))])
+
+
 def load_factored_random_effect(input_dir: str, name: str
                                 ) -> Tuple[Dict[str, np.ndarray], np.ndarray, str, str]:
     """Returns (entity latent factors, (k, D_global) matrix, reId, shard)."""

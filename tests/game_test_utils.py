@@ -230,3 +230,46 @@ def make_full_game_coords(
             ),
         ),
     }
+
+
+
+def launch_multihost(module: str, args, n_processes: int = 2,
+                     result_expr: str = "", timeout: int = 600):
+    """Run a multihost CLI module as n SPMD subprocesses on localhost
+    (4 virtual CPU devices each) and return their stdouts. ``result_expr``
+    is an optional print statement appended after main() (e.g. to emit a
+    tagged JSON line the caller parses)."""
+    import os
+    import socket
+    import subprocess
+    import sys
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    launcher = (
+        "import jax; jax.config.update('jax_platforms','cpu'); "
+        f"from photon_ml_tpu.cli.{module} import main; "
+        "import sys, json; res = main(sys.argv[1:]); " + (result_expr or "pass")
+    )
+    procs = []
+    for pid in range(n_processes):
+        env = dict(os.environ)
+        env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+        procs.append(subprocess.Popen(
+            [sys.executable, "-c", launcher,
+             "--multihost-coordinator", f"127.0.0.1:{port}",
+             "--multihost-num-processes", str(n_processes),
+             "--multihost-process-id", str(pid)] + list(args),
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+            cwd=repo, env=env,
+        ))
+    outs = []
+    for p in procs:
+        out, err = p.communicate(timeout=timeout)
+        assert p.returncode == 0, (
+            f"{module} failed:\n{out[-1200:]}\n{err[-2500:]}"
+        )
+        outs.append(out)
+    return outs

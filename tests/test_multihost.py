@@ -325,35 +325,16 @@ def test_multihost_game_driver_matches_single_process(tmp_path):
         "--delete-output-dir-if-exists", "true",
     ]
 
-    launcher = (
-        "import jax; jax.config.update('jax_platforms','cpu'); "
-        "from photon_ml_tpu.cli.game_multihost_driver import main; "
-        "import sys, json; res = main(sys.argv[1:]); "
-        "print('MHVAL', json.dumps(res['validation_metrics']))"
-    )
+    from game_test_utils import launch_multihost
 
     def launch(extra):
-        port = _free_port()
-        procs = []
-        for pid in range(2):
-            env = dict(os.environ)
-            env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
-            procs.append(subprocess.Popen(
-                [sys.executable, "-c", launcher,
-                 "--multihost-coordinator", f"127.0.0.1:{port}",
-                 "--multihost-num-processes", "2",
-                 "--multihost-process-id", str(pid),
-                 "--output-dir", str(tmp_path / "mh-out")] + flags + extra,
-                stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
-                cwd=REPO, env=env,
-            ))
-        outs = []
-        for p in procs:
-            out, err = p.communicate(timeout=600)
-            assert p.returncode == 0, f"mh driver failed:\n{out[-1500:]}\n{err[-2500:]}"
-            outs.append(out)
         import json as _json
 
+        outs = launch_multihost(
+            "game_multihost_driver",
+            ["--output-dir", str(tmp_path / "mh-out")] + flags + extra,
+            result_expr="print('MHVAL', json.dumps(res['validation_metrics']))",
+        )
         return [
             _json.loads(line.split("MHVAL ", 1)[1])
             for o in outs
@@ -501,37 +482,23 @@ def test_multihost_scoring_driver_matches_single_process(tmp_path):
         "global:fixedFeatures|per_user:userFeatures",
     ])
 
+    from game_test_utils import launch_multihost
+
     def launch(module, extra):
-        port = _free_port()
-        launcher = (
-            "import jax; jax.config.update('jax_platforms','cpu'); "
-            f"from photon_ml_tpu.cli.{module} import main; "
-            "import sys, json; res = main(sys.argv[1:]); "
-            "print('MHRES', json.dumps(res.get('metrics') or {}))"
-        )
-        procs = []
-        for pid in range(2):
-            env = dict(os.environ)
-            env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
-            procs.append(subprocess.Popen(
-                [sys.executable, "-c", launcher,
-                 "--multihost-coordinator", f"127.0.0.1:{port}",
-                 "--multihost-num-processes", "2",
-                 "--multihost-process-id", str(pid)] + extra,
-                stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
-                cwd=REPO, env=env,
-            ))
         import json as _json
 
-        all_metrics = []
-        for pr in procs:
-            out, err = pr.communicate(timeout=600)
-            assert pr.returncode == 0, f"{module} failed:\n{out[-1200:]}\n{err[-2500:]}"
-            all_metrics.extend(
-                _json.loads(line.split("MHRES ", 1)[1])
-                for line in out.splitlines()
-                if line.startswith("MHRES")
-            )
+        outs = launch_multihost(
+            module, extra,
+            result_expr="print('MHRES', json.dumps(res.get('metrics') or {}))",
+        )
+        all_metrics = [
+            _json.loads(line.split("MHRES ", 1)[1])
+            for o in outs
+            for line in o.splitlines()
+            if line.startswith("MHRES")
+        ]
+        # every host must compute the identical metrics (SPMD determinism)
+        assert all(m == all_metrics[0] for m in all_metrics[1:])
         return all_metrics
 
     launch("game_multihost_driver", [
@@ -693,28 +660,12 @@ def test_multihost_scoring_factored_model(tmp_path):
         "--offheap-indexmap-dir", idx_dir,
         "--delete-output-dir-if-exists", "true",
     ]
-    port = _free_port()
-    launcher = (
-        "import jax; jax.config.update('jax_platforms','cpu'); "
-        "from photon_ml_tpu.cli.game_multihost_scoring_driver import main; "
-        "import sys; main(sys.argv[1:])"
+    from game_test_utils import launch_multihost
+
+    launch_multihost(
+        "game_multihost_scoring_driver",
+        ["--output-dir", str(tmp_path / "mh-scores")] + score_flags,
     )
-    procs = []
-    for pid in range(2):
-        env = dict(os.environ)
-        env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
-        procs.append(subprocess.Popen(
-            [sys.executable, "-c", launcher,
-             "--multihost-coordinator", f"127.0.0.1:{port}",
-             "--multihost-num-processes", "2",
-             "--multihost-process-id", str(pid),
-             "--output-dir", str(tmp_path / "mh-scores")] + score_flags,
-            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
-            cwd=REPO, env=env,
-        ))
-    for pr in procs:
-        out, err = pr.communicate(timeout=600)
-        assert pr.returncode == 0, f"mh factored scoring failed:\n{err[-2500:]}"
 
     sp = game_scoring_driver.main(
         ["--output-dir", str(tmp_path / "sp-scores")] + score_flags
