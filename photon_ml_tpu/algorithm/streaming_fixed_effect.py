@@ -1,0 +1,141 @@
+"""Out-of-core fixed-effect coordinate for GAME coordinate descent.
+
+Extends the GLM driver's --streaming-chunk-rows mechanism (optim/
+streaming.py — the StorageLevel MEMORY_AND_DISK/DISK_ONLY answer) to the
+GAME fixed-effect coordinate: the FE batch lives in mmap'd row chunks,
+each optimizer evaluation streams them through the chunked
+value+gradient accumulation, and scoring streams margins chunk by chunk.
+Residual offsets fold per chunk (rows are contiguous in chunk order, so a
+chunk's residual block is a slice of the global (N,) vector — the
+addScoresToOffsets of Coordinate.scala:43-49, chunked).
+
+Drop-in for CoordinateDescent (update/score/initial_coefficients/
+regularization_term); cd_jit=False — the orchestrator must call it raw
+(each evaluation re-enters the host to stream).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from photon_ml_tpu.ops.normalization import NormalizationContext
+from photon_ml_tpu.optim.common import OptResult
+from photon_ml_tpu.optim.problem import GLMOptimizationProblem, _split_reg_weight
+from photon_ml_tpu.optim.streaming import (
+    ChunkedGLMSource,
+    lbfgs_minimize_streaming,
+    make_streaming_value_and_grad,
+)
+from photon_ml_tpu.types import OptimizerType, real_dtype
+
+Array = jax.Array
+
+
+@dataclasses.dataclass
+class StreamingFixedEffectCoordinate:
+    """Fixed-effect coordinate over a :class:`ChunkedGLMSource`."""
+
+    source: ChunkedGLMSource
+    problem: GLMOptimizationProblem
+    norm: NormalizationContext = dataclasses.field(
+        default_factory=NormalizationContext.identity
+    )
+
+    # streams per evaluation: CoordinateDescent must not wrap update/score
+    # in an outer jit (same contract as the multihost coordinates)
+    cd_jit = False
+
+    def __post_init__(self):
+        if self.problem.optimizer == OptimizerType.TRON:
+            raise ValueError(
+                "streaming fixed effect supports LBFGS/OWL-QN only (TRON's "
+                "CG would stream one full pass per Hessian-vector product)"
+            )
+        self._margin_fn = jax.jit(
+            lambda w, x: x @ self.norm.effective_coefficients(w)
+            + self.norm.margin_shift(self.norm.effective_coefficients(w))
+        )
+        # chunk sizes are static for the source's lifetime: measure once
+        # (for mmap'd .npy chunks len() reads only the header)
+        self._chunk_sizes = [len(load()["y"]) for load in self.source.loaders]
+        # ONE jitted chunk kernel for the whole run: the residual-updated
+        # source swaps per update, but make_streaming_value_and_grad closes
+        # over objective/norm only through the jitted partial, which caches
+        # by function identity — so build it once against a MUTABLE source
+        # holder and swap the holder's loaders per update
+        self._live_source = ChunkedGLMSource(
+            loaders=list(self.source.loaders),
+            dim=self.source.dim,
+            num_rows=self.source.num_rows,
+        )
+        l1, l2 = _split_reg_weight(self.problem.regularization, None)
+        self._l1, self._l2 = float(l1), float(l2)
+        self._vg = make_streaming_value_and_grad(
+            self._live_source, self.problem.objective, self.norm,
+            l2_weight=self._l2,
+        )
+
+    @property
+    def dim(self) -> int:
+        return self.source.dim
+
+    def initial_coefficients(self) -> Array:
+        return jnp.zeros((self.dim,), real_dtype())
+
+    def _residual_source(self, residual_offsets) -> ChunkedGLMSource:
+        """Chunk view with the residuals folded into offsets (chunk rows
+        are contiguous in source order, so each chunk takes a slice)."""
+        resid = np.asarray(residual_offsets)
+        loaders = []
+        lo = 0
+        for load, n_here in zip(self.source.loaders, self._chunk_sizes):
+            def wrap(load=load, lo=lo, n_c=n_here):
+                chunk = dict(load())
+                base = np.asarray(
+                    chunk.get("offsets", np.zeros(n_c, np.float32))
+                )
+                chunk["offsets"] = base + resid[lo : lo + n_c]
+                return chunk
+
+            loaders.append(wrap)
+            lo += n_here
+        return ChunkedGLMSource(
+            loaders=loaders, dim=self.source.dim, num_rows=self.source.num_rows
+        )
+
+    def update(self, residual_offsets: Array, init_coefficients: Array
+               ) -> Tuple[Array, OptResult]:
+        # swap the live source's loaders to the residual view; the jitted
+        # chunk kernel built once in __post_init__ is reused across updates
+        self._live_source.loaders = self._residual_source(
+            residual_offsets
+        ).loaders
+        bounds = (
+            (self.problem.constraints.lower, self.problem.constraints.upper)
+            if self.problem.constraints is not None
+            else None
+        )
+        res = lbfgs_minimize_streaming(
+            self._vg, jnp.asarray(init_coefficients, real_dtype()),
+            self.problem.optimizer_config, l1_weight=self._l1, bounds=bounds,
+        )
+        return res.coefficients, res
+
+    def score(self, coefficients: Array) -> Array:
+        """(N,) raw margins, streamed chunk by chunk (no offsets — GAME
+        scores are additive margin contributions, FixedEffectModel.scala:
+        91-100)."""
+        outs = []
+        for chunk in self.source.chunks():
+            x = jnp.asarray(chunk["x"], real_dtype())
+            outs.append(self._margin_fn(coefficients, x))
+        return jnp.concatenate(outs) if outs else jnp.zeros((0,), real_dtype())
+
+    def regularization_term(self, coefficients: Array) -> Array:
+        return self.problem.regularization_term_value(coefficients)

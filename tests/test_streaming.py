@@ -17,6 +17,7 @@ from photon_ml_tpu.ops.normalization import NormalizationContext
 from photon_ml_tpu.ops.objective import GLMBatch, GLMObjective
 from photon_ml_tpu.optim.common import OptimizerConfig
 from photon_ml_tpu.optim.lbfgs import lbfgs_minimize_
+from photon_ml_tpu.ops.regularization import RegularizationContext
 from photon_ml_tpu.optim.streaming import (
     ChunkedGLMSource,
     lbfgs_minimize_streaming,
@@ -127,3 +128,104 @@ class TestStreamingLBFGS:
             np.asarray(st_disk.coefficients), np.asarray(st_mem.coefficients),
             rtol=1e-6,
         )
+
+
+class TestStreamingFixedEffectCoordinate:
+    def test_game_descent_with_streaming_fe(self, tmp_path):
+        """Coordinate descent with an OUT-OF-CORE fixed effect (chunked
+        batch on disk) must reproduce the in-memory two-coordinate descent:
+        objectives and final scores."""
+        from game_test_utils import make_glmix_data
+
+        from photon_ml_tpu.algorithm import (
+            CoordinateDescent,
+            FixedEffectCoordinate,
+            RandomEffectCoordinate,
+        )
+        from photon_ml_tpu.algorithm.streaming_fixed_effect import (
+            StreamingFixedEffectCoordinate,
+        )
+        from photon_ml_tpu.data.game import (
+            RandomEffectDataConfig,
+            build_fixed_effect_batch,
+            build_random_effect_dataset,
+        )
+        from photon_ml_tpu.optim.problem import GLMOptimizationProblem
+        from photon_ml_tpu.optim.streaming import (
+            ChunkedGLMSource,
+            write_chunk_files,
+        )
+        from photon_ml_tpu.types import OptimizerType, TaskType
+
+        rng = np.random.default_rng(23)
+        data, _ = make_glmix_data(
+            rng, num_users=15, rows_per_user_range=(10, 20), d_fixed=5, d_random=3
+        )
+        labels = jnp.asarray(data.response)
+        loss_fn = lambda s: jnp.sum(losses.logistic.loss(s, labels))
+        cfg = OptimizerConfig(max_iterations=25, tolerance=1e-9)
+        problem = GLMOptimizationProblem(
+            TaskType.LOGISTIC_REGRESSION, OptimizerType.LBFGS, cfg,
+            RegularizationContext.l2(0.1),
+        )
+
+        def re_coord():
+            return RandomEffectCoordinate(
+                build_random_effect_dataset(
+                    data, RandomEffectDataConfig("userId", "per_user")
+                ),
+                TaskType.LOGISTIC_REGRESSION, OptimizerType.LBFGS, cfg,
+                RegularizationContext.l2(0.3),
+            )
+
+        batch = build_fixed_effect_batch(data, "global", dense=True)
+        mem_cd = CoordinateDescent(
+            {"fe": FixedEffectCoordinate(batch, problem), "re": re_coord()},
+            loss_fn,
+        )
+        mem = mem_cd.run(num_iterations=2, num_rows=data.num_rows)
+
+        # spill the FE batch to disk chunks and stream it
+        x = np.asarray(batch.features.matrix)[: data.num_rows]
+        write_chunk_files(
+            str(tmp_path), x, data.response.astype(np.float32), 97,
+            offsets=data.offset.astype(np.float32),
+            weights=data.weight.astype(np.float32),
+        )
+        src = ChunkedGLMSource.from_chunk_dir(str(tmp_path))
+        st_cd = CoordinateDescent(
+            {"fe": StreamingFixedEffectCoordinate(src, problem),
+             "re": re_coord()},
+            loss_fn,
+        )
+        st = st_cd.run(num_iterations=2, num_rows=data.num_rows)
+
+        np.testing.assert_allclose(
+            np.asarray(st.objective_history),
+            np.asarray(mem.objective_history), rtol=1e-4,
+        )
+        np.testing.assert_allclose(
+            np.asarray(st.total_scores), np.asarray(mem.total_scores),
+            rtol=5e-3, atol=5e-4,
+        )
+
+    def test_streaming_fe_rejects_tron(self):
+        from photon_ml_tpu.algorithm.streaming_fixed_effect import (
+            StreamingFixedEffectCoordinate,
+        )
+        from photon_ml_tpu.optim.streaming import ChunkedGLMSource
+        from photon_ml_tpu.optim.problem import GLMOptimizationProblem
+        from photon_ml_tpu.types import OptimizerType, TaskType
+
+        src = ChunkedGLMSource.from_arrays(
+            np.zeros((8, 2), np.float32), np.zeros(8, np.float32), 4
+        )
+        with pytest.raises(ValueError, match="LBFGS/OWL-QN only"):
+            StreamingFixedEffectCoordinate(
+                src,
+                GLMOptimizationProblem(
+                    TaskType.LOGISTIC_REGRESSION, OptimizerType.TRON,
+                    OptimizerConfig(max_iterations=5, tolerance=1e-5),
+                    RegularizationContext.l2(0.1),
+                ),
+            )
