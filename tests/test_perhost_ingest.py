@@ -408,3 +408,60 @@ def test_perhost_composes_with_fused_cycle(glmix, ctx):
         np.asarray(fused.total_scores), np.asarray(plain.total_scores),
         rtol=1e-4, atol=1e-5,
     )
+
+
+def test_routed_scoring_cold_entities_and_features(glmix, ctx):
+    """score_routed_rows cold-start semantics (RandomEffectModel.scala:
+    129-158): rows of an entity with no model score 0; features an entity
+    never saw in training contribute 0."""
+    data = glmix
+    rows = _host_rows_from_game(data, 0, data.num_rows)
+    sd = per_host_re_dataset(rows, ctx)
+    cfg = OptimizerConfig(max_iterations=15, tolerance=1e-8)
+    solver = PerHostRandomEffectSolver(
+        sd, TaskType.LOGISTIC_REGRESSION, OptimizerType.LBFGS, cfg,
+        RegularizationContext.l2(0.3), ctx,
+    )
+    import jax.numpy as jnp
+
+    from photon_ml_tpu.parallel.perhost_ingest import score_routed_rows
+
+    w, _ = solver.update(
+        jnp.zeros((data.num_rows,), jnp.float32), solver.initial_coefficients()
+    )
+
+    d = data.shards["per_user"].dim
+    probe = HostRows(
+        entity_raw_ids=[
+            data.id_vocabs["userId"][0],   # known entity, known feature
+            "never-seen-entity",            # cold entity
+            data.id_vocabs["userId"][0],   # known entity, UNSEEN feature
+        ],
+        row_index=np.asarray([0, 1, 2], np.int64),
+        labels=np.zeros(3, np.float32),
+        weights=np.ones(3, np.float32),
+        offsets=np.zeros(3, np.float32),
+        feat_idx=np.asarray([[0], [0], [d - 1 + 0]], np.int32),
+        feat_val=np.ones((3, 1), np.float32),
+        global_dim=d + 1,  # widen so the unseen feature index is in range
+    )
+    # the unseen feature: use an index beyond anything in training
+    probe.feat_idx[2, 0] = d  # never appears in any entity's local map
+    scores = score_routed_rows(sd, w, probe, 3, ctx)
+    assert scores[1] == 0.0  # cold entity -> 0
+    assert scores[2] == 0.0  # unseen feature -> 0
+    # known entity + known feature -> exactly w[entity, local(0)]
+    from photon_ml_tpu.parallel import shuffle as sh
+    from photon_ml_tpu.parallel.perhost_ingest import _unpack_u64
+
+    key0 = sh.stable_entity_key(data.id_vocabs["userId"][0])
+    keys = np.asarray(sd.entity_keys)
+    mask = np.asarray(sd.entity_mask)
+    lanes = np.nonzero(mask)[0]
+    lane = lanes[np.nonzero(
+        _unpack_u64(keys[lanes, 0], keys[lanes, 1]) == key0
+    )[0][0]]
+    l2g = np.asarray(sd.local_to_global)[lane]
+    j = int(np.nonzero(l2g == 0)[0][0])
+    expected = float(np.asarray(w)[lane, j])
+    assert scores[0] == pytest.approx(expected, rel=1e-5)
