@@ -422,8 +422,6 @@ def test_routed_scoring_cold_entities_and_features(glmix, ctx):
         sd, TaskType.LOGISTIC_REGRESSION, OptimizerType.LBFGS, cfg,
         RegularizationContext.l2(0.3), ctx,
     )
-    import jax.numpy as jnp
-
     from photon_ml_tpu.parallel.perhost_ingest import score_routed_rows
 
     w, _ = solver.update(
@@ -441,19 +439,16 @@ def test_routed_scoring_cold_entities_and_features(glmix, ctx):
         labels=np.zeros(3, np.float32),
         weights=np.ones(3, np.float32),
         offsets=np.zeros(3, np.float32),
-        feat_idx=np.asarray([[0], [0], [d - 1 + 0]], np.int32),
+        # row 2 probes feature d — beyond every training feature, so it
+        # appears in no entity's local map
+        feat_idx=np.asarray([[0], [0], [d]], np.int32),
         feat_val=np.ones((3, 1), np.float32),
         global_dim=d + 1,  # widen so the unseen feature index is in range
     )
-    # the unseen feature: use an index beyond anything in training
-    probe.feat_idx[2, 0] = d  # never appears in any entity's local map
     scores = score_routed_rows(sd, w, probe, 3, ctx)
     assert scores[1] == 0.0  # cold entity -> 0
     assert scores[2] == 0.0  # unseen feature -> 0
     # known entity + known feature -> exactly w[entity, local(0)]
-    from photon_ml_tpu.parallel import shuffle as sh
-    from photon_ml_tpu.parallel.perhost_ingest import _unpack_u64
-
     key0 = sh.stable_entity_key(data.id_vocabs["userId"][0])
     keys = np.asarray(sd.entity_keys)
     mask = np.asarray(sd.entity_mask)
