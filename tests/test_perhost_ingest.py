@@ -29,6 +29,7 @@ from photon_ml_tpu.parallel import shuffle as sh
 from photon_ml_tpu.parallel.perhost_ingest import (
     HostRows,
     PerHostRandomEffectSolver,
+    _unpack_u64,
     per_host_re_dataset,
 )
 from photon_ml_tpu.types import OptimizerType, TaskType
