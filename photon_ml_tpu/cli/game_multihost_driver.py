@@ -339,6 +339,12 @@ def main(argv: Optional[List[str]] = None) -> dict:
             )
         else:
             dc = p.random_effect_data_configs[name]
+            if dc.projector.upper() not in ("INDEX_MAP",):
+                raise ValueError(
+                    f"multihost ingest implements the INDEX_MAP projector "
+                    f"only; coordinate {name!r} requests {dc.projector!r} — "
+                    "rejecting rather than silently substituting"
+                )
             parts = []
             for ordinal, gd in gds:
                 f = gd.shards[dc.feature_shard_id]
