@@ -228,6 +228,13 @@ def main(argv: Optional[List[str]] = None) -> dict:
             f"multihost driver v1 does not implement {unsupported} — "
             "rejecting rather than silently ignoring"
         )
+    for cname, dc in p.random_effect_data_configs.items():
+        if dc.projector.upper() != "INDEX_MAP":
+            raise ValueError(
+                f"multihost ingest implements the INDEX_MAP projector only; "
+                f"coordinate {cname!r} requests {dc.projector!r} — rejecting "
+                "rather than silently substituting"
+            )
     combo = p.config_grid()[0]
 
     # ---- feature maps: prebuilt, shared, mmap'd ---------------------------
@@ -339,12 +346,6 @@ def main(argv: Optional[List[str]] = None) -> dict:
             )
         else:
             dc = p.random_effect_data_configs[name]
-            if dc.projector.upper() not in ("INDEX_MAP",):
-                raise ValueError(
-                    f"multihost ingest implements the INDEX_MAP projector "
-                    f"only; coordinate {name!r} requests {dc.projector!r} — "
-                    "rejecting rather than silently substituting"
-                )
             parts = []
             for ordinal, gd in gds:
                 f = gd.shards[dc.feature_shard_id]
