@@ -15,6 +15,13 @@ model is bit-identical however the input files are assigned to hosts.
 Memory: a host materializes its ingested row block and its owned slab —
 never the global dataset. Peak host memory scales ~1/n_hosts (asserted by
 tests/test_multihost.py via tracemalloc).
+
+Skew note: slabs pad every entity to the GLOBAL max active-sample count,
+so set ``active_upper_bound`` on skewed entity distributions (the
+reference always caps in production for the same reason,
+RandomEffectDataSet.scala:171-200); size-bucketed per-host slabs (the
+bucketed_random_effect treatment composed with the shuffle) are the
+uncapped answer and are future work.
 """
 
 from __future__ import annotations
