@@ -89,6 +89,17 @@ class TestShufflePrimitives:
             p, sh.stable_row_priority(k1, np.arange(50, dtype=np.int64))
         )
 
+    def test_stable_key_is_not_crc_linear(self):
+        # regression: with the old dual-CRC32 key, these two same-length ids
+        # collided in the full 64-bit key (CRC32 linearity makes the salted
+        # second stream collide whenever the first does). blake2b must keep
+        # them distinct, and same-length ids must be full-width hashed.
+        assert sh.stable_entity_key("id0009685295") != sh.stable_entity_key(
+            "id0012060020"
+        )
+        ids = [f"e{i:012d}" for i in range(200_000)]
+        assert len(np.unique(sh.stable_entity_keys(ids))) == 200_000
+
     def test_balanced_owner_load_spread(self):
         rng = np.random.default_rng(3)
         counts = rng.integers(0, 100, size=256).astype(np.int64)

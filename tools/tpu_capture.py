@@ -7,7 +7,7 @@ this under ``timeout``).
 Writes:
   - tools/autotune_report.json  — per-candidate timings of the fused kernel
     race at the bench shape (and wider shapes), for kernel iteration;
-  - BENCH_SELFRUN_r03.json      — the bench JSON line, iff it ran on TPU.
+  - BENCH_SELFRUN_r05.json      — the bench JSON line, iff it ran on TPU.
 
 Usage:  python tools/tpu_capture.py             (orchestrator; no jax)
         python tools/tpu_capture.py --autotune  (phase 1, internal)
@@ -79,7 +79,7 @@ def main():
             f"({time.strftime('%Y-%m-%d %H:%M UTC', time.gmtime())}); "
             "autotune candidates in tools/autotune_report.json."
         )
-        out = os.path.join(REPO, "BENCH_SELFRUN_r04.json")
+        out = os.path.join(REPO, "BENCH_SELFRUN_r05.json")
         with open(out, "w") as f:
             json.dump(payload, f, indent=1)
         log(f"TPU capture preserved to {out}")
