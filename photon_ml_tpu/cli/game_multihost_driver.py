@@ -59,6 +59,7 @@ from photon_ml_tpu.parallel.perhost_ingest import (
     csr_to_padded,
     global_row_layout,
     host_file_share,
+    local_shards,
     merge_group_ids,
     merge_row_vectors,
     per_host_re_dataset,
@@ -478,9 +479,9 @@ def _save_random_effect_parts(out, name, p, dc, coord, w, imap, mh):
     local = {}
     for arr, field in ((w, "w"), (sd.entity_keys, "keys"),
                        (sd.entity_mask, "mask"), (sd.local_to_global, "l2g")):
-        local[field] = np.concatenate(
-            [np.asarray(s.data) for s in arr.addressable_shards]
-        )
+        # local_shards orders by slab position so the four arrays' lanes
+        # align (addressable_shards iteration order is unspecified)
+        local[field] = np.concatenate(local_shards(arr))
     records = []
     mask = local["mask"].astype(bool)
     for lane in np.nonzero(mask)[0]:

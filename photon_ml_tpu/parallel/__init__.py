@@ -29,6 +29,8 @@ from photon_ml_tpu.parallel.perhost_ingest import (
     HostRows,
     PerHostRandomEffectSolver,
     ShardedREData,
+    densify_row_ids,
+    local_shards,
     per_host_re_dataset,
 )
 
@@ -45,5 +47,7 @@ __all__ = [
     "HostRows",
     "PerHostRandomEffectSolver",
     "ShardedREData",
+    "densify_row_ids",
+    "local_shards",
     "per_host_re_dataset",
 ]

@@ -108,6 +108,11 @@ class ShardedREData:
     rows_per_device: int  # padded scoring rows R_tot / n_dev
     num_rows: int  # global N
     global_dim: int
+    # True when the ingested row ids passed the dense-[0, num_rows) sanity
+    # checks (collective max + sum match a permutation of [0, N) — necessary,
+    # not sufficient). Sparse (e.g. strided) ids may only be used
+    # slab-build-only; PerHostRandomEffectSolver.score refuses them.
+    row_ids_dense: bool = True
     # HOST-LOCAL: raw id per entity key for the entities owned by THIS
     # host's devices (decoded from the exchanged fixed-width id bytes) —
     # what model save needs, never a device array
@@ -121,6 +126,19 @@ class ShardedREData:
     @property
     def local_dim(self) -> int:
         return self.x.shape[-1]
+
+
+def local_shards(arr: Array, axis: int = 0) -> List[np.ndarray]:
+    """This host's shards of an array sharded along ``axis``, ordered by
+    their position along that axis. ``addressable_shards`` iteration order
+    is NOT documented to match local-device order, and this host's devices
+    own a contiguous process-major block of the sharded axis — so sorting
+    by the shard's start offset yields exactly local-device order, and two
+    same-sharded arrays listed this way align lane-for-lane."""
+    shards = sorted(
+        arr.addressable_shards, key=lambda s: s.index[axis].start or 0
+    )
+    return [np.asarray(s.data) for s in shards]
 
 
 def _pack_u64(keys: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
@@ -195,16 +213,52 @@ def per_host_re_dataset(
     process_id: int = 0,
     active_upper_bound: Optional[int] = None,
     num_buckets: int = 4096,
+    slab_build_only: bool = False,
 ) -> ShardedREData:
     """Shuffle this host's rows to their entity owners and build the owned
     slabs. Every host calls this collectively (SPMD); the returned dataset's
-    arrays are globally sharded with per-host-local backing."""
+    arrays are globally sharded with per-host-local backing.
+
+    Row ids must be dense [0, N) across hosts (``global_row_layout`` or
+    ``densify_row_ids`` produce that layout): the scoring path scatters into
+    a (N,)-sized vector, and under jit an out-of-bounds scatter is DROPPED
+    silently, so sparse (e.g. strided ``host_rows_from_avro``) ids would
+    produce wrong scores with no error. Non-dense ids therefore raise here
+    unless ``slab_build_only=True``, which marks the result so scoring
+    refuses it loudly instead."""
     n_dev = ctx.num_devices
     local = max(n_dev // num_processes, 1)
     keys = stable_entity_keys(rows.entity_raw_ids)
 
-    # ---- agree on the packed record width (global max nnz) ---------------
-    k = int(collective_max(np.asarray([rows.feat_idx.shape[1]]), ctx, num_processes)[0])
+    # ---- agree on record width (global max nnz) + row-id bounds ----------
+    local_max_row = int(rows.row_index.max()) if rows.num_rows else -1
+    km = collective_max(
+        np.asarray([rows.feat_idx.shape[1], local_max_row]), ctx, num_processes
+    )
+    k, g_max_row = int(km[0]), int(km[1])
+    sums = collective_sum(
+        np.asarray(
+            [rows.num_rows, int(rows.row_index.sum())], np.int64
+        ),
+        ctx,
+        num_processes,
+    )
+    n_global, g_id_sum = int(sums[0]), int(sums[1])
+    # necessary (not sufficient) sanity check for ids == permutation of
+    # [0, N): right max AND right sum — catches the common off-by-stride /
+    # duplicated-base bugs without an O(N log N) collective sort
+    row_ids_dense = (
+        g_max_row == n_global - 1
+        and g_id_sum == n_global * (n_global - 1) // 2
+    )
+    if not row_ids_dense and not slab_build_only:
+        raise ValueError(
+            f"row ids are not dense [0, N): max id {g_max_row} vs "
+            f"{n_global} global rows. Use global_row_layout / "
+            "densify_row_ids to assign dense ids (host_rows_from_avro's "
+            "strided ids are slab-build-only), or pass slab_build_only=True "
+            "if this dataset will never be scored."
+        )
     fi = _pad_to(rows.feat_idx.astype(np.int32).T, k, -1).T if rows.feat_idx.shape[1] != k else rows.feat_idx.astype(np.int32)
     fv = _pad_to(rows.feat_val.astype(np.float32).T, k, 0.0).T if rows.feat_val.shape[1] != k else rows.feat_val.astype(np.float32)
 
@@ -301,9 +355,6 @@ def per_host_re_dataset(
         int(v) for v in collective_max(local_meta, ctx, num_processes)
     )
     e_max, s_max, d_loc, r_max = max(e_max, 1), max(s_max, 1), max(d_loc, 1), max(r_max, 1)
-    n_global = int(
-        collective_sum(np.asarray([rows.num_rows], np.int64), ctx, num_processes)[0]
-    )
     real_entities = int(
         collective_sum(
             np.asarray([sum(len(d["keys"]) for d in per_dev)], np.int64),
@@ -413,6 +464,7 @@ def per_host_re_dataset(
         rows_per_device=r_max,
         num_rows=n_global,
         global_dim=rows.global_dim,
+        row_ids_dense=row_ids_dense,
         raw_ids_by_key={
             k: v for d in per_dev for k, v in d["raw_ids"].items()
         },
@@ -544,6 +596,12 @@ class PerHostRandomEffectSolver:
         )
 
     def score(self, coefficients: Array) -> Array:
+        if not self.data.row_ids_dense:
+            raise ValueError(
+                "dataset was built slab_build_only from non-dense row ids; "
+                "scoring would silently drop out-of-bounds scatters — "
+                "rebuild with dense [0, N) ids (densify_row_ids)"
+            )
         if self._score_fn is None:
             axis = self.ctx.axis
             n = self.data.num_rows
@@ -603,7 +661,10 @@ def host_rows_from_avro(
     (``MultihostContext.host_shard_paths``), ``file_ordinals`` their
     positions in the GLOBAL sorted file list — global row ids are
     ``ordinal * row_stride + row_in_file``, unique without any cross-host
-    coordination as long as every file holds < row_stride rows. The feature
+    coordination as long as every file holds < row_stride rows. These
+    strided ids are SPARSE: pass the result through :func:`densify_row_ids`
+    (one collective) before :func:`per_host_re_dataset` if the dataset will
+    be scored — the build rejects sparse ids otherwise. The feature
     index map is consulted per decoded record; with the off-heap store
     (io/offheap.py) the backing is mmap'd, so each host faults in only the
     index pages its own partitions touch — per-partition index-map
@@ -653,6 +714,55 @@ def host_rows_from_avro(
     return concat_host_rows(parts, len(index_map))
 
 
+def densify_row_ids(
+    rows: HostRows,
+    row_stride: int,
+    ctx: MeshContext,
+    num_processes: int = 1,
+) -> HostRows:
+    """Rewrite :func:`host_rows_from_avro`'s strided global row ids
+    (``ordinal * row_stride + row_in_file``) into the dense [0, N) layout
+    the scoring path requires, with one collective per-file row-count
+    exchange (the same exclusive-prefix construction as
+    :func:`global_row_layout`, recovered from the ids themselves).
+
+    Requires the strided invariants host_rows_from_avro guarantees: each
+    file decoded wholly by exactly one host, rows within a file numbered
+    contiguously from 0. Both are validated and violations raise."""
+    ords = rows.row_index // row_stride
+    j = rows.row_index % row_stride
+    local_max = int(ords.max()) if rows.num_rows else -1
+    num_files = (
+        int(collective_max(np.asarray([local_max]), ctx, num_processes)[0]) + 1
+    )
+    counts = np.bincount(ords, minlength=max(num_files, 1)).astype(np.int64)
+    g_counts = collective_sum(counts, ctx, num_processes)
+    # single-pass validation: sorting by strided id groups rows by
+    # (ordinal, row-in-file), so within each file's contiguous segment the
+    # j values must be exactly 0..count-1
+    order = np.argsort(rows.row_index, kind="stable")
+    ords_s, j_s = ords[order], j[order]
+    uniq_o, seg_counts = np.unique(ords_s, return_counts=True)
+    starts = np.concatenate([[0], np.cumsum(seg_counts)[:-1]])
+    expected = np.arange(len(j_s)) - np.repeat(starts, seg_counts)
+    bad = j_s != expected
+    if bad.any():
+        o = int(ords_s[np.argmax(bad)])
+        raise ValueError(
+            f"file ordinal {o}: row-in-file ids are not contiguous "
+            f"[0, {int(counts[o])})"
+        )
+    split = g_counts[uniq_o] != seg_counts
+    if split.any():
+        o = int(uniq_o[np.argmax(split)])
+        raise ValueError(
+            f"file ordinal {o}: decoded on more than one host "
+            f"({int(counts[o])} rows here, {int(g_counts[o])} globally)"
+        )
+    file_base = np.concatenate([[0], np.cumsum(g_counts)[:-1]])
+    return dataclasses.replace(rows, row_index=file_base[ords] + j)
+
+
 # ---------------------------------------------------------------------------
 # scoring-time row routing (validation / inference over per-host models)
 # ---------------------------------------------------------------------------
@@ -699,10 +809,13 @@ def score_routed_rows(
 
     local = max(ctx.num_devices // num_processes, 1)
     scores_local = np.zeros(num_rows_out, np.float64)
-    w_host = [np.asarray(s.data) for s in coefficients.addressable_shards]
-    k_host = [np.asarray(s.data) for s in sd.entity_keys.addressable_shards]
-    m_host = [np.asarray(s.data) for s in sd.entity_mask.addressable_shards]
-    l_host = [np.asarray(s.data) for s in sd.local_to_global.addressable_shards]
+    # exchange blocks are keyed by explicit local-device index, so the slab
+    # shards MUST be listed in that same order (local_shards sorts by axis
+    # offset; raw addressable_shards order is unspecified)
+    w_host = local_shards(coefficients)
+    k_host = local_shards(sd.entity_keys)
+    m_host = local_shards(sd.entity_mask)
+    l_host = local_shards(sd.local_to_global)
     for ld in range(local):
         bi, bf = ex.int_rows[ld], ex.float_rows[ld]
         if not len(bi):
@@ -784,7 +897,9 @@ def per_host_model_slabs(
     array)."""
     rows = HostRows(
         entity_raw_ids=list(entity_ids),
-        # one "row" per model record; ids only need to be unique per record
+        # one "row" per model record; ids only need to be unique per host
+        # (slab_build_only below — this dataset locates active slots and
+        # routes scoring rows, it is never scored via the jit scatter)
         row_index=np.arange(len(entity_ids), dtype=np.int64),
         labels=np.zeros(len(entity_ids), np.float32),
         weights=np.ones(len(entity_ids), np.float32),
@@ -797,13 +912,13 @@ def per_host_model_slabs(
     # produces slabs whose single active sample IS the coefficient vector
     # in the entity's local space — read it back out as the model
     sd = per_host_re_dataset(
-        rows, ctx, num_processes, process_id, num_buckets=num_buckets
+        rows, ctx, num_processes, process_id, num_buckets=num_buckets,
+        slab_build_only=True,
     )
     sharding = NamedSharding(ctx.mesh, P(ctx.axis))
     local_blocks = []
-    for xs, rs in zip(sd.x.addressable_shards, sd.row_index.addressable_shards):
-        x_d = np.asarray(xs.data)  # (E_loc, S=1..., D_loc)
-        r_d = np.asarray(rs.data)
+    # pair the two arrays' shards by slab position, not iteration order
+    for x_d, r_d in zip(local_shards(sd.x), local_shards(sd.row_index)):
         # the record's coefficient vector sits at its (single) active slot
         has = (r_d >= 0).any(axis=1)
         first = np.argmax(r_d >= 0, axis=1)

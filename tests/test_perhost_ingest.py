@@ -304,11 +304,25 @@ class TestAvroPerHostDecode:
             intercept=False, row_stride=1 << 22,
         )
         assert rows_avro.num_rows == n and rows_avro.global_dim == feats.dim
-        sd_avro = per_host_re_dataset(rows_avro, ctx)
+        # strided ids are sparse: the scoring-capable build must refuse them
+        # (silent out-of-bounds scatter drop otherwise), slab-build-only is
+        # allowed, and densify_row_ids recovers the dense [0, N) layout
+        from photon_ml_tpu.parallel.perhost_ingest import densify_row_ids
+
+        with pytest.raises(ValueError, match="dense"):
+            per_host_re_dataset(rows_avro, ctx)
+        sd_sparse = per_host_re_dataset(rows_avro, ctx, slab_build_only=True)
+        assert not sd_sparse.row_ids_dense
+        rows_dense = densify_row_ids(rows_avro, 1 << 22, ctx)
+        # files are in global order and rows contiguous, so dense ids are
+        # exactly the original row order
+        np.testing.assert_array_equal(rows_dense.row_index, np.arange(n))
+        sd_avro = per_host_re_dataset(rows_dense, ctx)
+        assert sd_avro.row_ids_dense
 
         rows_mem = _host_rows_from_game(data, 0, n)
-        # same rows under different GLOBAL ids -> same entity grouping and
-        # training tensors modulo the row_index values themselves
+        # identical rows under identical (densified) GLOBAL ids -> same
+        # entity grouping and training tensors
         sd_mem = per_host_re_dataset(rows_mem, ctx)
         np.testing.assert_array_equal(
             np.asarray(sd_avro.entity_keys), np.asarray(sd_mem.entity_keys)
@@ -316,14 +330,14 @@ class TestAvroPerHostDecode:
         np.testing.assert_array_equal(
             np.asarray(sd_avro.local_to_global), np.asarray(sd_mem.local_to_global)
         )
-        # per-entity x slabs hold the same row payloads (order within an
-        # entity may differ: priorities hash the row ids, which differ)
-        xa = np.asarray(sd_avro.x)
-        xm = np.asarray(sd_mem.x)
-        for lane in np.nonzero(np.asarray(sd_mem.entity_mask))[0]:
-            sa = xa[lane][np.lexsort(xa[lane].T)]
-            sm = xm[lane][np.lexsort(xm[lane].T)]
-            np.testing.assert_allclose(sa, sm, rtol=1e-6, err_msg=str(lane))
+        # identical dense row ids -> identical priorities -> the slabs match
+        # exactly, row order included
+        np.testing.assert_array_equal(
+            np.asarray(sd_avro.row_index), np.asarray(sd_mem.row_index)
+        )
+        np.testing.assert_allclose(
+            np.asarray(sd_avro.x), np.asarray(sd_mem.x), rtol=1e-6
+        )
 
 
 class TestPerHostCoordinateDescent:
