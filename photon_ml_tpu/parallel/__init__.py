@@ -26,8 +26,11 @@ from photon_ml_tpu.parallel.distributed import (
     DistributedRandomEffectSolver,
 )
 from photon_ml_tpu.parallel.perhost_ingest import (
+    BucketedShardedREData,
     HostRows,
+    PerHostBucketedRandomEffectSolver,
     PerHostRandomEffectSolver,
+    REBucketSlabs,
     ShardedREData,
     densify_row_ids,
     local_shards,
@@ -44,8 +47,11 @@ __all__ = [
     "DistributedFactoredRandomEffectCoordinate",
     "DistributedFixedEffectSolver",
     "DistributedRandomEffectSolver",
+    "BucketedShardedREData",
     "HostRows",
+    "PerHostBucketedRandomEffectSolver",
     "PerHostRandomEffectSolver",
+    "REBucketSlabs",
     "ShardedREData",
     "densify_row_ids",
     "local_shards",

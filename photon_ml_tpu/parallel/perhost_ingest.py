@@ -16,12 +16,15 @@ Memory: a host materializes its ingested row block and its owned slab —
 never the global dataset. Peak host memory scales ~1/n_hosts (asserted by
 tests/test_multihost.py via tracemalloc).
 
-Skew note: slabs pad every entity to the GLOBAL max active-sample count,
-so set ``active_upper_bound`` on skewed entity distributions (the
-reference always caps in production for the same reason,
-RandomEffectDataSet.scala:171-200); size-bucketed per-host slabs (the
-bucketed_random_effect treatment composed with the shuffle) are the
-uncapped answer and are future work.
+Skew: ``size_buckets > 1`` composes the size-bucketed treatment
+(algorithm/bucketed_random_effect.py rationale) with the collective
+shuffle — entities are partitioned into geometric active-count buckets
+with collectively-agreed widths, and each bucket's slab pads only to ITS
+width, so an uncapped skewed distribution (one 10^4-row entity among
+singletons) no longer pads every entity to the global max. With
+``size_buckets=1`` (default) the classic single-slab layout is built;
+``active_upper_bound`` remains the hard-cap alternative the reference
+always uses in production (RandomEffectDataSet.scala:171-200).
 """
 
 from __future__ import annotations
@@ -128,6 +131,60 @@ class ShardedREData:
         return self.x.shape[-1]
 
 
+@dataclasses.dataclass
+class REBucketSlabs:
+    """One size bucket's entity-sharded training slabs: the same training
+    tensors as :class:`ShardedREData`, padded only to THIS bucket's
+    collectively-agreed (sample, feature) widths."""
+
+    row_index: Array  # (E_tot, S_b) int32, -1 pad
+    x: Array  # (E_tot, S_b, D_b)
+    labels: Array  # (E_tot, S_b)
+    base_offsets: Array  # (E_tot, S_b)
+    weights: Array  # (E_tot, S_b), 0 = pad
+    local_to_global: Array  # (E_tot, D_b) int32, -1 pad
+    entity_keys: Array  # (E_tot, 2) int32 packed u64
+    entity_mask: Array  # (E_tot,) bool
+    entities_per_device: int  # E_tot / n_dev
+    samples_cap: int  # S_b — the bucket's active-count width
+    num_entities: int  # real entities in this bucket (global)
+
+    @property
+    def local_dim(self) -> int:
+        return self.x.shape[-1]
+
+
+@dataclasses.dataclass
+class BucketedShardedREData:
+    """Entity-sharded random-effect tensors in size-bucketed form: training
+    slabs are a LIST of per-bucket stacks (each padded to its own width),
+    scoring tensors are shared row-major arrays whose entity slots index the
+    per-device CONCATENATION of the bucket slabs (bucket base + rank)."""
+
+    buckets: List[REBucketSlabs]
+    # scoring tensors over owned rows, sharded P(axis) on the row axis
+    score_row_index: Array  # (R_tot,) int32, -1 pad
+    score_slot: Array  # (R_tot,) int32 slot in the concat of bucket slabs
+    score_feat_idx: Array  # (R_tot, K) int32 local feature indices, -1 pad
+    score_feat_val: Array  # (R_tot, K)
+    num_entities: int
+    entities_per_device: int  # sum over buckets of per-bucket heights
+    rows_per_device: int
+    num_rows: int
+    global_dim: int
+    local_dim: int  # max over buckets of D_b (scoring matrix width)
+    row_ids_dense: bool = True
+    raw_ids_by_key: Dict[int, str] = dataclasses.field(default_factory=dict)
+    bucket_owners: Optional[np.ndarray] = None
+    num_buckets: int = 0
+
+    @property
+    def padded_elements(self) -> int:
+        """Total x-slab element count across buckets (the skew-blowup
+        diagnostic: compare against a single global-width slab)."""
+        return sum(int(np.prod(b.x.shape)) for b in self.buckets)
+
+
 def local_shards(arr: Array, axis: int = 0) -> List[np.ndarray]:
     """This host's shards of an array sharded along ``axis``, ordered by
     their position along that axis. ``addressable_shards`` iteration order
@@ -214,7 +271,8 @@ def per_host_re_dataset(
     active_upper_bound: Optional[int] = None,
     num_buckets: int = 4096,
     slab_build_only: bool = False,
-) -> ShardedREData:
+    size_buckets: int = 1,
+) -> "ShardedREData | BucketedShardedREData":
     """Shuffle this host's rows to their entity owners and build the owned
     slabs. Every host calls this collectively (SPMD); the returned dataset's
     arrays are globally sharded with per-host-local backing.
@@ -225,7 +283,13 @@ def per_host_re_dataset(
     silently, so sparse (e.g. strided ``host_rows_from_avro``) ids would
     produce wrong scores with no error. Non-dense ids therefore raise here
     unless ``slab_build_only=True``, which marks the result so scoring
-    refuses it loudly instead."""
+    refuses it loudly instead.
+
+    ``size_buckets=1`` returns :class:`ShardedREData` (one slab padded to
+    the global max active count); ``size_buckets>1`` returns
+    :class:`BucketedShardedREData` with up to that many geometric
+    active-count buckets, each padded only to its own collectively-agreed
+    width — the skew-proof layout for uncapped entity distributions."""
     n_dev = ctx.num_devices
     local = max(n_dev // num_processes, 1)
     keys = stable_entity_keys(rows.entity_raw_ids)
@@ -343,18 +407,27 @@ def per_host_re_dataset(
         )
 
     # ---- agree on uniform tensor dims (one collective max) ----------------
-    local_meta = np.zeros(4, np.int64)
+    # int64 reduces are exact (shuffle._collective_reduce runs them under
+    # jax.enable_x64), so the int64 min is a safe "no entities" sentinel
+    NEG_SENTINEL = np.iinfo(np.int64).min
+    local_meta = np.zeros(5, np.int64)
+    local_meta[4] = NEG_SENTINEL
     for d in per_dev:
         e_d = len(d["keys"])
         local_meta[0] = max(local_meta[0], e_d)  # entities per device
         if e_d:
-            local_meta[1] = max(local_meta[1], int(np.minimum(d["cnt"], d["cap"]).max()))
+            a_e = np.minimum(d["cnt"], d["cap"])
+            local_meta[1] = max(local_meta[1], int(a_e.max()))
             local_meta[2] = max(local_meta[2], int(d["dims"].max()) if len(d["dims"]) else 1)
+            # negated min: one collective_max also agrees the global MIN
+            # active count (the geometric bucket base)
+            local_meta[4] = max(local_meta[4], -int(a_e.min()))
         local_meta[3] = max(local_meta[3], len(d["row"]))  # owned rows
-    e_max, s_max, d_loc, r_max = (
+    e_max, s_max, d_loc, r_max, neg_min = (
         int(v) for v in collective_max(local_meta, ctx, num_processes)
     )
     e_max, s_max, d_loc, r_max = max(e_max, 1), max(s_max, 1), max(d_loc, 1), max(r_max, 1)
+    g_min_act = max(-neg_min, 1) if neg_min > NEG_SENTINEL else 1
     real_entities = int(
         collective_sum(
             np.asarray([sum(len(d["keys"]) for d in per_dev)], np.int64),
@@ -363,39 +436,92 @@ def per_host_re_dataset(
         )[0]
     )
 
-    # ---- build the slabs --------------------------------------------------
-    dt = real_dtype()
-    blocks: Dict[str, List[np.ndarray]] = {f: [] for f in (
-        "row_index", "x", "labels", "base_offsets", "weights", "local_to_global",
-        "entity_keys", "entity_mask", "score_row_index", "score_slot",
-        "score_feat_idx", "score_feat_val",
-    )}
+    # ---- agree on bucket widths + per-bucket dims -------------------------
+    # geometric widths doubling from the global min active count; the last
+    # bucket absorbs everything up to the global max. Deterministic from
+    # (g_min_act, s_max, size_buckets) alone — every host derives the same
+    # partition with no extra collective.
+    nb = max(int(size_buckets), 1)
+    if nb > 1:
+        widths = sorted(
+            {min(g_min_act << b, s_max) for b in range(nb - 1)} | {s_max}
+        )
+    else:
+        widths = [s_max]
+    warr = np.asarray(widths, np.int64)
+    nb_eff = len(widths)
+
+    bmeta = np.zeros(3 * nb_eff, np.int64)
+    bucket_counts_local = np.zeros(nb_eff, np.int64)
     for d in per_dev:
         e_d = len(d["keys"])
-        tri = np.full((e_max, s_max), -1, np.int32)
-        tx = np.zeros((e_max, s_max, d_loc), dt)
-        tlab = np.zeros((e_max, s_max), dt)
-        toff = np.zeros((e_max, s_max), dt)
-        twgt = np.zeros((e_max, s_max), dt)
-        l2g = np.full((e_max, d_loc), -1, np.int32)
-        ekeys = np.zeros((e_max, 2), np.int32)
-        emask = np.zeros((e_max,), bool)
-        sri = np.full((r_max,), -1, np.int32)
-        ssl = np.zeros((r_max,), np.int32)
-        sfi = np.full((r_max, k), -1, np.int32)
-        sfv = np.zeros((r_max, k), dt)
+        if not e_d:
+            d["bidx"] = np.zeros(0, np.int64)
+            d["bslot"] = np.zeros(0, np.int64)
+            continue
+        a_e = np.minimum(d["cnt"], d["cap"])
+        bidx = np.searchsorted(warr, a_e, side="left")  # first width >= a_e
+        bslot = np.zeros(e_d, np.int64)
+        for b in range(nb_eff):
+            sel = bidx == b
+            n_sel = int(sel.sum())
+            # slot = rank within the bucket on this device (key-sorted order
+            # is preserved, so slots are deterministic)
+            bslot[sel] = np.arange(n_sel)
+            bucket_counts_local[b] += n_sel
+            bmeta[3 * b] = max(bmeta[3 * b], n_sel)
+            if n_sel:
+                bmeta[3 * b + 1] = max(bmeta[3 * b + 1], int(a_e[sel].max()))
+                dm = d["dims"][sel]
+                bmeta[3 * b + 2] = max(
+                    bmeta[3 * b + 2], int(dm.max()) if len(dm) else 1
+                )
+        d["bidx"], d["bslot"] = bidx, bslot
+    g_bmeta = collective_max(bmeta, ctx, num_processes)
+    bucket_counts = collective_sum(bucket_counts_local, ctx, num_processes)
+    # drop globally-empty buckets (agreed: g_bmeta is collective)
+    kept = [b for b in range(nb_eff) if int(g_bmeta[3 * b]) > 0]
+    if not kept:
+        kept = [0]
+    # (entities/device, sample width, local feature width) per kept bucket
+    bdims = [
+        (
+            max(int(g_bmeta[3 * b]), 1),
+            max(int(g_bmeta[3 * b + 1]), 1),
+            max(int(g_bmeta[3 * b + 2]), 1),
+        )
+        for b in kept
+    ]
+    pos_of_bucket = np.full(nb_eff, -1, np.int64)
+    pos_of_bucket[kept] = np.arange(len(kept))
+    bucket_base = np.concatenate(
+        [[0], np.cumsum([bd[0] for bd in bdims])[:-1]]
+    ).astype(np.int64)
+    d_loc_max = max(bd[2] for bd in bdims)
+
+    # ---- build the slabs --------------------------------------------------
+    dt = real_dtype()
+    train_names = (
+        "row_index", "x", "labels", "base_offsets", "weights",
+        "local_to_global", "entity_keys", "entity_mask",
+    )
+    score_names = (
+        "score_row_index", "score_slot", "score_feat_idx", "score_feat_val",
+    )
+    tblocks: List[Dict[str, List[np.ndarray]]] = [
+        {f: [] for f in train_names} for _ in kept
+    ]
+    sblocks: Dict[str, List[np.ndarray]] = {f: [] for f in score_names}
+    for d in per_dev:
+        e_d = len(d["keys"])
+        nr = len(d["row"])
+        # per-row local projection (shared by scoring + every bucket's
+        # training block): the sorted (entity, feature) composite lookup
+        li = lv = None
         if e_d:
-            emask[:e_d] = True
-            hi_d, lo_d = _pack_u64(d["keys"])
-            ekeys[:e_d, 0], ekeys[:e_d, 1] = hi_d, lo_d
             ent_start_pairs = np.searchsorted(d["pair_e"], np.arange(e_d), side="left")
             loc_idx = np.arange(len(d["pair_e"])) - ent_start_pairs[d["pair_e"]]
-            l2g[d["pair_e"], loc_idx] = d["pair_f"].astype(np.int32)
-            # project every owned row (active -> training slot; all rows ->
-            # scoring block) into its entity's local space via the sorted
-            # (entity, feature) composite lookup
             comp_keys = d["pair_e"] * rows.global_dim + d["pair_f"]
-            nr = len(d["row"])
             rr = np.repeat(np.arange(nr), d["fi"].shape[1])
             cc = d["fi"].reshape(-1).astype(np.int64)
             valid = cc >= 0
@@ -405,69 +531,136 @@ def per_host_re_dataset(
             hit = valid & (len(comp_keys) > 0) & (comp_keys[pos_c] == comp)
             li = np.where(hit, loc_idx[pos_c], -1).reshape(nr, -1).astype(np.int32)
             lv = np.where(hit.reshape(nr, -1), d["fv"], 0.0)
-            # training tensors: active rows at (entity, rank)
-            act = d["active"]
-            er, rk = d["inv"][act], d["rank"][act]
-            tri[er, rk] = d["row"][act].astype(np.int32)
-            tlab[er, rk] = d["lab"][act]
-            toff[er, rk] = d["off"][act]
-            twgt[er, rk] = d["wgt"][act]
-            # dense per-row vectors scattered by local index
-            arow = np.nonzero(act)[0]
-            dense = np.zeros((len(arow), d_loc), dt)
-            rows2 = np.repeat(np.arange(len(arow)), li.shape[1])
-            lia = li[arow].reshape(-1)
-            lva = lv[arow].reshape(-1)
-            ok = lia >= 0
-            dense[rows2[ok], lia[ok]] = lva[ok]
-            tx[er, rk] = dense
-            # scoring tensors: every owned row
+        # scoring tensors: every owned row; entity slot = bucket base + rank
+        # within the bucket (indexes the per-device CONCAT of bucket slabs)
+        sri = np.full((r_max,), -1, np.int32)
+        ssl = np.zeros((r_max,), np.int32)
+        sfi = np.full((r_max, k), -1, np.int32)
+        sfv = np.zeros((r_max, k), dt)
+        if e_d:
+            gslot = bucket_base[pos_of_bucket[d["bidx"]]] + d["bslot"]
             sri[:nr] = d["row"].astype(np.int32)
-            ssl[:nr] = d["inv"].astype(np.int32)
+            ssl[:nr] = gslot[d["inv"]].astype(np.int32)
             sfi[:nr] = li
             sfv[:nr] = lv
-        blocks["row_index"].append(tri)
-        blocks["x"].append(tx)
-        blocks["labels"].append(tlab)
-        blocks["base_offsets"].append(toff)
-        blocks["weights"].append(twgt)
-        blocks["local_to_global"].append(l2g)
-        blocks["entity_keys"].append(ekeys)
-        blocks["entity_mask"].append(emask)
-        blocks["score_row_index"].append(sri)
-        blocks["score_slot"].append(ssl)
-        blocks["score_feat_idx"].append(sfi)
-        blocks["score_feat_val"].append(sfv)
+        sblocks["score_row_index"].append(sri)
+        sblocks["score_slot"].append(ssl)
+        sblocks["score_feat_idx"].append(sfi)
+        sblocks["score_feat_val"].append(sfv)
+        # per-bucket training tensors, padded to the bucket's own widths
+        for bpos, b in enumerate(kept):
+            e_max_b, s_b, dl_b = bdims[bpos]
+            tri = np.full((e_max_b, s_b), -1, np.int32)
+            tx = np.zeros((e_max_b, s_b, dl_b), dt)
+            tlab = np.zeros((e_max_b, s_b), dt)
+            toff = np.zeros((e_max_b, s_b), dt)
+            twgt = np.zeros((e_max_b, s_b), dt)
+            l2g = np.full((e_max_b, dl_b), -1, np.int32)
+            ekeys = np.zeros((e_max_b, 2), np.int32)
+            emask = np.zeros((e_max_b,), bool)
+            if e_d:
+                in_b = d["bidx"] == b  # (e_d,) entity membership
+                sel_e = np.nonzero(in_b)[0]  # key-sorted; bslot == arange
+                n_b = len(sel_e)
+                if n_b:
+                    emask[:n_b] = True
+                    hi_d, lo_d = _pack_u64(d["keys"][sel_e])
+                    ekeys[:n_b, 0], ekeys[:n_b, 1] = hi_d, lo_d
+                    pe_in = in_b[d["pair_e"]]
+                    l2g[
+                        d["bslot"][d["pair_e"][pe_in]], loc_idx[pe_in]
+                    ] = d["pair_f"][pe_in].astype(np.int32)
+                    # training rows: active rows of this bucket's entities
+                    act = d["active"] & in_b[d["inv"]]
+                    er = d["bslot"][d["inv"][act]]
+                    rk = d["rank"][act]
+                    tri[er, rk] = d["row"][act].astype(np.int32)
+                    tlab[er, rk] = d["lab"][act]
+                    toff[er, rk] = d["off"][act]
+                    twgt[er, rk] = d["wgt"][act]
+                    arow = np.nonzero(act)[0]
+                    dense = np.zeros((len(arow), dl_b), dt)
+                    rows2 = np.repeat(np.arange(len(arow)), li.shape[1])
+                    lia = li[arow].reshape(-1)
+                    lva = lv[arow].reshape(-1)
+                    ok = lia >= 0
+                    dense[rows2[ok], lia[ok]] = lva[ok]
+                    tx[er, rk] = dense
+            tb = tblocks[bpos]
+            tb["row_index"].append(tri)
+            tb["x"].append(tx)
+            tb["labels"].append(tlab)
+            tb["base_offsets"].append(toff)
+            tb["weights"].append(twgt)
+            tb["local_to_global"].append(l2g)
+            tb["entity_keys"].append(ekeys)
+            tb["entity_mask"].append(emask)
 
     sharding = NamedSharding(ctx.mesh, P(ctx.axis))
 
-    def shard(name):
+    def shard(blocks, name):
         return jax.make_array_from_process_local_data(
             sharding, np.concatenate(blocks[name], axis=0)
         )
 
-    return ShardedREData(
-        row_index=shard("row_index"),
-        x=shard("x"),
-        labels=shard("labels"),
-        base_offsets=shard("base_offsets"),
-        weights=shard("weights"),
-        local_to_global=shard("local_to_global"),
-        entity_keys=shard("entity_keys"),
-        entity_mask=shard("entity_mask"),
-        score_row_index=shard("score_row_index"),
-        score_slot=shard("score_slot"),
-        score_feat_idx=shard("score_feat_idx"),
-        score_feat_val=shard("score_feat_val"),
+    raw_ids = {k: v for d in per_dev for k, v in d["raw_ids"].items()}
+    if nb == 1:
+        # classic single-slab layout (bucket 0 IS the global-width slab)
+        tb = tblocks[0]
+        return ShardedREData(
+            row_index=shard(tb, "row_index"),
+            x=shard(tb, "x"),
+            labels=shard(tb, "labels"),
+            base_offsets=shard(tb, "base_offsets"),
+            weights=shard(tb, "weights"),
+            local_to_global=shard(tb, "local_to_global"),
+            entity_keys=shard(tb, "entity_keys"),
+            entity_mask=shard(tb, "entity_mask"),
+            score_row_index=shard(sblocks, "score_row_index"),
+            score_slot=shard(sblocks, "score_slot"),
+            score_feat_idx=shard(sblocks, "score_feat_idx"),
+            score_feat_val=shard(sblocks, "score_feat_val"),
+            num_entities=real_entities,
+            entities_per_device=bdims[0][0],
+            rows_per_device=r_max,
+            num_rows=n_global,
+            global_dim=rows.global_dim,
+            row_ids_dense=row_ids_dense,
+            raw_ids_by_key=raw_ids,
+            bucket_owners=owners,
+            num_buckets=num_buckets,
+        )
+
+    bucket_slabs = [
+        REBucketSlabs(
+            row_index=shard(tb, "row_index"),
+            x=shard(tb, "x"),
+            labels=shard(tb, "labels"),
+            base_offsets=shard(tb, "base_offsets"),
+            weights=shard(tb, "weights"),
+            local_to_global=shard(tb, "local_to_global"),
+            entity_keys=shard(tb, "entity_keys"),
+            entity_mask=shard(tb, "entity_mask"),
+            entities_per_device=bdims[bpos][0],
+            samples_cap=bdims[bpos][1],
+            num_entities=int(bucket_counts[kept[bpos]]),
+        )
+        for bpos, tb in enumerate(tblocks)
+    ]
+    return BucketedShardedREData(
+        buckets=bucket_slabs,
+        score_row_index=shard(sblocks, "score_row_index"),
+        score_slot=shard(sblocks, "score_slot"),
+        score_feat_idx=shard(sblocks, "score_feat_idx"),
+        score_feat_val=shard(sblocks, "score_feat_val"),
         num_entities=real_entities,
-        entities_per_device=e_max,
+        entities_per_device=int(sum(bd[0] for bd in bdims)),
         rows_per_device=r_max,
         num_rows=n_global,
         global_dim=rows.global_dim,
+        local_dim=d_loc_max,
         row_ids_dense=row_ids_dense,
-        raw_ids_by_key={
-            k: v for d in per_dev for k, v in d["raw_ids"].items()
-        },
+        raw_ids_by_key=raw_ids,
         bucket_owners=owners,
         num_buckets=num_buckets,
     )
@@ -639,6 +832,134 @@ class PerHostRandomEffectSolver:
         )
 
 
+@dataclasses.dataclass
+class PerHostBucketedRandomEffectSolver(PerHostRandomEffectSolver):
+    """Size-bucketed variant of :class:`PerHostRandomEffectSolver` over
+    :class:`BucketedShardedREData`: coefficients are a TUPLE of per-bucket
+    entity-sharded (E_b, D_b) arrays (same pytree contract as
+    algorithm.bucketed_random_effect), the vmapped solve runs once per
+    bucket (each padded only to its own width), and scoring concatenates
+    the per-device bucket slabs so one gather serves all buckets."""
+
+    data: "BucketedShardedREData"  # type: ignore[assignment]
+
+    def initial_coefficients(self) -> Tuple[Array, ...]:
+        shardng = NamedSharding(self.ctx.mesh, P(self.ctx.axis))
+        return tuple(
+            jax.device_put(
+                jnp.zeros((b.entity_mask.shape[0], b.local_dim), real_dtype()),
+                shardng,
+            )
+            for b in self.data.buckets
+        )
+
+    def update(self, residual_offsets: Array, init_coefficients):
+        from photon_ml_tpu.data.game import RandomEffectDataset
+
+        if self._update_fn is None:
+            axis = self.ctx.axis
+            gdim = self.data.global_dim
+
+            def solve_shard(x, labels, offs, wgts, row_index, w0, residuals):
+                dummy = jnp.zeros((1,), jnp.int32)
+                ds = RandomEffectDataset(
+                    row_index=row_index, x=x, labels=labels, base_offsets=offs,
+                    weights=wgts, entity_pos=dummy, feat_idx=dummy[None],
+                    feat_val=dummy[None].astype(x.dtype),
+                    local_to_global=dummy[None],
+                    num_entities=x.shape[0], global_dim=gdim,
+                )
+                return self._coordinate_for(ds).update(residuals, w0)
+
+            # one jitted shard_map serves every bucket: jit re-specializes
+            # per (E_b, S_b, D_b) shape, so each bucket compiles once
+            self._update_fn = jax.jit(
+                shard_map(
+                    solve_shard,
+                    mesh=self.ctx.mesh,
+                    in_specs=(
+                        P(axis), P(axis), P(axis), P(axis), P(axis), P(axis), P(),
+                    ),
+                    out_specs=(P(axis), P(axis)),
+                    # same rationale + compensating equivalence test as the
+                    # monolithic solver (tests/test_perhost_ingest.py)
+                    check_vma=False,
+                )
+            )
+        residuals = jax.device_put(
+            residual_offsets, NamedSharding(self.ctx.mesh, P())
+        )
+        new_state, results = [], []
+        for b, w0 in zip(self.data.buckets, init_coefficients):
+            w, res = self._update_fn(
+                b.x, b.labels, b.base_offsets, b.weights, b.row_index,
+                self._sharded_init(w0), residuals,
+            )
+            new_state.append(w)
+            results.append(res)
+        return tuple(new_state), tuple(results)
+
+    def score(self, state) -> Array:
+        if not self.data.row_ids_dense:
+            raise ValueError(
+                "dataset was built slab_build_only from non-dense row ids; "
+                "scoring would silently drop out-of-bounds scatters — "
+                "rebuild with dense [0, N) ids (densify_row_ids)"
+            )
+        if self._score_fn is None:
+            axis = self.ctx.axis
+            n = self.data.num_rows
+            d_max = self.data.local_dim
+
+            def score_shard(ws, srow, sslot, sfi, sfv):
+                # per-device concat of the bucket slabs, feature axis padded
+                # to the shared scoring width — slots were assigned against
+                # exactly this layout at build time
+                w_cat = jnp.concatenate(
+                    [
+                        jnp.pad(w, ((0, 0), (0, d_max - w.shape[-1])))
+                        for w in ws
+                    ],
+                    axis=0,
+                )
+                wsel = w_cat[jnp.maximum(sslot, 0)]  # (R, D_max)
+                vals = jnp.take_along_axis(wsel, jnp.maximum(sfi, 0), axis=-1)
+                vals = jnp.where(sfi >= 0, vals * sfv, 0.0)
+                s = jnp.where(srow >= 0, jnp.sum(vals, axis=-1), 0.0)
+                out = jnp.zeros((n,), s.dtype).at[jnp.maximum(srow, 0)].add(
+                    jnp.where(srow >= 0, s, 0.0)
+                )
+                return jax.lax.psum(out, axis)
+
+            self._score_fn = jax.jit(
+                shard_map(
+                    score_shard,
+                    mesh=self.ctx.mesh,
+                    in_specs=(
+                        tuple(P(axis) for _ in self.data.buckets),
+                        P(axis), P(axis), P(axis), P(axis),
+                    ),
+                    out_specs=P(),
+                )
+            )
+        d = self.data
+        return self._score_fn(
+            tuple(state), d.score_row_index, d.score_slot,
+            d.score_feat_idx, d.score_feat_val,
+        )
+
+    def regularization_term(self, state) -> Array:
+        l1 = self.regularization.l1_weight
+        l2 = self.regularization.l2_weight
+        return sum(
+            (
+                l1 * jnp.sum(jnp.abs(w)) + 0.5 * l2 * jnp.sum(jnp.square(w))
+                for w in state
+            ),
+            jnp.asarray(0.0, real_dtype()),
+        )
+
+
 # ---------------------------------------------------------------------------
 # per-host Avro decode (the DataProcessingUtils per-partition analogue)
 # ---------------------------------------------------------------------------
@@ -769,8 +1090,8 @@ def densify_row_ids(
 
 
 def score_routed_rows(
-    sd: ShardedREData,
-    coefficients: Array,
+    sd: "ShardedREData | BucketedShardedREData",
+    coefficients,
     rows: HostRows,
     num_rows_out: int,
     ctx: MeshContext,
@@ -783,6 +1104,11 @@ def score_routed_rows(
     into the entity's local space and dot with its slab row, then merge the
     per-host (num_rows_out,) partials with one collective sum.
 
+    ``coefficients`` is the matching solver state: the (E_tot, D_loc) array
+    for a :class:`ShardedREData`, the per-bucket tuple for a
+    :class:`BucketedShardedREData` (the buckets are flattened into the same
+    per-device concat layout the scoring slots index).
+
     Cold-start semantics: a row whose entity has no model, or a feature the
     entity never saw in training, contributes 0
     (RandomEffectModel.scala:129-158). Returns the replicated host-side
@@ -790,6 +1116,69 @@ def score_routed_rows(
     """
     if sd.bucket_owners is None:
         raise ValueError("dataset was built without bucket_owners")
+    if isinstance(sd, BucketedShardedREData):
+        # flatten the size buckets into per-device concatenated views (the
+        # same layout the scoring slots index); coefficients arrive as the
+        # solver's per-bucket tuple state. Meta/coefficient arrays are tiny
+        # next to the data slabs, so the host-side concat keeps the skew
+        # memory profile intact.
+        if not isinstance(coefficients, (tuple, list)) or len(
+            coefficients
+        ) != len(sd.buckets):
+            raise ValueError(
+                "bucketed dataset requires the per-bucket coefficient tuple "
+                f"({len(sd.buckets)} buckets)"
+            )
+        d_max = sd.local_dim
+        w_host, k_host, m_host, l_host = [], [], [], []
+        n_local = max(ctx.num_devices // num_processes, 1)
+        per_bucket = [
+            (
+                local_shards(w), local_shards(b.entity_keys),
+                local_shards(b.entity_mask), local_shards(b.local_to_global),
+            )
+            for b, w in zip(sd.buckets, coefficients)
+        ]
+        for ld in range(n_local):
+            w_host.append(np.concatenate([
+                np.pad(np.asarray(pb[0][ld]),
+                       ((0, 0), (0, d_max - pb[0][ld].shape[-1])))
+                for pb in per_bucket
+            ], axis=0))
+            k_host.append(np.concatenate([pb[1][ld] for pb in per_bucket]))
+            m_host.append(np.concatenate([pb[2][ld] for pb in per_bucket]))
+            l_host.append(np.concatenate([
+                np.pad(np.asarray(pb[3][ld]),
+                       ((0, 0), (0, d_max - pb[3][ld].shape[-1])),
+                       constant_values=-1)
+                for pb in per_bucket
+            ], axis=0))
+        return _score_routed_rows_impl(
+            sd, rows, num_rows_out, ctx, num_processes, process_id,
+            w_host, k_host, m_host, l_host,
+        )
+    w_host = local_shards(coefficients)
+    k_host = local_shards(sd.entity_keys)
+    m_host = local_shards(sd.entity_mask)
+    l_host = local_shards(sd.local_to_global)
+    return _score_routed_rows_impl(
+        sd, rows, num_rows_out, ctx, num_processes, process_id,
+        w_host, k_host, m_host, l_host,
+    )
+
+
+def _score_routed_rows_impl(
+    sd,
+    rows: HostRows,
+    num_rows_out: int,
+    ctx: MeshContext,
+    num_processes: int,
+    process_id: int,
+    w_host,
+    k_host,
+    m_host,
+    l_host,
+) -> np.ndarray:
     keys = stable_entity_keys(rows.entity_raw_ids)
     dest = sd.bucket_owners[bucket_of(keys, sd.num_buckets)]
     # all hosts must pack the SAME record width (the training path's rule)
@@ -809,13 +1198,9 @@ def score_routed_rows(
 
     local = max(ctx.num_devices // num_processes, 1)
     scores_local = np.zeros(num_rows_out, np.float64)
-    # exchange blocks are keyed by explicit local-device index, so the slab
-    # shards MUST be listed in that same order (local_shards sorts by axis
-    # offset; raw addressable_shards order is unspecified)
-    w_host = local_shards(coefficients)
-    k_host = local_shards(sd.entity_keys)
-    m_host = local_shards(sd.entity_mask)
-    l_host = local_shards(sd.local_to_global)
+    # exchange blocks are keyed by explicit local-device index, so the
+    # caller's slab shard lists MUST be in that same order (local_shards
+    # sorts by axis offset; raw addressable_shards order is unspecified)
     for ld in range(local):
         bi, bf = ex.int_rows[ld], ex.float_rows[ld]
         if not len(bi):

@@ -112,14 +112,23 @@ def _collective_reduce(
     Works identically single-process (the reduction is a no-op with L =
     num_devices) and multi-process (jax.make_array_from_process_local_data
     assembles the (n_dev, B) global, the jitted reduce runs SPMD)."""
+    import contextlib
+
     local = max(ctx.num_devices // num_processes, 1)
     fill = 0 if op == "sum" else np.iinfo(vec.dtype).min if np.issubdtype(vec.dtype, np.integer) else -np.inf
     block = _host_block(np.asarray(vec), local, fill)
     sharding = NamedSharding(ctx.mesh, P(ctx.axis))
-    g = jax.make_array_from_process_local_data(sharding, block)
     fn = jnp.sum if op == "sum" else jnp.max
-    out = jax.jit(lambda a: fn(a, axis=0), out_shardings=NamedSharding(ctx.mesh, P()))(g)
-    return np.asarray(jax.device_get(out))
+    # int64 must reduce EXACTLY: without x64 JAX silently wraps to int32,
+    # which (a) overflows row-id sums past N ~ 65k (sum N(N-1)/2 > 2^31)
+    # and (b) wraps the int64 min fill to 0, poisoning negative maxes
+    is_i64 = np.issubdtype(block.dtype, np.integer) and block.dtype.itemsize == 8
+    with jax.enable_x64() if is_i64 else contextlib.nullcontext():
+        g = jax.make_array_from_process_local_data(sharding, block)
+        out = jax.jit(
+            lambda a: fn(a, axis=0), out_shardings=NamedSharding(ctx.mesh, P())
+        )(g)
+        return np.asarray(jax.device_get(out))
 
 
 def collective_sum(vec, ctx, num_processes: int) -> np.ndarray:

@@ -266,3 +266,59 @@ print(
     f"MHRESOLVER proc={proc_id} ingest_peak={ingest_peak} csum={csum:.6f}",
     flush=True,
 )
+
+# -- UNCAPPED skewed distribution through SIZE-BUCKETED per-host slabs ------
+# (VERDICT r4 next-round #2): one giant entity among thousands of
+# singletons, rows interleaved across hosts. The global-max-padded slab for
+# this shape would be ~singletons/devices x giant-width — never built here;
+# the bucketed build pads each entity only to its bucket's width, so the
+# per-host ingest peak must stay ~1/n_hosts of a single host's.
+from photon_ml_tpu.parallel.perhost_ingest import (  # noqa: E402
+    PerHostBucketedRandomEffectSolver,
+)
+
+rng_s = np.random.default_rng(53)
+GIANT, SING, DS = 2048, 3000, 6
+n_skew = GIANT + SING
+ids_sk = np.array(["giant"] * GIANT + [f"s{i}" for i in range(SING)])
+fi_sk = rng_s.integers(0, DS, size=(n_skew, 3)).astype(np.int32)
+fv_sk = rng_s.normal(size=(n_skew, 3)).astype(np.float32)
+y_sk = (rng_s.random(n_skew) < 0.5).astype(np.float32)
+perm_sk = rng_s.permutation(n_skew)  # giant's rows land on BOTH hosts
+ids_sk, fi_sk, fv_sk, y_sk = (
+    ids_sk[perm_sk], fi_sk[perm_sk], fv_sk[perm_sk], y_sk[perm_sk]
+)
+lo_s = proc_id * (n_skew // nprocs)
+hi_s = n_skew if proc_id == nprocs - 1 else (proc_id + 1) * (n_skew // nprocs)
+skew_rows = HostRows(
+    entity_raw_ids=list(ids_sk[lo_s:hi_s]),
+    row_index=np.arange(lo_s, hi_s, dtype=np.int64),
+    labels=y_sk[lo_s:hi_s],
+    weights=np.ones(hi_s - lo_s, np.float32),
+    offsets=np.zeros(hi_s - lo_s, np.float32),
+    feat_idx=fi_sk[lo_s:hi_s],
+    feat_val=fv_sk[lo_s:hi_s],
+    global_dim=DS,
+)
+tracemalloc.start()
+skew_ds = per_host_re_dataset(
+    skew_rows, ctx, nprocs, proc_id, size_buckets=8
+)
+_, skew_peak = tracemalloc.get_traced_memory()
+tracemalloc.stop()
+bsolver = PerHostBucketedRandomEffectSolver(
+    skew_ds,
+    TaskType.LOGISTIC_REGRESSION,
+    OptimizerType.LBFGS,
+    OptimizerConfig(max_iterations=20, tolerance=1e-8),
+    RegularizationContext.l2(0.3),
+    ctx,
+)
+resid_sk = mh.global_replicated(np.zeros(n_skew, np.float32), ctx)
+w_sk, _ = bsolver.update(resid_sk, bsolver.initial_coefficients())
+ssum_sk = float(np.sum(np.asarray(jax.device_get(bsolver.score(w_sk)))))
+print(
+    f"MHSKEW proc={proc_id} ingest_peak={skew_peak} "
+    f"padded={skew_ds.padded_elements} ssum={ssum_sk:.6f}",
+    flush=True,
+)

@@ -486,3 +486,139 @@ def test_routed_scoring_cold_entities_and_features(glmix, ctx):
     j = int(np.nonzero(l2g == 0)[0][0])
     expected = float(np.asarray(w)[lane, j])
     assert scores[0] == pytest.approx(expected, rel=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# size-bucketed per-host slabs (VERDICT r4 next-round #2)
+# ---------------------------------------------------------------------------
+
+
+def _skewed_host_rows(giant_rows=1024, singletons=400, d=3, seed=7):
+    """One giant entity among singletons — the uncapped skew case the
+    global-max padding blows up on."""
+    rng = np.random.default_rng(seed)
+    n = giant_rows + singletons
+    ids = ["giant"] * giant_rows + [f"s{i}" for i in range(singletons)]
+    fi = rng.integers(0, d, size=(n, 2)).astype(np.int32)
+    fi[:, 1] = np.where(fi[:, 1] == fi[:, 0], (fi[:, 1] + 1) % d, fi[:, 1])
+    fv = rng.normal(size=(n, 2)).astype(np.float32)
+    y = (rng.random(n) < 0.5).astype(np.float32)
+    return HostRows(
+        entity_raw_ids=ids,
+        row_index=np.arange(n, dtype=np.int64),
+        labels=y,
+        weights=np.ones(n, np.float32),
+        offsets=np.zeros(n, np.float32),
+        feat_idx=fi,
+        feat_val=fv,
+        global_dim=d,
+    )
+
+
+class TestBucketedPerHost:
+    def _solvers(self, rows, ctx, size_buckets):
+        from photon_ml_tpu.parallel.perhost_ingest import (
+            BucketedShardedREData,
+            PerHostBucketedRandomEffectSolver,
+        )
+
+        cfg = OptimizerConfig(max_iterations=30, tolerance=1e-9)
+        reg = RegularizationContext.l2(0.3)
+        sd = per_host_re_dataset(rows, ctx)
+        bd = per_host_re_dataset(rows, ctx, size_buckets=size_buckets)
+        assert isinstance(bd, BucketedShardedREData)
+        mono = PerHostRandomEffectSolver(
+            sd, TaskType.LOGISTIC_REGRESSION, OptimizerType.LBFGS, cfg, reg, ctx
+        )
+        buck = PerHostBucketedRandomEffectSolver(
+            bd, TaskType.LOGISTIC_REGRESSION, OptimizerType.LBFGS, cfg, reg, ctx
+        )
+        return sd, bd, mono, buck
+
+    def test_bucketed_matches_monolithic(self, glmix, ctx):
+        """Multi-bucket slabs must train and score exactly like the single
+        global-width slab: same entities, same scores (the compensating
+        equivalence control for the bucketed solver's check_vma=False)."""
+        rows = _host_rows_from_game(glmix, 0, glmix.num_rows)
+        sd, bd, mono, buck = self._solvers(rows, ctx, size_buckets=4)
+        assert len(bd.buckets) >= 2  # rows-per-user 6..18 spans >1 width
+        assert bd.num_entities == sd.num_entities
+        assert sum(b.num_entities for b in bd.buckets) == sd.num_entities
+
+        resid = jnp.zeros((glmix.num_rows,), jnp.float32)
+        w_m, _ = mono.update(resid, mono.initial_coefficients())
+        s_m = mono.score(w_m)
+        w_b, _ = buck.update(resid, buck.initial_coefficients())
+        s_b = buck.score(w_b)
+        np.testing.assert_allclose(
+            np.asarray(s_b), np.asarray(s_m), rtol=5e-4, atol=5e-4
+        )
+        # regularization over the tuple state matches the monolithic term
+        np.testing.assert_allclose(
+            float(buck.regularization_term(w_b)),
+            float(mono.regularization_term(w_m)),
+            rtol=5e-4,
+        )
+
+    def test_skew_padding_collapses(self, ctx):
+        """One 1024-row entity among 400 singletons: bucketed slab volume
+        must be a small fraction of the global-max-padded volume, and the
+        scores must still match the monolithic build exactly."""
+        rows = _skewed_host_rows()
+        sd, bd, mono, buck = self._solvers(rows, ctx, size_buckets=8)
+
+        mono_elems = int(np.prod(sd.x.shape))
+        assert bd.padded_elements * 10 < mono_elems, (
+            f"bucketed {bd.padded_elements} vs monolithic {mono_elems}"
+        )
+        # the widths really are per-bucket (not all global max)
+        caps = sorted(b.samples_cap for b in bd.buckets)
+        assert caps[0] == 1 and caps[-1] == 1024
+
+        resid = jnp.zeros((rows.num_rows,), jnp.float32)
+        w_m, _ = mono.update(resid, mono.initial_coefficients())
+        w_b, _ = buck.update(resid, buck.initial_coefficients())
+        np.testing.assert_allclose(
+            np.asarray(buck.score(w_b)), np.asarray(mono.score(w_m)),
+            rtol=5e-4, atol=5e-4,
+        )
+
+    def test_bucketed_in_coordinate_descent(self, glmix, ctx):
+        """The bucketed solver is a drop-in CoordinateDescent coordinate
+        (tuple-state pytree), matching the monolithic descent."""
+        from photon_ml_tpu.algorithm import CoordinateDescent
+        from photon_ml_tpu.ops import losses
+
+        data = glmix
+        labels = jnp.asarray(data.response)
+        loss_fn = lambda s: jnp.sum(losses.logistic.loss(s, labels))
+        rows = _host_rows_from_game(data, 0, data.num_rows)
+        _, _, mono, buck = self._solvers(rows, ctx, size_buckets=4)
+
+        r_m = CoordinateDescent({"re": mono}, loss_fn).run(
+            num_iterations=2, num_rows=data.num_rows
+        )
+        r_b = CoordinateDescent({"re": buck}, loss_fn).run(
+            num_iterations=2, num_rows=data.num_rows
+        )
+        np.testing.assert_allclose(
+            np.asarray(r_b.objective_history),
+            np.asarray(r_m.objective_history), rtol=5e-4,
+        )
+        np.testing.assert_allclose(
+            np.asarray(r_b.total_scores), np.asarray(r_m.total_scores),
+            rtol=5e-3, atol=5e-4,
+        )
+
+    def test_bucketed_routed_scoring_matches_device_scoring(self, glmix, ctx):
+        """score_routed_rows over a bucketed build (per-bucket coefficient
+        tuple) must match the device-side owner-computes scoring."""
+        from photon_ml_tpu.parallel.perhost_ingest import score_routed_rows
+
+        rows = _host_rows_from_game(glmix, 0, glmix.num_rows)
+        _, bd, _, buck = self._solvers(rows, ctx, size_buckets=4)
+        resid = jnp.zeros((glmix.num_rows,), jnp.float32)
+        w_b, _ = buck.update(resid, buck.initial_coefficients())
+        device_scores = np.asarray(buck.score(w_b))
+        routed = score_routed_rows(bd, w_b, rows, glmix.num_rows, ctx)
+        np.testing.assert_allclose(routed, device_scores, rtol=1e-4, atol=1e-5)
