@@ -9,6 +9,12 @@ from photon_ml_tpu.algorithm.bucketed_random_effect import (
     BucketedRandomEffectCoordinate,
 )
 from photon_ml_tpu.algorithm.random_effect import RandomEffectCoordinate
+from photon_ml_tpu.algorithm.streaming_random_effect import (
+    SpilledREState,
+    StreamingRandomEffectCoordinate,
+    StreamingREManifest,
+    write_re_entity_blocks,
+)
 
 __all__ = [
     "BucketedRandomEffectCoordinate",
@@ -18,4 +24,8 @@ __all__ = [
     "FixedEffectCoordinate",
     "MFOptimizationConfig",
     "RandomEffectCoordinate",
+    "SpilledREState",
+    "StreamingRandomEffectCoordinate",
+    "StreamingREManifest",
+    "write_re_entity_blocks",
 ]

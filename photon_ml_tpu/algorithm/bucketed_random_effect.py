@@ -266,6 +266,11 @@ class BucketedRandomEffectCoordinate:
                     variances[raw] = var_stacks[b][pos_in_bucket[vi]]
         return means, variances
 
+    def stack_sizes(self) -> List[int]:
+        """Entity count per coefficient stack, in stack order (the offsets
+        a concatenated-stack gather needs)."""
+        return [s.num_entities for s in self._subs]
+
     # -- diagnostics --------------------------------------------------------
     @property
     def num_entities(self) -> int:

@@ -304,6 +304,12 @@ class GameTrainingParams:
     # per-bucket padding on skewed entity distributions; composes with
     # --distributed (each bucket entity-shards over the mesh)
     bucketed_random_effects: bool = False
+    # out-of-core random effects (algorithm/streaming_random_effect): the
+    # entity-major stacks live on disk as entity blocks, one block resident
+    # per evaluation; coefficients spill between updates. Budget in MB caps
+    # the resident block slab (reference DISK_ONLY analogue)
+    streaming_random_effects: bool = False
+    re_memory_budget_mb: Optional[float] = None
     # "true": train every lambda combo of the grid simultaneously as a vmap
     # axis over the descent cycle (CoordinateDescent.run_grid); "auto":
     # time one warm iteration of each strategy and pick the faster (the
@@ -349,6 +355,35 @@ class GameTrainingParams:
             errors.append(
                 "--validate-date-range and --validate-date-range-days-ago are exclusive"
             )
+        if self.re_memory_budget_mb is not None and self.re_memory_budget_mb <= 0:
+            errors.append("--re-memory-budget-mb must be positive")
+        if self.streaming_random_effects:
+            # loud scope fences: the streaming coordinate re-enters the host
+            # per evaluation, so anything that wraps it in one XLA program
+            # or serializes its state as device arrays cannot compose
+            if self.bucketed_random_effects:
+                errors.append(
+                    "--streaming-random-effects already sorts entities by "
+                    "size into tightly-padded blocks; drop "
+                    "--bucketed-random-effects"
+                )
+            if self.distributed:
+                errors.append(
+                    "--streaming-random-effects is single-device (one block "
+                    "resident at a time); --distributed cannot compose"
+                )
+            if self.fused_cycle:
+                errors.append(
+                    "--streaming-random-effects streams per evaluation; "
+                    "--fused-cycle (one XLA program per iteration) cannot "
+                    "compose"
+                )
+            if self.checkpoint_dir:
+                errors.append(
+                    "--streaming-random-effects spills its own state between "
+                    "updates; --checkpoint-dir (array-pytree checkpoints) "
+                    "cannot serialize the spilled handle"
+                )
         if errors:
             raise ValueError("; ".join(errors))
 
@@ -409,6 +444,12 @@ def build_training_parser() -> argparse.ArgumentParser:
       help="partition random-effect entities into size buckets (per-bucket "
            "padding on skewed entity distributions; composes with "
            "--distributed)")
+    a("--streaming-random-effects", default="false",
+      help="out-of-core random effects: entity-block stacks stream from "
+           "disk, one block resident per evaluation (DISK_ONLY analogue)")
+    a("--re-memory-budget-mb", default=None,
+      help="cap the resident random-effect block slab (MB); implies "
+           "--streaming-random-effects")
     a("--vmapped-grid", default="false",
       help="train every lambda combo of the grid simultaneously (one vmapped "
            "descent instead of sequential combos; lambda-only grids on plain "
@@ -458,6 +499,14 @@ def parse_training_params(argv: Optional[List[str]] = None) -> GameTrainingParam
         distributed=_truthy(ns.distributed),
         fused_cycle=_truthy(ns.fused_cycle),
         bucketed_random_effects=_truthy(ns.bucketed_random_effects),
+        streaming_random_effects=(
+            _truthy(ns.streaming_random_effects)
+            or ns.re_memory_budget_mb is not None
+        ),
+        re_memory_budget_mb=(
+            float(ns.re_memory_budget_mb)
+            if ns.re_memory_budget_mb is not None else None
+        ),
         vmapped_grid=(
             "auto" if str(ns.vmapped_grid).lower() == "auto"
             else "true" if _truthy(ns.vmapped_grid) else "false"

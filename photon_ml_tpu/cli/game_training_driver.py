@@ -147,6 +147,7 @@ class GameTrainingDriver:
         self.validation_data: Optional[GameData] = None
         self.re_datasets: Dict[str, object] = {}
         self.bucketed_bundles: Dict[str, object] = {}  # --bucketed-random-effects
+        self.streaming_manifests: Dict[str, object] = {}  # --streaming-random-effects
         self.fe_batches: Dict[str, object] = {}
         # combo results: (config map, CoordinateDescentResult, metrics)
         self.results: List[Tuple[Dict[str, CoordinateOptConfig], CoordinateDescentResult, Dict[str, float]]] = []
@@ -258,6 +259,33 @@ class GameTrainingDriver:
                 cfg = RandomEffectDataConfig(
                     **{**cfg.__dict__, "projector": "IDENTITY"}
                 )
+            if p.streaming_random_effects and name not in p.factored_configs:
+                # out-of-core: write the entity blocks to disk ONCE (each
+                # block built and released in turn — the full stack never
+                # exists); combos stream the same blocks
+                from photon_ml_tpu.algorithm.streaming_random_effect import (
+                    write_re_entity_blocks,
+                )
+
+                budget = (
+                    int(p.re_memory_budget_mb * 1e6)
+                    if p.re_memory_budget_mb is not None else None
+                )
+                self.streaming_manifests[name] = write_re_entity_blocks(
+                    self.train_data, cfg,
+                    os.path.join(p.output_dir, "streaming-re", name),
+                    # `is None`, not falsy: a (rejected-downstream) zero
+                    # budget must not silently pass BOTH sizing modes
+                    block_entities=None if budget is not None else 1024,
+                    memory_budget_bytes=budget,
+                )
+                self.logger.info(
+                    f"streaming RE {name}: "
+                    f"{len(self.streaming_manifests[name].blocks)} blocks, "
+                    f"max resident slab "
+                    f"{self.streaming_manifests[name].max_block_bytes}B"
+                )
+                continue
             if p.bucketed_random_effects and name not in p.factored_configs:
                 # bucketed coordinates own per-bucket stacks — building the
                 # single globally-padded stack here would allocate exactly
@@ -342,6 +370,18 @@ class GameTrainingDriver:
                         fac, self._mesh_context()
                     )
                 coords[name] = fac
+            elif p.streaming_random_effects:
+                from photon_ml_tpu.algorithm.streaming_random_effect import (
+                    StreamingRandomEffectCoordinate,
+                )
+
+                coords[name] = StreamingRandomEffectCoordinate(
+                    self.streaming_manifests[name],
+                    p.task_type,
+                    optimizer=cfg.optimizer,
+                    optimizer_config=cfg.optimizer_config(),
+                    regularization=cfg.regularization_context(),
+                )
             elif p.bucketed_random_effects:
                 from photon_ml_tpu.algorithm.bucketed_random_effect import (
                     BucketedRandomEffectCoordinate,
@@ -433,12 +473,18 @@ class GameTrainingDriver:
                 from photon_ml_tpu.algorithm.bucketed_random_effect import (
                     BucketedRandomEffectCoordinate,
                 )
+                from photon_ml_tpu.algorithm.streaming_random_effect import (
+                    StreamingRandomEffectCoordinate,
+                )
 
-                if isinstance(coord, BucketedRandomEffectCoordinate):
+                if isinstance(
+                    coord,
+                    (BucketedRandomEffectCoordinate, StreamingRandomEffectCoordinate),
+                ):
                     # map each validation row into the CONCATENATED stack:
-                    # bucket row offset + within-bucket tensor position
+                    # bucket/block row offset + within-unit tensor position
                     bucket_of, pos_in_bucket = coord.vocab_position_maps()
-                    sizes = [s_.num_entities for s_ in coord._subs]
+                    sizes = coord.stack_sizes()
                     offsets = np.concatenate([[0], np.cumsum(sizes)[:-1]]).astype(np.int32)
                     safe_vid = np.maximum(vocab_ids, 0)
                     b_of = bucket_of[safe_vid]
@@ -527,6 +573,8 @@ class GameTrainingDriver:
             return "--distributed (shard_map cannot nest under the combo vmap)"
         if p.bucketed_random_effects:
             return "--bucketed-random-effects (static per-bucket lambdas)"
+        if p.streaming_random_effects:
+            return "--streaming-random-effects (host streaming cannot vmap)"
         if p.factored_configs:
             return "factored coordinates (lambda lives in nested configs)"
         if p.compute_variance:
@@ -784,23 +832,30 @@ class GameTrainingDriver:
                 from photon_ml_tpu.algorithm.bucketed_random_effect import (
                     BucketedRandomEffectCoordinate,
                 )
+                from photon_ml_tpu.algorithm.streaming_random_effect import (
+                    StreamingRandomEffectCoordinate,
+                )
 
-                if p.bucketed_random_effects:
+                if p.bucketed_random_effects or p.streaming_random_effects:
                     if combo_index is None or not (
                         0 <= combo_index < len(self.combo_coords)
                     ):
                         raise ValueError(
-                            "save_models on a --bucketed-random-effects run "
-                            "needs the combo_index of the result being saved "
-                            "(the tuple-of-buckets coefficients are extracted "
-                            "through that combo's coordinate objects)"
+                            "save_models on a bucketed/streaming random-"
+                            "effects run needs the combo_index of the result "
+                            "being saved (the per-bucket/per-block "
+                            "coefficients are extracted through that combo's "
+                            "coordinate objects)"
                         )
                     coord = self.combo_coords[combo_index].get(name)
                 else:
                     coord = None
                 cfg = p.random_effect_data_configs[name]
                 entity_variances = None
-                if isinstance(coord, BucketedRandomEffectCoordinate):
+                if isinstance(
+                    coord,
+                    (BucketedRandomEffectCoordinate, StreamingRandomEffectCoordinate),
+                ):
                     resid = (
                         result.total_scores - coord.score(coeffs)
                         if _wants_variances(name)

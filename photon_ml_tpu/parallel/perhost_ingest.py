@@ -451,47 +451,58 @@ def per_host_re_dataset(
     warr = np.asarray(widths, np.int64)
     nb_eff = len(widths)
 
-    bmeta = np.zeros(3 * nb_eff, np.int64)
-    bucket_counts_local = np.zeros(nb_eff, np.int64)
-    for d in per_dev:
-        e_d = len(d["keys"])
-        if not e_d:
-            d["bidx"] = np.zeros(0, np.int64)
-            d["bslot"] = np.zeros(0, np.int64)
-            continue
-        a_e = np.minimum(d["cnt"], d["cap"])
-        bidx = np.searchsorted(warr, a_e, side="left")  # first width >= a_e
-        bslot = np.zeros(e_d, np.int64)
-        for b in range(nb_eff):
-            sel = bidx == b
-            n_sel = int(sel.sum())
-            # slot = rank within the bucket on this device (key-sorted order
-            # is preserved, so slots are deterministic)
-            bslot[sel] = np.arange(n_sel)
-            bucket_counts_local[b] += n_sel
-            bmeta[3 * b] = max(bmeta[3 * b], n_sel)
-            if n_sel:
-                bmeta[3 * b + 1] = max(bmeta[3 * b + 1], int(a_e[sel].max()))
-                dm = d["dims"][sel]
-                bmeta[3 * b + 2] = max(
-                    bmeta[3 * b + 2], int(dm.max()) if len(dm) else 1
-                )
-        d["bidx"], d["bslot"] = bidx, bslot
-    g_bmeta = collective_max(bmeta, ctx, num_processes)
-    bucket_counts = collective_sum(bucket_counts_local, ctx, num_processes)
-    # drop globally-empty buckets (agreed: g_bmeta is collective)
-    kept = [b for b in range(nb_eff) if int(g_bmeta[3 * b]) > 0]
-    if not kept:
+    if nb_eff == 1:
+        # single-slab default: the bucket dims ARE the already-collected
+        # local_meta maxima — skip the two extra cross-host reductions
+        for d in per_dev:
+            e_d = len(d["keys"])
+            d["bidx"] = np.zeros(e_d, np.int64)
+            d["bslot"] = np.arange(e_d, dtype=np.int64)
         kept = [0]
-    # (entities/device, sample width, local feature width) per kept bucket
-    bdims = [
-        (
-            max(int(g_bmeta[3 * b]), 1),
-            max(int(g_bmeta[3 * b + 1]), 1),
-            max(int(g_bmeta[3 * b + 2]), 1),
-        )
-        for b in kept
-    ]
+        bdims = [(e_max, s_max, d_loc)]
+        bucket_counts = np.asarray([real_entities], np.int64)
+    else:
+        bmeta = np.zeros(3 * nb_eff, np.int64)
+        bucket_counts_local = np.zeros(nb_eff, np.int64)
+        for d in per_dev:
+            e_d = len(d["keys"])
+            if not e_d:
+                d["bidx"] = np.zeros(0, np.int64)
+                d["bslot"] = np.zeros(0, np.int64)
+                continue
+            a_e = np.minimum(d["cnt"], d["cap"])
+            bidx = np.searchsorted(warr, a_e, side="left")  # first width >= a_e
+            bslot = np.zeros(e_d, np.int64)
+            for b in range(nb_eff):
+                sel = bidx == b
+                n_sel = int(sel.sum())
+                # slot = rank within the bucket on this device (key-sorted
+                # order is preserved, so slots are deterministic)
+                bslot[sel] = np.arange(n_sel)
+                bucket_counts_local[b] += n_sel
+                bmeta[3 * b] = max(bmeta[3 * b], n_sel)
+                if n_sel:
+                    bmeta[3 * b + 1] = max(bmeta[3 * b + 1], int(a_e[sel].max()))
+                    dm = d["dims"][sel]
+                    bmeta[3 * b + 2] = max(
+                        bmeta[3 * b + 2], int(dm.max()) if len(dm) else 1
+                    )
+            d["bidx"], d["bslot"] = bidx, bslot
+        g_bmeta = collective_max(bmeta, ctx, num_processes)
+        bucket_counts = collective_sum(bucket_counts_local, ctx, num_processes)
+        # drop globally-empty buckets (agreed: g_bmeta is collective)
+        kept = [b for b in range(nb_eff) if int(g_bmeta[3 * b]) > 0]
+        if not kept:
+            kept = [0]
+        # (entities/device, sample width, local feature width) per kept bucket
+        bdims = [
+            (
+                max(int(g_bmeta[3 * b]), 1),
+                max(int(g_bmeta[3 * b + 1]), 1),
+                max(int(g_bmeta[3 * b + 2]), 1),
+            )
+            for b in kept
+        ]
     pos_of_bucket = np.full(nb_eff, -1, np.int64)
     pos_of_bucket[kept] = np.arange(len(kept))
     bucket_base = np.concatenate(

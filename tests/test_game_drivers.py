@@ -562,6 +562,37 @@ class TestBucketedRandomEffects:
         _, _, local_metrics = local_driver.results[local_driver.best_index]
         assert metrics["AUC"] == pytest.approx(local_metrics["AUC"], abs=5e-3)
 
+    def test_streaming_re_flag_matches_plain(
+        self, trained, game_avro_dirs, tmp_path
+    ):
+        """--streaming-random-effects (+ a memory budget): entity blocks on
+        disk, one resident per evaluation, through the full driver — the
+        metrics AND the saved per-entity model must match the plain path."""
+        from photon_ml_tpu.algorithm.streaming_random_effect import (
+            StreamingRandomEffectCoordinate,
+        )
+
+        local_driver, _, _ = trained
+        train_dir, val_dir, _ = game_avro_dirs
+        driver = game_training_driver.main(
+            [
+                "--train-input-dirs", train_dir,
+                "--validate-input-dirs", val_dir,
+                "--output-dir", str(tmp_path / "out"),
+                "--num-iterations", "2",
+                "--re-memory-budget-mb", "0.005",
+            ]
+            + COMMON_FLAGS
+        )
+        manifest = driver.streaming_manifests["per-user"]
+        assert len(manifest.blocks) >= 2  # the budget actually split blocks
+        assert manifest.max_block_bytes <= 5_000
+        coords = driver._build_coordinates(driver.results[0][0])
+        assert isinstance(coords["per-user"], StreamingRandomEffectCoordinate)
+        _, _, metrics = driver.results[driver.best_index]
+        _, _, local_metrics = local_driver.results[local_driver.best_index]
+        assert metrics["AUC"] == pytest.approx(local_metrics["AUC"], abs=5e-3)
+
 
 class TestGridSearch:
     def test_config_grid_selects_best_combo(self, game_avro_dirs, tmp_path):
