@@ -30,7 +30,9 @@ from photon_ml_tpu.optim.problem import GLMOptimizationProblem, _split_reg_weigh
 from photon_ml_tpu.optim.streaming import (
     ChunkedGLMSource,
     lbfgs_minimize_streaming,
+    make_streaming_hvp,
     make_streaming_value_and_grad,
+    tron_minimize_streaming,
 )
 from photon_ml_tpu.types import OptimizerType, real_dtype
 
@@ -52,11 +54,6 @@ class StreamingFixedEffectCoordinate:
     cd_jit = False
 
     def __post_init__(self):
-        if self.problem.optimizer == OptimizerType.TRON:
-            raise ValueError(
-                "streaming fixed effect supports LBFGS/OWL-QN only (TRON's "
-                "CG would stream one full pass per Hessian-vector product)"
-            )
         self._margin_fn = jax.jit(
             lambda w, x: x @ self.norm.effective_coefficients(w)
             + self.norm.margin_shift(self.norm.effective_coefficients(w))
@@ -79,6 +76,15 @@ class StreamingFixedEffectCoordinate:
         self._vg = make_streaming_value_and_grad(
             self._live_source, self.problem.objective, self.norm,
             l2_weight=self._l2,
+        )
+        # TRON streams one extra pass per CG Hessian-vector product (the
+        # reference's one-treeAggregate-per-CG-step cost, TRON.scala:268-281)
+        self._hvp = (
+            make_streaming_hvp(
+                self._live_source, self.problem.objective, self.norm,
+                l2_weight=self._l2,
+            )
+            if self.problem.optimizer == OptimizerType.TRON else None
         )
 
     @property
@@ -121,10 +127,16 @@ class StreamingFixedEffectCoordinate:
             if self.problem.constraints is not None
             else None
         )
-        res = lbfgs_minimize_streaming(
-            self._vg, jnp.asarray(init_coefficients, real_dtype()),
-            self.problem.optimizer_config, l1_weight=self._l1, bounds=bounds,
-        )
+        if self._hvp is not None:
+            res = tron_minimize_streaming(
+                self._vg, self._hvp, jnp.asarray(init_coefficients, real_dtype()),
+                self.problem.optimizer_config, bounds=bounds,
+            )
+        else:
+            res = lbfgs_minimize_streaming(
+                self._vg, jnp.asarray(init_coefficients, real_dtype()),
+                self.problem.optimizer_config, l1_weight=self._l1, bounds=bounds,
+            )
         return res.coefficients, res
 
     def score(self, coefficients: Array) -> Array:

@@ -107,11 +107,6 @@ class GLMParams:
         if self.validate_per_iteration and self.validating_data_dir is None:
             errors.append("--validate-per-iteration requires --validating-data-directory")
         if self.streaming_chunk_rows > 0:
-            if self.optimizer_type == OptimizerType.TRON:
-                errors.append(
-                    "--streaming-chunk-rows supports LBFGS/OWL-QN only (TRON's "
-                    "CG would stream one full pass per Hessian-vector product)"
-                )
             if self.validate_per_iteration:
                 errors.append(
                     "--streaming-chunk-rows does not keep per-iteration "

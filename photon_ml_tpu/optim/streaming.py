@@ -351,6 +351,205 @@ def lbfgs_minimize_streaming(
     )
 
 
+def make_streaming_hvp(
+    source: ChunkedGLMSource,
+    objective: GLMObjective,
+    norm: NormalizationContext,
+    l2_weight: float = 0.0,
+    dtype=None,
+):
+    """hvp(w, v, l2_weight=...) -> H(w) v accumulated over chunks — the
+    chunked HessianVectorAggregator (HessianVectorAggregator.scala:90-116
+    algebra is additive over rows, so per-chunk partials sum exactly).
+    One jitted partial per chunk shape, like the value+grad factory."""
+    from photon_ml_tpu.types import real_dtype
+
+    dtype = dtype or real_dtype()
+
+    @jax.jit
+    def partial_hvp(w, v, x, y, off, wt):
+        batch = GLMBatch(DenseFeatures(x), y, off, wt)
+        return objective.hessian_vector(w, v, batch, norm, 0.0)
+
+    def hvp(w: Array, v: Array, l2_weight=l2_weight) -> Array:
+        hv = jnp.zeros((source.dim,), dtype)
+        for chunk in source.chunks():
+            x = jnp.asarray(chunk["x"], dtype)
+            y = jnp.asarray(chunk["y"], dtype)
+            n_c = x.shape[0]
+            off = jnp.asarray(
+                chunk.get("offsets", np.zeros(n_c, np.float32)), dtype
+            )
+            wt = jnp.asarray(chunk.get("weights", np.ones(n_c, np.float32)), dtype)
+            hv = hv + partial_hvp(w, v, x, y, off, wt)
+        return hv + jnp.asarray(l2_weight, dtype) * v
+
+    return hvp
+
+
+# ---------------------------------------------------------------------------
+# host-driven TRON (kernel-equivalent semantics; one streamed pass per
+# value+grad evaluation, one streamed pass per CG Hessian-vector product —
+# the same cost profile as the reference's one treeAggregate per CG step,
+# optimization/TRON.scala:268-281)
+# ---------------------------------------------------------------------------
+
+
+def tron_minimize_streaming(
+    value_and_grad_fn,
+    hvp_fn,
+    w0: Array,
+    config: OptimizerConfig,
+    bounds: Optional[Tuple[Array, Array]] = None,
+) -> OptResult:
+    """Host-loop trust-region Newton with the exact semantics of
+    optim/tron.tron_minimize_ (Steihaug CG inner loop, LIBLINEAR radius
+    rules, improvement-failure retries, same convergence reasons) for
+    objectives that must re-enter the host per evaluation.
+
+    Verified equivalent to the kernel on in-memory data by
+    tests/test_streaming.py.
+    """
+    from photon_ml_tpu.optim.tron import (
+        _CG_TOL,
+        _EPS as _TRON_EPS,
+        _ETA0, _ETA1, _ETA2,
+        _SIGMA1, _SIGMA2, _SIGMA3,
+    )
+    from photon_ml_tpu.types import ConvergenceReason
+
+    dtype = w0.dtype
+    max_iter = config.max_iterations
+    tol = config.tolerance
+
+    def reduced_grad(w, g):
+        if bounds is None:
+            return g
+        blocked = ((w >= bounds[1]) & (g < 0.0)) | ((w <= bounds[0]) & (g > 0.0))
+        return jnp.where(blocked, 0.0, g)
+
+    def truncated_cg(w, g, delta):
+        """Host Steihaug CG: one streamed hvp per step; same boundary /
+        negative-curvature / residual-tolerance rules as the kernel."""
+        s = jnp.zeros_like(g)
+        r = -g
+        d = -g
+        rtr = float(jnp.dot(g, g))
+        gnorm = float(jnp.linalg.norm(g))
+        if gnorm == 0.0:
+            return s, r
+        for _ in range(config.max_cg_iterations):
+            hd = hvp_fn(w, d)
+            dhd = float(jnp.dot(d, hd))
+            alpha = rtr / max(dhd, _TRON_EPS)
+            s_try = s + alpha * d
+            hit = (dhd <= 0.0) or (float(jnp.linalg.norm(s_try)) >= float(delta))
+            if hit:
+                sd = float(jnp.dot(s, d))
+                dd = max(float(jnp.dot(d, d)), _TRON_EPS)
+                ss = float(jnp.dot(s, s))
+                rad = np.sqrt(
+                    max(sd * sd + dd * (float(delta) ** 2 - ss), 0.0)
+                )
+                tau = (-sd + rad) / dd
+                s = s + tau * d
+                r = r - tau * hd
+                return s, r
+            s = s_try
+            r = r - alpha * hd
+            rtr_new = float(jnp.dot(r, r))
+            if np.sqrt(rtr_new) <= _CG_TOL * gnorm:
+                return s, r
+            beta = rtr_new / max(rtr, _TRON_EPS)
+            d = r + beta * d
+            rtr = rtr_new
+        return s, r
+
+    if bounds is not None:
+        w0 = jnp.clip(w0, bounds[0], bounds[1])
+    f, g = value_and_grad_fn(w0)
+    w = w0
+    f0 = float(f)
+    g0_norm = float(jnp.linalg.norm(reduced_grad(w, g)))
+    delta = g0_norm
+    value_history = np.full((max_iter + 1,), np.nan, np.float64)
+    grad_norm_history = np.full((max_iter + 1,), np.nan, np.float64)
+    value_history[0] = float(f)
+    grad_norm_history[0] = g0_norm
+
+    reason = int(ConvergenceReason.GRADIENT_CONVERGED) if g0_norm == 0.0 else 0
+    it = 0
+    failures = 0
+    while reason == 0:
+        step, r = truncated_cg(w, reduced_grad(w, g), delta)
+        w_trial = w + step
+        clipped = False
+        if bounds is not None:
+            w_clip = jnp.clip(w_trial, bounds[0], bounds[1])
+            clipped = bool(jnp.any(w_clip != w_trial))
+            w_trial = w_clip
+        if clipped:
+            # measure the model on the step actually taken (kernel comment:
+            # else improving clipped steps are rejected forever). Costs one
+            # extra streamed pass — paid ONLY when clipping changed the step
+            step = w_trial - w
+            snorm = float(jnp.linalg.norm(step))
+            gs = float(jnp.dot(g, step))
+            prered = -(gs + 0.5 * float(jnp.dot(step, hvp_fn(w, step))))
+        else:
+            snorm = float(jnp.linalg.norm(step))
+            gs = float(jnp.dot(g, step))
+            prered = -0.5 * (gs - float(jnp.dot(step, r)))
+        f_new, g_new = value_and_grad_fn(w_trial)
+        actred = float(f) - float(f_new)
+
+        if it == 0:
+            delta = min(delta, snorm)
+        denom = float(f_new) - float(f) - gs
+        alpha = _SIGMA3 if denom <= 0.0 else max(_SIGMA1, -0.5 * (gs / denom))
+        asn = alpha * snorm
+        if actred < _ETA0 * prered:
+            delta = min(max(asn, _SIGMA1 * snorm), _SIGMA2 * delta)
+        elif actred < _ETA1 * prered:
+            delta = max(_SIGMA1 * delta, min(asn, _SIGMA2 * delta))
+        elif actred < _ETA2 * prered:
+            delta = max(_SIGMA1 * delta, min(asn, _SIGMA3 * delta))
+        else:
+            delta = max(delta, min(asn, _SIGMA3 * delta))
+
+        accept = actred > _ETA0 * prered
+        if accept:
+            w, f, g = w_trial, f_new, g_new
+            failures = 0
+        else:
+            failures += 1
+
+        g_norm = float(jnp.linalg.norm(reduced_grad(w, g)))
+        it += 1
+        value_history[it] = float(f)
+        grad_norm_history[it] = g_norm
+
+        if g_norm <= tol * max(g0_norm, _TRON_EPS):
+            reason = int(ConvergenceReason.GRADIENT_CONVERGED)
+        elif failures >= config.max_improvement_failures:
+            reason = int(ConvergenceReason.OBJECTIVE_NOT_IMPROVING)
+        elif accept and abs(actred) <= tol * max(abs(f0), _TRON_EPS):
+            reason = int(ConvergenceReason.FUNCTION_VALUES_CONVERGED)
+        elif it >= max_iter:
+            reason = int(ConvergenceReason.MAX_ITERATIONS)
+
+    return OptResult(
+        coefficients=w,
+        value=f,
+        grad_norm=jnp.asarray(grad_norm_history[it], dtype),
+        iterations=jnp.asarray(it, jnp.int32),
+        reason=jnp.asarray(reason, jnp.int32),
+        value_history=jnp.asarray(value_history, dtype),
+        grad_norm_history=jnp.asarray(grad_norm_history, dtype),
+        coefficient_history=None,
+    )
+
+
 def streaming_hessian_diagonal(
     source: ChunkedGLMSource,
     objective: GLMObjective,

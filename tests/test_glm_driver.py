@@ -504,10 +504,26 @@ class TestStreamingOutOfCore:
         # streaming mode actually engaged (its source replaced the batch)
         assert st.streaming_source is not None and st.train_batch is None
 
-    def test_streaming_rejects_tron(self, libsvm_dirs):
-        train, _, out = libsvm_dirs
-        with pytest.raises(ValueError, match="LBFGS/OWL-QN only"):
-            _base_params(
-                train, out, optimizer_type=OptimizerType.TRON,
-                streaming_chunk_rows=64,
-            ).validate()
+    def test_streaming_tron_matches_in_memory(self, libsvm_dirs):
+        """TRON over streamed chunks through the full staged driver (the r4
+        restriction is gone): one streamed pass per CG Hessian-vector
+        product, same solution as the in-memory TRON run."""
+        train, val, out = libsvm_dirs
+        mem = Driver(_base_params(
+            train, out + "-tron-mem", validating_data_dir=val,
+            optimizer_type=OptimizerType.TRON,
+        ))
+        mem.run()
+        st = Driver(_base_params(
+            train, out + "-tron-st", validating_data_dir=val,
+            optimizer_type=OptimizerType.TRON,
+            streaming_chunk_rows=128,
+        ))
+        st.run()
+        assert st.stage == DriverStage.VALIDATED
+        assert st.best_reg_weight == mem.best_reg_weight
+        np.testing.assert_allclose(
+            np.asarray(st.best_model.coefficients.means),
+            np.asarray(mem.best_model.coefficients.means),
+            rtol=2e-3, atol=2e-4,
+        )

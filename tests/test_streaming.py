@@ -209,23 +209,215 @@ class TestStreamingFixedEffectCoordinate:
             rtol=5e-3, atol=5e-4,
         )
 
-    def test_streaming_fe_rejects_tron(self):
+    def test_streaming_fe_supports_tron(self, problem):
+        """The streaming FE coordinate solves with TRON (r4 #5: the old
+        LBFGS-only restriction is gone) and matches the kernel TRON fit."""
         from photon_ml_tpu.algorithm.streaming_fixed_effect import (
             StreamingFixedEffectCoordinate,
         )
-        from photon_ml_tpu.optim.streaming import ChunkedGLMSource
         from photon_ml_tpu.optim.problem import GLMOptimizationProblem
+        from photon_ml_tpu.optim.tron import tron_minimize_
         from photon_ml_tpu.types import OptimizerType, TaskType
 
+        x, y, offs, wts = problem
         src = ChunkedGLMSource.from_arrays(
-            np.zeros((8, 2), np.float32), np.zeros(8, np.float32), 4
+            x, y, 512, offsets=offs, weights=wts
         )
-        with pytest.raises(ValueError, match="LBFGS/OWL-QN only"):
-            StreamingFixedEffectCoordinate(
-                src,
-                GLMOptimizationProblem(
-                    TaskType.LOGISTIC_REGRESSION, OptimizerType.TRON,
-                    OptimizerConfig(max_iterations=5, tolerance=1e-5),
-                    RegularizationContext.l2(0.1),
-                ),
+        cfg = OptimizerConfig(max_iterations=30, tolerance=1e-9)
+        coord = StreamingFixedEffectCoordinate(
+            src,
+            GLMOptimizationProblem(
+                TaskType.LOGISTIC_REGRESSION, OptimizerType.TRON, cfg,
+                RegularizationContext.l2(0.3),
+            ),
+        )
+        w_s, res_s = coord.update(
+            jnp.zeros((x.shape[0],), jnp.float32), coord.initial_coefficients()
+        )
+        obj = GLMObjective(losses.logistic)
+        norm = NormalizationContext.identity()
+        batch = GLMBatch(
+            DenseFeatures(jnp.asarray(x)), jnp.asarray(y), jnp.asarray(offs),
+            jnp.asarray(wts),
+        )
+        vg = lambda w: obj.value_and_grad(w, batch, norm, 0.3)
+        hvp = lambda w, v: obj.hessian_vector(w, v, batch, norm, 0.3)
+        res_k = tron_minimize_(
+            vg, hvp, jnp.zeros((x.shape[1],), jnp.float32), cfg
+        )
+        np.testing.assert_allclose(
+            np.asarray(w_s), np.asarray(res_k.coefficients),
+            rtol=5e-4, atol=5e-5,
+        )
+
+
+class TestStreamingTron:
+    def test_streamed_hvp_is_exact(self, problem):
+        """Σ over chunks == one pass (the Hessian-vector algebra is
+        additive over rows, HessianVectorAggregator.scala:90-116)."""
+        from photon_ml_tpu.optim.streaming import make_streaming_hvp
+
+        x, y, offs, wts = problem
+        obj = GLMObjective(losses.logistic)
+        norm = NormalizationContext.identity()
+        batch = GLMBatch(
+            DenseFeatures(jnp.asarray(x)), jnp.asarray(y), jnp.asarray(offs),
+            jnp.asarray(wts),
+        )
+        rng = np.random.default_rng(4)
+        w = jnp.asarray(rng.normal(size=x.shape[1]).astype(np.float32) * 0.2)
+        v = jnp.asarray(rng.normal(size=x.shape[1]).astype(np.float32))
+        hv_mem = obj.hessian_vector(w, v, batch, norm, 0.3)
+        src = ChunkedGLMSource.from_arrays(x, y, 700, offsets=offs, weights=wts)
+        hv_stream = make_streaming_hvp(src, obj, norm, l2_weight=0.3)(w, v)
+        np.testing.assert_allclose(
+            np.asarray(hv_stream), np.asarray(hv_mem), rtol=1e-5, atol=1e-6
+        )
+
+    def test_streaming_tron_matches_kernel(self, problem):
+        """Host-loop TRON over chunks == the while_loop kernel on the same
+        objective: same solution, same convergence reason."""
+        from photon_ml_tpu.optim.streaming import (
+            make_streaming_hvp,
+            tron_minimize_streaming,
+        )
+        from photon_ml_tpu.optim.tron import tron_minimize_
+
+        x, y, offs, wts = problem
+        obj = GLMObjective(losses.logistic)
+        norm = NormalizationContext.identity()
+        batch = GLMBatch(
+            DenseFeatures(jnp.asarray(x)), jnp.asarray(y), jnp.asarray(offs),
+            jnp.asarray(wts),
+        )
+        cfg = OptimizerConfig(max_iterations=40, tolerance=1e-9)
+        vg_mem = lambda w: obj.value_and_grad(w, batch, norm, 0.3)
+        hvp_mem = lambda w, v: obj.hessian_vector(w, v, batch, norm, 0.3)
+        res_k = tron_minimize_(
+            vg_mem, hvp_mem, jnp.zeros((x.shape[1],), jnp.float32), cfg
+        )
+        src = ChunkedGLMSource.from_arrays(x, y, 512, offsets=offs, weights=wts)
+        vg_s = make_streaming_value_and_grad(src, obj, norm, l2_weight=0.3)
+        hvp_s = make_streaming_hvp(src, obj, norm, l2_weight=0.3)
+        res_s = tron_minimize_streaming(
+            vg_s, hvp_s, jnp.zeros((x.shape[1],), jnp.float32), cfg
+        )
+        np.testing.assert_allclose(
+            np.asarray(res_s.coefficients), np.asarray(res_k.coefficients),
+            rtol=5e-4, atol=5e-5,
+        )
+        assert int(res_s.reason) == int(res_k.reason)
+
+    def test_streaming_tron_poisson_with_offsets(self):
+        """The Poisson+offsets config through streaming TRON == kernel TRON
+        (the parity configuration the r4 verdict names)."""
+        from photon_ml_tpu.optim.streaming import (
+            make_streaming_hvp,
+            tron_minimize_streaming,
+        )
+        from photon_ml_tpu.optim.tron import tron_minimize_
+
+        rng = np.random.default_rng(23)
+        n, d = 2000, 8
+        x = rng.normal(size=(n, d)).astype(np.float32) * 0.4
+        w_true = rng.normal(size=d).astype(np.float32) * 0.3
+        offs = rng.normal(scale=0.2, size=n).astype(np.float32)
+        lam = np.exp(np.clip(x @ w_true + offs, -4, 4))
+        y = rng.poisson(lam).astype(np.float32)
+        wts = np.ones(n, np.float32)
+
+        obj = GLMObjective(losses.poisson)
+        norm = NormalizationContext.identity()
+        batch = GLMBatch(
+            DenseFeatures(jnp.asarray(x)), jnp.asarray(y), jnp.asarray(offs),
+            jnp.asarray(wts),
+        )
+        cfg = OptimizerConfig(max_iterations=40, tolerance=1e-9)
+        vg_mem = lambda w: obj.value_and_grad(w, batch, norm, 0.5)
+        hvp_mem = lambda w, v: obj.hessian_vector(w, v, batch, norm, 0.5)
+        res_k = tron_minimize_(
+            vg_mem, hvp_mem, jnp.zeros((d,), jnp.float32), cfg
+        )
+        src = ChunkedGLMSource.from_arrays(x, y, 300, offsets=offs, weights=wts)
+        vg_s = make_streaming_value_and_grad(src, obj, norm, l2_weight=0.5)
+        hvp_s = make_streaming_hvp(src, obj, norm, l2_weight=0.5)
+        res_s = tron_minimize_streaming(
+            vg_s, hvp_s, jnp.zeros((d,), jnp.float32), cfg
+        )
+        # chunked f32 sums differ from the one-pass sum in the last ulp and
+        # the exp-loss trust-region trajectory amplifies that; the OBJECTIVE
+        # at both solutions must still agree tightly
+        np.testing.assert_allclose(
+            np.asarray(res_s.coefficients), np.asarray(res_k.coefficients),
+            rtol=5e-3, atol=1e-3,
+        )
+        f_at_s, _ = vg_mem(res_s.coefficients)
+        np.testing.assert_allclose(
+            float(f_at_s), float(res_k.value), rtol=1e-5
+        )
+
+    def test_streaming_tron_with_box_constraints(self, problem):
+        """The clipped-step branch (recomputed gs/prered on the step
+        actually taken): streaming TRON under ACTIVE bounds == kernel TRON
+        under the same bounds."""
+        from photon_ml_tpu.optim.streaming import (
+            make_streaming_hvp,
+            tron_minimize_streaming,
+        )
+        from photon_ml_tpu.optim.tron import tron_minimize_
+
+        x, y, offs, wts = problem
+        d = x.shape[1]
+        obj = GLMObjective(losses.logistic)
+        norm = NormalizationContext.identity()
+        batch = GLMBatch(
+            DenseFeatures(jnp.asarray(x)), jnp.asarray(y), jnp.asarray(offs),
+            jnp.asarray(wts),
+        )
+        # tight box so several coordinates end up AT a bound (clipping real)
+        bounds = (jnp.full((d,), -0.05), jnp.full((d,), 0.05))
+        cfg = OptimizerConfig(max_iterations=40, tolerance=1e-9)
+        vg_mem = lambda w: obj.value_and_grad(w, batch, norm, 0.3)
+        hvp_mem = lambda w, v: obj.hessian_vector(w, v, batch, norm, 0.3)
+        res_k = tron_minimize_(
+            vg_mem, hvp_mem, jnp.zeros((d,), jnp.float32), cfg, bounds=bounds
+        )
+        src = ChunkedGLMSource.from_arrays(x, y, 512, offsets=offs, weights=wts)
+        vg_s = make_streaming_value_and_grad(src, obj, norm, l2_weight=0.3)
+        hvp_s = make_streaming_hvp(src, obj, norm, l2_weight=0.3)
+        res_s = tron_minimize_streaming(
+            vg_s, hvp_s, jnp.zeros((d,), jnp.float32), cfg, bounds=bounds
+        )
+        assert bool(jnp.any(jnp.abs(res_k.coefficients) >= 0.05 - 1e-6))
+        np.testing.assert_allclose(
+            np.asarray(res_s.coefficients), np.asarray(res_k.coefficients),
+            rtol=5e-4, atol=5e-5,
+        )
+
+    def test_glm_grid_streaming_tron(self, problem):
+        """train_glm_grid_streaming accepts TRON end-to-end (the old
+        reject is gone) and matches the in-memory grid's solutions."""
+        from photon_ml_tpu.optim.problem import GLMOptimizationProblem
+        from photon_ml_tpu.training import train_glm_grid, train_glm_grid_streaming
+        from photon_ml_tpu.types import OptimizerType, TaskType
+
+        x, y, offs, wts = problem
+        cfg = OptimizerConfig(max_iterations=30, tolerance=1e-8)
+        prob = GLMOptimizationProblem(
+            TaskType.LOGISTIC_REGRESSION, OptimizerType.TRON, cfg,
+            RegularizationContext.l2(1.0),
+        )
+        norm = NormalizationContext.identity()
+        batch = GLMBatch(
+            DenseFeatures(jnp.asarray(x)), jnp.asarray(y), jnp.asarray(offs),
+            jnp.asarray(wts),
+        )
+        mem = train_glm_grid(prob, batch, norm, [0.1, 1.0])
+        src = ChunkedGLMSource.from_arrays(x, y, 512, offsets=offs, weights=wts)
+        st = train_glm_grid_streaming(prob, src, norm, [0.1, 1.0])
+        for wm, ws in zip(mem.models, st.models):
+            np.testing.assert_allclose(
+                np.asarray(ws.coefficients.means),
+                np.asarray(wm.coefficients.means),
+                rtol=1e-3, atol=1e-4,
             )
