@@ -125,6 +125,11 @@ class ShardedREData:
     # device that holds their entity's model
     bucket_owners: Optional[np.ndarray] = None
     num_buckets: int = 0
+    # local-space projector the slabs were built with (ProjectorType.scala
+    # semantics): INDEX_MAP | IDENTITY | RANDOM; RANDOM carries the shared
+    # host-side Gaussian matrix for routed scoring + model back-projection
+    projector: str = "INDEX_MAP"
+    projection_matrix: Optional[np.ndarray] = None
 
     @property
     def local_dim(self) -> int:
@@ -177,6 +182,8 @@ class BucketedShardedREData:
     raw_ids_by_key: Dict[int, str] = dataclasses.field(default_factory=dict)
     bucket_owners: Optional[np.ndarray] = None
     num_buckets: int = 0
+    projector: str = "INDEX_MAP"
+    projection_matrix: Optional[np.ndarray] = None
 
     @property
     def padded_elements(self) -> int:
@@ -272,6 +279,11 @@ def per_host_re_dataset(
     num_buckets: int = 4096,
     slab_build_only: bool = False,
     size_buckets: int = 1,
+    projector: str = "INDEX_MAP",
+    projection_matrix: Optional[np.ndarray] = None,
+    projection_dim: Optional[int] = None,
+    projection_seed: int = 1234567890,
+    projection_keep_intercept: bool = True,
 ) -> "ShardedREData | BucketedShardedREData":
     """Shuffle this host's rows to their entity owners and build the owned
     slabs. Every host calls this collectively (SPMD); the returned dataset's
@@ -289,7 +301,49 @@ def per_host_re_dataset(
     the global max active count); ``size_buckets>1`` returns
     :class:`BucketedShardedREData` with up to that many geometric
     active-count buckets, each padded only to its own collectively-agreed
-    width — the skew-proof layout for uncapped entity distributions."""
+    width — the skew-proof layout for uncapped entity distributions.
+
+    ``projector`` selects the per-entity local feature space
+    (projector/ProjectorType.scala:22-30 semantics):
+
+    - ``"INDEX_MAP"`` (default): each entity's local space is the features
+      it actually saw in training (IndexMapProjectorRDD.scala:30-119);
+    - ``"IDENTITY"``: the local space IS the global shard space (what the
+      factored coordinate requires — its latent matrix projects globally);
+    - ``"RANDOM"``: every row is projected through a shared Gaussian matrix
+      (ProjectionMatrix.scala:31-119) at slab-build time, so all entities
+      share one dense ``projection_dim``(+intercept)-wide space. The matrix
+      is derived deterministically from ``projection_seed`` (identical on
+      every host with no collective) unless ``projection_matrix`` is given.
+    """
+    if projector not in ("INDEX_MAP", "IDENTITY", "RANDOM"):
+        raise ValueError(f"unknown projector {projector!r}")
+    if projector == "RANDOM":
+        if projection_matrix is None:
+            if projection_dim is None:
+                raise ValueError(
+                    "RANDOM projector needs projection_dim (or a prebuilt "
+                    "projection_matrix)"
+                )
+            from photon_ml_tpu.projectors import (
+                gaussian_random_projection_matrix,
+            )
+
+            projection_matrix = gaussian_random_projection_matrix(
+                projection_dim, rows.global_dim,
+                keep_intercept=projection_keep_intercept,
+                seed=projection_seed,
+            )
+        projection_matrix = np.asarray(projection_matrix, real_dtype())
+        if projection_matrix.shape[1] != rows.global_dim:
+            raise ValueError(
+                f"projection matrix is {projection_matrix.shape}, dataset "
+                f"global_dim is {rows.global_dim}"
+            )
+        k_proj = projection_matrix.shape[0]
+    else:
+        projection_matrix = None
+        k_proj = 0
     n_dev = ctx.num_devices
     local = max(n_dev // num_processes, 1)
     keys = stable_entity_keys(rows.entity_raw_ids)
@@ -384,15 +438,35 @@ def per_host_re_dataset(
         # (RandomEffectDataSet.scala:298-301)
         scale = np.where(cnt > cap, cnt / cap, 1.0)
         wgt_eff = owgt * np.where(active, scale[inv], 1.0)
-        # per-entity active feature set -> local index map
-        a_rows = np.nonzero(active)[0]
-        pe = np.repeat(inv[a_rows], ofi.shape[1])
-        pf = ofi[a_rows].reshape(-1)
-        keep = pf >= 0
-        pair = np.unique(pe[keep].astype(np.int64) * rows.global_dim + pf[keep])
-        pair_e = (pair // rows.global_dim).astype(np.int64)
-        pair_f = (pair % rows.global_dim).astype(np.int64)
-        dims = np.bincount(pair_e, minlength=e_d)
+        # per-entity local feature space, by projector
+        xproj = None
+        if projector == "INDEX_MAP":
+            # active feature set -> per-entity compacted index map
+            a_rows = np.nonzero(active)[0]
+            pe = np.repeat(inv[a_rows], ofi.shape[1])
+            pf = ofi[a_rows].reshape(-1)
+            keep = pf >= 0
+            pair = np.unique(pe[keep].astype(np.int64) * rows.global_dim + pf[keep])
+            pair_e = (pair // rows.global_dim).astype(np.int64)
+            pair_f = (pair % rows.global_dim).astype(np.int64)
+            dims = np.bincount(pair_e, minlength=e_d)
+        elif projector == "IDENTITY":
+            # local index == global index; no per-entity compaction
+            pair_e = pair_f = np.zeros(0, np.int64)
+            dims = np.full(e_d, rows.global_dim, np.int64)
+        else:  # RANDOM: project every owned row through the shared matrix
+            pair_e = pair_f = np.zeros(0, np.int64)
+            dims = np.full(e_d, k_proj, np.int64)
+            nr_d = len(orow)
+            xproj = np.zeros((nr_d, k_proj), real_dtype())
+            pm_t = projection_matrix.T  # (D_global, k_proj)
+            for lo_r in range(0, nr_d, 8192):
+                sl = slice(lo_r, min(lo_r + 8192, nr_d))
+                fi_b = ofi[sl]
+                fv_b = ofv[sl]
+                cols = pm_t[np.maximum(fi_b, 0)]  # (B, K, k_proj)
+                vals = np.where(fi_b >= 0, fv_b, 0.0)
+                xproj[sl] = np.einsum("bk,bkp->bp", vals, cols)
         raw_ids = {}
         for e, first in enumerate(ent_start):
             b = np.ascontiguousarray(oraw[first]).view(np.uint8).tobytes()
@@ -402,7 +476,7 @@ def per_host_re_dataset(
                 keys=uniq, row=orow, inv=inv, rank=rank, active=active,
                 fi=ofi, fv=ofv, lab=olab, wgt=wgt_eff, off=ooff, cnt=cnt,
                 pair_e=pair_e, pair_f=pair_f, dims=dims, cap=cap,
-                raw_ids=raw_ids,
+                raw_ids=raw_ids, xproj=xproj,
             )
         )
 
@@ -523,31 +597,40 @@ def per_host_re_dataset(
         {f: [] for f in train_names} for _ in kept
     ]
     sblocks: Dict[str, List[np.ndarray]] = {f: [] for f in score_names}
+    k_sc = k_proj if projector == "RANDOM" else k  # scoring feature width
     for d in per_dev:
         e_d = len(d["keys"])
         nr = len(d["row"])
         # per-row local projection (shared by scoring + every bucket's
-        # training block): the sorted (entity, feature) composite lookup
-        li = lv = None
+        # training block)
+        li = lv = loc_idx = None
         if e_d:
-            ent_start_pairs = np.searchsorted(d["pair_e"], np.arange(e_d), side="left")
-            loc_idx = np.arange(len(d["pair_e"])) - ent_start_pairs[d["pair_e"]]
-            comp_keys = d["pair_e"] * rows.global_dim + d["pair_f"]
-            rr = np.repeat(np.arange(nr), d["fi"].shape[1])
-            cc = d["fi"].reshape(-1).astype(np.int64)
-            valid = cc >= 0
-            comp = d["inv"][rr].astype(np.int64) * rows.global_dim + cc
-            pos = np.searchsorted(comp_keys, comp)
-            pos_c = np.clip(pos, 0, max(len(comp_keys) - 1, 0))
-            hit = valid & (len(comp_keys) > 0) & (comp_keys[pos_c] == comp)
-            li = np.where(hit, loc_idx[pos_c], -1).reshape(nr, -1).astype(np.int32)
-            lv = np.where(hit.reshape(nr, -1), d["fv"], 0.0)
+            if projector == "INDEX_MAP":
+                # the sorted (entity, feature) composite lookup
+                ent_start_pairs = np.searchsorted(d["pair_e"], np.arange(e_d), side="left")
+                loc_idx = np.arange(len(d["pair_e"])) - ent_start_pairs[d["pair_e"]]
+                comp_keys = d["pair_e"] * rows.global_dim + d["pair_f"]
+                rr = np.repeat(np.arange(nr), d["fi"].shape[1])
+                cc = d["fi"].reshape(-1).astype(np.int64)
+                valid = cc >= 0
+                comp = d["inv"][rr].astype(np.int64) * rows.global_dim + cc
+                pos = np.searchsorted(comp_keys, comp)
+                pos_c = np.clip(pos, 0, max(len(comp_keys) - 1, 0))
+                hit = valid & (len(comp_keys) > 0) & (comp_keys[pos_c] == comp)
+                li = np.where(hit, loc_idx[pos_c], -1).reshape(nr, -1).astype(np.int32)
+                lv = np.where(hit.reshape(nr, -1), d["fv"], 0.0)
+            elif projector == "IDENTITY":
+                li = d["fi"].astype(np.int32)  # local index IS global index
+                lv = d["fv"]
+            else:  # RANDOM: rows are dense k_proj-vectors in the shared space
+                li = np.tile(np.arange(k_proj, dtype=np.int32), (nr, 1))
+                lv = d["xproj"]
         # scoring tensors: every owned row; entity slot = bucket base + rank
         # within the bucket (indexes the per-device CONCAT of bucket slabs)
         sri = np.full((r_max,), -1, np.int32)
         ssl = np.zeros((r_max,), np.int32)
-        sfi = np.full((r_max, k), -1, np.int32)
-        sfv = np.zeros((r_max, k), dt)
+        sfi = np.full((r_max, k_sc), -1, np.int32)
+        sfv = np.zeros((r_max, k_sc), dt)
         if e_d:
             gslot = bucket_base[pos_of_bucket[d["bidx"]]] + d["bslot"]
             sri[:nr] = d["row"].astype(np.int32)
@@ -577,10 +660,16 @@ def per_host_re_dataset(
                     emask[:n_b] = True
                     hi_d, lo_d = _pack_u64(d["keys"][sel_e])
                     ekeys[:n_b, 0], ekeys[:n_b, 1] = hi_d, lo_d
-                    pe_in = in_b[d["pair_e"]]
-                    l2g[
-                        d["bslot"][d["pair_e"][pe_in]], loc_idx[pe_in]
-                    ] = d["pair_f"][pe_in].astype(np.int32)
+                    if projector == "INDEX_MAP":
+                        pe_in = in_b[d["pair_e"]]
+                        l2g[
+                            d["bslot"][d["pair_e"][pe_in]], loc_idx[pe_in]
+                        ] = d["pair_f"][pe_in].astype(np.int32)
+                    elif projector == "IDENTITY":
+                        # local space == global space for every entity lane
+                        l2g[:n_b] = np.arange(dl_b, dtype=np.int32)
+                    # RANDOM: l2g stays -1 — back-projection goes through
+                    # the shared matrix, not a per-entity index map
                     # training rows: active rows of this bucket's entities
                     act = d["active"] & in_b[d["inv"]]
                     er = d["bslot"][d["inv"][act]]
@@ -640,6 +729,8 @@ def per_host_re_dataset(
             raw_ids_by_key=raw_ids,
             bucket_owners=owners,
             num_buckets=num_buckets,
+            projector=projector,
+            projection_matrix=projection_matrix,
         )
 
     bucket_slabs = [
@@ -674,6 +765,8 @@ def per_host_re_dataset(
         raw_ids_by_key=raw_ids,
         bucket_owners=owners,
         num_buckets=num_buckets,
+        projector=projector,
+        projection_matrix=projection_matrix,
     )
 
 
@@ -1235,6 +1328,18 @@ def _score_routed_rows_impl(
         if not keep.any():
             continue
         rr = np.nonzero(keep)[0]
+        if getattr(sd, "projection_matrix", None) is not None:
+            # RANDOM projector: project the routed row through the shared
+            # matrix and dot with the slab's k_proj-wide coefficients (the
+            # l2g prefix lookup below is INDEX_MAP/IDENTITY machinery)
+            pm_t = np.asarray(sd.projection_matrix).T  # (D_global, k_proj)
+            fi_r, fv_r = fi[rr], fv[rr]
+            cols = pm_t[np.maximum(fi_r, 0)]  # (R, K, k_proj)
+            vals = np.where(fi_r >= 0, fv_r, 0.0)
+            xp = np.einsum("bk,bkp->bp", vals, cols)
+            contrib = np.sum(w_d[slot[rr]] * xp, axis=1)
+            np.add.at(scores_local, bi[rr, 0], contrib)
+            continue
         l2g_rows = l_d[slot[rr]]  # (R, D_loc), -1 pad AFTER the valid prefix
         big = np.int64(np.iinfo(np.int32).max)
         l2g_sorted = np.where(l2g_rows >= 0, l2g_rows, big).astype(np.int64)

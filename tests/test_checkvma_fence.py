@@ -1,0 +1,80 @@
+"""The check_vma=False fence (VERDICT r4 #10).
+
+``shard_map(check_vma=False)`` turns off the varying-manual-axes validation
+JAX provides for free — on exactly the collectives where a silent sharding
+bug would corrupt results. Every site that opts out MUST therefore carry a
+compensating control: a sharded-vs-single-device equivalence test asserting
+the shard_map computes what the unsharded oracle computes.
+
+This meta-test makes that rule mechanical: every ``check_vma=False`` in the
+package must be registered below TOGETHER with the name of its paired
+equivalence test, and that test must actually exist in the named test
+module. Adding a new ``check_vma=False`` without extending the registry —
+or registering a test that does not exist — fails this file.
+"""
+
+import pathlib
+import re
+
+PKG = pathlib.Path(__file__).resolve().parents[1] / "photon_ml_tpu"
+TESTS = pathlib.Path(__file__).resolve().parent
+
+# file (relative to photon_ml_tpu/) -> list of
+#   (occurrences, test_module, test_name) — the paired equivalence test
+# asserting the shard_map's output equals the single-device oracle's.
+REGISTRY = {
+    "parallel/distributed.py": [
+        # DistributedRandomEffectSolver.update
+        (1, "test_parallel.py", "test_distributed_random_effect_matches_local"),
+        # DistributedFactoredRandomEffectCoordinate._build
+        (1, "test_parallel.py", "test_distributed_factored_matches_local"),
+    ],
+    "parallel/perhost_ingest.py": [
+        # PerHostRandomEffectSolver.update
+        (1, "test_perhost_ingest.py", "test_matches_unsharded_coordinate"),
+        # PerHostBucketedRandomEffectSolver.update
+        (1, "test_perhost_ingest.py", "test_bucketed_matches_monolithic"),
+    ],
+    "parallel/perhost_factored.py": [
+        # PerHostFactoredRandomEffectCoordinate.update
+        (1, "test_perhost_ingest.py",
+         "test_factored_perhost_matches_single_device"),
+    ],
+}
+
+
+def _sites():
+    found = {}
+    for f in sorted(PKG.rglob("*.py")):
+        n = 0
+        for line in f.read_text().splitlines():
+            if line.lstrip().startswith("#"):
+                continue  # rationale comments mention the flag; only count code
+            n += len(re.findall(r"check_vma\s*=\s*False", line))
+        if n:
+            found[str(f.relative_to(PKG))] = n
+    return found
+
+
+def test_every_check_vma_false_site_is_registered():
+    found = _sites()
+    registered = {k: sum(c for c, _, _ in v) for k, v in REGISTRY.items()}
+    assert found == registered, (
+        "check_vma=False sites changed without updating the fence.\n"
+        f"  in the package: {found}\n"
+        f"  registered:     {registered}\n"
+        "Every new site needs a paired sharded-vs-single-device equivalence "
+        "test registered in tests/test_checkvma_fence.py."
+    )
+
+
+def test_every_registered_equivalence_test_exists():
+    for rel, entries in REGISTRY.items():
+        for _, module, test_name in entries:
+            path = TESTS / module
+            assert path.exists(), f"{rel}: test module {module} missing"
+            text = path.read_text()
+            assert re.search(rf"def {re.escape(test_name)}\b", text), (
+                f"{rel}: paired equivalence test {module}::{test_name} "
+                "does not exist — the fence names a test that cannot run"
+            )

@@ -622,3 +622,170 @@ class TestBucketedPerHost:
         device_scores = np.asarray(buck.score(w_b))
         routed = score_routed_rows(bd, w_b, rows, glmix.num_rows, ctx)
         np.testing.assert_allclose(routed, device_scores, rtol=1e-4, atol=1e-5)
+
+
+class TestPerHostProjectors:
+    """Projector scope of the per-host ingest (ProjectorType.scala:22-30):
+    IDENTITY and RANDOM local spaces, built collectively, must agree with
+    the single-device build and with each other where the optima coincide.
+    The factored equivalence test here is the mandated compensating control
+    for check_vma=False on the PerHostFactoredRandomEffectCoordinate
+    shard_map (VERDICT r4 #10 fence)."""
+
+    def _fit(self, sd, ctx, l2=0.3):
+        cfg = OptimizerConfig(max_iterations=40, tolerance=1e-10)
+        solver = PerHostRandomEffectSolver(
+            sd, TaskType.LOGISTIC_REGRESSION, OptimizerType.LBFGS, cfg,
+            RegularizationContext.l2(l2), ctx,
+        )
+        resid = jnp.zeros((sd.num_rows,), jnp.float32)
+        w, _ = solver.update(resid, solver.initial_coefficients())
+        return solver, w
+
+    def test_identity_matches_index_map(self, glmix, ctx):
+        """IDENTITY and INDEX_MAP solve the same optimization in different
+        bases: unseen features get zero gradient and L2 pulls them to 0, so
+        the optima (and scores) coincide."""
+        rows = _host_rows_from_game(glmix, 0, glmix.num_rows)
+        sd_im = per_host_re_dataset(rows, ctx, projector="INDEX_MAP")
+        sd_id = per_host_re_dataset(rows, ctx, projector="IDENTITY")
+        assert sd_id.local_dim == rows.global_dim
+        # IDENTITY lanes carry the identity local->global map
+        mask = np.asarray(sd_id.entity_mask)
+        l2g = np.asarray(sd_id.local_to_global)
+        np.testing.assert_array_equal(
+            l2g[mask], np.tile(np.arange(rows.global_dim), (mask.sum(), 1))
+        )
+        _, w_im = self._fit(sd_im, ctx)
+        s_im = np.asarray(self._fit(sd_im, ctx)[0].score(w_im))
+        solver_id, w_id = self._fit(sd_id, ctx)
+        s_id = np.asarray(solver_id.score(w_id))
+        np.testing.assert_allclose(s_id, s_im, rtol=5e-4, atol=5e-4)
+
+    def test_random_matches_single_device_build(self, glmix, ctx):
+        """The per-host RANDOM build with a shared matrix must reproduce the
+        single-device RANDOM dataset's fit: same projected space -> same
+        optimum -> same scores; back-projection through the matrix gives
+        the saved global-space coefficients."""
+        from photon_ml_tpu.parallel.perhost_ingest import score_routed_rows
+        from photon_ml_tpu.projectors import (
+            ProjectionMatrixProjector,
+            gaussian_random_projection_matrix,
+        )
+
+        data = glmix
+        rows = _host_rows_from_game(data, 0, data.num_rows)
+        k_dim = 4
+        pm = gaussian_random_projection_matrix(
+            k_dim, rows.global_dim, keep_intercept=True, seed=77
+        )
+        sd = per_host_re_dataset(
+            rows, ctx, projector="RANDOM", projection_matrix=pm
+        )
+        assert sd.local_dim == pm.shape[0]
+        solver, w = self._fit(sd, ctx)
+        scores = np.asarray(solver.score(w))
+
+        cfg_ds = RandomEffectDataConfig(
+            "userId", "per_user", projector="RANDOM",
+            random_projection_dim=k_dim, seed=77,
+        )
+        re_ds = build_random_effect_dataset(
+            data, cfg_ds, projector=ProjectionMatrixProjector(jnp.asarray(pm))
+        )
+        opt_cfg = OptimizerConfig(max_iterations=40, tolerance=1e-10)
+        reg = RegularizationContext.l2(0.3)
+        local = RandomEffectCoordinate(
+            re_ds, TaskType.LOGISTIC_REGRESSION, OptimizerType.LBFGS,
+            opt_cfg, reg,
+        )
+        w_ref, _ = local.update(
+            jnp.zeros((data.num_rows,), jnp.float32),
+            local.initial_coefficients(),
+        )
+        ref_scores = np.asarray(local.score(w_ref))
+        np.testing.assert_allclose(scores, ref_scores, rtol=1e-3, atol=5e-4)
+        # routed scoring projects through the shared matrix on the host path
+        routed = score_routed_rows(sd, w, rows, data.num_rows, ctx)
+        np.testing.assert_allclose(routed, scores, rtol=1e-4, atol=1e-5)
+
+    def test_random_composes_with_buckets(self, glmix, ctx):
+        """size_buckets>1 + RANDOM: every bucket's slab lives in the shared
+        projected space and the bucketed solver scores identically to the
+        monolithic RANDOM solver."""
+        from photon_ml_tpu.parallel.perhost_ingest import (
+            PerHostBucketedRandomEffectSolver,
+        )
+
+        rows = _host_rows_from_game(glmix, 0, glmix.num_rows)
+        kwargs = dict(projector="RANDOM", projection_dim=4,
+                      projection_seed=13)
+        sd = per_host_re_dataset(rows, ctx, **kwargs)
+        bd = per_host_re_dataset(rows, ctx, size_buckets=4, **kwargs)
+        assert all(b.local_dim == sd.local_dim for b in bd.buckets)
+        cfg = OptimizerConfig(max_iterations=40, tolerance=1e-10)
+        reg = RegularizationContext.l2(0.3)
+        mono = PerHostRandomEffectSolver(
+            sd, TaskType.LOGISTIC_REGRESSION, OptimizerType.LBFGS, cfg, reg, ctx
+        )
+        buck = PerHostBucketedRandomEffectSolver(
+            bd, TaskType.LOGISTIC_REGRESSION, OptimizerType.LBFGS, cfg, reg, ctx
+        )
+        resid = jnp.zeros((glmix.num_rows,), jnp.float32)
+        w_m, _ = mono.update(resid, mono.initial_coefficients())
+        w_b, _ = buck.update(resid, buck.initial_coefficients())
+        np.testing.assert_allclose(
+            np.asarray(buck.score(w_b)), np.asarray(mono.score(w_m)),
+            rtol=5e-4, atol=5e-4,
+        )
+
+    def test_factored_perhost_matches_single_device(self, glmix, ctx):
+        """PerHostFactoredRandomEffectCoordinate (entity-sharded v, psum'd
+        latent refit) must reproduce the single-device
+        FactoredRandomEffectCoordinate on an IDENTITY dataset: same scores
+        and same latent matrix trajectory. THE compensating equivalence
+        test for its check_vma=False shard_map."""
+        from photon_ml_tpu.algorithm.factored_random_effect import (
+            FactoredRandomEffectCoordinate,
+            MFOptimizationConfig,
+        )
+        from photon_ml_tpu.parallel.perhost_factored import (
+            PerHostFactoredRandomEffectCoordinate,
+        )
+
+        data = glmix
+        rows = _host_rows_from_game(data, 0, data.num_rows)
+        sd = per_host_re_dataset(rows, ctx, projector="IDENTITY")
+        mf = MFOptimizationConfig(2, 3)
+        cfg = OptimizerConfig(max_iterations=25, tolerance=1e-10)
+        reg = RegularizationContext.l2(0.5)
+        fac = PerHostFactoredRandomEffectCoordinate(
+            sd, TaskType.LOGISTIC_REGRESSION, mf_config=mf,
+            re_optimizer_config=cfg, re_regularization=reg,
+            latent_optimizer_config=cfg, latent_regularization=reg, ctx=ctx,
+        )
+        resid = jnp.zeros((data.num_rows,), jnp.float32)
+        st, _ = fac.update(resid, fac.initial_coefficients())
+        scores = np.asarray(fac.score(st))
+
+        re_ds = build_random_effect_dataset(
+            data, RandomEffectDataConfig("userId", "per_user",
+                                         projector="IDENTITY")
+        )
+        oracle = FactoredRandomEffectCoordinate(
+            re_ds, TaskType.LOGISTIC_REGRESSION, mf_config=mf,
+            re_optimizer_config=cfg, re_regularization=reg,
+            latent_optimizer_config=cfg, latent_regularization=reg,
+        )
+        st_ref, _ = oracle.update(resid, oracle.initial_coefficients())
+        ref_scores = np.asarray(oracle.score(st_ref))
+        np.testing.assert_allclose(scores, ref_scores, rtol=2e-3, atol=2e-3)
+        np.testing.assert_allclose(
+            np.asarray(st.matrix), np.asarray(st_ref.matrix),
+            rtol=2e-3, atol=2e-3,
+        )
+        # flattened coefficients W = V M land on the save path per host
+        W = np.asarray(fac.random_effect_coefficients(st))
+        assert W.shape == (np.asarray(sd.entity_mask).shape[0], sd.global_dim)
+        factors = fac.latent_factors_by_raw_id(st)
+        assert len(factors) == sd.num_entities
