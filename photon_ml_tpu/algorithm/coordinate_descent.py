@@ -317,14 +317,36 @@ class CoordinateDescent:
         num_iterations: int,
         num_rows: int,
         checkpointer: Optional["CoordinateDescentCheckpointer"] = None,
+        initial_params: Optional[Dict[str, object]] = None,
     ) -> CoordinateDescentResult:
         """Run the descent; with a ``checkpointer``, state is saved after
         every coordinate update and a restart resumes from the last complete
         step (photon_ml_tpu.checkpoint — a designed upgrade, SURVEY.md §5.4:
-        the reference has no mid-run checkpointing)."""
+        the reference has no mid-run checkpointing).
+
+        ``initial_params`` warm-starts named coordinates from a previous
+        run's coefficients (the grid-sweep warm start,
+        ModelTraining.scala:158-191 semantics); missing names fall back to
+        the coordinate's own initialization. A restored checkpoint takes
+        precedence over both."""
         names = list(self.coordinates)
-        params = {n: self.coordinates[n].initial_coefficients() for n in names}
+        params = {
+            n: (
+                initial_params[n]
+                if initial_params is not None and n in initial_params
+                else self.coordinates[n].initial_coefficients()
+            )
+            for n in names
+        }
         scores = {n: jnp.zeros((num_rows,), real_dtype()) for n in names}
+        if initial_params is not None:
+            # warm-started coordinates contribute their CURRENT scores from
+            # step zero, so the first update already trains on residuals of
+            # the warm model (the point of the warm start) rather than on
+            # zero offsets
+            for n in names:
+                if n in initial_params:
+                    scores[n] = self.coordinates[n].score(params[n])
         # device scalars until the end of the run — converting per update
         # would serialize every dispatch on a host round-trip (weak over a
         # remote device tunnel); the reference pays the same sync as a Spark
@@ -338,6 +360,8 @@ class CoordinateDescent:
         timings = {} if self.fused_cycle else {n: 0.0 for n in names}
         trackers: Dict[str, object] = {}
         total = jnp.zeros((num_rows,), real_dtype())
+        for n in names:
+            total = total + scores[n]  # zeros unless warm-started above
 
         start_step = 0
         if checkpointer is not None:

@@ -12,10 +12,19 @@ fixed-effect model and metadata.
 
 This is the driver-contract completion of the reference's cluster driver
 (cli/game/training/Driver.scala:537 on Spark executors): same flag
-grammar, SPMD instead of driver/executor. Scope (v1, documented): a single
-grid combo, plain fixed + random-effect coordinates, and prebuilt feature
-index maps (``--offheap-indexmap-dir`` or a name-and-term path) — index
-vocabularies must not require a full-data scan on every host.
+grammar, SPMD instead of driver/executor. Scope (v2): the full coordinate
+grid (combo sweep with best-combo selection by the primary validation
+evaluator, Driver.scala:330-402 semantics; ``--grid-warm-start true``
+additionally seeds each combo from the previous combo's coefficients, the
+ModelTraining.scala:158-191 warm-start idea lifted to the combo axis —
+off by default so the sweep matches the single-process driver and the
+reference exactly), plain + bucketed + factored random-effect
+coordinates, all three projector types (INDEX_MAP / RANDOM / IDENTITY,
+projector/ProjectorType.scala:22-30), and prebuilt feature index maps
+(``--offheap-indexmap-dir`` or a name-and-term path) — index vocabularies
+must not require a full-data scan on every host. Datasets are ingested
+ONCE (they are combo-invariant); each combo binds fresh optimization
+problems to the shared slabs.
 
 Run (one process per host):
 
@@ -163,16 +172,31 @@ class MultihostFixedEffectCoordinate:
     def regularization_term(self, coefficients: Array) -> Array:
         return self.problem.regularization_term_value(coefficients)
 
+    def rebind(self, problem: GLMOptimizationProblem
+               ) -> "MultihostFixedEffectCoordinate":
+        """Shallow copy sharing the device-resident data arrays (and the
+        jitted score fn) but solving a DIFFERENT optimization problem —
+        what the combo grid needs: the design matrix uploads once, only
+        the per-combo problem binding changes."""
+        import copy
+
+        c = copy.copy(self)
+        c.problem = problem
+        c.solver = DistributedFixedEffectSolver(problem, self.ctx)
+        return c
+
 
 def _add_multihost_flags(argv: List[str]) -> Tuple[dict, List[str]]:
-    """Strip the --multihost-* flags; the rest is the normal game grammar."""
-    mh_args = {"coordinator": None, "num_processes": None, "process_id": None}
+    """Strip the --multihost-* / --grid-warm-start flags; the rest is the
+    normal game grammar."""
+    mh_args = {"coordinator": None, "num_processes": None, "process_id": None,
+               "grid_warm_start": False}
     rest: List[str] = []
     i = 0
     while i < len(argv):
         a = argv[i]
         if a in ("--multihost-coordinator", "--multihost-num-processes",
-                 "--multihost-process-id"):
+                 "--multihost-process-id", "--grid-warm-start"):
             if i + 1 >= len(argv):
                 raise ValueError(f"{a} requires a value")
             value = argv[i + 1]
@@ -180,6 +204,10 @@ def _add_multihost_flags(argv: List[str]) -> Tuple[dict, List[str]]:
                 mh_args["coordinator"] = value
             elif a == "--multihost-num-processes":
                 mh_args["num_processes"] = int(value)
+            elif a == "--grid-warm-start":
+                mh_args["grid_warm_start"] = value.strip().lower() in (
+                    "true", "1", "yes"
+                )
             else:
                 mh_args["process_id"] = int(value)
             i += 2
@@ -213,10 +241,6 @@ def main(argv: Optional[List[str]] = None) -> dict:
         os.path.join(p.output_dir, f"photon-ml-tpu-mh-{mh.process_id}.log")
     )
 
-    if len(p.config_grid()) != 1:
-        raise ValueError("multihost driver v1 trains a single grid combo")
-    if p.factored_configs or p.bucketed_random_effects:
-        raise ValueError("multihost driver v1: plain fixed + RE coordinates only")
     unsupported = [
         flag for flag, on in (
             ("--compute-variance", p.compute_variance),
@@ -226,17 +250,24 @@ def main(argv: Optional[List[str]] = None) -> dict:
     ]
     if unsupported:
         raise ValueError(
-            f"multihost driver v1 does not implement {unsupported} — "
-            "rejecting rather than silently ignoring"
+            f"multihost driver does not implement {unsupported} — "
+            "rejecting rather than silently ignoring (the sharded slabs "
+            "are non-addressable, so an outer jit over the whole cycle "
+            "cannot close over them)"
         )
     for cname, dc in p.random_effect_data_configs.items():
-        if dc.projector.upper() != "INDEX_MAP":
+        proj = dc.projector.upper()
+        if proj not in ("INDEX_MAP", "IDENTITY", "RANDOM"):
             raise ValueError(
-                f"multihost ingest implements the INDEX_MAP projector only; "
-                f"coordinate {cname!r} requests {dc.projector!r} — rejecting "
-                "rather than silently substituting"
+                f"coordinate {cname!r} requests unknown projector "
+                f"{dc.projector!r}"
             )
-    combo = p.config_grid()[0]
+        if proj == "RANDOM" and dc.random_projection_dim is None:
+            raise ValueError(
+                f"coordinate {cname!r}: RANDOM projector needs "
+                "random_projection_dim in its data configuration"
+            )
+    combos = p.config_grid()
 
     # ---- feature maps: prebuilt, shared, mmap'd ---------------------------
     shard_maps = {}
@@ -314,10 +345,10 @@ def main(argv: Optional[List[str]] = None) -> dict:
     labels_g = assemble_global(lambda gd: gd.response.astype(np.float32))
     weights_g = assemble_global(lambda gd: gd.weight.astype(np.float32))
 
-    # ---- build coordinates ------------------------------------------------
-    coords: Dict[str, object] = {}
+    # ---- build DATASETS once (combo-invariant) ----------------------------
+    fe_tensors: Dict[str, tuple] = {}
+    re_datasets: Dict[str, object] = {}
     for name in p.updating_sequence:
-        cfg = combo.get(name, CoordinateOptConfig())
         if name in p.fixed_effect_data_configs:
             spec = p.fixed_effect_data_configs[name]
             feats_parts, y_parts, o_parts, w_parts, id_parts = [], [], [], [], []
@@ -333,20 +364,30 @@ def main(argv: Optional[List[str]] = None) -> dict:
                 o_parts.append(gd.offset)
                 w_parts.append(gd.weight)
                 id_parts.append(file_base[ordinal] + np.arange(gd.num_rows))
-            problem = GLMOptimizationProblem(
-                p.task_type, cfg.optimizer, cfg.optimizer_config(),
-                cfg.regularization_context(),
-            )
-            coords[name] = MultihostFixedEffectCoordinate(
+            # upload ONCE: the device-resident coordinate is combo-invariant;
+            # each combo rebinds only its optimization problem (rebind())
+            fe_tensors[name] = MultihostFixedEffectCoordinate(
                 np.concatenate(feats_parts) if feats_parts else np.zeros((0, dim), np.float32),
                 np.concatenate(y_parts) if y_parts else np.zeros(0),
                 np.concatenate(o_parts) if o_parts else np.zeros(0),
                 np.concatenate(w_parts) if w_parts else np.zeros(0),
                 np.concatenate(id_parts) if id_parts else np.zeros(0, np.int64),
-                n_global, problem, ctx, mh,
+                n_global,
+                GLMOptimizationProblem(
+                    p.task_type, CoordinateOptConfig().optimizer,
+                    CoordinateOptConfig().optimizer_config(),
+                    CoordinateOptConfig().regularization_context(),
+                ),
+                ctx, mh,
             )
         else:
             dc = p.random_effect_data_configs[name]
+            if name in p.factored_configs and dc.projector.upper() != "IDENTITY":
+                raise ValueError(
+                    f"factored coordinate {name!r} requires an IDENTITY "
+                    f"projector in its data config (got {dc.projector!r}) — "
+                    "the latent matrix projects the global shard space"
+                )
             parts = []
             for ordinal, gd in gds:
                 f = gd.shards[dc.feature_shard_id]
@@ -364,58 +405,161 @@ def main(argv: Optional[List[str]] = None) -> dict:
             rows = concat_host_rows(
                 parts, len(shard_maps[dc.feature_shard_id])
             )
-            sd = per_host_re_dataset(
+            bucketed = (
+                p.bucketed_random_effects and name not in p.factored_configs
+            )
+            re_datasets[name] = per_host_re_dataset(
                 rows, ctx, mh.num_processes, mh.process_id,
                 active_upper_bound=dc.active_upper_bound,
-            )
-            coords[name] = PerHostRandomEffectSolver(
-                sd, p.task_type, cfg.optimizer, cfg.optimizer_config(),
-                cfg.regularization_context(), ctx,
+                size_buckets=8 if bucketed else 1,
+                projector=dc.projector.upper(),
+                projection_dim=dc.random_projection_dim,
+                projection_seed=dc.seed,
+                projection_keep_intercept=dc.random_projection_intercept,
             )
 
-    # ---- descent ----------------------------------------------------------
+    def build_coords(combo: Dict[str, CoordinateOptConfig]) -> Dict[str, object]:
+        from photon_ml_tpu.parallel.perhost_factored import (
+            PerHostFactoredRandomEffectCoordinate,
+        )
+        from photon_ml_tpu.parallel.perhost_ingest import (
+            BucketedShardedREData,
+            PerHostBucketedRandomEffectSolver,
+        )
+
+        coords: Dict[str, object] = {}
+        for name in p.updating_sequence:
+            cfg = combo.get(name, CoordinateOptConfig())
+            if name in p.fixed_effect_data_configs:
+                coords[name] = fe_tensors[name].rebind(
+                    GLMOptimizationProblem(
+                        p.task_type, cfg.optimizer, cfg.optimizer_config(),
+                        cfg.regularization_context(),
+                    )
+                )
+            elif name in p.factored_configs:
+                from photon_ml_tpu.algorithm.factored_random_effect import (
+                    MFOptimizationConfig,
+                )
+
+                spec = p.factored_configs[name]
+                coords[name] = PerHostFactoredRandomEffectCoordinate(
+                    re_datasets[name], p.task_type,
+                    mf_config=MFOptimizationConfig(
+                        spec.mf_num_iterations, spec.latent_dim
+                    ),
+                    re_optimizer=spec.random_effect.optimizer,
+                    re_optimizer_config=spec.random_effect.optimizer_config(),
+                    re_regularization=spec.random_effect.regularization_context(),
+                    latent_optimizer=spec.latent_factor.optimizer,
+                    latent_optimizer_config=spec.latent_factor.optimizer_config(),
+                    latent_regularization=spec.latent_factor.regularization_context(),
+                    ctx=ctx,
+                )
+            else:
+                sd = re_datasets[name]
+                solver_cls = (
+                    PerHostBucketedRandomEffectSolver
+                    if isinstance(sd, BucketedShardedREData)
+                    else PerHostRandomEffectSolver
+                )
+                coords[name] = solver_cls(
+                    sd, p.task_type, cfg.optimizer, cfg.optimizer_config(),
+                    cfg.regularization_context(), ctx,
+                )
+        return coords
+
+    # ---- validation data decoded once (combo-invariant) -------------------
+    val_data = None
+    if p.validate_input_dirs:
+        val_data = _decode_validation(p, mh, ctx, shard_maps, needed_shards,
+                                      id_types)
+
+    # ---- warm-started grid sweep ------------------------------------------
     from photon_ml_tpu.algorithm.coordinate_descent import CoordinateDescent
+    from photon_ml_tpu.evaluation.evaluators import evaluator_for
+    from photon_ml_tpu.cli.game_training_driver import _default_evaluators
 
     loss = losses_mod.for_task(p.task_type)
     loss_fn = lambda scores: jnp.sum(weights_g * loss.loss(scores, labels_g))
-    cd = CoordinateDescent(coords, loss_fn)
-    checkpointer = None
-    if p.checkpoint_dir:
-        from photon_ml_tpu.checkpoint import (
-            CoordinateDescentCheckpointer,
-            fingerprint,
-        )
-
-        # multihost-safe: sharded leaves are allgathered for the write, the
-        # coordinator writes, barriers fence (checkpoint.py multihost mode)
-        checkpointer = CoordinateDescentCheckpointer(
-            p.checkpoint_dir,
-            run_fingerprint=fingerprint({
-                "multihost": mh.num_processes,
-                "coordinates": p.updating_sequence,
-                "num_rows": n_global,
-                # a config change must NOT silently resume the old run
-                # (same rule as the single-process driver's fingerprint)
-                "configs": {k: str(v) for k, v in combo.items()},
-            }),
-            multihost=mh,
-        )
-    result = cd.run(
-        num_iterations=p.num_iterations, num_rows=n_global,
-        checkpointer=checkpointer,
+    specs = p.evaluators or _default_evaluators(p.task_type)
+    primary = specs[0]
+    primary_key = (
+        primary[0].value if primary[1] is None
+        else f"{primary[0].value}@{primary[1]}"
     )
-    logger.info(
-        f"objective history: "
-        + " ".join(f"{v:.6g}" for v in result.objective_history)
-    )
+    primary_ev = evaluator_for(primary[0], primary[1] or 10)
 
-    # ---- validation metrics (per-host decode + routed scoring) ------------
-    metrics: Dict[str, float] = {}
-    if p.validate_input_dirs:
-        metrics = _validate(
-            p, mh, ctx, shard_maps, needed_shards, id_types,
-            coords=coords, result=result, logger=logger,
+    best_index = 0
+    best_value: Optional[float] = None
+    best_result = None
+    best_coords = None
+    all_metrics: List[Dict[str, float]] = []
+    prev_coefficients = None
+    for i, combo in enumerate(combos):
+        coords = build_coords(combo)
+        checkpointer = None
+        if p.checkpoint_dir:
+            from photon_ml_tpu.checkpoint import (
+                CoordinateDescentCheckpointer,
+                fingerprint,
+            )
+
+            # multihost-safe: sharded leaves are allgathered for the write,
+            # the coordinator writes, barriers fence (checkpoint.py
+            # multihost mode)
+            checkpointer = CoordinateDescentCheckpointer(
+                os.path.join(p.checkpoint_dir, f"combo-{i}"),
+                run_fingerprint=fingerprint({
+                    "multihost": mh.num_processes,
+                    "coordinates": p.updating_sequence,
+                    "num_rows": n_global,
+                    "combo": i,
+                    "warm_start": mh_args["grid_warm_start"],
+                    # a config change must NOT silently resume the old run
+                    # (same rule as the single-process driver's fingerprint)
+                    "configs": {k: str(v) for k, v in combo.items()},
+                }),
+                multihost=mh,
+            )
+        cd = CoordinateDescent(coords, loss_fn)
+        result = cd.run(
+            num_iterations=p.num_iterations, num_rows=n_global,
+            checkpointer=checkpointer,
+            initial_params=(
+                prev_coefficients if mh_args["grid_warm_start"] else None
+            ),
         )
+        prev_coefficients = result.coefficients
+        logger.info(
+            f"combo {i}: objective history "
+            + " ".join(f"{v:.6g}" for v in result.objective_history)
+        )
+        metrics: Dict[str, float] = {}
+        if val_data is not None:
+            metrics = _validate(
+                p, mh, ctx, coords=coords, result=result, logger=logger,
+                val_data=val_data,
+            )
+            logger.info(
+                f"combo {i} validation: "
+                + " ".join(f"{k}={v:.6g}" for k, v in metrics.items())
+            )
+        all_metrics.append(metrics)
+        if metrics and primary_key in metrics:
+            value = metrics[primary_key]
+            if best_value is None or primary_ev.better_than(value, best_value):
+                best_value, best_index = value, i
+                best_result, best_coords = result, coords
+        elif best_result is None:
+            best_result, best_coords = result, coords
+    if len(combos) > 1:
+        logger.info(
+            f"best combo: {best_index}"
+            + (f" ({primary_key}={best_value:.6g})" if best_value is not None else "")
+        )
+    result, coords = best_result, best_coords
+    metrics = all_metrics[best_index]
 
     # ---- save (reference layout; RE parts written per host) ---------------
     out = os.path.join(p.output_dir, "best")
@@ -435,6 +579,12 @@ def main(argv: Optional[List[str]] = None) -> dict:
                     shard_maps[spec.feature_shard_id],
                     feature_shard_id=spec.feature_shard_id,
                 )
+        elif name in p.factored_configs:
+            dc = p.random_effect_data_configs[name]
+            _save_factored_parts(
+                out, name, p, dc, coord, w,
+                shard_maps[dc.feature_shard_id], mh,
+            )
         else:
             dc = p.random_effect_data_configs[name]
             _save_random_effect_parts(
@@ -446,6 +596,8 @@ def main(argv: Optional[List[str]] = None) -> dict:
     return {
         "objective_history": result.objective_history,
         "validation_metrics": metrics,
+        "all_metrics": all_metrics,
+        "best_index": best_index,
         "num_rows": n_global,
         "process_id": mh.process_id,
         "output": out,
@@ -466,6 +618,8 @@ def _save_random_effect_parts(out, name, p, dc, coord, w, imap, mh):
         _model_record,
     )
 
+    from photon_ml_tpu.parallel.perhost_ingest import BucketedShardedREData
+
     sd = coord.data
     base = os.path.join(out, RANDOM_EFFECT, name)
     if mh.coordinator_only_io():
@@ -475,22 +629,41 @@ def _save_random_effect_parts(out, name, p, dc, coord, w, imap, mh):
     mh.barrier(f"re-dir-{name}")
     # this host's slab rows (addressable shards of the sharded arrays);
     # raw ids rode the exchange (ShardedREData.raw_ids_by_key), so the
-    # OWNER can name every entity it holds without any model gather
-    local = {}
-    for arr, field in ((w, "w"), (sd.entity_keys, "keys"),
-                       (sd.entity_mask, "mask"), (sd.local_to_global, "l2g")):
-        # local_shards orders by slab position so the four arrays' lanes
-        # align (addressable_shards iteration order is unspecified)
-        local[field] = np.concatenate(local_shards(arr))
+    # OWNER can name every entity it holds without any model gather.
+    # Bucketed datasets contribute one group per size bucket (the
+    # coefficients arrive as the solver's per-bucket tuple).
+    if isinstance(sd, BucketedShardedREData):
+        groups = [
+            (wb, b.entity_keys, b.entity_mask, b.local_to_global)
+            for b, wb in zip(sd.buckets, w)
+        ]
+    else:
+        groups = [(w, sd.entity_keys, sd.entity_mask, sd.local_to_global)]
+    pm = getattr(sd, "projection_matrix", None)
     records = []
-    mask = local["mask"].astype(bool)
-    for lane in np.nonzero(mask)[0]:
-        key = int(_unpack_u64(local["keys"][lane, :1], local["keys"][lane, 1:2])[0])
-        raw = sd.raw_ids_by_key[key]
-        dense = np.zeros(sd.global_dim, np.float32)
-        valid = local["l2g"][lane] >= 0
-        dense[local["l2g"][lane][valid]] = local["w"][lane][valid]
-        records.append(_model_record(raw, p.task_type, dense, None, imap))
+    for warr, karr, marr, larr in groups:
+        local = {}
+        for arr, field in ((warr, "w"), (karr, "keys"),
+                           (marr, "mask"), (larr, "l2g")):
+            # local_shards orders by slab position so the four arrays' lanes
+            # align (addressable_shards iteration order is unspecified)
+            local[field] = np.concatenate(local_shards(arr))
+        mask = local["mask"].astype(bool)
+        for lane in np.nonzero(mask)[0]:
+            key = int(_unpack_u64(local["keys"][lane, :1], local["keys"][lane, 1:2])[0])
+            raw = sd.raw_ids_by_key[key]
+            if pm is not None:
+                # RANDOM projector: coefficients live in the shared
+                # projected space — back-project through the matrix
+                # (RandomEffectModelInProjectedSpace.toRandomEffectModel)
+                dense = np.asarray(pm).T @ np.asarray(
+                    local["w"][lane], np.float32
+                )
+            else:
+                dense = np.zeros(sd.global_dim, np.float32)
+                valid = local["l2g"][lane] >= 0
+                dense[local["l2g"][lane][valid]] = local["w"][lane][valid]
+            records.append(_model_record(raw, p.task_type, dense, None, imap))
     avro_io.write_container(
         os.path.join(base, COEFFICIENTS, f"part-{mh.process_id:05d}.avro"),
         records,
@@ -498,24 +671,77 @@ def _save_random_effect_parts(out, name, p, dc, coord, w, imap, mh):
     )
 
 
+def _save_factored_parts(out, name, p, dc, coord, state, imap, mh):
+    """Factored random effect under multihost: each host writes ITS
+    entities' flattened-W coefficients part AND latent-factor part; the
+    coordinator writes the shared latent matrix + id-info (the factored
+    STRUCTURE persists, model_io.save_factored_random_effect layout —
+    AvroUtils.scala:244-266 semantics, per-host part files)."""
+    from photon_ml_tpu.io import avro as avro_io
+    from photon_ml_tpu.io import schemas
+    from photon_ml_tpu.io.model_io import (
+        ID_INFO,
+        LATENT_FACTORS,
+        LATENT_MATRIX,
+        RANDOM_EFFECT,
+        save_latent_factors,
+    )
+
+    base = os.path.join(out, RANDOM_EFFECT, name)
+    if mh.coordinator_only_io():
+        os.makedirs(os.path.join(base, LATENT_FACTORS), exist_ok=True)
+        matrix = np.asarray(jax.device_get(state.matrix), np.float32)
+        save_latent_factors(
+            os.path.join(base, LATENT_MATRIX),
+            {str(k): matrix[k] for k in range(matrix.shape[0])},
+        )
+    mh.barrier(f"fre-dir-{name}")
+    # flattened W = V M part (scoring compat) via the shared RE writer
+    w_flat = coord.random_effect_coefficients(state)
+    _save_random_effect_parts(out, name, p, dc, coord, w_flat, imap, mh)
+    # the factored marker goes LAST: the shared RE writer writes the plain
+    # 2-line id-info, and is_factored_random_effect keys off the 3rd line
+    if mh.coordinator_only_io():
+        import json as _json
+
+        from photon_ml_tpu.io.model_io import (
+            LATENT_MATRIX_FEATURES,
+            _split_key,
+        )
+
+        with open(os.path.join(base, ID_INFO), "w") as f:
+            f.write(f"{dc.random_effect_id}\n{dc.feature_shard_id}\nfactored\n")
+        # column -> feature-key binding (same artifact as the single-process
+        # save): lets a consumer with a different index map realign columns
+        pairs = [
+            list(_split_key(imap.get_feature_name(j) or str(j)))
+            for j in range(matrix.shape[1])
+        ]
+        with open(os.path.join(base, LATENT_MATRIX_FEATURES), "w") as f:
+            _json.dump({"columns": pairs}, f)
+    # this host's latent factors part
+    factors = coord.latent_factors_by_raw_id(state)
+    recs = [
+        {"effectId": str(eid), "latentFactor": [float(v) for v in vec]}
+        for eid, vec in sorted(factors.items())
+    ]
+    avro_io.write_container(
+        os.path.join(base, LATENT_FACTORS, f"part-{mh.process_id:05d}.avro"),
+        recs,
+        schemas.LATENT_FACTOR,
+    )
 
 
-def _validate(p, mh, ctx, shard_maps, needed_shards, id_types, coords,
-              result, logger):
-    """Validation metrics under multihost: each host decodes only its slice
-    of the validation files; fixed-effect margins are computed locally (the
-    model is replicated) and random-effect rows are ROUTED to their
-    entity's owner with the training shuffle's agreed owner map
-    (score_routed_rows) — cold entities/features contribute 0. Scores merge
-    with one collective sum; every host computes the same metric values and
-    the coordinator logs them."""
+
+
+def _decode_validation(p, mh, ctx, shard_maps, needed_shards, id_types):
+    """Per-host decode of the validation slice + the merged replicated
+    label/weight/offset vectors — combo-invariant, decoded ONCE per run."""
     from photon_ml_tpu.cli.game_training_driver import (
         _default_evaluators,
         _input_files,
         resolve_date_range_dirs,
     )
-    from photon_ml_tpu.evaluation.evaluators import evaluator_for
-    from photon_ml_tpu.parallel.perhost_ingest import score_routed_rows
 
     specs = p.evaluators or _default_evaluators(p.task_type)
     grouped_ids = sorted({idn for _, _, idn in specs if idn is not None})
@@ -546,9 +772,42 @@ def _validate(p, mh, ctx, shard_maps, needed_shards, id_types, coords,
             vgds, file_base, nv, ctx, mh.num_processes, vec_per_gd
         )
 
-    labels_v = merge(lambda gd: gd.response.astype(np.float32))
-    weights_v = merge(lambda gd: gd.weight.astype(np.float32))
-    offsets_v = merge(lambda gd: gd.offset.astype(np.float32))
+    return {
+        "specs": specs,
+        "grouped_ids": grouped_ids,
+        "vgds": vgds,
+        "file_base": file_base,
+        "nv": nv,
+        "labels": merge(lambda gd: gd.response.astype(np.float32)),
+        "weights": merge(lambda gd: gd.weight.astype(np.float32)),
+        "offsets": merge(lambda gd: gd.offset.astype(np.float32)),
+    }
+
+
+def _validate(p, mh, ctx, coords, result, logger, val_data):
+    """Validation metrics under multihost: each host decodes only its slice
+    of the validation files; fixed-effect margins are computed locally (the
+    model is replicated) and random-effect rows are ROUTED to their
+    entity's owner with the training shuffle's agreed owner map
+    (score_routed_rows) — cold entities/features contribute 0. Factored
+    coordinates route against the flattened W = V M slab; bucketed
+    coordinates against the per-bucket tuple. Scores merge with one
+    collective sum; every host computes the same metric values and the
+    coordinator logs them."""
+    from photon_ml_tpu.evaluation.evaluators import evaluator_for
+    from photon_ml_tpu.parallel.perhost_factored import (
+        PerHostFactoredRandomEffectCoordinate,
+    )
+    from photon_ml_tpu.parallel.perhost_ingest import score_routed_rows
+
+    specs = val_data["specs"]
+    grouped_ids = val_data["grouped_ids"]
+    vgds = val_data["vgds"]
+    file_base = val_data["file_base"]
+    nv = val_data["nv"]
+    labels_v = val_data["labels"]
+    weights_v = val_data["weights"]
+    offsets_v = val_data["offsets"]
 
     scores = offsets_v.astype(np.float64).copy()
     for name in p.updating_sequence:
@@ -582,9 +841,11 @@ def _validate(p, mh, ctx, shard_maps, needed_shards, id_types, coords,
                     feat_idx=fi, feat_val=fv,
                     global_dim=f.dim,
                 ))
-            vrows = concat_host_rows(
-                parts, len(shard_maps[dc.feature_shard_id])
-            )
+            vrows = concat_host_rows(parts, coord.data.global_dim)
+            if isinstance(coord, PerHostFactoredRandomEffectCoordinate):
+                # route against the flattened per-entity coefficients
+                # W = V M (IDENTITY local space, so the l2g lookup is exact)
+                w = coord.random_effect_coefficients(w)
             scores += score_routed_rows(
                 coord.data, w, vrows, nv, ctx, mh.num_processes, mh.process_id
             )
@@ -603,10 +864,6 @@ def _validate(p, mh, ctx, shard_maps, needed_shards, id_types, coords,
             kwargs["group_ids"] = group_cols[id_name]
         key = etype.value if k is None else f"{etype.value}@{k}"
         metrics[key] = float(ev.evaluate(s, **kwargs))
-    if mh.coordinator_only_io():
-        logger.info(
-            "validation: " + " ".join(f"{k}={v:.6g}" for k, v in metrics.items())
-        )
     return metrics
 
 
