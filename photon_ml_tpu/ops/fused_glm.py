@@ -466,12 +466,19 @@ def _time_value_and_grad(vg_fn, w0, data, iters: int = 16) -> float:
         return lax.scan(step, w, None, length=iters)
 
     scan = jax.jit(run)
-    jax.block_until_ready(scan(w0, data))  # compile + warm
+    w = jax.block_until_ready(scan(w0, data))[0]  # compile + warm
     best = float("inf")
     for _ in range(3):
+        # each repeat feeds the PREVIOUS repeat's final w: identical-input
+        # repeats could be served by a caching/memoizing execution layer in
+        # a remote-device stack and report microsecond "passes" (observed in
+        # the r5 phase-2 autotune report: 3e-6 s/pass for a 256 MB stream,
+        # ~1000x off); a fresh carry makes every timed call novel work
         t0 = time.perf_counter()
-        jax.block_until_ready(scan(w0, data))
+        out = scan(w, data)
+        jax.block_until_ready(out)
         best = min(best, (time.perf_counter() - t0) / iters)
+        w = out[0]
     return best
 
 

@@ -483,15 +483,16 @@ def tron_minimize_streaming(
     while reason == 0:
         step, r = truncated_cg(w, reduced_grad(w, g), delta)
         w_trial = w + step
-        clipped = False
         if bounds is not None:
-            w_clip = jnp.clip(w_trial, bounds[0], bounds[1])
-            clipped = bool(jnp.any(w_clip != w_trial))
-            w_trial = w_clip
-        if clipped:
-            # measure the model on the step actually taken (kernel comment:
-            # else improving clipped steps are rejected forever). Costs one
-            # extra streamed pass — paid ONLY when clipping changed the step
+            # mirror the kernel EXACTLY (optim/tron.py:185-193): whenever
+            # bounds are set, measure the quadratic model on the (possibly
+            # clipped) step with a FRESH Hv pass. The CG residual r was
+            # built from the REDUCED gradient, so even an UNCLIPPED step's
+            # -0.5*(g.s - s.r) differs from -(g.s + 0.5 s.Hs) by
+            # 0.5*(g_red - g).s at active bounds — using it would flip
+            # accept/shrink decisions near the eta thresholds and diverge
+            # from the kernel trajectory
+            w_trial = jnp.clip(w_trial, bounds[0], bounds[1])
             step = w_trial - w
             snorm = float(jnp.linalg.norm(step))
             gs = float(jnp.dot(g, step))

@@ -166,11 +166,24 @@ def main():
     )
     resid = jnp.zeros((n_rows,), jnp.float32)
     t0 = time.perf_counter()
-    w, _ = solver.update(resid, solver.initial_coefficients())
+    w, results = solver.update(resid, solver.initial_coefficients())
     jax.block_until_ready(w)
     t_solve = time.perf_counter() - t0
     log(f"update done in {t_solve:.1f}s ({e_tot:,} entity solves, "
         f"{e_tot * d_loc:,} coefficients trained)")
+    # per-entity iteration stats (VERDICT r4 weak #6): with the vmapped
+    # while_loop, every lane of a device slab pays the SLOWEST lane's
+    # iteration count — the waste ratio quantifies the §7.3 hazard
+    it = np.asarray(jax.device_get(results.iterations)).astype(np.int64)
+    waste = float(it.max() * it.size / max(it.sum(), 1))
+    log(
+        f"per-entity iterations: min {it.min()}, median "
+        f"{int(np.median(it))}, mean {it.mean():.2f}, max {it.max()} — "
+        f"vmapped-lane waste {waste:.2f}x (max-lane cost / useful work); "
+        "uniform s=1 entities converge in lockstep, so the single-slab "
+        "layout wastes nothing HERE — the skew phase below is where "
+        "bucketing earns its keep"
+    )
 
     t0 = time.perf_counter()
     scores = solver.score(w)
@@ -189,6 +202,88 @@ def main():
     log(f"OK: {e_tot * d_loc:,} coefficients (mean |w| = {nz:.4f}), "
         f"{n_dev} devices, update {t_solve:.1f}s, score {t_score:.1f}s")
 
+    skew_phase(ctx)
+
+
+def skew_phase(ctx):
+    """Skewed-distribution phase (VERDICT r4 weak #6): one 1024-sample
+    entity among 2^13-1 singletons, solved through the MONOLITHIC slab
+    (every entity padded to 1024 samples) vs the size-BUCKETED slabs —
+    reporting the padded-element ratio and per-entity iteration spread
+    that make the bucketed layout the right §7.3 answer. (The scale is
+    deliberately modest: the POINT is that the monolithic layout already
+    pads ~1000x here — at the coefficient-scale phase's entity count it
+    simply could not be built.)"""
+    from photon_ml_tpu.parallel.perhost_ingest import (
+        HostRows,
+        PerHostBucketedRandomEffectSolver,
+        per_host_re_dataset,
+    )
+
+    rng = np.random.default_rng(5)
+    singles, giant_rows, d, k = (1 << 13) - 1, 1024, 16, 8
+    n = singles + giant_rows
+    ids = ["giant"] * giant_rows + [f"s{i}" for i in range(singles)]
+    fi = np.tile(np.arange(k, dtype=np.int32), (n, 1))
+    fv = rng.normal(size=(n, k)).astype(np.float32)
+    rows = HostRows(
+        entity_raw_ids=ids,
+        row_index=np.arange(n, dtype=np.int64),
+        labels=(rng.random(n) < 0.5).astype(np.float32),
+        weights=np.ones(n, np.float32),
+        offsets=np.zeros(n, np.float32),
+        feat_idx=fi, feat_val=fv, global_dim=d,
+    )
+    resid = jnp.zeros((n,), jnp.float32)
+    cfg = OptimizerConfig(max_iterations=8, tolerance=1e-6)
+    reg = RegularizationContext.l2(1.0)
+    stats = {}
+    for layout, size_buckets in (("monolithic", 1), ("bucketed", 8)):
+        t0 = time.perf_counter()
+        sd = per_host_re_dataset(rows, ctx, size_buckets=size_buckets)
+        t_build = time.perf_counter() - t0
+        if size_buckets == 1:
+            padded = int(np.prod(sd.x.shape))
+            solver = PerHostRandomEffectSolver(
+                sd, TaskType.LOGISTIC_REGRESSION, OptimizerType.LBFGS,
+                cfg, reg, ctx,
+            )
+        else:
+            padded = sd.padded_elements
+            solver = PerHostBucketedRandomEffectSolver(
+                sd, TaskType.LOGISTIC_REGRESSION, OptimizerType.LBFGS,
+                cfg, reg, ctx,
+            )
+        t0 = time.perf_counter()
+        w, results = solver.update(resid, solver.initial_coefficients())
+        jax.block_until_ready(w)
+        t_solve = time.perf_counter() - t0
+        from photon_ml_tpu.optim.common import OptResult
+
+        # OptResult IS a (Named)tuple — test for it FIRST, else iterating
+        # "the tuple" walks the result's fields
+        groups = (results,) if isinstance(results, OptResult) else tuple(results)
+        its = np.concatenate([
+            np.asarray(jax.device_get(r.iterations)).reshape(-1)
+            for r in groups
+        ]).astype(np.int64)
+        stats[layout] = (padded, t_build, t_solve)
+        log(
+            f"skew[{layout}]: x-slab {padded:,} padded elements, build "
+            f"{t_build:.1f}s, solve {t_solve:.1f}s; per-entity iterations "
+            f"min {its.min()} / median {int(np.median(its))} / max {its.max()}"
+        )
+    ratio = stats["monolithic"][0] / max(stats["bucketed"][0], 1)
+    speedup = stats["monolithic"][2] / max(stats["bucketed"][2], 1e-9)
+    log(
+        f"skew summary: bucketed slabs are {ratio:.0f}x smaller and the "
+        f"solve is {speedup:.1f}x faster than the global-max-padded layout "
+        f"(one {giant_rows}-sample entity among {singles} singletons)"
+    )
+
 
 if __name__ == "__main__":
-    main()
+    if "--skew-only" in sys.argv:
+        skew_phase(MeshContext(data_mesh()))
+    else:
+        main()

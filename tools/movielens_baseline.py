@@ -40,6 +40,13 @@ N_MOVIES = 3_706
 N_GENRES = 18
 D_MOVIE = N_GENRES + 3  # genres + year + popularity + intercept-less numerics
 
+# dataset shapes (rows, users, movies) — ml20m is the MovieLens-20M shape
+# (VERDICT r4 #7: the size where bucketing/sharding actually gets exercised)
+SCALES = {
+    "ml1m": (1_000_209, 6_040, 3_706),
+    "ml20m": (20_000_263, 138_493, 26_744),
+}
+
 
 def log(msg):
     print(f"[{time.strftime('%H:%M:%S')}] {msg}", flush=True)
@@ -123,15 +130,30 @@ def write_avro(dirpath, users, movies, x, label, rows_slice, parts=4):
 
 
 def main():
+    global N_RATINGS, N_USERS, N_MOVIES
+
     ap = argparse.ArgumentParser()
-    ap.add_argument("--rows", type=int, default=N_RATINGS)
+    ap.add_argument("--scale", choices=sorted(SCALES), default="ml1m",
+                    help="dataset shape: ml1m (default) or ml20m "
+                         "(20,000,263 ratings / 138,493 users / 26,744 movies)")
+    ap.add_argument("--rows", type=int, default=None,
+                    help="override row count (default: the scale's)")
     ap.add_argument("--out", default="/tmp/ml1m_baseline")
     ap.add_argument("--iterations", type=int, default=2)
     ap.add_argument("--active-cap", type=int, default=512)
     ap.add_argument("--full-game", action="store_true",
                     help="BASELINE config-5 shape: + per-movie RE + factored "
                          "MF coordinate (latent 4)")
+    ap.add_argument("--bucketed", action="store_true",
+                    help="size-bucketed random-effect slabs (+ --distributed "
+                         "entity sharding when devices > 1) — the skew-proof "
+                         "path the 20M scale exercises")
+    ap.add_argument("--distributed", action="store_true",
+                    help="entity/row sharding over the visible device mesh")
     ns = ap.parse_args()
+    N_RATINGS, N_USERS, N_MOVIES = SCALES[ns.scale]
+    if ns.rows is None:
+        ns.rows = N_RATINGS
 
     rng = np.random.default_rng(20260730)
     t0 = time.time()
@@ -189,6 +211,10 @@ def main():
             "--random-effect-data-configurations",
             f"per-user:userId,per_user,4,{ns.active_cap},0,-1,index_map",
         ]
+    if ns.bucketed:
+        args += ["--bucketed-random-effects", "true"]
+    if ns.distributed:
+        args += ["--distributed", "true"]
     t0 = time.time()
     driver = game_main(args)
     wall = time.time() - t0
@@ -197,22 +223,26 @@ def main():
     # per-iteration cost: total train phase over coordinate-descent iterations
     sec_per_iter = driver.timer.totals.get("train", wall) / ns.iterations
     platform = jax.devices()[0].platform
+    import resource
+
+    peak_rss_gb = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss / 1e6
     log(f"done: AUC={auc:.4f}, {sec_per_iter:.1f}s/iter "
-        f"(wall {wall:.0f}s, platform={platform})")
+        f"(wall {wall:.0f}s, platform={platform}, peak RSS {peak_rss_gb:.1f} GB)")
 
     baseline_path = os.path.join(REPO, "BASELINE.json")
     with open(baseline_path) as f:
         baseline = json.load(f)
+    scale_tag = "movielens1m" if ns.scale == "ml1m" else "movielens20m"
     entry_key = (
-        "config5_full_game_movielens1m_scale" if ns.full_game
-        else "config4_movielens1m_scale"
+        f"config5_full_game_{scale_tag}_scale" if ns.full_game
+        else f"config4_{scale_tag}_scale"
     )
     baseline.setdefault("published", {})[entry_key] = {
         "dataset": (
-            f"synthetic MovieLens-1M-scale GLMix (zero-egress environment: "
-            f"real ML-1M unavailable; same shape/skew: {ns.rows:,} ratings, "
-            f"{N_USERS:,} users, {N_MOVIES:,} movies, planted fixed+per-user "
-            "logistic model)"
+            f"synthetic MovieLens-{ns.scale[2:].upper()}-scale GLMix "
+            f"(zero-egress environment: real data unavailable; same "
+            f"shape/skew: {ns.rows:,} ratings, {N_USERS:,} users, "
+            f"{N_MOVIES:,} movies, planted fixed+per-user logistic model)"
         ),
         "model": (
             "fixed + per-user RE + per-movie RE + factored MF (latent 4)"
@@ -223,6 +253,9 @@ def main():
         "sec_per_cd_iteration": round(sec_per_iter, 2),
         "cd_iterations": ns.iterations,
         "active_upper_bound": ns.active_cap,
+        "bucketed": bool(ns.bucketed),
+        "distributed": bool(ns.distributed),
+        "peak_rss_gb": round(peak_rss_gb, 2),
         "platform": platform,
         "captured": time.strftime("%Y-%m-%d"),
     }
