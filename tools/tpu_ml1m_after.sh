@@ -1,0 +1,16 @@
+#!/bin/bash
+# After the r5 recapture chain succeeds, run the MovieLens-1M-scale
+# config-4 baseline ON THE TPU (PHOTON_ML_TPU_BASELINE_TPU=1) — the
+# measurement that connects BASELINE.json's sec/iter to the chip
+# (VERDICT r4 weak #7). Only fires on a clean recapture (the tunnel is
+# then known-healthy); runs to completion, never killed.
+#   nohup bash tools/tpu_ml1m_after.sh >> tools/tpu_ml1m_after.log 2>&1 &
+cd /root/repo
+echo "$(date -u +%H:%M:%S) ml1m-after watcher start"
+while ! grep -q "recapture done rc=0" tools/tpu_requeue_r5.log 2>/dev/null; do
+  sleep 120
+done
+echo "$(date -u +%H:%M:%S) recapture clean; running ml1m config4 on TPU"
+PHOTON_ML_TPU_BASELINE_TPU=1 python tools/movielens_baseline.py \
+  --out /tmp/ml1m_tpu --iterations 2
+echo "$(date -u +%H:%M:%S) ml1m TPU run done rc=$?"

@@ -1,0 +1,17 @@
+#!/bin/bash
+# r5 recapture chain: wait for the CURRENT capture process tree to drain
+# (never two clients on the tunnel, never kill anything), then run the
+# patient prober until the tunnel answers, then a fresh full capture with
+# the hardened bench. Start detached:
+#   nohup bash tools/tpu_requeue_r5.sh >> tools/tpu_requeue_r5.log 2>&1 &
+cd /root/repo
+echo "$(date -u +%H:%M:%S) requeue watcher start"
+# drain: wait until no bench.py / tpu_capture.py processes remain
+while pgrep -f "tpu_capture.py|/root/repo/bench.py" > /dev/null; do
+  sleep 60
+done
+echo "$(date -u +%H:%M:%S) capture drained; starting patient probe loop"
+bash tools/tpu_probe_loop.sh
+echo "$(date -u +%H:%M:%S) tunnel healthy ($(cat tools/tpu_probe_ok 2>/dev/null)); recapturing"
+python tools/tpu_capture.py
+echo "$(date -u +%H:%M:%S) recapture done rc=$?"
