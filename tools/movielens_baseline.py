@@ -150,6 +150,10 @@ def main():
                          "path the 20M scale exercises")
     ap.add_argument("--distributed", action="store_true",
                     help="entity/row sharding over the visible device mesh")
+    ap.add_argument("--reuse-data", action="store_true",
+                    help="skip synthesis/writing when --out already holds "
+                         "train/ and validate/ (the 20M write takes ~45 min; "
+                         "a crashed training run should not pay it twice)")
     ns = ap.parse_args()
     N_RATINGS, N_USERS, N_MOVIES = SCALES[ns.scale]
     if ns.rows is None:
@@ -157,18 +161,23 @@ def main():
 
     rng = np.random.default_rng(20260730)
     t0 = time.time()
-    log(f"synthesizing {ns.rows:,} ratings ({N_USERS:,} users x {N_MOVIES:,} movies)")
-    users, movies, x, label = synthesize(ns.rows, rng)
-    n_train = int(ns.rows * 0.9)
-    log(f"writing avro ({n_train:,} train / {ns.rows - n_train:,} validation rows)")
-    if os.path.exists(ns.out):
-        shutil.rmtree(ns.out)
-    write_avro(os.path.join(ns.out, "train"), users, movies, x, label,
-               slice(0, n_train))
-    write_avro(
-        os.path.join(ns.out, "validate"), users, movies, x, label,
-        slice(n_train, ns.rows), parts=1,
-    )
+    have = (os.path.isdir(os.path.join(ns.out, "train"))
+            and os.path.isdir(os.path.join(ns.out, "validate")))
+    if ns.reuse_data and have:
+        log(f"reusing data in {ns.out} (--reuse-data)")
+    else:
+        log(f"synthesizing {ns.rows:,} ratings ({N_USERS:,} users x {N_MOVIES:,} movies)")
+        users, movies, x, label = synthesize(ns.rows, rng)
+        n_train = int(ns.rows * 0.9)
+        log(f"writing avro ({n_train:,} train / {ns.rows - n_train:,} validation rows)")
+        if os.path.exists(ns.out):
+            shutil.rmtree(ns.out)
+        write_avro(os.path.join(ns.out, "train"), users, movies, x, label,
+                   slice(0, n_train))
+        write_avro(
+            os.path.join(ns.out, "validate"), users, movies, x, label,
+            slice(n_train, ns.rows), parts=1,
+        )
     t_data = time.time() - t0
     log(f"data ready in {t_data:.0f}s")
 
