@@ -310,11 +310,12 @@ def _bench_sparse(extra, on_tpu):
     labels = jnp.asarray(labels_h)
 
     # race the two transpose-action layouts: random scatter-add vs the
-    # sorted-segment-sum CSC view (with_transpose) — the scatter into a
-    # 2^20-wide gradient is the sparse regime's TPU-hostile op. The
-    # HEADLINE uses the layout PRODUCTION ingest picks (ops.features.
-    # auto_transpose: sorted on TPU in the wide regime, scatter elsewhere)
-    # so the recorded number is the rate the real driver achieves.
+    # sorted-segment-sum CSC view (with_transpose). The HEADLINE uses the
+    # layout PRODUCTION ingest picks (ops.features.auto_transpose: scatter
+    # everywhere since the r5 measurement showed it 1.6x ahead of the
+    # sorted view on the v5e; env-overridable) so the recorded number is
+    # the rate the real driver achieves, and the race keeps both rates in
+    # the record in case a future chip/compiler flips the ordering.
     from photon_ml_tpu.ops.features import auto_transpose
 
     auto_sorted = auto_transpose(feats).t_idx is not None

@@ -244,17 +244,26 @@ def from_scipy_like(rows, dim: int, dtype=jnp.float32) -> SparseFeatures:
     return SparseFeatures(jnp.asarray(indices), jnp.asarray(values, dtype), dim)
 
 
-# production rule for the transpose layout: the sorted-segment-sum gradient
-# wins on TPU in the wide regime (random scatter into a 2^20-wide vector is
-# the hostile op there); on CPU the scatter is faster. Applied at ingest
-# (io/libsvm.to_batch) so drivers get it automatically.
+# Production rule for the transpose layout, set by MEASUREMENT, not theory.
+# The theory said the sorted-segment-sum CSC gradient should win on TPU in
+# the wide regime (random scatter into a 2^20-wide vector being the hostile
+# op); the v5e says otherwise: BENCH_SELFRUN_r05 measured scatter-add at
+# 1.08e6 ex/s vs 0.66e6 for the sorted view at (N=131072, D=2^20, nnz=64)
+# — the sort/gather machinery costs more than the scatter it avoids. The
+# default is therefore the scatter layout everywhere; the bench races both
+# every round (sparse_wide_examples_per_sec_{scatter,sorted}) so a future
+# chip/compiler that flips the ordering shows up in the record, and
+# ``PHOTON_ML_TPU_SPARSE_TRANSPOSE=1`` forces the CSC view back on for
+# comparison without a code change.
 SPARSE_TRANSPOSE_MIN_DIM = 1 << 16
 
 
 def auto_transpose(feats: SparseFeatures) -> SparseFeatures:
-    """Build the CSC view when (wide feature space) and (running on TPU)."""
+    """Apply the production transpose-layout rule (see comment above)."""
+    import os
+
     if feats.t_idx is not None or feats.dim < SPARSE_TRANSPOSE_MIN_DIM:
         return feats
-    from photon_ml_tpu.ops.fused_glm import _on_tpu
-
-    return feats.with_transpose() if _on_tpu() else feats
+    if os.environ.get("PHOTON_ML_TPU_SPARSE_TRANSPOSE") == "1":
+        return feats.with_transpose()
+    return feats

@@ -161,10 +161,28 @@ def main():
 
     rng = np.random.default_rng(20260730)
     t0 = time.time()
-    have = (os.path.isdir(os.path.join(ns.out, "train"))
-            and os.path.isdir(os.path.join(ns.out, "validate")))
-    if ns.reuse_data and have:
-        log(f"reusing data in {ns.out} (--reuse-data)")
+    # a manifest written AFTER the last avro byte is the only acceptable
+    # reuse evidence: train/ and validate/ existing proves nothing (the dirs
+    # are created before the parts are written, so a crashed write leaves
+    # both present but truncated), and the manifest must also match the
+    # requested scale/rows or a stale dir would silently publish a baseline
+    # entry describing data that was never used
+    manifest_path = os.path.join(ns.out, "data-manifest.json")
+    manifest = {"scale": ns.scale, "rows": ns.rows, "complete": True}
+    reusable = False
+    if ns.reuse_data and os.path.exists(manifest_path):
+        with open(manifest_path) as f:
+            on_disk = json.load(f)
+        if on_disk == manifest:
+            reusable = True
+        else:
+            log(f"--reuse-data refused: manifest {on_disk} != requested "
+                f"{manifest}; regenerating")
+    elif ns.reuse_data:
+        log("--reuse-data refused: no data-manifest.json (a complete write "
+            "stamps one); regenerating")
+    if reusable:
+        log(f"reusing data in {ns.out} (--reuse-data, manifest verified)")
     else:
         log(f"synthesizing {ns.rows:,} ratings ({N_USERS:,} users x {N_MOVIES:,} movies)")
         users, movies, x, label = synthesize(ns.rows, rng)
@@ -178,6 +196,9 @@ def main():
             os.path.join(ns.out, "validate"), users, movies, x, label,
             slice(n_train, ns.rows), parts=1,
         )
+        with open(manifest_path + ".tmp", "w") as f:
+            json.dump(manifest, f)
+        os.replace(manifest_path + ".tmp", manifest_path)
     t_data = time.time() - t0
     log(f"data ready in {t_data:.0f}s")
 
