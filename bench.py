@@ -256,7 +256,8 @@ def _bench_dense(extra, x_h, y_h, on_tpu=True):
         if rel_vf > 5e-2 or rel_gf > 5e-2:
             _log("fused kernel failed parity; falling back to XLA path")
             extra["fused_block_rows"] = None
-            obj = obj_plain
+            extra.pop("fused_family", None)  # the record must describe the
+            obj = obj_plain                  # path that actually ran
 
     eps = _scan_throughput(
         lambda w, b: obj.value_and_grad(w, b, norm, 0.1),
@@ -273,6 +274,17 @@ def _bench_dense(extra, x_h, y_h, on_tpu=True):
     # traffic (y, w, z, d) is < 1% at D=512 and is ignored. TPU-only: the
     # 819 GB/s peak is the v5e HBM spec, meaningless against a CPU run.
     x_passes = 1 if extra["fused_block_rows"] else 2
+    if extra["fused_block_rows"] and extra.get("fused_family", "").startswith("scan"):
+        # the pure-XLA scan family is ALGORITHMICALLY one pass, but whether
+        # the block actually stays resident between the matvec and the
+        # rank-update is the compiler's call. 1-pass accounting UNDERSTATES
+        # achieved bandwidth if XLA re-reads the block (the conservative
+        # direction for an achieved-GB/s claim — 2-pass accounting could
+        # print a physically impossible >100% of HBM peak); flag it.
+        extra["dense_traffic_note"] = (
+            "scan family: 1-pass accounting (understates achieved GB/s if "
+            "XLA re-reads the block between contractions)"
+        )
     bytes_per_example = d * 2 * x_passes  # bf16 storage
     achieved_gbs = eps * bytes_per_example / 1e9
     extra["dense_achieved_gb_s"] = round(achieved_gbs, 1)

@@ -59,14 +59,23 @@ DEFAULT_BLOCK_ROWS = 1024
 # for bf16 plus the f32 scalars), so 8192 x 512 bf16 = 8 MiB stays
 # comfortably under budget.
 VPU_MARK = 1 << 20
+# SCAN_MARK + rows — pure-XLA single pass: lax.scan over row blocks with
+# both contractions per block and f32 accumulators (no Pallas at all; see
+# _scan_value_grad_parts). The only family that still compiles when the
+# remote Pallas-compile path is down, and a test of whether XLA alone can
+# hold a block resident between the matvec and the rank-update.
+SCAN_MARK = 2 << 20
 AUTOTUNE_CANDIDATES = (
     1024, 2048, 4096, 8192, 16384, -2048, -4096, -8192,
     VPU_MARK + 2048, VPU_MARK + 4096, VPU_MARK + 8192, VPU_MARK + 16384,
+    SCAN_MARK + 2048, SCAN_MARK + 8192, SCAN_MARK + 32768,
 )
 
 
 def _decode_block(block_rows: int) -> Tuple[str, int]:
     """(family, rows) from the encoded autotune candidate."""
+    if block_rows >= SCAN_MARK:
+        return "scan", block_rows - SCAN_MARK
     if block_rows >= VPU_MARK:
         return "vpu", block_rows - VPU_MARK
     if block_rows < 0:
@@ -395,11 +404,52 @@ def fused_value_grad_parts(
         y = jnp.concatenate([y, jnp.zeros((pad,), y.dtype)])
         weights = jnp.concatenate([weights, jnp.zeros((pad,), weights.dtype)])
         offsets = jnp.concatenate([offsets, jnp.zeros((pad,), offsets.dtype)])
+    if family == "scan":
+        return _scan_value_grad_parts(loss, block, x, y, weights, offsets, w)
     if family == "manual":
         fn = _fused_fn_manual(loss, block, interpret)
     else:
         fn = _fused_fn(loss, block, interpret, vpu=family == "vpu")
     return fn(x, y, weights, offsets, w)
+
+
+def _scan_value_grad_parts(loss, block, x, y, weights, offsets, w):
+    """Pure-XLA single-pass family: lax.scan over row blocks, both
+    contractions (margins + gradient) computed per block with f32
+    accumulators. No Pallas anywhere — it compiles even when a remote
+    Pallas-compile path is unavailable (r5 tunnel outage mode) — and the
+    block is small enough (block x D bf16) that XLA can keep it resident
+    in VMEM between the matvec and the rank-update, approaching one-pass
+    HBM traffic without hand-written kernels."""
+    n, d = x.shape
+    nb = n // block
+    xb = x.reshape(nb, block, d)
+    yb = y.reshape(nb, block)
+    wb = weights.reshape(nb, block)
+    ob = offsets.reshape(nb, block)
+    wx = w.astype(x.dtype)
+
+    def step(carry, inp):
+        val, g, ds = carry
+        xx, yy, ww, oo = inp
+        z = jnp.dot(xx, wx, preferred_element_type=jnp.float32) + oo
+        # same masking rule as every other family: zero-weight rows must be
+        # EXCLUDED, not multiplied (0 * inf = NaN for e.g. Poisson d1 at a
+        # large margin)
+        dvec = jnp.where(ww > 0, ww * loss.d1(z, yy), 0.0)
+        val = val + jnp.sum(jnp.where(ww > 0, ww * loss.loss(z, yy), 0.0))
+        g = g + jnp.dot(dvec.astype(xx.dtype), xx,
+                        preferred_element_type=jnp.float32)
+        ds = ds + jnp.sum(dvec)
+        return (val, g, ds), None
+
+    init = (
+        jnp.float32(0.0),
+        jnp.zeros((d,), jnp.float32),
+        jnp.float32(0.0),
+    )
+    (val, g, ds), _ = lax.scan(step, init, (xb, yb, wb, ob))
+    return val, g, ds
 
 
 def fused_logistic_value_and_grad(

@@ -230,3 +230,48 @@ class TestVpuFamily:
         assert _decode_block(4096) == ("grid", 4096)
         assert _decode_block(-2048) == ("manual", 2048)
         assert _decode_block(VPU_MARK + 8192) == ("vpu", 8192)
+
+
+class TestScanFamily:
+    """Pure-XLA single-pass scan family (SCAN_MARK encodings): no Pallas
+    anywhere, so it must be exact against the two-pass oracle on every
+    backend and through the ragged pad path."""
+
+    def test_matches_oracle_all_blocks(self, rng):
+        from photon_ml_tpu.ops import losses
+        from photon_ml_tpu.ops.fused_glm import SCAN_MARK, fused_value_grad_parts
+
+        n, d = 3072, 192
+        x = jnp.asarray(rng.normal(size=(n, d)).astype(np.float32))
+        y = jnp.asarray((rng.random(n) < 0.5).astype(np.float32))
+        wt = jnp.asarray(rng.uniform(0.2, 2.0, n).astype(np.float32))
+        off = jnp.asarray(rng.normal(scale=0.2, size=n).astype(np.float32))
+        w = jnp.asarray(rng.normal(size=d).astype(np.float32) * 0.1)
+        z = x @ w + off
+        val_ref = float(jnp.sum(wt * losses.logistic.loss(z, y)))
+        g_ref = np.asarray((wt * losses.logistic.d1(z, y)) @ x)
+        d_ref = float(jnp.sum(wt * losses.logistic.d1(z, y)))
+        for block in (256, 1024, 3072, 4096):  # incl. block > n (pad) and n itself
+            v, g, ds = fused_value_grad_parts(
+                losses.logistic, x, y, wt, off, w, block_rows=SCAN_MARK + block
+            )
+            np.testing.assert_allclose(float(v), val_ref, rtol=1e-5, err_msg=str(block))
+            np.testing.assert_allclose(np.asarray(g), g_ref, rtol=1e-4, atol=1e-4)
+            np.testing.assert_allclose(float(ds), d_ref, rtol=1e-4, atol=1e-4)
+
+    def test_decode_and_autotune_candidates(self):
+        from photon_ml_tpu.ops.fused_glm import (
+            AUTOTUNE_CANDIDATES,
+            SCAN_MARK,
+            VPU_MARK,
+            _decode_block,
+        )
+
+        assert _decode_block(SCAN_MARK + 8192) == ("scan", 8192)
+        # SCAN_MARK encodings must not collide with the VPU band
+        assert all(
+            _decode_block(c)[0] != "vpu"
+            for c in AUTOTUNE_CANDIDATES if c >= SCAN_MARK
+        )
+        assert any(_decode_block(c)[0] == "scan" for c in AUTOTUNE_CANDIDATES)
+        assert VPU_MARK + 16384 < SCAN_MARK
