@@ -79,6 +79,16 @@ def main():
             f"({time.strftime('%Y-%m-%d %H:%M UTC', time.gmtime())}); "
             "autotune candidates in tools/autotune_report.json."
         )
+        if not payload.get("value"):
+            # a probe that said "tpu" but a run whose every section died
+            # (r5: the tunnel fell over mid-capture) must NOT clobber an
+            # earlier GOOD capture — park the evidence separately
+            out = os.path.join(REPO, "BENCH_SELFRUN_r05_failed.json")
+            with open(out, "w") as f:
+                json.dump(payload, f, indent=1)
+            log(f"capture ran on tpu but produced NO dense value; evidence "
+                f"parked at {out} (selfrun untouched)")
+            return 1
         out = os.path.join(REPO, "BENCH_SELFRUN_r05.json")
         with open(out, "w") as f:
             json.dump(payload, f, indent=1)

@@ -1,17 +1,23 @@
 #!/bin/bash
-# r5 recapture chain: wait for the CURRENT capture process tree to drain
-# (never two clients on the tunnel, never kill anything), then run the
-# patient prober until the tunnel answers, then a fresh full capture with
-# the hardened bench. Start detached:
+# r5 recapture chain (retry version): wait for any live capture tree to
+# drain (never two clients, never kill anything), then loop: patient probe
+# -> fresh capture. A capture that reaches the TPU but banks no dense
+# value exits 1 (tools/tpu_capture.py) and the chain goes back to probing.
 #   nohup bash tools/tpu_requeue_r5.sh >> tools/tpu_requeue_r5.log 2>&1 &
 cd /root/repo
-echo "$(date -u +%H:%M:%S) requeue watcher start"
-# drain: wait until no bench.py / tpu_capture.py processes remain
-while pgrep -f "tpu_capture.py|/root/repo/bench.py" > /dev/null; do
-  sleep 60
+echo "$(date -u +%H:%M:%S) requeue watcher start (retry mode)"
+while true; do
+  while pgrep -f "tpu_capture.py|/root/repo/bench.py" > /dev/null; do
+    sleep 60
+  done
+  echo "$(date -u +%H:%M:%S) drained; starting patient probe loop"
+  bash tools/tpu_probe_loop.sh
+  echo "$(date -u +%H:%M:%S) tunnel healthy ($(cat tools/tpu_probe_ok 2>/dev/null)); capturing"
+  python tools/tpu_capture.py
+  rc=$?
+  echo "$(date -u +%H:%M:%S) recapture done rc=$rc"
+  if [ $rc -eq 0 ]; then
+    break
+  fi
+  sleep 120
 done
-echo "$(date -u +%H:%M:%S) capture drained; starting patient probe loop"
-bash tools/tpu_probe_loop.sh
-echo "$(date -u +%H:%M:%S) tunnel healthy ($(cat tools/tpu_probe_ok 2>/dev/null)); recapturing"
-python tools/tpu_capture.py
-echo "$(date -u +%H:%M:%S) recapture done rc=$?"
