@@ -33,6 +33,13 @@ import jax
 
 if not os.environ.get("PHOTON_ML_TPU_BASELINE_TPU"):
     jax.config.update("jax_platforms", "cpu")
+if os.environ.get("PHOTON_ML_TPU_SYNC_DISPATCH"):
+    # single-physical-core boxes: async dispatch lets a second program's
+    # device threads occupy the thread pool while an earlier program's
+    # collective rendezvous starves -> livelock -> XLA's termination
+    # timeout kills the run (observed 3x on the 20M run). Synchronous
+    # dispatch serializes programs and removes the hazard.
+    jax.config.update("jax_cpu_enable_async_dispatch", False)
 
 N_RATINGS = 1_000_209
 N_USERS = 6_040
