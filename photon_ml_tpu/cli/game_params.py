@@ -310,14 +310,12 @@ class GameTrainingParams:
     # the resident block slab (reference DISK_ONLY analogue)
     streaming_random_effects: bool = False
     re_memory_budget_mb: Optional[float] = None
-    # "true": train every lambda combo of the grid simultaneously as a vmap
-    # axis over the descent cycle (CoordinateDescent.run_grid); "auto":
-    # time one warm iteration of each strategy and pick the faster (the
-    # batched grid reads data once per iteration for all combos but pays
-    # the slowest lane's while_loop — platform-dependent, so measure);
-    # "false": sequential combos. Non-false falls back to sequential when
-    # combos differ beyond lambda or the run uses distributed/bucketed/
-    # factored coordinates, checkpoints, or variance.
+    # non-"false": train the lambda grid through the traced-lambda grid API
+    # (CoordinateDescent.run_grid — ONE compiled cycle serves every combo;
+    # the batched G-lane vmapped variant this flag once selected lost every
+    # measured race and was removed, VERDICT r4 #9). Falls back to the
+    # per-combo rebuild when combos differ beyond lambda or the run uses
+    # distributed/bucketed/factored coordinates, checkpoints, or variance.
     vmapped_grid: str = "false"
 
     def validate(self) -> None:

@@ -650,7 +650,7 @@ class TestVmappedGrid:
                 np.asarray(rs.objective_history),
                 rtol=1e-4,
             )
-        assert "(vmapped-grid)" in vm.results[0][1].timings
+        assert "(grid)" in vm.results[0][1].timings
         # the saved best model matches the sequential best
         from photon_ml_tpu.io import model_io
 
@@ -663,10 +663,10 @@ class TestVmappedGrid:
         )
         np.testing.assert_allclose(mv_means, ms_means, rtol=2e-3, atol=2e-4)
 
-    def test_auto_mode_races_and_picks(self, game_avro_dirs, tmp_path):
-        """--vmapped-grid auto measures one iteration of each strategy and
-        demonstrably picks one (VERDICT r3 #6); either choice must produce
-        the full per-combo results."""
+    def test_auto_mode_uses_shared_compile_grid(self, game_avro_dirs, tmp_path):
+        """--vmapped-grid auto routes through the shared-compile grid (the
+        batched G-lane variant was removed after losing every measured
+        race, VERDICT r4 #9) and still produces full per-combo results."""
         train_dir, val_dir, _ = game_avro_dirs
         flags = [f for f in COMMON_FLAGS]
         i = flags.index("--fixed-effect-optimization-configurations")
@@ -682,11 +682,8 @@ class TestVmappedGrid:
             + flags
         )
         assert len(driver.results) == 2
-        # the race ran (timer span recorded) and a strategy was picked: the
-        # vmapped timing key is present iff the race chose vmapped
-        assert "grid-race" in driver.timer.totals
-        chose_vmapped = "(vmapped-grid)" in driver.results[0][1].timings
-        assert ("vmapped-grid" in driver.timer.totals) == chose_vmapped
+        assert "shared-compile-grid" in driver.timer.totals
+        assert "(grid)" in driver.results[0][1].timings
 
     def test_vmapped_grid_falls_back_when_ineligible(self, game_avro_dirs, tmp_path):
         """Combos varying beyond lambda -> sequential fallback (logged),
@@ -707,7 +704,7 @@ class TestVmappedGrid:
             + flags
         )
         assert len(driver.results) == 2  # sequential path still ran the grid
-        assert "(vmapped-grid)" not in driver.results[0][1].timings
+        assert "(grid)" not in driver.results[0][1].timings
 
 
 class TestDateRangeDiscovery:

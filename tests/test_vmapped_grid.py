@@ -1,8 +1,8 @@
-"""Vmapped lambda-grid coordinate descent: all combos in one batched
-program, matching per-combo sequential descents.
-
-(The GAME analogue of train_glm_grid_vmapped; the reference re-runs the
-whole driver per grid combo, cli/game/training/Driver.scala:330-337.)
+"""Traced-lambda grid coordinate descent (CoordinateDescent.run_grid):
+one compiled cycle serves every combo, matching per-combo descents
+exactly. (The batched G-lane vmapped variant was removed after losing
+every measured race, VERDICT r4 #9; the reference re-runs the whole
+driver per grid combo, cli/game/training/Driver.scala:330-337.)
 """
 
 import numpy as np

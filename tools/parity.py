@@ -568,8 +568,8 @@ def run_config_game(results, fast):
     print("fused-cycle + bucketed modes: objective/RMSE identical", flush=True)
 
     # --vmapped-grid: a 2-combo lambda grid whose FIRST combo equals the
-    # plain run must reproduce its objective/RMSE through the batched
-    # descent (real-data gate for CoordinateDescent.run_grid)
+    # plain run must reproduce its objective/RMSE through the traced-lambda
+    # grid API (real-data gate for CoordinateDescent.run_grid)
     grid_args = list(base_args)
     gi = grid_args.index("--fixed-effect-optimization-configurations")
     grid_args[gi + 1] = (
@@ -581,7 +581,7 @@ def run_config_game(results, fast):
         + ["--output-dir", os.path.join(tmp, "output-vgrid"),
            "--vmapped-grid", "true"]
     )
-    assert "(vmapped-grid)" in vg.results[0][1].timings, "vmapped path did not engage"
+    assert "(grid)" in vg.results[0][1].timings, "grid API path did not engage"
     vg_obj = float(vg.results[0][1].objective_history[-1])
     vg_rmse = float(vg.results[0][2]["RMSE"])
     assert abs(vg_obj - ours_obj) / abs(ours_obj) < 1e-7, (vg_obj, ours_obj)
