@@ -119,7 +119,7 @@ class CoordinateDescent:
         static structure, arrays flow through as traced pytrees). ``lam``
         (coordinate name -> traced total reg weight) is the lambda-grid
         override; None uses each coordinate's static regularization —
-        fused mode and the vmapped grid share this single body."""
+        fused mode and the traced-lambda grid share this single body."""
         names = list(self.coordinates)
         objs = []
         vals = []

@@ -449,11 +449,11 @@ def build_training_parser() -> argparse.ArgumentParser:
       help="cap the resident random-effect block slab (MB); implies "
            "--streaming-random-effects")
     a("--vmapped-grid", default="false",
-      help="train every lambda combo of the grid simultaneously (one vmapped "
-           "descent instead of sequential combos; lambda-only grids on plain "
-           "fixed/random coordinates). 'auto' times one iteration of each "
-           "strategy and picks the faster; truthy values ('true', '1', "
-           "'yes') enable the vmapped grid unconditionally")
+      help="train the lambda grid through the shared-compile grid API (ONE "
+           "compiled cycle serves every combo; lambda-only grids on plain "
+           "fixed/random coordinates). The batched G-lane variant this flag "
+           "once selected was removed after losing every measured race; "
+           "'auto' and truthy values now both route here")
     return p
 
 
