@@ -47,7 +47,9 @@ def main():
     ctx = MeshContext(data_mesh())
     n_dev = ctx.num_devices
     # 2^21 entities x 64 local dims = 134,217,728 coefficients (>= 1e8)
-    e_tot = 1 << 21
+    # 2^21 x 64 = 134M coefficients by default; PHOTON_ML_TPU_SCALE_LOG2E
+    # raises the entity exponent (r5 ran 22 -> 268,435,456 coefficients)
+    e_tot = 1 << int(os.environ.get("PHOTON_ML_TPU_SCALE_LOG2E", "21"))
     d_loc = 64
     s = 1  # samples per entity (scale demo: the COEFFICIENT axis is the point)
     k = 4  # nnz per scoring row
