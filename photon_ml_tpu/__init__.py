@@ -48,6 +48,13 @@ _LAZY = {
     "train_glm_grid": "photon_ml_tpu.training",
     "MeshContext": "photon_ml_tpu.parallel",
     "data_mesh": "photon_ml_tpu.parallel",
+    "ResilienceConfig": "photon_ml_tpu.resilience",
+    "RetryPolicy": "photon_ml_tpu.resilience",
+    "DivergenceGuard": "photon_ml_tpu.resilience",
+    "FaultPlan": "photon_ml_tpu.resilience",
+    "FaultSpec": "photon_ml_tpu.resilience",
+    "fault_scope": "photon_ml_tpu.resilience",
+    "resilience_scope": "photon_ml_tpu.resilience",
 }
 
 __all__ = ["TaskType", "__version__", *sorted(_LAZY)]

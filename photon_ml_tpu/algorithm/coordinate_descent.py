@@ -22,10 +22,12 @@ import jax
 import jax.numpy as jnp
 
 from photon_ml_tpu.evaluation.evaluators import Evaluator
+from photon_ml_tpu.resilience import faults as _faults
 from photon_ml_tpu.types import real_dtype
 
 if TYPE_CHECKING:  # pragma: no cover
     from photon_ml_tpu.checkpoint import CoordinateDescentCheckpointer
+    from photon_ml_tpu.resilience.guards import DivergenceGuard, GuardEvent
 
 Array = jax.Array
 
@@ -45,6 +47,9 @@ class CoordinateDescentResult:
     # (RandomEffectOptimizationTracker.scala:62-95). Empty in fused-cycle
     # mode (results stay inside the compiled cycle).
     trackers: Dict[str, object] = dataclasses.field(default_factory=dict)
+    # divergence-guard incidents during this run (resilience.guards): every
+    # rollback / skipped cycle, with the coordinate and step it hit
+    guard_events: List["GuardEvent"] = dataclasses.field(default_factory=list)
 
 
 class CoordinateDescent:
@@ -63,6 +68,7 @@ class CoordinateDescent:
         validation_evaluators: Optional[Dict[str, Tuple[Evaluator, dict]]] = None,
         collect_timings: bool = False,
         fused_cycle: bool = False,
+        divergence_guard: Optional["DivergenceGuard"] = None,
     ):
         """``training_loss(total_scores) -> scalar`` is the loss-evaluator
         analogue used for the objective value (the training counterpart of
@@ -86,6 +92,12 @@ class CoordinateDescent:
         coordinate boundaries. Trade-offs: checkpoints land at iteration
         (not per-update) granularity, and per-coordinate wall timings
         collapse into one '(fused-cycle)' entry.
+
+        ``divergence_guard`` (resilience.guards.DivergenceGuard) gates every
+        update: a non-finite parameter/score state is rolled back to the
+        coordinate's last good state instead of poisoning the shared score
+        vectors. The check blocks on one small scalar per update, so leave
+        it None on latency-critical remote-tunnel runs unless needed.
         """
         self.coordinates = coordinates
         self.training_loss = training_loss
@@ -93,6 +105,7 @@ class CoordinateDescent:
         self.validation_evaluators = validation_evaluators or {}
         self.collect_timings = collect_timings
         self.fused_cycle = fused_cycle
+        self.divergence_guard = divergence_guard
         self._cycle_fn = None
         self._grid_cycle_fn = None  # jitted vmap(_cycle_body), built once
         # jit the per-coordinate update+score once per coordinate. A
@@ -356,6 +369,8 @@ class CoordinateDescent:
                 )
                 validation_dev.clear()
 
+        guard = self.divergence_guard
+        guard_events_start = len(guard.events) if guard is not None else 0
         if self.fused_cycle:
             n_coords = len(names)
             if start_step % n_coords != 0:
@@ -372,7 +387,37 @@ class CoordinateDescent:
                 if step <= start_step:
                     continue
                 t0 = time.perf_counter()
-                params, scores, total, objs, vals = self._cycle_fn(params, scores, total)
+                new_params, new_scores, new_total, objs, vals = self._cycle_fn(
+                    params, scores, total
+                )
+                if guard is not None:
+                    # iteration granularity: the per-update states live
+                    # inside the compiled cycle, so a non-finite outcome
+                    # rolls the WHOLE iteration back to its entry state
+                    new_params, new_total, ok = guard.filter_update(
+                        "(fused-cycle)", step, new_params, new_total, params, total
+                    )
+                    if not ok:
+                        new_scores = scores
+                        # re-evaluate the rolled-back state once and repeat
+                        # it per update so histories (and the step-aligned
+                        # checkpoint contract) keep one entry per update
+                        obj = self.training_loss(total) + sum(
+                            self.coordinates[n].regularization_term(params[n])
+                            for n in names
+                        )
+                        objs = [obj] * n_coords
+                        if self.validation_scorer is not None:
+                            v_scores = self.validation_scorer(params)
+                            vals = [
+                                {
+                                    key: ev.evaluate(v_scores, **kw)
+                                    for key, (ev, kw) in self.validation_evaluators.items()
+                                }
+                            ] * n_coords
+                        else:
+                            vals = []
+                params, scores, total = new_params, new_scores, new_total
                 if self.collect_timings:
                     jax.block_until_ready(total)
                 timings["(fused-cycle)"] = (
@@ -407,25 +452,47 @@ class CoordinateDescent:
                 objective_history=objective_history,
                 validation_history=validation_history,
                 timings=timings,
+                guard_events=(
+                    list(guard.events[guard_events_start:])
+                    if guard is not None
+                    else []
+                ),
             )
 
         step = 0
         for it in range(num_iterations):
+            skip_rest_of_cycle = False
             for name in names:
                 step += 1
                 if step <= start_step:
                     continue  # already completed before the restart
-                partial = total - scores[name]  # sum of the OTHER coordinates
-                t0 = time.perf_counter()
-                params[name], trackers[name] = self._update_fns[name](
-                    partial, params[name]
-                )
-                new_score = self._score_fns[name](params[name])
-                if self.collect_timings:
-                    new_score.block_until_ready()
-                timings[name] += time.perf_counter() - t0
-                total = partial + new_score
-                scores[name] = new_score
+                if not skip_rest_of_cycle:
+                    partial = total - scores[name]  # sum of the OTHER coordinates
+                    t0 = time.perf_counter()
+                    new_params, trackers[name] = self._update_fns[name](
+                        partial, params[name]
+                    )
+                    # chaos-test hook: a kind="nan" fault at this site
+                    # corrupts the update exactly like a diverged solve
+                    new_params = _faults.corrupt(
+                        "optim.step", new_params, coordinate=name, step=step
+                    )
+                    new_score = self._score_fns[name](new_params)
+                    if guard is not None:
+                        new_params, new_score, ok = guard.filter_update(
+                            name, step, new_params, new_score,
+                            params[name], scores[name],
+                        )
+                        if not ok and guard.mode == "skip_cycle":
+                            skip_rest_of_cycle = True
+                    if self.collect_timings:
+                        jax.block_until_ready(new_score)
+                    timings[name] += time.perf_counter() - t0
+                    params[name] = new_params
+                    total = partial + new_score
+                    scores[name] = new_score
+                # else: guard abandoned this cycle — state is unchanged, but
+                # histories and checkpoints below stay step-aligned
 
                 # objective = loss(total scores) + sum of reg terms
                 # (CoordinateDescent.scala:172-178) — stays on device
@@ -469,4 +536,7 @@ class CoordinateDescent:
             validation_history=validation_history,
             timings=timings,
             trackers=trackers,
+            guard_events=(
+                list(guard.events[guard_events_start:]) if guard is not None else []
+            ),
         )

@@ -20,9 +20,11 @@ from __future__ import annotations
 import dataclasses
 import hashlib
 import json
+import logging
 import os
 import shutil
 import tempfile
+import zipfile
 from typing import Any, Dict, List, Optional, Tuple
 
 import jax
@@ -30,8 +32,11 @@ import jax.numpy as jnp
 import numpy as np
 
 STEP_PREFIX = "step-"
+TMP_PREFIX = ".ckpt-"
 ARRAYS_FILE = "arrays.npz"
 META_FILE = "meta.json"
+
+logger = logging.getLogger(__name__)
 
 
 def fingerprint(parts: Dict[str, Any]) -> str:
@@ -133,6 +138,16 @@ class CoordinateDescentCheckpointer:
         self.multihost = multihost
         if multihost is None or multihost.coordinator_only_io():
             os.makedirs(directory, exist_ok=True)
+            self._sweep_stale_tmp()
+
+    def _sweep_stale_tmp(self) -> None:
+        """Remove ``.ckpt-*`` debris a crashed writer left behind (a temp dir
+        never renamed into place is by definition incomplete)."""
+        for name in os.listdir(self.directory):
+            if name.startswith(TMP_PREFIX):
+                stale = os.path.join(self.directory, name)
+                logger.warning("removing stale checkpoint temp dir %s", stale)
+                shutil.rmtree(stale, ignore_errors=True)
 
     # ------------------------------------------------------------------
     def _step_dirs(self) -> List[Tuple[int, str]]:
@@ -169,9 +184,19 @@ class CoordinateDescentCheckpointer:
             "objective_history": state.objective_history,
             "validation_history": state.validation_history,
         }
+        from photon_ml_tpu import resilience
+        from photon_ml_tpu.resilience import faults
+
         final_dir = os.path.join(self.directory, f"{STEP_PREFIX}{state.step}")
-        tmp_dir = tempfile.mkdtemp(prefix=".ckpt-", dir=self.directory)
-        try:
+
+        def write_once() -> None:
+            """One atomic write attempt: fresh temp dir -> rename. The temp
+            dir is removed on ANY failure (try/finally, not a broad except)
+            so a retry never inherits partial state and a crashed process
+            leaves at most an ignorable .ckpt-* directory behind."""
+            faults.inject("io.checkpoint_write", step=state.step, path=final_dir)
+            tmp_dir = tempfile.mkdtemp(prefix=TMP_PREFIX, dir=self.directory)
+            renamed = False
             try:
                 np.savez(os.path.join(tmp_dir, ARRAYS_FILE), **arrays)
                 with open(os.path.join(tmp_dir, META_FILE), "w") as f:
@@ -179,9 +204,20 @@ class CoordinateDescentCheckpointer:
                 if os.path.exists(final_dir):
                     shutil.rmtree(final_dir)
                 os.replace(tmp_dir, final_dir)
-            except Exception:
-                shutil.rmtree(tmp_dir, ignore_errors=True)
-                raise
+                renamed = True
+            finally:
+                if not renamed:
+                    shutil.rmtree(tmp_dir, ignore_errors=True)
+
+        try:
+            resilience.call_with_retry(
+                write_once,
+                resilience.current_config().io_policy,
+                describe=f"checkpoint step {state.step}",
+                on_retry=lambda a, e, d: logger.warning(
+                    "retrying checkpoint step %d (attempt %d): %s", state.step, a + 2, e
+                ),
+            )
             self._retire()
         finally:
             # barrier even when the write fails: non-coordinators are already
@@ -206,37 +242,63 @@ class CoordinateDescentCheckpointer:
     ) -> Optional[CheckpointState]:
         """Load the newest complete checkpoint; None when there is none.
 
-        Templates supply pytree structure (restored arrays replace leaves);
-        a fingerprint mismatch raises instead of silently resuming a
-        different run.
+        Crash debris is tolerated: stale ``.ckpt-*`` temp dirs are never
+        candidates (only ``step-*`` dirs with a meta file are), and a
+        checkpoint whose ``arrays.npz`` is truncated or undecodable (a crash
+        on a non-atomic filesystem) is skipped with a warning, falling back
+        to the next-newest complete step. Reads retry under the active I/O
+        policy. Templates supply pytree structure (restored arrays replace
+        leaves); a fingerprint mismatch raises instead of silently resuming
+        a different run.
         """
-        dirs = self._step_dirs()
-        if not dirs:
-            return None
-        step, path = dirs[-1]
-        with open(os.path.join(path, META_FILE)) as f:
-            meta = json.load(f)
-        if meta.get("fingerprint") != self.run_fingerprint:
-            raise ValueError(
-                f"checkpoint fingerprint {meta.get('fingerprint')!r} does not match "
-                f"this run ({self.run_fingerprint!r}); refusing to resume"
+        from photon_ml_tpu import resilience
+
+        policy = resilience.current_config().io_policy
+        for step, path in reversed(self._step_dirs()):
+            def load_meta() -> dict:
+                with open(os.path.join(path, META_FILE)) as f:
+                    return json.load(f)
+
+            try:
+                meta = resilience.call_with_retry(
+                    load_meta, policy, describe=f"read {path} meta"
+                )
+            except (resilience.RetryError, ValueError) as e:
+                logger.warning("skipping unreadable checkpoint %s: %s", path, e)
+                continue
+            if meta.get("fingerprint") != self.run_fingerprint:
+                raise ValueError(
+                    f"checkpoint fingerprint {meta.get('fingerprint')!r} does not match "
+                    f"this run ({self.run_fingerprint!r}); refusing to resume"
+                )
+
+            def load_arrays() -> Dict[str, np.ndarray]:
+                with np.load(os.path.join(path, ARRAYS_FILE)) as npz:
+                    return {k: npz[k] for k in npz.files}
+
+            try:
+                arrays = resilience.call_with_retry(
+                    load_arrays, policy, describe=f"read {path} arrays"
+                )
+            except (resilience.RetryError, zipfile.BadZipFile, ValueError, EOFError) as e:
+                # truncated/corrupt arrays.npz: this step never completed
+                logger.warning("skipping corrupt checkpoint %s: %s", path, e)
+                continue
+            restored = _unflatten_state(
+                {
+                    "params": params_template,
+                    "scores": scores_template,
+                    "total": total_template,
+                },
+                arrays,
+                meta["structure"],
             )
-        with np.load(os.path.join(path, ARRAYS_FILE)) as npz:
-            arrays = {k: npz[k] for k in npz.files}
-        restored = _unflatten_state(
-            {
-                "params": params_template,
-                "scores": scores_template,
-                "total": total_template,
-            },
-            arrays,
-            meta["structure"],
-        )
-        return CheckpointState(
-            step=int(meta["step"]),
-            params=restored["params"],
-            scores=restored["scores"],
-            total_scores=restored["total"],
-            objective_history=list(meta["objective_history"]),
-            validation_history=list(meta["validation_history"]),
-        )
+            return CheckpointState(
+                step=int(meta["step"]),
+                params=restored["params"],
+                scores=restored["scores"],
+                total_scores=restored["total"],
+                objective_history=list(meta["objective_history"]),
+                validation_history=list(meta["validation_history"]),
+            )
+        return None

@@ -317,6 +317,21 @@ class GameTrainingParams:
     # per-combo rebuild when combos differ beyond lambda or the run uses
     # distributed/bucketed/factored coordinates, checkpoints, or variance.
     vmapped_grid: str = "false"
+    # --- resilience (photon_ml_tpu.resilience) ------------------------
+    # corrupt Avro shard policy: "raise" fails fast on the first bad block;
+    # "skip" drops bad blocks (resyncing on the sync marker) up to the
+    # budget below per part file
+    on_corrupt: str = "raise"
+    corrupt_skip_budget: int = 16
+    # retry/backoff for every filesystem read/write (Avro blocks, index
+    # maps, checkpoints): attempt count and base backoff delay (seconds)
+    io_retries: int = 4
+    io_retry_base_delay: float = 0.05
+    # non-finite gate on coordinate-descent updates: "off" keeps the fully
+    # async dispatch (one fewer host sync per update); "rollback" restores
+    # the coordinate's last good state; "skip_cycle" additionally abandons
+    # the rest of the iteration
+    divergence_guard: str = "off"
 
     def validate(self) -> None:
         errors = []
@@ -355,6 +370,21 @@ class GameTrainingParams:
             )
         if self.re_memory_budget_mb is not None and self.re_memory_budget_mb <= 0:
             errors.append("--re-memory-budget-mb must be positive")
+        if self.on_corrupt not in ("raise", "skip"):
+            errors.append(
+                f"--on-corrupt must be 'raise' or 'skip', got {self.on_corrupt!r}"
+            )
+        if self.corrupt_skip_budget < 0:
+            errors.append("--corrupt-skip-budget must be >= 0")
+        if self.io_retries < 1:
+            errors.append("--io-retries must be >= 1")
+        if self.io_retry_base_delay < 0:
+            errors.append("--io-retry-base-delay must be >= 0")
+        if self.divergence_guard not in ("off", "rollback", "skip_cycle"):
+            errors.append(
+                "--divergence-guard must be 'off', 'rollback', or "
+                f"'skip_cycle', got {self.divergence_guard!r}"
+            )
         if self.streaming_random_effects:
             # loud scope fences: the streaming coordinate re-enters the host
             # per evaluation, so anything that wraps it in one XLA program
@@ -454,6 +484,20 @@ def build_training_parser() -> argparse.ArgumentParser:
            "fixed/random coordinates). The batched G-lane variant this flag "
            "once selected was removed after losing every measured race; "
            "'auto' and truthy values now both route here")
+    a("--on-corrupt", default="raise", choices=["raise", "skip"],
+      help="corrupt Avro block policy: fail fast, or skip bad blocks "
+           "(resyncing on the sync marker) within --corrupt-skip-budget")
+    a("--corrupt-skip-budget", type=int, default=16,
+      help="max corrupt blocks skipped per part file before raising")
+    a("--io-retries", type=int, default=4,
+      help="attempts for every filesystem read/write (exponential backoff)")
+    a("--io-retry-base-delay", type=float, default=0.05,
+      help="base backoff delay in seconds between I/O retries")
+    a("--divergence-guard", default="off",
+      choices=["off", "rollback", "skip_cycle"],
+      help="non-finite gate on coordinate updates: rollback restores the "
+           "last good state, skip_cycle also abandons the iteration "
+           "(costs one host sync per update)")
     return p
 
 
@@ -509,6 +553,11 @@ def parse_training_params(argv: Optional[List[str]] = None) -> GameTrainingParam
             "auto" if str(ns.vmapped_grid).lower() == "auto"
             else "true" if _truthy(ns.vmapped_grid) else "false"
         ),
+        on_corrupt=ns.on_corrupt,
+        corrupt_skip_budget=ns.corrupt_skip_budget,
+        io_retries=ns.io_retries,
+        io_retry_base_delay=ns.io_retry_base_delay,
+        divergence_guard=ns.divergence_guard,
     )
     params.validate()
     return params
@@ -535,6 +584,10 @@ class GameScoringParams:
         default_factory=list
     )
     host_scoring: bool = False  # NumPy oracle path (device path is default)
+    # resilience knobs (same semantics as GameTrainingParams)
+    on_corrupt: str = "raise"
+    corrupt_skip_budget: int = 16
+    io_retries: int = 4
 
     def validate(self) -> None:
         errors = []
@@ -546,6 +599,14 @@ class GameScoringParams:
             errors.append("--output-dir is required")
         if self.date_range and self.date_range_days_ago:
             errors.append("--date-range and --date-range-days-ago are exclusive")
+        if self.on_corrupt not in ("raise", "skip"):
+            errors.append(
+                f"--on-corrupt must be 'raise' or 'skip', got {self.on_corrupt!r}"
+            )
+        if self.corrupt_skip_budget < 0:
+            errors.append("--corrupt-skip-budget must be >= 0")
+        if self.io_retries < 1:
+            errors.append("--io-retries must be >= 1")
         if errors:
             raise ValueError("; ".join(errors))
 
@@ -575,6 +636,12 @@ def build_scoring_parser() -> argparse.ArgumentParser:
     a("--min-partitions-for-random-effect-model", type=int, default=1)
     a("--host-scoring", default="false",
       help="force the NumPy host scoring path (device scoring's parity oracle)")
+    a("--on-corrupt", default="raise", choices=["raise", "skip"],
+      help="corrupt Avro block policy during scoring reads")
+    a("--corrupt-skip-budget", type=int, default=16,
+      help="max corrupt blocks skipped per part file before raising")
+    a("--io-retries", type=int, default=4,
+      help="attempts for every filesystem read (exponential backoff)")
     return p
 
 
@@ -600,6 +667,9 @@ def parse_scoring_params(argv: Optional[List[str]] = None) -> GameScoringParams:
         offheap_indexmap_dir=ns.offheap_indexmap_dir,
         evaluators=parse_evaluators(ns.evaluators),
         host_scoring=_truthy(ns.host_scoring),
+        on_corrupt=ns.on_corrupt,
+        corrupt_skip_budget=ns.corrupt_skip_budget,
+        io_retries=ns.io_retries,
     )
     params.validate()
     return params

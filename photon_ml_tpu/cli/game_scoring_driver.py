@@ -172,6 +172,26 @@ class GameScoringDriver:
 
     # ------------------------------------------------------------------
     def run(self) -> None:
+        import dataclasses
+
+        from photon_ml_tpu import resilience
+
+        p = self.params
+        with resilience.resilience_scope(
+            resilience.ResilienceConfig(
+                on_corrupt=p.on_corrupt,
+                corrupt_skip_budget=p.corrupt_skip_budget,
+                # --io-retries overrides attempts; backoff shape keeps the
+                # env-tunable defaults (PHOTON_IO_RETRY_* knobs)
+                io_policy=dataclasses.replace(
+                    resilience.RetryPolicy.io_default(),
+                    max_attempts=p.io_retries,
+                ),
+            )
+        ):
+            self._run_guarded()
+
+    def _run_guarded(self) -> None:
         p = self.params
         prepare_output_dir(p.output_dir, p.delete_output_dir_if_exists)
         try:

@@ -581,6 +581,8 @@ class GameTrainingDriver:
             return "--compute-variance (save-time Hessians need per-combo statics)"
         if p.checkpoint_dir:
             return "--checkpoint-dir (no per-update checkpoints in a vmapped grid)"
+        if p.divergence_guard != "off":
+            return "--divergence-guard (per-update host gate cannot enter the compiled cycle)"
         import dataclasses as _dc
 
         for name in p.updating_sequence:
@@ -708,9 +710,15 @@ class GameTrainingDriver:
                         }
                     ),
                 )
+            guard = None
+            if p.divergence_guard != "off":
+                from photon_ml_tpu.resilience import DivergenceGuard
+
+                guard = DivergenceGuard(mode=p.divergence_guard)
             self.combo_coords.append(coords)
             cd = CoordinateDescent(
-                coords, loss_fn, scorer, evaluators, fused_cycle=p.fused_cycle
+                coords, loss_fn, scorer, evaluators, fused_cycle=p.fused_cycle,
+                divergence_guard=guard,
             )
             from photon_ml_tpu.utils.profiling import maybe_trace
 
@@ -724,6 +732,11 @@ class GameTrainingDriver:
                 f"combo {i}: objective={result.objective_history[-1]:.6g} "
                 + " ".join(f"{k}={v:.6g}" for k, v in metrics.items())
             )
+            for ev in result.guard_events:
+                self.logger.warn(
+                    f"combo {i}: divergence guard {ev.action} at coordinate "
+                    f"{ev.coordinate!r} step {ev.step} ({ev.detail})"
+                )
             for cname, tracker in result.trackers.items():
                 summary = _summarize_tracker(tracker)
                 if summary:
@@ -899,7 +912,35 @@ class GameTrainingDriver:
                     )
 
     # ------------------------------------------------------------------
+    def _resilience_config(self):
+        """Process-wide ingest resilience settings from the driver flags
+        (corrupt-shard policy + I/O retry/backoff), installed for the whole
+        run so every read path — feature scan, dataset load, checkpoint —
+        behaves consistently."""
+        import dataclasses
+
+        from photon_ml_tpu import resilience
+
+        p = self.params
+        # flags override attempts/base-delay; the rest of the policy keeps
+        # the env-tunable defaults (PHOTON_IO_RETRY_MAX_DELAY / _DEADLINE)
+        return resilience.ResilienceConfig(
+            on_corrupt=p.on_corrupt,
+            corrupt_skip_budget=p.corrupt_skip_budget,
+            io_policy=dataclasses.replace(
+                resilience.RetryPolicy.io_default(),
+                max_attempts=p.io_retries,
+                base_delay=p.io_retry_base_delay,
+            ),
+        )
+
     def run(self) -> None:
+        from photon_ml_tpu import resilience
+
+        with resilience.resilience_scope(self._resilience_config()):
+            self._run_guarded()
+
+    def _run_guarded(self) -> None:
         p = self.params
         prepare_output_dir(p.output_dir, p.delete_output_dir_if_exists)
         try:

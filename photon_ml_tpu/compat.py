@@ -1,0 +1,90 @@
+"""Version-gated JAX API shims.
+
+The baked image pins one JAX version; developer machines and CI may run
+another. Every cross-version API difference the package depends on is
+resolved HERE, once, instead of try/excepting at each call site — part of
+the resilience story: an import-time AttributeError in a leaf module would
+otherwise take down the whole ``parallel`` package (and every driver that
+lazily imports it) on a version skew.
+
+Currently shimmed:
+
+  * ``shard_map`` — stable ``jax.shard_map`` (jax >= 0.6) with the
+    ``check_vma`` kwarg, vs ``jax.experimental.shard_map.shard_map`` (older
+    jax) where the same knob is spelled ``check_rep``. Callers use the
+    modern spelling; the shim translates when running on the older API.
+  * ``distributed_is_initialized`` — ``jax.distributed.is_initialized()``
+    does not exist on older jax; fall back to probing the internal
+    distributed global state for a live client.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+try:  # modern spelling (jax >= 0.6): stable, check_vma kwarg
+    from jax import shard_map as _shard_map  # type: ignore[attr-defined]
+
+    _NEEDS_TRANSLATION = False
+except ImportError:  # older jax: experimental module, check_rep kwarg
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    _NEEDS_TRANSLATION = True
+
+
+def shard_map(f: Callable[..., Any], **kwargs: Any):
+    """``jax.shard_map`` facade accepting the modern kwargs on any jax.
+
+    On the legacy API the ``check_rep`` validator has no replication rule
+    for ``lax.while_loop`` (NotImplementedError at trace time), which every
+    solver kernel here carries — so when translating, validation is turned
+    OFF rather than crashing the solve. The modern ``check_vma`` validator
+    handles while_loop and stays at the caller's setting; the compensating
+    sharded-vs-local equivalence tests (tests/test_checkvma_fence.py
+    registry) hold on both APIs.
+    """
+    if _NEEDS_TRANSLATION:
+        kwargs.pop("check_vma", None)
+        kwargs["check_rep"] = False
+    return _shard_map(f, **kwargs)
+
+
+def distributed_is_initialized() -> bool:
+    """``jax.distributed.is_initialized()`` on any jax version."""
+    import jax
+
+    try:
+        return bool(jax.distributed.is_initialized())
+    except AttributeError:
+        from jax._src import distributed as _dist
+
+        return getattr(_dist.global_state, "client", None) is not None
+
+
+def enable_x64():
+    """``jax.enable_x64()`` context manager on any jax version (older jax
+    spells it ``jax.experimental.enable_x64``)."""
+    import jax
+
+    try:
+        return jax.enable_x64()
+    except AttributeError:
+        from jax.experimental import enable_x64 as _enable_x64
+
+        return _enable_x64()
+
+
+def ensure_cpu_collectives() -> None:
+    """Select the Gloo CPU collectives implementation where it is opt-in.
+
+    Older jax ships multiprocess CPU collectives behind
+    ``jax_cpu_collectives_implementation`` (default ``none`` -> cross-host
+    psums fail with "Multiprocess computations aren't implemented on the
+    CPU backend"); newer jax enables a CPU collectives backend by default.
+    Harmless on TPU — the option only affects the CPU PJRT client."""
+    import jax
+
+    try:
+        jax.config.update("jax_cpu_collectives_implementation", "gloo")
+    except (AttributeError, ValueError):
+        pass  # option gone (newer jax: CPU collectives are on by default)

@@ -19,15 +19,34 @@ from __future__ import annotations
 
 import io as _io
 import json
+import logging
 import os
 import struct
 import zlib
-from typing import Any, BinaryIO, Dict, Iterable, Iterator, List, Union
+from typing import Any, BinaryIO, Dict, Iterable, Iterator, List, Optional, Union
 
 MAGIC = b"Obj\x01"
 DEFAULT_SYNC = b"\x50\x48\x4f\x54\x4f\x4e\x2d\x54\x50\x55\x2d\x53\x59\x4e\x43\x21"  # 16B
 
 Schema = Union[str, dict, list]
+
+logger = logging.getLogger(__name__)
+
+
+class CorruptBlockError(ValueError):
+    """A container block failed to decode. Carries the file path, block
+    index, and byte offset so a corrupt shard report is actionable (which
+    part-file to quarantine, where to look with a hex editor)."""
+
+    def __init__(self, path: str, block_index: int, offset: int, reason: str):
+        self.path = path
+        self.block_index = block_index
+        self.offset = offset
+        self.reason = reason
+        super().__init__(
+            f"{path}: corrupt avro block {block_index} at byte offset "
+            f"{offset}: {reason}"
+        )
 
 
 # ---------------------------------------------------------------------------
@@ -285,41 +304,157 @@ def write_container(
         flush()
 
 
-def read_container(path: str) -> Iterator[Any]:
-    with open(path, "rb") as f:
-        if f.read(4) != MAGIC:
-            raise ValueError(f"{path}: not an avro container file")
-        meta: Dict[str, bytes] = {}
-        while True:
-            count = read_long(f)
-            if count == 0:
-                break
-            if count < 0:
-                read_long(f)
-                count = -count
-            for _ in range(count):
-                k = read_string(f)
-                meta[k] = read_bytes(f)
+def _resync(f: BinaryIO, sync: bytes, start: int) -> Optional[int]:
+    """Scan forward from ``start`` for the next 16-byte sync marker; return
+    the offset just past it (the next block start), or None at EOF. Reads in
+    chunks with a 15-byte overlap so a marker straddling a chunk boundary is
+    still found."""
+    chunk_size = 1 << 16
+    f.seek(start)
+    carry = b""
+    base = start
+    while True:
+        chunk = f.read(chunk_size)
+        if not chunk:
+            return None
+        buf = carry + chunk
+        hit = buf.find(sync)
+        if hit >= 0:
+            return base - len(carry) + hit + len(sync)
+        carry = buf[-(len(sync) - 1):]
+        base += len(chunk)
+
+
+def read_container(
+    path: str,
+    on_corrupt: Optional[str] = None,
+    skip_budget: Optional[int] = None,
+) -> Iterator[Any]:
+    """Iterate records of one container file.
+
+    Transient read failures (OSError, including injected
+    ``io.read_block`` faults) are retried per block with the active
+    :class:`~photon_ml_tpu.resilience.RetryPolicy` — the file offset is
+    remembered before each block so a retry re-reads exactly that block.
+
+    ``on_corrupt="skip"`` drops undecodable blocks (resynchronizing on the
+    sync marker) up to ``skip_budget`` blocks before raising; ``"raise"``
+    (default) surfaces the first :class:`CorruptBlockError`. Both default to
+    the process-wide resilience config.
+    """
+    from photon_ml_tpu import resilience
+    from photon_ml_tpu.resilience import faults
+
+    cfg = resilience.current_config()
+    if on_corrupt is None:
+        on_corrupt = cfg.on_corrupt
+    if on_corrupt not in resilience.ON_CORRUPT_MODES:
+        raise ValueError(
+            f"on_corrupt must be one of {resilience.ON_CORRUPT_MODES}, "
+            f"got {on_corrupt!r}"
+        )
+    if skip_budget is None:
+        skip_budget = cfg.corrupt_skip_budget
+    policy = cfg.io_policy
+
+    with resilience.call_with_retry(
+        lambda: open(path, "rb"), policy, describe=f"open {path}"
+    ) as f:
+
+        def read_header():
+            """Magic + metadata map + sync marker; seeks to 0 first so the
+            enclosing retry (transient read errors mid-header) is idempotent."""
+            f.seek(0)
+            if f.read(4) != MAGIC:
+                raise ValueError(f"{path}: not an avro container file")
+            meta: Dict[str, bytes] = {}
+            while True:
+                count = read_long(f)
+                if count == 0:
+                    break
+                if count < 0:
+                    read_long(f)
+                    count = -count
+                for _ in range(count):
+                    k = read_string(f)
+                    meta[k] = read_bytes(f)
+            return meta, f.read(16)
+
+        meta, sync = resilience.call_with_retry(
+            read_header, policy, describe=f"read {path} header"
+        )
         schema = json.loads(meta["avro.schema"].decode())
         codec = meta.get("avro.codec", b"null").decode()
-        sync = f.read(16)
+        if codec not in ("deflate", "null"):
+            raise ValueError(f"unsupported codec {codec}")
         names: Dict[str, dict] = {}
         _register(schema, names)
-        while True:
+
+        block_index = 0
+        skipped = 0
+
+        def read_block(offset: int, index: int) -> Optional[List[Any]]:
+            """One complete block -> record list; None on clean EOF. Seeks
+            back to ``offset`` first so the enclosing retry is idempotent;
+            decode failures become CorruptBlockError (never retried —
+            re-reading corrupt bytes cannot help)."""
+            f.seek(offset)
+            faults.inject("io.read_block", path=path, block=index, offset=offset)
             try:
                 count = read_long(f)
             except EOFError:
-                return
-            payload = read_bytes(f)
-            if codec == "deflate":
-                payload = zlib.decompress(payload, -15)
-            elif codec != "null":
-                raise ValueError(f"unsupported codec {codec}")
-            block = _io.BytesIO(payload)
-            for _ in range(count):
-                yield read_datum(block, schema, names)
+                return None  # clean end of container
+            try:
+                payload = read_bytes(f)
+                if codec == "deflate":
+                    payload = zlib.decompress(payload, -15)
+                block = _io.BytesIO(payload)
+                records = [read_datum(block, schema, names) for _ in range(count)]
+            except (EOFError, struct.error) as e:
+                raise CorruptBlockError(
+                    path, index, offset, f"unexpected end of avro data ({e})"
+                ) from e
+            except zlib.error as e:
+                raise CorruptBlockError(
+                    path, index, offset, f"deflate payload corrupt ({e})"
+                ) from e
+            except (ValueError, KeyError, IndexError, TypeError) as e:
+                raise CorruptBlockError(
+                    path, index, offset, f"datum decode failed ({e})"
+                ) from e
             if f.read(16) != sync:
-                raise ValueError(f"{path}: sync marker mismatch")
+                raise CorruptBlockError(path, index, offset, "sync marker mismatch")
+            return records
+
+        while True:
+            offset = f.tell()
+            try:
+                records = resilience.call_with_retry(
+                    lambda: read_block(offset, block_index),
+                    policy,
+                    describe=f"read {path} block {block_index}",
+                    on_retry=lambda a, e, d: logger.warning(
+                        "retrying %s block %d (attempt %d): %s", path, block_index, a + 2, e
+                    ),
+                )
+            except CorruptBlockError as err:
+                if on_corrupt != "skip" or skipped >= skip_budget:
+                    raise
+                skipped += 1
+                logger.warning(
+                    "skipping corrupt block (%d/%d of skip budget): %s",
+                    skipped, skip_budget, err,
+                )
+                next_off = _resync(f, sync, offset + 1)
+                if next_off is None:
+                    return  # no later sync marker: rest of the file is gone
+                f.seek(next_off)
+                block_index += 1
+                continue
+            if records is None:
+                return
+            yield from records
+            block_index += 1
 
 
 def list_part_files(path: str) -> list:
@@ -335,7 +470,12 @@ def list_part_files(path: str) -> list:
     ]
 
 
-def read_directory(path: str) -> Iterator[Any]:
-    """Read all part files of an avro output directory (part-*.avro)."""
+def read_directory(
+    path: str,
+    on_corrupt: Optional[str] = None,
+    skip_budget: Optional[int] = None,
+) -> Iterator[Any]:
+    """Read all part files of an avro output directory (part-*.avro).
+    ``on_corrupt``/``skip_budget`` apply per part file (read_container)."""
     for f in list_part_files(path):
-        yield from read_container(f)
+        yield from read_container(f, on_corrupt=on_corrupt, skip_budget=skip_budget)

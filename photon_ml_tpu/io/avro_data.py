@@ -11,20 +11,26 @@ GameData containers the LIBSVM path produces.
 
 from __future__ import annotations
 
+import logging
 from typing import Dict, Iterable, List, Optional, Sequence, Tuple
 
 import numpy as np
 
 from photon_ml_tpu.types import real_dtype
 
+from photon_ml_tpu import resilience
 from photon_ml_tpu.data.game import GameData, HostFeatures
 from photon_ml_tpu.io import avro as avro_io
 from photon_ml_tpu.io import schemas
 from photon_ml_tpu.io.index_map import IndexMap, feature_key
 from photon_ml_tpu.io.libsvm import HostDataset
 
+logger = logging.getLogger(__name__)
+
 
 def _iter_records(paths: Sequence[str]) -> Iterable[dict]:
+    # per-block retry + corrupt-shard policy live in avro.read_container,
+    # driven by the process-wide resilience config
     for p in paths:
         yield from avro_io.read_directory(p)
 
@@ -39,12 +45,33 @@ def _expand_part_files(paths: Sequence[str]) -> List[str]:
 
 def _native_columns(paths: Sequence[str]):
     """NativeColumns per part file, or None if ANY file can't take the
-    native fast path (all-or-nothing keeps the assembly uniform)."""
+    native fast path (all-or-nothing keeps the assembly uniform).
+
+    Reads retry under the active policy (the ``io.read_block`` fault site
+    covers the whole-file native parse, block=-1). A file the native decoder
+    rejects as corrupt falls back to the python row loop, which owns the
+    block-granular corrupt-shard skip/raise semantics.
+    """
     from photon_ml_tpu.io import avro_native
+    from photon_ml_tpu.resilience import faults
+
+    policy = resilience.current_config().io_policy
+
+    def read_one(f: str):
+        faults.inject("io.read_block", path=f, block=-1, offset=0)
+        return avro_native.read_columns(f)
 
     cols = []
     for f in _expand_part_files(paths):
-        c = avro_native.read_columns(f)
+        try:
+            c = resilience.call_with_retry(
+                lambda f=f: read_one(f), policy, describe=f"native read {f}"
+            )
+        except ValueError as e:
+            logger.warning(
+                "native decoder rejected %s (%s); falling back to python ingest", f, e
+            )
+            return None
         if c is None:
             return None
         cols.append(c)

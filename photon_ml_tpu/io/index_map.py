@@ -88,6 +88,15 @@ class IndexMap:
 
     @staticmethod
     def load(path: str) -> "IndexMap":
-        with open(path) as f:
-            names = json.load(f)
+        from photon_ml_tpu import resilience
+        from photon_ml_tpu.resilience import faults
+
+        def read() -> list:
+            faults.inject("io.index_load", path=path)
+            with open(path) as f:
+                return json.load(f)
+
+        names = resilience.call_with_retry(
+            read, resilience.current_config().io_policy, describe=f"load {path}"
+        )
         return IndexMap({k: i for i, k in enumerate(names)}, names)

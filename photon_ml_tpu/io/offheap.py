@@ -22,6 +22,7 @@ from __future__ import annotations
 
 import ctypes
 import json
+import logging
 import mmap as mmap_mod
 import os
 import struct
@@ -33,6 +34,8 @@ from typing import Dict, Iterable, List, Optional
 import numpy as np
 
 from photon_ml_tpu.io.index_map import INTERCEPT_KEY, IndexMap, partition_keys
+
+logger = logging.getLogger(__name__)
 
 META_FILE = "meta.json"
 PARTITION_PREFIX = "partition-"
@@ -118,7 +121,11 @@ def _load_native():
             ctypes.POINTER(ctypes.c_uint64), ctypes.c_long,
         ]
         _native_lib = lib
-    except Exception:
+    except (OSError, subprocess.CalledProcessError, AttributeError) as e:
+        # expected degradations: no source file / no g++ / CDLL load failure /
+        # a library missing an entry point — fall back to the pure-Python
+        # reader, loudly (anything else, e.g. a ctypes misuse bug, raises)
+        logger.warning("native pmix store unavailable (%s); using pure-Python reader", e)
         _native_failed = True
         _native_lib = None
     return _native_lib
@@ -199,8 +206,9 @@ class _NativePartition:
     def __del__(self):  # pragma: no cover - GC timing
         try:
             self.close()
-        except Exception:
-            pass
+        except OSError as e:
+            # interpreter-shutdown close can fail; never raise from __del__
+            logger.warning("pmix partition close failed during GC: %s", e)
 
 
 class _PythonPartition:
@@ -311,14 +319,28 @@ class OffHeapIndexMap:
     """
 
     def __init__(self, store_dir: str, force_python: bool = False):
-        with open(os.path.join(store_dir, META_FILE)) as f:
-            self._meta = json.load(f)
+        from photon_ml_tpu import resilience
+        from photon_ml_tpu.resilience import faults
+
+        policy = resilience.current_config().io_policy
+
+        def read_meta() -> dict:
+            faults.inject("io.index_load", path=store_dir)
+            with open(os.path.join(store_dir, META_FILE)) as f:
+                return json.load(f)
+
+        self._meta = resilience.call_with_retry(
+            read_meta, policy, describe=f"load {store_dir} meta"
+        )
         if self._meta.get("format") != "pmix":
             raise IOError(f"{store_dir} is not a pmix off-heap store")
         self._partitions = [
-            _open_partition(
-                os.path.join(store_dir, f"{PARTITION_PREFIX}{i}{PARTITION_SUFFIX}"),
-                force_python,
+            resilience.call_with_retry(
+                lambda p=os.path.join(
+                    store_dir, f"{PARTITION_PREFIX}{i}{PARTITION_SUFFIX}"
+                ): _open_partition(p, force_python),
+                policy,
+                describe=f"open {store_dir} partition {i}",
             )
             for i in range(self._meta["num_partitions"])
         ]

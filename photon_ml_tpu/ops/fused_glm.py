@@ -594,8 +594,8 @@ def select_fused_block_rows(
                 block_rows=b, interpret=interpret,
             )[:2]
             timings[block] = _time_value_and_grad(fn, w0, probe_data)
-        except Exception:
-            continue  # a block config that fails to compile is just not a candidate
+        except Exception:  # noqa: BLE001 — autotune probe: any compile/run failure just disqualifies the candidate
+            continue
     _autotune_timings[key] = dict(timings)
     if not timings:
         _autotune_cache[key] = None

@@ -224,6 +224,18 @@ def lbfgs_minimize_(
         init = (t0, s.w, s.f, s.g, s.F, jnp.zeros((), jnp.int32), jnp.zeros((), bool))
         t, w_new, f_new, g_new, F_new, _, ls_ok = lax.while_loop(ls_cond, ls_body, init)
 
+        # divergence guard (resilience): a trial point with a non-finite
+        # value, gradient, or coefficient vector is rejected exactly like a
+        # failed line search — the carried state stays at the last good
+        # iterate instead of poisoning the curvature history (branch-free,
+        # so the guard also protects every vmapped per-entity lane)
+        finite = (
+            jnp.isfinite(F_new)
+            & jnp.all(jnp.isfinite(w_new))
+            & jnp.all(jnp.isfinite(g_new))
+        )
+        ls_ok = ls_ok & finite
+
         # ---- curvature pair update --------------------------------------
         sv = w_new - s.w
         yv = g_new - s.g

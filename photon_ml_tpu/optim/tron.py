@@ -220,11 +220,32 @@ def tron_minimize_(
             ),
         )
 
-        accept = actred > _ETA0 * prered
+        # divergence guard (resilience): never accept a non-finite trial
+        # point — it counts as an improvement failure and the trust region
+        # shrinks, so the solver retries from the last good iterate
+        finite = (
+            jnp.isfinite(f_new)
+            & jnp.all(jnp.isfinite(w_trial))
+            & jnp.all(jnp.isfinite(g_new))
+        )
+        accept = (actred > _ETA0 * prered) & finite
         w_out = jnp.where(accept, w_trial, s.w)
         f_out = jnp.where(accept, f_new, s.f)
         g_out = jnp.where(accept, g_new, s.g)
         failures = jnp.where(accept, 0, s.failures + 1).astype(jnp.int32)
+        # a NaN objective poisons the interpolated radius formula; restore a
+        # finite, shrunken radius so the retry is meaningful. snorm itself
+        # is NaN when CG diverged — fall back to shrinking the previous
+        # (finite by induction) radius in that case
+        delta = jnp.where(
+            jnp.isfinite(delta),
+            delta,
+            jnp.where(
+                jnp.isfinite(snorm),
+                jnp.maximum(_SIGMA1 * snorm, _EPS),
+                jnp.maximum(_SIGMA1 * s.delta, _EPS),
+            ),
+        )
 
         g_norm = jnp.linalg.norm(reduced_grad(w_out, g_out))
         it = s.iteration + 1

@@ -47,7 +47,13 @@ def initialize(
     on CPU/GPU test clusters pass all three of coordinator/num/process-id.
     """
     if (num_processes is not None and num_processes > 1) or coordinator_address:
-        if not jax.distributed.is_initialized():
+        from photon_ml_tpu.compat import (
+            distributed_is_initialized,
+            ensure_cpu_collectives,
+        )
+
+        if not distributed_is_initialized():
+            ensure_cpu_collectives()
             kwargs = {}
             if local_device_count is not None:
                 # spelled local_device_ids in this jax version
@@ -139,11 +145,31 @@ class MultihostContext:
     # -- coordination ----------------------------------------------------
     def barrier(self, name: str = "photon-ml-tpu-barrier") -> None:
         """Block until every process reaches this point (checkpoint fences,
-        output-dir creation). No-op single-process."""
-        if self.num_processes > 1:
-            from jax.experimental import multihost_utils
+        output-dir creation). No-op single-process.
 
-            multihost_utils.sync_global_devices(name)
+        Barrier *entry* is a fault-injection site (``multihost.barrier``)
+        retried under the active I/O policy — the injected failure fires
+        before the collective, so a retry is safe (the sync itself is never
+        re-entered after succeeding). Chaos tests use this to prove the
+        checkpoint fences survive transient coordination failures.
+        """
+        from photon_ml_tpu import resilience
+        from photon_ml_tpu.resilience import faults
+
+        def enter() -> None:
+            # single-process still exercises the fault site, so chaos
+            # tests run without a multi-host harness
+            faults.inject("multihost.barrier", name=name, process=self.process_id)
+            if self.num_processes > 1:
+                from jax.experimental import multihost_utils
+
+                multihost_utils.sync_global_devices(name)
+
+        resilience.call_with_retry(
+            enter,
+            resilience.current_config().io_policy,
+            describe=f"barrier {name}",
+        )
 
     def coordinator_only_io(self) -> bool:
         """True when this process should perform global side effects (model

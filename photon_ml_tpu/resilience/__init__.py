@@ -1,0 +1,112 @@
+"""Resilience subsystem: fault injection, retry/backoff I/O, divergence guards.
+
+The reference Photon ML inherits fault tolerance from Spark (lineage
+recompute, task re-execution — SURVEY/PAPER §5.4); the TPU port owns its
+own I/O and solver loops, so it owns its own resilience:
+
+  * :mod:`photon_ml_tpu.resilience.faults` — deterministic fault injection
+    at named sites (``io.read_block``, ``io.checkpoint_write``,
+    ``io.index_load``, ``multihost.barrier``, ``optim.step``), driven by a
+    context manager or the ``PHOTON_FAULTS`` env var.
+  * :mod:`photon_ml_tpu.resilience.retry` — exponential backoff + jitter +
+    deadline retry policies applied to Avro reads, index-map/off-heap loads,
+    and checkpoint save/restore.
+  * :mod:`photon_ml_tpu.resilience.guards` — non-finite detection in
+    coordinate descent with last-good-state rollback.
+
+This module also holds the process-wide :class:`ResilienceConfig` consulted
+by the ingest layer (corrupt-shard policy + retry policy), installed by the
+CLI drivers from ``--on-corrupt`` / ``--corrupt-skip-budget`` /
+``--io-retries`` flags or scoped with :func:`resilience_scope` in tests.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import dataclasses
+from typing import Iterator, Optional
+
+from photon_ml_tpu.resilience import faults, guards, retry
+from photon_ml_tpu.resilience.faults import (
+    FaultPlan,
+    FaultSpec,
+    InjectedFatalError,
+    InjectedIOError,
+    fault_scope,
+)
+from photon_ml_tpu.resilience.guards import DivergenceGuard, GuardEvent, tree_all_finite
+from photon_ml_tpu.resilience.retry import RetryError, RetryPolicy, call_with_retry
+
+__all__ = [
+    "faults",
+    "guards",
+    "retry",
+    "FaultPlan",
+    "FaultSpec",
+    "InjectedIOError",
+    "InjectedFatalError",
+    "fault_scope",
+    "DivergenceGuard",
+    "GuardEvent",
+    "tree_all_finite",
+    "RetryError",
+    "RetryPolicy",
+    "call_with_retry",
+    "ResilienceConfig",
+    "current_config",
+    "set_config",
+    "resilience_scope",
+]
+
+ON_CORRUPT_MODES = ("raise", "skip")
+
+
+@dataclasses.dataclass(frozen=True)
+class ResilienceConfig:
+    """Process-wide ingest resilience settings.
+
+    ``on_corrupt="skip"`` lets Avro container reads drop corrupt blocks
+    (resynchronizing on the sync marker) up to ``corrupt_skip_budget`` blocks
+    per file before raising; ``io_policy`` is the retry policy every
+    filesystem read/write path uses.
+    """
+
+    on_corrupt: str = "raise"
+    corrupt_skip_budget: int = 16
+    io_policy: RetryPolicy = dataclasses.field(default_factory=RetryPolicy.io_default)
+
+    def __post_init__(self):
+        if self.on_corrupt not in ON_CORRUPT_MODES:
+            raise ValueError(
+                f"on_corrupt must be one of {ON_CORRUPT_MODES}, got {self.on_corrupt!r}"
+            )
+        if self.corrupt_skip_budget < 0:
+            raise ValueError(
+                f"corrupt_skip_budget must be >= 0, got {self.corrupt_skip_budget}"
+            )
+
+
+_config: Optional[ResilienceConfig] = None
+
+
+def current_config() -> ResilienceConfig:
+    """The installed config, else defaults (raise on corrupt, env-tuned retry)."""
+    return _config if _config is not None else ResilienceConfig()
+
+
+def set_config(config: Optional[ResilienceConfig]) -> None:
+    """Install (or with None, reset) the process-wide resilience config."""
+    global _config
+    _config = config
+
+
+@contextlib.contextmanager
+def resilience_scope(config: ResilienceConfig) -> Iterator[ResilienceConfig]:
+    """``with resilience_scope(cfg):`` — install for the duration."""
+    global _config
+    prev = _config
+    _config = config
+    try:
+        yield config
+    finally:
+        _config = prev

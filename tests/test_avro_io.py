@@ -146,3 +146,136 @@ def test_random_effect_model_roundtrip(tmp_path, rng):
     # layout check: part files exist under coordinates dir
     parts = os.listdir(os.path.join(out, "random-effect", "perUser", "coefficients"))
     assert len(parts) == 3 and all(p.endswith(".avro") for p in parts)
+
+
+# ---------------------------------------------------------------------------
+# corrupt-shard resilience (resilience subsystem wiring in read_container)
+# ---------------------------------------------------------------------------
+
+
+def _write_blocks(path, num_records=30, block_size=10):
+    recs = [
+        {"name": f"f{i}", "term": str(i % 3), "value": float(i) * 0.5}
+        for i in range(num_records)
+    ]
+    avro_io.write_container(
+        path, recs, schemas.NAME_TERM_VALUE, codec="deflate", block_size=block_size
+    )
+    return recs
+
+
+def _sync_positions(path):
+    data = open(path, "rb").read()
+    out, start = [], 0
+    while True:
+        hit = data.find(avro_io.DEFAULT_SYNC, start)
+        if hit < 0:
+            return data, out
+        out.append(hit)
+        start = hit + 1
+
+
+def _corrupt_block(path, block):
+    """Flip bytes mid-payload of the given 0-based block (deflate -> the
+    decompressor reliably detects the damage)."""
+    data, syncs = _sync_positions(path)
+    lo = syncs[block] + 16  # block starts after the previous sync
+    hi = syncs[block + 1]
+    mid = (lo + hi) // 2
+    garbled = bytearray(data)
+    for i in range(mid, min(mid + 8, hi)):
+        garbled[i] ^= 0xFF
+    with open(path, "wb") as f:
+        f.write(bytes(garbled))
+    return lo
+
+
+@pytest.mark.faults
+class TestCorruptShards:
+    def test_corrupt_block_error_is_actionable(self, tmp_path):
+        path = str(tmp_path / "part-0.avro")
+        _write_blocks(path)
+        offset = _corrupt_block(path, 1)
+        with pytest.raises(avro_io.CorruptBlockError) as ei:
+            list(avro_io.read_container(path))
+        err = ei.value
+        assert err.path == path and err.block_index == 1 and err.offset == offset
+        # path, block index, and byte offset all appear in the message
+        assert path in str(err) and "block 1" in str(err) and str(offset) in str(err)
+
+    def test_skip_mode_resyncs_and_drops_only_bad_block(self, tmp_path):
+        path = str(tmp_path / "part-0.avro")
+        recs = _write_blocks(path)
+        _corrupt_block(path, 1)
+        got = list(avro_io.read_container(path, on_corrupt="skip", skip_budget=2))
+        assert got == recs[:10] + recs[20:]  # exactly block 2 lost
+
+    def test_skip_budget_zero_still_raises(self, tmp_path):
+        path = str(tmp_path / "part-0.avro")
+        _write_blocks(path)
+        _corrupt_block(path, 0)
+        with pytest.raises(avro_io.CorruptBlockError):
+            list(avro_io.read_container(path, on_corrupt="skip", skip_budget=0))
+
+    def test_truncated_file_error_mentions_eof_and_location(self, tmp_path):
+        path = str(tmp_path / "part-0.avro")
+        recs = _write_blocks(path)
+        data, syncs = _sync_positions(path)
+        with open(path, "wb") as f:
+            f.write(data[: syncs[2] - 5])  # cut mid-way through block 2
+        with pytest.raises(avro_io.CorruptBlockError) as ei:
+            list(avro_io.read_container(path))
+        msg = str(ei.value)
+        assert (
+            "unexpected end of avro data" in msg
+            or "sync marker" in msg
+            or "truncated" in msg
+        )
+        assert path in msg and "block 1" in msg and "offset" in msg
+        # skip mode: the complete first block still reads, then clean stop
+        got = list(avro_io.read_container(path, on_corrupt="skip", skip_budget=4))
+        assert got == recs[:10]
+
+    def test_process_config_drives_skip_mode(self, tmp_path):
+        from photon_ml_tpu import resilience
+
+        path = str(tmp_path / "part-0.avro")
+        recs = _write_blocks(path)
+        _corrupt_block(path, 2)
+        cfg = resilience.ResilienceConfig(on_corrupt="skip", corrupt_skip_budget=1)
+        with resilience.resilience_scope(cfg):
+            got = list(avro_io.read_container(path))
+        assert got == recs[:20]
+
+    def test_retryable_faults_heal_transparently(self, tmp_path):
+        from photon_ml_tpu import resilience
+        from photon_ml_tpu.resilience import faults
+
+        path = str(tmp_path / "part-0.avro")
+        recs = _write_blocks(path)
+        plan = faults.FaultPlan(
+            [faults.FaultSpec("io.read_block", rate=0.3, seed=13, times=None)]
+        )
+        cfg = resilience.ResilienceConfig(
+            io_policy=resilience.RetryPolicy(max_attempts=8, base_delay=0.0)
+        )
+        with faults.fault_scope(plan), resilience.resilience_scope(cfg):
+            got = list(avro_io.read_container(path))
+        assert got == recs
+        assert plan.fire_count("io.read_block") > 0  # faults actually fired
+
+    def test_retry_exhaustion_surfaces_retry_error(self, tmp_path):
+        from photon_ml_tpu import resilience
+        from photon_ml_tpu.resilience import faults
+
+        path = str(tmp_path / "part-0.avro")
+        _write_blocks(path)
+        plan = faults.FaultPlan(
+            [faults.FaultSpec("io.read_block", rate=1.0, seed=1, times=None)]
+        )
+        cfg = resilience.ResilienceConfig(
+            io_policy=resilience.RetryPolicy(max_attempts=2, base_delay=0.0)
+        )
+        with faults.fault_scope(plan), resilience.resilience_scope(cfg):
+            with pytest.raises(resilience.RetryError):
+                list(avro_io.read_container(path))

@@ -187,3 +187,89 @@ class TestDriverCheckpointFlag:
         # second run resumes from the complete checkpoint: same final objective
         d2 = game_training_driver.main(args)
         assert d2.results[0][1].objective_history == d1.results[0][1].objective_history
+
+
+class TestCrashMidWriteResume:
+    """Crash debris tolerance (resilience subsystem): a killed writer leaves
+    a stale temp dir and possibly a truncated arrays.npz on a non-atomic
+    filesystem; restore() must ignore both and resume from the last
+    COMPLETE step."""
+
+    def _save_steps(self, tmp_path, steps):
+        ckpt = CoordinateDescentCheckpointer(str(tmp_path), "fp", keep=10)
+        scores = {"a": jnp.zeros(2)}
+        for step in steps:
+            ckpt.save(
+                CheckpointState(step, {"a": jnp.full(2, float(step))}, scores,
+                                jnp.zeros(2), [float(step)], [])
+            )
+        return ckpt
+
+    def test_restore_ignores_stale_tmp_and_truncated_npz(self, tmp_path):
+        self._save_steps(tmp_path, (1, 2))
+
+        # crash debris 1: a stale temp dir from a writer killed mid-write
+        stale = tmp_path / ".ckpt-deadbeef"
+        stale.mkdir()
+        (stale / "arrays.npz").write_bytes(b"PK\x03\x04 partial garbage")
+
+        # crash debris 2: step-3 got its meta written but arrays.npz is
+        # truncated (crash between file writes on a non-atomic filesystem)
+        import shutil as _sh
+
+        _sh.copytree(tmp_path / "step-2", tmp_path / "step-3")
+        meta_path = tmp_path / "step-3" / "meta.json"
+        import json as _json
+
+        meta = _json.loads(meta_path.read_text())
+        meta["step"] = 3
+        meta_path.write_text(_json.dumps(meta))
+        arrays_path = tmp_path / "step-3" / "arrays.npz"
+        arrays_path.write_bytes(arrays_path.read_bytes()[:20])  # truncate
+
+        params = {"a": jnp.zeros(2)}
+        scores = {"a": jnp.zeros(2)}
+        ckpt = CoordinateDescentCheckpointer(str(tmp_path), "fp", keep=10)
+        restored = ckpt.restore(params, scores, jnp.zeros(2))
+        # fell back to step 2, the last complete checkpoint
+        assert restored is not None and restored.step == 2
+        np.testing.assert_array_equal(np.asarray(restored.params["a"]), [2.0, 2.0])
+        # the stale temp dir was swept on checkpointer construction
+        assert not stale.exists()
+
+    def test_descent_resumes_through_crash_debris(self, glmix, tmp_path):
+        data, _ = glmix
+        n = data.num_rows
+        ckpt_dir = str(tmp_path / "ckpt")
+        full = _build_cd(data).run(2, n)
+
+        _build_cd(data).run(1, n, CoordinateDescentCheckpointer(ckpt_dir, "run"))
+        # simulate a crash mid-write of the NEXT checkpoint
+        os.makedirs(os.path.join(ckpt_dir, ".ckpt-wip"))
+        with open(os.path.join(ckpt_dir, ".ckpt-wip", "arrays.npz"), "wb") as f:
+            f.write(b"\x00" * 64)
+
+        resumed = _build_cd(data).run(
+            2, n, CoordinateDescentCheckpointer(ckpt_dir, "run")
+        )
+        np.testing.assert_allclose(
+            np.asarray(resumed.total_scores), np.asarray(full.total_scores),
+            rtol=1e-5, atol=1e-5,
+        )
+
+    def test_save_retries_through_injected_write_faults(self, tmp_path):
+        from photon_ml_tpu import resilience
+        from photon_ml_tpu.resilience import faults
+
+        plan = faults.FaultPlan(
+            [faults.FaultSpec("io.checkpoint_write", at=1, times=1)]
+        )
+        cfg = resilience.ResilienceConfig(
+            io_policy=resilience.RetryPolicy(max_attempts=3, base_delay=0.0)
+        )
+        with faults.fault_scope(plan), resilience.resilience_scope(cfg):
+            ckpt = self._save_steps(tmp_path, (1,))
+        assert plan.fire_count("io.checkpoint_write") == 1
+        assert ckpt.latest_step() == 1  # the retry completed the write
+        # no temp-dir debris from the failed attempt
+        assert not [d for d in os.listdir(tmp_path) if d.startswith(".ckpt-")]

@@ -1,0 +1,51 @@
+"""tools/lint_excepts.py: the broad-except linter, enforced from tier-1."""
+
+import os
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+TOOL = os.path.join(REPO, "tools", "lint_excepts.py")
+
+sys.path.insert(0, os.path.join(REPO, "tools"))
+import lint_excepts  # noqa: E402
+
+
+def _violations(src):
+    return list(lint_excepts.check_source("<test>", textwrap.dedent(src)))
+
+
+def test_bare_except_flagged():
+    assert _violations("try:\n    pass\nexcept:\n    pass\n")
+
+
+def test_broad_exception_without_tag_flagged():
+    assert _violations("try:\n    pass\nexcept Exception:\n    pass\n")
+    assert _violations("try:\n    pass\nexcept BaseException as e:\n    pass\n")
+    assert _violations("try:\n    pass\nexcept (ValueError, Exception):\n    pass\n")
+
+
+def test_annotated_broad_exception_allowed():
+    assert not _violations(
+        "try:\n    pass\nexcept Exception:  # noqa: BLE001 — justified\n    pass\n"
+    )
+
+
+def test_narrow_excepts_pass():
+    assert not _violations(
+        "try:\n    pass\nexcept (OSError, ValueError) as e:\n    raise\n"
+    )
+
+
+def test_package_is_clean():
+    """THE gate: photon_ml_tpu must carry no unjustified broad excepts."""
+    proc = subprocess.run(
+        [sys.executable, TOOL],
+        capture_output=True,
+        text=True,
+        cwd=REPO,
+    )
+    assert proc.returncode == 0, f"lint_excepts violations:\n{proc.stdout}"

@@ -10,7 +10,11 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 import pytest
-from hypothesis import given, settings, strategies as st
+
+pytest.importorskip(
+    "hypothesis", reason="property suite needs hypothesis (absent in some images)"
+)
+from hypothesis import given, settings, strategies as st  # noqa: E402
 
 from photon_ml_tpu.ops import losses as losses_mod
 from photon_ml_tpu.ops.features import DenseFeatures, SparseFeatures, from_scipy_like

@@ -1,0 +1,415 @@
+"""Unit tests for the resilience subsystem: fault registry, retry policies,
+divergence guards, and the corrupt-shard / retry wiring in the I/O layer."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from photon_ml_tpu import resilience
+from photon_ml_tpu.resilience import faults
+from photon_ml_tpu.resilience.guards import DivergenceGuard, tree_all_finite
+from photon_ml_tpu.resilience.retry import RetryError, RetryPolicy, call_with_retry
+
+
+@pytest.fixture(autouse=True)
+def _clean_plan():
+    faults.clear()
+    yield
+    faults.clear()
+
+
+# ---------------------------------------------------------------------------
+# fault registry
+# ---------------------------------------------------------------------------
+
+
+class TestFaults:
+    def test_no_plan_is_noop(self):
+        faults.inject("io.read_block", path="x")
+        assert faults.corrupt("optim.step", {"a": 1}) == {"a": 1}
+
+    def test_at_fires_exactly_once_on_nth_hit(self):
+        plan = faults.FaultPlan([faults.FaultSpec("io.read_block", at=3)])
+        with faults.fault_scope(plan):
+            faults.inject("io.read_block")
+            faults.inject("io.read_block")
+            with pytest.raises(faults.InjectedIOError):
+                faults.inject("io.read_block")
+            faults.inject("io.read_block")  # times defaults to 1 for `at`
+        assert plan.fire_count("io.read_block") == 1
+        assert plan.hits("io.read_block") == 4
+
+    def test_rate_is_deterministic_per_seed(self):
+        def run(seed):
+            plan = faults.FaultPlan(
+                [faults.FaultSpec("io.read_block", rate=0.5, seed=seed, times=None)]
+            )
+            fired = []
+            with faults.fault_scope(plan):
+                for i in range(32):
+                    try:
+                        faults.inject("io.read_block", i=i)
+                        fired.append(False)
+                    except faults.InjectedIOError:
+                        fired.append(True)
+            return fired
+
+        assert run(7) == run(7)
+        assert any(run(7)) and not all(run(7))
+
+    def test_fatal_kind(self):
+        plan = faults.FaultPlan([faults.FaultSpec("multihost.barrier", at=1, kind="fatal")])
+        with faults.fault_scope(plan), pytest.raises(faults.InjectedFatalError):
+            faults.inject("multihost.barrier")
+
+    def test_corrupt_pours_nan_into_first_leaf(self):
+        import jax.numpy as jnp
+
+        plan = faults.FaultPlan([faults.FaultSpec("optim.step", at=1, kind="nan")])
+        tree = {"w": jnp.ones(4), "b": jnp.zeros(2)}
+        with faults.fault_scope(plan):
+            out = faults.corrupt("optim.step", tree)
+        leaves = [np.asarray(v) for v in out.values()]
+        assert any(np.isnan(leaf).all() for leaf in leaves)
+        # second call: spec exhausted (times=1), tree untouched
+        with faults.fault_scope(plan):
+            out2 = faults.corrupt("optim.step", tree)
+        assert all(np.isfinite(np.asarray(v)).all() for v in out2.values())
+
+    def test_env_parsing_roundtrip(self):
+        plan = faults.parse_fault_env(
+            "io.read_block:rate=0.25,seed=9;optim.step:at=2,kind=nan;io.checkpoint_write:rate=1.0,times=2"
+        )
+        assert plan.spec("io.read_block").rate == 0.25
+        assert plan.spec("optim.step").kind == "nan"
+        assert plan.spec("io.checkpoint_write").times == 2
+        with pytest.raises(ValueError):
+            faults.parse_fault_env("io.read_block:bogus=1")
+
+    def test_env_activation(self, monkeypatch):
+        monkeypatch.setenv(faults.FAULT_ENV, "io.index_load:at=1")
+        with pytest.raises(faults.InjectedIOError):
+            faults.inject("io.index_load")
+
+    def test_events_record_context(self):
+        plan = faults.FaultPlan([faults.FaultSpec("io.read_block", at=1)])
+        with faults.fault_scope(plan):
+            with pytest.raises(faults.InjectedIOError):
+                faults.inject("io.read_block", path="p.avro", block=4)
+        assert plan.events == [("io.read_block", {"path": "p.avro", "block": 4, "hit": 1})]
+
+
+# ---------------------------------------------------------------------------
+# retry policies
+# ---------------------------------------------------------------------------
+
+
+class TestRetry:
+    def test_succeeds_after_transient_failures(self):
+        calls = []
+
+        def flaky():
+            calls.append(1)
+            if len(calls) < 3:
+                raise OSError("transient")
+            return "done"
+
+        slept = []
+        out = call_with_retry(
+            flaky,
+            RetryPolicy(max_attempts=4, base_delay=0.1, jitter=0.0),
+            sleep=slept.append,
+        )
+        assert out == "done"
+        assert len(calls) == 3
+        assert slept == [0.1, 0.2]  # exponential, no jitter
+
+    def test_exhaustion_raises_retry_error_with_cause(self):
+        def always():
+            raise OSError("nope")
+
+        with pytest.raises(RetryError) as ei:
+            call_with_retry(
+                always, RetryPolicy(max_attempts=3, base_delay=0.0), describe="op"
+            )
+        assert isinstance(ei.value.__cause__, OSError)
+        assert "3 attempt" in str(ei.value)
+
+    def test_non_retryable_propagates_immediately(self):
+        calls = []
+
+        def bad():
+            calls.append(1)
+            raise ValueError("corrupt")
+
+        with pytest.raises(ValueError):
+            call_with_retry(bad, RetryPolicy(max_attempts=5, base_delay=0.0))
+        assert len(calls) == 1
+
+    def test_deadline_bounds_total_retry_time(self):
+        now = [0.0]
+
+        def clock():
+            return now[0]
+
+        def sleep(d):
+            now[0] += d
+
+        def always():
+            raise OSError("x")
+
+        calls = []
+
+        def counting():
+            calls.append(1)
+            raise OSError("x")
+
+        with pytest.raises(RetryError):
+            call_with_retry(
+                counting,
+                RetryPolicy(max_attempts=100, base_delay=1.0, multiplier=1.0,
+                            jitter=0.0, deadline=2.5),
+                sleep=sleep,
+                clock=clock,
+            )
+        assert len(calls) == 3  # attempt, +1s retry, +1s retry, next would pass 2.5s
+
+    def test_delay_capped_and_jittered_deterministically(self):
+        import random
+
+        p = RetryPolicy(base_delay=1.0, max_delay=3.0, multiplier=10.0, jitter=0.5)
+        d = p.delay_for(5, random.Random(0))
+        assert 1.5 <= d <= 4.5  # 3.0 capped, +/-50%
+        assert p.delay_for(5, random.Random(0)) == d
+
+    def test_injected_fault_is_retryable(self):
+        plan = faults.FaultPlan([faults.FaultSpec("io.index_load", at=1)])
+        calls = []
+
+        def read():
+            calls.append(1)
+            faults.inject("io.index_load")
+            return 42
+
+        with faults.fault_scope(plan):
+            out = call_with_retry(read, RetryPolicy(max_attempts=3, base_delay=0.0))
+        assert out == 42 and len(calls) == 2
+
+
+# ---------------------------------------------------------------------------
+# config scoping
+# ---------------------------------------------------------------------------
+
+
+class TestConfig:
+    def test_defaults(self):
+        cfg = resilience.current_config()
+        assert cfg.on_corrupt == "raise"
+        assert cfg.io_policy.max_attempts >= 1
+
+    def test_scope_installs_and_restores(self):
+        cfg = resilience.ResilienceConfig(on_corrupt="skip", corrupt_skip_budget=2)
+        with resilience.resilience_scope(cfg):
+            assert resilience.current_config().on_corrupt == "skip"
+        assert resilience.current_config().on_corrupt == "raise"
+
+    def test_validation(self):
+        with pytest.raises(ValueError):
+            resilience.ResilienceConfig(on_corrupt="explode")
+        with pytest.raises(ValueError):
+            resilience.ResilienceConfig(corrupt_skip_budget=-1)
+
+
+# ---------------------------------------------------------------------------
+# divergence guard
+# ---------------------------------------------------------------------------
+
+
+class TestGuards:
+    def test_tree_all_finite(self):
+        import jax.numpy as jnp
+
+        assert tree_all_finite({"a": jnp.ones(3), "n": np.arange(3)})
+        assert not tree_all_finite({"a": jnp.array([1.0, np.nan])})
+        assert not tree_all_finite([jnp.array([np.inf])])
+        # integer arrays can't be non-finite
+        assert tree_all_finite({"i": np.array([1, 2], np.int32)})
+
+    def test_rollback_returns_last_good_state(self):
+        import jax.numpy as jnp
+
+        g = DivergenceGuard()
+        good_w, good_s = jnp.ones(3), jnp.zeros(5)
+        bad_w = jnp.array([1.0, np.nan, 2.0])
+        w, s, ok = g.filter_update("fixed", 3, bad_w, good_s, good_w, good_s)
+        assert not ok
+        assert np.allclose(np.asarray(w), 1.0)
+        assert g.events[0].coordinate == "fixed" and g.events[0].step == 3
+
+    def test_finite_update_passes_through(self):
+        import jax.numpy as jnp
+
+        g = DivergenceGuard()
+        w, s, ok = g.filter_update("c", 1, jnp.ones(2), jnp.ones(2), None, None)
+        assert ok and not g.events
+
+    def test_max_events_exhaustion_raises(self):
+        import jax.numpy as jnp
+
+        g = DivergenceGuard(max_events=1)
+        bad = jnp.array([np.nan])
+        g.filter_update("c", 1, bad, bad, jnp.ones(1), jnp.ones(1))
+        with pytest.raises(FloatingPointError):
+            g.filter_update("c", 2, bad, bad, jnp.ones(1), jnp.ones(1))
+
+    def test_invalid_mode(self):
+        with pytest.raises(ValueError):
+            DivergenceGuard(mode="panic")
+
+
+# ---------------------------------------------------------------------------
+# I/O wiring: index map + offheap loads retry under injected faults
+# ---------------------------------------------------------------------------
+
+
+class TestIOWiring:
+    def test_index_map_load_retries_injected_faults(self, tmp_path):
+        from photon_ml_tpu.io.index_map import IndexMap
+
+        path = str(tmp_path / "feature-index.json")
+        IndexMap.build(["a\x01", "b\x01"]).save(path)
+        plan = faults.FaultPlan([faults.FaultSpec("io.index_load", at=1)])
+        with faults.fault_scope(plan):
+            m = IndexMap.load(path)
+        assert len(m) == 3  # two keys + intercept
+        assert plan.fire_count("io.index_load") == 1
+
+    def test_offheap_load_retries_injected_faults(self, tmp_path):
+        from photon_ml_tpu.io.offheap import OffHeapIndexMap, build_offheap_store
+
+        store = str(tmp_path / "store")
+        build_offheap_store(store, ["a\x01", "b\x01", "c\x01"], num_partitions=2)
+        plan = faults.FaultPlan([faults.FaultSpec("io.index_load", at=1)])
+        with faults.fault_scope(plan):
+            m = OffHeapIndexMap(store, force_python=True)
+        assert m.get_index("a\x01") >= 0
+        m.close()
+
+    def test_multihost_barrier_site_retries(self):
+        from photon_ml_tpu.parallel.multihost import MultihostContext
+
+        ctx = MultihostContext(process_id=0, num_processes=1)
+        plan = faults.FaultPlan([faults.FaultSpec("multihost.barrier", at=1)])
+        with faults.fault_scope(plan):
+            ctx.barrier("test-fence")  # retried internally, must not raise
+        assert plan.fire_count("multihost.barrier") == 1
+
+
+# ---------------------------------------------------------------------------
+# coordinate-descent guard integration (mock coordinates — no solver cost)
+# ---------------------------------------------------------------------------
+
+
+class _CountingCoordinate:
+    """Deterministic toy coordinate: params start at 0 and +1 each update."""
+
+    def __init__(self, n):
+        import jax.numpy as jnp
+
+        self.n = n
+        self._jnp = jnp
+
+    def initial_coefficients(self):
+        return self._jnp.zeros(1)
+
+    def update(self, offsets, init, **_):
+        return init + 1.0, None
+
+    def score(self, params):
+        return self._jnp.broadcast_to(params, (self.n,))
+
+    def regularization_term(self, params, *_):
+        return self._jnp.sum(params) * 0.0
+
+
+@pytest.mark.faults
+class TestCoordinateDescentGuard:
+    def _cd(self, mode):
+        import jax.numpy as jnp
+
+        from photon_ml_tpu.algorithm.coordinate_descent import CoordinateDescent
+
+        n = 4
+        coords = {"a": _CountingCoordinate(n), "b": _CountingCoordinate(n)}
+        return (
+            CoordinateDescent(
+                coords,
+                training_loss=lambda s: jnp.sum(s),
+                divergence_guard=DivergenceGuard(mode=mode),
+            ),
+            n,
+        )
+
+    def test_rollback_keeps_descending_other_coordinates(self):
+        cd, n = self._cd("rollback")
+        plan = faults.FaultPlan([faults.FaultSpec("optim.step", at=3, kind="nan")])
+        with faults.fault_scope(plan):
+            result = cd.run(num_iterations=3, num_rows=n)
+        # coordinate a: update at step 3 (iteration 2) rolled back -> 2 not 3
+        assert float(result.coefficients["a"][0]) == 2.0
+        # coordinate b: unaffected, all 3 updates landed
+        assert float(result.coefficients["b"][0]) == 3.0
+        assert [e.action for e in result.guard_events] == ["rollback"]
+        assert len(result.objective_history) == 6  # histories stay aligned
+
+    def test_skip_cycle_abandons_rest_of_iteration(self, tmp_path):
+        from photon_ml_tpu.checkpoint import CoordinateDescentCheckpointer
+
+        cd, n = self._cd("skip_cycle")
+        plan = faults.FaultPlan([faults.FaultSpec("optim.step", at=3, kind="nan")])
+        ckpt = CoordinateDescentCheckpointer(str(tmp_path), "fp")
+        with faults.fault_scope(plan):
+            result = cd.run(num_iterations=3, num_rows=n, checkpointer=ckpt)
+        # step 3 (a, iteration 2) poisoned -> rolled back AND b's step-4
+        # update skipped; both catch up in iteration 3
+        assert float(result.coefficients["a"][0]) == 2.0
+        assert float(result.coefficients["b"][0]) == 2.0
+        assert [e.action for e in result.guard_events] == ["skip_cycle"]
+        # histories and the final checkpoint stay step-aligned
+        assert len(result.objective_history) == 6
+        assert ckpt.latest_step() == 6
+
+    def test_fused_cycle_rollback_keeps_histories_aligned(self):
+        import jax.numpy as jnp
+
+        from photon_ml_tpu.algorithm.coordinate_descent import CoordinateDescent
+
+        n = 4
+
+        class _DivergingCoordinate(_CountingCoordinate):
+            """Counts 0->1->2, then every further update produces NaN —
+            in-graph divergence the fused (compiled) cycle can hit."""
+
+            def update(self, offsets, init, **_):
+                nxt = init + 1.0
+                return jnp.where(init >= 2.0, jnp.nan, nxt), None
+
+        coords = {"a": _DivergingCoordinate(n), "b": _CountingCoordinate(n)}
+        cd = CoordinateDescent(
+            coords,
+            training_loss=lambda s: jnp.sum(s),
+            fused_cycle=True,
+            divergence_guard=DivergenceGuard(),
+        )
+        result = cd.run(num_iterations=4, num_rows=n)
+        # iterations 3 and 4 diverge and roll back WHOLE iterations
+        assert [e.action for e in result.guard_events] == ["rollback", "rollback"]
+        assert all(e.coordinate == "(fused-cycle)" for e in result.guard_events)
+        assert float(result.coefficients["a"][0]) == 2.0
+        assert float(result.coefficients["b"][0]) == 2.0
+        # histories keep one entry per update (the step-aligned contract),
+        # so the driver's objective_history[-1] report never IndexErrors
+        assert len(result.objective_history) == 8
+        assert np.isfinite(result.objective_history).all()

@@ -1,0 +1,221 @@
+"""Chaos tests: GAME training end-to-end under injected faults.
+
+The acceptance bar for the resilience subsystem: with transient I/O
+failures (retryable, rate 0.3), one corrupt Avro block (skip mode), and one
+injected NaN coordinate step, a GAME training run completes and matches the
+clean-run objective after rollback; a killed-then-restarted run resumes
+from the last checkpoint to the same final model. Everything runs in plain
+pytest via the deterministic fault registry (photon_ml_tpu.resilience).
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from photon_ml_tpu.cli import game_training_driver
+from photon_ml_tpu.io import avro as avro_io
+from photon_ml_tpu.resilience import faults
+
+from game_test_utils import make_glmix_data
+from test_game_drivers import COMMON_FLAGS, GAME_EXAMPLE_SCHEMA
+
+NUM_ITERATIONS = 10  # enough cycles that descent reaches its fixed point
+
+
+@pytest.fixture(scope="module")
+def chaos_train_dir(tmp_path_factory):
+    """Two-part-file training dir; part-1 written in small blocks with ONE
+    block corrupted (so skip mode drops a bounded row range, not a file)."""
+    base = tmp_path_factory.mktemp("chaos")
+    rng = np.random.default_rng(20260803)
+    gd, truth = make_glmix_data(
+        rng, num_users=8, rows_per_user_range=(20, 40), d_fixed=4, d_random=3
+    )
+    data = {
+        "y": gd.response,
+        "x_fixed": truth["x_fixed"],
+        "x_random": truth["x_random"],
+        "user_raw": [gd.id_vocabs["userId"][i] for i in gd.ids["userId"]],
+    }
+    n = gd.num_rows
+    split = n // 2
+
+    def records(rows):
+        for r in rows:
+            yield {
+                "uid": str(r),
+                "label": float(data["y"][r]),
+                "fixedFeatures": [
+                    {"name": f"f{j}", "term": "", "value": float(v)}
+                    for j, v in enumerate(data["x_fixed"][r])
+                    if v != 0.0
+                ],
+                "userFeatures": [
+                    {"name": f"u{j}", "term": "", "value": float(v)}
+                    for j, v in enumerate(data["x_random"][r])
+                    if v != 0.0
+                ],
+                "metadataMap": {"userId": data["user_raw"][r]},
+                "weight": None,
+                "offset": None,
+            }
+
+    train_dir = base / "train"
+    train_dir.mkdir()
+    avro_io.write_container(
+        str(train_dir / "part-0.avro"), records(range(split)), GAME_EXAMPLE_SCHEMA
+    )
+    avro_io.write_container(
+        str(train_dir / "part-1.avro"),
+        records(range(split, n)),
+        GAME_EXAMPLE_SCHEMA,
+        block_size=16,
+    )
+
+    # corrupt the middle block of part-1 (deflate payload garbled in place)
+    part1 = str(train_dir / "part-1.avro")
+    raw = open(part1, "rb").read()
+    syncs = []
+    start = 0
+    while True:
+        hit = raw.find(avro_io.DEFAULT_SYNC, start)
+        if hit < 0:
+            break
+        syncs.append(hit)
+        start = hit + 1
+    assert len(syncs) >= 4, "need multiple blocks to corrupt just one"
+    lo, hi = syncs[1] + 16, syncs[2]
+    garbled = bytearray(raw)
+    mid = (lo + hi) // 2
+    for i in range(mid, min(mid + 8, hi)):
+        garbled[i] ^= 0xFF
+    with open(part1, "wb") as f:
+        f.write(bytes(garbled))
+    return str(train_dir), str(base)
+
+
+def _run_driver(train_dir, out_dir, num_iterations, extra=(), plan=None):
+    args = [
+        "--train-input-dirs", train_dir,
+        "--output-dir", out_dir,
+        "--num-iterations", str(num_iterations),
+        "--model-output-mode", "NONE",
+        "--on-corrupt", "skip",
+        "--corrupt-skip-budget", "4",
+        "--io-retries", "8",
+        "--io-retry-base-delay", "0",
+        # single intercept (global only): a per-shard intercept pair is
+        # nearly collinear and makes the alternating descent contract too
+        # slowly to reach its fixed point in a test-sized iteration budget
+        "--feature-shard-id-to-intercept-map", "global:true|per_user:false",
+    ] + COMMON_FLAGS + list(extra)  # extras AFTER so they can override
+    if plan is None:
+        return game_training_driver.main(args)
+    with faults.fault_scope(plan):
+        return game_training_driver.main(args)
+
+
+@pytest.mark.faults
+class TestGameChaos:
+    def test_chaos_run_completes_and_matches_clean_objective(
+        self, chaos_train_dir, tmp_path
+    ):
+        train_dir, _ = chaos_train_dir
+        clean = _run_driver(
+            train_dir, str(tmp_path / "clean"), NUM_ITERATIONS
+        )
+        plan = faults.FaultPlan(
+            [
+                # transient read failures on ~30% of block reads, healed by
+                # the 8-attempt retry policy
+                faults.FaultSpec("io.read_block", rate=0.3, seed=13, times=None),
+                # one poisoned coordinate update (step 3 = the fixed effect's
+                # second solve), rolled back by the divergence guard
+                faults.FaultSpec("optim.step", at=3, kind="nan"),
+            ]
+        )
+        chaos = _run_driver(
+            train_dir,
+            str(tmp_path / "chaos"),
+            NUM_ITERATIONS,
+            extra=("--divergence-guard", "rollback"),
+            plan=plan,
+        )
+        # the injected faults actually fired
+        assert plan.fire_count("io.read_block") > 0
+        assert plan.fire_count("optim.step") == 1
+        events = chaos.results[0][1].guard_events
+        assert len(events) == 1 and events[0].action == "rollback"
+        assert events[0].step == 3
+
+        # training data identical (same skipped block), rollback re-converges:
+        # final objectives agree to well under 1e-6 relative
+        obj_clean = clean.results[0][1].objective_history[-1]
+        obj_chaos = chaos.results[0][1].objective_history[-1]
+        assert np.isfinite(obj_chaos)
+        assert abs(obj_chaos - obj_clean) <= 1e-6 * max(1.0, abs(obj_clean))
+
+        # and the final models agree coordinate-by-coordinate (loose bound:
+        # near the optimum the objective is flat, so f32 solves stall at
+        # slightly different coefficient vectors of equal objective)
+        for name, w in clean.results[0][1].coefficients.items():
+            np.testing.assert_allclose(
+                np.asarray(chaos.results[0][1].coefficients[name]),
+                np.asarray(w),
+                atol=0.01,
+            )
+
+    def test_killed_then_restarted_resumes_to_same_model(
+        self, chaos_train_dir, tmp_path
+    ):
+        train_dir, _ = chaos_train_dir
+
+        def io_plan():
+            # fresh counters per run: transient faults on block reads AND
+            # checkpoint writes, all healed by retry
+            return faults.FaultPlan(
+                [
+                    faults.FaultSpec("io.read_block", rate=0.3, seed=5, times=None),
+                    faults.FaultSpec("io.checkpoint_write", rate=0.3, seed=6, times=None),
+                ]
+            )
+
+        straight = _run_driver(
+            train_dir,
+            str(tmp_path / "straight"),
+            4,
+            extra=("--checkpoint-dir", str(tmp_path / "ckpt-a")),
+            plan=io_plan(),
+        )
+        # "kill" after 2 of 4 iterations...
+        _run_driver(
+            train_dir,
+            str(tmp_path / "killed"),
+            2,
+            extra=("--checkpoint-dir", str(tmp_path / "ckpt-b")),
+            plan=io_plan(),
+        )
+        # ...leave crash debris next to the checkpoint...
+        debris = tmp_path / "ckpt-b" / "combo-0" / ".ckpt-crashed"
+        debris.mkdir()
+        (debris / "arrays.npz").write_bytes(b"\x00" * 32)
+        # ...and restart for the full 4 iterations: resumes from step 4
+        resumed = _run_driver(
+            train_dir,
+            str(tmp_path / "resumed"),
+            4,
+            extra=("--checkpoint-dir", str(tmp_path / "ckpt-b")),
+            plan=io_plan(),
+        )
+        r_straight = straight.results[0][1]
+        r_resumed = resumed.results[0][1]
+        assert r_resumed.objective_history == pytest.approx(
+            r_straight.objective_history, rel=1e-6
+        )
+        for name, w in r_straight.coefficients.items():
+            np.testing.assert_allclose(
+                np.asarray(r_resumed.coefficients[name]),
+                np.asarray(w),
+                rtol=1e-6, atol=1e-7,
+            )
