@@ -250,21 +250,36 @@ class CoordinateDescent:
         cycle_v = self._grid_cycle_fn
 
         dt = real_dtype()
+        # every combo starts from the SAME seeded state — build it once, not
+        # once per combo (a G-combo grid would otherwise pay G-1 redundant
+        # full-data score passes per coordinate)
+        params0 = {
+            n: jnp.broadcast_to(
+                (w0 := (
+                    init_params[n]
+                    if init_params is not None
+                    else self.coordinates[n].initial_coefficients()
+                )), (1,) + w0.shape
+            )
+            for n in names
+        }
+        scores0 = {n: jnp.zeros((1, num_rows), dt) for n in names}
+        total0 = jnp.zeros((1, num_rows), dt)
+        if init_params is not None:
+            # mirror run(initial_params=...): a warm-started coordinate
+            # contributes its CURRENT scores from step zero, broadcast
+            # to the lane axis — otherwise the first grid cycle trains
+            # every combo against zero offsets, defeating the warm start
+            for n in names:
+                s0 = self.coordinates[n].score(jnp.asarray(init_params[n], dt))
+                scores0[n] = jnp.broadcast_to(s0, (1, num_rows)).astype(dt)
+                total0 = total0 + scores0[n]
         out = []
         for i in range(g):
             lam_i = {n: lam[n][i : i + 1] for n in names}
-            params = {
-                n: jnp.broadcast_to(
-                    (w0 := (
-                        init_params[n]
-                        if init_params is not None
-                        else self.coordinates[n].initial_coefficients()
-                    )), (1,) + w0.shape
-                )
-                for n in names
-            }
-            scores = {n: jnp.zeros((1, num_rows), dt) for n in names}
-            total = jnp.zeros((1, num_rows), dt)
+            params = dict(params0)
+            scores = dict(scores0)
+            total = total0
 
             t0 = time.perf_counter()
             objective_dev: List[Array] = []

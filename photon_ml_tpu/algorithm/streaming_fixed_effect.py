@@ -17,7 +17,7 @@ regularization_term); cd_jit=False — the orchestrator must call it raw
 from __future__ import annotations
 
 import dataclasses
-from typing import Tuple
+from typing import Optional, Tuple
 
 import numpy as np
 
@@ -48,6 +48,12 @@ class StreamingFixedEffectCoordinate:
     norm: NormalizationContext = dataclasses.field(
         default_factory=NormalizationContext.identity
     )
+    # async pipeline depth (io/pipeline.py): chunks read + page-faulted on a
+    # background thread while the previous chunk's kernel runs, next chunk's
+    # H2D double-buffered. <= 0 = synchronous; None = PHOTON_PREFETCH_DEPTH
+    # (default 2). Exact either way — chunk order and the additive
+    # accumulation are unchanged.
+    prefetch_depth: Optional[int] = None
 
     # streams per evaluation: CoordinateDescent must not wrap update/score
     # in an outer jit (same contract as the multihost coordinates)
@@ -75,14 +81,14 @@ class StreamingFixedEffectCoordinate:
         self._l1, self._l2 = float(l1), float(l2)
         self._vg = make_streaming_value_and_grad(
             self._live_source, self.problem.objective, self.norm,
-            l2_weight=self._l2,
+            l2_weight=self._l2, prefetch_depth=self.prefetch_depth,
         )
         # TRON streams one extra pass per CG Hessian-vector product (the
         # reference's one-treeAggregate-per-CG-step cost, TRON.scala:268-281)
         self._hvp = (
             make_streaming_hvp(
                 self._live_source, self.problem.objective, self.norm,
-                l2_weight=self._l2,
+                l2_weight=self._l2, prefetch_depth=self.prefetch_depth,
             )
             if self.problem.optimizer == OptimizerType.TRON else None
         )
@@ -140,12 +146,15 @@ class StreamingFixedEffectCoordinate:
         return res.coefficients, res
 
     def score(self, coefficients: Array) -> Array:
-        """(N,) raw margins, streamed chunk by chunk (no offsets — GAME
-        scores are additive margin contributions, FixedEffectModel.scala:
-        91-100)."""
+        """(N,) raw margins, streamed chunk by chunk through the prefetch +
+        double-buffered H2D pipeline (no offsets — GAME scores are additive
+        margin contributions, FixedEffectModel.scala:91-100)."""
+        from photon_ml_tpu.optim.streaming import pipelined_device_chunks
+
         outs = []
-        for chunk in self.source.chunks():
-            x = jnp.asarray(chunk["x"], real_dtype())
+        for x, _, _, _ in pipelined_device_chunks(
+            self.source, real_dtype(), self.prefetch_depth
+        ):
             outs.append(self._margin_fn(coefficients, x))
         return jnp.concatenate(outs) if outs else jnp.zeros((0,), real_dtype())
 

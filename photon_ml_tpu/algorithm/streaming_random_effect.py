@@ -30,7 +30,7 @@ from __future__ import annotations
 import dataclasses
 import json
 import os
-from typing import Dict, List, Optional, Tuple
+from typing import Dict, Iterator, List, Optional, Tuple
 
 import numpy as np
 
@@ -68,6 +68,8 @@ def write_re_entity_blocks(
     out_dir: str,
     block_entities: Optional[int] = None,
     memory_budget_bytes: Optional[int] = None,
+    tensor_cache=None,
+    cache_key: Optional[str] = None,
 ) -> "StreamingREManifest":
     """Split the random-effect dataset into entity blocks on disk.
 
@@ -78,7 +80,36 @@ def write_re_entity_blocks(
     projection — RandomEffectDataSet.scala:171-357 semantics) over only its
     entities' rows, then written and released — the full stack never
     exists anywhere.
+
+    With a ``tensor_cache`` (:class:`photon_ml_tpu.io.tensor_cache.
+    TensorCache`) and ``cache_key`` (content address of the SOURCE inputs +
+    ingest config, computed by the caller who knows the source files), the
+    block directory is built once under the cache and later calls with the
+    same key return the committed manifest without re-grouping or
+    re-padding anything — ``out_dir`` is ignored on a hit. A cache-write
+    failure that survives retries degrades to the plain uncached build.
+    :class:`StreamingRandomEffectCoordinate` detects a cache-resident
+    manifest and spills its default run state to a private temp dir
+    instead of the shared entry (pass ``state_root`` to control it).
     """
+    if tensor_cache is not None and cache_key is not None:
+        hit = tensor_cache.get_dir(cache_key)
+        if hit is not None:
+            return StreamingREManifest.load(hit)
+        from photon_ml_tpu.resilience import RetryError
+
+        try:
+            entry = tensor_cache.build_dir(
+                cache_key,
+                lambda tmp: write_re_entity_blocks(
+                    data, config, tmp,
+                    block_entities=block_entities,
+                    memory_budget_bytes=memory_budget_bytes,
+                ),
+            )
+            return StreamingREManifest.load(entry)
+        except RetryError:
+            pass  # cache unusable: fall through to the plain build
     if config.projector == "RANDOM":
         raise ValueError(
             "streaming random effects support INDEX_MAP/IDENTITY projectors "
@@ -208,16 +239,67 @@ class StreamingREManifest:
     def max_block_bytes(self) -> int:
         return max(b["x_bytes"] for b in self.blocks)
 
-    def load_block(self, i: int) -> Tuple[RandomEffectDataset, np.ndarray, np.ndarray]:
-        """(dataset, row_sel, dense_ids) for block i; arrays mmap-backed
-        until device_put faults them in page by page."""
+    def load_block_host(self, i: int) -> dict:
+        """Block i's arrays faulted onto the HOST (numpy, no device
+        placement) — the disk stage of the prefetch pipeline. ``np.asarray``
+        here (not a lazy mmap handle) so the page-cache faulting happens on
+        the prefetch thread, not in the consumer's timed solve window."""
         z = np.load(os.path.join(self.dir, self.blocks[i]["file"]), mmap_mode="r")
+        out = {f: np.asarray(z[f]) for f in _DATASET_FIELDS}
+        out["row_sel"] = np.asarray(z["row_sel"])
+        out["dense_ids"] = np.asarray(z["dense_ids"])
+        out["_index"] = i
+        return out
+
+    def _block_from_host(
+        self, host: dict
+    ) -> Tuple[RandomEffectDataset, np.ndarray, np.ndarray]:
+        i = host["_index"]
         ds = RandomEffectDataset(
-            **{f: jnp.asarray(z[f]) for f in _DATASET_FIELDS},
+            **{f: jnp.asarray(host[f]) for f in _DATASET_FIELDS},
             num_entities=self.blocks[i]["num_entities"],
             global_dim=self.global_dim,
         )
-        return ds, np.asarray(z["row_sel"]), np.asarray(z["dense_ids"])
+        return ds, host["row_sel"], host["dense_ids"]
+
+    def load_block(self, i: int) -> Tuple[RandomEffectDataset, np.ndarray, np.ndarray]:
+        """(dataset, row_sel, dense_ids) for block i (synchronous)."""
+        return self._block_from_host(self.load_block_host(i))
+
+    def iter_blocks(
+        self, prefetch_depth: Optional[int] = None
+    ) -> "Iterator[Tuple[int, RandomEffectDataset, np.ndarray, np.ndarray]]":
+        """Yield ``(i, dataset, row_sel, dense_ids)`` for every block with
+        the async pipeline (io/pipeline.py): up to ``prefetch_depth`` blocks
+        are read + page-faulted on a background thread while earlier blocks
+        solve, and the NEXT block's host->device transfer (``jnp.asarray``,
+        an async dispatch) is issued while the CURRENT block is consumed —
+        double-buffered H2D. Depth <= 0 is the synchronous loop; block order
+        and arithmetic are identical either way, so results are
+        bit-identical with the pipeline on or off."""
+        from photon_ml_tpu.io.pipeline import (
+            Prefetcher,
+            device_pipelined,
+            resolve_depth,
+        )
+
+        depth = resolve_depth(prefetch_depth)
+        n = len(self.blocks)
+        if depth <= 0:
+            for i in range(n):
+                ds, row_sel, dense_ids = self.load_block(i)
+                yield i, ds, row_sel, dense_ids
+            return
+        host_blocks = Prefetcher(
+            lambda: (self.load_block_host(i) for i in range(n)),
+            depth=depth,
+            name="re-block-prefetch",
+        )
+
+        def place(host):
+            return (host["_index"],) + self._block_from_host(host)
+
+        yield from device_pipelined(host_blocks, place, depth=1)
 
     def load_block_meta(self, i: int) -> "BlockMeta":
         """Metadata-only view of block i: the per-entity bookkeeping arrays
@@ -294,6 +376,12 @@ class StreamingRandomEffectCoordinate:
         default_factory=RegularizationContext.none
     )
     state_root: Optional[str] = None  # default: <manifest.dir>/state
+    # async pipeline depth (io/pipeline.py): how many blocks the background
+    # thread reads ahead of the solve, with the next block's H2D transfer
+    # double-buffered against the current solve. <= 0 = synchronous; None =
+    # PHOTON_PREFETCH_DEPTH (default 2). Results are bit-identical either
+    # way (tests/test_pipeline.py) — this only moves I/O off the solve path.
+    prefetch_depth: Optional[int] = None
 
     # streams per evaluation — CoordinateDescent must call update/score raw
     cd_jit = False
@@ -307,8 +395,18 @@ class StreamingRandomEffectCoordinate:
             # selection saves after all combos ran)
             global _instance_seq
             _instance_seq += 1
+            base = self.manifest.dir
+            if os.path.exists(os.path.join(base, "meta.json")):
+                # the manifest lives in a shared tensor-cache entry (only
+                # cache commits carry meta.json next to manifest.json):
+                # spilling run state there would grow the immutable entry
+                # without bound and race concurrent runs — redirect the
+                # default to a private temp dir instead
+                import tempfile
+
+                base = tempfile.mkdtemp(prefix="photon-re-state-")
             self.state_root = os.path.join(
-                self.manifest.dir, f"state-{os.getpid()}-{_instance_seq}"
+                base, f"state-{os.getpid()}-{_instance_seq}"
             )
         self._epoch = 0
         self._shapes = [
@@ -358,8 +456,9 @@ class StreamingRandomEffectCoordinate:
         )
         resid_host = None
         summaries = []
-        for i in range(len(self.manifest.blocks)):
-            ds, row_sel, _ = self.manifest.load_block(i)
+        # pipelined block loop: block k+1 reads from disk + transfers H2D
+        # on the background stage while block k's vmapped solve runs
+        for i, ds, row_sel, _ in self.manifest.iter_blocks(self.prefetch_depth):
             if isinstance(residual_offsets, jax.Array):
                 local_resid = residual_offsets[jnp.asarray(row_sel)]
             else:
@@ -377,8 +476,7 @@ class StreamingRandomEffectCoordinate:
 
     def score(self, state: SpilledREState) -> Array:
         total = np.zeros(self.manifest.num_rows, real_dtype())
-        for i in range(len(self.manifest.blocks)):
-            ds, row_sel, _ = self.manifest.load_block(i)
+        for i, ds, row_sel, _ in self.manifest.iter_blocks(self.prefetch_depth):
             w = jnp.asarray(state.block(i))
             total[row_sel] = np.asarray(self._sub_for(ds).score(w))
             del ds, w
@@ -438,13 +536,21 @@ class StreamingRandomEffectCoordinate:
             {} if residual_offsets is not None else None
         )
         vocab = self.manifest.vocab
+        # the variance branch streams the data slabs (Hessian diagonals need
+        # the samples) — pipeline them like update/score; the means-only
+        # export stays metadata-only and loads no slab at all
+        slabs = (
+            self.manifest.iter_blocks(self.prefetch_depth)
+            if residual_offsets is not None
+            else iter(())
+        )
         for i in range(len(self.manifest.blocks)):
             m = self.manifest.load_block_meta(i)
             w = jnp.asarray(state.block(i))
             mean_stack = np.asarray(global_coefficients(m, w))
             var_stack = None
             if residual_offsets is not None:
-                ds, row_sel, _ = self.manifest.load_block(i)
+                _, ds, row_sel, _ = next(slabs)
                 sub = self._sub_for(ds)
                 local_resid = jnp.asarray(
                     np.asarray(residual_offsets)[row_sel]

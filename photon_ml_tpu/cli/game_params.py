@@ -310,6 +310,12 @@ class GameTrainingParams:
     # the resident block slab (reference DISK_ONLY analogue)
     streaming_random_effects: bool = False
     re_memory_budget_mb: Optional[float] = None
+    # content-addressed tensor cache (io/tensor_cache.py): built ingest
+    # tensors (decoded GAME columns, padded RE stacks, streaming entity
+    # blocks) are stored keyed by SHA-256 of source file stats + ingest
+    # config, so a re-run / warm-started grid over unchanged inputs skips
+    # Avro decode + grouping + padding entirely
+    tensor_cache_dir: Optional[str] = None
     # non-"false": train the lambda grid through the traced-lambda grid API
     # (CoordinateDescent.run_grid — ONE compiled cycle serves every combo;
     # the batched G-lane vmapped variant this flag once selected lost every
@@ -478,6 +484,11 @@ def build_training_parser() -> argparse.ArgumentParser:
     a("--re-memory-budget-mb", default=None,
       help="cap the resident random-effect block slab (MB); implies "
            "--streaming-random-effects")
+    a("--tensor-cache", dest="tensor_cache_dir", default=None,
+      help="content-addressed on-disk cache of built ingest tensors "
+           "(keyed by source file stats + ingest config): warm runs skip "
+           "Avro decode + grouping + padding; any input/config change is "
+           "a miss")
     a("--vmapped-grid", default="false",
       help="train the lambda grid through the shared-compile grid API (ONE "
            "compiled cycle serves every combo; lambda-only grids on plain "
@@ -549,6 +560,7 @@ def parse_training_params(argv: Optional[List[str]] = None) -> GameTrainingParam
             float(ns.re_memory_budget_mb)
             if ns.re_memory_budget_mb is not None else None
         ),
+        tensor_cache_dir=ns.tensor_cache_dir,
         vmapped_grid=(
             "auto" if str(ns.vmapped_grid).lower() == "auto"
             else "true" if _truthy(ns.vmapped_grid) else "false"

@@ -14,6 +14,7 @@ parity).
 
 from __future__ import annotations
 
+import dataclasses
 import os
 from typing import Dict, List, Optional, Tuple
 
@@ -227,15 +228,76 @@ class GameTrainingDriver:
         ids |= {id_name for _, _, id_name in self.params.evaluators if id_name}
         return sorted(ids)
 
-    def prepare_datasets(self) -> None:
+    def _next_stream_state_seq(self) -> int:
+        self._stream_state_seq = getattr(self, "_stream_state_seq", 0) + 1
+        return self._stream_state_seq
+
+    def _tensor_cache(self):
+        """The --tensor-cache store (lazy), or None."""
+        if not self.params.tensor_cache_dir:
+            return None
+        if not hasattr(self, "_tensor_cache_obj"):
+            from photon_ml_tpu.io.tensor_cache import TensorCache
+
+            self._tensor_cache_obj = TensorCache(self.params.tensor_cache_dir)
+        return self._tensor_cache_obj
+
+    def _ingest_cache_config(self) -> Dict[str, object]:
+        """The ingest-config part of every tensor-cache key: anything that
+        changes the decoded columns or the feature index assignment must
+        change the key (a config change is a MISS, never a stale hit)."""
+        from photon_ml_tpu.io.tensor_cache import index_map_digest
+
         p = self.params
-        self.train_data = avro_data.read_game_data(
-            _input_files(self._train_dirs()),
-            self.shard_index_maps,
-            p.feature_shard_sections,
-            self._id_types(),
-            shard_intercepts=p.feature_shard_intercepts or None,
+        return {
+            "sections": p.feature_shard_sections,
+            "intercepts": p.feature_shard_intercepts,
+            "id_types": self._id_types(),
+            "index_maps": {
+                shard: index_map_digest(imap)
+                for shard, imap in sorted(self.shard_index_maps.items())
+            },
+        }
+
+    def prepare_datasets(self) -> None:
+        from photon_ml_tpu.data.game import (
+            game_data_from_arrays,
+            game_data_to_arrays,
         )
+
+        p = self.params
+        cache = self._tensor_cache()
+        train_files = _input_files(self._train_dirs())
+        train_key = (
+            cache.key_for(
+                train_files, {"kind": "game_data", **self._ingest_cache_config()}
+            )
+            if cache is not None
+            else None
+        )
+        hit = cache.get(train_key) if cache is not None else None
+        if hit is not None:
+            self.train_data = game_data_from_arrays(hit.arrays, hit.meta)
+            self.logger.info(
+                f"tensor cache HIT {train_key[:12]}: Avro decode skipped"
+            )
+        else:
+            self.train_data = avro_data.read_game_data(
+                train_files,
+                self.shard_index_maps,
+                p.feature_shard_sections,
+                self._id_types(),
+                shard_intercepts=p.feature_shard_intercepts or None,
+            )
+            if cache is not None:
+                from photon_ml_tpu.resilience import RetryError
+
+                try:
+                    arrays, meta = game_data_to_arrays(self.train_data)
+                    cache.put(train_key, arrays, meta)
+                    self.logger.info(f"tensor cache stored {train_key[:12]}")
+                except RetryError as e:
+                    self.logger.info(f"tensor cache write failed (uncached): {e}")
         self.logger.info(f"training rows: {self.train_data.num_rows}")
         if p.validate_input_dirs:
             self.validation_data = avro_data.read_game_data(
@@ -278,6 +340,17 @@ class GameTrainingDriver:
                     # budget must not silently pass BOTH sizing modes
                     block_entities=None if budget is not None else 1024,
                     memory_budget_bytes=budget,
+                    tensor_cache=cache,
+                    cache_key=(
+                        cache.key_for(
+                            train_files,
+                            {"kind": "streaming_re_blocks", "coord": name,
+                             "config": dataclasses.asdict(cfg),
+                             "budget": budget,
+                             **self._ingest_cache_config()},
+                        )
+                        if cache is not None else None
+                    ),
                 )
                 self.logger.info(
                     f"streaming RE {name}: "
@@ -299,7 +372,19 @@ class GameTrainingDriver:
                     self.train_data, cfg
                 )
                 continue
-            self.re_datasets[name] = build_random_effect_dataset(self.train_data, cfg)
+            self.re_datasets[name] = build_random_effect_dataset(
+                self.train_data, cfg,
+                tensor_cache=cache,
+                cache_key=(
+                    cache.key_for(
+                        train_files,
+                        {"kind": "re_dataset", "coord": name,
+                         "config": dataclasses.asdict(cfg),
+                         **self._ingest_cache_config()},
+                    )
+                    if cache is not None else None
+                ),
+            )
 
     # ------------------------------------------------------------------
     def _mesh_context(self):
@@ -381,6 +466,15 @@ class GameTrainingDriver:
                     optimizer=cfg.optimizer,
                     optimizer_config=cfg.optimizer_config(),
                     regularization=cfg.regularization_context(),
+                    # spilled state goes under OUR output dir, never inside
+                    # the manifest dir (a --tensor-cache hit points that at
+                    # the shared cache entry, which must stay run-agnostic);
+                    # unique per coordinate INSTANCE like the coordinate's
+                    # own default (grid combos must not share spill dirs)
+                    state_root=os.path.join(
+                        p.output_dir, "streaming-re-state",
+                        f"{name}-{os.getpid()}-{self._next_stream_state_seq()}",
+                    ),
                 )
             elif p.bucketed_random_effects:
                 from photon_ml_tpu.algorithm.bucketed_random_effect import (

@@ -276,78 +276,116 @@ class Driver:
                 "wide-sparse regime streams through the in-memory sparse "
                 "layout instead (sparse chunk spilling is not implemented)."
             )
-        chunk_dir = os.path.join(p.output_dir, "stream-chunks")
-        # stale chunks from an aborted prior run must never be trained on —
-        # and a FAILED purge must be loud, not a silent mixed-data model
-        import shutil
+        def _spill_chunks(chunk_dir: str) -> None:
+            """Decode file by file and spill re-chunked rows into
+            ``chunk_dir`` (rows carried across file boundaries so every
+            chunk but the final tail shares one shape -> one executable)."""
+            chunk_i = 0
+            total_rows = 0
+            buf: List[dict] = []
+            buf_rows = 0
 
-        if os.path.exists(chunk_dir):
-            shutil.rmtree(chunk_dir)  # raises loudly if the purge fails
-        os.makedirs(chunk_dir)
-        chunk_i = 0
-        total_rows = 0
-        # carry rows across file boundaries so every chunk except the final
-        # tail has EXACTLY chunk_rows rows -> one jitted executable
-        buf: List[dict] = []
-        buf_rows = 0
+            def _flush(final=False):
+                nonlocal chunk_i, buf, buf_rows
+                while buf_rows >= p.streaming_chunk_rows or (final and buf_rows > 0):
+                    take = min(buf_rows, p.streaming_chunk_rows)
+                    parts: List[dict] = []
+                    got = 0
+                    while got < take:
+                        head = buf[0]
+                        n_h = len(head["y"])
+                        if got + n_h <= take:
+                            parts.append(buf.pop(0))
+                            got += n_h
+                        else:
+                            split = take - got
+                            parts.append({k: v[:split] for k, v in head.items()})
+                            buf[0] = {k: v[split:] for k, v in head.items()}
+                            got = take
+                    payload = {
+                        k: np.concatenate([q[k] for q in parts])
+                        for k in parts[0]
+                    }
+                    from photon_ml_tpu.optim.streaming import write_chunk
 
-        def _flush(final=False):
-            nonlocal chunk_i, buf, buf_rows
-            while buf_rows >= p.streaming_chunk_rows or (final and buf_rows > 0):
-                take = min(buf_rows, p.streaming_chunk_rows)
-                parts: List[dict] = []
-                got = 0
-                while got < take:
-                    head = buf[0]
-                    n_h = len(head["y"])
-                    if got + n_h <= take:
-                        parts.append(buf.pop(0))
-                        got += n_h
-                    else:
-                        split = take - got
-                        parts.append({k: v[:split] for k, v in head.items()})
-                        buf[0] = {k: v[split:] for k, v in head.items()}
-                        got = take
-                payload = {
-                    k: np.concatenate([q[k] for q in parts])
-                    for k in parts[0]
+                    write_chunk(chunk_dir, chunk_i, payload)
+                    chunk_i += 1
+                    buf_rows -= take
+
+            for path in paths:
+                ds = file_ds.pop(path, None) or read_file(path)
+                batch = to_batch(ds, dense=True)
+                sanity_check_data(batch, p.task_type, p.data_validation_type)
+                # uniform keys across files (a file without offsets/weights
+                # must still concatenate with one that has them)
+                piece = {
+                    "x": np.asarray(batch.features.matrix)[: ds.num_rows],
+                    "y": np.asarray(ds.labels),
+                    "offsets": (
+                        np.asarray(ds.offsets)
+                        if ds.offsets is not None
+                        else np.zeros(ds.num_rows, np.float32)
+                    ),
+                    "weights": (
+                        np.asarray(ds.weights)
+                        if ds.weights is not None
+                        else np.ones(ds.num_rows, np.float32)
+                    ),
                 }
-                from photon_ml_tpu.optim.streaming import write_chunk
+                buf.append(piece)
+                buf_rows += ds.num_rows
+                total_rows += ds.num_rows
+                _flush()
+            _flush(final=True)
+            self.logger.info(
+                f"streaming mode: {total_rows} rows x {dim} features spilled "
+                f"to {chunk_i} chunks of {p.streaming_chunk_rows} rows (+ tail)"
+            )
 
-                write_chunk(chunk_dir, chunk_i, payload)
-                chunk_i += 1
-                buf_rows -= take
+        source_dir = None
+        if p.tensor_cache_dir:
+            # content-addressed chunk reuse: a warm run over unchanged
+            # inputs + config mmaps the committed chunks, skipping decode +
+            # sanity pass + spill entirely
+            from photon_ml_tpu.io.tensor_cache import (
+                TensorCache,
+                index_map_digest,
+            )
+            from photon_ml_tpu.resilience import RetryError
 
-        for path in paths:
-            ds = file_ds.pop(path, None) or read_file(path)
-            batch = to_batch(ds, dense=True)
-            sanity_check_data(batch, p.task_type, p.data_validation_type)
-            # uniform keys across files (a file without offsets/weights must
-            # still concatenate with one that has them)
-            piece = {
-                "x": np.asarray(batch.features.matrix)[: ds.num_rows],
-                "y": np.asarray(ds.labels),
-                "offsets": (
-                    np.asarray(ds.offsets)
-                    if ds.offsets is not None
-                    else np.zeros(ds.num_rows, np.float32)
-                ),
-                "weights": (
-                    np.asarray(ds.weights)
-                    if ds.weights is not None
-                    else np.ones(ds.num_rows, np.float32)
-                ),
-            }
-            buf.append(piece)
-            buf_rows += ds.num_rows
-            total_rows += ds.num_rows
-            _flush()
-        _flush(final=True)
-        self.streaming_source = ChunkedGLMSource.from_chunk_dir(chunk_dir)
-        self.logger.info(
-            f"streaming mode: {total_rows} rows x {dim} features spilled to "
-            f"{chunk_i} chunks of {p.streaming_chunk_rows} rows (+ tail)"
-        )
+            cache = TensorCache(p.tensor_cache_dir)
+            cache_key = cache.key_for(
+                paths,
+                {"kind": "glm_stream_chunks",
+                 "chunk_rows": p.streaming_chunk_rows,
+                 "format": p.input_file_format,
+                 "fields": p.field_names_type,
+                 "intercept": p.add_intercept,
+                 "index_map": index_map_digest(self.index_map)},
+            )
+            source_dir = cache.get_dir(cache_key)
+            if source_dir is not None:
+                self.logger.info(
+                    f"tensor cache HIT {cache_key[:12]}: decode + spill skipped"
+                )
+            else:
+                try:
+                    source_dir = cache.build_dir(cache_key, _spill_chunks)
+                    self.logger.info(f"tensor cache stored {cache_key[:12]}")
+                except RetryError as e:
+                    self.logger.info(f"tensor cache unusable (uncached): {e}")
+                    source_dir = None
+        if source_dir is None:
+            source_dir = os.path.join(p.output_dir, "stream-chunks")
+            # stale chunks from an aborted prior run must never be trained
+            # on — and a FAILED purge must be loud, not a silent mixed model
+            import shutil
+
+            if os.path.exists(source_dir):
+                shutil.rmtree(source_dir)  # raises loudly if the purge fails
+            os.makedirs(source_dir)
+            _spill_chunks(source_dir)
+        self.streaming_source = ChunkedGLMSource.from_chunk_dir(source_dir)
 
         needs_summary = (
             p.normalization_type != NormalizationType.NONE

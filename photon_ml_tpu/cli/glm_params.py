@@ -72,6 +72,9 @@ class GLMParams:
     # size and stream them through the optimizer (optim/streaming.py — the
     # StorageLevel.scala:22-24 DISK_ONLY answer); 0 = in-memory (default)
     streaming_chunk_rows: int = 0
+    # content-addressed cache of the spilled stream chunks (io/tensor_cache):
+    # a warm run over unchanged inputs skips decode + re-spill entirely
+    tensor_cache_dir: Optional[str] = None
     # obsolete on TPU (treeAggregate depth, kryo, min partitions) — accepted
     # for CLI compatibility, ignored with a note
     tree_aggregate_depth: int = 1
@@ -184,6 +187,10 @@ def build_parser() -> argparse.ArgumentParser:
     a("--streaming-chunk-rows", dest="streaming_chunk_rows", type=int, default=0,
       help="spill the training batch to row chunks of this size and stream "
            "them through the optimizer (out-of-core; 0 = in-memory)")
+    a("--tensor-cache", dest="tensor_cache_dir", default=None,
+      help="content-addressed on-disk cache of the spilled stream chunks "
+           "(keyed by source file stats + ingest config): a warm "
+           "--streaming-chunk-rows run skips decode + re-spill")
     return p
 
 
@@ -218,6 +225,7 @@ def parse_from_command_line(argv: Optional[List[str]] = None) -> GLMParams:
         feature_dimension=ns.feature_dimension,
         compute_variance=ns.compute_variance,
         streaming_chunk_rows=ns.streaming_chunk_rows,
+        tensor_cache_dir=ns.tensor_cache_dir,
         use_kryo=ns.use_kryo,
         min_num_partitions=ns.min_num_partitions,
         tree_aggregate_depth=ns.tree_aggregate_depth,

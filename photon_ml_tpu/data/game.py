@@ -85,6 +85,50 @@ class GameData:
         return len(self.response)
 
 
+def game_data_to_arrays(data: GameData):
+    """Flatten a GameData into (named arrays, JSON-safe meta) for the
+    content-addressed tensor cache (io/tensor_cache.py): a warm run
+    reconstructs the decoded columnar dataset without touching Avro."""
+    arrays = {
+        "response": data.response,
+        "offset": data.offset,
+        "weight": data.weight,
+    }
+    for k, v in data.ids.items():
+        arrays[f"ids~{k}"] = v
+    for k, f in data.shards.items():
+        arrays[f"shard~{k}~indptr"] = f.indptr
+        arrays[f"shard~{k}~indices"] = f.indices
+        arrays[f"shard~{k}~values"] = f.values
+    meta = {
+        "id_types": sorted(data.ids),
+        "shards": {k: int(f.dim) for k, f in data.shards.items()},
+        "id_vocabs": {k: list(v) for k, v in data.id_vocabs.items()},
+    }
+    return arrays, meta
+
+
+def game_data_from_arrays(arrays, meta) -> GameData:
+    """Inverse of :func:`game_data_to_arrays` over a cache hit (arrays are
+    mmap-backed; nothing is decoded)."""
+    return GameData(
+        response=np.asarray(arrays["response"]),
+        offset=np.asarray(arrays["offset"]),
+        weight=np.asarray(arrays["weight"]),
+        ids={k: np.asarray(arrays[f"ids~{k}"]) for k in meta["id_types"]},
+        id_vocabs={k: list(v) for k, v in meta["id_vocabs"].items()},
+        shards={
+            k: HostFeatures(
+                indptr=np.asarray(arrays[f"shard~{k}~indptr"]),
+                indices=np.asarray(arrays[f"shard~{k}~indices"]),
+                values=np.asarray(arrays[f"shard~{k}~values"]),
+                dim=int(dim),
+            )
+            for k, dim in meta["shards"].items()
+        },
+    )
+
+
 # ---------------------------------------------------------------------------
 # balanced entity ordering (RandomEffectIdPartitioner analogue)
 # ---------------------------------------------------------------------------
@@ -257,15 +301,77 @@ class RandomEffectDataset:
         return cls(*children[:9], aux[0], aux[1], children[9])
 
 
+_RE_TENSOR_FIELDS = (
+    "row_index", "x", "labels", "base_offsets", "weights",
+    "entity_pos", "feat_idx", "feat_val", "local_to_global",
+)
+
+
+def _re_dataset_from_cache(entry) -> RandomEffectDataset:
+    """Rebuild a RandomEffectDataset from a tensor-cache hit. The cached
+    arrays are mmap-backed ``.npy`` slabs; ``jnp.asarray`` faults them in
+    page by page on device placement — grouping/projection/padding are all
+    skipped."""
+    return RandomEffectDataset(
+        **{f: jnp.asarray(entry.arrays[f]) for f in _RE_TENSOR_FIELDS},
+        num_entities=int(entry.meta["num_entities"]),
+        global_dim=int(entry.meta["global_dim"]),
+        projection_matrix=(
+            jnp.asarray(entry.arrays["projection_matrix"])
+            if "projection_matrix" in entry.arrays
+            else None
+        ),
+    )
+
+
 def build_random_effect_dataset(
-    data: GameData, config: RandomEffectDataConfig, projector=None
+    data: GameData,
+    config: RandomEffectDataConfig,
+    projector=None,
+    tensor_cache=None,
+    cache_key: Optional[str] = None,
 ) -> RandomEffectDataset:
     """Host-side build: group, cap, project, pad, ship to device.
 
     ``projector`` (a ProjectionMatrixProjector) is only consulted when
     ``config.projector == "RANDOM"``; omitted, one is built from
     ``config.random_projection_dim`` and ``config.seed``.
+
+    With a ``tensor_cache`` (:class:`photon_ml_tpu.io.tensor_cache.
+    TensorCache`) and ``cache_key`` (the content address of the SOURCE
+    inputs + this config, computed by the caller who knows the source
+    files), the BUILT padded entity-major tensors are stored as mmap'd
+    ``.npy`` slabs and a later call with the same key skips grouping +
+    projection + padding entirely. Any config or input change produces a
+    different key — a miss — so stale tensors are never served. A
+    cache-write failure degrades to the uncached build.
     """
+    if tensor_cache is not None and cache_key is not None:
+        hit = tensor_cache.get(cache_key)
+        if hit is not None:
+            return _re_dataset_from_cache(hit)
+    ds = _build_random_effect_dataset(data, config, projector)
+    if tensor_cache is not None and cache_key is not None:
+        from photon_ml_tpu.resilience import RetryError
+
+        arrays = {f: np.asarray(getattr(ds, f)) for f in _RE_TENSOR_FIELDS}
+        if ds.projection_matrix is not None:
+            arrays["projection_matrix"] = np.asarray(ds.projection_matrix)
+        try:
+            tensor_cache.put(
+                cache_key, arrays,
+                meta={"num_entities": ds.num_entities,
+                      "global_dim": ds.global_dim},
+            )
+        except RetryError:
+            pass  # an unusable cache must not fail the build it wraps
+    return ds
+
+
+def _build_random_effect_dataset(
+    data: GameData, config: RandomEffectDataConfig, projector=None
+) -> RandomEffectDataset:
+    """The uncached build (see :func:`build_random_effect_dataset`)."""
     ids = data.ids[config.random_effect_id]
     feats = data.shards[config.feature_shard_id]
     n = data.num_rows

@@ -1,4 +1,14 @@
 from photon_ml_tpu.io.index_map import IndexMap
 from photon_ml_tpu.io.libsvm import read_libsvm
+from photon_ml_tpu.io.pipeline import Prefetcher, device_pipelined, prefetched
+from photon_ml_tpu.io.tensor_cache import TensorCache, content_key
 
-__all__ = ["IndexMap", "read_libsvm"]
+__all__ = [
+    "IndexMap",
+    "Prefetcher",
+    "TensorCache",
+    "content_key",
+    "device_pipelined",
+    "prefetched",
+    "read_libsvm",
+]

@@ -1,0 +1,230 @@
+"""Async pipelined data path: bounded block prefetch + double-buffered H2D.
+
+The streaming coordinates (algorithm/streaming_random_effect.py,
+algorithm/streaming_fixed_effect.py via optim/streaming.py) and the per-host
+ingest (parallel/perhost_ingest.py) were fully synchronous: the device idled
+while the host decoded / mmap-faulted the next block, and the host idled
+while the vmapped solve ran — out-of-core wall-clock was ingest + compute.
+Snap ML's pipelined chunk prefetch across the storage -> host -> accelerator
+hierarchy (PAPERS.md) hides essentially all I/O behind compute; this module
+is that pipeline for the TPU port:
+
+  * :class:`Prefetcher` / :func:`prefetched` — a bounded background-thread
+    stage that produces up to ``depth`` items ahead of the consumer (disk
+    read + slab assembly overlap compute). Items arrive in exactly the
+    source order, and a producer exception is re-raised at the position the
+    failing item would have occupied — a fault injected at ``io.cache_read``
+    three blocks in surfaces to the consumer after blocks 0..2, never
+    reordered, never swallowed.
+  * :func:`device_pipelined` — double-buffered host->device transfer: the
+    NEXT block's ``jax.device_put`` (an async dispatch) is issued while the
+    CURRENT block is being consumed by the solver, and the stage's own
+    reference to a consumed block is dropped on swap so its buffers free as
+    soon as the solver releases them (the donation on swap).
+
+Pipelining never changes WHAT is computed — blocks arrive in source order
+and the consumer's arithmetic is untouched — so results are bit-identical
+with the pipeline on or off (asserted by tests/test_pipeline.py).
+
+``PHOTON_PREFETCH_DEPTH`` overrides the default depth process-wide
+(``0`` forces every pipelined loop synchronous — the A/B lever bench.py's
+``streaming_pipeline`` section uses).
+"""
+
+from __future__ import annotations
+
+import collections
+import os
+import queue
+import threading
+from typing import Any, Callable, Iterable, Iterator, Optional
+
+__all__ = [
+    "DEFAULT_DEPTH",
+    "Prefetcher",
+    "prefetched",
+    "device_pipelined",
+    "resolve_depth",
+]
+
+DEFAULT_DEPTH = 2
+_DEPTH_ENV = "PHOTON_PREFETCH_DEPTH"
+
+
+def resolve_depth(depth: Optional[int]) -> int:
+    """Effective prefetch depth: explicit ``depth`` wins; ``None`` falls back
+    to ``PHOTON_PREFETCH_DEPTH`` (default 2). Depth <= 0 means synchronous."""
+    if depth is not None:
+        return int(depth)
+    raw = os.environ.get(_DEPTH_ENV)
+    if raw is None:
+        return DEFAULT_DEPTH
+    try:
+        return int(raw)
+    except ValueError:
+        raise ValueError(f"{_DEPTH_ENV} must be an integer, got {raw!r}")
+
+
+class _EndOfStream:
+    pass
+
+
+_END = _EndOfStream()
+
+
+class Prefetcher:
+    """Bounded background-thread prefetcher over an iterable factory.
+
+    ``source`` is a zero-arg callable returning an iterable (called once, in
+    the worker thread, so even construction-time I/O overlaps the consumer)
+    or a plain iterable. At most ``depth`` produced-but-unconsumed items are
+    buffered; the worker blocks once the bound is reached, so a slow
+    consumer never builds an unbounded backlog of slabs in host memory.
+
+    Ordering/exception contract: items are yielded in production order; an
+    exception raised by the source is re-raised to the consumer at exactly
+    the position the failing item would have occupied (everything produced
+    before it is still delivered first). After the error the iterator is
+    exhausted.
+
+    ``depth <= 0`` degrades to a synchronous passthrough — no thread, no
+    behavior change, one code path for callers.
+    """
+
+    def __init__(
+        self,
+        source: "Callable[[], Iterable[Any]] | Iterable[Any]",
+        depth: Optional[int] = None,
+        name: str = "prefetch",
+    ):
+        self._depth = resolve_depth(depth)
+        self._factory = source if callable(source) else (lambda: source)
+        self._queue: Optional[queue.Queue] = None
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._name = name
+        self._consumed = False
+
+    # -- worker -------------------------------------------------------------
+    def _run(self) -> None:
+        q = self._queue
+        try:
+            for item in self._factory():
+                while not self._stop.is_set():
+                    try:
+                        q.put(("item", item), timeout=0.1)
+                        break
+                    except queue.Full:
+                        continue
+                if self._stop.is_set():
+                    return
+        except BaseException as e:  # noqa: BLE001 — the exception is NOT
+            # swallowed: it crosses the thread boundary and re-raises in the
+            # consumer at the failing item's position (the module contract)
+            while not self._stop.is_set():
+                try:
+                    q.put(("error", e), timeout=0.1)
+                    return
+                except queue.Full:
+                    continue
+            return
+        while not self._stop.is_set():
+            try:
+                q.put(("end", _END), timeout=0.1)
+                return
+            except queue.Full:
+                continue
+
+    # -- consumer -----------------------------------------------------------
+    def __iter__(self) -> Iterator[Any]:
+        # non-generator wrapper so the single-pass check fires at iter()
+        # time, not at the first next()
+        if self._consumed:
+            raise RuntimeError("Prefetcher is single-pass; build a new one")
+        self._consumed = True
+        return self._iterate()
+
+    def _iterate(self) -> Iterator[Any]:
+        if self._depth <= 0:
+            yield from self._factory()
+            return
+        self._queue = queue.Queue(maxsize=self._depth)
+        self._thread = threading.Thread(
+            target=self._run, name=self._name, daemon=True
+        )
+        self._thread.start()
+        try:
+            while True:
+                kind, payload = self._queue.get()
+                if kind == "item":
+                    yield payload
+                elif kind == "error":
+                    raise payload
+                else:
+                    return
+        finally:
+            self.close()
+
+    def close(self) -> None:
+        """Stop the worker (e.g. the consumer abandoned the loop early).
+        Idempotent; the worker exits at its next queue interaction."""
+        self._stop.set()
+        if self._queue is not None:
+            try:  # unblock a worker waiting on a full queue
+                self._queue.get_nowait()
+            except queue.Empty:
+                pass
+
+    def __enter__(self) -> "Prefetcher":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+
+def prefetched(
+    source: "Callable[[], Iterable[Any]] | Iterable[Any]",
+    depth: Optional[int] = None,
+    name: str = "prefetch",
+) -> Iterator[Any]:
+    """Iterate ``source`` with up to ``depth`` items produced ahead on a
+    background thread (:class:`Prefetcher` as a function)."""
+    return iter(Prefetcher(source, depth=depth, name=name))
+
+
+def device_pipelined(
+    blocks: Iterable[Any],
+    place: Callable[[Any], Any],
+    depth: int = 1,
+) -> Iterator[Any]:
+    """Double-buffered device placement over a host-block stream.
+
+    ``place`` maps a host block to its device form (typically
+    ``jax.device_put`` / ``jnp.asarray`` over the block's arrays — an async
+    dispatch that returns immediately while the transfer runs). The NEXT
+    ``depth`` blocks' placements are issued before the CURRENT block is
+    yielded, so block k+1's H2D transfer runs while block k solves. On each
+    swap this stage drops its own reference to the yielded block, so device
+    buffers free the moment the solver releases them.
+
+    ``depth <= 0`` degrades to ``map(place, blocks)`` semantics (still lazy,
+    no read-ahead).
+    """
+    it = iter(blocks)
+    if depth <= 0:
+        for b in it:
+            yield place(b)
+        return
+    pending: "collections.deque[Any]" = collections.deque()
+    exhausted = False
+    while True:
+        while not exhausted and len(pending) < depth + 1:
+            try:
+                pending.append(place(next(it)))
+            except StopIteration:
+                exhausted = True
+        if not pending:
+            return
+        # popleft BEFORE yield: the stage holds no reference to the block
+        # the consumer is working on (the donation on swap)
+        yield pending.popleft()

@@ -1,0 +1,250 @@
+"""Content-addressed on-disk cache for built ingest tensors.
+
+The Spark-perf study (PAPERS.md, arXiv:1612.01437) identifies
+serialization/shuffle of reusable intermediates as the dominant per-pass
+cost GLMix-style workloads pay — which is exactly what re-running
+Avro decode -> entity grouping -> padded-tensor assembly costs this port on
+every run, epoch, and warm-started grid combo over unchanged inputs. This
+module caches the BUILT tensors, keyed by content:
+
+  key = SHA-256( source file stats (path, size, mtime_ns)
+               + canonical JSON of the ingest config
+               + cache format version )
+
+so any change to the inputs OR the ingest configuration is a miss (no
+invalidation protocol — a stale entry is simply never addressed again).
+
+Two entry shapes:
+
+  * array entries (:meth:`TensorCache.put` / :meth:`TensorCache.get`) —
+    named ndarrays stored as individual ``.npy`` files (REAL mmap on read:
+    ``np.load`` ignores ``mmap_mode`` inside ``.npz`` zips) plus a
+    ``meta.json`` manifest. What ``data/game.py`` ingest consults.
+  * directory entries (:meth:`TensorCache.get_dir` /
+    :meth:`TensorCache.build_dir`) — an arbitrary directory a builder
+    callback populates (the streaming-RE entity-block layout of
+    ``write_re_entity_blocks``).
+
+Both commit atomically: the entry is assembled in a same-filesystem temp
+directory and ``os.replace``d into place, so a crash mid-write leaves no
+half-entry a later run could hit. All filesystem touches go through the
+resilience retry machinery (PR 1) and carry the fault sites ``io.cache_read``
+/ ``io.cache_write`` so the chaos suite covers them. A cache READ that
+stays broken after retries degrades to a miss (rebuild from source —
+a corrupt cache must never fail a training run); a cache WRITE that stays
+broken raises :class:`photon_ml_tpu.resilience.RetryError` to the caller,
+who may continue uncached (the CLI drivers log and do exactly that).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import json
+import os
+import shutil
+import tempfile
+from typing import Callable, Dict, Iterable, Optional, Tuple
+
+import numpy as np
+
+from photon_ml_tpu.resilience import RetryError, RetryPolicy, call_with_retry, faults
+
+__all__ = [
+    "CACHE_FORMAT",
+    "TensorCache",
+    "content_key",
+    "file_stat_token",
+]
+
+CACHE_FORMAT = 1
+_META = "meta.json"
+
+
+def file_stat_token(paths: Iterable[str]) -> list:
+    """(path, size, mtime_ns) per source file — the identity of the inputs.
+    Stats are fetched up front so the key describes the files the build is
+    ABOUT to read; a file modified mid-build yields tensors addressed by the
+    old stats, and the next run (seeing new stats) rebuilds."""
+    out = []
+    for p in sorted(paths):
+        st = os.stat(p)
+        out.append([os.path.abspath(p), int(st.st_size), int(st.st_mtime_ns)])
+    return out
+
+
+def _canonical(config: Dict) -> str:
+    return json.dumps(config, sort_keys=True, separators=(",", ":"), default=str)
+
+
+def content_key(sources: Iterable[str], config: Dict) -> str:
+    """SHA-256 content address of (source file stats, ingest config)."""
+    h = hashlib.sha256()
+    h.update(f"format={CACHE_FORMAT}\n".encode())
+    h.update(_canonical(file_stat_token(sources)).encode())
+    h.update(b"\n")
+    h.update(_canonical(config).encode())
+    return h.hexdigest()
+
+
+@dataclasses.dataclass
+class CacheEntry:
+    """A hit: mmap-backed arrays + the meta dict stored alongside them."""
+
+    arrays: Dict[str, np.ndarray]
+    meta: Dict
+
+
+class TensorCache:
+    """Content-addressed tensor cache rooted at ``root`` (see module doc).
+
+    ``policy=None`` (the default) resolves the retry policy at CALL time
+    from the installed process-wide resilience config — so the drivers'
+    ``--io-retries`` / ``--io-retry-base-delay`` flags govern cache I/O
+    exactly like every other filesystem path (avro, index maps,
+    checkpoints). Pass an explicit :class:`RetryPolicy` to override.
+    """
+
+    def __init__(self, root: str, policy: Optional[RetryPolicy] = None):
+        self.root = root
+        self.policy = policy
+        os.makedirs(root, exist_ok=True)
+
+    @property
+    def _policy(self) -> RetryPolicy:
+        if self.policy is not None:
+            return self.policy
+        from photon_ml_tpu import resilience
+
+        return resilience.current_config().io_policy
+
+    # -- addressing ---------------------------------------------------------
+    def key_for(self, sources: Iterable[str], config: Dict) -> str:
+        return content_key(sources, config)
+
+    def entry_dir(self, key: str) -> str:
+        return os.path.join(self.root, key[:2], key)
+
+    def has(self, key: str) -> bool:
+        return os.path.exists(os.path.join(self.entry_dir(key), _META))
+
+    # -- array entries -------------------------------------------------------
+    def get(self, key: str) -> Optional[CacheEntry]:
+        """The entry at ``key``, arrays mmap-backed, or None on miss.
+        A broken entry (injected/real read failure that survives retries,
+        truncated file, manifest mismatch) degrades to a miss and the debris
+        is swept so the rebuild can re-commit."""
+        entry = self.entry_dir(key)
+        meta_path = os.path.join(entry, _META)
+        if not os.path.exists(meta_path):
+            return None
+        try:
+            def read():
+                faults.inject("io.cache_read", key=key, entry=entry)
+                with open(meta_path) as f:
+                    meta = json.load(f)
+                arrays = {}
+                for name in meta.get("arrays", []):
+                    arrays[name] = np.load(
+                        os.path.join(entry, f"{name}.npy"), mmap_mode="r"
+                    )
+                return CacheEntry(arrays=arrays, meta=meta.get("meta", {}))
+
+            return call_with_retry(
+                read, self._policy, describe=f"tensor-cache read {key[:12]}"
+            )
+        except (RetryError, OSError, ValueError, json.JSONDecodeError):
+            # a cache must never fail the run it exists to speed up: sweep
+            # the broken entry (best effort) and report a miss
+            shutil.rmtree(entry, ignore_errors=True)
+            return None
+
+    def put(self, key: str, arrays: Dict[str, np.ndarray], meta: Optional[Dict] = None) -> str:
+        """Commit named arrays + meta under ``key`` atomically; returns the
+        entry directory. Raises :class:`RetryError` if the write stays broken
+        after retries (callers continue uncached)."""
+
+        def build(tmp: str) -> None:
+            manifest = {"format": CACHE_FORMAT, "key": key,
+                        "arrays": sorted(arrays), "meta": meta or {}}
+            for name, arr in arrays.items():
+                if "/" in name or name.startswith("."):
+                    raise ValueError(f"bad cache array name {name!r}")
+                np.save(os.path.join(tmp, f"{name}.npy"), np.asarray(arr))
+            with open(os.path.join(tmp, _META), "w") as f:
+                json.dump(manifest, f)
+
+        return self.build_dir(key, build)
+
+    # -- directory entries ---------------------------------------------------
+    def get_dir(self, key: str) -> Optional[str]:
+        """The committed directory entry for ``key``, or None. The injected
+        ``io.cache_read`` fault fires here too (the streaming-RE block reuse
+        path); a read fault that survives retries degrades to a miss."""
+        entry = self.entry_dir(key)
+        if not os.path.exists(os.path.join(entry, _META)):
+            return None
+        try:
+            def probe():
+                faults.inject("io.cache_read", key=key, entry=entry)
+                with open(os.path.join(entry, _META)) as f:
+                    json.load(f)
+                return entry
+
+            return call_with_retry(
+                probe, self._policy, describe=f"tensor-cache probe {key[:12]}"
+            )
+        except (RetryError, OSError, json.JSONDecodeError):
+            shutil.rmtree(entry, ignore_errors=True)
+            return None
+
+    def build_dir(self, key: str, build: Callable[[str], None]) -> str:
+        """Populate a fresh entry directory through ``build(tmp_dir)`` and
+        commit it atomically under ``key``; returns the final directory.
+        ``build`` writes ordinary files into ``tmp_dir`` — nothing is live
+        until the single ``os.replace``. Lost-race commits (another process
+        finished the same key first) keep the winner and discard ours."""
+        entry = self.entry_dir(key)
+        os.makedirs(os.path.dirname(entry), exist_ok=True)
+        tmp = tempfile.mkdtemp(
+            prefix=f".tmp-{key[:12]}-", dir=os.path.dirname(entry)
+        )
+        try:
+            def write():
+                faults.inject("io.cache_write", key=key, entry=entry)
+                build(tmp)
+                if not os.path.exists(os.path.join(tmp, _META)):
+                    with open(os.path.join(tmp, _META), "w") as f:
+                        json.dump({"format": CACHE_FORMAT, "key": key}, f)
+
+            call_with_retry(
+                write, self._policy, describe=f"tensor-cache write {key[:12]}"
+            )
+            try:
+                os.replace(tmp, entry)
+            except OSError:
+                if os.path.exists(os.path.join(entry, _META)):
+                    pass  # lost the commit race; the winner's entry serves
+                else:
+                    raise
+            return entry
+        finally:
+            shutil.rmtree(tmp, ignore_errors=True)
+
+
+def index_map_digest(index_map) -> str:
+    """Stable digest of an index map's content for cache keys (the feature
+    index assignment changes the built tensors even when input files do not
+    — e.g. an --offheap-indexmap-dir swap). Works against the shared index
+    protocol (``__len__`` + ``get_feature_name``), so the in-memory
+    :class:`~photon_ml_tpu.io.index_map.IndexMap` and the off-heap
+    :class:`~photon_ml_tpu.io.offheap.OffHeapIndexMap` both digest; the
+    in-memory list is used directly when present (no per-index call)."""
+    h = hashlib.sha256()
+    names = getattr(index_map, "index_to_name", None)
+    if names is None:
+        names = (index_map.get_feature_name(i) for i in range(len(index_map)))
+    for name in names:
+        h.update((name or "").encode())
+        h.update(b"\x00")
+    return h.hexdigest()

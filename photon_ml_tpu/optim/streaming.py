@@ -162,17 +162,72 @@ def write_chunk_files(
 # ---------------------------------------------------------------------------
 
 
+def pipelined_device_chunks(
+    source: ChunkedGLMSource, dtype, prefetch_depth: Optional[int] = None
+):
+    """Yield ``(x, y, offsets, weights)`` device tuples per chunk through the
+    async pipeline (io/pipeline.py): a background thread reads + page-faults
+    up to ``prefetch_depth`` chunks ahead of the consumer, and the NEXT
+    chunk's host->device transfer is issued while the CURRENT chunk's kernel
+    runs (double-buffered H2D). Chunk order is the source order either way,
+    and the additive aggregator algebra is order-identical — streamed passes
+    stay exact, pipelined or not. Depth <= 0 is the old synchronous loop."""
+    from photon_ml_tpu.io.pipeline import (
+        Prefetcher,
+        device_pipelined,
+        resolve_depth,
+    )
+
+    def to_host(chunk):
+        n_c = len(chunk["y"])
+
+        def materialize(a):
+            # np.asarray over an np.load(mmap_mode="r") memmap is a SHARED
+            # view — no pages read. The prefetch stage exists to do the disk
+            # read off the solve path, so mmap-backed chunks must be COPIED
+            # here (bounded: at most depth+1 chunks resident); plain arrays
+            # pass through untouched.
+            if isinstance(a, np.memmap):
+                return np.array(a, copy=True)
+            return np.asarray(a)
+
+        return (
+            materialize(chunk["x"]),
+            materialize(chunk["y"]),
+            materialize(chunk.get("offsets", np.zeros(n_c, np.float32))),
+            materialize(chunk.get("weights", np.ones(n_c, np.float32))),
+        )
+
+    def place(host):
+        return tuple(jnp.asarray(a, dtype) for a in host)
+
+    depth = resolve_depth(prefetch_depth)
+    if depth <= 0:
+        for chunk in source.chunks():
+            yield place(to_host(chunk))
+        return
+    host_chunks = Prefetcher(
+        lambda: (to_host(c) for c in source.chunks()),
+        depth=depth,
+        name="glm-chunk-prefetch",
+    )
+    yield from device_pipelined(host_chunks, place, depth=1)
+
+
 def make_streaming_value_and_grad(
     source: ChunkedGLMSource,
     objective: GLMObjective,
     norm: NormalizationContext,
     l2_weight: float = 0.0,
     dtype=None,
+    prefetch_depth: Optional[int] = None,
 ):
     """vg(w, l2_weight=...) -> (f, g) accumulated over chunks; one jitted
     partial per chunk shape (all chunks but the tail share one executable,
     and l2 is a traced arg so a lambda grid NEVER recompiles — build the
-    factory once, wrap per lambda)."""
+    factory once, wrap per lambda). Chunks stream through the async
+    prefetch + double-buffered H2D pipeline (:func:`pipelined_device_chunks`);
+    the accumulation order is unchanged, so values stay exact."""
     from photon_ml_tpu.types import real_dtype
 
     dtype = dtype or real_dtype()
@@ -189,14 +244,7 @@ def make_streaming_value_and_grad(
     def vg(w: Array, l2_weight=l2_weight) -> Tuple[Array, Array]:
         f = jnp.zeros((), dtype)
         g = jnp.zeros((source.dim,), dtype)
-        for chunk in source.chunks():
-            x = jnp.asarray(chunk["x"], dtype)
-            y = jnp.asarray(chunk["y"], dtype)
-            n_c = x.shape[0]
-            off = jnp.asarray(
-                chunk.get("offsets", np.zeros(n_c, np.float32)), dtype
-            )
-            wt = jnp.asarray(chunk.get("weights", np.ones(n_c, np.float32)), dtype)
+        for x, y, off, wt in pipelined_device_chunks(source, dtype, prefetch_depth):
             fv, gv = partial_vg(w, x, y, off, wt)
             f = f + fv
             g = g + gv
@@ -357,11 +405,13 @@ def make_streaming_hvp(
     norm: NormalizationContext,
     l2_weight: float = 0.0,
     dtype=None,
+    prefetch_depth: Optional[int] = None,
 ):
     """hvp(w, v, l2_weight=...) -> H(w) v accumulated over chunks — the
     chunked HessianVectorAggregator (HessianVectorAggregator.scala:90-116
     algebra is additive over rows, so per-chunk partials sum exactly).
-    One jitted partial per chunk shape, like the value+grad factory."""
+    One jitted partial per chunk shape, like the value+grad factory; chunks
+    stream through the same prefetch + double-buffered H2D pipeline."""
     from photon_ml_tpu.types import real_dtype
 
     dtype = dtype or real_dtype()
@@ -373,14 +423,7 @@ def make_streaming_hvp(
 
     def hvp(w: Array, v: Array, l2_weight=l2_weight) -> Array:
         hv = jnp.zeros((source.dim,), dtype)
-        for chunk in source.chunks():
-            x = jnp.asarray(chunk["x"], dtype)
-            y = jnp.asarray(chunk["y"], dtype)
-            n_c = x.shape[0]
-            off = jnp.asarray(
-                chunk.get("offsets", np.zeros(n_c, np.float32)), dtype
-            )
-            wt = jnp.asarray(chunk.get("weights", np.ones(n_c, np.float32)), dtype)
+        for x, y, off, wt in pipelined_device_chunks(source, dtype, prefetch_depth):
             hv = hv + partial_hvp(w, v, x, y, off, wt)
         return hv + jnp.asarray(l2_weight, dtype) * v
 
@@ -557,6 +600,7 @@ def streaming_hessian_diagonal(
     norm: NormalizationContext,
     w: Array,
     l2_weight: float = 0.0,
+    prefetch_depth: Optional[int] = None,
 ) -> Array:
     """diag(H) accumulated over chunks (additive data part + l2 once) —
     the coefficient-variance pass for out-of-core fits."""
@@ -567,12 +611,7 @@ def streaming_hessian_diagonal(
         return objective.hessian_diagonal(w, batch, norm, 0.0)
 
     diag = jnp.zeros((source.dim,), w.dtype)
-    for chunk in source.chunks():
-        x = jnp.asarray(chunk["x"], w.dtype)
-        y = jnp.asarray(chunk["y"], w.dtype)
-        n_c = x.shape[0]
-        off = jnp.asarray(chunk.get("offsets", np.zeros(n_c, np.float32)), w.dtype)
-        wt = jnp.asarray(chunk.get("weights", np.ones(n_c, np.float32)), w.dtype)
+    for x, y, off, wt in pipelined_device_chunks(source, w.dtype, prefetch_depth):
         diag = diag + partial_diag(w, x, y, off, wt)
     return diag + l2_weight
 

@@ -86,6 +86,7 @@ class PerHostFactoredRandomEffectCoordinate:
         self._update_fn = None
         self._score_fn = None
         self._coef_fn = None
+        self._vterm_fn = None
         # same contract as PerHostRandomEffectSolver: under multihost SPMD
         # the sharded slabs are non-addressable, so CoordinateDescent must
         # not close over them in an outer jit
@@ -218,19 +219,24 @@ class PerHostFactoredRandomEffectCoordinate:
     def regularization_term(self, state: FactoredState) -> Array:
         re, lat = self.re_regularization, self.latent_regularization
         # v is sharded: sum its term under a shard_map psum so every host
-        # sees the global value; M is replicated — term computed directly
-        axis = self.ctx.axis
+        # sees the global value; M is replicated — term computed directly.
+        # The jitted shard_map closure is cached on the instance (like
+        # _update_fn/_score_fn): rebuilding it per call re-traced and
+        # re-jitted the collective every evaluation (ADVICE.md).
+        if self._vterm_fn is None:
+            axis = self.ctx.axis
 
-        def v_term(v):
-            t = re.l1_weight * jnp.sum(jnp.abs(v)) + 0.5 * re.l2_weight * jnp.sum(
-                jnp.square(v)
+            def v_term(v):
+                t = re.l1_weight * jnp.sum(jnp.abs(v)) + (
+                    0.5 * re.l2_weight * jnp.sum(jnp.square(v))
+                )
+                return jax.lax.psum(t, axis)
+
+            self._vterm_fn = jax.jit(
+                shard_map(v_term, mesh=self.ctx.mesh, in_specs=(P(axis),),
+                          out_specs=P())
             )
-            return jax.lax.psum(t, axis)
-
-        vterm = jax.jit(
-            shard_map(v_term, mesh=self.ctx.mesh, in_specs=(P(axis),),
-                      out_specs=P())
-        )(state.v)
+        vterm = self._vterm_fn(state.v)
         mterm = lat.l1_weight * jnp.sum(jnp.abs(state.matrix)) + (
             0.5 * lat.l2_weight * jnp.sum(jnp.square(state.matrix))
         )

@@ -1078,6 +1078,7 @@ def host_rows_from_avro(
     shard_sections: Sequence[str],
     intercept: bool = True,
     row_stride: int = 1 << 22,
+    prefetch_depth: Optional[int] = None,
 ) -> HostRows:
     """Decode ONLY this host's Avro part files into :class:`HostRows`.
 
@@ -1094,8 +1095,16 @@ def host_rows_from_avro(
     (io/offheap.py) the backing is mmap'd, so each host faults in only the
     index pages its own partitions touch — per-partition index-map
     instantiation without explicit partition files.
+
+    The per-file decode is the per-host block iteration of the async data
+    pipeline (io/pipeline.py): up to ``prefetch_depth`` files decode on a
+    background thread while the consumer pads/assembles earlier files'
+    rows, so disk read + Avro decode overlap the tensor assembly. File
+    order (and therefore every produced tensor) is identical pipelined or
+    not.
     """
     from photon_ml_tpu.io.avro_data import read_game_data
+    from photon_ml_tpu.io.pipeline import Prefetcher
 
     file_ordinals = list(file_ordinals)
     if len(host_files) != len(file_ordinals):
@@ -1109,15 +1118,22 @@ def host_rows_from_avro(
             f"file ordinal {max_ord} x stride {row_stride} overflows the "
             "int32 row-id space; lower row_stride or merge input files"
         )
+
+    def decode_all():
+        for path, ordinal in zip(host_files, file_ordinals):
+            gd = read_game_data(
+                [path],
+                {shard_id: index_map},
+                {shard_id: list(shard_sections)},
+                [random_effect_id],
+                shard_intercepts={shard_id: intercept},
+            )
+            yield path, ordinal, gd
+
     parts: List[HostRows] = []
-    for path, ordinal in zip(host_files, file_ordinals):
-        gd = read_game_data(
-            [path],
-            {shard_id: index_map},
-            {shard_id: list(shard_sections)},
-            [random_effect_id],
-            shard_intercepts={shard_id: intercept},
-        )
+    for path, ordinal, gd in Prefetcher(
+        decode_all, depth=prefetch_depth, name="avro-decode-prefetch"
+    ):
         feats = gd.shards[shard_id]
         n = gd.num_rows
         fi, fv = csr_to_padded(feats, n)

@@ -159,7 +159,12 @@ def test_grid_shape_validation(setup):
 
 def test_grid_warm_start_reaches_same_optima(setup):
     """init_params warm-starts every lane from a shared point; final
-    objectives must match the cold grid (same optima, different path)."""
+    objectives must land at the same optima (different path). Since the
+    score-seeding fix (run_grid now mirrors run(initial_params=...): a
+    warm-started coordinate contributes its CURRENT scores from step zero
+    instead of training the first cycle against zero offsets), the warm
+    trajectory genuinely diverges from cold early on — so the bound is
+    'same optimum to ~1e-3 and never worse', not trajectory equality."""
     data, labels, loss_fn = setup
     coords = _coords(data, 0.1, 0.1)
     cd = CoordinateDescent(coords, loss_fn)
@@ -175,5 +180,10 @@ def test_grid_warm_start_reaches_same_optima(setup):
     )
     for c, w in zip(cold, warm):
         assert w.objective_history[-1] == pytest.approx(
-            c.objective_history[-1], rel=1e-4
+            c.objective_history[-1], rel=2e-3
         )
+        # a correctly-seeded warm start must never END worse than cold
+        assert w.objective_history[-1] <= c.objective_history[-1] * (1 + 1e-4)
+    # the seeding itself: the warm grid's FIRST objective must reflect the
+    # warm model's scores, not a zero-offset cold start
+    assert warm[0].objective_history[0] < cold[0].objective_history[0] * 1.5
