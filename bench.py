@@ -660,6 +660,173 @@ def _bench_streaming_pipeline(extra, on_tpu):
         shutil.rmtree(tmp, ignore_errors=True)
 
 
+def _bench_compile_reuse(extra, on_tpu):
+    """Compile-once execution layer (photon_ml_tpu/compile/): (a) a
+    multi-block streaming-RE update with shape canonicalization ON vs OFF —
+    the ladder collapses N block shapes onto ~log(N) compiled solver
+    executables (trace counts from CompileStats), with bit-identical
+    coefficients and cold (compiling) vs warm (steady-state) wall-clock for
+    both arms; (b) persistent XLA compilation cache cold vs warm across
+    FRESH processes — the warm run must report zero new XLA compiles for
+    the solver sites. The subprocesses run on CPU deliberately: cache
+    behavior needs no accelerator, and grandchildren must never contend
+    for the single-client device tunnel."""
+    import shutil
+    import subprocess
+    import tempfile
+
+    import jax
+    import jax.numpy as jnp
+
+    from game_test_utils import make_glmix_data
+
+    from photon_ml_tpu.algorithm.streaming_random_effect import (
+        StreamingRandomEffectCoordinate,
+        write_re_entity_blocks,
+    )
+    from photon_ml_tpu.compile import ShapeBucketer, compile_stats
+    from photon_ml_tpu.data.game import RandomEffectDataConfig
+    from photon_ml_tpu.optim.common import OptimizerConfig
+    from photon_ml_tpu.ops.regularization import RegularizationContext
+    from photon_ml_tpu.types import OptimizerType, TaskType
+
+    num_users = 4096 if on_tpu else 512
+    rng = np.random.default_rng(29)
+    # skewed entity sizes: block max-counts differ, so WITHOUT the ladder
+    # nearly every block carries its own shape (the N-compiles regime).
+    # The extents sit in the ladder's verified bit-exact regime (sample
+    # counts <= 16 at d_loc 4 — photon_ml_tpu/compile/canonical.py): the
+    # on-vs-off coefficient comparison below is BITWISE, not allclose.
+    data, _ = make_glmix_data(
+        rng, num_users=num_users, rows_per_user_range=(4, 16),
+        d_fixed=8, d_random=4,
+    )
+    n = data.num_rows
+    cfg = RandomEffectDataConfig("userId", "per_user")
+    resid = jnp.zeros((n,), jnp.float32)
+    tmp = tempfile.mkdtemp(prefix="bench-compile-reuse-")
+    try:
+        results = {}
+        for tag, bucketer in (("off", None), ("on", ShapeBucketer(8, 2.0))):
+            manifest = write_re_entity_blocks(
+                data, cfg, os.path.join(tmp, f"blocks-{tag}"),
+                block_entities=max(num_users // 16, 1),
+                bucketer=bucketer,
+            )
+            coord = StreamingRandomEffectCoordinate(
+                manifest, TaskType.LOGISTIC_REGRESSION, OptimizerType.LBFGS,
+                OptimizerConfig(max_iterations=10, tolerance=1e-7),
+                RegularizationContext.l2(0.1),
+                state_root=os.path.join(tmp, f"state-{tag}"),
+            )
+            compile_stats.reset()
+            t0 = time.perf_counter()
+            state, _ = coord.update(resid, coord.initial_coefficients())
+            t_cold = time.perf_counter() - t0
+            traces = compile_stats.traces_of("streaming_re.block_update")
+            t0 = time.perf_counter()
+            state, _ = coord.update(resid, coord.initial_coefficients())
+            t_warm = time.perf_counter() - t0
+            coefs = [state.block(i) for i in range(len(manifest.blocks))]
+            results[tag] = dict(
+                manifest=manifest, traces=traces, cold=t_cold, warm=t_warm,
+                coefs=coefs,
+            )
+        off, on = results["off"], results["on"]
+        # ladder pads lanes/samples at the END: slicing the ladder arm's
+        # stacks back to the natural shapes must reproduce the off arm
+        # bit for bit
+        identical = all(
+            c_on[: meta["num_entities"], : meta["local_dim"]].tobytes()
+            == c_off.tobytes()
+            for c_off, c_on, meta in zip(
+                off["coefs"], on["coefs"], off["manifest"].blocks
+            )
+        )
+        _log(
+            f"compile reuse ({len(off['manifest'].blocks)} blocks): "
+            f"ladder off {off['traces']} solver compiles, on {on['traces']} "
+            f"({off['cold']:.2f}s->{off['warm']:.2f}s vs "
+            f"{on['cold']:.2f}s->{on['warm']:.2f}s cold->warm); "
+            f"bit-identical={identical}"
+        )
+        extra["compile_reuse_blocks"] = len(off["manifest"].blocks)
+        extra["compile_reuse_solver_compiles_ladder_off"] = off["traces"]
+        extra["compile_reuse_solver_compiles_ladder_on"] = on["traces"]
+        extra["compile_reuse_fewer_compiles"] = bool(on["traces"] < off["traces"])
+        extra["compile_reuse_bit_identical"] = bool(identical)
+        extra["compile_reuse_cold_update_sec_ladder_off"] = round(off["cold"], 4)
+        extra["compile_reuse_warm_update_sec_ladder_off"] = round(off["warm"], 4)
+        extra["compile_reuse_cold_update_sec_ladder_on"] = round(on["cold"], 4)
+        extra["compile_reuse_warm_update_sec_ladder_on"] = round(on["warm"], 4)
+        extra["compile_reuse_config"] = {
+            "rows": n, "entities": num_users, "d_random": 4,
+            "blocks": len(off["manifest"].blocks),
+        }
+
+        # ---- persistent cache: cold vs warm across fresh processes --------
+        cache_dir = os.path.join(tmp, "xla-cache")
+        child_src = (
+            "import os, json, time\n"
+            "os.environ['JAX_PLATFORMS'] = 'cpu'\n"
+            "import numpy as np\n"
+            "import jax, jax.numpy as jnp\n"
+            "from photon_ml_tpu import compat\n"
+            "from photon_ml_tpu.compile import compile_stats\n"
+            "compile_stats.install_xla_listeners()\n"
+            f"assert compat.enable_persistent_cache({cache_dir!r})\n"
+            "from photon_ml_tpu.ops import losses\n"
+            "from photon_ml_tpu.ops.normalization import NormalizationContext\n"
+            "from photon_ml_tpu.ops.objective import GLMObjective\n"
+            "from photon_ml_tpu.optim.streaming import (\n"
+            "    ChunkedGLMSource, lbfgs_minimize_streaming,\n"
+            "    make_streaming_value_and_grad)\n"
+            "from photon_ml_tpu.optim.common import OptimizerConfig\n"
+            "rng = np.random.default_rng(7)\n"
+            "x = rng.normal(size=(4096, 64)).astype(np.float32)\n"
+            "y = (rng.random(4096) < 0.5).astype(np.float32)\n"
+            "src = ChunkedGLMSource.from_arrays(x, y, chunk_rows=1024)\n"
+            "obj = GLMObjective(losses.logistic)\n"
+            "vg = make_streaming_value_and_grad(\n"
+            "    src, obj, NormalizationContext.identity(), l2_weight=0.1,\n"
+            "    prefetch_depth=0)\n"
+            "t0 = time.perf_counter()\n"
+            "res = lbfgs_minimize_streaming(\n"
+            "    vg, jnp.zeros((64,), jnp.float32),\n"
+            "    OptimizerConfig(max_iterations=5, tolerance=1e-7))\n"
+            "jax.block_until_ready(res.coefficients)\n"
+            "print(json.dumps({'sec': time.perf_counter() - t0,\n"
+            "                  'misses': compile_stats.xla_cache_misses,\n"
+            "                  'hits': compile_stats.xla_cache_hits}))\n"
+        )
+        runs = []
+        for arm in ("cold", "warm"):
+            proc = subprocess.run(
+                [sys.executable, "-c", child_src],
+                capture_output=True, text=True, timeout=600,
+                cwd=os.path.dirname(os.path.abspath(__file__)),
+            )
+            if proc.returncode != 0:
+                raise RuntimeError(
+                    f"persistent-cache {arm} child failed: {proc.stderr[-500:]}"
+                )
+            runs.append(json.loads(proc.stdout.strip().splitlines()[-1]))
+        cold, warm = runs
+        _log(
+            f"persistent cache: cold {cold['misses']} compiles "
+            f"{cold['sec']:.2f}s; warm {warm['misses']} new compiles, "
+            f"{warm['hits']} cache hits, {warm['sec']:.2f}s"
+        )
+        extra["persistent_cache_cold_compiles"] = cold["misses"]
+        extra["persistent_cache_cold_sec"] = round(cold["sec"], 3)
+        extra["persistent_cache_warm_new_compiles"] = warm["misses"]
+        extra["persistent_cache_warm_hits"] = warm["hits"]
+        extra["persistent_cache_warm_sec"] = round(warm["sec"], 3)
+        extra["persistent_cache_fully_warm"] = bool(warm["misses"] == 0)
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
 def _bench_ingest(extra):
     """Data-loader throughput: native C++ avro columnar ingest vs the pure
     python codec on an identical synthetic GAME file (host-side; no
@@ -970,7 +1137,8 @@ def _bench_game5(extra, on_tpu):
 
 SECTION_ORDER = (
     "dense", "sparse", "game", "game5", "grid",
-    "streaming", "streaming_pipeline", "perhost", "scoring", "ingest",
+    "streaming", "streaming_pipeline", "compile_reuse", "perhost",
+    "scoring", "ingest",
 )
 # orchestrator per-section deadlines (s): generous — tunnel compiles are slow,
 # and hitting a deadline DETACHES the child (never kills: r3 claim-orphan
@@ -1015,6 +1183,8 @@ def _run_sections(names, extra, errors, on_tpu, state=None, after=None):
                 _bench_streaming(extra, on_tpu)
             elif name == "streaming_pipeline":
                 _bench_streaming_pipeline(extra, on_tpu)
+            elif name == "compile_reuse":
+                _bench_compile_reuse(extra, on_tpu)
             elif name == "perhost":
                 _bench_perhost(extra, on_tpu)
             elif name == "scoring":

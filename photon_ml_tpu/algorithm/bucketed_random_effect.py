@@ -117,8 +117,17 @@ class BucketedDatasetBundle:
 
     @staticmethod
     def build(
-        data: GameData, config: RandomEffectDataConfig, max_buckets: int = 6
+        data: GameData, config: RandomEffectDataConfig, max_buckets: int = 6,
+        bucketer=None,
     ) -> "BucketedDatasetBundle":
+        """``bucketer`` (photon_ml_tpu.compile, None = PHOTON_SHAPE_LADDER)
+        additionally rounds every bucket's dims up the canonical ladder
+        with masked padding: buckets from DIFFERENT coordinates / datasets
+        / grid combos land on identical shapes and share compiled solver
+        executables instead of each compiling their own."""
+        from photon_ml_tpu.compile import canonicalize_re_dataset, resolve_bucketer
+
+        bucketer = resolve_bucketer(bucketer)
         re_id = config.random_effect_id
         ids = data.ids[re_id]
         counts = np.bincount(ids, minlength=int(ids.max()) + 1 if len(ids) else 0)
@@ -129,7 +138,11 @@ class BucketedDatasetBundle:
             filtered = _filter_game_data(
                 data, re_id, config.feature_shard_id, row_sel, entity_ids
             )
-            datasets.append(build_random_effect_dataset(filtered, config))
+            datasets.append(
+                canonicalize_re_dataset(
+                    build_random_effect_dataset(filtered, config), bucketer
+                )
+            )
             row_sels.append(row_sel)
             dense_ids.append(filtered.ids[re_id])
         return BucketedDatasetBundle(
@@ -156,6 +169,10 @@ class BucketedRandomEffectCoordinate:
     )
     max_buckets: int = 6
     bundle: Optional[BucketedDatasetBundle] = None  # prebuilt, shared
+    # canonical shape ladder (photon_ml_tpu.compile.ShapeBucketer or spec;
+    # None = PHOTON_SHAPE_LADDER, default off): buckets padded onto ladder
+    # shapes share compiled solver executables across coordinates/combos
+    bucketer: Optional[object] = None
     # when set, every bucket's vmapped solve is ALSO entity-sharded over the
     # mesh (DistributedRandomEffectSolver per bucket): bucketing handles the
     # size skew, sharding handles the scale — the two axes compose
@@ -164,7 +181,7 @@ class BucketedRandomEffectCoordinate:
     def __post_init__(self):
         if self.bundle is None:
             self.bundle = BucketedDatasetBundle.build(
-                self.data, self.config, self.max_buckets
+                self.data, self.config, self.max_buckets, self.bucketer
             )
         b = self.bundle
         self.buckets = b.buckets
@@ -202,7 +219,9 @@ class BucketedRandomEffectCoordinate:
         for bi, (sub, entity_ids, dense_ids) in enumerate(
             zip(self._subs, self.buckets, self._dense_ids)
         ):
-            entity_pos = np.asarray(sub.dataset.entity_pos)
+            # ladder-canonicalized buckets pad entity_pos with -1 rows
+            # beyond the real rows dense_ids covers — slice to match
+            entity_pos = np.asarray(sub.dataset.entity_pos)[: len(dense_ids)]
             known = entity_pos >= 0
             pos_of_dense = np.full(len(entity_ids), -1, np.int32)
             pos_of_dense[dense_ids[known]] = entity_pos[known]
@@ -306,7 +325,11 @@ class BucketedRandomEffectCoordinate:
     def score(self, state: Tuple[Array, ...]) -> Array:
         total = jnp.zeros((self._num_rows,), real_dtype())
         for unit, row_sel, w in zip(self._units(), self._row_sels, state):
-            total = total.at[jnp.asarray(row_sel)].set(unit.score(w))
+            # ladder-canonicalized buckets score their pad rows too
+            # (entity_pos -1 -> 0); slice back to the bucket's real rows
+            total = total.at[jnp.asarray(row_sel)].set(
+                unit.score(w)[: len(row_sel)]
+            )
         return total
 
     def regularization_term(self, state: Tuple[Array, ...]) -> Array:

@@ -108,20 +108,38 @@ class CoordinateDescent:
         self.divergence_guard = divergence_guard
         self._cycle_fn = None
         self._grid_cycle_fn = None  # jitted vmap(_cycle_body), built once
-        # jit the per-coordinate update+score once per coordinate. A
-        # coordinate may opt OUT (class attr cd_jit=False) when its arrays
+        # jit the per-coordinate update+score once per coordinate, with
+        # compile telemetry (photon_ml_tpu.compile.compile_stats) per site.
+        # A coordinate may opt OUT (class attr cd_jit=False) when its arrays
         # span non-addressable devices under multihost SPMD — closing over
         # them in an outer jit is illegal; such coordinates jit internally
         # with the global arrays as ARGUMENTS (shard_map calls).
-        def _maybe_jit(fn, coord):
-            return jax.jit(fn) if getattr(coord, "cd_jit", True) else fn
+        #
+        # Donation: the incoming coefficient state w0 is DONATED into each
+        # update — the solver's output state aliases it in place, halving
+        # peak HBM for the largest (E, D) stacks — EXCEPT under a
+        # divergence guard, whose rollback must keep the pre-update state
+        # alive (donating it would hand the guard a deleted buffer).
+        from photon_ml_tpu.compile import donation_enabled, instrumented_jit
+
+        self._donate = donation_enabled() and divergence_guard is None
+
+        def _maybe_jit(fn, coord, site, donate=()):
+            if not getattr(coord, "cd_jit", True):
+                return fn
+            return instrumented_jit(fn, site=site, donate_argnums=donate)
 
         self._update_fns = {
-            name: _maybe_jit(lambda off, w0, c=coord: c.update(off, w0), coord)
+            name: _maybe_jit(
+                lambda off, w0, c=coord: c.update(off, w0),
+                coord,
+                f"cd.update[{name}]",
+                donate=(1,) if self._donate else (),
+            )
             for name, coord in coordinates.items()
         }
         self._score_fns = {
-            name: _maybe_jit(lambda w, c=coord: c.score(w), coord)
+            name: _maybe_jit(lambda w, c=coord: c.score(w), coord, f"cd.score[{name}]")
             for name, coord in coordinates.items()
         }
 
@@ -181,8 +199,17 @@ class CoordinateDescent:
             )
 
     def _build_cycle(self):
+        from photon_ml_tpu.compile import instrumented_jit
+
         self._require_jittable_coordinates("fused_cycle")
-        return jax.jit(self._cycle_body)
+        # donate the carried (params, scores, total) pytrees: each fused
+        # iteration's outputs alias the previous iteration's buffers — the
+        # whole descent carries ONE copy of the model state instead of two
+        return instrumented_jit(
+            self._cycle_body,
+            site="cd.fused_cycle",
+            donate_argnums=(0, 1, 2) if self._donate else (),
+        )
 
     def run_grid(
         self,
@@ -246,7 +273,13 @@ class CoordinateDescent:
             # one-lane vmap keeps the lane axis in the traced shapes, so
             # every combo (and every run_grid call on this instance) reuses
             # the SAME executable — the compile-amortization win
-            self._grid_cycle_fn = jax.jit(jax.vmap(self._cycle_body))
+            from photon_ml_tpu.compile import instrumented_jit
+
+            self._grid_cycle_fn = instrumented_jit(
+                jax.vmap(self._cycle_body),
+                site="cd.grid_cycle",
+                donate_argnums=(0, 1, 2) if self._donate else (),
+            )
         cycle_v = self._grid_cycle_fn
 
         dt = real_dtype()
@@ -277,9 +310,17 @@ class CoordinateDescent:
         out = []
         for i in range(g):
             lam_i = {n: lam[n][i : i + 1] for n in names}
-            params = dict(params0)
-            scores = dict(scores0)
-            total = total0
+            if self._donate:
+                # the donating cycle consumes its (params, scores, total)
+                # inputs — hand every combo a fresh copy of the shared
+                # seeds, or combo 2 would read combo 1's deleted buffers
+                params = jax.tree.map(jnp.copy, dict(params0))
+                scores = jax.tree.map(jnp.copy, dict(scores0))
+                total = jnp.copy(total0)
+            else:
+                params = dict(params0)
+                scores = dict(scores0)
+                total = total0
 
             t0 = time.perf_counter()
             objective_dev: List[Array] = []
@@ -336,6 +377,15 @@ class CoordinateDescent:
             )
             for n in names
         }
+        if initial_params is not None and self._donate:
+            # donating updates consume their w0 — warm-start params belong
+            # to the CALLER (e.g. a previous combo's result); hand the
+            # donation a private copy so the caller's arrays survive
+            for n in names:
+                if n in initial_params and getattr(
+                    self.coordinates[n], "cd_jit", True
+                ):
+                    params[n] = jax.tree.map(jnp.copy, params[n])
         scores = {n: jnp.zeros((num_rows,), real_dtype()) for n in names}
         if initial_params is not None:
             # warm-started coordinates contribute their CURRENT scores from

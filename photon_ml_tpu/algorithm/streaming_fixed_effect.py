@@ -54,12 +54,20 @@ class StreamingFixedEffectCoordinate:
     # (default 2). Exact either way — chunk order and the additive
     # accumulation are unchanged.
     prefetch_depth: Optional[int] = None
+    # shape canonicalization (photon_ml_tpu.compile): chunk row counts are
+    # rounded up the geometric ladder with weight-0 rows, so the tail chunk
+    # shares the other chunks' compiled partial. None = PHOTON_SHAPE_LADDER
+    # (default off); accepts a ShapeBucketer or a spec string.
+    bucketer: Optional[object] = None
 
     # streams per evaluation: CoordinateDescent must not wrap update/score
     # in an outer jit (same contract as the multihost coordinates)
     cd_jit = False
 
     def __post_init__(self):
+        from photon_ml_tpu.compile import resolve_bucketer
+
+        self.bucketer = resolve_bucketer(self.bucketer)
         self._margin_fn = jax.jit(
             lambda w, x: x @ self.norm.effective_coefficients(w)
             + self.norm.margin_shift(self.norm.effective_coefficients(w))
@@ -82,6 +90,7 @@ class StreamingFixedEffectCoordinate:
         self._vg = make_streaming_value_and_grad(
             self._live_source, self.problem.objective, self.norm,
             l2_weight=self._l2, prefetch_depth=self.prefetch_depth,
+            bucketer=self.bucketer,
         )
         # TRON streams one extra pass per CG Hessian-vector product (the
         # reference's one-treeAggregate-per-CG-step cost, TRON.scala:268-281)
@@ -89,6 +98,7 @@ class StreamingFixedEffectCoordinate:
             make_streaming_hvp(
                 self._live_source, self.problem.objective, self.norm,
                 l2_weight=self._l2, prefetch_depth=self.prefetch_depth,
+                bucketer=self.bucketer,
             )
             if self.problem.optimizer == OptimizerType.TRON else None
         )
@@ -152,10 +162,15 @@ class StreamingFixedEffectCoordinate:
         from photon_ml_tpu.optim.streaming import pipelined_device_chunks
 
         outs = []
-        for x, _, _, _ in pipelined_device_chunks(
-            self.source, real_dtype(), self.prefetch_depth
+        # canonicalized chunks carry weight-0 pad rows: slice each chunk's
+        # margins back to its real row count so the (N,) layout is unchanged
+        for (x, _, _, _), n_here in zip(
+            pipelined_device_chunks(
+                self.source, real_dtype(), self.prefetch_depth, self.bucketer
+            ),
+            self._chunk_sizes,
         ):
-            outs.append(self._margin_fn(coefficients, x))
+            outs.append(self._margin_fn(coefficients, x)[:n_here])
         return jnp.concatenate(outs) if outs else jnp.zeros((0,), real_dtype())
 
     def regularization_term(self, coefficients: Array) -> Array:

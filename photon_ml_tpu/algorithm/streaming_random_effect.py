@@ -70,6 +70,7 @@ def write_re_entity_blocks(
     memory_budget_bytes: Optional[int] = None,
     tensor_cache=None,
     cache_key: Optional[str] = None,
+    bucketer=None,
 ) -> "StreamingREManifest":
     """Split the random-effect dataset into entity blocks on disk.
 
@@ -91,7 +92,18 @@ def write_re_entity_blocks(
     :class:`StreamingRandomEffectCoordinate` detects a cache-resident
     manifest and spills its default run state to a private temp dir
     instead of the shared entry (pass ``state_root`` to control it).
+
+    With a ``bucketer`` (:class:`photon_ml_tpu.compile.ShapeBucketer` or a
+    spec string), every block's dims — entity lanes, active samples, local
+    dim, scoring rows, nnz width — are rounded up the canonical ladder
+    with masked padding BEFORE writing, so N blocks stream through ~log(N)
+    compiled solver executables instead of N. The ladder spec is recorded
+    in the manifest (callers including it in ``cache_key`` keep ladder
+    changes from serving stale block shapes).
     """
+    from photon_ml_tpu.compile import canonicalize_re_arrays, resolve_bucketer
+
+    bucketer = resolve_bucketer(bucketer)
     if tensor_cache is not None and cache_key is not None:
         hit = tensor_cache.get_dir(cache_key)
         if hit is not None:
@@ -105,6 +117,7 @@ def write_re_entity_blocks(
                     data, config, tmp,
                     block_entities=block_entities,
                     memory_budget_bytes=memory_budget_bytes,
+                    bucketer=bucketer,
                 ),
             )
             return StreamingREManifest.load(entry)
@@ -172,6 +185,10 @@ def write_re_entity_blocks(
         )
         ds = build_random_effect_dataset(filtered, config)
         payload = {f: np.asarray(getattr(ds, f)) for f in _DATASET_FIELDS}
+        if bucketer is not None:
+            # canonical ladder shapes: the budget below is checked on the
+            # PADDED slab — the padded slab is what becomes resident
+            payload = canonicalize_re_arrays(payload, bucketer)
         if memory_budget_bytes is not None and payload["x"].nbytes > memory_budget_bytes:
             raise ValueError(
                 f"block {i}: x-stack {payload['x'].nbytes}B exceeds the "
@@ -188,8 +205,11 @@ def write_re_entity_blocks(
         metas.append(
             dict(
                 file=f"block-{i:05d}.npz",
-                num_entities=int(ds.num_entities),
-                local_dim=int(ds.local_dim),
+                # padded lane/local-dim counts: the shapes the solver and the
+                # spilled coefficient stacks actually carry (padded lanes
+                # scatter nothing — no row's entity_pos points at them)
+                num_entities=int(payload["x"].shape[0]),
+                local_dim=int(payload["x"].shape[2]),
                 num_rows=int(len(row_sel)),
                 x_bytes=int(payload["x"].nbytes),
             )
@@ -203,6 +223,7 @@ def write_re_entity_blocks(
         vocab=list(data.id_vocabs[re_id]),
         random_effect_id=re_id,
         feature_shard_id=config.feature_shard_id,
+        ladder=(f"{bucketer.base}:{bucketer.growth:g}" if bucketer else None),
     )
     with open(os.path.join(out_dir, "manifest.json.tmp"), "w") as f:
         json.dump(manifest, f)
@@ -224,6 +245,10 @@ class StreamingREManifest:
     vocab: List[str]
     random_effect_id: str
     feature_shard_id: str
+    # "BASE:GROWTH" canonical-ladder spec the blocks were padded with at
+    # write time (photon_ml_tpu.compile), or None for natural shapes;
+    # absent in pre-ladder manifests (load() defaults it)
+    ladder: Optional[str] = None
 
     @classmethod
     def load(cls, path: str) -> "StreamingREManifest":
@@ -334,10 +359,13 @@ class BlockMeta:
 def _positions_of_dense(m: "BlockMeta") -> np.ndarray:
     """dense (block-local) entity id -> tensor position, -1 where absent.
     ``entity_pos`` is per ROW; only rows with a real tensor position carry
-    their entity's mapping (dropped-passive rows hold -1)."""
-    known = m.entity_pos >= 0
+    their entity's mapping (dropped-passive rows hold -1). In a
+    ladder-canonicalized block ``entity_pos`` carries -1 pad rows beyond
+    the real rows ``dense_ids`` covers — slice to the real extent first."""
+    entity_pos = m.entity_pos[: len(m.dense_ids)]
+    known = entity_pos >= 0
     pos_of_dense = np.full(len(m.entity_ids), -1, np.int32)
-    pos_of_dense[m.dense_ids[known]] = m.entity_pos[known]
+    pos_of_dense[m.dense_ids[known]] = entity_pos[known]
     return pos_of_dense
 
 
@@ -362,6 +390,66 @@ class SpilledREState:
         with open(path + ".tmp", "wb") as f:
             np.save(f, np.asarray(arr))
         os.replace(path + ".tmp", path)
+
+
+# ONE jitted update/score kernel shared by every block of every streaming
+# coordinate in the process: the block dataset rides through as a pytree
+# ARGUMENT and the solver configuration as hashable statics, so the jit
+# cache keys on (shapes, config) — ladder-canonicalized blocks
+# (write_re_entity_blocks bucketer) collapse onto ~log(N) compiled
+# executables ACROSS coordinates and grid combos, counted per site by
+# photon_ml_tpu.compile.compile_stats. w0 is donated: each block's
+# coefficient stack is loaded fresh from the spill and dead after the
+# solve, so the solver output aliases it in place. Built lazily so
+# PHOTON_DONATE set before first training still applies.
+_BLOCK_KERNEL_STATICS = ("task", "optimizer", "optimizer_config", "regularization")
+_BLOCK_UPDATE_JIT = None
+_BLOCK_SCORE_JIT = None
+
+
+def _block_coord(ds, task, optimizer, optimizer_config, regularization):
+    return RandomEffectCoordinate(
+        dataset=ds, task=task, optimizer=optimizer,
+        optimizer_config=optimizer_config, regularization=regularization,
+    )
+
+
+def _block_update(ds, local_resid, w0, **cfg):
+    global _BLOCK_UPDATE_JIT
+    if _BLOCK_UPDATE_JIT is None:
+        from photon_ml_tpu.compile import donation_enabled, instrumented_jit
+
+        def impl(ds, local_resid, w0, task, optimizer, optimizer_config,
+                 regularization):
+            return _block_coord(
+                ds, task, optimizer, optimizer_config, regularization
+            ).update(local_resid, w0)
+
+        _BLOCK_UPDATE_JIT = instrumented_jit(
+            impl,
+            site="streaming_re.block_update",
+            static_argnames=_BLOCK_KERNEL_STATICS,
+            donate_argnums=(2,) if donation_enabled() else (),
+        )
+    return _BLOCK_UPDATE_JIT(ds, local_resid, w0, **cfg)
+
+
+def _block_score(ds, w, **cfg):
+    global _BLOCK_SCORE_JIT
+    if _BLOCK_SCORE_JIT is None:
+        from photon_ml_tpu.compile import instrumented_jit
+
+        def impl(ds, w, task, optimizer, optimizer_config, regularization):
+            return _block_coord(
+                ds, task, optimizer, optimizer_config, regularization
+            ).score(w)
+
+        _BLOCK_SCORE_JIT = instrumented_jit(
+            impl,
+            site="streaming_re.block_score",
+            static_argnames=_BLOCK_KERNEL_STATICS,
+        )
+    return _BLOCK_SCORE_JIT(ds, w, **cfg)
 
 
 @dataclasses.dataclass
@@ -412,6 +500,32 @@ class StreamingRandomEffectCoordinate:
         self._shapes = [
             (b["num_entities"], b["local_dim"]) for b in self.manifest.blocks
         ]
+
+    def _update_fn(self, ds, local_resid, w0):
+        return _block_update(
+            ds, local_resid, w0,
+            task=self.task, optimizer=self.optimizer,
+            optimizer_config=self.optimizer_config,
+            regularization=self.regularization,
+        )
+
+    def _score_fn(self, ds, w):
+        return _block_score(
+            ds, w,
+            task=self.task, optimizer=self.optimizer,
+            optimizer_config=self.optimizer_config,
+            regularization=self.regularization,
+        )
+
+    def _padded_resid(self, local_resid: Array, ds: RandomEffectDataset) -> Array:
+        """Block residuals padded to the block's (ladder-canonical) row
+        count: padded slots are never gathered (row_index there is -1), so
+        zeros keep the solve exact while the residual SHAPE matches the
+        shared executable's signature."""
+        n_pad = ds.num_rows
+        if local_resid.shape[0] == n_pad:
+            return local_resid
+        return jnp.pad(local_resid, (0, n_pad - local_resid.shape[0]))
 
     # -- coordinate protocol ------------------------------------------------
     @property
@@ -466,7 +580,9 @@ class StreamingRandomEffectCoordinate:
                     resid_host = np.asarray(residual_offsets)
                 local_resid = jnp.asarray(resid_host[row_sel])
             w0 = jnp.asarray(state.block(i))
-            coefs, res = self._sub_for(ds).update(local_resid, w0)
+            coefs, res = self._update_fn(
+                ds, self._padded_resid(local_resid, ds), w0
+            )
             new_state.write(i, np.asarray(coefs))
             # pull the tracker to host NOW: keeping the vmapped OptResult
             # as device arrays would pin every block's buffers alive
@@ -478,7 +594,9 @@ class StreamingRandomEffectCoordinate:
         total = np.zeros(self.manifest.num_rows, real_dtype())
         for i, ds, row_sel, _ in self.manifest.iter_blocks(self.prefetch_depth):
             w = jnp.asarray(state.block(i))
-            total[row_sel] = np.asarray(self._sub_for(ds).score(w))
+            # ladder-padded blocks score their pad rows too (entity_pos -1
+            # -> 0); slice back to the block's real rows
+            total[row_sel] = np.asarray(self._score_fn(ds, w))[: len(row_sel)]
             del ds, w
         return jnp.asarray(total)
 

@@ -240,6 +240,24 @@ def main(argv: Optional[List[str]] = None) -> dict:
     logger = PhotonLogger(
         os.path.join(p.output_dir, f"photon-ml-tpu-mh-{mh.process_id}.log")
     )
+    from photon_ml_tpu.compile import compile_stats
+
+    compile_stats.install_xla_listeners()
+    if p.persistent_cache_dir:
+        # per-process subdir: hosts compile the same programs but must not
+        # race each other's cache files on a shared filesystem
+        from photon_ml_tpu import compat
+
+        cache_dir = os.path.join(
+            p.persistent_cache_dir, f"process-{mh.process_id}"
+        )
+        if compat.enable_persistent_cache(cache_dir):
+            logger.info(f"persistent XLA compilation cache: {cache_dir}")
+        else:
+            logger.warn(
+                "--persistent-cache requested but this jax has no "
+                "compilation-cache API; compiling uncached"
+            )
 
     unsupported = [
         flag for flag, on in (
@@ -592,6 +610,9 @@ def main(argv: Optional[List[str]] = None) -> dict:
             )
         mh.barrier(f"saved-{name}")
     logger.info(f"model saved to {out}")
+    from photon_ml_tpu.compile import compile_stats
+
+    logger.info(compile_stats.summary())
     logger.close()
     return {
         "objective_history": result.objective_history,

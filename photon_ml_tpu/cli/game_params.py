@@ -316,6 +316,16 @@ class GameTrainingParams:
     # config, so a re-run / warm-started grid over unchanged inputs skips
     # Avro decode + grouping + padding entirely
     tensor_cache_dir: Optional[str] = None
+    # persistent XLA compilation cache (photon_ml_tpu.compat shims): warm
+    # driver runs skip XLA compilation entirely — composes with
+    # --tensor-cache for a fully warm restart (cached tensors + cached
+    # executables)
+    persistent_cache_dir: Optional[str] = None
+    # canonical shape ladder (photon_ml_tpu.compile): "off" | "on" |
+    # "BASE:GROWTH" — dynamic dims (entity blocks/buckets, chunk rows)
+    # round up a geometric ladder with masked padding so N near-identical
+    # shapes share ~log(N) compiled solver executables
+    shape_canonicalization: str = "off"
     # non-"false": train the lambda grid through the traced-lambda grid API
     # (CoordinateDescent.run_grid — ONE compiled cycle serves every combo;
     # the batched G-lane vmapped variant this flag once selected lost every
@@ -391,6 +401,12 @@ class GameTrainingParams:
                 "--divergence-guard must be 'off', 'rollback', or "
                 f"'skip_cycle', got {self.divergence_guard!r}"
             )
+        try:
+            from photon_ml_tpu.compile import resolve_bucketer
+
+            resolve_bucketer(self.shape_canonicalization)
+        except ValueError as e:
+            errors.append(f"--shape-canonicalization: {e}")
         if self.streaming_random_effects:
             # loud scope fences: the streaming coordinate re-enters the host
             # per evaluation, so anything that wraps it in one XLA program
@@ -489,6 +505,15 @@ def build_training_parser() -> argparse.ArgumentParser:
            "(keyed by source file stats + ingest config): warm runs skip "
            "Avro decode + grouping + padding; any input/config change is "
            "a miss")
+    a("--persistent-cache", dest="persistent_cache_dir", default=None,
+      help="persistent XLA compilation cache dir: warm driver runs skip "
+           "compilation entirely (composes with --tensor-cache for a "
+           "fully warm restart)")
+    a("--shape-canonicalization", default="off",
+      help="round dynamic dims (entity blocks/buckets, chunk rows) up a "
+           "geometric ladder of canonical shapes with masked padding, so "
+           "N near-identical shapes share ~log(N) compiled executables: "
+           "off | on | BASE:GROWTH (e.g. 8:2)")
     a("--vmapped-grid", default="false",
       help="train the lambda grid through the shared-compile grid API (ONE "
            "compiled cycle serves every combo; lambda-only grids on plain "
@@ -561,6 +586,8 @@ def parse_training_params(argv: Optional[List[str]] = None) -> GameTrainingParam
             if ns.re_memory_budget_mb is not None else None
         ),
         tensor_cache_dir=ns.tensor_cache_dir,
+        persistent_cache_dir=ns.persistent_cache_dir,
+        shape_canonicalization=ns.shape_canonicalization,
         vmapped_grid=(
             "auto" if str(ns.vmapped_grid).lower() == "auto"
             else "true" if _truthy(ns.vmapped_grid) else "false"

@@ -138,6 +138,13 @@ class GameTrainingDriver:
     def __init__(self, params: GameTrainingParams, logger: Optional[PhotonLogger] = None):
         params.validate()
         self.params = params
+        from photon_ml_tpu.compile import compile_stats, resolve_bucketer
+
+        # the canonical shape ladder every dataset build below feeds through
+        # (None = off); compile telemetry is always on — the summary lands
+        # in the run log either way
+        self.bucketer = resolve_bucketer(params.shape_canonicalization)
+        compile_stats.install_xla_listeners()
         self._own_logger = logger is None
         self.logger = logger or PhotonLogger(
             os.path.join(params.output_dir, "photon-ml-tpu-game.log")
@@ -245,7 +252,9 @@ class GameTrainingDriver:
     def _ingest_cache_config(self) -> Dict[str, object]:
         """The ingest-config part of every tensor-cache key: anything that
         changes the decoded columns or the feature index assignment must
-        change the key (a config change is a MISS, never a stale hit)."""
+        change the key (a config change is a MISS, never a stale hit) —
+        including the canonical shape ladder, which changes the PADDED
+        tensors a hit would serve."""
         from photon_ml_tpu.io.tensor_cache import index_map_digest
 
         p = self.params
@@ -253,6 +262,10 @@ class GameTrainingDriver:
             "sections": p.feature_shard_sections,
             "intercepts": p.feature_shard_intercepts,
             "id_types": self._id_types(),
+            "ladder": (
+                f"{self.bucketer.base}:{self.bucketer.growth:g}"
+                if self.bucketer is not None else None
+            ),
             "index_maps": {
                 shard: index_map_digest(imap)
                 for shard, imap in sorted(self.shard_index_maps.items())
@@ -340,6 +353,7 @@ class GameTrainingDriver:
                     # budget must not silently pass BOTH sizing modes
                     block_entities=None if budget is not None else 1024,
                     memory_budget_bytes=budget,
+                    bucketer=self.bucketer,
                     tensor_cache=cache,
                     cache_key=(
                         cache.key_for(
@@ -369,7 +383,7 @@ class GameTrainingDriver:
                 )
 
                 self.bucketed_bundles[name] = BucketedDatasetBundle.build(
-                    self.train_data, cfg
+                    self.train_data, cfg, bucketer=self.bucketer
                 )
                 continue
             self.re_datasets[name] = build_random_effect_dataset(
@@ -1037,6 +1051,22 @@ class GameTrainingDriver:
     def _run_guarded(self) -> None:
         p = self.params
         prepare_output_dir(p.output_dir, p.delete_output_dir_if_exists)
+        if p.persistent_cache_dir:
+            from photon_ml_tpu import compat
+
+            if compat.enable_persistent_cache(p.persistent_cache_dir):
+                self.logger.info(
+                    f"persistent XLA compilation cache: {p.persistent_cache_dir}"
+                )
+            else:
+                self.logger.warn(
+                    "--persistent-cache requested but this jax has no "
+                    "compilation-cache API; compiling uncached"
+                )
+        if self.bucketer is not None:
+            self.logger.info(
+                f"shape canonicalization: {self.bucketer.describe()}"
+            )
         try:
             with self.timer.measure("prepare-feature-maps"):
                 self.prepare_feature_maps()
@@ -1060,6 +1090,13 @@ class GameTrainingDriver:
                             i,
                         )
             self.logger.info(self.timer.summary())
+            from photon_ml_tpu.compile import compile_stats
+
+            self.logger.info(compile_stats.summary())
+            if p.persistent_cache_dir and compile_stats.xla_cache_misses == 0:
+                self.logger.info(
+                    "persistent cache fully warm: zero new XLA compiles"
+                )
         finally:
             if self._own_logger:
                 self.logger.close()

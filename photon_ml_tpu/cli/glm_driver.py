@@ -139,6 +139,21 @@ class Driver:
         prepare_output_dir(p.output_dir, p.delete_output_dirs_if_exist)
         self.logger.info(f"job {p.job_name}: {p.task_type.value} via "
                          f"{p.optimizer_type.value}, lambdas={p.regularization_weights}")
+        from photon_ml_tpu.compile import compile_stats
+
+        compile_stats.install_xla_listeners()
+        if p.persistent_cache_dir:
+            from photon_ml_tpu import compat
+
+            if compat.enable_persistent_cache(p.persistent_cache_dir):
+                self.logger.info(
+                    f"persistent XLA compilation cache: {p.persistent_cache_dir}"
+                )
+            else:
+                self.logger.warn(
+                    "--persistent-cache requested but this jax has no "
+                    "compilation-cache API; compiling uncached"
+                )
         try:
             with self.timer.measure("preprocess"):
                 self.preprocess()
@@ -151,6 +166,11 @@ class Driver:
                 with self.timer.measure("diagnose"):
                     self.diagnose()
             self.logger.info(self.timer.summary())
+            self.logger.info(compile_stats.summary())
+            if p.persistent_cache_dir and compile_stats.xla_cache_misses == 0:
+                self.logger.info(
+                    "persistent cache fully warm: zero new XLA compiles"
+                )
         finally:
             if self._own_logger:
                 self.logger.close()
@@ -545,11 +565,13 @@ class Driver:
 
         with maybe_trace("glm-train"):
             if self.streaming_source is not None:
+                from photon_ml_tpu.compile import resolve_bucketer
                 from photon_ml_tpu.training import train_glm_grid_streaming
 
                 self.trained = train_glm_grid_streaming(
                     self.problem, self.streaming_source, self.norm,
                     p.regularization_weights,
+                    bucketer=resolve_bucketer(p.shape_canonicalization),
                 )
                 # the spilled chunks are dead weight once training completes
                 import shutil

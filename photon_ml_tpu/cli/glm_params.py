@@ -75,6 +75,13 @@ class GLMParams:
     # content-addressed cache of the spilled stream chunks (io/tensor_cache):
     # a warm run over unchanged inputs skips decode + re-spill entirely
     tensor_cache_dir: Optional[str] = None
+    # persistent XLA compilation cache (photon_ml_tpu.compat shims): a warm
+    # run skips compilation entirely — composes with --tensor-cache
+    persistent_cache_dir: Optional[str] = None
+    # canonical shape ladder (photon_ml_tpu.compile): "off" | "on" |
+    # "BASE:GROWTH" — stream-chunk row counts round up a geometric ladder
+    # so the tail chunk shares the other chunks' compiled partial
+    shape_canonicalization: str = "off"
     # obsolete on TPU (treeAggregate depth, kryo, min partitions) — accepted
     # for CLI compatibility, ignored with a note
     tree_aggregate_depth: int = 1
@@ -120,6 +127,12 @@ class GLMParams:
                     "--streaming-chunk-rows does not support --diagnostic-mode "
                     "(diagnostics need the in-memory batch)"
                 )
+        try:
+            from photon_ml_tpu.compile import resolve_bucketer
+
+            resolve_bucketer(self.shape_canonicalization)
+        except ValueError as e:
+            errors.append(f"--shape-canonicalization: {e}")
         if self.diagnostic_mode.runs_validate and self.validating_data_dir is None:
             errors.append(
                 f"diagnostic mode {self.diagnostic_mode.value} requires "
@@ -191,6 +204,13 @@ def build_parser() -> argparse.ArgumentParser:
       help="content-addressed on-disk cache of the spilled stream chunks "
            "(keyed by source file stats + ingest config): a warm "
            "--streaming-chunk-rows run skips decode + re-spill")
+    a("--persistent-cache", dest="persistent_cache_dir", default=None,
+      help="persistent XLA compilation cache dir: warm runs skip "
+           "compilation entirely (composes with --tensor-cache)")
+    a("--shape-canonicalization", dest="shape_canonicalization", default="off",
+      help="round stream-chunk row counts up a geometric ladder of "
+           "canonical shapes (masked padding; the tail chunk stops "
+           "compiling its own kernel): off | on | BASE:GROWTH")
     return p
 
 
@@ -226,6 +246,8 @@ def parse_from_command_line(argv: Optional[List[str]] = None) -> GLMParams:
         compute_variance=ns.compute_variance,
         streaming_chunk_rows=ns.streaming_chunk_rows,
         tensor_cache_dir=ns.tensor_cache_dir,
+        persistent_cache_dir=ns.persistent_cache_dir,
+        shape_canonicalization=ns.shape_canonicalization,
         use_kryo=ns.use_kryo,
         min_num_partitions=ns.min_num_partitions,
         tree_aggregate_depth=ns.tree_aggregate_depth,

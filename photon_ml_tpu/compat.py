@@ -16,6 +16,11 @@ Currently shimmed:
   * ``distributed_is_initialized`` — ``jax.distributed.is_initialized()``
     does not exist on older jax; fall back to probing the internal
     distributed global state for a live client.
+  * ``enable_persistent_cache`` — the persistent XLA compilation cache is
+    spelled three ways across jax versions (``jax_compilation_cache_dir``
+    config + tuning knobs, vs the experimental
+    ``compilation_cache.set_cache_dir``); one call resolves whichever this
+    jax has, so warm driver runs skip XLA compilation entirely.
 """
 
 from __future__ import annotations
@@ -72,6 +77,57 @@ def enable_x64():
         from jax.experimental import enable_x64 as _enable_x64
 
         return _enable_x64()
+
+
+def enable_persistent_cache(path: str) -> bool:
+    """Point jax's persistent XLA compilation cache at ``path``.
+
+    Modern jax: the ``jax_compilation_cache_dir`` config option, plus the
+    two tuning knobs that default to skipping small/fast entries — both
+    zeroed here, because the GLMix solver sites are exactly the many-small-
+    executables workload those defaults would exclude (a "warm" run that
+    still recompiles every solver kernel reports zero benefit). Older jax:
+    ``jax.experimental.compilation_cache.set_cache_dir``. Returns False
+    when no spelling exists on this jax (the caller logs and moves on —
+    an absent cache must never fail a training run).
+    """
+    import os
+
+    import jax
+
+    os.makedirs(path, exist_ok=True)
+    try:
+        jax.config.update("jax_compilation_cache_dir", path)
+    except AttributeError:
+        try:
+            from jax.experimental.compilation_cache import (
+                compilation_cache as _cc,
+            )
+
+            _cc.set_cache_dir(path)
+            return True
+        except (ImportError, AttributeError):
+            return False
+    # cache EVERYTHING: -1 disables the min-entry-size filter; 0 disables
+    # the min-compile-seconds filter (knobs absent on some versions)
+    for knob, value in (
+        ("jax_persistent_cache_min_entry_size_bytes", -1),
+        ("jax_persistent_cache_min_compile_time_secs", 0),
+    ):
+        try:
+            jax.config.update(knob, value)
+        except (AttributeError, ValueError):
+            pass  # knob not on this jax: defaults still cache solver-sized entries
+    try:
+        # jax LATCHES cache-used at the first compile of the process; a
+        # driver that touched the device before reaching this call (backend
+        # probe, data placement) would silently never cache without a reset
+        from jax._src import compilation_cache as _cc_internal
+
+        _cc_internal.reset_cache()
+    except (ImportError, AttributeError):
+        pass  # no latch on this jax: the config alone suffices
+    return True
 
 
 def ensure_cpu_collectives() -> None:

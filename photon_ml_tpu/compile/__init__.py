@@ -1,0 +1,61 @@
+"""Compile-once execution layer.
+
+Three pillars for keeping the steady-state solve loop free of compilation
+overhead (ISSUE 3; the Snap ML / DrJAX compile-amortization idea):
+
+  * **Shape canonicalization** (:mod:`.canonical`): a geometric ladder of
+    canonical shapes so N near-identical blocks/buckets/chunks hit ~log(N)
+    compiled executables, with masked padding the kernels treat as exact
+    no-ops.
+  * **Compile telemetry** (:mod:`.stats`): per-site trace/call counters
+    (:func:`instrumented_jit`) plus XLA persistent-cache hit/miss counts
+    and backend-compile seconds via ``jax.monitoring``.
+  * **Persistent compilation cache**: enabled through
+    :func:`photon_ml_tpu.compat.enable_persistent_cache` (version-gated
+    jax config shims) — warm driver runs skip XLA compilation entirely and
+    report it through the same telemetry.
+
+Buffer donation rides the same layer: :func:`donation_enabled` gates the
+``donate_argnums`` annotations on the coordinate-descent update/cycle
+functions and the streaming accumulators (``PHOTON_DONATE=0`` opts out for
+debugging use-after-donate reports).
+"""
+
+from __future__ import annotations
+
+import os
+
+from photon_ml_tpu.compile.canonical import (
+    ShapeBucketer,
+    canonicalize_re_arrays,
+    canonicalize_re_dataset,
+    pad_axis,
+    pad_glm_chunk,
+    resolve_bucketer,
+)
+from photon_ml_tpu.compile.stats import CompileStats, compile_stats, instrumented_jit
+
+_DONATE_ENV = "PHOTON_DONATE"
+
+
+def donation_enabled() -> bool:
+    """Whether hot-path jit sites annotate ``donate_argnums`` (default on;
+    ``PHOTON_DONATE=0`` disables, e.g. to rule donation out while
+    debugging a deleted-buffer error)."""
+    return os.environ.get(_DONATE_ENV, "1").strip().lower() not in (
+        "0", "false", "off", "no",
+    )
+
+
+__all__ = [
+    "CompileStats",
+    "ShapeBucketer",
+    "canonicalize_re_arrays",
+    "canonicalize_re_dataset",
+    "compile_stats",
+    "donation_enabled",
+    "instrumented_jit",
+    "pad_axis",
+    "pad_glm_chunk",
+    "resolve_bucketer",
+]

@@ -1,0 +1,249 @@
+"""Shape canonicalization: round dynamic dims onto a geometric ladder.
+
+The solver hot paths see a stream of NEAR-identical shapes — streaming-RE
+entity blocks, size buckets, FE row chunks, grid lanes — and every distinct
+shape costs a fresh trace + XLA compile. A :class:`ShapeBucketer` rounds
+each dynamic dim UP to a small geometric ladder (base * growth^k), so N
+distinct natural shapes collapse onto ~log(N) canonical shapes and the jit
+caches (and the persistent XLA cache) hit instead of compiling.
+
+Padding is MASKED with the conventions the kernels already honor:
+``weights == 0`` rows are no-ops in every weighted reduction, ``row_index /
+entity_pos / feat_idx / local_to_global == -1`` are masked gathers, and
+padded entity lanes are all-zero problems whose vmapped solve converges at
+iteration zero. Appended zeros contribute exactly +0.0 to every sum.
+
+Exactness by axis (pinned by tests/test_compile_layer.py):
+  * the pure BATCH axes — entity lanes E, scoring rows N, nnz width K —
+    are bit-identical padded vs not on every extent tried: no reduction
+    runs over them lane-to-lane.
+  * the sample axis M is a reduction extent of the gradient's x^T(..)
+    contraction: padding is bit-identical in the small-extent regime
+    (M <= ~16 at small D on the CPU backend, where XLA reduces the real
+    prefix in order) and drifts by ~1e-6 beyond it, where XLA retiles the
+    contraction. On TPU the (8, 128)-tiled layout already rounds these
+    extents up, so ladder padding there coincides with what the hardware
+    does anyway.
+  * the local feature dim D retiles the margin dot-general on most
+    extents — so D-padding is OPT-IN (``pad_local_dim=True``: maximal
+    executable sharing, coefficients equal to ~1e-6 instead of bitwise).
+
+Env control (the ``resolve_depth`` pattern of io/pipeline.py):
+``PHOTON_SHAPE_LADDER`` = ``off`` (default) | ``on`` | ``BASE:GROWTH``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+import os
+from typing import Optional
+
+import numpy as np
+
+_LADDER_ENV = "PHOTON_SHAPE_LADDER"
+DEFAULT_BASE = 8
+DEFAULT_GROWTH = 2.0
+
+
+@dataclasses.dataclass(frozen=True)
+class ShapeBucketer:
+    """Rounds sizes up to the geometric ladder base * growth^k."""
+
+    base: int = DEFAULT_BASE
+    growth: float = DEFAULT_GROWTH
+
+    def __post_init__(self):
+        if self.base < 1:
+            raise ValueError(f"ladder base must be >= 1, got {self.base}")
+        if self.growth <= 1.0:
+            raise ValueError(
+                f"ladder growth must be > 1 (the ladder must climb), "
+                f"got {self.growth}"
+            )
+
+    def canon(self, n: int) -> int:
+        """Smallest ladder rung >= n (n <= 0 passes through unchanged)."""
+        if n <= 0:
+            return n
+        size = self.base
+        while size < n:
+            # ceil keeps the ladder strictly climbing for any growth > 1
+            size = max(int(math.ceil(size * self.growth)), size + 1)
+        return size
+
+    def describe(self) -> str:
+        return f"ladder(base={self.base}, growth={self.growth:g})"
+
+
+def resolve_bucketer(
+    bucketer: "Optional[ShapeBucketer | str | bool]" = None,
+) -> Optional[ShapeBucketer]:
+    """Effective bucketer: an explicit value wins; ``None`` falls back to
+    ``PHOTON_SHAPE_LADDER``. Returns None when canonicalization is off.
+
+    Accepted spellings (flag values and the env var share them):
+    ``off``/``false``/``0`` -> None; ``on``/``true``/``1`` -> defaults;
+    ``BASE:GROWTH`` (e.g. ``16:1.5``) -> custom ladder.
+    """
+    if isinstance(bucketer, ShapeBucketer):
+        return bucketer
+    if bucketer is None:
+        raw = os.environ.get(_LADDER_ENV)
+        if raw is None:
+            return None
+        return resolve_bucketer(raw)
+    if isinstance(bucketer, bool):
+        return ShapeBucketer() if bucketer else None
+    text = str(bucketer).strip().lower()
+    if text in ("", "off", "false", "0", "none"):
+        return None
+    if text in ("on", "true", "1", "default"):
+        return ShapeBucketer()
+    if ":" in text:
+        base_s, growth_s = text.split(":", 1)
+        try:
+            return ShapeBucketer(base=int(base_s), growth=float(growth_s))
+        except ValueError as e:
+            raise ValueError(
+                f"bad shape-ladder spec {bucketer!r} (want BASE:GROWTH, "
+                f"e.g. 8:2): {e}"
+            ) from e
+    raise ValueError(
+        f"bad shape-ladder spec {bucketer!r} "
+        "(want off | on | BASE:GROWTH)"
+    )
+
+
+def pad_axis(a: np.ndarray, axis: int, size: int, fill) -> np.ndarray:
+    """``a`` grown to ``size`` along ``axis`` with ``fill`` (no-op when
+    already there). Host-side numpy — canonicalization happens at build
+    time, before tensors ship to the device."""
+    a = np.asarray(a)
+    have = a.shape[axis]
+    if have >= size:
+        return a
+    widths = [(0, 0)] * a.ndim
+    widths[axis] = (0, size - have)
+    return np.pad(a, widths, constant_values=fill)
+
+
+# fill value per RandomEffectDataset field: -1 marks masked index slots,
+# 0.0 is the no-op value/weight (weights==0 rows drop out of every
+# weighted reduction)
+_RE_FIELD_FILL = {
+    "row_index": -1,
+    "x": 0.0,
+    "labels": 0.0,
+    "base_offsets": 0.0,
+    "weights": 0.0,
+    "entity_pos": -1,
+    "feat_idx": -1,
+    "feat_val": 0.0,
+    "local_to_global": -1,
+}
+
+
+def canonicalize_re_arrays(
+    arrays: dict,
+    bucketer: ShapeBucketer,
+    pad_samples: bool = True,
+    pad_local_dim: bool = False,
+    pad_rows: bool = True,
+) -> dict:
+    """Canonicalize a host-side random-effect tensor dict (the
+    ``_DATASET_FIELDS`` layout of streaming blocks / dataset builds).
+
+    Axes:
+      * entity lanes E (always): row_index/x/labels/base_offsets/weights/
+        local_to_global axis 0 — padded lanes are all-zero problems.
+      * active samples M (``pad_samples``): axis 1 of the entity-major
+        stacks — padded slots carry weight 0 / row_index -1.
+      * local dim D_loc (``pad_local_dim``, OFF by default): x axis 2 +
+        local_to_global axis 1 — padded columns are all-zero features,
+        masked -1 in the scatter map, so their coefficients stay exactly
+        0. Off by default because XLA retiles the margin contraction when
+        D changes, costing bitwise reproducibility (~1e-6 coefficient
+        drift); turn on for maximal executable sharing when that trade is
+        acceptable.
+      * scoring rows N + nnz width K (``pad_rows``): entity_pos/feat_idx/
+        feat_val — padded rows have entity_pos -1 (score 0); consumers
+        slice score output back to the real row count.
+
+    Returns a NEW dict (inputs unchanged).
+    """
+    out = dict(arrays)
+    e_pad = bucketer.canon(arrays["x"].shape[0])
+    m_pad = bucketer.canon(arrays["x"].shape[1]) if pad_samples else arrays["x"].shape[1]
+    d_pad = (
+        bucketer.canon(arrays["x"].shape[2]) if pad_local_dim else arrays["x"].shape[2]
+    )
+    for f in ("row_index", "x", "labels", "base_offsets", "weights"):
+        out[f] = pad_axis(out[f], 0, e_pad, _RE_FIELD_FILL[f])
+        out[f] = pad_axis(out[f], 1, m_pad, _RE_FIELD_FILL[f])
+    out["x"] = pad_axis(out["x"], 2, d_pad, 0.0)
+    out["local_to_global"] = pad_axis(out["local_to_global"], 0, e_pad, -1)
+    out["local_to_global"] = pad_axis(out["local_to_global"], 1, d_pad, -1)
+    if pad_rows:
+        n_pad = bucketer.canon(arrays["entity_pos"].shape[0])
+        k_pad = bucketer.canon(arrays["feat_idx"].shape[1])
+        out["entity_pos"] = pad_axis(out["entity_pos"], 0, n_pad, -1)
+        for f in ("feat_idx", "feat_val"):
+            out[f] = pad_axis(out[f], 0, n_pad, _RE_FIELD_FILL[f])
+            out[f] = pad_axis(out[f], 1, k_pad, _RE_FIELD_FILL[f])
+    return out
+
+
+def canonicalize_re_dataset(ds, bucketer: Optional[ShapeBucketer]):
+    """A :class:`~photon_ml_tpu.data.game.RandomEffectDataset` with every
+    dynamic dim rounded up the ladder (``num_entities`` grows to the padded
+    lane count — padded lanes scatter nothing: their ``local_to_global`` is
+    all -1 and no row's ``entity_pos`` points at them). None bucketer is
+    the identity."""
+    if bucketer is None:
+        return ds
+    import jax.numpy as jnp
+
+    from photon_ml_tpu.data.game import RandomEffectDataset
+
+    if ds.projection_matrix is not None:
+        # RANDOM-projected local dims are already uniform (= projection k);
+        # padding D would desync the stored projection matrix
+        raise ValueError(
+            "shape canonicalization supports INDEX_MAP/IDENTITY datasets "
+            "(a RANDOM projection fixes the local dim already)"
+        )
+    fields = (
+        "row_index", "x", "labels", "base_offsets", "weights",
+        "entity_pos", "feat_idx", "feat_val", "local_to_global",
+    )
+    arrays = {f: np.asarray(getattr(ds, f)) for f in fields}
+    out = canonicalize_re_arrays(arrays, bucketer)
+    return RandomEffectDataset(
+        **{f: jnp.asarray(out[f]) for f in fields},
+        num_entities=int(out["x"].shape[0]),
+        global_dim=ds.global_dim,
+    )
+
+
+def pad_glm_chunk(
+    host: tuple, bucketer: Optional[ShapeBucketer]
+) -> tuple:
+    """A host ``(x, y, offsets, weights)`` GLM chunk with the row count
+    rounded up the ladder (weight-0 rows: exact no-ops in the additive
+    value/gradient/Hv/diag aggregations). None bucketer is the identity.
+    The tail chunk stops being its own compiled executable — every chunk
+    of a ladder-sized stream shares one."""
+    if bucketer is None:
+        return host
+    x, y, off, wt = host
+    n = x.shape[0]
+    n_pad = bucketer.canon(n)
+    if n_pad == n:
+        return host
+    return (
+        pad_axis(x, 0, n_pad, 0.0),
+        pad_axis(y, 0, n_pad, 0.0),
+        pad_axis(off, 0, n_pad, 0.0),
+        pad_axis(wt, 0, n_pad, 0.0),
+    )

@@ -163,7 +163,8 @@ def write_chunk_files(
 
 
 def pipelined_device_chunks(
-    source: ChunkedGLMSource, dtype, prefetch_depth: Optional[int] = None
+    source: ChunkedGLMSource, dtype, prefetch_depth: Optional[int] = None,
+    bucketer=None,
 ):
     """Yield ``(x, y, offsets, weights)`` device tuples per chunk through the
     async pipeline (io/pipeline.py): a background thread reads + page-faults
@@ -171,12 +172,22 @@ def pipelined_device_chunks(
     chunk's host->device transfer is issued while the CURRENT chunk's kernel
     runs (double-buffered H2D). Chunk order is the source order either way,
     and the additive aggregator algebra is order-identical — streamed passes
-    stay exact, pipelined or not. Depth <= 0 is the old synchronous loop."""
+    stay exact, pipelined or not. Depth <= 0 is the old synchronous loop.
+
+    With a ``bucketer`` (:class:`photon_ml_tpu.compile.ShapeBucketer`, or a
+    spec resolved via :func:`photon_ml_tpu.compile.resolve_bucketer`), every
+    chunk's row count is rounded up the canonical ladder with weight-0 rows
+    (exact no-ops in the additive aggregations) so the tail chunk — and any
+    other off-ladder chunking — reuses the same compiled partial instead of
+    compiling its own."""
+    from photon_ml_tpu.compile import pad_glm_chunk, resolve_bucketer
     from photon_ml_tpu.io.pipeline import (
         Prefetcher,
         device_pipelined,
         resolve_depth,
     )
+
+    bucketer = resolve_bucketer(bucketer)
 
     def to_host(chunk):
         n_c = len(chunk["y"])
@@ -191,12 +202,14 @@ def pipelined_device_chunks(
                 return np.array(a, copy=True)
             return np.asarray(a)
 
-        return (
+        host = (
             materialize(chunk["x"]),
             materialize(chunk["y"]),
             materialize(chunk.get("offsets", np.zeros(n_c, np.float32))),
             materialize(chunk.get("weights", np.ones(n_c, np.float32))),
         )
+        # canonicalize on the prefetch thread: padding is host-side numpy
+        return pad_glm_chunk(host, bucketer)
 
     def place(host):
         return tuple(jnp.asarray(a, dtype) for a in host)
@@ -221,33 +234,47 @@ def make_streaming_value_and_grad(
     l2_weight: float = 0.0,
     dtype=None,
     prefetch_depth: Optional[int] = None,
+    bucketer=None,
 ):
     """vg(w, l2_weight=...) -> (f, g) accumulated over chunks; one jitted
-    partial per chunk shape (all chunks but the tail share one executable,
-    and l2 is a traced arg so a lambda grid NEVER recompiles — build the
-    factory once, wrap per lambda). Chunks stream through the async
-    prefetch + double-buffered H2D pipeline (:func:`pipelined_device_chunks`);
-    the accumulation order is unchanged, so values stay exact."""
+    partial per chunk shape (all chunks but the tail share one executable —
+    and with a ``bucketer`` the tail is padded onto the ladder so EVERY
+    chunk shares one — and l2 is a traced arg so a lambda grid NEVER
+    recompiles: build the factory once, wrap per lambda). Chunks stream
+    through the async prefetch + double-buffered H2D pipeline
+    (:func:`pipelined_device_chunks`); the accumulation order is unchanged,
+    so values stay exact. The (f, g) accumulators are DONATED through the
+    per-chunk kernel (in-place accumulation: no fresh gradient buffer per
+    chunk)."""
+    from photon_ml_tpu.compile import donation_enabled, instrumented_jit
     from photon_ml_tpu.types import real_dtype
 
     dtype = dtype or real_dtype()
+    donate = (0, 1) if donation_enabled() else ()
 
-    @jax.jit
-    def partial_vg(w, x, y, off, wt):
+    def acc_vg(f, g, w, x, y, off, wt):
         batch = GLMBatch(DenseFeatures(x), y, off, wt)
-        return objective.value_and_grad(w, batch, norm, 0.0)
+        fv, gv = objective.value_and_grad(w, batch, norm, 0.0)
+        return f + fv, g + gv
 
-    @jax.jit
+    acc_vg = instrumented_jit(
+        acc_vg, site="streaming.vg_chunk", donate_argnums=donate
+    )
+
     def add_reg(f, g, w, l2):
         return f + 0.5 * l2 * jnp.sum(jnp.square(w)), g + l2 * w
+
+    add_reg = instrumented_jit(
+        add_reg, site="streaming.vg_reg", donate_argnums=donate
+    )
 
     def vg(w: Array, l2_weight=l2_weight) -> Tuple[Array, Array]:
         f = jnp.zeros((), dtype)
         g = jnp.zeros((source.dim,), dtype)
-        for x, y, off, wt in pipelined_device_chunks(source, dtype, prefetch_depth):
-            fv, gv = partial_vg(w, x, y, off, wt)
-            f = f + fv
-            g = g + gv
+        for x, y, off, wt in pipelined_device_chunks(
+            source, dtype, prefetch_depth, bucketer
+        ):
+            f, g = acc_vg(f, g, w, x, y, off, wt)
         return add_reg(f, g, w, jnp.asarray(l2_weight, dtype))
 
     return vg
@@ -258,7 +285,6 @@ def make_streaming_value_and_grad(
 # ---------------------------------------------------------------------------
 
 
-@jax.jit
 def _direction(pg, S, Y, rho, k, l1, pg_norm):
     m = S.shape[0]
     d = _two_loop_direction(pg, S, Y, rho, k, m)
@@ -270,7 +296,6 @@ def _direction(pg, S, Y, rho, k, l1, pg_norm):
     return d, deriv
 
 
-@jax.jit
 def _curvature_update(S, Y, rho, k, w_new, w, g_new, g, store_ok):
     m = S.shape[0]
     sv = w_new - w
@@ -282,6 +307,33 @@ def _curvature_update(S, Y, rho, k, w_new, w, g_new, g, store_ok):
     Y = jnp.where(store, Y.at[pos].set(yv), Y)
     rho = jnp.where(store, rho.at[pos].set(1.0 / jnp.maximum(sy, _EPS)), rho)
     return S, Y, rho, jnp.where(store, k + 1, k)
+
+
+def _host_lbfgs_kernels():
+    """The host-loop LBFGS step kernels, jitted once with compile telemetry.
+    The (m, D) curvature ring buffers are DONATED through the update — each
+    iteration's (S, Y, rho) aliases the previous iteration's buffers instead
+    of allocating fresh ones (the in-place ring the lax.while_loop kernel
+    gets for free, recovered for the host loop). Donation is resolved at
+    first use, not import, so ``PHOTON_DONATE`` set by a test/driver before
+    training still applies."""
+    global _DIRECTION_JIT, _CURVATURE_JIT
+    if _DIRECTION_JIT is None:
+        from photon_ml_tpu.compile import donation_enabled, instrumented_jit
+
+        _DIRECTION_JIT = instrumented_jit(
+            _direction, site="streaming.lbfgs_direction"
+        )
+        _CURVATURE_JIT = instrumented_jit(
+            _curvature_update,
+            site="streaming.lbfgs_curvature",
+            donate_argnums=(0, 1, 2) if donation_enabled() else (),
+        )
+    return _DIRECTION_JIT, _CURVATURE_JIT
+
+
+_DIRECTION_JIT = None
+_CURVATURE_JIT = None
 
 
 def lbfgs_minimize_streaming(
@@ -305,6 +357,7 @@ def lbfgs_minimize_streaming(
     dtype = w0.dtype
     dim = w0.shape[0]
     l1 = jnp.asarray(l1_weight, dtype)
+    direction_fn, curvature_fn = _host_lbfgs_kernels()
 
     def F_of(w, f):
         return f + l1 * jnp.sum(jnp.abs(w))
@@ -348,7 +401,7 @@ def lbfgs_minimize_streaming(
     it = 0
     while reason == 0:
         pg = reduced_pg(w, g)
-        d, deriv = _direction(pg, S, Y, rho, k, l1, pg_norm)
+        d, deriv = direction_fn(pg, S, Y, rho, k, l1, pg_norm)
         xi = jnp.where(w != 0.0, jnp.sign(w), jnp.sign(-pg))
         d_norm = float(jnp.linalg.norm(d))
         t = 1.0 / max(d_norm, 1.0) if int(k) == 0 else 1.0
@@ -365,7 +418,7 @@ def lbfgs_minimize_streaming(
                 break
             t *= 0.5
 
-        S, Y, rho, k = _curvature_update(
+        S, Y, rho, k = curvature_fn(
             S, Y, rho, k, w_new, w, g_new, g, jnp.asarray(ls_ok)
         )
         if ls_ok:
@@ -406,25 +459,36 @@ def make_streaming_hvp(
     l2_weight: float = 0.0,
     dtype=None,
     prefetch_depth: Optional[int] = None,
+    bucketer=None,
 ):
     """hvp(w, v, l2_weight=...) -> H(w) v accumulated over chunks — the
     chunked HessianVectorAggregator (HessianVectorAggregator.scala:90-116
     algebra is additive over rows, so per-chunk partials sum exactly).
-    One jitted partial per chunk shape, like the value+grad factory; chunks
-    stream through the same prefetch + double-buffered H2D pipeline."""
+    One jitted partial per chunk shape (one total with a ``bucketer``),
+    like the value+grad factory; chunks stream through the same prefetch +
+    double-buffered H2D pipeline, and the Hv accumulator is donated
+    through the per-chunk kernel."""
+    from photon_ml_tpu.compile import donation_enabled, instrumented_jit
     from photon_ml_tpu.types import real_dtype
 
     dtype = dtype or real_dtype()
 
-    @jax.jit
-    def partial_hvp(w, v, x, y, off, wt):
+    def acc_hvp(hv, w, v, x, y, off, wt):
         batch = GLMBatch(DenseFeatures(x), y, off, wt)
-        return objective.hessian_vector(w, v, batch, norm, 0.0)
+        return hv + objective.hessian_vector(w, v, batch, norm, 0.0)
+
+    acc_hvp = instrumented_jit(
+        acc_hvp,
+        site="streaming.hvp_chunk",
+        donate_argnums=(0,) if donation_enabled() else (),
+    )
 
     def hvp(w: Array, v: Array, l2_weight=l2_weight) -> Array:
         hv = jnp.zeros((source.dim,), dtype)
-        for x, y, off, wt in pipelined_device_chunks(source, dtype, prefetch_depth):
-            hv = hv + partial_hvp(w, v, x, y, off, wt)
+        for x, y, off, wt in pipelined_device_chunks(
+            source, dtype, prefetch_depth, bucketer
+        ):
+            hv = acc_hvp(hv, w, v, x, y, off, wt)
         return hv + jnp.asarray(l2_weight, dtype) * v
 
     return hvp
@@ -601,19 +665,39 @@ def streaming_hessian_diagonal(
     w: Array,
     l2_weight: float = 0.0,
     prefetch_depth: Optional[int] = None,
+    bucketer=None,
 ) -> Array:
     """diag(H) accumulated over chunks (additive data part + l2 once) —
-    the coefficient-variance pass for out-of-core fits."""
+    the coefficient-variance pass for out-of-core fits. The accumulator is
+    donated through the per-chunk kernel; the kernel is jitted once at
+    module scope so repeated save-time passes reuse it."""
+    from photon_ml_tpu.compile import donation_enabled, instrumented_jit
 
-    @jax.jit
-    def partial_diag(w, x, y, off, wt):
-        batch = GLMBatch(DenseFeatures(x), y, off, wt)
-        return objective.hessian_diagonal(w, batch, norm, 0.0)
+    global _DIAG_JIT
+    if _DIAG_JIT is None:
+
+        def acc_diag(diag, w, x, y, off, wt, norm, objective):
+            batch = GLMBatch(DenseFeatures(x), y, off, wt)
+            return diag + objective.hessian_diagonal(w, batch, norm, 0.0)
+
+        _DIAG_JIT = instrumented_jit(
+            acc_diag,
+            site="streaming.hessian_diag_chunk",
+            # the objective is a frozen (hashable) bundle -> static; the
+            # normalization context is a pytree and rides as a traced arg
+            static_argnames=("objective",),
+            donate_argnums=(0,) if donation_enabled() else (),
+        )
 
     diag = jnp.zeros((source.dim,), w.dtype)
-    for x, y, off, wt in pipelined_device_chunks(source, w.dtype, prefetch_depth):
-        diag = diag + partial_diag(w, x, y, off, wt)
+    for x, y, off, wt in pipelined_device_chunks(
+        source, w.dtype, prefetch_depth, bucketer
+    ):
+        diag = _DIAG_JIT(diag, w, x, y, off, wt, norm, objective=objective)
     return diag + l2_weight
+
+
+_DIAG_JIT = None
 
 
 def streaming_summarize(source: ChunkedGLMSource):

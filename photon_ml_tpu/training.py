@@ -113,6 +113,7 @@ def train_glm_grid_streaming(
     source,
     norm: NormalizationContext,
     reg_weights: Sequence[float],
+    bucketer=None,
 ) -> TrainedModelList:
     """Warm-started lambda grid over CHUNK-STREAMED data (out-of-core):
     same high-to-low warm-start chain as :func:`train_glm_grid`, but each
@@ -122,6 +123,10 @@ def train_glm_grid_streaming(
     LBFGS/OWL-QN stream one pass per evaluation; TRON additionally streams
     one pass per CG Hessian-vector product — the reference's cost profile
     exactly (one treeAggregate per CG step, TRON.scala:268-281).
+
+    ``bucketer`` (photon_ml_tpu.compile; None = PHOTON_SHAPE_LADDER) rounds
+    chunk row counts up the canonical ladder so the tail chunk reuses the
+    other chunks' compiled partial instead of compiling its own.
     """
     from photon_ml_tpu.optim.problem import _split_reg_weight, variances_from_hessian_diag
     from photon_ml_tpu.optim.streaming import (
@@ -144,9 +149,9 @@ def train_glm_grid_streaming(
     # ONE factory for the whole grid: l2 rides through as an argument, so
     # the per-chunk kernel compiles once (the streaming counterpart of the
     # in-memory path's module-level jitted _solve)
-    vg_base = make_streaming_value_and_grad(source, obj, norm)
+    vg_base = make_streaming_value_and_grad(source, obj, norm, bucketer=bucketer)
     hvp_base = (
-        make_streaming_hvp(source, obj, norm)
+        make_streaming_hvp(source, obj, norm, bucketer=bucketer)
         if problem.optimizer == OptimizerType.TRON else None
     )
     weights, models, results = [], [], []
@@ -165,7 +170,9 @@ def train_glm_grid_streaming(
         w = res.coefficients
         variances = None
         if problem.compute_variance:
-            diag = streaming_hessian_diagonal(source, obj, norm, w, float(l2))
+            diag = streaming_hessian_diagonal(
+                source, obj, norm, w, float(l2), bucketer=bucketer
+            )
             variances = variances_from_hessian_diag(diag)
         models.append(
             GeneralizedLinearModel(Coefficients(w, variances), problem.task)

@@ -1,0 +1,92 @@
+"""tools/lint_jit_sites.py: the bare-jit linter, enforced from tier-1.
+
+Hot-path ``jax.jit`` sites must either carry donation/static annotations
+(usually via photon_ml_tpu.compile.instrumented_jit), a ``# jit-ok:``
+justification, or an explicit ALLOWLIST entry — the compile-once layer's
+guarantee that new code does not silently reintroduce un-donated,
+un-measured jit sites.
+"""
+
+import os
+import subprocess
+import sys
+import textwrap
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+TOOL = os.path.join(REPO, "tools", "lint_jit_sites.py")
+
+sys.path.insert(0, os.path.join(REPO, "tools"))
+import lint_jit_sites  # noqa: E402
+
+
+def _violations(src):
+    return list(lint_jit_sites.check_source("<test>", textwrap.dedent(src)))
+
+
+def test_bare_jit_call_flagged():
+    assert _violations("import jax\nf = jax.jit(lambda x: x)\n")
+
+
+def test_bare_jit_decorator_flagged():
+    assert _violations(
+        "import jax\n@jax.jit\ndef f(x):\n    return x\n"
+    )
+
+
+def test_bare_partial_jit_flagged():
+    assert _violations(
+        "import jax, functools\n"
+        "@functools.partial(jax.jit)\ndef f(x):\n    return x\n"
+    )
+
+
+def test_annotated_sites_pass():
+    assert not _violations(
+        "import jax\nf = jax.jit(lambda x: x, donate_argnums=(0,))\n"
+    )
+    assert not _violations(
+        "import jax\ng = jax.jit(lambda x: x, static_argnames=('n',))\n"
+    )
+    assert not _violations(
+        "import jax, functools\n"
+        "@functools.partial(jax.jit, donate_argnums=(0,))\n"
+        "def f(x):\n    return x\n"
+    )
+
+
+def test_jit_ok_tag_allows():
+    assert not _violations(
+        "import jax\nf = jax.jit(lambda x: x)  # jit-ok: read-only oracle\n"
+    )
+
+
+def test_instrumented_jit_not_flagged():
+    # instrumented_jit is the blessed path: it is not a jax.jit call at the
+    # call site, and its kwargs carry the annotations through
+    assert not _violations(
+        "from photon_ml_tpu.compile import instrumented_jit\n"
+        "f = instrumented_jit(lambda x: x, site='t')\n"
+    )
+
+
+def test_qualname_resolution():
+    src = (
+        "import jax\n"
+        "class C:\n"
+        "    def m(self):\n"
+        "        return jax.jit(lambda x: x)\n"
+    )
+    (lineno, msg), = _violations(src)
+    assert "<test>:C.m" in msg and lineno == 4
+
+
+def test_package_is_clean():
+    """THE gate: photon_ml_tpu carries no unannotated, unjustified jit
+    sites (and no stale allowlist entries)."""
+    proc = subprocess.run(
+        [sys.executable, TOOL],
+        capture_output=True,
+        text=True,
+        cwd=REPO,
+    )
+    assert proc.returncode == 0, f"lint_jit_sites violations:\n{proc.stdout}"

@@ -12,6 +12,7 @@ import pytest
 import jax.numpy as jnp
 
 from game_test_utils import make_glmix_data
+from tolerances import assert_allclose
 
 from photon_ml_tpu.algorithm import (
     CoordinateDescent,
@@ -146,13 +147,19 @@ class TestStreamingEquivalence:
         r_p = self._cd(glmix, plain).run(
             num_iterations=2, num_rows=glmix.num_rows
         )
-        np.testing.assert_allclose(
+        # shared per-dtype policy (tests/tolerances.py): both runs compute
+        # in f32 and iterate 25 LBFGS steps x 2 descent cycles — ulp-level
+        # reduction-order differences between the blocked and in-memory
+        # layouts compound, which is exactly the "solver" regime. The
+        # histories are python-float lists, so name the computation dtype.
+        assert_allclose(
             np.asarray(r_s.objective_history),
-            np.asarray(r_p.objective_history), rtol=5e-4,
+            np.asarray(r_p.objective_history),
+            kind="solver", dtype=np.float32,
         )
-        np.testing.assert_allclose(
+        assert_allclose(
             np.asarray(r_s.total_scores), np.asarray(r_p.total_scores),
-            rtol=5e-3, atol=5e-4,
+            kind="solver",
         )
 
     def test_entity_export_matches_plain(self, glmix, manifest):
@@ -188,8 +195,8 @@ class TestStreamingEquivalence:
         for e, pos in pos_of.items():
             # block-grouped lanes reduce in a different order than the one
             # global vmap — f32 trajectory wiggle needs the looser bound
-            np.testing.assert_allclose(
-                means_s[vocab[e]], glob[pos], rtol=2e-3, atol=1e-4
+            assert_allclose(
+                means_s[vocab[e]], glob[pos], kind="solver"
             )
 
     def test_spilled_state_on_disk_between_updates(self, glmix, manifest):
