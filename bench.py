@@ -1135,10 +1135,136 @@ def _bench_game5(extra, on_tpu):
     }
 
 
+def _bench_compaction(extra, on_tpu):
+    """Convergence-compacted solve scheduler (optim/scheduler.py) on a
+    SKEWED convergence distribution — a few badly-conditioned entities next
+    to many easy ones, the GLMix shape SURVEY §7.3 calls out: one-shot
+    vmapping burns every lane until the slowest converges; the scheduler
+    chunks the solve and repacks active lanes onto the ladder. Measures
+    saved lane-iterations, wall-clock vs the one-shot kernel, bitwise
+    equality, and ladder executable reuse (zero extra XLA compiles after
+    the first compaction step, via CompileStats)."""
+    import jax
+    import jax.numpy as jnp
+
+    from photon_ml_tpu.algorithm.random_effect import entity_lane_fns
+    from photon_ml_tpu.compile import compile_stats
+    from photon_ml_tpu.ops.regularization import RegularizationContext
+    from photon_ml_tpu.optim.common import OptimizerConfig
+    from photon_ml_tpu.optim.scheduler import (
+        SolveSchedule,
+        compacted_solve,
+        solve_stats,
+    )
+    from photon_ml_tpu.types import OptimizerType, TaskType
+
+    E = 2048 if on_tpu else 512
+    M, D, hard = 32, 16, 8
+    rng = np.random.default_rng(11)
+    x = rng.normal(size=(E, M, D)).astype(np.float32)
+    # skew: a handful of ill-conditioned straggler lanes (big feature scale
+    # -> big curvature spread -> 2-4x the iterations of the easy lanes,
+    # which the L2 weight below makes converge within the FIRST chunk)
+    x[:hard] *= np.geomspace(1.0, 64.0, D).astype(np.float32)
+    w_true = (rng.normal(size=(E, D)) * 0.5).astype(np.float32)
+    z = np.einsum("emd,ed->em", x.astype(np.float64), w_true)
+    y = (1.0 / (1.0 + np.exp(-z)) > rng.random((E, M))).astype(np.float32)
+    data = tuple(
+        jnp.asarray(a)
+        for a in (x, y, np.zeros((E, M), np.float32), np.ones((E, M), np.float32))
+    )
+    w0 = jnp.zeros((E, D), jnp.float32)
+
+    task = TaskType.LOGISTIC_REGRESSION
+    opt = OptimizerType.LBFGS
+    cfg = OptimizerConfig(max_iterations=120, tolerance=1e-7)
+    reg = RegularizationContext.l2(1.0)
+    kw = dict(task=task, optimizer=opt, optimizer_config=cfg, regularization=reg)
+
+    solve_one, *_ = entity_lane_fns(task, opt, cfg, reg)
+    one_shot = jax.jit(jax.vmap(solve_one))
+    ref = jax.block_until_ready(one_shot(*data, w0))  # compile + warm
+    reps = 3
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        ref = one_shot(*data, w0)
+    jax.block_until_ready(ref)
+    t_one = (time.perf_counter() - t0) / reps
+
+    schedule = SolveSchedule(chunk_size=16)
+    sites = ("scheduler.init", "scheduler.chunk",
+             "scheduler.compact", "scheduler.scatter")
+    traces_cold = {s: compile_stats.traces_of(s) for s in sites}
+    solve_stats.reset()
+    res = compacted_solve(data, w0, schedule=schedule, label="bench", **kw)
+    jax.block_until_ready(res.coefficients)
+    # ladder reuse WITHIN the first solve: one init + one full-batch chunk
+    # + the first compacted rung's chunk/compact/scatter — every compaction
+    # step after the first must reuse those executables, so exactly 5 new
+    # traces appear (asserted below as zero EXTRA compiles)
+    first_decay = " -> ".join(
+        f"{c.active_lanes}/{c.batch_lanes}@{c.limit}"
+        for c in solve_stats.snapshot()[-1].chunks
+    )
+    extra_compiles = (
+        sum(compile_stats.traces_of(s) - traces_cold[s] for s in sites) - 5
+    )
+    traces_warm = {s: compile_stats.traces_of(s) for s in sites}
+    solve_stats.reset()
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        res = compacted_solve(data, w0, schedule=schedule, label="bench", **kw)
+    jax.block_until_ready(res.coefficients)
+    t_comp = (time.perf_counter() - t0) / reps
+    # steady state: identical warm solves add zero traces at any site
+    extra_compiles += sum(
+        compile_stats.traces_of(s) - traces_warm[s] for s in sites
+    )
+
+    bitwise = all(
+        np.array_equal(np.asarray(a), np.asarray(b), equal_nan=True)
+        for a, b in zip(res[:7], ref[:7])
+        if a is not None
+    )
+    ledger = solve_stats.totals()
+    saved = ledger["saved_lane_iterations"] // reps
+    _log(
+        f"compaction: E={E} (hard={hard}) one-shot {t_one*1e3:.1f}ms vs "
+        f"compacted {t_comp*1e3:.1f}ms ({t_one/max(t_comp,1e-9):.2f}x); "
+        f"saved {saved} lane-iterations/solve "
+        f"({100*saved/max(ledger['baseline_lane_iterations']//reps,1):.1f}%), "
+        f"bitwise={bitwise}, extra compiles after first compaction={extra_compiles}"
+    )
+    _log(f"compaction: first-solve active-lane decay: {first_decay}")
+    _log(solve_stats.summary())
+    if not bitwise:
+        raise AssertionError("compacted solve is not bitwise-equal to one-shot")
+    if saved <= 0:
+        raise AssertionError(f"no lane-iterations saved ({saved})")
+    if extra_compiles != 0:
+        raise AssertionError(
+            f"{extra_compiles} extra XLA compiles after the first compaction "
+            "step — ladder reuse regressed"
+        )
+    extra["compaction_oneshot_ms"] = round(t_one * 1e3, 2)
+    extra["compaction_compacted_ms"] = round(t_comp * 1e3, 2)
+    extra["compaction_speedup"] = round(t_one / max(t_comp, 1e-9), 3)
+    extra["compaction_saved_lane_iters_per_solve"] = int(saved)
+    extra["compaction_saved_pct"] = round(
+        100.0 * saved / max(ledger["baseline_lane_iterations"] // reps, 1), 1
+    )
+    extra["compaction_bitwise_equal"] = bool(bitwise)
+    extra["compaction_extra_compiles_after_first"] = int(extra_compiles)
+    extra["compaction_config"] = {
+        "entities": E, "hard": hard, "samples": M, "dim": D,
+        "chunk": schedule.chunk_size, "max_iter": cfg.max_iterations,
+    }
+
+
 SECTION_ORDER = (
     "dense", "sparse", "game", "game5", "grid",
-    "streaming", "streaming_pipeline", "compile_reuse", "perhost",
-    "scoring", "ingest",
+    "streaming", "streaming_pipeline", "compile_reuse", "compaction",
+    "perhost", "scoring", "ingest",
 )
 # orchestrator per-section deadlines (s): generous — tunnel compiles are slow,
 # and hitting a deadline DETACHES the child (never kills: r3 claim-orphan
@@ -1157,9 +1283,24 @@ def _dense_data():
     return x_h, y_h
 
 
+# traceback signatures of a wedged device client: once one section dies
+# this way, every later device section in the SAME process dies identically
+# (r5 self-capture post-mortem) — record the root cause once, short entries
+# after, instead of N duplicate tracebacks polluting the JSON tail
+_WEDGE_SIGNATURES = ("UNAVAILABLE", "TPU device error", "DEADLINE_EXCEEDED")
+
+
 def _run_sections(names, extra, errors, on_tpu, state=None, after=None):
-    """Run the named bench sections in-process; returns the dense value."""
+    """Run the named bench sections in-process; returns the dense value.
+
+    Per-section failure isolation: a section that raises records its
+    traceback under ``errors[name]`` and the remaining sections still run.
+    A DEVICE-WEDGE failure (UNAVAILABLE — the client is dead for the whole
+    process) is recorded in full ONCE; later sections still run (they may
+    be host-only, e.g. ingest) but a repeat of the same signature degrades
+    to a one-line pointer at the wedging section."""
     value = 0.0
+    wedged_by = None  # (section, signature) of the first wedge traceback
     for name in names:
         try:
             if name == "dense":
@@ -1185,6 +1326,8 @@ def _run_sections(names, extra, errors, on_tpu, state=None, after=None):
                 _bench_streaming_pipeline(extra, on_tpu)
             elif name == "compile_reuse":
                 _bench_compile_reuse(extra, on_tpu)
+            elif name == "compaction":
+                _bench_compaction(extra, on_tpu)
             elif name == "perhost":
                 _bench_perhost(extra, on_tpu)
             elif name == "scoring":
@@ -1192,7 +1335,20 @@ def _run_sections(names, extra, errors, on_tpu, state=None, after=None):
             elif name == "ingest":
                 _bench_ingest(extra)
         except Exception:
-            errors[name] = traceback.format_exc(limit=3)
+            tb = traceback.format_exc(limit=3)
+            sig = next((s for s in _WEDGE_SIGNATURES if s in tb), None)
+            if wedged_by is not None and sig == wedged_by[1]:
+                # dedup ONLY an identical signature: a different failure
+                # mode after a wedge is new information and keeps its
+                # full traceback
+                errors[name] = (
+                    f"device client wedged ({sig} — same signature as "
+                    f"section {wedged_by[0]!r}, see its traceback)"
+                )
+            else:
+                errors[name] = tb
+                if sig is not None and wedged_by is None:
+                    wedged_by = (name, sig)
         if after is not None:
             after()
     return value
@@ -1311,6 +1467,12 @@ def _run_isolated_sections(names, extra, errors, state, save_partial):
 
 
 def main():
+    if "--list-sections" in sys.argv:
+        # enumerate sections WITHOUT importing jax or any accelerator path
+        # (smoke-testable everywhere, incl. hosts with no backend at all)
+        for name in SECTION_ORDER:
+            print(name)
+        return
     if "--section" in sys.argv:
         # plain return, NOT sys.exit: SystemExit would be caught by the
         # __main__ BaseException fence and append a bogus fatal JSON line

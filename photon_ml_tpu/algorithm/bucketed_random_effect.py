@@ -177,8 +177,21 @@ class BucketedRandomEffectCoordinate:
     # mesh (DistributedRandomEffectSolver per bucket): bucketing handles the
     # size skew, sharding handles the scale — the two axes compose
     mesh_ctx: Optional[object] = None  # parallel.mesh.MeshContext
+    # convergence-compaction schedule (optim.scheduler.SolveSchedule, None =
+    # one-shot): each bucket's vmapped solve runs chunked with active-lane
+    # repacking — bucketing fixes the PADDING waste of skewed entity sizes,
+    # compaction fixes the ITERATION waste of skewed convergence within a
+    # bucket; the two compose per bucket. Scheduled buckets re-enter the
+    # host between chunks, so the coordinate opts out of the outer CD jit.
+    solve_schedule: Optional[object] = None
 
     def __post_init__(self):
+        if self.solve_schedule is not None and self.mesh_ctx is not None:
+            raise ValueError(
+                "solve compaction gathers active lanes host-side and cannot "
+                "compose with mesh-sharded bucket solves; drop mesh_ctx or "
+                "solve_schedule"
+            )
         if self.bundle is None:
             self.bundle = BucketedDatasetBundle.build(
                 self.data, self.config, self.max_buckets, self.bucketer
@@ -195,9 +208,15 @@ class BucketedRandomEffectCoordinate:
                 optimizer=self.optimizer,
                 optimizer_config=self.optimizer_config,
                 regularization=self.regularization,
+                solve_schedule=self.solve_schedule,
+                solve_label=f"bucket{i}",
             )
-            for ds in b.datasets
+            for i, ds in enumerate(b.datasets)
         ]
+        if self.solve_schedule is not None:
+            # per-bucket chunk pauses re-enter the host: the outer
+            # CoordinateDescent jit must call update raw
+            self.cd_jit = False
         self._solvers = None
         if self.mesh_ctx is not None:
             from photon_ml_tpu.parallel.distributed import (

@@ -470,6 +470,13 @@ class StreamingRandomEffectCoordinate:
     # PHOTON_PREFETCH_DEPTH (default 2). Results are bit-identical either
     # way (tests/test_pipeline.py) — this only moves I/O off the solve path.
     prefetch_depth: Optional[int] = None
+    # convergence-compaction schedule (optim.scheduler.SolveSchedule, None =
+    # one-shot): each block's vmapped solve runs chunked with active-lane
+    # repacking through the scheduler's PROCESS-SHARED chunk kernels — since
+    # ladder-canonicalized blocks share shapes, compacted batches from every
+    # block reuse the same executables, and compaction composes with the
+    # prefetch pipeline (block k+1 prefetches while block k's chunks run)
+    solve_schedule: Optional[object] = None
 
     # streams per evaluation — CoordinateDescent must call update/score raw
     cd_jit = False
@@ -537,13 +544,18 @@ class StreamingRandomEffectCoordinate:
             dir=os.path.join(self.state_root, "init"), shapes=self._shapes
         )
 
-    def _sub_for(self, ds: RandomEffectDataset) -> RandomEffectCoordinate:
+    def _sub_for(self, ds: RandomEffectDataset,
+                 block: Optional[int] = None) -> RandomEffectCoordinate:
         return RandomEffectCoordinate(
             dataset=ds,
             task=self.task,
             optimizer=self.optimizer,
             optimizer_config=self.optimizer_config,
             regularization=self.regularization,
+            solve_schedule=self.solve_schedule,
+            solve_label=(
+                "streaming-re" if block is None else f"streaming-re[block {block}]"
+            ),
         )
 
     def update(
@@ -580,9 +592,18 @@ class StreamingRandomEffectCoordinate:
                     resid_host = np.asarray(residual_offsets)
                 local_resid = jnp.asarray(resid_host[row_sel])
             w0 = jnp.asarray(state.block(i))
-            coefs, res = self._update_fn(
-                ds, self._padded_resid(local_resid, ds), w0
-            )
+            if self.solve_schedule is not None:
+                # compacted path: the per-block coordinate routes through
+                # the scheduler's process-shared chunk kernels (same-ladder
+                # blocks reuse executables; the prefetch pipeline keeps
+                # feeding blocks while chunks run)
+                coefs, res = self._sub_for(ds, block=i).update(
+                    self._padded_resid(local_resid, ds), w0
+                )
+            else:
+                coefs, res = self._update_fn(
+                    ds, self._padded_resid(local_resid, ds), w0
+                )
             new_state.write(i, np.asarray(coefs))
             # pull the tracker to host NOW: keeping the vmapped OptResult
             # as device arrays would pin every block's buffers alive

@@ -326,6 +326,12 @@ class GameTrainingParams:
     # round up a geometric ladder with masked padding so N near-identical
     # shapes share ~log(N) compiled solver executables
     shape_canonicalization: str = "off"
+    # convergence-compacted random-effect solves (optim/scheduler.py):
+    # "off" | "on" | CHUNK — the vmapped per-entity solve runs in chunks of
+    # CHUNK iterations, unconverged lanes are repacked into ladder-sized
+    # batches between chunks, results are BITWISE-equal to the one-shot
+    # kernel. None defers to PHOTON_SOLVE_CHUNK (default off).
+    solve_compaction: Optional[str] = None
     # non-"false": train the lambda grid through the traced-lambda grid API
     # (CoordinateDescent.run_grid — ONE compiled cycle serves every combo;
     # the batched G-lane vmapped variant this flag once selected lost every
@@ -407,6 +413,29 @@ class GameTrainingParams:
             resolve_bucketer(self.shape_canonicalization)
         except ValueError as e:
             errors.append(f"--shape-canonicalization: {e}")
+        solve_schedule = None
+        try:
+            from photon_ml_tpu.optim.scheduler import resolve_schedule
+
+            solve_schedule = resolve_schedule(self.solve_compaction)
+        except ValueError as e:
+            errors.append(f"--solve-compaction: {e}")
+        if solve_schedule is not None:
+            # loud scope fences: the scheduler re-enters the host between
+            # chunks, so anything that compiles whole updates/iterations
+            # into one XLA program (or shards lanes over the mesh) cannot
+            # compose with it
+            if self.distributed:
+                errors.append(
+                    "--solve-compaction gathers active lanes host-side; "
+                    "--distributed (mesh-sharded lanes) cannot compose"
+                )
+            if self.fused_cycle:
+                errors.append(
+                    "--solve-compaction pauses the solve at chunk "
+                    "boundaries; --fused-cycle (one XLA program per "
+                    "iteration) cannot compose"
+                )
         if self.streaming_random_effects:
             # loud scope fences: the streaming coordinate re-enters the host
             # per evaluation, so anything that wraps it in one XLA program
@@ -514,6 +543,13 @@ def build_training_parser() -> argparse.ArgumentParser:
            "geometric ladder of canonical shapes with masked padding, so "
            "N near-identical shapes share ~log(N) compiled executables: "
            "off | on | BASE:GROWTH (e.g. 8:2)")
+    a("--solve-compaction", default=None,
+      help="convergence-compacted random-effect solves: run the vmapped "
+           "per-entity solve in chunks, repacking unconverged lanes into "
+           "ladder-sized batches between chunks (bitwise-equal results, "
+           "straggler lanes stop burning whole-batch iterations): "
+           "off | on | CHUNK iterations per chunk (e.g. 8). Default defers "
+           "to PHOTON_SOLVE_CHUNK")
     a("--vmapped-grid", default="false",
       help="train the lambda grid through the shared-compile grid API (ONE "
            "compiled cycle serves every combo; lambda-only grids on plain "
@@ -588,6 +624,7 @@ def parse_training_params(argv: Optional[List[str]] = None) -> GameTrainingParam
         tensor_cache_dir=ns.tensor_cache_dir,
         persistent_cache_dir=ns.persistent_cache_dir,
         shape_canonicalization=ns.shape_canonicalization,
+        solve_compaction=ns.solve_compaction,
         vmapped_grid=(
             "auto" if str(ns.vmapped_grid).lower() == "auto"
             else "true" if _truthy(ns.vmapped_grid) else "false"

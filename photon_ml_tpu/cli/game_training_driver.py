@@ -144,6 +144,20 @@ class GameTrainingDriver:
         # (None = off); compile telemetry is always on — the summary lands
         # in the run log either way
         self.bucketer = resolve_bucketer(params.shape_canonicalization)
+        # convergence-compacted random-effect solves (None = one-shot):
+        # resolved once so every combo's coordinates share the policy.
+        # Compacted rungs ride the SAME ladder --shape-canonicalization
+        # configured (when it did) — one rung vocabulary across block
+        # padding and lane compaction, as documented
+        import dataclasses as _dc
+
+        from photon_ml_tpu.optim.scheduler import resolve_schedule
+
+        self.solve_schedule = resolve_schedule(params.solve_compaction)
+        if self.solve_schedule is not None and self.bucketer is not None:
+            self.solve_schedule = _dc.replace(
+                self.solve_schedule, bucketer=self.bucketer
+            )
         compile_stats.install_xla_listeners()
         self._own_logger = logger is None
         self.logger = logger or PhotonLogger(
@@ -480,6 +494,7 @@ class GameTrainingDriver:
                     optimizer=cfg.optimizer,
                     optimizer_config=cfg.optimizer_config(),
                     regularization=cfg.regularization_context(),
+                    solve_schedule=self.solve_schedule,
                     # spilled state goes under OUR output dir, never inside
                     # the manifest dir (a --tensor-cache hit points that at
                     # the shared cache entry, which must stay run-agnostic);
@@ -504,6 +519,7 @@ class GameTrainingDriver:
                     regularization=cfg.regularization_context(),
                     bundle=self.bucketed_bundles[name],
                     mesh_ctx=self._mesh_context() if p.distributed else None,
+                    solve_schedule=self.solve_schedule,
                 )
             else:
                 re = RandomEffectCoordinate(
@@ -512,6 +528,8 @@ class GameTrainingDriver:
                     optimizer=cfg.optimizer,
                     optimizer_config=cfg.optimizer_config(),
                     regularization=cfg.regularization_context(),
+                    solve_schedule=self.solve_schedule,
+                    solve_label=name,
                 )
                 if p.distributed:
                     from photon_ml_tpu.parallel.distributed import (
@@ -691,6 +709,8 @@ class GameTrainingDriver:
             return "--checkpoint-dir (no per-update checkpoints in a vmapped grid)"
         if p.divergence_guard != "off":
             return "--divergence-guard (per-update host gate cannot enter the compiled cycle)"
+        if self.solve_schedule is not None:
+            return "--solve-compaction (chunk pauses re-enter the host per update)"
         import dataclasses as _dc
 
         for name in p.updating_sequence:
@@ -1067,6 +1087,10 @@ class GameTrainingDriver:
             self.logger.info(
                 f"shape canonicalization: {self.bucketer.describe()}"
             )
+        if self.solve_schedule is not None:
+            self.logger.info(
+                f"solve compaction: {self.solve_schedule.describe()}"
+            )
         try:
             with self.timer.measure("prepare-feature-maps"):
                 self.prepare_feature_maps()
@@ -1093,6 +1117,10 @@ class GameTrainingDriver:
             from photon_ml_tpu.compile import compile_stats
 
             self.logger.info(compile_stats.summary())
+            if self.solve_schedule is not None:
+                from photon_ml_tpu.optim.scheduler import solve_stats
+
+                self.logger.info(solve_stats.summary())
             if p.persistent_cache_dir and compile_stats.xla_cache_misses == 0:
                 self.logger.info(
                     "persistent cache fully warm: zero new XLA compiles"

@@ -1,5 +1,18 @@
 from photon_ml_tpu.optim.common import OptimizerConfig, OptResult
 from photon_ml_tpu.optim.lbfgs import lbfgs_minimize
+from photon_ml_tpu.optim.scheduler import (
+    SolveSchedule,
+    resolve_schedule,
+    solve_stats,
+)
 from photon_ml_tpu.optim.tron import tron_minimize
 
-__all__ = ["OptimizerConfig", "OptResult", "lbfgs_minimize", "tron_minimize"]
+__all__ = [
+    "OptimizerConfig",
+    "OptResult",
+    "SolveSchedule",
+    "lbfgs_minimize",
+    "resolve_schedule",
+    "solve_stats",
+    "tron_minimize",
+]

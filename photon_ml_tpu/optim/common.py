@@ -79,11 +79,36 @@ def summarize_result(res: OptResult) -> str:
     )
 
 
+def iteration_histogram(iterations) -> str:
+    """Power-of-2 histogram of per-lane iteration counts, e.g.
+    ``<=4:120 <=8:30 <=32:1`` — makes the convergence skew (and therefore
+    the one-shot vmapped solve's straggler waste, which every lane pays up
+    to the max bucket) visible in one log line."""
+    import numpy as np
+
+    iters = np.asarray(iterations).ravel()
+    if iters.size == 0:
+        return "(empty)"
+    top = int(iters.max())
+    parts = []
+    lo = -1
+    hi = 1
+    while lo < top:
+        n = int(np.sum((iters > lo) & (iters <= hi)))
+        if n:
+            parts.append(f"<={hi}:{n}")
+        lo = hi
+        hi *= 2
+    return " ".join(parts) if parts else "(empty)"
+
+
 def summarize_stacked_results(res: OptResult) -> str:
     """Aggregate summary of a vmapped solve (leading entity axis on every
-    field) — convergence-reason counts + iteration/value stats, the analogue
-    of RandomEffectOptimizationTracker.toSummaryString
-    (optimization/game/RandomEffectOptimizationTracker.scala:62-95)."""
+    field) — convergence-reason counts + iteration histogram/stats, the
+    analogue of RandomEffectOptimizationTracker.toSummaryString
+    (optimization/game/RandomEffectOptimizationTracker.scala:62-95). The
+    histogram is the before/after ledger for solve compaction: a long tail
+    of high-iteration lanes is exactly the waste compaction removes."""
     import numpy as np
 
     reasons = np.asarray(res.reason).ravel()
@@ -96,6 +121,7 @@ def summarize_stacked_results(res: OptResult) -> str:
     }
     return (
         f"entities={reasons.size} convergenceReasons={counts} "
-        f"iterations(mean={iters.mean():.1f} max={int(iters.max())}) "
+        f"iterations(mean={iters.mean():.1f} max={int(iters.max())} "
+        f"histogram: {iteration_histogram(iters)}) "
         f"value(mean={values.mean():.6g} max={values.max():.6g})"
     )

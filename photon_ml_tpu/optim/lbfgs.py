@@ -73,6 +73,13 @@ def _two_loop_direction(pg, S, Y, rho, k, m):
 
 
 class _State(NamedTuple):
+    """Carried solve state. Self-contained for RESUMABILITY: the two
+    reference scalars the convergence tests compare against (``F0``,
+    ``pg0_norm``, fixed at init) ride in the state instead of living as
+    Python-closure constants, so a paused state can be handed to a
+    different compiled chunk kernel (or gathered into a compacted batch by
+    optim/scheduler.py) and resumed bit-exactly."""
+
     w: Array
     f: Array  # smooth value
     g: Array  # smooth gradient
@@ -87,6 +94,8 @@ class _State(NamedTuple):
     value_history: Array
     grad_norm_history: Array
     w_history: Array  # (max_iter + 1, D) if tracking, else (1, 1) dummy
+    F0: Array  # objective at w0 (function-convergence reference)
+    pg0_norm: Array  # initial pseudo-gradient norm (gradient-tol reference)
 
 
 @functools.partial(jax.jit, static_argnames=("value_and_grad_fn", "config"))
@@ -106,25 +115,8 @@ def lbfgs_minimize(
     return lbfgs_minimize_(value_and_grad_fn, w0, config, l1_weight, bounds)
 
 
-def lbfgs_minimize_(
-    value_and_grad_fn,
-    w0: Array,
-    config: OptimizerConfig,
-    l1_weight: Array | float = 0.0,
-    bounds: Optional[Tuple[Array, Array]] = None,
-    track_coefficients: bool = False,
-) -> OptResult:
-    """Non-jitted body (callable from inside other jitted code / vmap).
-
-    ``track_coefficients`` carries per-iteration coefficient snapshots
-    through the while_loop ((max_iter+1, D) extra memory — the ModelTracker
-    analogue for validate-per-iteration)."""
-    m = config.num_corrections
-    max_iter = config.max_iterations
-    tol = config.tolerance
-    dtype = w0.dtype
-    dim = w0.shape[0]
-    l1 = jnp.asarray(l1_weight, dtype)
+def _problem_fns(l1, bounds):
+    """(F_of, reduced_pg) closures shared by init and advance."""
 
     def F_of(w, f):
         return f + l1 * jnp.sum(jnp.abs(w))
@@ -140,6 +132,25 @@ def lbfgs_minimize_(
             pg = jnp.where(blocked, 0.0, pg)
         return pg
 
+    return F_of, reduced_pg
+
+
+def lbfgs_init_(
+    value_and_grad_fn,
+    w0: Array,
+    config: OptimizerConfig,
+    l1_weight: Array | float = 0.0,
+    bounds: Optional[Tuple[Array, Array]] = None,
+    track_coefficients: bool = False,
+) -> _State:
+    """Fresh resumable solve state at ``w0`` (one objective evaluation)."""
+    m = config.num_corrections
+    max_iter = config.max_iterations
+    dtype = w0.dtype
+    dim = w0.shape[0]
+    l1 = jnp.asarray(l1_weight, dtype)
+    F_of, reduced_pg = _problem_fns(l1, bounds)
+
     if bounds is not None:
         w0 = jnp.clip(w0, bounds[0], bounds[1])
     f0, g0 = value_and_grad_fn(w0)
@@ -152,7 +163,7 @@ def lbfgs_minimize_(
         w_hist0 = jnp.zeros((max_iter + 1, dim), dtype).at[0].set(w0)
     else:
         w_hist0 = jnp.zeros((1, 1), dtype)
-    state = _State(
+    return _State(
         w=w0,
         f=f0,
         g=g0,
@@ -169,7 +180,35 @@ def lbfgs_minimize_(
         value_history=hist0.at[0].set(F0),
         grad_norm_history=hist0.at[0].set(pg0_norm),
         w_history=w_hist0,
+        F0=F0,
+        pg0_norm=pg0_norm,
     )
+
+
+def lbfgs_advance_(
+    value_and_grad_fn,
+    state: _State,
+    config: OptimizerConfig,
+    l1_weight: Array | float = 0.0,
+    bounds: Optional[Tuple[Array, Array]] = None,
+    iteration_limit=None,
+    track_coefficients: bool = False,
+) -> _State:
+    """Run the while_loop from ``state`` until convergence or the ABSOLUTE
+    ``iteration_limit`` (traced or static int; None = config.max_iterations).
+    Per-lane trajectories are deterministic functions of the carried state,
+    so advancing in chunks of K iterations and re-feeding the paused state —
+    including through a scheduler's gather/compact/scatter — replays exactly
+    the one-shot iteration sequence: bitwise-equal results (pinned by
+    tests/test_scheduler.py)."""
+    max_iter = config.max_iterations
+    tol = config.tolerance
+    dtype = state.w.dtype
+    l1 = jnp.asarray(l1_weight, dtype)
+    limit = max_iter if iteration_limit is None else iteration_limit
+    F_of, reduced_pg = _problem_fns(l1, bounds)
+
+    m = config.num_corrections
 
     def orthant_project(w_trial, xi):
         # project onto the orthant xi; identity when no L1
@@ -186,7 +225,7 @@ def lbfgs_minimize_(
         return w_trial
 
     def cond(s: _State):
-        return s.reason == 0
+        return (s.reason == 0) & (s.iteration < limit)
 
     def body(s: _State):
         pg = reduced_pg(s.w, s.g)
@@ -256,8 +295,8 @@ def lbfgs_minimize_(
         pg_norm = jnp.linalg.norm(pg_new)
         it = s.iteration + 1
 
-        grad_ok = pg_norm <= tol * jnp.maximum(pg0_norm, _EPS)
-        func_ok = jnp.abs(s.F - F_out) <= tol * jnp.maximum(jnp.abs(F0), _EPS)
+        grad_ok = pg_norm <= tol * jnp.maximum(s.pg0_norm, _EPS)
+        func_ok = jnp.abs(s.F - F_out) <= tol * jnp.maximum(jnp.abs(s.F0), _EPS)
         reason = jnp.where(
             grad_ok,
             ConvergenceReason.GRADIENT_CONVERGED,
@@ -289,16 +328,50 @@ def lbfgs_minimize_(
             w_history=(
                 s.w_history.at[it].set(w_out) if track_coefficients else s.w_history
             ),
+            F0=s.F0,
+            pg0_norm=s.pg0_norm,
         )
 
-    final = lax.while_loop(cond, body, state)
+    return lax.while_loop(cond, body, state)
+
+
+def lbfgs_result(state: _State, track_coefficients: bool = False) -> OptResult:
+    """OptResult view of a (possibly paused) solve state. Works unchanged on
+    a vmapped state (every field gains the leading lane axis)."""
     return OptResult(
-        coefficients=final.w,
-        value=final.F,
-        grad_norm=final.pg_norm,
-        iterations=final.iteration,
-        reason=final.reason,
-        value_history=final.value_history,
-        grad_norm_history=final.grad_norm_history,
-        coefficient_history=final.w_history if track_coefficients else None,
+        coefficients=state.w,
+        value=state.F,
+        grad_norm=state.pg_norm,
+        iterations=state.iteration,
+        reason=state.reason,
+        value_history=state.value_history,
+        grad_norm_history=state.grad_norm_history,
+        coefficient_history=state.w_history if track_coefficients else None,
     )
+
+
+def lbfgs_minimize_(
+    value_and_grad_fn,
+    w0: Array,
+    config: OptimizerConfig,
+    l1_weight: Array | float = 0.0,
+    bounds: Optional[Tuple[Array, Array]] = None,
+    track_coefficients: bool = False,
+) -> OptResult:
+    """Non-jitted one-shot body (callable from inside other jitted code /
+    vmap): init + advance-to-convergence + result, the same while_loop the
+    pre-resumable kernel ran (the body sets MAX_ITERATIONS at max_iter, so
+    the static limit below never changes which states are visited).
+
+    ``track_coefficients`` carries per-iteration coefficient snapshots
+    through the while_loop ((max_iter+1, D) extra memory — the ModelTracker
+    analogue for validate-per-iteration)."""
+    state = lbfgs_init_(
+        value_and_grad_fn, w0, config, l1_weight, bounds, track_coefficients
+    )
+    final = lbfgs_advance_(
+        value_and_grad_fn, state, config, l1_weight, bounds,
+        iteration_limit=config.max_iterations,
+        track_coefficients=track_coefficients,
+    )
+    return lbfgs_result(final, track_coefficients)

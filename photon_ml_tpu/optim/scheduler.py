@@ -1,0 +1,496 @@
+"""Convergence-compacted solve scheduler: chunk → compact → resume.
+
+SURVEY §7.3 names the residual TPU-mapping hazard of GLMix random effects:
+vmapping a while_loop means every lane steps until the slowest lane
+converges. Size-bucketing (PR-3 ladder, bucketed/streaming coordinates)
+fixed the *padding* waste; this module attacks the *iteration* waste — the
+Snap ML observation (1803.06333) that hierarchical GLM training wins come
+from scheduling work to match convergence heterogeneity, and the straggler
+accounting of the Spark-ML study (1612.01437) applied to per-entity lanes.
+
+Mechanism (host-side loop over device chunk kernels):
+
+  1. **chunk** — run the resumable vmapped kernel (optim/lbfgs.py /
+     optim/tron.py ``*_advance_``) for K more iterations; converged lanes
+     freeze (the while_loop batching rule masks them), active lanes pause
+     at the chunk boundary with their full carried state.
+  2. **compact** — pull the per-lane ``reason`` flags (one tiny D2H), gather
+     the unconverged lanes' problem data + carried state into a smaller
+     batch padded up the :class:`~photon_ml_tpu.compile.ShapeBucketer`
+     ladder, so compacted batches land on ~log(E) canonical lane counts and
+     REUSE compiled chunk executables instead of recompiling per active
+     count. Ladder-pad lanes repeat a real lane with ``reason`` forced
+     nonzero, so they freeze at zero marginal iterations.
+  3. **resume** — advance the compacted batch another K iterations and
+     scatter its lanes' state back into the full entity-order state (pad
+     lanes scatter nowhere).
+
+Per-lane trajectories are branch-free and lane-independent, so chunking
+and re-batching change WHICH lanes burn device iterations but not any
+lane's arithmetic: final results are bitwise-equal to the one-shot kernel
+(tests/test_scheduler.py pins this for LBFGS, OWL-QN, and TRON).
+
+Telemetry: every compacted solve records per-chunk active-lane counts and
+the lane-iteration ledger in :data:`solve_stats` (the CompileStats
+pattern); drivers log ``solve_stats.summary()`` next to the compile stats.
+
+Env control: ``PHOTON_SOLVE_CHUNK`` = ``off`` (default) | ``on`` | K
+(chunk size), the same resolve pattern as ``PHOTON_SHAPE_LADDER``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import threading
+from typing import List, Optional
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from photon_ml_tpu.compile import ShapeBucketer, instrumented_jit
+from photon_ml_tpu.optim.common import OptResult
+
+Array = jax.Array
+
+_CHUNK_ENV = "PHOTON_SOLVE_CHUNK"
+DEFAULT_CHUNK = 8
+
+# reason code stamped on ladder-pad lanes so the chunk while_loop freezes
+# them; never scattered back (pad lanes map out of bounds -> dropped)
+_PAD_REASON = np.int32(1)
+
+
+@dataclasses.dataclass(frozen=True)
+class SolveSchedule:
+    """Static compaction policy for one coordinate's solves.
+
+    ``chunk_size`` — iterations per chunk between compaction pauses. Small
+    K compacts sooner (less straggler burn) but pays more host syncs; K >=
+    max_iterations degenerates to the one-shot kernel plus one sync.
+
+    ``bucketer`` — the ladder compacted lane counts round up to, so every
+    chunk/gather/scatter executable is shared across compaction steps (and
+    across blocks/buckets that land on the same rung).
+    """
+
+    chunk_size: int = DEFAULT_CHUNK
+    bucketer: ShapeBucketer = ShapeBucketer()
+
+    def __post_init__(self):
+        if self.chunk_size < 1:
+            raise ValueError(
+                f"solve-compaction chunk size must be >= 1, got {self.chunk_size}"
+            )
+
+    def describe(self) -> str:
+        return (
+            f"compaction(chunk={self.chunk_size}, {self.bucketer.describe()})"
+        )
+
+
+def resolve_schedule(
+    spec: "Optional[SolveSchedule | str | bool | int]" = None,
+) -> Optional[SolveSchedule]:
+    """Effective schedule: an explicit value wins; ``None`` falls back to
+    ``PHOTON_SOLVE_CHUNK``. Returns None when compaction is off.
+
+    Accepted spellings (driver flag and env var share them):
+    ``off``/``false``/``0`` -> None; ``on``/``true`` -> default chunk; a
+    positive integer -> that chunk size.
+    """
+    if isinstance(spec, SolveSchedule):
+        return spec
+    if spec is None:
+        raw = os.environ.get(_CHUNK_ENV)
+        if raw is None:
+            return None
+        return resolve_schedule(raw)
+    if isinstance(spec, bool):
+        return SolveSchedule() if spec else None
+    if isinstance(spec, int):
+        return SolveSchedule(chunk_size=spec) if spec > 0 else None
+    text = str(spec).strip().lower()
+    if text in ("", "off", "false", "0", "none"):
+        return None
+    if text in ("on", "true", "default"):
+        return SolveSchedule()
+    try:
+        chunk = int(text)
+    except ValueError as e:
+        raise ValueError(
+            f"bad solve-compaction spec {spec!r} (want off | on | CHUNK, "
+            f"e.g. 8): {e}"
+        ) from e
+    if chunk < 1:
+        raise ValueError(
+            f"solve-compaction chunk size must be >= 1, got {chunk}"
+        )
+    return SolveSchedule(chunk_size=chunk)
+
+
+# ---------------------------------------------------------------------------
+# telemetry (the CompileStats pattern: process-wide, thread-safe)
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class ChunkRecord:
+    """One chunk dispatch of one compacted solve."""
+
+    chunk: int  # chunk index within the solve
+    batch_lanes: int  # lanes in the dispatched batch (full E or ladder rung)
+    active_lanes: int  # genuinely unconverged lanes in the batch
+    limit: int  # absolute iteration bound the chunk ran to
+    advanced: int  # iterations the loop actually stepped (max over lanes)
+
+
+@dataclasses.dataclass
+class SolveRecord:
+    """Lane-iteration ledger of one compacted solve."""
+
+    label: str
+    lanes: int  # entity lanes in the full problem
+    max_iteration: int  # slowest lane's final iteration count
+    executed: int  # sum over chunks of batch_lanes * advanced
+    baseline: int  # lanes * max_iteration: the one-shot vmapped burn
+    chunks: List[ChunkRecord]
+
+    @property
+    def saved(self) -> int:
+        return self.baseline - self.executed
+
+
+class SolveStats:
+    """Registry of compacted-solve ledgers (thread-safe: the streaming
+    prefetch pipeline can overlap block solves with host work).
+
+    BOUNDED, like the CompileStats counter pattern: totals aggregate into
+    plain counters, and only the worst (largest-baseline) record plus a
+    short ring of the most recent ones are retained — a B-blocks x
+    I-iterations x C-combos run records B*I*C solves without growing
+    process memory with the run length."""
+
+    RECENT_KEEP = 32
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._counters = dict.fromkeys(
+            ("solves", "lanes", "executed", "baseline"), 0
+        )
+        self._worst: Optional[SolveRecord] = None
+        self._recent: List[SolveRecord] = []
+
+    def record(self, rec: SolveRecord) -> None:
+        with self._lock:
+            self._counters["solves"] += 1
+            self._counters["lanes"] += rec.lanes
+            self._counters["executed"] += rec.executed
+            self._counters["baseline"] += rec.baseline
+            if self._worst is None or rec.baseline > self._worst.baseline:
+                self._worst = rec
+            self._recent.append(rec)
+            del self._recent[: -self.RECENT_KEEP]
+
+    def snapshot(self) -> List[SolveRecord]:
+        """The most recent solve records (bounded ring, newest last)."""
+        with self._lock:
+            return list(self._recent)
+
+    def reset(self) -> None:
+        with self._lock:
+            self._counters = dict.fromkeys(self._counters, 0)
+            self._worst = None
+            self._recent.clear()
+
+    def totals(self) -> dict:
+        with self._lock:
+            return {
+                "solves": self._counters["solves"],
+                "lanes": self._counters["lanes"],
+                "executed_lane_iterations": self._counters["executed"],
+                "baseline_lane_iterations": self._counters["baseline"],
+                "saved_lane_iterations": (
+                    self._counters["baseline"] - self._counters["executed"]
+                ),
+            }
+
+    def summary(self) -> str:
+        """Driver-log summary: the ledger plus per-chunk active-lane decay
+        of the worst (largest-baseline) solve."""
+        with self._lock:  # one acquisition: totals + worst must be coherent
+            t = {
+                "solves": self._counters["solves"],
+                "lanes": self._counters["lanes"],
+                "executed_lane_iterations": self._counters["executed"],
+                "baseline_lane_iterations": self._counters["baseline"],
+                "saved_lane_iterations": (
+                    self._counters["baseline"] - self._counters["executed"]
+                ),
+            }
+            worst = self._worst
+        if not t["solves"]:
+            return "solve compaction: no compacted solves recorded"
+        pct = (
+            100.0 * t["saved_lane_iterations"] / t["baseline_lane_iterations"]
+            if t["baseline_lane_iterations"]
+            else 0.0
+        )
+        lines = [
+            f"solve compaction: {t['solves']} solves / {t['lanes']} lanes; "
+            f"{t['executed_lane_iterations']} lane-iterations executed vs "
+            f"{t['baseline_lane_iterations']} one-shot "
+            f"(saved {t['saved_lane_iterations']}, {pct:.1f}%)"
+        ]
+        if worst is not None:
+            decay = " -> ".join(
+                f"{c.active_lanes}/{c.batch_lanes}@{c.limit}" for c in worst.chunks
+            )
+            lines.append(
+                f"  [{worst.label}] active-lane decay (active/batch@limit): {decay}"
+            )
+        return "\n".join(lines)
+
+
+#: THE process-wide registry every compacted solve reports into.
+solve_stats = SolveStats()
+
+
+# ---------------------------------------------------------------------------
+# shared chunk kernels (one per process, like streaming_re's block kernels:
+# problem data rides as a pytree argument, solver configuration as hashable
+# statics, so jit caches key on (shapes, config) — ladder-sized compacted
+# batches and same-ladder streaming blocks collapse onto few executables)
+# ---------------------------------------------------------------------------
+
+_STATICS = ("task", "optimizer", "optimizer_config", "regularization")
+_INIT_JIT = None
+_CHUNK_JIT = None
+_GATHER_JIT = None
+_SCATTER_JIT = None
+
+
+def _lane_fns(task, optimizer, optimizer_config, regularization):
+    from photon_ml_tpu.algorithm.random_effect import entity_lane_fns
+
+    return entity_lane_fns(task, optimizer, optimizer_config, regularization)
+
+
+def _init_batch(data, w0, **cfg):
+    """Vmapped fresh solve state for every lane (one objective eval)."""
+    global _INIT_JIT
+    if _INIT_JIT is None:
+
+        def impl(data, w0, task, optimizer, optimizer_config, regularization):
+            _, init_one, _, _ = _lane_fns(
+                task, optimizer, optimizer_config, regularization
+            )
+            return jax.vmap(init_one)(*data, w0)
+
+        _INIT_JIT = instrumented_jit(
+            impl, site="scheduler.init", static_argnames=_STATICS
+        )
+    return _INIT_JIT(data, w0, **cfg)
+
+
+def _chunk_batch(data, state, limit, **cfg):
+    """Advance every lane to the absolute iteration bound ``limit`` (a
+    TRACED scalar, so every chunk of every compaction step reuses the same
+    executable per batch shape)."""
+    global _CHUNK_JIT
+    if _CHUNK_JIT is None:
+        from photon_ml_tpu.compile import donation_enabled
+
+        def impl(data, state, limit, task, optimizer, optimizer_config,
+                 regularization):
+            _, _, advance_one, _ = _lane_fns(
+                task, optimizer, optimizer_config, regularization
+            )
+            return jax.vmap(
+                advance_one, in_axes=(0, 0, 0, 0, 0, None)
+            )(*data, state, limit)
+
+        _CHUNK_JIT = instrumented_jit(
+            impl,
+            site="scheduler.chunk",
+            static_argnames=_STATICS,
+            # the paused state is dead once advanced — update it in place
+            donate_argnums=(1,) if donation_enabled() else (),
+        )
+    return _CHUNK_JIT(data, state, limit, **cfg)
+
+
+def _gather_batch(data, state, idx, n_active):
+    """Compact the ``idx`` lanes of (data, state) into a smaller batch.
+    ``idx`` is ladder-rung sized; entries past ``n_active`` repeat a real
+    lane and get their ``reason`` forced nonzero so they freeze instead of
+    burning chunk iterations."""
+    global _GATHER_JIT
+    if _GATHER_JIT is None:
+
+        def impl(data, state, idx, n_active):
+            take = lambda a: jnp.take(a, idx, axis=0)
+            data_c = jax.tree.map(take, data)
+            state_c = jax.tree.map(take, state)
+            pad = jnp.arange(idx.shape[0]) >= n_active
+            state_c = state_c._replace(
+                reason=jnp.where(pad, _PAD_REASON, state_c.reason)
+            )
+            return data_c, state_c
+
+        _GATHER_JIT = instrumented_jit(
+            impl,
+            site="scheduler.compact",
+            # full state/data must stay alive (scatter target / next gather
+            # source) — nothing to donate
+            static_argnames=(),
+        )
+    return _GATHER_JIT(data, state, idx, n_active)
+
+
+def _scatter_batch(full_state, part_state, idx, n_active):
+    """Scatter a compacted batch's lanes back into entity order. Pad lanes
+    (positions >= n_active) map out of bounds and are DROPPED by the jitted
+    scatter — only real lanes land."""
+    global _SCATTER_JIT
+    if _SCATTER_JIT is None:
+        from photon_ml_tpu.compile import donation_enabled
+
+        def impl(full_state, part_state, idx, n_active):
+            lanes = full_state.reason.shape[0]
+            pos = jnp.where(jnp.arange(idx.shape[0]) < n_active, idx, lanes)
+            return jax.tree.map(
+                lambda f, p: f.at[pos].set(p, mode="drop"), full_state, part_state
+            )
+
+        _SCATTER_JIT = instrumented_jit(
+            impl,
+            site="scheduler.scatter",
+            static_argnames=(),
+            # the stale full state is consumed — scatter in place
+            donate_argnums=(0,) if donation_enabled() else (),
+        )
+    return _SCATTER_JIT(full_state, part_state, idx, n_active)
+
+
+# ---------------------------------------------------------------------------
+# the scheduler loop
+# ---------------------------------------------------------------------------
+
+
+def compacted_solve(
+    data,
+    w0: Array,
+    *,
+    task,
+    optimizer,
+    optimizer_config,
+    regularization,
+    schedule: SolveSchedule,
+    label: str = "re_solve",
+) -> OptResult:
+    """Solve every lane of ``data = (x, labels, offsets, weights)`` (each
+    with leading entity axis E) with chunked, convergence-compacted vmapped
+    kernels. Returns the stacked :class:`OptResult` — bitwise-equal to
+    ``vmap(solve_one)`` over the same data.
+
+    The loop: init -> chunk on the FULL batch -> pull per-lane reason flags
+    -> while any lane is unconverged: gather active lanes onto the ladder
+    (only when the rung is strictly smaller than the current batch), chunk
+    again, scatter back. Telemetry lands in :data:`solve_stats`.
+    """
+    cfg = dict(
+        task=task,
+        optimizer=optimizer,
+        optimizer_config=optimizer_config,
+        regularization=regularization,
+    )
+    lanes = int(w0.shape[0])
+    max_iter = optimizer_config.max_iterations
+    chunk = schedule.chunk_size
+    bucketer = schedule.bucketer
+
+    _, _, _, result_of = _lane_fns(**cfg)
+
+    state = _init_batch(data, w0, **cfg)
+    chunks: List[ChunkRecord] = []
+    executed = 0
+
+    # current batch bookkeeping: lane_ids maps batch position -> entity
+    # lane; the full state is authoritative (compacted chunks scatter back
+    # into it at every pause)
+    cur_data = data
+    cur_state = state
+    cur_ids = np.arange(lanes)
+    cur_active = lanes
+    compacted = False
+    limit = 0
+
+    while True:
+        prev_limit = limit
+        limit = min(limit + chunk, max_iter)
+        cur_state = _chunk_batch(cur_data, cur_state, jnp.int32(limit), **cfg)
+        if compacted:
+            state = _scatter_batch(
+                state, cur_state, jnp.asarray(cur_ids, jnp.int32),
+                jnp.int32(cur_active),
+            )
+        else:
+            state = cur_state
+        # one tiny D2H per chunk: the lane flags + iteration counters that
+        # drive compaction and the iteration ledger
+        reasons = np.asarray(state.reason)
+        iters = np.asarray(state.iteration)
+        advanced = (
+            int(min(int(iters.max(initial=0)), limit) - prev_limit)
+            if lanes
+            else 0
+        )
+        advanced = max(advanced, 0)
+        batch_lanes = len(cur_ids)
+        active_idx = np.nonzero(reasons == 0)[0]
+        chunks.append(
+            ChunkRecord(
+                chunk=len(chunks),
+                batch_lanes=batch_lanes,
+                active_lanes=cur_active,
+                limit=limit,
+                advanced=advanced,
+            )
+        )
+        executed += batch_lanes * advanced
+        if active_idx.size == 0 or limit >= max_iter:
+            break
+        # compact when the ladder rung genuinely shrinks the batch; once
+        # compacted, also re-gather whenever the active SET changed (so
+        # newly-frozen lanes stop riding along) — but skip the dispatch
+        # entirely when nothing converged this chunk, the common case deep
+        # in a straggler tail
+        rung = min(bucketer.canon(int(active_idx.size)), lanes)
+        if (rung < batch_lanes or compacted) and not np.array_equal(
+            active_idx, cur_ids[:cur_active]
+        ):
+            idx = np.concatenate(
+                [active_idx, np.full(rung - active_idx.size, active_idx[0])]
+            ).astype(np.int32)
+            cur_data, cur_state = _gather_batch(
+                data, state, jnp.asarray(idx), jnp.int32(active_idx.size)
+            )
+            cur_ids = idx
+            compacted = True
+        cur_active = int(active_idx.size)
+
+    max_iteration = int(np.asarray(state.iteration).max(initial=0))
+    solve_stats.record(
+        SolveRecord(
+            label=label,
+            lanes=lanes,
+            max_iteration=max_iteration,
+            executed=executed,
+            baseline=lanes * max_iteration,
+            chunks=chunks,
+        )
+    )
+    return result_of(state)

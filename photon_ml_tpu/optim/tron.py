@@ -95,6 +95,12 @@ def _truncated_cg(hvp, g, delta, max_cg_iter, dtype):
 
 
 class _State(NamedTuple):
+    """Carried solve state. Self-contained for RESUMABILITY (the
+    optim/scheduler.py chunk/compact/resume contract): the init-time
+    reference scalars the convergence tests compare against (``f0``,
+    ``g0_norm``) ride in the state, so a paused state survives a hop to a
+    different compiled chunk kernel bit-exactly."""
+
     w: Array
     f: Array
     g: Array
@@ -105,6 +111,8 @@ class _State(NamedTuple):
     value_history: Array
     grad_norm_history: Array
     w_history: Array  # (max_iter + 1, D) if tracking, else (1, 1) dummy
+    f0: Array  # objective at w0 (function-convergence reference)
+    g0_norm: Array  # initial reduced-gradient norm (gradient-tol reference)
 
 
 @functools.partial(jax.jit, static_argnames=("value_and_grad_fn", "hvp_fn", "config"))
@@ -118,18 +126,7 @@ def tron_minimize(
     return tron_minimize_(value_and_grad_fn, hvp_fn, w0, config, bounds)
 
 
-def tron_minimize_(
-    value_and_grad_fn, hvp_fn, w0, config: OptimizerConfig, bounds=None,
-    track_coefficients: bool = False,
-) -> OptResult:
-    """Non-jitted body (callable from inside jit / vmap / shard_map).
-
-    ``track_coefficients`` carries per-iteration coefficient snapshots
-    ((max_iter+1, D) extra memory — the ModelTracker analogue)."""
-    dtype = w0.dtype
-    max_iter = config.max_iterations
-    tol = config.tolerance
-
+def _reduced_grad_fn(bounds):
     def reduced_grad(w, g):
         """Gradient with bound-blocked components zeroed (a coordinate at an
         active bound whose descent direction points outward cannot move):
@@ -140,6 +137,18 @@ def tron_minimize_(
         blocked = ((w >= bounds[1]) & (g < 0.0)) | ((w <= bounds[0]) & (g > 0.0))
         return jnp.where(blocked, 0.0, g)
 
+    return reduced_grad
+
+
+def tron_init_(
+    value_and_grad_fn, w0, config: OptimizerConfig, bounds=None,
+    track_coefficients: bool = False,
+) -> _State:
+    """Fresh resumable solve state at ``w0`` (one objective evaluation)."""
+    dtype = w0.dtype
+    max_iter = config.max_iterations
+    reduced_grad = _reduced_grad_fn(bounds)
+
     if bounds is not None:
         w0 = jnp.clip(w0, bounds[0], bounds[1])
     f0, g0 = value_and_grad_fn(w0)
@@ -149,7 +158,7 @@ def tron_minimize_(
         w_hist0 = jnp.zeros((max_iter + 1, w0.shape[0]), dtype).at[0].set(w0)
     else:
         w_hist0 = jnp.zeros((1, 1), dtype)
-    s0 = _State(
+    return _State(
         w=w0,
         f=f0,
         g=g0,
@@ -162,10 +171,28 @@ def tron_minimize_(
         value_history=hist0.at[0].set(f0),
         grad_norm_history=hist0.at[0].set(g0_norm),
         w_history=w_hist0,
+        f0=f0,
+        g0_norm=g0_norm,
     )
 
+
+def tron_advance_(
+    value_and_grad_fn, hvp_fn, state: _State, config: OptimizerConfig,
+    bounds=None, iteration_limit=None, track_coefficients: bool = False,
+) -> _State:
+    """Run the trust-region loop from ``state`` until convergence or the
+    ABSOLUTE ``iteration_limit`` (traced or static int; None =
+    config.max_iterations). Chunked advances replay the one-shot iteration
+    sequence bit-exactly (tests/test_scheduler.py pins it)."""
+    dtype = state.w.dtype
+    max_iter = config.max_iterations
+    tol = config.tolerance
+    limit = max_iter if iteration_limit is None else iteration_limit
+    reduced_grad = _reduced_grad_fn(bounds)
+    s0 = state
+
     def cond(s: _State):
-        return s.reason == 0
+        return (s.reason == 0) & (s.iteration < limit)
 
     def body(s: _State):
         step, r = _truncated_cg(
@@ -249,8 +276,8 @@ def tron_minimize_(
 
         g_norm = jnp.linalg.norm(reduced_grad(w_out, g_out))
         it = s.iteration + 1
-        grad_ok = g_norm <= tol * jnp.maximum(g0_norm, _EPS)
-        func_ok = accept & (jnp.abs(actred) <= tol * jnp.maximum(jnp.abs(f0), _EPS))
+        grad_ok = g_norm <= tol * jnp.maximum(s.g0_norm, _EPS)
+        func_ok = accept & (jnp.abs(actred) <= tol * jnp.maximum(jnp.abs(s.f0), _EPS))
         reason = jnp.where(
             grad_ok,
             ConvergenceReason.GRADIENT_CONVERGED,
@@ -278,16 +305,47 @@ def tron_minimize_(
             w_history=(
                 s.w_history.at[it].set(w_out) if track_coefficients else s.w_history
             ),
+            f0=s.f0,
+            g0_norm=s.g0_norm,
         )
 
-    final = lax.while_loop(cond, body, s0)
+    return lax.while_loop(cond, body, s0)
+
+
+def tron_result(
+    state: _State, bounds=None, track_coefficients: bool = False
+) -> OptResult:
+    """OptResult view of a (possibly paused) solve state. The final
+    reduced-gradient norm reduces over the trailing coefficient axis, so a
+    vmapped (lane-stacked) state works unchanged."""
+    reduced_grad = _reduced_grad_fn(bounds)
     return OptResult(
-        coefficients=final.w,
-        value=final.f,
-        grad_norm=jnp.linalg.norm(reduced_grad(final.w, final.g)),
-        iterations=final.iteration,
-        reason=final.reason,
-        value_history=final.value_history,
-        grad_norm_history=final.grad_norm_history,
-        coefficient_history=final.w_history if track_coefficients else None,
+        coefficients=state.w,
+        value=state.f,
+        grad_norm=jnp.linalg.norm(reduced_grad(state.w, state.g), axis=-1),
+        iterations=state.iteration,
+        reason=state.reason,
+        value_history=state.value_history,
+        grad_norm_history=state.grad_norm_history,
+        coefficient_history=state.w_history if track_coefficients else None,
     )
+
+
+def tron_minimize_(
+    value_and_grad_fn, hvp_fn, w0, config: OptimizerConfig, bounds=None,
+    track_coefficients: bool = False,
+) -> OptResult:
+    """Non-jitted one-shot body (callable from inside jit / vmap /
+    shard_map): init + advance-to-convergence + result, the same loop the
+    pre-resumable kernel ran (the body sets MAX_ITERATIONS at max_iter, so
+    the static limit never changes which states are visited).
+
+    ``track_coefficients`` carries per-iteration coefficient snapshots
+    ((max_iter+1, D) extra memory — the ModelTracker analogue)."""
+    state = tron_init_(value_and_grad_fn, w0, config, bounds, track_coefficients)
+    final = tron_advance_(
+        value_and_grad_fn, hvp_fn, state, config, bounds,
+        iteration_limit=config.max_iterations,
+        track_coefficients=track_coefficients,
+    )
+    return tron_result(final, bounds, track_coefficients)

@@ -289,6 +289,14 @@ def per_host_re_dataset(
     slabs. Every host calls this collectively (SPMD); the returned dataset's
     arrays are globally sharded with per-host-local backing.
 
+    Resilience: the metadata collectives below (``collective_max`` /
+    ``collective_sum``) never dispatch to the device single-process — the
+    local value IS the reduction — and degrade to the local value with a
+    logged warning when the backend dies under a single-process runtime,
+    so a wedged device client cannot throw ``JaxRuntimeError`` out of this
+    builder's bookkeeping (shuffle._collective_reduce; genuinely multihost
+    failures still raise — a local fallback would desynchronize hosts).
+
     Row ids must be dense [0, N) across hosts (``global_row_layout`` or
     ``densify_row_ids`` produce that layout): the scoring path scatters into
     a (N,)-sized vector, and under jit an out-of-bounds scatter is DROPPED

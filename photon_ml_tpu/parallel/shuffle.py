@@ -113,8 +113,24 @@ def _collective_reduce(
 
     Works identically single-process (the reduction is a no-op with L =
     num_devices) and multi-process (jax.make_array_from_process_local_data
-    assembles the (n_dev, B) global, the jitted reduce runs SPMD)."""
+    assembles the (n_dev, B) global, the jitted reduce runs SPMD).
+
+    Single-process, the local vector IS the global reduction — returned
+    host-side with no device dispatch at all, so an unavailable backend or
+    wedged device client (the ``UNAVAILABLE`` tracebacks the r5 bench
+    self-capture hit inside ``per_host_re_dataset``) can no longer fail
+    the ingest metadata exchange; a backend failure on a mesh claiming
+    multiple processes ALSO degrades to the local value — with a logged
+    warning — when every mesh device is process-local (the backend lied /
+    died but no other host can be waiting on us); a genuinely multi-host
+    failure re-raises, since a silently-local value would desynchronize
+    the hosts."""
     import contextlib
+    import logging
+
+    vec = np.asarray(vec)
+    if num_processes <= 1:
+        return vec.copy()
 
     local = max(ctx.num_devices // num_processes, 1)
     fill = 0 if op == "sum" else np.iinfo(vec.dtype).min if np.issubdtype(vec.dtype, np.integer) else -np.inf
@@ -125,12 +141,29 @@ def _collective_reduce(
     # which (a) overflows row-id sums past N ~ 65k (sum N(N-1)/2 > 2^31)
     # and (b) wraps the int64 min fill to 0, poisoning negative maxes
     is_i64 = np.issubdtype(block.dtype, np.integer) and block.dtype.itemsize == 8
-    with compat.enable_x64() if is_i64 else contextlib.nullcontext():
-        g = jax.make_array_from_process_local_data(sharding, block)
-        out = jax.jit(
-            lambda a: fn(a, axis=0), out_shardings=NamedSharding(ctx.mesh, P())
-        )(g)
-        return np.asarray(jax.device_get(out))
+    try:
+        with compat.enable_x64() if is_i64 else contextlib.nullcontext():
+            g = jax.make_array_from_process_local_data(sharding, block)
+            out = jax.jit(
+                lambda a: fn(a, axis=0), out_shardings=NamedSharding(ctx.mesh, P())
+            )(g)
+            return np.asarray(jax.device_get(out))
+    except Exception as e:  # noqa: BLE001 — any backend fault, incl. JaxRuntimeError
+        try:
+            genuinely_multihost = jax.process_count() > 1
+        except Exception:  # noqa: BLE001 — a dead runtime cannot be multihost
+            genuinely_multihost = False
+        if genuinely_multihost:
+            raise RuntimeError(
+                f"collective {op} over {num_processes} processes failed "
+                f"mid-reduce; a local fallback would desynchronize hosts"
+            ) from e
+        logging.getLogger(__name__).warning(
+            "collective %s degraded to the process-local value: backend "
+            "unavailable in a single-process runtime (%s: %s)",
+            op, type(e).__name__, e,
+        )
+        return vec.copy()
 
 
 def collective_sum(vec, ctx, num_processes: int) -> np.ndarray:

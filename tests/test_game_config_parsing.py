@@ -176,3 +176,49 @@ class TestObsoleteSparkFlags:
             "--offheap-indexmap-num-partitions", "2",
         ])
         assert p.output_dir == "/out"
+
+
+class TestSolveCompactionFlag:
+    _BASE = [
+        "--train-input-dirs", "/in",
+        "--task-type", "LOGISTIC_REGRESSION",
+        "--output-dir", "/out",
+        "--updating-sequence", "fixed",
+        "--fixed-effect-data-configurations", "fixed:global,4",
+    ]
+
+    def _parse(self, *extra):
+        from photon_ml_tpu.cli.game_params import parse_training_params
+
+        return parse_training_params(self._BASE + list(extra))
+
+    def test_spellings(self, monkeypatch):
+        from photon_ml_tpu.optim.scheduler import resolve_schedule
+
+        # the default (no flag) genuinely defers to PHOTON_SOLVE_CHUNK
+        assert self._parse().solve_compaction is None
+        monkeypatch.delenv("PHOTON_SOLVE_CHUNK", raising=False)
+        assert resolve_schedule(self._parse().solve_compaction) is None
+        monkeypatch.setenv("PHOTON_SOLVE_CHUNK", "8")
+        assert resolve_schedule(self._parse().solve_compaction).chunk_size == 8
+        # an explicit flag beats the env
+        assert self._parse("--solve-compaction", "off").solve_compaction == "off"
+        assert resolve_schedule(
+            self._parse("--solve-compaction", "off").solve_compaction
+        ) is None
+        p = self._parse("--solve-compaction", "16")
+        assert resolve_schedule(p.solve_compaction).chunk_size == 16
+        p = self._parse("--solve-compaction", "on")
+        assert resolve_schedule(p.solve_compaction) is not None
+
+    def test_bad_spec_rejected(self):
+        with pytest.raises(ValueError, match="solve-compaction"):
+            self._parse("--solve-compaction", "sideways")
+
+    def test_fused_cycle_fence(self):
+        with pytest.raises(ValueError, match="fused-cycle"):
+            self._parse("--solve-compaction", "on", "--fused-cycle", "true")
+
+    def test_distributed_fence(self):
+        with pytest.raises(ValueError, match="distributed"):
+            self._parse("--solve-compaction", "8", "--distributed", "true")

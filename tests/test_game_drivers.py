@@ -594,6 +594,43 @@ class TestBucketedRandomEffects:
         assert metrics["AUC"] == pytest.approx(local_metrics["AUC"], abs=5e-3)
 
 
+class TestSolveCompaction:
+    def test_solve_compaction_flag_matches_plain(
+        self, trained, game_avro_dirs, tmp_path
+    ):
+        """--solve-compaction: chunked, convergence-compacted RE solves
+        through the full driver — coordinates carry the schedule, the
+        solve_stats ledger records the chunks, metrics match the plain
+        path (the coefficients themselves are pinned bitwise-equal at the
+        coordinate level by tests/test_scheduler.py)."""
+        from photon_ml_tpu.optim.scheduler import solve_stats
+
+        local_driver, _, _ = trained
+        train_dir, val_dir, _ = game_avro_dirs
+        solve_stats.reset()
+        driver = game_training_driver.main(
+            [
+                "--train-input-dirs", train_dir,
+                "--validate-input-dirs", val_dir,
+                "--output-dir", str(tmp_path / "out"),
+                "--num-iterations", "2",
+                "--solve-compaction", "6",
+            ]
+            + COMMON_FLAGS
+        )
+        assert driver.solve_schedule is not None
+        assert driver.solve_schedule.chunk_size == 6
+        coords = driver._build_coordinates(driver.results[0][0])
+        assert coords["per-user"].solve_schedule is driver.solve_schedule
+        assert coords["per-user"].cd_jit is False
+        ledger = solve_stats.totals()
+        assert ledger["solves"] >= 2  # one RE update per iteration
+        assert ledger["executed_lane_iterations"] > 0
+        _, _, metrics = driver.results[driver.best_index]
+        _, _, local_metrics = local_driver.results[local_driver.best_index]
+        assert metrics["AUC"] == pytest.approx(local_metrics["AUC"], abs=5e-3)
+
+
 class TestGridSearch:
     def test_config_grid_selects_best_combo(self, game_avro_dirs, tmp_path):
         """';'-separated optimization configs form a grid

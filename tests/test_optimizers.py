@@ -172,6 +172,109 @@ def test_state_tracking(rng):
     assert np.all(np.isnan(np.asarray(res.value_history)[it + 1:]))
 
 
+class TestConvergenceReasons:
+    """Every solver's stopping paths report the right ConvergenceReason —
+    the codes the driver's convergence summaries and the compaction
+    scheduler's active-lane masks are built on (reason == 0 IS the lane's
+    'still active' flag)."""
+
+    def _nan_off_origin(self):
+        """Objective finite only at w = 0 with a nonzero gradient: every
+        trial point the line search / trust region proposes evaluates to
+        NaN, so the in-kernel non-finite rejection must fire."""
+
+        def vg(w):
+            at_origin = jnp.all(w == 0.0)
+            f = jnp.where(at_origin, 1.0, jnp.nan)
+            return f, jnp.ones_like(w)
+
+        return vg
+
+    # ---- LBFGS ----------------------------------------------------------
+    def test_lbfgs_gradient_converged(self, rng):
+        d = 6
+        A = jnp.asarray(make_spd(rng, d, cond=5.0), jnp.float32)
+        b = jnp.asarray(rng.normal(size=d), jnp.float32)
+        # loose gradient tol: grad_ok must fire while F still moves (an
+        # f32 value-stall would otherwise report FUNCTION_VALUES_CONVERGED)
+        res = lbfgs_minimize(quadratic(A, b), jnp.zeros(d, jnp.float32),
+                             OptimizerConfig(max_iterations=100, tolerance=1e-3))
+        assert int(res.reason) == ConvergenceReason.GRADIENT_CONVERGED
+
+    def test_lbfgs_max_iterations(self, rng):
+        d = 12
+        A = jnp.asarray(make_spd(rng, d, cond=1e4), jnp.float32)
+        b = jnp.asarray(rng.normal(size=d), jnp.float32)
+        res = lbfgs_minimize(quadratic(A, b), jnp.zeros(d, jnp.float32),
+                             OptimizerConfig(max_iterations=2, tolerance=1e-12))
+        assert int(res.reason) == ConvergenceReason.MAX_ITERATIONS
+        assert int(res.iterations) == 2
+
+    def test_lbfgs_line_search_failure(self):
+        res = lbfgs_minimize(self._nan_off_origin(), jnp.zeros(4, jnp.float32),
+                             OptimizerConfig(max_iterations=20, tolerance=1e-9))
+        assert int(res.reason) == ConvergenceReason.OBJECTIVE_NOT_IMPROVING
+        # the carried state stayed at the last good iterate
+        assert np.all(np.asarray(res.coefficients) == 0.0)
+
+    # ---- OWL-QN branch (l1 > 0) ----------------------------------------
+    def test_owlqn_gradient_converged(self, rng):
+        d = 8
+        b = jnp.asarray(rng.normal(size=d) * 2.0, jnp.float32)
+        vg = lambda w: (0.5 * jnp.sum((w - b) ** 2), w - b)
+        res = lbfgs_minimize(vg, jnp.zeros(d, jnp.float32),
+                             OptimizerConfig(max_iterations=100, tolerance=1e-6),
+                             l1_weight=0.5)
+        assert int(res.reason) == ConvergenceReason.GRADIENT_CONVERGED
+
+    def test_owlqn_max_iterations(self, rng):
+        d = 12
+        A = jnp.asarray(make_spd(rng, d, cond=1e4), jnp.float32)
+        b = jnp.asarray(rng.normal(size=d), jnp.float32)
+        res = lbfgs_minimize(quadratic(A, b), jnp.zeros(d, jnp.float32),
+                             OptimizerConfig(max_iterations=2, tolerance=1e-12),
+                             l1_weight=0.3)
+        assert int(res.reason) == ConvergenceReason.MAX_ITERATIONS
+
+    def test_owlqn_line_search_failure(self):
+        res = lbfgs_minimize(self._nan_off_origin(), jnp.zeros(4, jnp.float32),
+                             OptimizerConfig(max_iterations=20, tolerance=1e-9),
+                             l1_weight=0.5)
+        assert int(res.reason) == ConvergenceReason.OBJECTIVE_NOT_IMPROVING
+
+    # ---- TRON -----------------------------------------------------------
+    def test_tron_gradient_converged(self, rng):
+        d = 6
+        A = jnp.asarray(make_spd(rng, d, cond=5.0), jnp.float32)
+        b = jnp.asarray(rng.normal(size=d), jnp.float32)
+        res = tron_minimize(quadratic(A, b), lambda w, v: A @ v,
+                            jnp.zeros(d, jnp.float32),
+                            OptimizerConfig(max_iterations=50, tolerance=1e-3))
+        assert int(res.reason) == ConvergenceReason.GRADIENT_CONVERGED
+
+    def test_tron_max_iterations(self, rng):
+        d = 12
+        A = jnp.asarray(make_spd(rng, d, cond=1e6), jnp.float32)
+        b = jnp.asarray(rng.normal(size=d), jnp.float32)
+        res = tron_minimize(quadratic(A, b), lambda w, v: A @ v,
+                            jnp.zeros(d, jnp.float32),
+                            OptimizerConfig(max_iterations=2, tolerance=1e-14,
+                                            max_cg_iterations=1))
+        assert int(res.reason) == ConvergenceReason.MAX_ITERATIONS
+        assert int(res.iterations) == 2
+
+    def test_tron_improvement_failures(self):
+        """Every trial rejected (NaN off origin) -> the improvement-failure
+        budget trips, the TRON line-search-failure analogue."""
+        res = tron_minimize(self._nan_off_origin(), lambda w, v: v,
+                            jnp.zeros(4, jnp.float32),
+                            OptimizerConfig(max_iterations=20, tolerance=1e-9,
+                                            max_improvement_failures=5))
+        assert int(res.reason) == ConvergenceReason.OBJECTIVE_NOT_IMPROVING
+        assert int(res.iterations) == 5  # one iteration per rejected trial
+        assert np.all(np.asarray(res.coefficients) == 0.0)
+
+
 class TestVmappedLambdaGrid:
     """train_glm_grid_vmapped: all lambdas as lanes of ONE batched kernel —
     must reach the same per-lambda optima as the sequential warm-started

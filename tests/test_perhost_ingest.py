@@ -112,6 +112,37 @@ class TestShufflePrimitives:
         np.testing.assert_array_equal(sh.collective_sum(v, ctx, 1), v)
         np.testing.assert_array_equal(sh.collective_max(v, ctx, 1), v)
 
+    def test_collective_single_process_never_dispatches(self, ctx, monkeypatch):
+        """Single-process, the local value IS the reduction — computed
+        host-side, so a dead backend (the r5 UNAVAILABLE wedge) cannot
+        raise out of per_host_re_dataset's metadata exchange."""
+
+        def boom(*a, **k):
+            raise RuntimeError("UNAVAILABLE: device client is wedged")
+
+        monkeypatch.setattr(jax, "make_array_from_process_local_data", boom)
+        v = np.asarray([7, -3, 12], np.int64)
+        np.testing.assert_array_equal(sh.collective_sum(v, ctx, 1), v)
+        np.testing.assert_array_equal(sh.collective_max(v, ctx, 1), v)
+
+    def test_collective_degrades_with_warning_when_backend_dies(
+        self, ctx, monkeypatch, caplog
+    ):
+        """A backend failure under a single-process runtime degrades to the
+        local value with a logged warning (multi-host would desynchronize,
+        but jax.process_count()==1 here means no other host is waiting)."""
+        import logging
+
+        def boom(*a, **k):
+            raise RuntimeError("UNAVAILABLE: device client is wedged")
+
+        monkeypatch.setattr(jax, "make_array_from_process_local_data", boom)
+        v = np.asarray([5.0, -1.0], np.float32)
+        with caplog.at_level(logging.WARNING):
+            out = sh.collective_max(v, ctx, 2)  # claims 2 processes
+        np.testing.assert_array_equal(out, v)
+        assert any("degraded" in r.message for r in caplog.records)
+
     def test_exchange_routes_every_row_to_its_destination(self, ctx):
         rng = np.random.default_rng(5)
         n = 500

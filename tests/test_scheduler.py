@@ -1,0 +1,441 @@
+"""Convergence-compacted solve scheduler (optim/scheduler.py).
+
+The load-bearing claims, pinned BITWISE:
+
+  * resumable kernels: an LBFGS / OWL-QN / TRON solve chunked at ANY K and
+    resumed from its paused state equals the one-shot kernel bit for bit;
+  * compaction: gathering active lanes into smaller ladder-sized batches
+    between chunks (and scattering finished lanes back to entity order)
+    changes no entity's result bits, through the plain / bucketed /
+    streaming random-effect coordinates;
+  * reuse: compacted batches land on ladder rungs and REUSE compiled chunk
+    executables — no per-active-count recompiles.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from game_test_utils import make_glmix_data
+
+from photon_ml_tpu.algorithm.random_effect import (
+    RandomEffectCoordinate,
+    entity_lane_fns,
+)
+from photon_ml_tpu.compile import compile_stats
+from photon_ml_tpu.data.game import (
+    RandomEffectDataConfig,
+    build_random_effect_dataset,
+)
+from photon_ml_tpu.optim.common import OptimizerConfig
+from photon_ml_tpu.optim.lbfgs import lbfgs_advance_, lbfgs_init_, lbfgs_result
+from photon_ml_tpu.optim.scheduler import (
+    SolveSchedule,
+    compacted_solve,
+    resolve_schedule,
+    solve_stats,
+)
+from photon_ml_tpu.optim.tron import tron_advance_, tron_init_, tron_result
+from photon_ml_tpu.ops.regularization import RegularizationContext
+from photon_ml_tpu.types import OptimizerType, TaskType
+
+pytestmark = pytest.mark.compaction
+
+
+def assert_results_bitwise(a, b):
+    """Every array field of two OptResults equal bit for bit (NaN == NaN:
+    histories carry NaN past each lane's final iteration)."""
+    for name, x, y in zip(a._fields, a, b):
+        if x is None or y is None:
+            assert x is y, name
+            continue
+        assert np.array_equal(np.asarray(x), np.asarray(y), equal_nan=True), name
+
+
+def quadratic(A, b):
+    def vg(w):
+        g = A @ w - b
+        return 0.5 * jnp.dot(w, A @ w) - jnp.dot(b, w), g
+
+    return vg
+
+
+def make_spd(rng, d, cond=200.0):
+    q, _ = np.linalg.qr(rng.normal(size=(d, d)))
+    eig = np.geomspace(1.0, cond, d)
+    return jnp.asarray((q * eig) @ q.T, jnp.float32)
+
+
+# ---------------------------------------------------------------------------
+# resumable kernels
+# ---------------------------------------------------------------------------
+
+
+class TestResumableKernels:
+    @pytest.mark.parametrize("chunk", [1, 3, 7, 100])
+    def test_lbfgs_chunked_equals_one_shot(self, rng, chunk):
+        d = 10
+        A = make_spd(rng, d)
+        b = jnp.asarray(rng.normal(size=d), jnp.float32)
+        vg = quadratic(A, b)
+        cfg = OptimizerConfig(max_iterations=50, tolerance=1e-7)
+        one = jax.jit(
+            lambda w: lbfgs_result(
+                lbfgs_advance_(vg, lbfgs_init_(vg, w, cfg), cfg)
+            )
+        )(jnp.zeros(d, jnp.float32))
+        st = jax.jit(lambda w: lbfgs_init_(vg, w, cfg))(jnp.zeros(d, jnp.float32))
+        adv = jax.jit(
+            lambda s, lim: lbfgs_advance_(vg, s, cfg, iteration_limit=lim)
+        )
+        lim = 0
+        while lim < cfg.max_iterations:
+            lim = min(lim + chunk, cfg.max_iterations)
+            st = adv(st, jnp.int32(lim))
+        assert_results_bitwise(lbfgs_result(st), one)
+
+    @pytest.mark.parametrize("chunk", [1, 4, 100])
+    def test_owlqn_chunked_equals_one_shot(self, rng, chunk):
+        d = 12
+        b = jnp.asarray(rng.normal(size=d) * 2.0, jnp.float32)
+        vg = lambda w: (0.5 * jnp.sum((w - b) ** 2), w - b)
+        cfg = OptimizerConfig(max_iterations=60, tolerance=1e-8)
+        l1 = 0.7
+        one = jax.jit(
+            lambda w: lbfgs_result(
+                lbfgs_advance_(
+                    vg, lbfgs_init_(vg, w, cfg, l1_weight=l1), cfg, l1_weight=l1
+                )
+            )
+        )(jnp.zeros(d, jnp.float32))
+        st = jax.jit(lambda w: lbfgs_init_(vg, w, cfg, l1_weight=l1))(
+            jnp.zeros(d, jnp.float32)
+        )
+        adv = jax.jit(
+            lambda s, lim: lbfgs_advance_(
+                vg, s, cfg, l1_weight=l1, iteration_limit=lim
+            )
+        )
+        lim = 0
+        while lim < cfg.max_iterations:
+            lim = min(lim + chunk, cfg.max_iterations)
+            st = adv(st, jnp.int32(lim))
+        assert_results_bitwise(lbfgs_result(st), one)
+        # the one-shot OWL-QN really produced sparsity (the branch under test)
+        assert np.sum(np.asarray(one.coefficients) == 0.0) > 0
+
+    @pytest.mark.parametrize("chunk", [1, 4, 100])
+    def test_tron_chunked_equals_one_shot(self, rng, chunk):
+        d = 10
+        A = make_spd(rng, d)
+        b = jnp.asarray(rng.normal(size=d), jnp.float32)
+        vg = quadratic(A, b)
+        hvp = lambda w, v: A @ v
+        cfg = OptimizerConfig(max_iterations=30, tolerance=1e-6)
+        one = jax.jit(
+            lambda w: tron_result(
+                tron_advance_(vg, hvp, tron_init_(vg, w, cfg), cfg)
+            )
+        )(jnp.zeros(d, jnp.float32))
+        st = jax.jit(lambda w: tron_init_(vg, w, cfg))(jnp.zeros(d, jnp.float32))
+        adv = jax.jit(
+            lambda s, lim: tron_advance_(vg, hvp, s, cfg, iteration_limit=lim)
+        )
+        lim = 0
+        while lim < cfg.max_iterations:
+            lim = min(lim + chunk, cfg.max_iterations)
+            st = adv(st, jnp.int32(lim))
+        assert_results_bitwise(tron_result(st), one)
+
+    def test_one_shot_wrappers_unchanged(self, rng):
+        """lbfgs_minimize_/tron_minimize_ still converge to the analytic
+        optimum (the wrapper preserves the pre-resumable API)."""
+        from photon_ml_tpu.optim.lbfgs import lbfgs_minimize
+        from photon_ml_tpu.optim.tron import tron_minimize
+
+        d = 8
+        A = make_spd(rng, d, cond=50.0)
+        b = jnp.asarray(rng.normal(size=d), jnp.float32)
+        w_star = jnp.linalg.solve(A, b)
+        res = lbfgs_minimize(
+            quadratic(A, b), jnp.zeros(d, jnp.float32),
+            OptimizerConfig(max_iterations=100, tolerance=1e-7),
+        )
+        np.testing.assert_allclose(res.coefficients, w_star, rtol=1e-3, atol=1e-3)
+        res = tron_minimize(
+            quadratic(A, b), lambda w, v: A @ v, jnp.zeros(d, jnp.float32),
+            OptimizerConfig(max_iterations=50, tolerance=1e-6),
+        )
+        np.testing.assert_allclose(res.coefficients, w_star, rtol=1e-3, atol=1e-3)
+
+
+# ---------------------------------------------------------------------------
+# compacted_solve
+# ---------------------------------------------------------------------------
+
+
+def skewed_lane_problem(rng, E=40, M=10, D=4, hard=4):
+    """A few ill-conditioned lanes among many easy ones."""
+    x = rng.normal(size=(E, M, D)).astype(np.float32)
+    x[:hard] *= np.geomspace(1.0, 32.0, D).astype(np.float32)
+    w_true = (rng.normal(size=(E, D)) * 0.5).astype(np.float32)
+    z = np.einsum("emd,ed->em", x.astype(np.float64), w_true)
+    y = (1.0 / (1.0 + np.exp(-z)) > rng.random((E, M))).astype(np.float32)
+    data = tuple(
+        jnp.asarray(a)
+        for a in (x, y, np.zeros((E, M), np.float32), np.ones((E, M), np.float32))
+    )
+    return data, jnp.zeros((E, D), jnp.float32)
+
+
+class TestCompactedSolve:
+    @pytest.mark.parametrize(
+        "optimizer,reg",
+        [
+            (OptimizerType.LBFGS, RegularizationContext.l2(0.5)),
+            (OptimizerType.LBFGS, RegularizationContext.elastic_net(0.3, 0.5)),
+            (OptimizerType.TRON, RegularizationContext.l2(0.5)),
+        ],
+        ids=["lbfgs-l2", "owlqn-l1", "tron"],
+    )
+    @pytest.mark.parametrize("chunk", [1, 5, 64])
+    def test_bitwise_vs_one_shot(self, rng, optimizer, reg, chunk):
+        data, w0 = skewed_lane_problem(rng)
+        cfg = (
+            OptimizerConfig(max_iterations=25, tolerance=1e-6)
+            if optimizer == OptimizerType.TRON
+            else OptimizerConfig(max_iterations=60, tolerance=1e-7)
+        )
+        kw = dict(
+            task=TaskType.LOGISTIC_REGRESSION,
+            optimizer=optimizer,
+            optimizer_config=cfg,
+            regularization=reg,
+        )
+        solve_one, *_ = entity_lane_fns(**kw)
+        one = jax.jit(jax.vmap(solve_one))(*data, w0)
+        res = compacted_solve(
+            data, w0, schedule=SolveSchedule(chunk_size=chunk), **kw
+        )
+        assert_results_bitwise(res, one)
+
+    def test_ledger_and_reuse(self, rng):
+        """Saved lane-iterations are positive on a skewed distribution, and
+        a second identical solve reuses every compiled chunk executable."""
+        data, w0 = skewed_lane_problem(rng, E=40, hard=4)
+        kw = dict(
+            task=TaskType.LOGISTIC_REGRESSION,
+            optimizer=OptimizerType.LBFGS,
+            optimizer_config=OptimizerConfig(max_iterations=80, tolerance=1e-8),
+            regularization=RegularizationContext.l2(1.0),
+        )
+        schedule = SolveSchedule(chunk_size=8)
+        solve_stats.reset()
+        compacted_solve(data, w0, schedule=schedule, label="warm", **kw)
+        rec = solve_stats.snapshot()[-1]
+        assert rec.lanes == 40
+        assert rec.executed > 0
+        assert rec.executed < rec.baseline  # compaction genuinely saved work
+        assert rec.saved == rec.baseline - rec.executed
+        # batches shrank at least once and ride the ladder
+        assert any(c.batch_lanes < 40 for c in rec.chunks)
+        sites = ("scheduler.init", "scheduler.chunk",
+                 "scheduler.compact", "scheduler.scatter")
+        before = {s: compile_stats.traces_of(s) for s in sites}
+        compacted_solve(data, w0, schedule=schedule, label="reuse", **kw)
+        for s in sites:
+            assert compile_stats.traces_of(s) == before[s], (
+                f"{s} recompiled on an identical warm solve"
+            )
+
+    def test_resolve_schedule_spellings(self, monkeypatch):
+        assert resolve_schedule("off") is None
+        assert resolve_schedule(False) is None
+        assert resolve_schedule(0) is None
+        assert resolve_schedule("on").chunk_size == SolveSchedule().chunk_size
+        assert resolve_schedule(5).chunk_size == 5
+        assert resolve_schedule("12").chunk_size == 12
+        with pytest.raises(ValueError):
+            resolve_schedule("sideways")
+        with pytest.raises(ValueError):
+            resolve_schedule("-3")
+        monkeypatch.delenv("PHOTON_SOLVE_CHUNK", raising=False)
+        assert resolve_schedule(None) is None
+        monkeypatch.setenv("PHOTON_SOLVE_CHUNK", "9")
+        assert resolve_schedule(None).chunk_size == 9
+        monkeypatch.setenv("PHOTON_SOLVE_CHUNK", "off")
+        assert resolve_schedule(None) is None
+
+
+# ---------------------------------------------------------------------------
+# coordinate wiring
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def glmix():
+    rng = np.random.default_rng(77)
+    data, _ = make_glmix_data(
+        rng, num_users=40, rows_per_user_range=(3, 30), d_fixed=4, d_random=3
+    )
+    return data
+
+
+class TestCoordinateWiring:
+    def test_random_effect_coordinate_bitwise(self, glmix):
+        ds = build_random_effect_dataset(
+            glmix, RandomEffectDataConfig("userId", "per_user")
+        )
+        kw = dict(
+            dataset=ds,
+            task=TaskType.LOGISTIC_REGRESSION,
+            optimizer=OptimizerType.LBFGS,
+            regularization=RegularizationContext.l2(0.1),
+        )
+        plain = RandomEffectCoordinate(**kw)
+        sched = RandomEffectCoordinate(
+            **kw, solve_schedule=SolveSchedule(chunk_size=6)
+        )
+        assert getattr(plain, "cd_jit", True)
+        assert sched.cd_jit is False
+        resid = jnp.zeros((glmix.num_rows,), jnp.float32)
+        w_plain, res_plain = jax.jit(plain.update)(
+            resid, plain.initial_coefficients()
+        )
+        w_sched, res_sched = sched.update(resid, sched.initial_coefficients())
+        assert np.array_equal(np.asarray(w_plain), np.asarray(w_sched))
+        assert_results_bitwise(res_sched, jax.tree.map(jnp.asarray, res_plain))
+        # scoring off the compacted coefficients matches too
+        assert np.array_equal(
+            np.asarray(plain.score(w_plain)), np.asarray(sched.score(w_sched))
+        )
+
+    def test_random_effect_rejects_traced_lambda(self, glmix):
+        ds = build_random_effect_dataset(
+            glmix, RandomEffectDataConfig("userId", "per_user")
+        )
+        coord = RandomEffectCoordinate(
+            dataset=ds,
+            task=TaskType.LOGISTIC_REGRESSION,
+            solve_schedule=SolveSchedule(chunk_size=4),
+        )
+        with pytest.raises(ValueError, match="compaction"):
+            coord.update(
+                jnp.zeros((glmix.num_rows,), jnp.float32),
+                coord.initial_coefficients(),
+                reg_weight=jnp.asarray(0.5),
+            )
+
+    def test_bucketed_coordinate_bitwise(self, glmix):
+        from photon_ml_tpu.algorithm.bucketed_random_effect import (
+            BucketedRandomEffectCoordinate,
+        )
+
+        cfg = RandomEffectDataConfig("userId", "per_user")
+        kw = dict(
+            data=glmix,
+            config=cfg,
+            task=TaskType.LOGISTIC_REGRESSION,
+            regularization=RegularizationContext.l2(0.2),
+        )
+        plain = BucketedRandomEffectCoordinate(**kw)
+        sched = BucketedRandomEffectCoordinate(
+            **kw,
+            bundle=plain.bundle,  # share the built stacks
+            solve_schedule=SolveSchedule(chunk_size=6),
+        )
+        assert sched.cd_jit is False
+        resid = jnp.zeros((glmix.num_rows,), jnp.float32)
+        st_plain, _ = plain.update(resid, plain.initial_coefficients())
+        st_sched, _ = sched.update(resid, sched.initial_coefficients())
+        for a, b in zip(st_plain, st_sched):
+            assert np.array_equal(np.asarray(a), np.asarray(b))
+
+    def test_bucketed_rejects_mesh(self, glmix):
+        from photon_ml_tpu.algorithm.bucketed_random_effect import (
+            BucketedRandomEffectCoordinate,
+        )
+
+        with pytest.raises(ValueError, match="mesh"):
+            BucketedRandomEffectCoordinate(
+                data=glmix,
+                config=RandomEffectDataConfig("userId", "per_user"),
+                task=TaskType.LOGISTIC_REGRESSION,
+                mesh_ctx=object(),
+                solve_schedule=SolveSchedule(),
+            )
+
+    def test_streaming_coordinate_bitwise(self, glmix, tmp_path):
+        from photon_ml_tpu.algorithm.streaming_random_effect import (
+            StreamingRandomEffectCoordinate,
+            write_re_entity_blocks,
+        )
+
+        manifest = write_re_entity_blocks(
+            glmix,
+            RandomEffectDataConfig("userId", "per_user"),
+            str(tmp_path / "blocks"),
+            block_entities=16,
+        )
+        kw = dict(
+            manifest=manifest,
+            task=TaskType.LOGISTIC_REGRESSION,
+            regularization=RegularizationContext.l2(0.1),
+        )
+        plain = StreamingRandomEffectCoordinate(
+            **kw, state_root=str(tmp_path / "state-plain")
+        )
+        sched = StreamingRandomEffectCoordinate(
+            **kw,
+            state_root=str(tmp_path / "state-sched"),
+            solve_schedule=SolveSchedule(chunk_size=6),
+        )
+        resid = jnp.zeros((glmix.num_rows,), jnp.float32)
+        st_plain, res_plain = plain.update(resid, plain.initial_coefficients())
+        st_sched, res_sched = sched.update(resid, sched.initial_coefficients())
+        for i in range(len(manifest.blocks)):
+            assert np.array_equal(st_plain.block(i), st_sched.block(i)), i
+        for a, b in zip(res_plain, res_sched):
+            assert_results_bitwise(
+                jax.tree.map(np.asarray, b), jax.tree.map(np.asarray, a)
+            )
+        # scoring off the two states matches bitwise as well
+        assert np.array_equal(
+            np.asarray(plain.score(st_plain)), np.asarray(sched.score(st_sched))
+        )
+
+    def test_coordinate_descent_end_to_end(self, glmix):
+        """A full CD run with a scheduled RE coordinate equals the
+        unscheduled run bitwise (the cd_jit=False raw-update path)."""
+        from photon_ml_tpu.algorithm.coordinate_descent import CoordinateDescent
+        from photon_ml_tpu.ops import losses
+
+        loss = losses.for_task(TaskType.LOGISTIC_REGRESSION)
+        labels = jnp.asarray(glmix.response)
+        weights = jnp.asarray(glmix.weight)
+        loss_fn = lambda total: jnp.sum(weights * loss.loss(total, labels))
+
+        def run(schedule):
+            ds = build_random_effect_dataset(
+                glmix, RandomEffectDataConfig("userId", "per_user")
+            )
+            coord = RandomEffectCoordinate(
+                dataset=ds,
+                task=TaskType.LOGISTIC_REGRESSION,
+                regularization=RegularizationContext.l2(0.1),
+                solve_schedule=schedule,
+            )
+            cd = CoordinateDescent({"per_user": coord}, loss_fn)
+            return cd.run(num_iterations=2, num_rows=glmix.num_rows)
+
+        base = run(None)
+        comp = run(SolveSchedule(chunk_size=7))
+        assert np.array_equal(
+            np.asarray(base.coefficients["per_user"]),
+            np.asarray(comp.coefficients["per_user"]),
+        )
+        np.testing.assert_array_equal(
+            np.asarray(base.objective_history), np.asarray(comp.objective_history)
+        )
