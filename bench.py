@@ -1261,9 +1261,158 @@ def _bench_compaction(extra, on_tpu):
     }
 
 
+def _bench_preempt(extra, on_tpu):
+    """Preemption-safe training (resilience/preemption.py +
+    checkpoint_async.py): (1) emergency-checkpoint latency — how long the
+    drain boundary blocks on save() with the synchronous writer vs the
+    background-commit wrapper (the async save returns after the host
+    snapshot; the commit overlaps the next solve); (2) preempt-and-resume
+    overhead — a compacted solve interrupted at a chunk boundary and
+    resumed from its snapshot vs running uninterrupted, pinned BITWISE, and
+    the resume must reuse the warm shape-ladder executables (ZERO new
+    solver compiles, CompileStats-asserted)."""
+    import tempfile
+
+    import jax
+    import jax.numpy as jnp
+
+    from photon_ml_tpu.checkpoint import (
+        CheckpointState,
+        CoordinateDescentCheckpointer,
+    )
+    from photon_ml_tpu.checkpoint_async import AsyncCheckpointer
+    from photon_ml_tpu.compile import compile_stats
+    from photon_ml_tpu.ops.regularization import RegularizationContext
+    from photon_ml_tpu.optim.common import OptimizerConfig
+    from photon_ml_tpu.optim.scheduler import SolveSchedule, compacted_solve
+    from photon_ml_tpu.resilience import preemption
+    from photon_ml_tpu.resilience.preemption import Preempted
+    from photon_ml_tpu.types import OptimizerType, TaskType
+
+    # ---- emergency-checkpoint latency: sync vs async commit ---------------
+    rng = np.random.default_rng(3)
+    big = rng.normal(size=(2_000_000,)).astype(np.float32)  # ~8MB payload
+
+    def state(step):
+        return CheckpointState(
+            step=step, params={"fe": jnp.asarray(big)},
+            scores={"fe": jnp.asarray(big[:1000])},
+            total_scores=jnp.asarray(big[:1000]),
+            objective_history=[0.0], validation_history=[],
+        )
+
+    reps = 5
+    with tempfile.TemporaryDirectory() as d:
+        sync_ck = CoordinateDescentCheckpointer(d, keep=2)
+        t0 = time.perf_counter()
+        for s in range(1, reps + 1):
+            sync_ck.save(state(s))
+        t_sync = (time.perf_counter() - t0) / reps
+    with tempfile.TemporaryDirectory() as d:
+        async_ck = AsyncCheckpointer(
+            CoordinateDescentCheckpointer(d, keep=2), max_pending=2
+        )
+        t0 = time.perf_counter()
+        for s in range(1, reps + 1):
+            async_ck.save(state(s))  # returns after the host snapshot
+        t_async_save = (time.perf_counter() - t0) / reps
+        t0 = time.perf_counter()
+        async_ck.wait()  # the fence pays the remaining commit time ONCE
+        t_fence = time.perf_counter() - t0
+        async_ck.close()
+    _log(
+        f"preempt: checkpoint save stall {t_sync*1e3:.1f}ms sync vs "
+        f"{t_async_save*1e3:.1f}ms async (+{t_fence*1e3:.1f}ms one-time "
+        f"fence) — commit overlaps the solve"
+    )
+    if t_async_save >= t_sync:
+        raise AssertionError(
+            f"async save ({t_async_save*1e3:.1f}ms) did not beat the "
+            f"synchronous save stall ({t_sync*1e3:.1f}ms)"
+        )
+
+    # ---- preempt -> emergency snapshot -> resume, bitwise + zero compiles -
+    E = 1024 if on_tpu else 256
+    M, D, hard = 24, 12, 6
+    x = rng.normal(size=(E, M, D)).astype(np.float32)
+    x[:hard] *= np.geomspace(1.0, 48.0, D).astype(np.float32)
+    w_true = (rng.normal(size=(E, D)) * 0.5).astype(np.float32)
+    z = np.einsum("emd,ed->em", x.astype(np.float64), w_true)
+    y = (1.0 / (1.0 + np.exp(-z)) > rng.random((E, M))).astype(np.float32)
+    data = tuple(
+        jnp.asarray(a)
+        for a in (x, y, np.zeros((E, M), np.float32), np.ones((E, M), np.float32))
+    )
+    w0 = jnp.zeros((E, D), jnp.float32)
+    kw = dict(
+        task=TaskType.LOGISTIC_REGRESSION, optimizer=OptimizerType.LBFGS,
+        optimizer_config=OptimizerConfig(max_iterations=96, tolerance=1e-7),
+        regularization=RegularizationContext.l2(1.0),
+        schedule=SolveSchedule(chunk_size=12),
+    )
+    ref = compacted_solve(data, w0, label="warmup", **kw)  # compile + warm
+    jax.block_until_ready(ref.coefficients)
+    t0 = time.perf_counter()
+    ref = compacted_solve(data, w0, label="uninterrupted", **kw)
+    jax.block_until_ready(ref.coefficients)
+    t_clean = time.perf_counter() - t0
+
+    preemption.reset()
+    preemption.install_plan({"chunk": 2})
+    sites = ("scheduler.init", "scheduler.chunk",
+             "scheduler.compact", "scheduler.scatter")
+    t0 = time.perf_counter()
+    try:
+        compacted_solve(data, w0, label="interrupted", **kw)
+        raise AssertionError("preemption plan never fired")
+    except Preempted as e:
+        partial = e.partial
+    t_interrupted = time.perf_counter() - t0
+    preemption.reset()
+    traces_before = {s: compile_stats.traces_of(s) for s in sites}
+    t0 = time.perf_counter()
+    res = compacted_solve(data, w0, label="resumed", resume=partial, **kw)
+    jax.block_until_ready(res.coefficients)
+    t_resume = time.perf_counter() - t0
+    new_compiles = sum(
+        compile_stats.traces_of(s) - traces_before[s] for s in sites
+    )
+    bitwise = all(
+        np.array_equal(np.asarray(a), np.asarray(b), equal_nan=True)
+        for a, b in zip(res[:7], ref[:7])
+        if a is not None
+    )
+    overhead = (t_interrupted + t_resume) / max(t_clean, 1e-9) - 1.0
+    _log(
+        f"preempt: uninterrupted {t_clean*1e3:.1f}ms vs interrupted+resume "
+        f"{(t_interrupted + t_resume)*1e3:.1f}ms ({overhead*100:+.1f}% "
+        f"overhead); bitwise={bitwise}, new solver compiles on warm "
+        f"resume={new_compiles}"
+    )
+    if not bitwise:
+        raise AssertionError("preempted+resumed solve is not bitwise-equal")
+    if new_compiles != 0:
+        raise AssertionError(
+            f"{new_compiles} new solver compiles on warm resume — the "
+            "snapshot restore must land on the existing shape-ladder "
+            "executables"
+        )
+    extra["preempt_ckpt_sync_ms"] = round(t_sync * 1e3, 2)
+    extra["preempt_ckpt_async_save_ms"] = round(t_async_save * 1e3, 2)
+    extra["preempt_ckpt_fence_ms"] = round(t_fence * 1e3, 2)
+    extra["preempt_uninterrupted_ms"] = round(t_clean * 1e3, 2)
+    extra["preempt_resume_total_ms"] = round(
+        (t_interrupted + t_resume) * 1e3, 2
+    )
+    extra["preempt_resume_overhead_pct"] = round(overhead * 100, 1)
+    extra["preempt_bitwise_equal"] = bool(bitwise)
+    extra["preempt_new_compiles_on_resume"] = int(new_compiles)
+
+
 SECTION_ORDER = (
     "dense", "sparse", "game", "game5", "grid",
     "streaming", "streaming_pipeline", "compile_reuse", "compaction",
+    "preemption_resume",
     "perhost", "scoring", "ingest",
 )
 # orchestrator per-section deadlines (s): generous — tunnel compiles are slow,
@@ -1328,6 +1477,8 @@ def _run_sections(names, extra, errors, on_tpu, state=None, after=None):
                 _bench_compile_reuse(extra, on_tpu)
             elif name == "compaction":
                 _bench_compaction(extra, on_tpu)
+            elif name == "preemption_resume":
+                _bench_preempt(extra, on_tpu)
             elif name == "perhost":
                 _bench_perhost(extra, on_tpu)
             elif name == "scoring":

@@ -332,11 +332,24 @@ class BucketedRandomEffectCoordinate:
         """Each bucket gathers ITS rows' residuals (row indices were
         remapped to global order at build time) and solves independently —
         buckets are disjoint entity sets, so no cross-bucket coupling."""
+        from photon_ml_tpu.resilience import preemption as _preemption
+
         new_state = []
         results = []
         for unit, row_sel, w0 in zip(self._units(), self._row_sels, state):
             local_resid = residual_offsets[jnp.asarray(row_sel)]
-            coefs, res = unit.update(local_resid, w0)
+            try:
+                coefs, res = unit.update(local_resid, w0)
+            except _preemption.Preempted as e:
+                # a scheduled bucket drained at a chunk boundary. This
+                # coordinate does not implement mid-bucket resume (the
+                # snapshot carries no bucket index), so DROP the partial:
+                # the emergency checkpoint lands at the previous update
+                # boundary and the relaunch recomputes this coordinate
+                # whole — correct, just not mid-solve-granular
+                raise _preemption.Preempted(
+                    str(e), site=e.site, partial=None
+                ) from e
             new_state.append(coefs)
             results.append(res)
         return tuple(new_state), tuple(results)

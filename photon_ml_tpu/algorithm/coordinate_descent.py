@@ -15,6 +15,7 @@ elementwise add/subtract; the persist/unpersist choreography disappears
 from __future__ import annotations
 
 import dataclasses
+import os
 import time
 from typing import TYPE_CHECKING, Callable, Dict, List, Optional, Tuple
 
@@ -23,6 +24,7 @@ import jax.numpy as jnp
 
 from photon_ml_tpu.evaluation.evaluators import Evaluator
 from photon_ml_tpu.resilience import faults as _faults
+from photon_ml_tpu.resilience import preemption as _preemption
 from photon_ml_tpu.types import real_dtype
 
 if TYPE_CHECKING:  # pragma: no cover
@@ -217,6 +219,7 @@ class CoordinateDescent:
         num_iterations: int,
         num_rows: int,
         init_params: Optional[Dict[str, Array]] = None,
+        checkpointers: Optional[List[Optional[object]]] = None,
     ) -> List[CoordinateDescentResult]:
         """Train a lambda grid through ONE compiled descent cycle: the
         traced-``reg_weight`` cycle compiles once and every combo reuses the
@@ -242,6 +245,17 @@ class CoordinateDescent:
         ``init_params`` (coordinate name -> unbatched params) warm-starts
         every combo's solver from the same point (e.g. a cheap pre-solve at
         one lambda), cutting each solve's while_loop iteration count.
+
+        ``checkpointers`` (one per combo, or None) enables PER-CYCLE
+        checkpoints on the grid: the compiled cycle returns at iteration
+        granularity, so each crossed ``save_every`` boundary (and the final
+        iteration) lands a checkpoint of the combo's (params, scores,
+        total) lane pytree, and a restart resumes the combo from its last
+        complete iteration — finished combos replay from their final
+        checkpoint without re-solving. Per-UPDATE granularity is the one
+        thing the grid cannot offer (updates live inside the compiled
+        cycle); the iteration boundaries are also cooperative-preemption
+        drain points, exactly like the fused cycle.
 
         Returns one CoordinateDescentResult per combo, in input order.
         """
@@ -307,9 +321,16 @@ class CoordinateDescent:
                 s0 = self.coordinates[n].score(jnp.asarray(init_params[n], dt))
                 scores0[n] = jnp.broadcast_to(s0, (1, num_rows)).astype(dt)
                 total0 = total0 + scores0[n]
+        if checkpointers is not None and len(checkpointers) != g:
+            raise ValueError(
+                f"checkpointers must match the grid ({g} combos), "
+                f"got {len(checkpointers)}"
+            )
+        n_coords = len(names)
         out = []
         for i in range(g):
             lam_i = {n: lam[n][i : i + 1] for n in names}
+            ck = checkpointers[i] if checkpointers is not None else None
             if self._donate:
                 # the donating cycle consumes its (params, scores, total)
                 # inputs — hand every combo a fresh copy of the shared
@@ -321,31 +342,91 @@ class CoordinateDescent:
                 params = dict(params0)
                 scores = dict(scores0)
                 total = total0
+            objective_history: List[float] = []
+            validation_history: List[Dict[str, float]] = []
+            start_iter = 0
+            if ck is not None:
+                restored = ck.restore(params0, scores0, total0)
+                if restored is not None:
+                    # grid checkpoints land only at iteration boundaries,
+                    # so a restored step is always iteration-aligned
+                    start_iter = restored.step // n_coords
+                    params = restored.params
+                    scores = restored.scores
+                    total = restored.total_scores
+                    objective_history = restored.objective_history
+                    validation_history = restored.validation_history
 
             t0 = time.perf_counter()
             objective_dev: List[Array] = []
             validation_dev: List[Dict[str, Array]] = []
-            for _ in range(num_iterations):
+
+            def _drain():
+                # one batched transfer each, like run()'s _drain — never
+                # one RTT per scalar over a remote device tunnel
+                if objective_dev:
+                    objective_history.extend(
+                        float(o[0]) for o in jax.device_get(objective_dev)
+                    )
+                    objective_dev.clear()
+                if validation_dev:
+                    validation_history.extend(
+                        {k: float(v[0]) for k, v in m.items()}
+                        for m in jax.device_get(validation_dev)
+                    )
+                    validation_dev.clear()
+
+            def _save(step):
+                from photon_ml_tpu.checkpoint import CheckpointState
+
+                _drain()
+                ck.save(
+                    CheckpointState(
+                        step=step,
+                        params=params,
+                        scores=scores,
+                        total_scores=total,
+                        objective_history=objective_history,
+                        validation_history=validation_history,
+                    )
+                )
+
+            for it in range(start_iter, num_iterations):
+                step = (it + 1) * n_coords
                 params, scores, total, objs, vals = cycle_v(
                     params, scores, total, lam_i
                 )
                 objective_dev.extend(objs)
                 validation_dev.extend(vals)
+                is_last = it == num_iterations - 1
+                saved_here = ck is not None and (
+                    step % ck.save_every < n_coords or is_last
+                )
+                if saved_here:
+                    _save(step)
+                if not is_last and _preemption.check(
+                    "cycle", step=step, combo=i
+                ):
+                    if ck is not None:
+                        if not saved_here:
+                            _save(step)
+                        if hasattr(ck, "wait"):
+                            ck.wait()
+                    raise _preemption.Preempted(
+                        f"preempted at grid iteration boundary (combo {i}, "
+                        f"step {step}): {_preemption.reason()}",
+                        site="cycle",
+                    )
             jax.block_until_ready(total)
             elapsed = time.perf_counter() - t0
 
-            # one batched transfer each, like run()'s _drain — never one
-            # RTT per scalar over a remote device tunnel
-            obj_host = jax.device_get(objective_dev)  # list of (1,)
-            val_host = jax.device_get(validation_dev)  # list of {key: (1,)}
+            _drain()
             out.append(
                 CoordinateDescentResult(
                     coefficients={n: params[n][0] for n in names},
                     total_scores=total[0],
-                    objective_history=[float(o[0]) for o in obj_host],
-                    validation_history=[
-                        {k: float(v[0]) for k, v in m.items()} for m in val_host
-                    ],
+                    objective_history=objective_history,
+                    validation_history=validation_history,
                     timings={"(grid)": elapsed},
                 )
             )
@@ -412,6 +493,7 @@ class CoordinateDescent:
             total = total + scores[n]  # zeros unless warm-started above
 
         start_step = 0
+        midstep = None  # mid-coordinate resume payload from an emergency ckpt
         if checkpointer is not None:
             restored = checkpointer.restore(params, scores, total)
             if restored is not None:
@@ -421,6 +503,7 @@ class CoordinateDescent:
                 total = restored.total_scores
                 objective_history = restored.objective_history
                 validation_history = restored.validation_history
+                midstep = restored.partial
 
         def _drain():
             """Pull accumulated device scalars to host (one batched transfer)."""
@@ -433,6 +516,40 @@ class CoordinateDescent:
                     {k: float(v) for k, v in m.items()} for m in host
                 )
                 validation_dev.clear()
+
+        def _emergency_save(at_step: int, partial=None, already_saved=False):
+            """Drain-to-boundary checkpoint for a preemption exit: make the
+            completed work durable NOW (and fence an async commit) so the
+            relaunched process resumes instead of recomputing. Returns the
+            checkpoint path, or None without a checkpointer (the process
+            still exits with the distinct preemption code — the supervisor
+            just restarts from scratch)."""
+            if checkpointer is None:
+                return None
+            from photon_ml_tpu.checkpoint import STEP_PREFIX, CheckpointState
+
+            _drain()
+            # the boundary save a moment ago already covers this step
+            path = os.path.join(
+                checkpointer.directory, f"{STEP_PREFIX}{at_step}"
+            )
+            if not already_saved or partial is not None:
+                path = checkpointer.save(
+                    CheckpointState(
+                        step=at_step,
+                        params=params,
+                        scores=scores,
+                        total_scores=total,
+                        objective_history=objective_history,
+                        validation_history=validation_history,
+                        partial=partial,
+                    )
+                )
+            # the fence: an async commit must be durable before the process
+            # exits on the preemption path
+            if hasattr(checkpointer, "wait"):
+                checkpointer.wait()
+            return path
 
         guard = self.divergence_guard
         guard_events_start = len(guard.events) if guard is not None else 0
@@ -494,9 +611,10 @@ class CoordinateDescent:
                 # steps advance n_coords at a time here: fire whenever a
                 # save_every boundary was CROSSED this iteration, not only
                 # when step lands exactly on a multiple
-                if checkpointer is not None and (
+                saved_here = checkpointer is not None and (
                     step % checkpointer.save_every < n_coords or is_last
-                ):
+                )
+                if saved_here:
                     from photon_ml_tpu.checkpoint import CheckpointState
 
                     _drain()
@@ -509,6 +627,19 @@ class CoordinateDescent:
                             objective_history=objective_history,
                             validation_history=validation_history,
                         )
+                    )
+                # cooperative preemption: iteration boundaries are the fused
+                # cycle's only safe points (per-update state lives inside
+                # the compiled program) — and they are iteration-ALIGNED, so
+                # an emergency checkpoint here always satisfies the fused
+                # resume contract above
+                if not is_last and _preemption.check("cycle", step=step):
+                    path = _emergency_save(step, already_saved=saved_here)
+                    raise _preemption.Preempted(
+                        f"preempted at iteration boundary (step {step}): "
+                        f"{_preemption.reason()}",
+                        site="cycle",
+                        checkpoint_path=path,
                     )
             _drain()
             return CoordinateDescentResult(
@@ -534,9 +665,46 @@ class CoordinateDescent:
                 if not skip_rest_of_cycle:
                     partial = total - scores[name]  # sum of the OTHER coordinates
                     t0 = time.perf_counter()
-                    new_params, trackers[name] = self._update_fns[name](
-                        partial, params[name]
-                    )
+                    try:
+                        if midstep is not None and step == int(
+                            midstep["meta"].get("resume_step", -1)
+                        ):
+                            # the emergency checkpoint interrupted THIS step:
+                            # hand the in-flight coordinate its paused state
+                            # (scheduler carries / per-block progress) so it
+                            # finishes instead of restarting — bitwise the
+                            # same coefficients either way
+                            mid_name = midstep["meta"].get("coordinate")
+                            if mid_name != name:
+                                raise ValueError(
+                                    f"checkpoint partial targets coordinate "
+                                    f"{mid_name!r} at step {step} but the "
+                                    f"sequence reaches {name!r} — updating "
+                                    "sequence changed; refusing to resume"
+                                )
+                            new_params, trackers[name] = self.coordinates[
+                                name
+                            ].update(partial, params[name], resume=midstep)
+                            midstep = None
+                        else:
+                            new_params, trackers[name] = self._update_fns[name](
+                                partial, params[name]
+                            )
+                    except _preemption.Preempted as e:
+                        # an inner loop drained at a block/chunk boundary:
+                        # checkpoint the completed steps PLUS the in-flight
+                        # coordinate's progress, then unwind to the driver
+                        payload = dict(e.partial) if e.partial else None
+                        if payload is not None:
+                            payload["meta"] = dict(
+                                payload.get("meta") or {},
+                                coordinate=name,
+                                resume_step=step,
+                            )
+                        e.checkpoint_path = _emergency_save(
+                            step - 1, partial=payload
+                        )
+                        raise
                     # chaos-test hook: a kind="nan" fault at this site
                     # corrupts the update exactly like a diverged solve
                     new_params = _faults.corrupt(
@@ -576,9 +744,10 @@ class CoordinateDescent:
                     )
 
                 is_last = it == num_iterations - 1 and name == names[-1]
-                if checkpointer is not None and (
+                saved_here = checkpointer is not None and (
                     step % checkpointer.save_every == 0 or is_last
-                ):
+                )
+                if saved_here:
                     from photon_ml_tpu.checkpoint import CheckpointState
 
                     _drain()
@@ -591,6 +760,18 @@ class CoordinateDescent:
                             objective_history=objective_history,
                             validation_history=validation_history,
                         )
+                    )
+                # cooperative preemption: every update boundary is a safe
+                # drain point — make the finished step durable and unwind
+                # with the distinct exit path (the final update just
+                # finishes; there is nothing left to preempt)
+                if not is_last and _preemption.check("cycle", step=step):
+                    path = _emergency_save(step, already_saved=saved_here)
+                    raise _preemption.Preempted(
+                        f"preempted at update boundary (step {step}): "
+                        f"{_preemption.reason()}",
+                        site="cycle",
+                        checkpoint_path=path,
                     )
 
         _drain()

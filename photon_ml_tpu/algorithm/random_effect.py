@@ -172,13 +172,18 @@ class RandomEffectCoordinate:
         return ds.base_offsets + jnp.where(ds.row_index >= 0, gathered, 0.0)
 
     def update(self, residual_offsets: Array, init_coefficients: Array,
-               reg_weight: Optional[Array] = None) -> Tuple[Array, OptResult]:
+               reg_weight: Optional[Array] = None,
+               resume: Optional[dict] = None) -> Tuple[Array, OptResult]:
         """Solve every entity's local problem (vmapped).
 
         ``residual_offsets`` is the global (N,) residual-score vector from
         the other coordinates. ``reg_weight`` overrides the context's
         total regularization weight as a TRACED scalar (the lambda-grid
-        vmap axis).
+        vmap axis). ``resume`` is a scheduler preemption snapshot (the
+        ``partial`` payload of a
+        :class:`~photon_ml_tpu.resilience.preemption.Preempted` raised at a
+        chunk boundary) — the interrupted solve continues bitwise-identically
+        from its paused carries; only valid with a ``solve_schedule``.
 
         Returns stacked coefficients (E, D_loc) and the vmapped OptResult
         (every field gains a leading entity axis — this is the
@@ -205,9 +210,16 @@ class RandomEffectCoordinate:
                 regularization=self.regularization,
                 schedule=self.solve_schedule,
                 label=self.solve_label,
+                resume=resume,
             )
             return results.coefficients, results
 
+        if resume is not None:
+            raise ValueError(
+                "a mid-solve resume snapshot needs the convergence "
+                "scheduler's chunk boundaries; this coordinate solves "
+                "one-shot (no solve_schedule)"
+            )
         solve_one, _, _, _ = entity_lane_fns(
             self.task, self.optimizer, self.optimizer_config,
             self.regularization, reg_weight,

@@ -50,6 +50,7 @@ from photon_ml_tpu.data.game import (
 )
 from photon_ml_tpu.optim.common import OptimizerConfig
 from photon_ml_tpu.ops.regularization import RegularizationContext
+from photon_ml_tpu.resilience import preemption as _preemption
 from photon_ml_tpu.types import OptimizerType, TaskType, real_dtype
 
 Array = jax.Array
@@ -292,16 +293,19 @@ class StreamingREManifest:
         return self._block_from_host(self.load_block_host(i))
 
     def iter_blocks(
-        self, prefetch_depth: Optional[int] = None
+        self, prefetch_depth: Optional[int] = None, start: int = 0
     ) -> "Iterator[Tuple[int, RandomEffectDataset, np.ndarray, np.ndarray]]":
-        """Yield ``(i, dataset, row_sel, dense_ids)`` for every block with
-        the async pipeline (io/pipeline.py): up to ``prefetch_depth`` blocks
-        are read + page-faulted on a background thread while earlier blocks
-        solve, and the NEXT block's host->device transfer (``jnp.asarray``,
-        an async dispatch) is issued while the CURRENT block is consumed —
-        double-buffered H2D. Depth <= 0 is the synchronous loop; block order
-        and arithmetic are identical either way, so results are
-        bit-identical with the pipeline on or off."""
+        """Yield ``(i, dataset, row_sel, dense_ids)`` for every block from
+        ``start`` on, with the async pipeline (io/pipeline.py): up to
+        ``prefetch_depth`` blocks are read + page-faulted on a background
+        thread while earlier blocks solve, and the NEXT block's
+        host->device transfer (``jnp.asarray``, an async dispatch) is
+        issued while the CURRENT block is consumed — double-buffered H2D.
+        Depth <= 0 is the synchronous loop; block order and arithmetic are
+        identical either way, so results are bit-identical with the
+        pipeline on or off. ``start`` (a preemption resume) skips finished
+        blocks BEFORE the prefetcher reads them, so resume cost is
+        proportional to the remaining work, not the whole epoch."""
         from photon_ml_tpu.io.pipeline import (
             Prefetcher,
             device_pipelined,
@@ -311,12 +315,12 @@ class StreamingREManifest:
         depth = resolve_depth(prefetch_depth)
         n = len(self.blocks)
         if depth <= 0:
-            for i in range(n):
+            for i in range(start, n):
                 ds, row_sel, dense_ids = self.load_block(i)
                 yield i, ds, row_sel, dense_ids
             return
         host_blocks = Prefetcher(
-            lambda: (self.load_block_host(i) for i in range(n)),
+            lambda: (self.load_block_host(i) for i in range(start, n)),
             depth=depth,
             name="re-block-prefetch",
         )
@@ -390,6 +394,46 @@ class SpilledREState:
         with open(path + ".tmp", "wb") as f:
             np.save(f, np.asarray(arr))
         os.replace(path + ".tmp", path)
+
+    # -- checkpoint-by-reference protocol (photon_ml_tpu.checkpoint) --------
+    # the coefficients are ALREADY durable (atomic per-block .npy spills),
+    # so a descent checkpoint stores the directory handle, not the arrays:
+    # streaming runs checkpoint without ever materializing the full stack
+    def __checkpoint_ref__(self) -> dict:
+        return {
+            "kind": "spilled_re_state",
+            "dir": self.dir,
+            "shapes": [list(map(int, s)) for s in self.shapes],
+            # distinguishes "never written: zeros by design" (the initial
+            # state) from "written but since vanished" — the latter must
+            # REJECT on restore, or block() would silently serve zeros for
+            # trained coefficients
+            "written": os.path.isdir(self.dir),
+        }
+
+    def __checkpoint_from_ref__(self, ref: dict) -> "SpilledREState":
+        from photon_ml_tpu.checkpoint import CheckpointRefError
+
+        if ref.get("kind") != "spilled_re_state":
+            raise CheckpointRefError(
+                f"checkpoint ref kind {ref.get('kind')!r} is not a spilled "
+                "streaming state — coordinate types changed since the save"
+            )
+        shapes = [tuple(s) for s in ref["shapes"]]
+        if shapes != [tuple(s) for s in self.shapes]:
+            raise CheckpointRefError(
+                "spilled-state ref shapes do not match this manifest's "
+                f"blocks ({shapes[:3]}... vs {self.shapes[:3]}...) — the "
+                "streaming blocks were rebuilt differently; refusing to resume"
+            )
+        if ref.get("written") and not os.path.isdir(ref["dir"]):
+            raise CheckpointRefError(
+                f"spilled coefficient dir {ref['dir']} referenced by this "
+                "checkpoint no longer exists (epoch GC'd or output dir "
+                "wiped) — restoring would silently zero trained "
+                "coefficients; falling back to an older step"
+            )
+        return SpilledREState(dir=ref["dir"], shapes=shapes)
 
 
 # ONE jitted update/score kernel shared by every block of every streaming
@@ -558,8 +602,27 @@ class StreamingRandomEffectCoordinate:
             ),
         )
 
+    def _partial_payload(self, new_state: SpilledREState, blocks_done: int,
+                         inner: Optional[dict] = None) -> dict:
+        """Preemption ``partial`` payload: per-block progress (the finished
+        blocks' coefficients are ALREADY durable in the epoch dir) plus, for
+        a mid-chunk interruption, the in-flight block's scheduler snapshot
+        nested with prefixed array keys."""
+        meta = {
+            "kind": "streaming_re",
+            "epoch": self._epoch,
+            "epoch_dir": new_state.dir,
+            "blocks_done": blocks_done,
+            "inner": inner["meta"] if inner is not None else None,
+        }
+        arrays = {}
+        if inner is not None:
+            arrays = {f"inner.{k}": v for k, v in inner["arrays"].items()}
+        return {"meta": meta, "arrays": arrays}
+
     def update(
-        self, residual_offsets: Array, state: SpilledREState
+        self, residual_offsets: Array, state: SpilledREState,
+        resume: Optional[dict] = None,
     ) -> Tuple[SpilledREState, tuple]:
         """One block resident at a time: load slab, gather the block rows'
         residuals, run the vmapped solve, spill the coefficients, release.
@@ -567,24 +630,64 @@ class StreamingRandomEffectCoordinate:
         valid (CD may still reference it), while epochs older than that are
         garbage-collected — without GC a C-combo x I-iteration grid would
         leave C*I full coefficient copies on disk, for exactly the
-        workloads too big to be casual about storage."""
+        workloads too big to be casual about storage.
+
+        Block boundaries are PREEMPTION drain points: a request observed
+        between blocks raises
+        :class:`~photon_ml_tpu.resilience.preemption.Preempted` with this
+        coordinate's per-block progress (finished blocks are already spilled
+        atomically; a mid-chunk interruption inside a scheduled block nests
+        the scheduler's snapshot). Passing that payload back as ``resume``
+        continues from the first unfinished block — the completed blocks'
+        tracker summaries are not recomputed (``None`` placeholders), the
+        coefficients are bitwise those of an uninterrupted update."""
         import shutil
 
-        self._epoch += 1
-        for old in range(1, self._epoch - 1):
-            shutil.rmtree(
-                os.path.join(self.state_root, f"epoch-{old}"),
-                ignore_errors=True,
+        inner_resume = None
+        if resume is not None:
+            m = resume["meta"]
+            if m.get("kind") != "streaming_re":
+                raise ValueError(
+                    f"resume payload kind {m.get('kind')!r} is not a "
+                    "streaming-RE progress snapshot"
+                )
+            # continue the interrupted epoch IN PLACE: its dir already holds
+            # blocks 0..blocks_done-1 (each spilled atomically); no GC here —
+            # the previous epoch must survive as this update's input
+            self._epoch = int(m["epoch"])
+            new_state = SpilledREState(dir=m["epoch_dir"], shapes=self._shapes)
+            start_block = int(m["blocks_done"])
+            if m.get("inner") is not None:
+                inner_resume = {
+                    "meta": m["inner"],
+                    "arrays": {
+                        k[len("inner."):]: v
+                        for k, v in (resume.get("arrays") or {}).items()
+                        if k.startswith("inner.")
+                    },
+                }
+        else:
+            self._epoch += 1
+            for old in range(1, self._epoch - 1):
+                shutil.rmtree(
+                    os.path.join(self.state_root, f"epoch-{old}"),
+                    ignore_errors=True,
+                )
+            new_state = SpilledREState(
+                dir=os.path.join(self.state_root, f"epoch-{self._epoch}"),
+                shapes=self._shapes,
             )
-        new_state = SpilledREState(
-            dir=os.path.join(self.state_root, f"epoch-{self._epoch}"),
-            shapes=self._shapes,
-        )
+            start_block = 0
         resid_host = None
-        summaries = []
+        # finished blocks were solved and spilled before the interruption;
+        # their tracker summaries are telemetry and are not recomputed
+        summaries = [None] * start_block
         # pipelined block loop: block k+1 reads from disk + transfers H2D
-        # on the background stage while block k's vmapped solve runs
-        for i, ds, row_sel, _ in self.manifest.iter_blocks(self.prefetch_depth):
+        # on the background stage while block k's vmapped solve runs —
+        # resume starts the pipeline AT the first unfinished block
+        for i, ds, row_sel, _ in self.manifest.iter_blocks(
+            self.prefetch_depth, start=start_block
+        ):
             if isinstance(residual_offsets, jax.Array):
                 local_resid = residual_offsets[jnp.asarray(row_sel)]
             else:
@@ -597,9 +700,18 @@ class StreamingRandomEffectCoordinate:
                 # the scheduler's process-shared chunk kernels (same-ladder
                 # blocks reuse executables; the prefetch pipeline keeps
                 # feeding blocks while chunks run)
-                coefs, res = self._sub_for(ds, block=i).update(
-                    self._padded_resid(local_resid, ds), w0
-                )
+                try:
+                    coefs, res = self._sub_for(ds, block=i).update(
+                        self._padded_resid(local_resid, ds), w0,
+                        resume=(inner_resume if i == start_block else None),
+                    )
+                except _preemption.Preempted as e:
+                    # mid-chunk inside block i: wrap the scheduler snapshot
+                    # with this coordinate's block progress and unwind
+                    raise _preemption.Preempted(
+                        str(e), site=e.site,
+                        partial=self._partial_payload(new_state, i, e.partial),
+                    ) from e
             else:
                 coefs, res = self._update_fn(
                     ds, self._padded_resid(local_resid, ds), w0
@@ -609,6 +721,16 @@ class StreamingRandomEffectCoordinate:
             # as device arrays would pin every block's buffers alive
             summaries.append(jax.tree.map(np.asarray, res))
             del ds, coefs, res
+            if i + 1 < len(self.manifest.blocks) and _preemption.check(
+                "block", block=i, epoch=self._epoch
+            ):
+                raise _preemption.Preempted(
+                    f"preempted at block boundary (block {i + 1}/"
+                    f"{len(self.manifest.blocks)}, epoch {self._epoch}): "
+                    f"{_preemption.reason()}",
+                    site="block",
+                    partial=self._partial_payload(new_state, i + 1),
+                )
         return new_state, tuple(summaries)
 
     def score(self, state: SpilledREState) -> Array:

@@ -9,10 +9,34 @@ vectors, objective history, step counter) is written atomically; a restart
 resumes from the last complete step.
 
 Format: one directory per step (``step-<n>/``) holding an ``arrays.npz``
-with every array leaf and a ``meta.json`` with the pytree structure + a
+with every array leaf and a ``meta.json`` with the pytree structure, a
 config fingerprint that must match on resume (guards against resuming onto
-a different dataset/coordinate setup). Writes go to a temp dir renamed into
-place, so a crash mid-write never corrupts the latest checkpoint.
+a different dataset/coordinate setup), and per-array SHA-256 checksums
+verified on restore (a bit-rotten step is rejected with an actionable
+error and restore falls back to the previous intact step). Writes go to a
+temp dir renamed into place, so a crash mid-write never corrupts the
+latest checkpoint.
+
+Preemption extensions (resilience/preemption.py):
+
+  * ``CheckpointState.partial`` carries a mid-coordinate payload (the
+    convergence scheduler's paused carries, the streaming coordinate's
+    per-block progress) so an emergency checkpoint written at a drain
+    boundary resumes INSIDE the interrupted coordinate.
+  * A state leaf exposing ``__checkpoint_ref__()`` (e.g. the streaming
+    coordinate's :class:`~photon_ml_tpu.algorithm.streaming_random_effect.
+    SpilledREState`, whose coefficients already live on disk) is stored as
+    a JSON reference instead of arrays; restore rebuilds it via the
+    template leaf's ``__checkpoint_from_ref__``.
+  * The save path is split into :meth:`CoordinateDescentCheckpointer.
+    _prepare` (host snapshot — the only part that must be synchronous) and
+    ``_commit`` (retry + atomic rename), which
+    :class:`photon_ml_tpu.checkpoint_async.AsyncCheckpointer` runs on a
+    background thread so the solve never blocks on disk.
+  * Under multihost, restore first agrees on the step via a collective min
+    (:meth:`~photon_ml_tpu.parallel.multihost.MultihostContext.
+    agree_restore_step`) so no host resumes a step another host failed to
+    commit.
 """
 
 from __future__ import annotations
@@ -58,18 +82,35 @@ def _leaf_to_host(leaf) -> np.ndarray:
     return np.asarray(leaf)
 
 
+class CheckpointRefError(ValueError):
+    """A by-reference leaf could not be rebuilt (wrong kind / stale ref);
+    restore treats the step as unusable and falls back."""
+
+
+def _is_ref_leaf(x: Any) -> bool:
+    return hasattr(x, "__checkpoint_ref__")
+
+
 def _flatten_state(state: Dict[str, Any]) -> Tuple[Dict[str, np.ndarray], Dict[str, Any]]:
-    """Pytree state dict -> (flat arrays, structure description)."""
+    """Pytree state dict -> (flat arrays, structure description). Leaves
+    with a ``__checkpoint_ref__`` protocol (state that is ALREADY durable
+    on disk, e.g. spilled streaming coefficients) contribute a JSON ref in
+    the structure instead of arrays."""
     arrays: Dict[str, np.ndarray] = {}
     structure: Dict[str, Any] = {}
     for name, value in state.items():
-        leaves, treedef = jax.tree_util.tree_flatten(value)
+        leaves, treedef = jax.tree_util.tree_flatten(value, is_leaf=_is_ref_leaf)
+        refs: Dict[str, Any] = {}
         structure[name] = {
             "num_leaves": len(leaves),
             "treedef": str(treedef),  # compared against the template on restore
+            "refs": refs,
         }
         for i, leaf in enumerate(leaves):
-            arrays[f"{name}.{i}"] = _leaf_to_host(leaf)
+            if _is_ref_leaf(leaf):
+                refs[str(i)] = leaf.__checkpoint_ref__()
+            else:
+                arrays[f"{name}.{i}"] = _leaf_to_host(leaf)
     return arrays, structure
 
 
@@ -79,7 +120,7 @@ def _unflatten_state(
     """Rebuild state using the caller's template pytrees for structure."""
     out: Dict[str, Any] = {}
     for name, value in template.items():
-        leaves, treedef = jax.tree_util.tree_flatten(value)
+        leaves, treedef = jax.tree_util.tree_flatten(value, is_leaf=_is_ref_leaf)
         if name not in structure:
             raise ValueError(f"checkpoint missing state entry {name!r}")
         if structure[name]["num_leaves"] != len(leaves):
@@ -94,9 +135,51 @@ def _unflatten_state(
                 f"checkpoint entry {name!r} structure {structure[name]['treedef']} "
                 f"does not match template {str(treedef)}; refusing to resume"
             )
-        new_leaves = [jnp.asarray(arrays[f"{name}.{i}"]) for i in range(len(leaves))]
+        refs = structure[name].get("refs") or {}
+        new_leaves = []
+        for i, tmpl_leaf in enumerate(leaves):
+            if str(i) in refs:
+                if not _is_ref_leaf(tmpl_leaf):
+                    raise CheckpointRefError(
+                        f"checkpoint entry {name!r} leaf {i} was saved by "
+                        "reference but the template leaf has no "
+                        "__checkpoint_from_ref__ — coordinate types changed"
+                    )
+                new_leaves.append(tmpl_leaf.__checkpoint_from_ref__(refs[str(i)]))
+            else:
+                new_leaves.append(jnp.asarray(arrays[f"{name}.{i}"]))
         out[name] = jax.tree_util.tree_unflatten(treedef, new_leaves)
     return out
+
+
+def _checksums(arrays: Dict[str, np.ndarray]) -> Dict[str, str]:
+    """Per-array SHA-256 over the raw bytes (written into meta; verified on
+    restore so silent bit-rot is caught before it poisons a resume)."""
+    return {
+        k: hashlib.sha256(np.ascontiguousarray(v).tobytes()).hexdigest()
+        for k, v in arrays.items()
+    }
+
+
+def _verify_checksums(
+    arrays: Dict[str, np.ndarray], expected: Dict[str, str], path: str
+) -> None:
+    """Raise ValueError naming the first mismatched array (actionable: the
+    step directory to delete / the fallback restore will take)."""
+    for k, digest in expected.items():
+        if k not in arrays:
+            raise ValueError(
+                f"checkpoint {path} is missing array {k!r} listed in its "
+                "meta checksums — truncated or tampered step"
+            )
+        got = hashlib.sha256(np.ascontiguousarray(arrays[k]).tobytes()).hexdigest()
+        if got != digest:
+            raise ValueError(
+                f"checkpoint {path} array {k!r} fails its SHA-256 check "
+                f"({got[:12]} != recorded {digest[:12]}) — bit-rotten step; "
+                "restore falls back to the previous intact step (delete "
+                f"{path} to silence this warning)"
+            )
 
 
 @dataclasses.dataclass
@@ -109,6 +192,11 @@ class CheckpointState:
     total_scores: Any  # (N,)
     objective_history: List[float]
     validation_history: List[Dict[str, float]]
+    # mid-coordinate payload from a preemption drain (resilience/preemption):
+    # {"meta": JSON-able bookkeeping incl. the in-flight coordinate and
+    # resume_step, "arrays": name -> ndarray of paused solver carries} —
+    # None for ordinary boundary checkpoints
+    partial: Optional[Dict[str, Any]] = None
 
 
 class CoordinateDescentCheckpointer:
@@ -152,6 +240,11 @@ class CoordinateDescentCheckpointer:
     # ------------------------------------------------------------------
     def _step_dirs(self) -> List[Tuple[int, str]]:
         out = []
+        if not os.path.isdir(self.directory):
+            # a non-coordinator host with a per-host (non-shared) checkpoint
+            # dir that never wrote: no steps, not an error — the collective
+            # min in restore() settles what the JOB can resume
+            return out
         for name in os.listdir(self.directory):
             if name.startswith(STEP_PREFIX):
                 try:
@@ -168,33 +261,46 @@ class CoordinateDescentCheckpointer:
         return dirs[-1][0] if dirs else None
 
     # ------------------------------------------------------------------
-    def save(self, state: CheckpointState) -> str:
-        # collective: every host participates in the sharded-leaf all-gather
+    def _prepare(self, state: CheckpointState) -> Tuple[Dict[str, np.ndarray], Dict[str, Any]]:
+        """Host snapshot of ``state``: (flat arrays, meta). COLLECTIVE under
+        multihost (sharded leaves allgather) — every host must call this
+        together; only the commit that follows is coordinator-only."""
         arrays, structure = _flatten_state(
             {"params": state.params, "scores": state.scores, "total": state.total_scores}
         )
-        if self.multihost is not None and not self.multihost.coordinator_only_io():
-            # non-coordinators just fence the coordinator's write
-            self.multihost.barrier("ckpt-write")
-            return os.path.join(self.directory, f"{STEP_PREFIX}{state.step}")
+        partial_meta = None
+        if state.partial is not None:
+            partial_meta = state.partial.get("meta") or {}
+            for k, v in (state.partial.get("arrays") or {}).items():
+                arrays[f"partial.{k}"] = np.asarray(v)
         meta = {
             "step": state.step,
             "fingerprint": self.run_fingerprint,
             "structure": structure,
             "objective_history": state.objective_history,
             "validation_history": state.validation_history,
+            # checksums are stamped in _commit: hashing the full model is
+            # commit work — coordinator-only, and on the background thread
+            # under async saves — not snapshot work every host pays
+            "partial": partial_meta,
         }
+        return arrays, meta
+
+    def _commit(self, step: int, arrays: Dict[str, np.ndarray], meta: Dict[str, Any]) -> str:
+        """Durably write one prepared snapshot (retry + atomic rename) and
+        retire old steps. Pure host I/O — safe on a background thread."""
         from photon_ml_tpu import resilience
         from photon_ml_tpu.resilience import faults
 
-        final_dir = os.path.join(self.directory, f"{STEP_PREFIX}{state.step}")
+        final_dir = os.path.join(self.directory, f"{STEP_PREFIX}{step}")
+        meta = dict(meta, checksums=_checksums(arrays))
 
         def write_once() -> None:
             """One atomic write attempt: fresh temp dir -> rename. The temp
             dir is removed on ANY failure (try/finally, not a broad except)
             so a retry never inherits partial state and a crashed process
             leaves at most an ignorable .ckpt-* directory behind."""
-            faults.inject("io.checkpoint_write", step=state.step, path=final_dir)
+            faults.inject("io.checkpoint_write", step=step, path=final_dir)
             tmp_dir = tempfile.mkdtemp(prefix=TMP_PREFIX, dir=self.directory)
             renamed = False
             try:
@@ -209,16 +315,26 @@ class CoordinateDescentCheckpointer:
                 if not renamed:
                     shutil.rmtree(tmp_dir, ignore_errors=True)
 
+        resilience.call_with_retry(
+            write_once,
+            resilience.current_config().io_policy,
+            describe=f"checkpoint step {step}",
+            on_retry=lambda a, e, d: logger.warning(
+                "retrying checkpoint step %d (attempt %d): %s", step, a + 2, e
+            ),
+        )
+        self._retire()
+        return final_dir
+
+    def save(self, state: CheckpointState) -> str:
+        # collective: every host participates in the sharded-leaf all-gather
+        arrays, meta = self._prepare(state)
+        if self.multihost is not None and not self.multihost.coordinator_only_io():
+            # non-coordinators just fence the coordinator's write
+            self.multihost.barrier("ckpt-write")
+            return os.path.join(self.directory, f"{STEP_PREFIX}{state.step}")
         try:
-            resilience.call_with_retry(
-                write_once,
-                resilience.current_config().io_policy,
-                describe=f"checkpoint step {state.step}",
-                on_retry=lambda a, e, d: logger.warning(
-                    "retrying checkpoint step %d (attempt %d): %s", state.step, a + 2, e
-                ),
-            )
-            self._retire()
+            final_dir = self._commit(state.step, arrays, meta)
         finally:
             # barrier even when the write fails: non-coordinators are already
             # blocked in their "ckpt-write" barrier — skipping ours would
@@ -227,6 +343,10 @@ class CoordinateDescentCheckpointer:
             if self.multihost is not None:
                 self.multihost.barrier("ckpt-write")
         return final_dir
+
+    def wait(self) -> None:
+        """Synchronous checkpointer: every save already committed before
+        returning — the fence is a no-op (the async wrapper overrides)."""
 
     def _retire(self) -> None:
         dirs = self._step_dirs()
@@ -239,22 +359,41 @@ class CoordinateDescentCheckpointer:
         params_template: Dict[str, Any],
         scores_template: Dict[str, Any],
         total_template: Any,
+        max_step: Optional[int] = None,
+        agree: bool = True,
     ) -> Optional[CheckpointState]:
         """Load the newest complete checkpoint; None when there is none.
 
         Crash debris is tolerated: stale ``.ckpt-*`` temp dirs are never
         candidates (only ``step-*`` dirs with a meta file are), and a
-        checkpoint whose ``arrays.npz`` is truncated or undecodable (a crash
-        on a non-atomic filesystem) is skipped with a warning, falling back
+        checkpoint whose ``arrays.npz`` is truncated, undecodable (a crash
+        on a non-atomic filesystem), or failing its recorded SHA-256
+        checksums (silent bit-rot) is skipped with a warning, falling back
         to the next-newest complete step. Reads retry under the active I/O
         policy. Templates supply pytree structure (restored arrays replace
         leaves); a fingerprint mismatch raises instead of silently resuming
         a different run.
+
+        ``max_step`` caps the step considered (newer steps are ignored, not
+        deleted). Under multihost (with ``agree=True``, the default) the cap
+        defaults to the COLLECTIVE MIN of every host's latest step — no
+        host restores a step another host failed to commit; when any host
+        has nothing, the whole job starts fresh. The agreement is a
+        COLLECTIVE: every host must call restore together (the coordinate-
+        descent resume path does). A coordinator-only read-back must pass
+        ``agree=False`` or it deadlocks the allgather.
         """
         from photon_ml_tpu import resilience
 
+        if agree and max_step is None and self.multihost is not None:
+            max_step = self.multihost.agree_restore_step(self.latest_step())
+            if max_step is None:
+                return None
+
         policy = resilience.current_config().io_policy
         for step, path in reversed(self._step_dirs()):
+            if max_step is not None and step > max_step:
+                continue
             def load_meta() -> dict:
                 with open(os.path.join(path, META_FILE)) as f:
                     return json.load(f)
@@ -280,19 +419,37 @@ class CoordinateDescentCheckpointer:
                 arrays = resilience.call_with_retry(
                     load_arrays, policy, describe=f"read {path} arrays"
                 )
+                if meta.get("checksums"):
+                    # pre-checksum checkpoints (older runs) skip verification
+                    _verify_checksums(arrays, meta["checksums"], path)
             except (resilience.RetryError, zipfile.BadZipFile, ValueError, EOFError) as e:
-                # truncated/corrupt arrays.npz: this step never completed
+                # truncated/corrupt/bit-rotten arrays.npz: this step is
+                # unusable — fall back to the previous intact one
                 logger.warning("skipping corrupt checkpoint %s: %s", path, e)
                 continue
-            restored = _unflatten_state(
-                {
-                    "params": params_template,
-                    "scores": scores_template,
-                    "total": total_template,
-                },
-                arrays,
-                meta["structure"],
-            )
+            try:
+                restored = _unflatten_state(
+                    {
+                        "params": params_template,
+                        "scores": scores_template,
+                        "total": total_template,
+                    },
+                    arrays,
+                    meta["structure"],
+                )
+            except CheckpointRefError as e:
+                logger.warning("skipping unrestorable checkpoint %s: %s", path, e)
+                continue
+            partial = None
+            if meta.get("partial") is not None:
+                partial = {
+                    "meta": meta["partial"],
+                    "arrays": {
+                        k[len("partial."):]: v
+                        for k, v in arrays.items()
+                        if k.startswith("partial.")
+                    },
+                }
             return CheckpointState(
                 step=int(meta["step"]),
                 params=restored["params"],
@@ -300,5 +457,6 @@ class CoordinateDescentCheckpointer:
                 total_scores=restored["total"],
                 objective_history=list(meta["objective_history"]),
                 validation_history=list(meta["validation_history"]),
+                partial=partial,
             )
         return None

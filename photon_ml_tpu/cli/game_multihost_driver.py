@@ -219,10 +219,35 @@ def _add_multihost_flags(argv: List[str]) -> Tuple[dict, List[str]]:
 def main(argv: Optional[List[str]] = None) -> dict:
     import sys
 
+    from photon_ml_tpu.resilience import preemption
+
     mh_args, rest = _add_multihost_flags(
         list(argv) if argv is not None else sys.argv[1:]
     )
     p = parse_training_params(rest)
+
+    # SPMD preemption: every host observes the same request (the pod
+    # scheduler SIGTERMs all workers; PHOTON_PREEMPT_AT counts polls
+    # identically on every host) and drains at the same boundary, so the
+    # emergency-checkpoint collectives stay aligned. A relaunch re-ingests
+    # (the slabs are process state) and resumes descent from the
+    # collective-min checkpoint step.
+    with preemption.signal_scope():
+        try:
+            return preemption.run_with_restarts(
+                lambda attempt: _main_once(mh_args, p, restart=attempt > 0),
+                p.max_restarts,
+            )
+        except preemption.Preempted as e:
+            print(
+                f"photon-ml-tpu multihost: preempted ({e}); exiting "
+                f"{preemption.PREEMPT_EXIT_CODE}",
+                file=sys.stderr,
+            )
+            raise SystemExit(preemption.PREEMPT_EXIT_CODE) from e
+
+
+def _main_once(mh_args: dict, p, restart: bool = False) -> dict:
     mh = multihost.initialize(
         coordinator_address=mh_args["coordinator"],
         num_processes=mh_args["num_processes"],
@@ -231,11 +256,15 @@ def main(argv: Optional[List[str]] = None) -> dict:
     ctx = mh.mesh_context()
     # the coordinator owns the output dir lifecycle (incl. purge — stale
     # per-host RE part files from a previous topology must never be merged
-    # into a reloaded model); everyone else waits
+    # into a reloaded model); everyone else waits. A supervised relaunch
+    # keeps the dir — the checkpoints under it are what it resumes from.
     if mh.coordinator_only_io():
         from photon_ml_tpu.utils.io_utils import prepare_output_dir
 
-        prepare_output_dir(p.output_dir, p.delete_output_dir_if_exists)
+        if restart:
+            os.makedirs(p.output_dir, exist_ok=True)
+        else:
+            prepare_output_dir(p.output_dir, p.delete_output_dir_if_exists)
     mh.barrier("output-dir")
     logger = PhotonLogger(
         os.path.join(p.output_dir, f"photon-ml-tpu-mh-{mh.process_id}.log")
@@ -514,6 +543,14 @@ def main(argv: Optional[List[str]] = None) -> dict:
     best_coords = None
     all_metrics: List[Dict[str, float]] = []
     prev_coefficients = None
+    # per-host heartbeats (multihost health fencing): every host stamps the
+    # shared dir at its safe boundaries; the coordinator logs the ages so a
+    # wedged host — the one whose barrier everyone else is stuck in — is
+    # diagnosable by name instead of by silence
+    hb_dir = os.path.join(p.output_dir, "heartbeats")
+    mh.write_heartbeat(hb_dir, step=None)
+    if mh.coordinator_only_io():
+        logger.info(mh.describe_heartbeats(hb_dir))
     for i, combo in enumerate(combos):
         coords = build_coords(combo)
         checkpointer = None
@@ -522,33 +559,46 @@ def main(argv: Optional[List[str]] = None) -> dict:
                 CoordinateDescentCheckpointer,
                 fingerprint,
             )
+            from photon_ml_tpu.checkpoint_async import maybe_async
 
             # multihost-safe: sharded leaves are allgathered for the write,
             # the coordinator writes, barriers fence (checkpoint.py
-            # multihost mode)
-            checkpointer = CoordinateDescentCheckpointer(
-                os.path.join(p.checkpoint_dir, f"combo-{i}"),
-                run_fingerprint=fingerprint({
-                    "multihost": mh.num_processes,
-                    "coordinates": p.updating_sequence,
-                    "num_rows": n_global,
-                    "combo": i,
-                    "warm_start": mh_args["grid_warm_start"],
-                    # a config change must NOT silently resume the old run
-                    # (same rule as the single-process driver's fingerprint)
-                    "configs": {k: str(v) for k, v in combo.items()},
-                }),
-                multihost=mh,
+            # multihost mode; restore agrees on the step via collective min)
+            checkpointer = maybe_async(
+                CoordinateDescentCheckpointer(
+                    os.path.join(p.checkpoint_dir, f"combo-{i}"),
+                    run_fingerprint=fingerprint({
+                        "multihost": mh.num_processes,
+                        "coordinates": p.updating_sequence,
+                        "num_rows": n_global,
+                        "combo": i,
+                        "warm_start": mh_args["grid_warm_start"],
+                        # a config change must NOT silently resume the old run
+                        # (same rule as the single-process driver's fingerprint)
+                        "configs": {k: str(v) for k, v in combo.items()},
+                    }),
+                    multihost=mh,
+                ),
+                p.checkpoint_async,
             )
         cd = CoordinateDescent(coords, loss_fn)
-        result = cd.run(
-            num_iterations=p.num_iterations, num_rows=n_global,
-            checkpointer=checkpointer,
-            initial_params=(
-                prev_coefficients if mh_args["grid_warm_start"] else None
-            ),
-        )
+        try:
+            result = cd.run(
+                num_iterations=p.num_iterations, num_rows=n_global,
+                checkpointer=checkpointer,
+                initial_params=(
+                    prev_coefficients if mh_args["grid_warm_start"] else None
+                ),
+            )
+        finally:
+            # async fence before this combo retires (preemption already
+            # fenced inside the emergency save)
+            if checkpointer is not None and hasattr(checkpointer, "close"):
+                checkpointer.close()
         prev_coefficients = result.coefficients
+        mh.write_heartbeat(hb_dir, step=(i + 1) * p.num_iterations)
+        if mh.coordinator_only_io():
+            logger.info(mh.describe_heartbeats(hb_dir))
         logger.info(
             f"combo {i}: objective history "
             + " ".join(f"{v:.6g}" for v in result.objective_history)

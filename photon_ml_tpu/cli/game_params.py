@@ -294,6 +294,14 @@ class GameTrainingParams:
     # step-checkpoint directory (designed upgrade — the reference has no
     # mid-run checkpointing, SURVEY.md §5.4); resume is automatic
     checkpoint_dir: Optional[str] = None
+    # commit checkpoints on a background thread (checkpoint_async.py): the
+    # solve never blocks on disk; wait() fences before model save / exit
+    checkpoint_async: bool = False
+    # in-process restart supervisor (resilience/preemption.py): on a
+    # cooperative preemption (SIGTERM / PHOTON_PREEMPT_AT), relaunch from
+    # the latest checkpoint up to N times before exiting with the distinct
+    # preemption code (75)
+    max_restarts: int = 0
     # shard fixed-effect rows + random-effect entities over all visible
     # devices (jax.sharding Mesh; collectives ride ICI)
     distributed: bool = False
@@ -457,12 +465,13 @@ class GameTrainingParams:
                     "--fused-cycle (one XLA program per iteration) cannot "
                     "compose"
                 )
-            if self.checkpoint_dir:
-                errors.append(
-                    "--streaming-random-effects spills its own state between "
-                    "updates; --checkpoint-dir (array-pytree checkpoints) "
-                    "cannot serialize the spilled handle"
-                )
+            # NOTE: --checkpoint-dir composes with streaming since the
+            # preemption-safe training PR: the spilled coefficient handle
+            # checkpoints BY REFERENCE (SpilledREState.__checkpoint_ref__)
+        if self.max_restarts < 0:
+            errors.append("--max-restarts must be >= 0")
+        if self.checkpoint_async and not self.checkpoint_dir:
+            errors.append("--checkpoint-async needs --checkpoint-dir")
         if errors:
             raise ValueError("; ".join(errors))
 
@@ -515,6 +524,16 @@ def build_training_parser() -> argparse.ArgumentParser:
     # parsed for spark-submit command compatibility, ignored on TPU
     a("--min-partitions-for-validation", type=int, default=1)
     a("--checkpoint-dir", default=None)
+    a("--checkpoint-async", default="false",
+      help="commit checkpoints on a background thread through the same "
+           "retry/atomic-rename path (the solve never blocks on disk; a "
+           "wait() fence makes everything durable before model save, "
+           "process exit, and supervised relaunch)")
+    a("--max-restarts", type=int, default=0,
+      help="on a cooperative preemption (SIGTERM/SIGINT or "
+           "PHOTON_PREEMPT_AT), relaunch in-process from the latest "
+           "checkpoint up to N times before exiting with the distinct "
+           "preemption exit code (75)")
     a("--distributed", default="false")
     a("--fused-cycle", default="false",
       help="compile each full coordinate-descent iteration as ONE XLA "
@@ -610,6 +629,8 @@ def parse_training_params(argv: Optional[List[str]] = None) -> GameTrainingParam
         offheap_indexmap_dir=ns.offheap_indexmap_dir,
         evaluators=parse_evaluators(ns.evaluators),
         checkpoint_dir=ns.checkpoint_dir,
+        checkpoint_async=_truthy(ns.checkpoint_async),
+        max_restarts=ns.max_restarts,
         distributed=_truthy(ns.distributed),
         fused_cycle=_truthy(ns.fused_cycle),
         bucketed_random_effects=_truthy(ns.bucketed_random_effects),

@@ -381,9 +381,24 @@ class GameScoringDriver:
 
 
 def main(argv: Optional[List[str]] = None) -> GameScoringDriver:
+    import sys
+
+    from photon_ml_tpu.resilience import preemption
+
     params = parse_scoring_params(argv)
     driver = GameScoringDriver(params)
-    driver.run()
+    # scoring is restartable from scratch (no descent state): cooperative
+    # preemption here just means a clean distinct exit for the supervisor
+    with preemption.signal_scope():
+        try:
+            driver.run()
+        except preemption.Preempted as e:
+            print(
+                f"photon-ml-tpu game-scoring: preempted ({e}); exiting "
+                f"{preemption.PREEMPT_EXIT_CODE}",
+                file=sys.stderr,
+            )
+            raise SystemExit(preemption.PREEMPT_EXIT_CODE) from e
     return driver
 
 

@@ -705,8 +705,10 @@ class GameTrainingDriver:
             return "factored coordinates (lambda lives in nested configs)"
         if p.compute_variance:
             return "--compute-variance (save-time Hessians need per-combo statics)"
-        if p.checkpoint_dir:
-            return "--checkpoint-dir (no per-update checkpoints in a vmapped grid)"
+        # --checkpoint-dir no longer blocks the grid: run_grid lands
+        # PER-CYCLE checkpoints (params/scores/total lane pytree at every
+        # iteration boundary) — only per-UPDATE granularity is inherently
+        # unavailable (updates live inside the compiled cycle)
         if p.divergence_guard != "off":
             return "--divergence-guard (per-update host gate cannot enter the compiled cycle)"
         if self.solve_schedule is not None:
@@ -750,20 +752,74 @@ class GameTrainingDriver:
             for name in self.params.updating_sequence
         }
 
+    def _make_checkpointer(self, combo_index: int, opt_configs, grid: bool = False):
+        """Per-combo checkpointer (async-wrapped under --checkpoint-async);
+        None without --checkpoint-dir. Grid and per-combo runs fingerprint
+        differently — their step granularities must never cross-resume."""
+        p = self.params
+        if not p.checkpoint_dir:
+            return None
+        from photon_ml_tpu.checkpoint import (
+            CoordinateDescentCheckpointer,
+            fingerprint,
+        )
+        from photon_ml_tpu.checkpoint_async import maybe_async
+
+        return maybe_async(
+            CoordinateDescentCheckpointer(
+                os.path.join(p.checkpoint_dir, f"combo-{combo_index}"),
+                # num_iterations intentionally excluded: extending a
+                # finished run with more iterations IS the resume case
+                run_fingerprint=fingerprint(
+                    {
+                        "coordinates": p.updating_sequence,
+                        "num_rows": self.train_data.num_rows,
+                        "combo": combo_index,
+                        "configs": {k: str(v) for k, v in opt_configs.items()},
+                        **({"grid": True} if grid else {}),
+                    }
+                ),
+            ),
+            p.checkpoint_async,
+        )
+
+    @staticmethod
+    def _close_checkpointer(checkpointer) -> None:
+        """Fence + stop an async checkpointer (no-op for the sync one):
+        every commit durable — and any background failure surfaced —
+        before models are saved or the run retires."""
+        if checkpointer is not None and hasattr(checkpointer, "close"):
+            checkpointer.close()
+
     def _train_shared_compile_grid(self, combos, loss_fn) -> None:
         """All grid combos through the traced-lambda grid API
         (CoordinateDescent.run_grid): ONE compiled cycle serves every
         combo; results and best_index land in self.results exactly like
-        the per-combo rebuild path."""
+        the per-combo rebuild path. With --checkpoint-dir each combo
+        checkpoints per cycle and resumes from its last complete
+        iteration."""
         p = self.params
         coords, cd, evaluators, primary = self._grid_cd(combos, loss_fn)
         lam = self._grid_lambdas(combos)
+        checkpointers = (
+            [
+                self._make_checkpointer(i, combos[i], grid=True)
+                for i in range(len(combos))
+            ]
+            if p.checkpoint_dir
+            else None
+        )
         from photon_ml_tpu.utils.profiling import maybe_trace
 
-        with self.timer.measure("shared-compile-grid"), maybe_trace("game-grid"):
-            grid_results = cd.run_grid(
-                lam, p.num_iterations, self.train_data.num_rows
-            )
+        try:
+            with self.timer.measure("shared-compile-grid"), maybe_trace("game-grid"):
+                grid_results = cd.run_grid(
+                    lam, p.num_iterations, self.train_data.num_rows,
+                    checkpointers=checkpointers,
+                )
+        finally:
+            for ck in checkpointers or ():
+                self._close_checkpointer(ck)
         best_value: Optional[float] = None
         for i, (opt_configs, result) in enumerate(zip(combos, grid_results)):
             metrics = result.validation_history[-1] if result.validation_history else {}
@@ -818,26 +874,7 @@ class GameTrainingDriver:
                 evaluators = self._validation_evaluators()
                 if primary is None and evaluators:
                     primary = next(iter(evaluators))
-            checkpointer = None
-            if p.checkpoint_dir:
-                from photon_ml_tpu.checkpoint import (
-                    CoordinateDescentCheckpointer,
-                    fingerprint,
-                )
-
-                checkpointer = CoordinateDescentCheckpointer(
-                    os.path.join(p.checkpoint_dir, f"combo-{i}"),
-                    # num_iterations intentionally excluded: extending a
-                    # finished run with more iterations IS the resume case
-                    run_fingerprint=fingerprint(
-                        {
-                            "coordinates": p.updating_sequence,
-                            "num_rows": self.train_data.num_rows,
-                            "combo": i,
-                            "configs": {k: str(v) for k, v in opt_configs.items()},
-                        }
-                    ),
-                )
+            checkpointer = self._make_checkpointer(i, opt_configs)
             guard = None
             if p.divergence_guard != "off":
                 from photon_ml_tpu.resilience import DivergenceGuard
@@ -850,10 +887,16 @@ class GameTrainingDriver:
             )
             from photon_ml_tpu.utils.profiling import maybe_trace
 
-            with self.timer.measure(f"combo-{i}"), maybe_trace(f"game-combo-{i}"):
-                result = cd.run(
-                    p.num_iterations, self.train_data.num_rows, checkpointer
-                )
+            try:
+                with self.timer.measure(f"combo-{i}"), maybe_trace(f"game-combo-{i}"):
+                    result = cd.run(
+                        p.num_iterations, self.train_data.num_rows, checkpointer
+                    )
+            finally:
+                # async fence: every commit durable (and any background
+                # commit failure surfaced) before this combo retires —
+                # on the preemption path the emergency save already fenced
+                self._close_checkpointer(checkpointer)
             metrics = result.validation_history[-1] if result.validation_history else {}
             self.results.append((opt_configs, result, metrics))
             self.logger.info(
@@ -1062,15 +1105,22 @@ class GameTrainingDriver:
             ),
         )
 
-    def run(self) -> None:
+    def run(self, restart: bool = False) -> None:
+        """``restart=True`` (a supervised relaunch after a preemption)
+        keeps the existing output dir: the streaming entity blocks, spilled
+        coordinate state, and logs written by the interrupted attempt are
+        exactly what the checkpoint's by-reference entries resume from."""
         from photon_ml_tpu import resilience
 
         with resilience.resilience_scope(self._resilience_config()):
-            self._run_guarded()
+            self._run_guarded(restart)
 
-    def _run_guarded(self) -> None:
+    def _run_guarded(self, restart: bool = False) -> None:
         p = self.params
-        prepare_output_dir(p.output_dir, p.delete_output_dir_if_exists)
+        if restart:
+            os.makedirs(p.output_dir, exist_ok=True)
+        else:
+            prepare_output_dir(p.output_dir, p.delete_output_dir_if_exists)
         if p.persistent_cache_dir:
             from photon_ml_tpu import compat
 
@@ -1143,10 +1193,41 @@ def _default_evaluators(task: TaskType):
 
 
 def main(argv: Optional[List[str]] = None) -> GameTrainingDriver:
+    import logging
+    import sys
+
+    from photon_ml_tpu.resilience import preemption
+
     params = parse_training_params(argv)
-    driver = GameTrainingDriver(params)
-    driver.run()
-    return driver
+
+    def run_once(attempt: int) -> GameTrainingDriver:
+        driver = GameTrainingDriver(params)
+        driver.run(restart=attempt > 0)
+        return driver
+
+    def on_restart(attempt: int, e: preemption.Preempted) -> None:
+        logging.getLogger(__name__).warning(
+            "preempted (%s); relaunching from the latest checkpoint "
+            "(restart %d/%d)", e, attempt, params.max_restarts
+        )
+
+    # SIGTERM/SIGINT become cooperative preemption requests for the whole
+    # run; the loops drain to the nearest safe boundary, write an emergency
+    # checkpoint, and either relaunch in-process (--max-restarts) or exit
+    # with the distinct preemption code for tools/run_supervised.py
+    with preemption.signal_scope():
+        try:
+            return preemption.run_with_restarts(
+                run_once, params.max_restarts, on_restart=on_restart
+            )
+        except preemption.Preempted as e:
+            print(
+                f"photon-ml-tpu: preempted ({e}); emergency checkpoint "
+                f"{e.checkpoint_path or '(no --checkpoint-dir)'}; "
+                f"exiting {preemption.PREEMPT_EXIT_CODE}",
+                file=sys.stderr,
+            )
+            raise SystemExit(preemption.PREEMPT_EXIT_CODE) from e
 
 
 if __name__ == "__main__":

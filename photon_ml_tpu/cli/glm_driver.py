@@ -856,9 +856,27 @@ def _concat_datasets(a: HostDataset, b: HostDataset) -> HostDataset:
 
 
 def main(argv: Optional[List[str]] = None) -> Driver:
+    import sys
+
+    from photon_ml_tpu.resilience import preemption
+
     params = parse_from_command_line(argv)
     driver = Driver(params)
-    driver.run()
+    # cooperative interruption: SIGTERM/SIGINT set the preemption flag; a
+    # loop that polls (e.g. a compacted solve's chunk boundary) drains and
+    # unwinds here, and the process exits with the distinct preemption code
+    # so a supervisor (tools/run_supervised.py) can tell "rescheduled" from
+    # "broken" and relaunch
+    with preemption.signal_scope():
+        try:
+            driver.run()
+        except preemption.Preempted as e:
+            print(
+                f"photon-ml-tpu glm: preempted ({e}); exiting "
+                f"{preemption.PREEMPT_EXIT_CODE}",
+                file=sys.stderr,
+            )
+            raise SystemExit(preemption.PREEMPT_EXIT_CODE) from e
     return driver
 
 

@@ -52,6 +52,7 @@ import jax.numpy as jnp
 
 from photon_ml_tpu.compile import ShapeBucketer, instrumented_jit
 from photon_ml_tpu.optim.common import OptResult
+from photon_ml_tpu.resilience import preemption
 
 Array = jax.Array
 
@@ -380,6 +381,46 @@ def _scatter_batch(full_state, part_state, idx, n_active):
 # ---------------------------------------------------------------------------
 
 
+def _snapshot_state(state, label: str, limit: int, executed: int,
+                    chunks: List[ChunkRecord]) -> dict:
+    """Host snapshot of a paused solve: the full per-lane carried state
+    (flattened to numbered numpy leaves — bitwise round-trip) plus the
+    scheduler bookkeeping, in the ``partial`` payload shape checkpoint.py
+    persists. Resume rebuilds the exact state and continues; PR 4 pinned
+    chunked resume bitwise-equal at any boundary, so the interrupted solve
+    finishes identical to an uninterrupted one."""
+    leaves, treedef = jax.tree_util.tree_flatten(state)
+    return {
+        "meta": {
+            "kind": "scheduler",
+            "label": label,
+            "limit": int(limit),
+            "executed": int(executed),
+            "treedef": str(treedef),
+            "num_leaves": len(leaves),
+            "chunks": [dataclasses.asdict(c) for c in chunks],
+        },
+        "arrays": {f"state.{i}": np.asarray(l) for i, l in enumerate(leaves)},
+    }
+
+
+def _restore_state(template_state, partial: dict):
+    """Rebuild the paused state from a snapshot, using a freshly-initialized
+    state purely as the structure template."""
+    leaves, treedef = jax.tree_util.tree_flatten(template_state)
+    meta = partial["meta"]
+    if meta.get("treedef") != str(treedef) or meta.get("num_leaves") != len(leaves):
+        raise ValueError(
+            "scheduler resume snapshot does not match this solver's state "
+            f"structure ({meta.get('treedef')} vs {treedef}) — optimizer or "
+            "config changed since the emergency checkpoint; refusing to resume"
+        )
+    new_leaves = [
+        jnp.asarray(partial["arrays"][f"state.{i}"]) for i in range(len(leaves))
+    ]
+    return jax.tree_util.tree_unflatten(treedef, new_leaves)
+
+
 def compacted_solve(
     data,
     w0: Array,
@@ -390,6 +431,7 @@ def compacted_solve(
     regularization,
     schedule: SolveSchedule,
     label: str = "re_solve",
+    resume: Optional[dict] = None,
 ) -> OptResult:
     """Solve every lane of ``data = (x, labels, offsets, weights)`` (each
     with leading entity axis E) with chunked, convergence-compacted vmapped
@@ -400,6 +442,15 @@ def compacted_solve(
     -> while any lane is unconverged: gather active lanes onto the ladder
     (only when the rung is strictly smaller than the current batch), chunk
     again, scatter back. Telemetry lands in :data:`solve_stats`.
+
+    Chunk pauses are PREEMPTION drain points: when
+    :func:`photon_ml_tpu.resilience.preemption.check` reports a request at
+    the ``"chunk"`` site, the loop raises
+    :class:`~photon_ml_tpu.resilience.preemption.Preempted` carrying a host
+    snapshot of the paused carries; passing that snapshot back as
+    ``resume`` continues the solve bitwise-identically (resumed batches
+    restart uncompacted and re-compact at the next pause — lane arithmetic
+    is batch-independent, so results are unchanged).
     """
     cfg = dict(
         task=task,
@@ -417,6 +468,14 @@ def compacted_solve(
     state = _init_batch(data, w0, **cfg)
     chunks: List[ChunkRecord] = []
     executed = 0
+    limit = 0
+    if resume is not None:
+        # the freshly-initialized state is only the structure template;
+        # every carried value comes from the snapshot (bitwise round-trip)
+        state = _restore_state(state, resume)
+        limit = int(resume["meta"]["limit"])
+        executed = int(resume["meta"]["executed"])
+        chunks = [ChunkRecord(**c) for c in resume["meta"]["chunks"]]
 
     # current batch bookkeeping: lane_ids maps batch position -> entity
     # lane; the full state is authoritative (compacted chunks scatter back
@@ -424,9 +483,12 @@ def compacted_solve(
     cur_data = data
     cur_state = state
     cur_ids = np.arange(lanes)
-    cur_active = lanes
+    cur_active = (
+        int(np.count_nonzero(np.asarray(state.reason) == 0))
+        if resume is not None
+        else lanes
+    )
     compacted = False
-    limit = 0
 
     while True:
         prev_limit = limit
@@ -463,6 +525,16 @@ def compacted_solve(
         executed += batch_lanes * advanced
         if active_idx.size == 0 or limit >= max_iter:
             break
+        if preemption.check("chunk", label=label, limit=limit):
+            # drain to the chunk boundary: the full state was just
+            # scattered back, so a host snapshot of it IS the solve —
+            # coordinate descent folds it into the emergency checkpoint
+            raise preemption.Preempted(
+                f"preempted at chunk boundary ({label}, iteration limit "
+                f"{limit}/{max_iter}): {preemption.reason()}",
+                site="chunk",
+                partial=_snapshot_state(state, label, limit, executed, chunks),
+            )
         # compact when the ladder rung genuinely shrinks the batch; once
         # compacted, also re-gather whenever the active SET changed (so
         # newly-frozen lanes stop riding along) — but skip the dispatch

@@ -23,7 +23,12 @@ Bring-up matrix (initialize()):
 from __future__ import annotations
 
 import dataclasses
-from typing import List, Optional, Sequence
+import json
+import logging
+import os
+import threading
+import time
+from typing import Dict, List, Optional, Sequence
 
 import numpy as np
 
@@ -33,6 +38,69 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 from photon_ml_tpu.parallel.mesh import DATA_AXIS, MeshContext, data_mesh
 
 Array = jax.Array
+
+logger = logging.getLogger(__name__)
+
+#: Env override for the barrier deadline (seconds; 0/unset = no deadline).
+BARRIER_TIMEOUT_ENV = "PHOTON_BARRIER_TIMEOUT"
+
+HEARTBEAT_PREFIX = "heartbeat-"
+
+
+class BarrierTimeoutError(OSError):
+    """A barrier did not complete within its deadline: converts an infinite
+    hang behind a wedged host into a diagnosable failure (check the
+    per-host heartbeat ages). Deliberately NOT retried by barrier() itself:
+    re-entering ``sync_global_devices`` while the abandoned wait is still
+    parked in the collective would desynchronize barrier sequencing across
+    hosts — the recovery path is the restart supervisor, not a retry."""
+
+
+def resolve_barrier_timeout(timeout: Optional[float]) -> Optional[float]:
+    """Effective barrier deadline: explicit value wins; ``None`` falls back
+    to ``PHOTON_BARRIER_TIMEOUT``; 0/absent means no deadline."""
+    if timeout is not None:
+        return timeout if timeout > 0 else None
+    raw = os.environ.get(BARRIER_TIMEOUT_ENV)
+    if not raw:
+        return None
+    try:
+        val = float(raw)
+    except ValueError:
+        raise ValueError(
+            f"{BARRIER_TIMEOUT_ENV} must be a number of seconds, got {raw!r}"
+        )
+    return val if val > 0 else None
+
+
+def _call_with_deadline(fn, timeout: float, describe: str) -> None:
+    """Run ``fn`` on a worker thread, raising :class:`BarrierTimeoutError`
+    if it does not return within ``timeout`` seconds. The hung worker is a
+    daemon and is left behind — a blocked collective cannot be cancelled,
+    only diagnosed; retrying after its eventual completion is the caller's
+    (retry policy's) judgement call."""
+    done = threading.Event()
+    box: List[BaseException] = []
+
+    def run():
+        try:
+            fn()
+        except BaseException as e:  # noqa: BLE001 — crossing the thread
+            # boundary; re-raised below in the caller
+            box.append(e)
+        finally:
+            done.set()
+
+    t = threading.Thread(target=run, name=f"barrier-{describe}", daemon=True)
+    t.start()
+    if not done.wait(timeout):
+        raise BarrierTimeoutError(
+            f"{describe} did not complete within {timeout:g}s — a peer host "
+            "is likely wedged, preempted, or dead; check the per-host "
+            "heartbeat ages in the coordinator log"
+        )
+    if box:
+        raise box[0]
 
 
 def initialize(
@@ -143,7 +211,9 @@ class MultihostContext:
         return jax.make_array_from_process_local_data(sharding, host_local)
 
     # -- coordination ----------------------------------------------------
-    def barrier(self, name: str = "photon-ml-tpu-barrier") -> None:
+    def barrier(
+        self, name: str = "photon-ml-tpu-barrier", timeout: Optional[float] = None
+    ) -> None:
         """Block until every process reaches this point (checkpoint fences,
         output-dir creation). No-op single-process.
 
@@ -152,24 +222,132 @@ class MultihostContext:
         before the collective, so a retry is safe (the sync itself is never
         re-entered after succeeding). Chaos tests use this to prove the
         checkpoint fences survive transient coordination failures.
+
+        ``timeout`` (default: ``PHOTON_BARRIER_TIMEOUT``) is the health
+        fence: a ``sync_global_devices`` that outlives the deadline raises
+        :class:`BarrierTimeoutError` instead of hanging the job forever
+        behind one wedged host. The timeout is NOT retried (only the
+        pre-collective entry faults are): the abandoned wait is still
+        parked inside the collective, so re-entering it would desync
+        barrier sequencing across hosts — a timed-out barrier is
+        diagnose-and-fail (heartbeats name the wedged host), and recovery
+        is the restart supervisor's job.
         """
         from photon_ml_tpu import resilience
         from photon_ml_tpu.resilience import faults
 
+        deadline = resolve_barrier_timeout(timeout)
+
         def enter() -> None:
             # single-process still exercises the fault site, so chaos
-            # tests run without a multi-host harness
+            # tests run without a multi-host harness; the injected failure
+            # fires BEFORE the collective, so retrying it is safe
             faults.inject("multihost.barrier", name=name, process=self.process_id)
-            if self.num_processes > 1:
-                from jax.experimental import multihost_utils
-
-                multihost_utils.sync_global_devices(name)
 
         resilience.call_with_retry(
             enter,
             resilience.current_config().io_policy,
             describe=f"barrier {name}",
         )
+        if self.num_processes > 1:
+            from jax.experimental import multihost_utils
+
+            sync = lambda: multihost_utils.sync_global_devices(name)
+            if deadline is None:
+                sync()
+            else:
+                _call_with_deadline(
+                    sync, deadline,
+                    f"barrier {name!r} (process {self.process_id})",
+                )
+
+    # -- health fencing --------------------------------------------------
+    def agree_restore_step(self, local_step: Optional[int]) -> Optional[int]:
+        """Collective MIN over every host's latest complete checkpoint step:
+        the job resumes from the newest step EVERY host can restore, so no
+        host resumes a step another host failed to commit (per-host
+        checkpoint dirs, torn shared-FS writes). ``None`` (no checkpoint on
+        this host) participates as -1; a -1 minimum means fresh start."""
+        if self.num_processes <= 1:
+            return local_step
+        from jax.experimental import multihost_utils
+
+        local = np.asarray([local_step if local_step is not None else -1], np.int64)
+        gathered = np.asarray(
+            multihost_utils.process_allgather(local, tiled=True)
+        ).reshape(-1)
+        agreed = int(gathered.min())
+        if agreed != (local_step if local_step is not None else -1):
+            logger.warning(
+                "host %d: restoring step %s instead of local latest %s "
+                "(collective-min agreement; per-host steps %s)",
+                self.process_id, agreed if agreed >= 0 else None, local_step,
+                gathered.tolist(),
+            )
+        return agreed if agreed >= 0 else None
+
+    def write_heartbeat(self, directory: str, step: Optional[int] = None) -> str:
+        """Write this host's heartbeat file (atomic tmp+rename, retried;
+        fault site ``multihost.heartbeat``). Every host calls this at its
+        safe boundaries; the coordinator reads the ages back with
+        :meth:`heartbeat_ages` so a wedged host is diagnosable by name."""
+        from photon_ml_tpu import resilience
+        from photon_ml_tpu.resilience import faults
+
+        path = os.path.join(directory, f"{HEARTBEAT_PREFIX}{self.process_id}.json")
+
+        def write_once() -> None:
+            faults.inject(
+                "multihost.heartbeat", process=self.process_id, path=path
+            )
+            os.makedirs(directory, exist_ok=True)
+            payload = {
+                "process": self.process_id,
+                "time": time.time(),
+                "step": step,
+            }
+            with open(path + ".tmp", "w") as f:
+                json.dump(payload, f)
+            os.replace(path + ".tmp", path)
+
+        resilience.call_with_retry(
+            write_once,
+            resilience.current_config().io_policy,
+            describe=f"heartbeat process {self.process_id}",
+        )
+        return path
+
+    def heartbeat_ages(self, directory: str) -> Dict[int, float]:
+        """process id -> seconds since its last heartbeat (missing hosts
+        absent from the map — a host that NEVER beat is the loudest
+        diagnosis of all). Read-only; any host may call it, the coordinator
+        logs it."""
+        ages: Dict[int, float] = {}
+        if not os.path.isdir(directory):
+            return ages
+        now = time.time()
+        for name in sorted(os.listdir(directory)):
+            if not name.startswith(HEARTBEAT_PREFIX) or not name.endswith(".json"):
+                continue
+            try:
+                with open(os.path.join(directory, name)) as f:
+                    payload = json.load(f)
+                ages[int(payload["process"])] = now - float(payload["time"])
+            except (OSError, ValueError, KeyError) as e:
+                logger.warning("unreadable heartbeat %s: %s", name, e)
+        return ages
+
+    def describe_heartbeats(self, directory: str) -> str:
+        """Coordinator-log line: per-host heartbeat age (and who is MISSING
+        entirely) — the first thing to read when a barrier times out."""
+        ages = self.heartbeat_ages(directory)
+        parts = []
+        for pid in range(self.num_processes):
+            if pid in ages:
+                parts.append(f"host {pid}: {ages[pid]:.1f}s ago")
+            else:
+                parts.append(f"host {pid}: NO HEARTBEAT")
+        return "heartbeats: " + ", ".join(parts)
 
     def coordinator_only_io(self) -> bool:
         """True when this process should perform global side effects (model

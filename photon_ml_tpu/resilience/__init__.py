@@ -13,6 +13,12 @@ own I/O and solver loops, so it owns its own resilience:
     and checkpoint save/restore.
   * :mod:`photon_ml_tpu.resilience.guards` — non-finite detection in
     coordinate descent with last-good-state rollback.
+  * :mod:`photon_ml_tpu.resilience.preemption` — cooperative interruption:
+    SIGTERM/SIGINT (or ``PHOTON_PREEMPT_AT`` / a ``preempt.signal`` fault)
+    set a flag the training loops poll at safe boundaries; they drain,
+    write an emergency checkpoint, and unwind with :class:`Preempted`
+    (drivers exit with :data:`PREEMPT_EXIT_CODE` or relaunch via
+    ``--max-restarts``).
 
 This module also holds the process-wide :class:`ResilienceConfig` consulted
 by the ingest layer (corrupt-shard policy + retry policy), installed by the
@@ -26,7 +32,7 @@ import contextlib
 import dataclasses
 from typing import Iterator, Optional
 
-from photon_ml_tpu.resilience import faults, guards, retry
+from photon_ml_tpu.resilience import faults, guards, preemption, retry
 from photon_ml_tpu.resilience.faults import (
     FaultPlan,
     FaultSpec,
@@ -35,12 +41,16 @@ from photon_ml_tpu.resilience.faults import (
     fault_scope,
 )
 from photon_ml_tpu.resilience.guards import DivergenceGuard, GuardEvent, tree_all_finite
+from photon_ml_tpu.resilience.preemption import PREEMPT_EXIT_CODE, Preempted
 from photon_ml_tpu.resilience.retry import RetryError, RetryPolicy, call_with_retry
 
 __all__ = [
     "faults",
     "guards",
+    "preemption",
     "retry",
+    "PREEMPT_EXIT_CODE",
+    "Preempted",
     "FaultPlan",
     "FaultSpec",
     "InjectedIOError",

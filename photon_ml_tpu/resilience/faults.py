@@ -14,7 +14,11 @@ Named sites wired through the stack:
   * ``io.checkpoint_write`` — per checkpoint save attempt (checkpoint.py)
   * ``io.index_load``       — index-map / off-heap store loads (io/)
   * ``multihost.barrier``   — cross-host sync points (parallel/multihost.py)
+  * ``multihost.heartbeat`` — per-host heartbeat writes (parallel/multihost.py)
   * ``optim.step``          — coordinate-descent updates (NaN corruption)
+  * ``preempt.signal``      — preemption polls (resilience/preemption.py);
+    a firing spec FLAGS a preemption request instead of raising (see
+    :func:`flag`), simulating a SIGTERM at a drain boundary
 
 ``PHOTON_FAULTS`` grammar (';'-separated site specs, ','-separated options)::
 
@@ -48,6 +52,7 @@ __all__ = [
     "active_plan",
     "inject",
     "corrupt",
+    "flag",
     "parse_fault_env",
 ]
 
@@ -245,6 +250,16 @@ def inject(site: str, **context: Any) -> None:
     if spec is None or spec.kind == "nan":
         return
     _raise_fault(spec, site, context)
+
+
+def flag(site: str, **context: Any) -> bool:
+    """Count a hit at ``site``; return True when the plan fires — WITHOUT
+    raising, whatever the spec's kind. For sites where a fault is a signal
+    to act on (``preempt.signal``), not an error to propagate."""
+    plan = active_plan()
+    if plan is None:
+        return False
+    return plan.should_fire(site, **context) is not None
 
 
 def corrupt(site: str, tree: Any, **context: Any) -> Any:

@@ -106,6 +106,9 @@ if outdir:
             {"fe": np.zeros(D, np.float32)},
             {"fe": np.zeros(n_pad, np.float32)},
             np.zeros(n_pad, np.float32),
+            # coordinator-only read-back: the collective-min agreement
+            # would deadlock (process 1 is not in this branch)
+            agree=False,
         )
         full_scores = x_all @ coefs
         got = np.asarray(restored.total_scores)
@@ -113,6 +116,49 @@ if outdir:
         np.testing.assert_array_equal(got[N:], 0.0)  # padding rows score 0
         print("MHCKPT-OK", flush=True)
     mh.barrier("after-ckpt-check")
+
+# -- multihost health fencing: per-host heartbeats, barrier deadline (the
+# completing path), and the collective-min restore-step agreement — host 1
+# deliberately MISSES the latest checkpoint step, and both hosts must agree
+# to restore the newest step EVERY host can serve ---------------------------
+if outdir:
+    hb_dir = os.path.join(outdir, "heartbeats")
+    mh.write_heartbeat(hb_dir, step=1)
+    mh.barrier("heartbeats-written", timeout=60)  # deadline path, completing
+    ages = mh.heartbeat_ages(hb_dir)
+    assert sorted(ages) == list(range(nprocs)), ages
+    assert all(age < 60 for age in ages.values()), ages
+    if mh.coordinator_only_io():
+        desc = mh.describe_heartbeats(hb_dir)
+        assert "NO HEARTBEAT" not in desc, desc
+        print("MHHB-OK", flush=True)
+
+    # per-host (NON-shared) checkpoint dirs: host 0 commits steps 1 and 2,
+    # host 1 only step 1 (its "crash" lost the latest commit)
+    per_host_dir = os.path.join(outdir, f"ckpt-host-{proc_id}")
+    local_ck = CoordinateDescentCheckpointer(per_host_dir, run_fingerprint="agree")
+    tiny = np.arange(4, dtype=np.float32)
+
+    def tiny_state(step):
+        return CheckpointState(
+            step=step, params={"w": tiny + step}, scores={"w": tiny},
+            total_scores=tiny, objective_history=[float(step)],
+            validation_history=[],
+        )
+
+    local_ck.save(tiny_state(1))
+    if proc_id == 0:
+        local_ck.save(tiny_state(2))
+    agreed = mh.agree_restore_step(local_ck.latest_step())
+    assert agreed == 1, (proc_id, agreed)
+    restored = local_ck.restore(
+        {"w": tiny}, {"w": tiny}, tiny, max_step=agreed
+    )
+    assert restored is not None and restored.step == 1, proc_id
+    np.testing.assert_array_equal(np.asarray(restored.params["w"]), tiny + 1)
+    mh.barrier("agree-check")
+    if mh.coordinator_only_io():
+        print("MHAGREE-OK", flush=True)
 
 print(f"MHOK proc={proc_id} coefs={','.join(f'{c:.6f}' for c in coefs)}", flush=True)
 

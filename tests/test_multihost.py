@@ -56,6 +56,10 @@ def test_two_process_fixed_effect_matches_single_process(tmp_path):
     assert "MHCKPT-OK" not in outs[1]  # non-coordinator never writes/reads
     ckpt_dir = tmp_path / "ckpt" / "step-1"
     assert (ckpt_dir / "arrays.npz").exists() and (ckpt_dir / "meta.json").exists()
+    # health fencing: both hosts heartbeat, and the collective-min restore
+    # agreement picked step 1 when host 1 was missing step 2 (asserted
+    # inside BOTH workers; the coordinator prints the markers)
+    assert "MHHB-OK" in outs[0] and "MHAGREE-OK" in outs[0]
     # both processes see the identical replicated solution
     np.testing.assert_array_equal(coefs[0], coefs[1])
 

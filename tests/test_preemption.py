@@ -1,0 +1,827 @@
+"""Preemption-safe training: cooperative interruption, async checkpointing,
+multihost health fencing.
+
+The load-bearing claims:
+
+  * a preemption request delivered mid-cycle / mid-streaming-block /
+    mid-compaction-chunk drains to the boundary, lands an emergency
+    checkpoint (with the in-flight coordinate's state), and the resumed run
+    finishes BITWISE-equal to an uninterrupted one (LBFGS and TRON);
+  * async checkpointing commits in the background through the same
+    retry/atomic-rename path, surfaces commit failures in order (the
+    Prefetcher contract), fences on wait(), and never interleaves tmp dirs;
+  * checkpoint restore rejects bit-rotten steps by checksum and falls back
+    to the previous intact step;
+  * multihost: barrier deadlines convert hangs into diagnosable errors,
+    restore agrees on the collective-min step, heartbeats age out loudly.
+"""
+
+import os
+import signal
+import time
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from game_test_utils import make_glmix_data
+
+from photon_ml_tpu.algorithm import (
+    CoordinateDescent,
+    FixedEffectCoordinate,
+    RandomEffectCoordinate,
+    StreamingRandomEffectCoordinate,
+    write_re_entity_blocks,
+)
+from photon_ml_tpu.checkpoint import (
+    CheckpointState,
+    CoordinateDescentCheckpointer,
+)
+from photon_ml_tpu.checkpoint_async import AsyncCheckpointer
+from photon_ml_tpu.data.game import (
+    RandomEffectDataConfig,
+    build_fixed_effect_batch,
+)
+from photon_ml_tpu.ops import losses
+from photon_ml_tpu.ops.regularization import RegularizationContext
+from photon_ml_tpu.optim.common import OptimizerConfig
+from photon_ml_tpu.optim.problem import GLMOptimizationProblem
+from photon_ml_tpu.optim.scheduler import SolveSchedule, compacted_solve
+from photon_ml_tpu.resilience import faults, preemption
+from photon_ml_tpu.resilience.preemption import Preempted
+from photon_ml_tpu.types import OptimizerType, TaskType
+
+pytestmark = pytest.mark.preempt
+
+
+@pytest.fixture(autouse=True)
+def _clean_preemption_state():
+    """Preemption flag/poll counters are process-global by design; every
+    test starts and leaves them clean."""
+    preemption.reset()
+    faults.clear()
+    yield
+    preemption.reset()
+    faults.clear()
+
+
+# ---------------------------------------------------------------------------
+# the flag: env plan, fault site, signals
+# ---------------------------------------------------------------------------
+
+
+class TestPreemptionFlag:
+    def test_env_plan_fires_on_nth_poll_once(self, monkeypatch):
+        monkeypatch.setenv("PHOTON_PREEMPT_AT", "block:2")
+        preemption.reset()  # new env value -> fresh cache + counters
+        assert not preemption.check("block")
+        assert preemption.check("block")  # 2nd poll fires
+        assert "block poll 2" in preemption.reason()
+        preemption.clear()
+        # counters survive clear(): the spec fires once per process, so a
+        # supervised restart is not immediately re-preempted
+        for _ in range(5):
+            assert not preemption.check("block")
+
+    def test_env_plan_parses_multiple_sites_and_rejects_junk(self):
+        assert preemption.parse_preempt_env("cycle:3;chunk") == {
+            "cycle": 3, "chunk": 1
+        }
+        with pytest.raises(ValueError, match="unknown"):
+            preemption.parse_preempt_env("solve:1")
+        with pytest.raises(ValueError, match=">= 1"):
+            preemption.parse_preempt_env("cycle:0")
+
+    def test_other_sites_unaffected(self):
+        preemption.install_plan({"chunk": 1})
+        assert not preemption.check("cycle")
+        assert not preemption.check("block")
+        assert preemption.check("chunk")
+
+    def test_fault_site_preempt_signal_flags(self):
+        plan = faults.FaultPlan([faults.FaultSpec("preempt.signal", at=2)])
+        with faults.fault_scope(plan):
+            assert not preemption.check("cycle", step=1)
+            assert preemption.check("cycle", step=2)
+        assert plan.fire_count("preempt.signal") == 1
+        assert "injected" in preemption.reason()
+
+    def test_sigterm_sets_flag_and_handlers_restore(self):
+        before = signal.getsignal(signal.SIGTERM)
+        with preemption.signal_scope():
+            assert not preemption.requested()
+            signal.raise_signal(signal.SIGTERM)
+            assert preemption.requested()
+            assert "SIGTERM" in preemption.reason()
+        assert signal.getsignal(signal.SIGTERM) is before
+
+
+class TestRunWithRestarts:
+    def test_restarts_until_budget_then_reraises(self):
+        calls = []
+
+        def run_once(attempt):
+            calls.append(attempt)
+            if attempt < 2:
+                preemption.request("test")
+                raise Preempted("boom")
+            return "done"
+
+        assert preemption.run_with_restarts(run_once, 2) == "done"
+        assert calls == [0, 1, 2]
+        assert not preemption.requested()  # cleared between attempts
+
+        with pytest.raises(Preempted):
+            preemption.run_with_restarts(
+                lambda a: (_ for _ in ()).throw(Preempted("x")), 1
+            )
+
+    def test_run_supervised_tool_restarts_on_preempt_code_only(self):
+        import sys
+
+        sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "tools"))
+        try:
+            import run_supervised
+        finally:
+            sys.path.pop(0)
+        codes = [75, 75, 0]
+        ran = []
+        rc = run_supervised.supervise(
+            ["cmd"], max_restarts=5, run=lambda c: (ran.append(c), codes.pop(0))[1],
+            log=lambda m: None,
+        )
+        assert rc == 0 and len(ran) == 3
+        # a crash (non-75) passes through untouched
+        assert run_supervised.supervise(
+            ["cmd"], max_restarts=5, run=lambda c: 1, log=lambda m: None
+        ) == 1
+        # budget exhausted -> final preempt code propagates
+        assert run_supervised.supervise(
+            ["cmd"], max_restarts=1, run=lambda c: 75, log=lambda m: None
+        ) == 75
+
+
+# ---------------------------------------------------------------------------
+# async checkpointing
+# ---------------------------------------------------------------------------
+
+
+def _mini_state(step, seed=0):
+    rng = np.random.default_rng(seed + step)
+    return CheckpointState(
+        step=step,
+        params={"fe": jnp.asarray(rng.normal(size=8).astype(np.float32))},
+        scores={"fe": jnp.asarray(rng.normal(size=32).astype(np.float32))},
+        total_scores=jnp.asarray(rng.normal(size=32).astype(np.float32)),
+        objective_history=[float(step)],
+        validation_history=[],
+    )
+
+
+class TestAsyncCheckpointer:
+    def test_background_commit_then_wait_then_restore(self, tmp_path):
+        ck = AsyncCheckpointer(
+            CoordinateDescentCheckpointer(str(tmp_path), keep=2)
+        )
+        st = _mini_state(1)
+        ck.save(st)
+        ck.wait()
+        assert ck.latest_step() == 1
+        restored = ck.restore(st.params, st.scores, st.total_scores)
+        np.testing.assert_array_equal(
+            np.asarray(restored.params["fe"]), np.asarray(st.params["fe"])
+        )
+        ck.close()
+
+    def test_commit_failure_surfaces_on_next_interaction(self, tmp_path):
+        from photon_ml_tpu.resilience import RetryError
+
+        inner = CoordinateDescentCheckpointer(str(tmp_path), keep=10)
+        ck = AsyncCheckpointer(inner)
+        # every write attempt faults: the background commit exhausts its
+        # retries; nothing surfaces until the caller's next interaction
+        plan = faults.FaultPlan(
+            [faults.FaultSpec("io.checkpoint_write", rate=1.0, times=None)]
+        )
+        with faults.fault_scope(plan):
+            ck.save(_mini_state(1))
+            with pytest.raises(RetryError):
+                ck.wait()
+        assert ck.latest_step() is None
+        # after the error is consumed (and the fault plan removed) the
+        # checkpointer recovers
+        ck.save(_mini_state(3))
+        ck.wait()
+        assert ck.latest_step() == 3
+        ck.close()
+
+    def test_jobs_behind_a_failed_commit_are_dropped(self, tmp_path, monkeypatch):
+        """In-order, like the Prefetcher: a commit queued AFTER a failing
+        one must never land past the hole."""
+        inner = CoordinateDescentCheckpointer(str(tmp_path))
+        committed = []
+        real_commit = inner._commit
+
+        def slow_fail(step, arrays, meta):
+            if step == 1:
+                time.sleep(0.3)  # hold the worker so step 2 queues behind
+                raise OSError("disk gone")
+            committed.append(step)
+            return real_commit(step, arrays, meta)
+
+        monkeypatch.setattr(inner, "_commit", slow_fail)
+        ck = AsyncCheckpointer(inner, max_pending=4)
+        ck.save(_mini_state(1))
+        ck.save(_mini_state(2))
+        with pytest.raises(OSError, match="disk gone"):
+            ck.wait()
+        assert committed == [] and ck.latest_step() is None
+        ck.close()
+
+    def test_pending_failure_blocks_the_next_save(self, tmp_path):
+        ck = AsyncCheckpointer(CoordinateDescentCheckpointer(str(tmp_path)))
+        ck._error = RuntimeError("earlier commit failed")
+        with pytest.raises(RuntimeError, match="earlier commit"):
+            ck.save(_mini_state(2))
+        ck.wait()  # error consumed; the rejected save was never enqueued
+        assert ck.latest_step() is None
+        ck.close()
+
+    def test_save_pressure_never_interleaves_tmp_dirs(self, tmp_path):
+        ck = AsyncCheckpointer(
+            CoordinateDescentCheckpointer(str(tmp_path), keep=2), max_pending=4
+        )
+        for s in range(1, 9):
+            ck.save(_mini_state(s))
+        ck.wait()
+        ck.close()
+        # retention holds, all commits atomic, zero .ckpt-* debris
+        leftover = [n for n in os.listdir(tmp_path) if n.startswith(".ckpt-")]
+        assert leftover == []
+        steps = sorted(
+            int(n[len("step-"):])
+            for n in os.listdir(tmp_path)
+            if n.startswith("step-")
+        )
+        assert steps == [7, 8]
+        restored = ck.restore(
+            _mini_state(8).params, _mini_state(8).scores,
+            _mini_state(8).total_scores,
+        )
+        assert restored.step == 8
+
+    def test_wait_fences_before_retire(self, tmp_path):
+        """wait() returning means the step directory is durable on disk —
+        not merely enqueued."""
+        ck = AsyncCheckpointer(CoordinateDescentCheckpointer(str(tmp_path)))
+        ck.save(_mini_state(5))
+        ck.wait()
+        assert os.path.exists(tmp_path / "step-5" / "arrays.npz")
+        ck.close()
+
+
+def _rot_one_array(step_dir):
+    """Silent bit-rot: rewrite arrays.npz as a VALID archive whose content
+    changed — only the recorded SHA-256 can catch this (the zip CRC and
+    shapes all still check out)."""
+    path = os.path.join(step_dir, "arrays.npz")
+    with np.load(path) as z:
+        arrays = {k: z[k].copy() for k in z.files}
+    key = sorted(arrays)[0]
+    flat = arrays[key].view(np.uint8).reshape(-1)
+    flat[0] ^= 0x01
+    with open(path, "wb") as f:
+        np.savez(f, **arrays)
+
+
+class TestChecksumIntegrity:
+    def test_bit_rot_rejected_falls_back_to_previous_step(self, tmp_path):
+        ck = CoordinateDescentCheckpointer(str(tmp_path), keep=5)
+        s1, s2 = _mini_state(1), _mini_state(2)
+        ck.save(s1)
+        ck.save(s2)
+        _rot_one_array(str(tmp_path / "step-2"))
+        restored = ck.restore(s1.params, s1.scores, s1.total_scores)
+        assert restored is not None and restored.step == 1
+        np.testing.assert_array_equal(
+            np.asarray(restored.params["fe"]), np.asarray(s1.params["fe"])
+        )
+
+    def test_all_steps_rotten_restores_none(self, tmp_path):
+        ck = CoordinateDescentCheckpointer(str(tmp_path))
+        s1 = _mini_state(1)
+        ck.save(s1)
+        _rot_one_array(str(tmp_path / "step-1"))
+        assert ck.restore(s1.params, s1.scores, s1.total_scores) is None
+
+    def test_vanished_spill_dir_rejected_not_zeroed(self, glmix, tmp_path):
+        """A checkpoint referencing a since-GC'd epoch dir must REJECT (and
+        fall back), never restore silently-zero coefficients."""
+        import shutil
+
+        from photon_ml_tpu.algorithm import StreamingREManifest
+
+        mani_dir = str(tmp_path / "blocks")
+        write_re_entity_blocks(
+            glmix, RandomEffectDataConfig("userId", "per_user"),
+            mani_dir, block_entities=16,
+        )
+        coord = StreamingRandomEffectCoordinate(
+            StreamingREManifest.load(mani_dir),
+            TaskType.LOGISTIC_REGRESSION,
+            optimizer_config=OptimizerConfig(max_iterations=5, tolerance=1e-6),
+            state_root=str(tmp_path / "state"),
+            prefetch_depth=0,
+        )
+        n = glmix.num_rows
+        state0 = coord.initial_coefficients()
+        new_state, _ = coord.update(jnp.zeros((n,), jnp.float32), state0)
+        ck = CoordinateDescentCheckpointer(str(tmp_path / "ckpt"))
+        ck.save(
+            CheckpointState(
+                step=1, params={"re": new_state},
+                scores={"re": jnp.zeros((n,), jnp.float32)},
+                total_scores=jnp.zeros((n,), jnp.float32),
+                objective_history=[0.0], validation_history=[],
+            )
+        )
+        shutil.rmtree(new_state.dir)  # the epoch GC / wiped output dir
+        restored = ck.restore(
+            {"re": coord.initial_coefficients()},
+            {"re": jnp.zeros((n,), jnp.float32)},
+            jnp.zeros((n,), jnp.float32),
+        )
+        assert restored is None  # rejected, no silent zeros
+
+    def test_truncated_npz_still_falls_back(self, tmp_path):
+        """The pre-existing crash-debris tolerance is unchanged: a torn
+        write (non-atomic FS) skips to the previous step."""
+        ck = CoordinateDescentCheckpointer(str(tmp_path), keep=5)
+        s1, s2 = _mini_state(1), _mini_state(2)
+        ck.save(s1)
+        ck.save(s2)
+        path = tmp_path / "step-2" / "arrays.npz"
+        path.write_bytes(path.read_bytes()[:40])
+        restored = ck.restore(s1.params, s1.scores, s1.total_scores)
+        assert restored is not None and restored.step == 1
+
+
+# ---------------------------------------------------------------------------
+# mid-chunk: the convergence scheduler drains, snapshots, resumes bitwise
+# ---------------------------------------------------------------------------
+
+
+def _lane_problem(rng, E=24, M=12, D=5):
+    x = rng.normal(size=(E, M, D)).astype(np.float32)
+    x[:4] *= np.geomspace(1.0, 32.0, D).astype(np.float32)  # straggler lanes
+    w_true = (rng.normal(size=(E, D)) * 0.5).astype(np.float32)
+    z = np.einsum("emd,ed->em", x.astype(np.float64), w_true)
+    y = (1.0 / (1.0 + np.exp(-z)) > rng.random((E, M))).astype(np.float32)
+    data = tuple(
+        jnp.asarray(a)
+        for a in (x, y, np.zeros((E, M), np.float32), np.ones((E, M), np.float32))
+    )
+    return data, jnp.zeros((E, D), jnp.float32)
+
+
+class TestSchedulerPreemption:
+    @pytest.mark.parametrize("opt", [OptimizerType.LBFGS, OptimizerType.TRON])
+    def test_mid_chunk_snapshot_resumes_bitwise(self, rng, opt):
+        data, w0 = _lane_problem(rng)
+        kw = dict(
+            task=TaskType.LOGISTIC_REGRESSION,
+            optimizer=opt,
+            optimizer_config=OptimizerConfig(max_iterations=40, tolerance=1e-7),
+            regularization=RegularizationContext.l2(0.5),
+            schedule=SolveSchedule(chunk_size=4),
+        )
+        clean = compacted_solve(data, w0, label="clean", **kw)
+
+        preemption.install_plan({"chunk": 2})
+        with pytest.raises(Preempted) as ei:
+            compacted_solve(data, w0, label="interrupted", **kw)
+        assert ei.value.site == "chunk"
+        partial = ei.value.partial
+        assert partial["meta"]["kind"] == "scheduler"
+        assert partial["meta"]["limit"] == 8  # drained at the 2nd boundary
+
+        preemption.reset()
+        resumed = compacted_solve(
+            data, w0, label="resumed", resume=partial, **kw
+        )
+        for name, a, b in zip(clean._fields, clean, resumed):
+            if a is None or b is None:
+                assert a is b, name
+                continue
+            assert np.array_equal(
+                np.asarray(a), np.asarray(b), equal_nan=True
+            ), name
+
+    def test_resume_rejects_mismatched_solver(self, rng):
+        data, w0 = _lane_problem(rng)
+        base = dict(
+            task=TaskType.LOGISTIC_REGRESSION,
+            optimizer_config=OptimizerConfig(max_iterations=40, tolerance=1e-7),
+            regularization=RegularizationContext.l2(0.5),
+            schedule=SolveSchedule(chunk_size=4),
+        )
+        preemption.install_plan({"chunk": 1})
+        with pytest.raises(Preempted) as ei:
+            compacted_solve(data, w0, optimizer=OptimizerType.LBFGS, **base)
+        preemption.reset()
+        with pytest.raises(ValueError, match="refusing to resume"):
+            compacted_solve(
+                data, w0, optimizer=OptimizerType.TRON,
+                resume=ei.value.partial, **base
+            )
+
+
+# ---------------------------------------------------------------------------
+# coordinate-descent + streaming: emergency checkpoint -> supervised resume
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def glmix():
+    rng = np.random.default_rng(20260803)
+    data, _ = make_glmix_data(
+        rng, num_users=48, rows_per_user_range=(4, 18), d_fixed=4, d_random=3
+    )
+    return data
+
+
+def _fixed_coord(glmix):
+    return FixedEffectCoordinate(
+        build_fixed_effect_batch(glmix, "global", dense=True),
+        GLMOptimizationProblem(
+            TaskType.LOGISTIC_REGRESSION, OptimizerType.LBFGS,
+            OptimizerConfig(max_iterations=25, tolerance=1e-9),
+            RegularizationContext.l2(0.05),
+        ),
+    )
+
+
+def _cd(glmix, re_coord):
+    labels = jnp.asarray(glmix.response)
+    loss_fn = lambda s: jnp.sum(losses.logistic.loss(s, labels))
+    return CoordinateDescent(
+        {"fixed": _fixed_coord(glmix), "re": re_coord}, loss_fn
+    )
+
+
+def _re_coord(glmix, **kw):
+    from photon_ml_tpu.data.game import build_random_effect_dataset
+
+    ds = build_random_effect_dataset(
+        glmix, RandomEffectDataConfig("userId", "per_user")
+    )
+    return RandomEffectCoordinate(
+        ds, TaskType.LOGISTIC_REGRESSION,
+        optimizer=kw.pop("optimizer", OptimizerType.LBFGS),
+        optimizer_config=OptimizerConfig(max_iterations=30, tolerance=1e-8),
+        regularization=RegularizationContext.l2(0.1),
+        **kw,
+    )
+
+
+def _assert_cd_results_equal(a, b):
+    assert a.objective_history == b.objective_history
+    for name, w in a.coefficients.items():
+        wa, wb = w, b.coefficients[name]
+        if hasattr(wa, "block"):  # spilled streaming state: compare blocks
+            for i in range(len(wa.shapes)):
+                np.testing.assert_array_equal(
+                    wa.block(i), wb.block(i), err_msg=f"{name} block {i}"
+                )
+        else:
+            np.testing.assert_array_equal(np.asarray(wa), np.asarray(wb))
+    np.testing.assert_array_equal(
+        np.asarray(a.total_scores), np.asarray(b.total_scores)
+    )
+
+
+class TestMidCyclePreemption:
+    def test_emergency_checkpoint_and_resume_bitwise(self, glmix, tmp_path):
+        n = glmix.num_rows
+        clean = _cd(glmix, _re_coord(glmix)).run(3, n)
+
+        ck_dir = str(tmp_path / "ckpt")
+        preemption.install_plan({"cycle": 3})
+        with pytest.raises(Preempted) as ei:
+            _cd(glmix, _re_coord(glmix)).run(
+                3, n, CoordinateDescentCheckpointer(ck_dir)
+            )
+        assert ei.value.checkpoint_path is not None
+        assert os.path.basename(ei.value.checkpoint_path) == "step-3"
+
+        preemption.reset()
+        resumed = _cd(glmix, _re_coord(glmix)).run(
+            3, n, CoordinateDescentCheckpointer(ck_dir)
+        )
+        _assert_cd_results_equal(clean, resumed)
+
+    def test_preempt_without_checkpointer_still_exits_distinctly(self, glmix):
+        preemption.install_plan({"cycle": 1})
+        with pytest.raises(Preempted) as ei:
+            _cd(glmix, _re_coord(glmix)).run(2, glmix.num_rows)
+        assert ei.value.checkpoint_path is None
+
+    def test_async_emergency_checkpoint_is_durable(self, glmix, tmp_path):
+        """The Preempted unwind passes through wait(): the emergency step
+        is on disk before the driver sees the exception."""
+        ck_dir = str(tmp_path / "ckpt")
+        preemption.install_plan({"cycle": 2})
+        ck = AsyncCheckpointer(CoordinateDescentCheckpointer(ck_dir))
+        with pytest.raises(Preempted):
+            _cd(glmix, _re_coord(glmix)).run(3, glmix.num_rows, ck)
+        assert os.path.exists(os.path.join(ck_dir, "step-2", "arrays.npz"))
+        ck.close()
+
+
+class TestMidChunkPreemption:
+    @pytest.mark.parametrize("opt", [OptimizerType.LBFGS, OptimizerType.TRON])
+    def test_mid_chunk_emergency_resume_bitwise(self, glmix, tmp_path, opt):
+        n = glmix.num_rows
+        sched = SolveSchedule(chunk_size=3)
+        clean = _cd(
+            glmix, _re_coord(glmix, optimizer=opt, solve_schedule=sched)
+        ).run(2, n)
+
+        ck_dir = str(tmp_path / "ckpt")
+        preemption.install_plan({"chunk": 2})
+        with pytest.raises(Preempted) as ei:
+            _cd(
+                glmix, _re_coord(glmix, optimizer=opt, solve_schedule=sched)
+            ).run(2, n, CoordinateDescentCheckpointer(ck_dir))
+        # the emergency checkpoint carries the paused carries + target step
+        assert ei.value.partial["meta"]["kind"] == "scheduler"
+
+        preemption.reset()
+        resumed = _cd(
+            glmix, _re_coord(glmix, optimizer=opt, solve_schedule=sched)
+        ).run(2, n, CoordinateDescentCheckpointer(ck_dir))
+        _assert_cd_results_equal(clean, resumed)
+
+
+class TestBucketedPreemption:
+    def test_mid_chunk_in_bucket_drops_partial_cleanly(self, glmix):
+        """The bucketed coordinate has no mid-bucket resume: a chunk-level
+        preemption must surface WITHOUT a partial (so the emergency
+        checkpoint lands at the update boundary and the relaunch recomputes
+        the coordinate whole) — never a TypeError on the resume path."""
+        from photon_ml_tpu.algorithm.bucketed_random_effect import (
+            BucketedRandomEffectCoordinate,
+        )
+
+        coord = BucketedRandomEffectCoordinate(
+            data=glmix,
+            config=RandomEffectDataConfig("userId", "per_user"),
+            task=TaskType.LOGISTIC_REGRESSION,
+            regularization=RegularizationContext.l2(0.2),
+            solve_schedule=SolveSchedule(chunk_size=3),
+        )
+        preemption.install_plan({"chunk": 2})
+        with pytest.raises(Preempted) as ei:
+            coord.update(
+                jnp.zeros((glmix.num_rows,), jnp.float32),
+                coord.initial_coefficients(),
+            )
+        assert ei.value.partial is None
+        assert ei.value.site == "chunk"
+
+
+class TestMidBlockPreemption:
+    def _streaming_coord(self, glmix, tmp_path, tag, **kw):
+        mani_dir = str(tmp_path / "blocks")
+        if not os.path.exists(os.path.join(mani_dir, "manifest.json")):
+            write_re_entity_blocks(
+                glmix, RandomEffectDataConfig("userId", "per_user"),
+                mani_dir, block_entities=16,
+            )
+        from photon_ml_tpu.algorithm import StreamingREManifest
+
+        return StreamingRandomEffectCoordinate(
+            StreamingREManifest.load(mani_dir),
+            TaskType.LOGISTIC_REGRESSION,
+            optimizer=OptimizerType.LBFGS,
+            optimizer_config=OptimizerConfig(max_iterations=30, tolerance=1e-8),
+            regularization=RegularizationContext.l2(0.1),
+            state_root=str(tmp_path / f"state-{tag}"),
+            prefetch_depth=0,
+            **kw,
+        )
+
+    def test_mid_block_emergency_resume_bitwise(self, glmix, tmp_path):
+        n = glmix.num_rows
+        clean = _cd(glmix, self._streaming_coord(glmix, tmp_path, "clean")).run(
+            2, n
+        )
+
+        ck_dir = str(tmp_path / "ckpt")
+        # 3 blocks -> 2 boundary polls per streaming update; poll 3 is the
+        # SECOND update's first boundary (step 4 of 4, block 0 spilled)
+        preemption.install_plan({"block": 3})
+        with pytest.raises(Preempted) as ei:
+            _cd(glmix, self._streaming_coord(glmix, tmp_path, "int")).run(
+                2, n, CoordinateDescentCheckpointer(ck_dir)
+            )
+        assert ei.value.partial["meta"]["kind"] == "streaming_re"
+
+        preemption.reset()
+        resumed = _cd(glmix, self._streaming_coord(glmix, tmp_path, "res")).run(
+            2, n, CoordinateDescentCheckpointer(ck_dir)
+        )
+        _assert_cd_results_equal(clean, resumed)
+
+    def test_mid_chunk_inside_streaming_block_resumes_bitwise(
+        self, glmix, tmp_path
+    ):
+        n = glmix.num_rows
+        sched = SolveSchedule(chunk_size=3)
+        clean = _cd(
+            glmix,
+            self._streaming_coord(glmix, tmp_path, "clean", solve_schedule=sched),
+        ).run(1, n)
+
+        ck_dir = str(tmp_path / "ckpt")
+        preemption.install_plan({"chunk": 2})
+        with pytest.raises(Preempted) as ei:
+            _cd(
+                glmix,
+                self._streaming_coord(glmix, tmp_path, "int", solve_schedule=sched),
+            ).run(1, n, CoordinateDescentCheckpointer(ck_dir))
+        meta = ei.value.partial["meta"]
+        assert meta["kind"] == "streaming_re" and meta["inner"] is not None
+
+        preemption.reset()
+        resumed = _cd(
+            glmix,
+            self._streaming_coord(glmix, tmp_path, "res", solve_schedule=sched),
+        ).run(1, n, CoordinateDescentCheckpointer(ck_dir))
+        _assert_cd_results_equal(clean, resumed)
+
+
+class TestGridCheckpoints:
+    def test_grid_resumes_per_cycle_bitwise(self, glmix, tmp_path):
+        n = glmix.num_rows
+        lam = {
+            "fixed": jnp.asarray([0.05, 0.2], jnp.float32),
+            "re": jnp.asarray([0.1, 0.5], jnp.float32),
+        }
+        clean = _cd(glmix, _re_coord(glmix)).run_grid(lam, 3, n)
+
+        cks = [
+            CoordinateDescentCheckpointer(str(tmp_path / f"combo-{i}"))
+            for i in range(2)
+        ]
+        # polls happen per non-final cycle per combo (2 per combo): the 3rd
+        # poll is combo 1's first cycle — preempt mid-grid
+        preemption.install_plan({"cycle": 3})
+        with pytest.raises(Preempted):
+            _cd(glmix, _re_coord(glmix)).run_grid(lam, 3, n, checkpointers=cks)
+        assert cks[0].latest_step() is not None  # combo 0 finished + saved
+
+        preemption.reset()
+        cks2 = [
+            CoordinateDescentCheckpointer(str(tmp_path / f"combo-{i}"))
+            for i in range(2)
+        ]
+        resumed = _cd(glmix, _re_coord(glmix)).run_grid(
+            lam, 3, n, checkpointers=cks2
+        )
+        assert len(resumed) == len(clean) == 2
+        for a, b in zip(clean, resumed):
+            assert a.objective_history == b.objective_history
+            for name, w in a.coefficients.items():
+                np.testing.assert_array_equal(
+                    np.asarray(w), np.asarray(b.coefficients[name])
+                )
+
+    def test_driver_grid_fence_lifted(self):
+        """--checkpoint-dir no longer blocks the shared-compile grid; the
+        narrower per-update machinery (divergence guard, compaction,
+        streaming) still falls back to the per-combo path."""
+        import dataclasses
+
+        from photon_ml_tpu.cli.game_params import (
+            FixedEffectDataSpec,
+            GameTrainingParams,
+        )
+        from photon_ml_tpu.cli.game_training_driver import GameTrainingDriver
+
+        p = GameTrainingParams(
+            train_input_dirs=["x"], output_dir="o",
+            updating_sequence=["fixed"],
+            fixed_effect_data_configs={"fixed": FixedEffectDataSpec("global")},
+            checkpoint_dir="/ckpt",
+        )
+
+        class _D:
+            params = p
+            solve_schedule = None
+
+        combos = [{}, {}]
+        assert GameTrainingDriver._vmapped_grid_blocker(_D(), combos) is None
+        # the per-update restriction stays: a divergence guard gates every
+        # update host-side and cannot enter the compiled cycle
+        _D.params = dataclasses.replace(p, divergence_guard="rollback")
+        assert "divergence-guard" in GameTrainingDriver._vmapped_grid_blocker(
+            _D(), combos
+        )
+
+
+# ---------------------------------------------------------------------------
+# multihost health fencing
+# ---------------------------------------------------------------------------
+
+
+class _FakeMH:
+    """Duck-typed stand-in for MultihostContext in checkpointer tests."""
+
+    def __init__(self, agreed):
+        self.agreed = agreed
+        self.barriers = []
+
+    def coordinator_only_io(self):
+        return True
+
+    def barrier(self, name="b", timeout=None):
+        self.barriers.append(name)
+
+    def agree_restore_step(self, local_step):
+        return self.agreed
+
+
+class TestMultihostFencing:
+    def test_barrier_deadline_converts_hang_to_error(self, monkeypatch):
+        from jax.experimental import multihost_utils
+
+        from photon_ml_tpu.parallel.multihost import (
+            BarrierTimeoutError,
+            MultihostContext,
+        )
+
+        monkeypatch.setattr(
+            multihost_utils, "sync_global_devices",
+            lambda name: time.sleep(30),
+        )
+        ctx = MultihostContext(process_id=0, num_processes=2)
+        t0 = time.monotonic()
+        # NOT retried: re-entering the collective behind an abandoned wait
+        # would desync barrier sequencing — diagnose-and-fail, one attempt
+        with pytest.raises(BarrierTimeoutError) as ei:
+            ctx.barrier("test-fence", timeout=0.2)
+        assert "wedged" in str(ei.value)
+        assert time.monotonic() - t0 < 10  # converted, not hung
+
+    def test_barrier_timeout_env_resolution(self, monkeypatch):
+        from photon_ml_tpu.parallel.multihost import resolve_barrier_timeout
+
+        assert resolve_barrier_timeout(5.0) == 5.0
+        assert resolve_barrier_timeout(0) is None
+        monkeypatch.setenv("PHOTON_BARRIER_TIMEOUT", "30")
+        assert resolve_barrier_timeout(None) == 30.0
+        monkeypatch.setenv("PHOTON_BARRIER_TIMEOUT", "0")
+        assert resolve_barrier_timeout(None) is None
+
+    def test_agree_restore_step_single_process_passthrough(self):
+        from photon_ml_tpu.parallel.multihost import MultihostContext
+
+        ctx = MultihostContext(process_id=0, num_processes=1)
+        assert ctx.agree_restore_step(7) == 7
+        assert ctx.agree_restore_step(None) is None
+
+    def test_restore_respects_collective_min(self, tmp_path):
+        """A host that holds steps {1, 2} but whose peer only committed 1
+        restores step 1 — never the step the peer is missing."""
+        mh = _FakeMH(agreed=1)
+        ck = CoordinateDescentCheckpointer(str(tmp_path), multihost=mh)
+        s1, s2 = _mini_state(1), _mini_state(2)
+        ck.save(s1)
+        ck.save(s2)
+        restored = ck.restore(s1.params, s1.scores, s1.total_scores)
+        assert restored.step == 1
+        np.testing.assert_array_equal(
+            np.asarray(restored.params["fe"]), np.asarray(s1.params["fe"])
+        )
+        # and a peer with NOTHING forces a fresh start
+        mh.agreed = None
+        assert ck.restore(s1.params, s1.scores, s1.total_scores) is None
+
+    def test_heartbeats_age_and_name_missing_hosts(self, tmp_path):
+        from photon_ml_tpu.parallel.multihost import MultihostContext
+
+        ctx = MultihostContext(process_id=0, num_processes=2)
+        hb = str(tmp_path / "hb")
+        plan = faults.FaultPlan(
+            [faults.FaultSpec("multihost.heartbeat", at=1)]
+        )
+        with faults.fault_scope(plan):
+            ctx.write_heartbeat(hb, step=3)  # first attempt faults, retried
+        assert plan.fire_count("multihost.heartbeat") == 1
+        ages = ctx.heartbeat_ages(hb)
+        assert list(ages) == [0] and ages[0] < 60
+        desc = ctx.describe_heartbeats(hb)
+        assert "host 0" in desc and "host 1: NO HEARTBEAT" in desc

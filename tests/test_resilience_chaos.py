@@ -219,3 +219,108 @@ class TestGameChaos:
                 np.asarray(w),
                 rtol=1e-6, atol=1e-7,
             )
+
+
+@pytest.mark.faults
+@pytest.mark.preempt
+class TestPreemptionChaos:
+    """Cooperative preemption end-to-end through the training driver: a
+    deterministic "SIGTERM" (PHOTON_PREEMPT_AT) lands mid-run, the driver
+    drains to the boundary, writes an emergency checkpoint, and the
+    --max-restarts supervisor relaunches in-process to a final model
+    BITWISE-equal to an uninterrupted run."""
+
+    def _reset(self):
+        from photon_ml_tpu.resilience import preemption
+
+        preemption.reset()
+
+    def test_preempt_mid_cycle_supervised_rerun_bitwise(
+        self, chaos_train_dir, tmp_path, monkeypatch
+    ):
+        train_dir, _ = chaos_train_dir
+        straight = _run_driver(
+            train_dir,
+            str(tmp_path / "straight"),
+            4,
+            extra=("--checkpoint-dir", str(tmp_path / "ckpt-a")),
+        )
+        self._reset()
+        # fire at the 3rd update boundary; the supervisor relaunches once
+        # and the relaunched attempt resumes from the emergency checkpoint.
+        # --checkpoint-async additionally exercises the background-commit
+        # path end-to-end (the emergency save fences via wait()).
+        monkeypatch.setenv("PHOTON_PREEMPT_AT", "cycle:3")
+        try:
+            resumed = _run_driver(
+                train_dir,
+                str(tmp_path / "resumed"),
+                4,
+                extra=(
+                    "--checkpoint-dir", str(tmp_path / "ckpt-b"),
+                    "--checkpoint-async", "true",
+                    "--max-restarts", "2",
+                ),
+            )
+        finally:
+            self._reset()
+        # the spec actually fired (the flag machinery consumed it)
+        r_straight = straight.results[0][1]
+        r_resumed = resumed.results[0][1]
+        assert r_resumed.objective_history == r_straight.objective_history
+        for name, w in r_straight.coefficients.items():
+            np.testing.assert_array_equal(
+                np.asarray(r_resumed.coefficients[name]), np.asarray(w)
+            )
+        # the emergency checkpoint landed (step 3, retired or superseded by
+        # later saves — SOME step dir exists and the run completed)
+        assert any(
+            d.startswith("step-")
+            for d in os.listdir(tmp_path / "ckpt-b" / "combo-0")
+        )
+
+    def test_preempt_without_restart_budget_exits_with_code(
+        self, chaos_train_dir, tmp_path, monkeypatch
+    ):
+        from photon_ml_tpu.resilience import preemption
+
+        train_dir, _ = chaos_train_dir
+        self._reset()
+        monkeypatch.setenv("PHOTON_PREEMPT_AT", "cycle:2")
+        try:
+            with pytest.raises(SystemExit) as ei:
+                _run_driver(
+                    train_dir,
+                    str(tmp_path / "out"),
+                    4,
+                    extra=("--checkpoint-dir", str(tmp_path / "ckpt")),
+                )
+        finally:
+            self._reset()
+        assert ei.value.code == preemption.PREEMPT_EXIT_CODE
+        # the emergency checkpoint is on disk for the NEXT (supervised) run
+        assert os.path.exists(tmp_path / "ckpt" / "combo-0" / "step-2")
+
+    def test_injected_preempt_signal_via_photon_faults(
+        self, chaos_train_dir, tmp_path
+    ):
+        """The seeded fault registry can deliver the preemption too
+        (PHOTON_FAULTS="preempt.signal:at=N") — same drain, same resume."""
+        train_dir, _ = chaos_train_dir
+        self._reset()
+        plan = faults.FaultPlan([faults.FaultSpec("preempt.signal", at=4)])
+        try:
+            resumed = _run_driver(
+                train_dir,
+                str(tmp_path / "out"),
+                4,
+                extra=(
+                    "--checkpoint-dir", str(tmp_path / "ckpt"),
+                    "--max-restarts", "1",
+                ),
+                plan=plan,
+            )
+        finally:
+            self._reset()
+        assert plan.fire_count("preempt.signal") == 1
+        assert len(resumed.results[0][1].objective_history) == 8

@@ -1,0 +1,96 @@
+#!/usr/bin/env python
+"""Restart supervisor: rerun a command while it exits with the preemption
+code.
+
+The cross-process half of the preemption story
+(photon_ml_tpu/resilience/preemption.py): the drivers convert a cooperative
+preemption (SIGTERM / ``PHOTON_PREEMPT_AT``) into exit code 75
+(EX_TEMPFAIL) after writing an emergency checkpoint. This supervisor
+relaunches exactly that exit code — a crash (any other nonzero code) or a
+clean finish passes through untouched, so a genuinely broken run never
+flaps in a restart loop.
+
+Usage::
+
+    python tools/run_supervised.py [--max-restarts N] [--backoff SECONDS] \\
+        -- python -m photon_ml_tpu.cli.game_training_driver \\
+           --checkpoint-dir /ckpts ...
+
+The relaunched command resumes from its latest checkpoint through the
+driver's normal restore path; the supervisor only counts restarts and
+propagates the final exit code. (For in-process supervision — no re-ingest
+— prefer the drivers' own ``--max-restarts`` flag; this tool is for the
+cases where the process itself must die: cgroup teardown, wrapper scripts,
+chaos harnesses that SIGKILL.)
+"""
+
+from __future__ import annotations
+
+import argparse
+import subprocess
+import sys
+import time
+from typing import List, Optional
+
+# mirrored from photon_ml_tpu.resilience.preemption.PREEMPT_EXIT_CODE —
+# duplicated here so the supervisor stays importable on hosts without the
+# package installed (it supervises arbitrary commands)
+PREEMPT_EXIT_CODE = 75
+
+
+def supervise(
+    cmd: List[str],
+    max_restarts: int = 16,
+    backoff: float = 0.0,
+    run=subprocess.call,
+    log=lambda msg: print(msg, file=sys.stderr),
+    sleep=time.sleep,
+) -> int:
+    """Run ``cmd``; relaunch while it exits PREEMPT_EXIT_CODE, up to
+    ``max_restarts`` times. Returns the final exit code (``run``/``log``/
+    ``sleep`` injectable so tests run instantly without subprocesses)."""
+    restarts = 0
+    while True:
+        rc = run(cmd)
+        if rc != PREEMPT_EXIT_CODE:
+            return rc
+        if restarts >= max_restarts:
+            log(
+                f"run_supervised: still preempted after {restarts} "
+                f"restart(s); giving up with exit {rc}"
+            )
+            return rc
+        restarts += 1
+        log(
+            f"run_supervised: preempted (exit {rc}); restart "
+            f"{restarts}/{max_restarts}"
+        )
+        if backoff > 0:
+            sleep(backoff)
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    if "--" in argv:
+        split = argv.index("--")
+        own, cmd = argv[:split], argv[split + 1:]
+    else:
+        own, cmd = [], argv
+    parser = argparse.ArgumentParser(
+        prog="run_supervised",
+        description="rerun a command while it exits with the preemption "
+        f"code ({PREEMPT_EXIT_CODE})",
+    )
+    parser.add_argument("--max-restarts", type=int, default=16)
+    parser.add_argument(
+        "--backoff", type=float, default=0.0,
+        help="seconds to wait before each relaunch",
+    )
+    ns = parser.parse_args(own)
+    if not cmd:
+        parser.error("no command given (pass it after --)")
+    return supervise(cmd, max_restarts=ns.max_restarts, backoff=ns.backoff)
+
+
+if __name__ == "__main__":
+    sys.exit(main())
