@@ -392,6 +392,155 @@ def _bench_scoring(extra, on_tpu):
     extra["scoring_config"] = {"rows": n_rows, "entities": n_entities, "d": d, "nnz": k}
 
 
+def _bench_serving(extra, on_tpu):
+    """Online scoring service (photon_ml_tpu/serve): p50/p99 latency + QPS
+    vs micro-batch size through the warm server, request scores BITWISE-
+    equal to the batch game_scoring_driver on the same inputs, and a live
+    model-swap arm (zero new compiles, zero dropped requests)."""
+    import concurrent.futures
+    import shutil
+    import tempfile
+
+    from game_test_utils import (
+        game_avro_records,
+        make_glmix_data,
+        save_synthetic_game_model,
+        serve_requests_from_records,
+        write_game_avro,
+    )
+
+    from photon_ml_tpu.cli import game_scoring_driver
+    from photon_ml_tpu.compile import ShapeBucketer, compile_stats
+    from photon_ml_tpu.serve import (
+        ModelStore,
+        ModelSwapper,
+        ScoringServer,
+        ServeStats,
+        build_model_store,
+    )
+
+    tmp = tempfile.mkdtemp(prefix="bench-serving-")
+    try:
+        rng = np.random.default_rng(11)
+        num_users = 256 if on_tpu else 64
+        d_fixed, d_random = 8, 6
+        data, truth = make_glmix_data(
+            rng, num_users=num_users, rows_per_user_range=(4, 10),
+            d_fixed=d_fixed, d_random=d_random,
+        )
+        offsets = rng.normal(size=data.num_rows).astype(np.float32)
+        model_dir = os.path.join(tmp, "model")
+        save_synthetic_game_model(
+            model_dir, rng, d_fixed=d_fixed, d_random=d_random,
+            num_users=num_users,
+        )
+        in_dir = os.path.join(tmp, "in")
+        os.makedirs(in_dir)
+        write_game_avro(
+            os.path.join(in_dir, "part-0.avro"), data,
+            range(data.num_rows), truth, offsets,
+        )
+        store_dir = os.path.join(tmp, "store")
+        build_model_store(model_dir, store_dir, bucketer=ShapeBucketer())
+
+        # batch-driver oracle over the SAME feature space (the store's
+        # feature index doubles as --offheap-indexmap-dir)
+        drv = game_scoring_driver.main([
+            "--input-dirs", in_dir,
+            "--game-model-input-dir", model_dir,
+            "--output-dir", os.path.join(tmp, "score-out"),
+            "--offheap-indexmap-dir", os.path.join(store_dir, "features"),
+            "--feature-shard-id-to-feature-section-keys-map",
+            "global:fixedFeatures|per_user:userFeatures",
+            "--delete-output-dir-if-exists", "true",
+        ])
+        records = list(
+            game_avro_records(data, range(data.num_rows), truth, offsets)
+        )
+        reqs = serve_requests_from_records(records)
+        sections = {"global": ["fixedFeatures"], "per_user": ["userFeatures"]}
+
+        def fire(server, requests, workers=32):
+            """One-row requests from concurrent client threads, results in
+            submit order."""
+            with concurrent.futures.ThreadPoolExecutor(workers) as pool:
+                futs = list(pool.map(lambda q: server.submit_rows([q]), requests))
+            return np.concatenate([f.result() for f in futs])
+
+        latency_vs_batch = {}
+        bitwise = None
+        for max_batch in (1, 8, 32, 128):
+            server = ScoringServer(
+                ModelStore(store_dir), shard_sections=sections,
+                max_batch_rows=max_batch, max_wait_ms=2.0, stats=ServeStats(),
+            )
+            server.warmup(warm_nnz=16)
+            served = fire(server, reqs)
+            snap = server.stats.snapshot()
+            latency_vs_batch[str(max_batch)] = {
+                "p50_ms": snap["p50_ms"],
+                "p99_ms": snap["p99_ms"],
+                "qps": snap["qps"],
+                "batch_fill": snap["batch_fill_ratio"],
+            }
+            if max_batch == 32:
+                bitwise = bool(np.array_equal(served, drv.scores))
+            _log(
+                f"serving[batch<={max_batch}]: p50 {snap['p50_ms']}ms / "
+                f"p99 {snap['p99_ms']}ms, {snap['qps']} req/s, "
+                f"fill {snap['batch_fill_ratio']:.0%}"
+            )
+            server.close()
+        if not bitwise:
+            raise AssertionError(
+                "served scores are not bitwise-equal to game_scoring_driver"
+            )
+
+        # swap arm: roll to a perturbed model (same entity count -> same
+        # ladder rung) under live traffic
+        model2 = os.path.join(tmp, "model2")
+        save_synthetic_game_model(
+            model2, np.random.default_rng(12), d_fixed=d_fixed,
+            d_random=d_random, num_users=num_users,
+        )
+        store2 = os.path.join(tmp, "store2")
+        build_model_store(model2, store2, bucketer=ShapeBucketer())
+        server = ScoringServer(
+            ModelStore(store_dir), shard_sections=sections,
+            max_batch_rows=32, max_wait_ms=2.0, stats=ServeStats(),
+        )
+        server.warmup(warm_nnz=16)
+        swapper = ModelSwapper(server)
+        wm = compile_stats.watermark()
+        with concurrent.futures.ThreadPoolExecutor(16) as pool:
+            futs = [pool.submit(server.score_rows, [q]) for q in reqs]
+            report = swapper.swap(store2)
+            results = [f.result() for f in futs]  # raises on any drop/error
+        dropped = sum(1 for r in results if r is None or len(r) != 1)
+        server.close()
+        _log(
+            f"serving swap: gen {report['generation']}, "
+            f"{report['new_compiles']} new compiles during swap, "
+            f"{wm.new_traces()} traces over the whole swap window, "
+            f"{dropped} dropped of {len(results)}"
+        )
+        if report["new_compiles"] != 0 or dropped != 0:
+            raise AssertionError(
+                f"model swap must be compile-free and lossless "
+                f"(compiles={report['new_compiles']}, dropped={dropped})"
+            )
+        extra["serving_latency_vs_batch"] = latency_vs_batch
+        extra["serving_bitwise_equal_to_driver"] = bool(bitwise)
+        extra["serving_swap_new_compiles"] = int(report["new_compiles"])
+        extra["serving_swap_dropped_requests"] = int(dropped)
+        extra["serving_config"] = {
+            "rows": int(data.num_rows), "entities": num_users,
+            "d_fixed": d_fixed, "d_random": d_random,
+        }
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
 def _bench_perhost(extra, on_tpu):
     """Per-host ingest shuffle (parallel/shuffle + perhost_ingest): rows/sec
     through the full collective regroup — bucket-count psum, balanced owner
@@ -1413,7 +1562,7 @@ SECTION_ORDER = (
     "dense", "sparse", "game", "game5", "grid",
     "streaming", "streaming_pipeline", "compile_reuse", "compaction",
     "preemption_resume",
-    "perhost", "scoring", "ingest",
+    "perhost", "scoring", "serving", "ingest",
 )
 # orchestrator per-section deadlines (s): generous — tunnel compiles are slow,
 # and hitting a deadline DETACHES the child (never kills: r3 claim-orphan
@@ -1483,6 +1632,8 @@ def _run_sections(names, extra, errors, on_tpu, state=None, after=None):
                 _bench_perhost(extra, on_tpu)
             elif name == "scoring":
                 _bench_scoring(extra, on_tpu)
+            elif name == "serving":
+                _bench_serving(extra, on_tpu)
             elif name == "ingest":
                 _bench_ingest(extra)
         except Exception:
@@ -1500,6 +1651,13 @@ def _run_sections(names, extra, errors, on_tpu, state=None, after=None):
                 errors[name] = tb
                 if sig is not None and wedged_by is None:
                     wedged_by = (name, sig)
+            # failed-with-reason marker in the PAYLOAD (not just errors —
+            # which partial saves truncate): the capture records which
+            # sections died and why in one line, and the run continues
+            # (BENCH_r05 postmortem: a device wedge in perhost/scoring must
+            # never erase the sections after it)
+            last = tb.strip().splitlines()[-1] if tb.strip() else "unknown"
+            extra.setdefault("sections_failed", {})[name] = last[:200]
         if after is not None:
             after()
     return value

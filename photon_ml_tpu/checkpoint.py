@@ -91,6 +91,25 @@ def _is_ref_leaf(x: Any) -> bool:
     return hasattr(x, "__checkpoint_ref__")
 
 
+def rebuild_from_ref(template: Any, ref: Any) -> Any:
+    """Rebuild a by-reference state leaf from its stored JSON ref.
+
+    The single entry point of the by-reference restore path: checkpoint
+    restore uses it for ``__checkpoint_ref__`` leaves (spilled streaming
+    coefficients), and the serving :class:`~photon_ml_tpu.serve.swap.
+    ModelSwapper` rolls a live server to a new model through the same
+    protocol — the template (the currently-installed leaf) validates the
+    ref kind and constructs the replacement; a stale/wrong-kind ref raises
+    :class:`CheckpointRefError` so the caller falls back instead of
+    installing garbage."""
+    if not hasattr(template, "__checkpoint_from_ref__"):
+        raise CheckpointRefError(
+            f"cannot rebuild {type(template).__name__} from a reference: "
+            "the template has no __checkpoint_from_ref__"
+        )
+    return template.__checkpoint_from_ref__(ref)
+
+
 def _flatten_state(state: Dict[str, Any]) -> Tuple[Dict[str, np.ndarray], Dict[str, Any]]:
     """Pytree state dict -> (flat arrays, structure description). Leaves
     with a ``__checkpoint_ref__`` protocol (state that is ALREADY durable
@@ -145,7 +164,7 @@ def _unflatten_state(
                         "reference but the template leaf has no "
                         "__checkpoint_from_ref__ — coordinate types changed"
                     )
-                new_leaves.append(tmpl_leaf.__checkpoint_from_ref__(refs[str(i)]))
+                new_leaves.append(rebuild_from_ref(tmpl_leaf, refs[str(i)]))
             else:
                 new_leaves.append(jnp.asarray(arrays[f"{name}.{i}"]))
         out[name] = jax.tree_util.tree_unflatten(treedef, new_leaves)

@@ -742,6 +742,137 @@ def build_scoring_parser() -> argparse.ArgumentParser:
     return p
 
 
+@dataclasses.dataclass
+class GameServeParams:
+    """Online scoring server parameters (photon_ml_tpu.serve). A designed
+    upgrade — the reference has no serving path; its scoring Driver is
+    batch-only."""
+
+    # model source: a prebuilt serve store, or a saved GAME model dir the
+    # driver exports into one at --model-store-dir first
+    model_store_dir: str = ""
+    game_model_input_dir: Optional[str] = None
+    feature_shard_sections: Dict[str, List[str]] = dataclasses.field(default_factory=dict)
+    # micro-batching (serve/batcher.py): coalesce concurrent requests up to
+    # this many rows / this long a wait onto one ladder-canonical batch
+    max_batch_rows: int = 128
+    max_wait_ms: float = 2.0
+    # canonical shape ladder — defaults ON for serving (a server lives or
+    # dies by executable reuse across arbitrary request shapes)
+    shape_canonicalization: str = "on"
+    # persistent XLA cache: a warm server start compiles NOTHING
+    persistent_cache_dir: Optional[str] = None
+    # warmup: pre-score every (rows, nnz) ladder rung at startup; nnz cap
+    # per shard for the warmed rungs (requests wider than this pay one
+    # compile on first sight)
+    warmup: bool = True
+    warm_nnz: Optional[int] = None
+    # fail startup unless the warm start compiled nothing new in XLA
+    # (requires --persistent-cache and a prior run to have filled it)
+    assert_warm: bool = False
+    # export the model store from --game-model-input-dir then exit
+    build_store_only: bool = False
+    num_store_partitions: int = 1
+    log_path: Optional[str] = None
+
+    def validate(self) -> None:
+        errors = []
+        if not self.model_store_dir:
+            errors.append("--model-store-dir is required")
+        if self.build_store_only and not self.game_model_input_dir:
+            errors.append("--build-store-only needs --game-model-input-dir")
+        if self.max_batch_rows < 1:
+            errors.append("--max-batch-rows must be >= 1")
+        if self.max_wait_ms < 0:
+            errors.append("--max-wait-ms must be >= 0")
+        if self.num_store_partitions < 1:
+            errors.append("--num-store-partitions must be >= 1")
+        if self.warm_nnz is not None and self.warm_nnz < 1:
+            errors.append("--warm-nnz must be >= 1")
+        if self.assert_warm and not self.persistent_cache_dir:
+            errors.append(
+                "--assert-warm needs --persistent-cache (zero new compiles "
+                "is only achievable from a filled persistent cache)"
+            )
+        if self.assert_warm and not self.warmup:
+            errors.append(
+                "--assert-warm needs warmup: with --no-warmup nothing "
+                "compiles at startup, so 'zero new compiles' would hold "
+                "vacuously while every first request pays a compile"
+            )
+        try:
+            from photon_ml_tpu.compile import resolve_bucketer
+
+            resolve_bucketer(self.shape_canonicalization)
+        except ValueError as e:
+            errors.append(f"--shape-canonicalization: {e}")
+        if errors:
+            raise ValueError("; ".join(errors))
+
+
+def build_serve_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="photon-ml-tpu game-serve",
+        description="persistent online GAME scoring server (JSON-lines on "
+        "stdin/stdout; photon_ml_tpu.serve)",
+    )
+    a = p.add_argument
+    a("--model-store-dir", required=True,
+      help="mmap'd serving store (serve/model_store.py layout); built here "
+           "from --game-model-input-dir when absent")
+    a("--game-model-input-dir", default=None,
+      help="saved GAME model dir (reference Avro layout) to export into "
+           "the store when the store does not exist yet")
+    a("--feature-shard-id-to-feature-section-keys-map", dest="shard_sections",
+      default=None)
+    a("--max-batch-rows", type=int, default=128,
+      help="micro-batch row cap: concurrent requests coalesce up to this "
+           "many rows per device call")
+    a("--max-wait-ms", type=float, default=2.0,
+      help="micro-batch window: the first request of an idle window waits "
+           "at most this long for company (a saturated queue never waits)")
+    a("--shape-canonicalization", default="on",
+      help="batch-shape ladder: off | on | BASE:GROWTH (default ON — every "
+           "request shape rounds up to a warmed canonical executable)")
+    a("--persistent-cache", dest="persistent_cache_dir", default=None,
+      help="persistent XLA compilation cache dir: a warm server start "
+           "compiles nothing (asserted when --assert-warm)")
+    a("--no-warmup", action="store_true",
+      help="skip the startup ladder warmup (first requests then compile)")
+    a("--warm-nnz", type=int, default=None,
+      help="nnz-per-row cap the warmup assumes (default 64, clamped to the "
+           "feature dim)")
+    a("--assert-warm", default="false",
+      help="fail startup unless zero new XLA compiles after warmup")
+    a("--build-store-only", default="false",
+      help="export --game-model-input-dir into --model-store-dir, then exit")
+    a("--num-store-partitions", type=int, default=1,
+      help="pmix partitions for the store's feature/entity lookups")
+    a("--log-path", default=None, help="log file (default: stderr only)")
+    return p
+
+
+def parse_serve_params(argv: Optional[List[str]] = None) -> GameServeParams:
+    ns = build_serve_parser().parse_args(argv)
+    params = GameServeParams(
+        model_store_dir=ns.model_store_dir,
+        game_model_input_dir=ns.game_model_input_dir,
+        feature_shard_sections=parse_shard_sections(ns.shard_sections),
+        max_batch_rows=ns.max_batch_rows,
+        max_wait_ms=ns.max_wait_ms,
+        shape_canonicalization=ns.shape_canonicalization,
+        persistent_cache_dir=ns.persistent_cache_dir,
+        warmup=not ns.no_warmup,
+        warm_nnz=ns.warm_nnz,
+        assert_warm=_truthy(ns.assert_warm),
+        build_store_only=_truthy(ns.build_store_only),
+        num_store_partitions=ns.num_store_partitions,
+        log_path=ns.log_path,
+    )
+    params.validate()
+    return params
+
+
 def parse_scoring_params(argv: Optional[List[str]] = None) -> GameScoringParams:
     ns = build_scoring_parser().parse_args(argv)
     params = GameScoringParams(

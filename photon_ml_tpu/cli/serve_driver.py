@@ -1,0 +1,173 @@
+"""Online scoring server driver.
+
+Brings a persistent GAME scoring process up warm and serves JSON-lines
+requests on stdin/stdout (photon_ml_tpu/serve). A designed upgrade over
+the reference, which only ships a batch scoring Driver — the startup
+sequence is the whole point:
+
+  1. resolve the model store (export a saved GAME model into the mmap'd
+     serving layout if the store does not exist yet),
+  2. enable the persistent XLA cache (compat.enable_persistent_cache),
+  3. warm every (rows, nnz) ladder rung the request path can produce,
+  4. log ``compile_stats.summary()`` and — on a warm cache — "serving
+     fully warm: zero new XLA compiles" (``--assert-warm`` makes that a
+     hard startup gate),
+  5. serve; a ``{"cmd": "swap", "store_dir": ...}`` line rolls the model
+     live through the by-reference swap path.
+
+Usage::
+
+    python -m photon_ml_tpu.cli.serve_driver \
+        --model-store-dir /models/store \
+        --game-model-input-dir /models/best \
+        --persistent-cache /cache/xla --assert-warm true < requests.jsonl
+"""
+
+from __future__ import annotations
+
+import sys
+from typing import List, Optional
+
+from photon_ml_tpu.cli.game_params import GameServeParams, parse_serve_params
+from photon_ml_tpu.utils.logging import PhotonLogger
+
+
+class GameServeDriver:
+    """Builds/opens the store, warms the server, runs the request loop."""
+
+    def __init__(self, params: GameServeParams, logger: Optional[PhotonLogger] = None):
+        params.validate()
+        self.params = params
+        self._own_logger = logger is None
+        self.logger = logger or PhotonLogger(params.log_path)
+        self.server = None
+        self.swapper = None
+        self.warm_report: Optional[dict] = None
+        self.handled = 0
+
+    # ------------------------------------------------------------------
+    def resolve_store(self):
+        from photon_ml_tpu.compile import resolve_bucketer
+        from photon_ml_tpu.serve import ModelStore, build_model_store, is_model_store
+
+        p = self.params
+        if not is_model_store(p.model_store_dir):
+            if not p.game_model_input_dir:
+                raise ValueError(
+                    f"{p.model_store_dir} is not a serve store and no "
+                    "--game-model-input-dir was given to export from"
+                )
+            self.logger.info(
+                f"exporting {p.game_model_input_dir} -> serve store "
+                f"{p.model_store_dir}"
+            )
+            build_model_store(
+                p.game_model_input_dir,
+                p.model_store_dir,
+                num_partitions=p.num_store_partitions,
+                bucketer=resolve_bucketer(p.shape_canonicalization),
+            )
+        store = ModelStore(p.model_store_dir)
+        self.logger.info(store.describe())
+        return store
+
+    def start(self):
+        """Everything up to (not including) the blocking request loop."""
+        from photon_ml_tpu import compat
+        from photon_ml_tpu.compile import compile_stats
+        from photon_ml_tpu.serve import ModelSwapper, ScoringServer
+
+        p = self.params
+        cache_ok = False
+        if p.persistent_cache_dir:
+            cache_ok = compat.enable_persistent_cache(p.persistent_cache_dir)
+            if cache_ok:
+                self.logger.info(
+                    f"persistent XLA compilation cache: {p.persistent_cache_dir}"
+                )
+            else:
+                self.logger.warn(
+                    "--persistent-cache requested but this jax has no "
+                    "compilation-cache API; compiling uncached"
+                )
+        listeners_ok = compile_stats.install_xla_listeners()
+        if p.assert_warm and not (cache_ok and listeners_ok):
+            # the gate must not be vacuously satisfiable: with no cache the
+            # start cannot be warm, and with no monitoring API the miss
+            # counter would stay 0 no matter how much XLA compiled
+            raise RuntimeError(
+                "--assert-warm needs a working persistent cache "
+                f"(enabled={cache_ok}) and the jax.monitoring compile "
+                f"listeners (installed={listeners_ok}) to be verifiable "
+                "on this jax version"
+            )
+        store = self.resolve_store()
+        if p.build_store_only:
+            store.close()
+            return None
+        self.server = ScoringServer(
+            store,
+            shard_sections=p.feature_shard_sections,
+            bucketer=p.shape_canonicalization,
+            max_batch_rows=p.max_batch_rows,
+            max_wait_ms=p.max_wait_ms,
+        )
+        self.swapper = ModelSwapper(self.server)
+        if p.warmup:
+            self.warm_report = self.server.warmup(warm_nnz=p.warm_nnz)
+            self.logger.info(
+                f"warmup: {self.warm_report['warm_batches']} batches over "
+                f"row rungs {self.warm_report['row_rungs']} x nnz rungs "
+                f"{self.warm_report['nnz_rungs']}; "
+                f"{self.warm_report['new_traces']} traces, "
+                f"{self.warm_report['new_xla_misses']} new XLA compiles"
+            )
+        self.logger.info(compile_stats.summary())
+        if cache_ok and listeners_ok and self.server.fully_warm():
+            self.logger.info("serving fully warm: zero new XLA compiles")
+        elif p.assert_warm:
+            raise RuntimeError(
+                f"--assert-warm: startup compiled "
+                f"{compile_stats.xla_cache_misses} new XLA executables "
+                "(persistent cache cold or ladder changed)"
+            )
+        return self.server
+
+    def run(self, in_stream=None, out_stream=None) -> None:
+        from photon_ml_tpu.serve import serve_json_lines
+
+        try:
+            if self.start() is None:
+                return  # --build-store-only
+            self.logger.info(
+                f"serving (max_batch_rows={self.params.max_batch_rows}, "
+                f"max_wait_ms={self.params.max_wait_ms})"
+            )
+            self.handled = serve_json_lines(
+                self.server,
+                in_stream if in_stream is not None else sys.stdin,
+                out_stream if out_stream is not None else sys.stdout,
+                swapper=self.swapper,
+            )
+        finally:
+            if self.server is not None:
+                self.logger.info(self.server.stats.summary())
+                if self.server.new_request_compiles():
+                    self.logger.warn(
+                        f"{self.server.new_request_compiles()} request-path "
+                        "compiles AFTER warmup — a request shape escaped the "
+                        "warmed ladder (raise --warm-nnz or --max-batch-rows)"
+                    )
+                self.server.close()
+            if self._own_logger:
+                self.logger.close()
+
+
+def main(argv: Optional[List[str]] = None) -> GameServeDriver:
+    driver = GameServeDriver(parse_serve_params(argv))
+    driver.run()
+    return driver
+
+
+if __name__ == "__main__":
+    main()

@@ -33,7 +33,12 @@ from photon_ml_tpu.compile.canonical import (
     pad_glm_chunk,
     resolve_bucketer,
 )
-from photon_ml_tpu.compile.stats import CompileStats, compile_stats, instrumented_jit
+from photon_ml_tpu.compile.stats import (
+    CompileStats,
+    CompileWatermark,
+    compile_stats,
+    instrumented_jit,
+)
 
 _DONATE_ENV = "PHOTON_DONATE"
 
@@ -49,6 +54,7 @@ def donation_enabled() -> bool:
 
 __all__ = [
     "CompileStats",
+    "CompileWatermark",
     "ShapeBucketer",
     "canonicalize_re_arrays",
     "canonicalize_re_dataset",

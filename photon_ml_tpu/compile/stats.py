@@ -125,6 +125,18 @@ class CompileStats:
             )
         return "\n".join(lines)
 
+    def watermark(self) -> "CompileWatermark":
+        """Capture the current counters; the returned watermark reports how
+        many NEW traces / XLA cache misses happened since. The zero-compile
+        assertions (warm serving start, live model swap, warm resume) all
+        phrase themselves as "no new compiles past this watermark"."""
+        with self._lock:
+            return CompileWatermark(
+                self,
+                sum(s.traces for s in self._sites.values()),
+                self.xla_cache_misses,
+            )
+
     # -- jax.monitoring bridge ----------------------------------------------
     def install_xla_listeners(self) -> bool:
         """Hook the XLA compilation-cache + compile-duration monitoring
@@ -157,6 +169,26 @@ class CompileStats:
             return False  # older monitoring surface: trace-only telemetry
         self._listeners_installed = True
         return True
+
+
+@dataclasses.dataclass(frozen=True)
+class CompileWatermark:
+    """A point-in-time snapshot of trace/XLA-miss counters (see
+    :meth:`CompileStats.watermark`)."""
+
+    stats: CompileStats
+    traces0: int
+    xla_misses0: int
+
+    def new_traces(self) -> int:
+        return self.stats.total_traces() - self.traces0
+
+    def new_xla_misses(self) -> int:
+        return self.stats.xla_cache_misses - self.xla_misses0
+
+    def clean(self) -> bool:
+        """True when nothing compiled since the watermark."""
+        return self.new_traces() == 0 and self.new_xla_misses() == 0
 
 
 #: THE process-wide registry every instrumented site reports into.

@@ -140,13 +140,18 @@ def native_available() -> bool:
 # ---------------------------------------------------------------------------
 
 
-def _build_partition_file(path: str, keys: List[str]) -> None:
-    """Write one partition; key i gets local index i."""
+def _build_partition_file(path: str, keys: List[str], force_python: bool = False) -> None:
+    """Write one partition; key i gets local index i.
+
+    The native (g++/ctypes) and pure-Python writers emit IDENTICAL bytes
+    (pinned by tests/test_offheap_index.py::TestWriterBytesIdentity), so a
+    store built wherever a compiler happens to exist opens everywhere.
+    """
     encoded = [k.encode("utf-8") for k in keys]
     blob = b"".join(encoded)
     offsets = np.zeros(len(keys) + 1, np.uint64)
     np.cumsum([len(e) for e in encoded], out=offsets[1:])
-    lib = _load_native()
+    lib = None if force_python else _load_native()
     if lib is not None:
         err = lib.pmix_build(
             path.encode(),
@@ -277,6 +282,7 @@ def build_offheap_store(
     feature_keys: Iterable[str],
     add_intercept: bool = True,
     num_partitions: int = 1,
+    force_python: bool = False,
 ) -> None:
     """Hash-partition keys (IndexMap.build parity: crc32 % P, sorted within
     partition), write one pmix file per partition + meta.json."""
@@ -288,7 +294,9 @@ def build_offheap_store(
         offsets.append(total)
         total += len(p)
         _build_partition_file(
-            os.path.join(output_dir, f"{PARTITION_PREFIX}{i}{PARTITION_SUFFIX}"), p
+            os.path.join(output_dir, f"{PARTITION_PREFIX}{i}{PARTITION_SUFFIX}"),
+            p,
+            force_python=force_python,
         )
     meta = {
         "format": "pmix",
@@ -405,6 +413,64 @@ def load_index_map(path: str):
     if os.path.isdir(path):
         return IndexMap.load(os.path.join(path, "feature-index.json"))
     return IndexMap.load(path)
+
+
+# ---------------------------------------------------------------------------
+# coefficient-slab row lookup (the feature-index machinery generalized)
+# ---------------------------------------------------------------------------
+
+
+class SlabRowIndex(OffHeapIndexMap):
+    """Entity raw id -> coefficient-slab row, over the same mapped ``.pmix``
+    partition files as the feature index (the PalDB machinery generalized
+    from feature indices to coefficient slabs): the serving
+    :class:`~photon_ml_tpu.serve.model_store.ModelStore` keeps each random
+    effect's per-entity coefficients as one ``(E, D)`` mmap'd slab whose row
+    order IS this store's global index order, so ``get_row(raw_id)`` is a
+    hash probe in mapped memory — no JSON parse, no dict materialization,
+    shared page cache across server processes."""
+
+    def __init__(self, store_dir: str, force_python: bool = False):
+        super().__init__(store_dir, force_python=force_python)
+        if self._intercept:
+            raise IOError(
+                f"{store_dir} was built with an intercept slot — not a slab "
+                "row index (build with build_slab_index)"
+            )
+
+    @property
+    def num_rows(self) -> int:
+        return self._num_features
+
+    def get_row(self, key: str) -> int:
+        """Slab row of ``key``; -1 when the entity has no model."""
+        return self.get_index(key)
+
+    def row_key(self, row: int) -> Optional[str]:
+        return self.get_feature_name(row)
+
+
+def build_slab_index(
+    output_dir: str,
+    keys: Iterable[str],
+    num_partitions: int = 1,
+    force_python: bool = False,
+) -> None:
+    """Write an entity->slab-row lookup store: ``build_offheap_store``
+    without the intercept slot (slab rows are exactly the key set). Row
+    assignment matches ``IndexMap.build`` partitioning, so the builder can
+    lay slab rows down in this store's enumeration order."""
+    build_offheap_store(
+        output_dir,
+        keys,
+        add_intercept=False,
+        num_partitions=num_partitions,
+        force_python=force_python,
+    )
+
+
+def open_slab_index(store_dir: str, force_python: bool = False) -> SlabRowIndex:
+    return SlabRowIndex(store_dir, force_python=force_python)
 
 
 def load_shard_index_map(base_dir: str, shard: str):

@@ -1,0 +1,47 @@
+"""Online scoring service: the persistent GAME request path.
+
+Production GLMix exists to score millions of users at request time. This
+package is the warm-process serving layer over the training stack's
+already-shipped pieces — and deliberately nothing more (no network
+framework; transport is the deployment's problem):
+
+  * :mod:`.model_store` — mmap'd off-heap coefficient store (the
+    ``io/offheap.py`` PalDB machinery generalized from feature indices to
+    coefficient slabs, entity -> slab-row hash probes in mapped memory).
+  * :mod:`.batcher` — request micro-batching onto the PR-3 canonical
+    shape ladder (bounded wait, padded batch, sliced responses).
+  * :mod:`.server` — the scoring engine + JSON-lines request loop; warm
+    startup through the persistent XLA cache asserts zero new compiles;
+    scores are bitwise-equal to the batch ``game_scoring_driver``.
+  * :mod:`.swap` — zero-downtime model rolls through the checkpoint
+    by-reference protocol (no dropped requests, no recompiles).
+  * :mod:`.stats` — p50/p99 latency, batch-fill ratio, QPS telemetry.
+
+Driver: ``photon_ml_tpu.cli.serve_driver`` (``bench.py serving`` publishes
+latency/QPS vs micro-batch size and the swap proof).
+"""
+
+from __future__ import annotations
+
+from photon_ml_tpu.serve.batcher import MicroBatcher, RowBatch
+from photon_ml_tpu.serve.model_store import (
+    ModelStore,
+    build_model_store,
+    is_model_store,
+)
+from photon_ml_tpu.serve.server import ScoringServer, serve_json_lines
+from photon_ml_tpu.serve.stats import ServeStats, serve_stats
+from photon_ml_tpu.serve.swap import ModelSwapper
+
+__all__ = [
+    "MicroBatcher",
+    "ModelStore",
+    "ModelSwapper",
+    "RowBatch",
+    "ScoringServer",
+    "ServeStats",
+    "build_model_store",
+    "is_model_store",
+    "serve_json_lines",
+    "serve_stats",
+]

@@ -1,0 +1,362 @@
+"""Mmap'd coefficient store for the online scoring server.
+
+A saved GAME model (the reference's Avro layout, io/model_io.py) is great
+for offline interchange and terrible for a warm request path: every open
+re-parses name/term records and re-densifies coefficients through a Python
+dict. This module EXPORTS a model once into an off-heap serving layout and
+then serves it with zero parse work per process:
+
+  ``store_dir/``
+    ``meta.json``                 format/coordinates/shards/ladder manifest
+    ``features/<shard>/``         pmix feature index (io/offheap.py store;
+                                  the SAME store the batch drivers accept
+                                  via ``--offheap-indexmap-dir``)
+    ``fixed/<name>.npy``          (D,) f32 fixed-effect coefficients (mmap)
+    ``random/<name>/rows/``       pmix entity -> slab-row lookup
+                                  (:class:`~photon_ml_tpu.io.offheap.
+                                  SlabRowIndex` — the feature-index
+                                  machinery generalized to coefficient
+                                  slabs)
+    ``random/<name>/slab.npy``    (E_pad, D) f32 per-entity coefficient
+                                  slab, row order = the rows store's index
+                                  order, entity count padded up the PR-3
+                                  shape ladder so a model swap that stays
+                                  within the rung reuses every compiled
+                                  executable
+
+Opening the store is a handful of mmaps (the page cache is the share
+mechanism — concurrent servers on one host map the same physical pages,
+the owner-computes lookup never copies a slab), and the store participates
+in the checkpoint by-reference protocol (``__checkpoint_ref__`` /
+``__checkpoint_from_ref__``, photon_ml_tpu/checkpoint.py) so the
+:class:`~photon_ml_tpu.serve.swap.ModelSwapper` rolls a live server to a
+new store through the same path streaming checkpoints restore through.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import logging
+import os
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from photon_ml_tpu.checkpoint import CheckpointRefError
+from photon_ml_tpu.compile import ShapeBucketer
+from photon_ml_tpu.io import avro as avro_io
+from photon_ml_tpu.io import model_io
+from photon_ml_tpu.io.index_map import DELIMITER, INTERCEPT_KEY, feature_key
+from photon_ml_tpu.io.offheap import (
+    OffHeapIndexMap,
+    SlabRowIndex,
+    build_offheap_store,
+    build_slab_index,
+)
+
+logger = logging.getLogger(__name__)
+
+STORE_FORMAT = "game-serve-store"
+STORE_VERSION = 1
+META_FILE = "meta.json"
+FEATURES_DIR = "features"
+FIXED_DIR = "fixed"
+RANDOM_DIR = "random"
+ROWS_DIR = "rows"
+SLAB_FILE = "slab.npy"
+
+
+def _scan_records(model_dir: str, kind: str, name: str) -> List[dict]:
+    return list(
+        avro_io.read_directory(
+            os.path.join(model_dir, kind, name, model_io.COEFFICIENTS)
+        )
+    )
+
+
+def _record_keys(rec: dict) -> List[str]:
+    """Feature keys named by one BayesianLinearModelAvro record (the
+    intercept pseudo-feature is excluded — the index store carries its own
+    intercept slot)."""
+    out = []
+    for section in ("means", "variances"):
+        for ntv in rec.get(section) or []:
+            if ntv["name"] == INTERCEPT_KEY and ntv["term"] == "":
+                continue
+            out.append(feature_key(ntv["name"], ntv["term"]))
+    return out
+
+
+def build_model_store(
+    model_dir: str,
+    store_dir: str,
+    num_partitions: int = 1,
+    bucketer: Optional[ShapeBucketer] = None,
+    force_python: bool = False,
+) -> dict:
+    """Export a saved GAME model dir into the serving layout. Returns the
+    written meta dict.
+
+    The feature space is scanned FROM THE MODEL ITSELF (every name/term its
+    coefficient records mention) — no training inputs needed at export
+    time. Features a request carries that the model never weighted resolve
+    to index -1 and drop out, which contributes exactly the 0.0 their zero
+    coefficient would have.
+    """
+    layout = model_io.list_game_model(model_dir)
+    fixed_entries = []
+    for name in layout[model_io.FIXED_EFFECT]:
+        with open(
+            os.path.join(model_dir, model_io.FIXED_EFFECT, name, model_io.ID_INFO)
+        ) as f:
+            shard = f.read().strip()
+        fixed_entries.append((name, shard))
+    random_entries = []
+    for name in layout[model_io.RANDOM_EFFECT]:
+        with open(
+            os.path.join(model_dir, model_io.RANDOM_EFFECT, name, model_io.ID_INFO)
+        ) as f:
+            lines = f.read().splitlines()
+        re_id = lines[0] if lines else ""
+        shard = lines[1] if len(lines) > 1 else ""
+        random_entries.append((name, re_id, shard))
+
+    # pass 1: raw records per coordinate + per-shard feature vocabulary
+    fixed_recs: Dict[str, dict] = {}
+    random_recs: Dict[str, List[dict]] = {}
+    shard_keys: Dict[str, set] = {}
+    task = None
+    for name, shard in fixed_entries:
+        recs = _scan_records(model_dir, model_io.FIXED_EFFECT, name)
+        fixed_recs[name] = recs[0]
+        shard_keys.setdefault(shard, set()).update(_record_keys(recs[0]))
+        task = task or recs[0].get("modelClass")
+    for name, re_id, shard in random_entries:
+        if model_io.is_factored_random_effect(model_dir, name):
+            logger.warning(
+                "random effect %r is factored: serving its projected-back "
+                "coefficients (bitwise parity holds against the driver's "
+                "--host-scoring oracle, not the latent-native device path)",
+                name,
+            )
+        recs = _scan_records(model_dir, model_io.RANDOM_EFFECT, name)
+        random_recs[name] = recs
+        keys = shard_keys.setdefault(shard, set())
+        for rec in recs:
+            keys.update(_record_keys(rec))
+        task = task or (recs[0].get("modelClass") if recs else None)
+
+    os.makedirs(store_dir, exist_ok=True)
+
+    # feature index stores (one per shard; the batch drivers open these
+    # directly via --offheap-indexmap-dir <store_dir>/features)
+    maps: Dict[str, OffHeapIndexMap] = {}
+    for shard, keys in sorted(shard_keys.items()):
+        fdir = os.path.join(store_dir, FEATURES_DIR, shard)
+        build_offheap_store(
+            fdir,
+            sorted(keys),
+            add_intercept=True,
+            num_partitions=num_partitions,
+            force_python=force_python,
+        )
+        maps[shard] = OffHeapIndexMap(fdir, force_python=force_python)
+
+    meta: dict = {
+        "format": STORE_FORMAT,
+        "version": STORE_VERSION,
+        "task": model_io.schemas.TASK_BY_MODEL_CLASS.get(
+            task, "LOGISTIC_REGRESSION"
+        ),
+        "source_model_dir": os.path.abspath(model_dir),
+        "ladder": bucketer.describe() if bucketer is not None else None,
+        "shards": {s: {"dim": len(m), "intercept": True} for s, m in maps.items()},
+        "fixed": [],
+        "random": [],
+    }
+
+    os.makedirs(os.path.join(store_dir, FIXED_DIR), exist_ok=True)
+    for name, shard in fixed_entries:
+        means, _ = model_io._record_to_dense(fixed_recs[name], maps[shard])
+        np.save(
+            os.path.join(store_dir, FIXED_DIR, f"{name}.npy"),
+            means.astype(np.float32),
+        )
+        meta["fixed"].append({"name": name, "shard": shard})
+
+    for name, re_id, shard in random_entries:
+        base = os.path.join(store_dir, RANDOM_DIR, name)
+        os.makedirs(base, exist_ok=True)
+        recs = random_recs[name]
+        entity_ids = sorted(str(rec["modelId"]) for rec in recs)
+        build_slab_index(
+            os.path.join(base, ROWS_DIR),
+            entity_ids,
+            num_partitions=num_partitions,
+            force_python=force_python,
+        )
+        rows = SlabRowIndex(os.path.join(base, ROWS_DIR), force_python=force_python)
+        n_entities = rows.num_rows
+        padded = bucketer.canon(n_entities) if bucketer is not None else n_entities
+        slab = np.zeros((max(padded, 1), len(maps[shard])), np.float32)
+        for rec in recs:
+            row = rows.get_row(str(rec["modelId"]))
+            means, _ = model_io._record_to_dense(rec, maps[shard])
+            slab[row] = means
+        rows.close()
+        np.save(os.path.join(base, SLAB_FILE), slab)
+        meta["random"].append(
+            {
+                "name": name,
+                "re_id": re_id,
+                "shard": shard,
+                "entities": n_entities,
+                "padded_rows": int(slab.shape[0]),
+            }
+        )
+
+    for m in maps.values():
+        m.close()
+    tmp = os.path.join(store_dir, META_FILE + ".tmp")
+    with open(tmp, "w") as f:
+        json.dump(meta, f, indent=1)
+    os.replace(tmp, os.path.join(store_dir, META_FILE))
+    return meta
+
+
+def is_model_store(path: str) -> bool:
+    try:
+        with open(os.path.join(path, META_FILE)) as f:
+            return json.load(f).get("format") == STORE_FORMAT
+    except (OSError, ValueError):
+        return False
+
+
+@dataclasses.dataclass
+class FixedEffectSlab:
+    name: str
+    shard: str
+    coefficients: np.ndarray  # (D,) f32 memmap
+
+
+@dataclasses.dataclass
+class RandomEffectSlab:
+    name: str
+    re_id: str
+    shard: str
+    rows: SlabRowIndex  # entity raw id -> slab row
+    slab: np.ndarray  # (E_pad, D) f32 memmap
+    entities: int  # real (unpadded) entity count
+
+
+class ModelStore:
+    """One opened serving store: mmap'd coefficients + entity/feature
+    lookups. Read-only and thread-safe after construction (every member is
+    an immutable mmap or a mapped hash probe)."""
+
+    def __init__(self, store_dir: str, force_python: bool = False):
+        self.store_dir = os.path.abspath(store_dir)
+        with open(os.path.join(store_dir, META_FILE)) as f:
+            self.meta = json.load(f)
+        if self.meta.get("format") != STORE_FORMAT:
+            raise IOError(f"{store_dir} is not a {STORE_FORMAT} directory")
+        self.feature_maps: Dict[str, OffHeapIndexMap] = {
+            shard: OffHeapIndexMap(
+                os.path.join(store_dir, FEATURES_DIR, shard),
+                force_python=force_python,
+            )
+            for shard in self.meta["shards"]
+        }
+        self.fixed: List[FixedEffectSlab] = [
+            FixedEffectSlab(
+                e["name"],
+                e["shard"],
+                np.load(
+                    os.path.join(store_dir, FIXED_DIR, f"{e['name']}.npy"),
+                    mmap_mode="r",
+                ),
+            )
+            for e in self.meta["fixed"]
+        ]
+        self.random: List[RandomEffectSlab] = []
+        for e in self.meta["random"]:
+            base = os.path.join(store_dir, RANDOM_DIR, e["name"])
+            self.random.append(
+                RandomEffectSlab(
+                    e["name"],
+                    e["re_id"],
+                    e["shard"],
+                    SlabRowIndex(
+                        os.path.join(base, ROWS_DIR), force_python=force_python
+                    ),
+                    np.load(os.path.join(base, SLAB_FILE), mmap_mode="r"),
+                    int(e["entities"]),
+                )
+            )
+
+    # -- lookups ------------------------------------------------------------
+    def shard_dim(self, shard: str) -> int:
+        return len(self.feature_maps[shard])
+
+    def feature_index(self, shard: str, key: str) -> int:
+        return self.feature_maps[shard].get_index(key)
+
+    def entity_row(self, coordinate: str, raw_id: Optional[str]) -> int:
+        """Slab row of ``raw_id`` for a random-effect coordinate; -1 when
+        the entity has no model (its contribution is 0 —
+        RandomEffectModel.scala:129-158 semantics)."""
+        if raw_id is None:
+            return -1
+        for re in self.random:
+            if re.name == coordinate:
+                return re.rows.get_row(str(raw_id))
+        raise KeyError(f"no random-effect coordinate {coordinate!r} in store")
+
+    def features_dir(self) -> str:
+        """The per-shard feature index stores — hand this to the batch
+        scoring driver as ``--offheap-indexmap-dir`` so both paths score
+        through an identical feature space."""
+        return os.path.join(self.store_dir, FEATURES_DIR)
+
+    def describe(self) -> str:
+        re_desc = ", ".join(
+            f"{r.name}({r.entities} entities, slab {tuple(r.slab.shape)})"
+            for r in self.random
+        )
+        return (
+            f"model store {self.store_dir}: "
+            f"{len(self.fixed)} fixed / {len(self.random)} random "
+            f"[{re_desc}]"
+        )
+
+    def close(self) -> None:
+        for m in self.feature_maps.values():
+            m.close()
+        for r in self.random:
+            r.rows.close()
+        self.feature_maps = {}
+        self.fixed = []
+        self.random = []
+
+    # -- checkpoint by-reference protocol (photon_ml_tpu/checkpoint.py) ----
+    def __checkpoint_ref__(self) -> dict:
+        return {
+            "kind": STORE_FORMAT,
+            "version": STORE_VERSION,
+            "store_dir": self.store_dir,
+        }
+
+    def __checkpoint_from_ref__(self, ref: dict) -> "ModelStore":
+        if not isinstance(ref, dict) or ref.get("kind") != STORE_FORMAT:
+            raise CheckpointRefError(
+                f"not a {STORE_FORMAT} reference: {ref!r}"
+            )
+        store_dir = ref.get("store_dir", "")
+        if not is_model_store(store_dir):
+            raise CheckpointRefError(
+                f"serve-store reference points at {store_dir!r}, which is "
+                "missing or not a store — it may have been retired; refusing "
+                "to swap"
+            )
+        return ModelStore(store_dir)

@@ -1,0 +1,151 @@
+"""Serving telemetry: request latency percentiles, batch fill, QPS.
+
+The registry mirrors :mod:`photon_ml_tpu.compile.stats` — a thread-safe
+process-wide instance (``serve_stats``) every server records into, a
+``snapshot()`` the tests/bench assert on, and a one-screen ``summary()``
+the serve driver logs next to ``compile_stats.summary()``.
+
+What gets recorded:
+
+  * per REQUEST: end-to-end latency (submit -> response ready), row count.
+    Latencies keep a bounded reservoir (newest ``max_samples``) so a
+    long-lived server's percentiles track recent behavior without
+    unbounded memory.
+  * per BATCH: real rows vs ladder-padded rows (the fill ratio — how much
+    of each canonical executable's work was real) and the number of
+    requests coalesced into it (avg requests/batch is THE number the
+    micro-batcher exists to raise).
+  * swaps: count + whether each was compile-free.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import Dict, Optional
+
+
+def _percentile(sorted_vals, q: float) -> float:
+    """Nearest-rank percentile over an already-sorted list."""
+    if not sorted_vals:
+        return 0.0
+    idx = min(int(q * (len(sorted_vals) - 1) + 0.5), len(sorted_vals) - 1)
+    return sorted_vals[idx]
+
+
+class ServeStats:
+    """Thread-safe serving-telemetry registry (batcher worker, responder
+    threads, and in-process callers all record concurrently)."""
+
+    def __init__(self, max_samples: int = 100_000):
+        self._lock = threading.Lock()
+        self._latencies = deque(maxlen=max_samples)
+        self.requests = 0
+        self.rows = 0
+        self.batches = 0
+        self.batch_rows_real = 0
+        self.batch_rows_padded = 0
+        self.batch_requests = 0
+        self.errors = 0
+        self.swaps = 0
+        self.swap_compiles = 0
+        self._first_ts: Optional[float] = None
+        self._last_ts: Optional[float] = None
+
+    # -- recording ----------------------------------------------------------
+    def record_request(self, latency_s: float, num_rows: int = 1) -> None:
+        now = time.monotonic()
+        with self._lock:
+            self._latencies.append(latency_s)
+            self.requests += 1
+            self.rows += num_rows
+            if self._first_ts is None:
+                self._first_ts = now
+            self._last_ts = now
+
+    def record_batch(self, rows_real: int, rows_padded: int, num_requests: int) -> None:
+        with self._lock:
+            self.batches += 1
+            self.batch_rows_real += rows_real
+            self.batch_rows_padded += rows_padded
+            self.batch_requests += num_requests
+
+    def record_error(self) -> None:
+        with self._lock:
+            self.errors += 1
+
+    def record_swap(self, new_compiles: int) -> None:
+        with self._lock:
+            self.swaps += 1
+            self.swap_compiles += new_compiles
+
+    # -- reading ------------------------------------------------------------
+    def snapshot(self) -> Dict[str, float]:
+        with self._lock:
+            lat = sorted(self._latencies)
+            span = (
+                (self._last_ts - self._first_ts)
+                if self._first_ts is not None and self._last_ts is not None
+                else 0.0
+            )
+            return {
+                "requests": self.requests,
+                "rows": self.rows,
+                "errors": self.errors,
+                "batches": self.batches,
+                "p50_ms": round(_percentile(lat, 0.50) * 1e3, 3),
+                "p99_ms": round(_percentile(lat, 0.99) * 1e3, 3),
+                "qps": round(self.requests / span, 1) if span > 0 else 0.0,
+                "rows_per_sec": round(self.rows / span, 1) if span > 0 else 0.0,
+                "batch_fill_ratio": (
+                    round(self.batch_rows_real / self.batch_rows_padded, 4)
+                    if self.batch_rows_padded
+                    else 0.0
+                ),
+                "avg_batch_rows": (
+                    round(self.batch_rows_real / self.batches, 2)
+                    if self.batches
+                    else 0.0
+                ),
+                "avg_requests_per_batch": (
+                    round(self.batch_requests / self.batches, 2)
+                    if self.batches
+                    else 0.0
+                ),
+                "swaps": self.swaps,
+                "swap_compiles": self.swap_compiles,
+            }
+
+    def reset(self) -> None:
+        with self._lock:
+            self._latencies.clear()
+            self.requests = 0
+            self.rows = 0
+            self.batches = 0
+            self.batch_rows_real = 0
+            self.batch_rows_padded = 0
+            self.batch_requests = 0
+            self.errors = 0
+            self.swaps = 0
+            self.swap_compiles = 0
+            self._first_ts = None
+            self._last_ts = None
+
+    def summary(self) -> str:
+        """One-screen driver-log summary (the compile_stats.summary shape)."""
+        s = self.snapshot()
+        return (
+            f"serve stats: {s['requests']} requests / {s['rows']} rows in "
+            f"{s['batches']} batches; latency p50 {s['p50_ms']:.3f}ms / "
+            f"p99 {s['p99_ms']:.3f}ms; {s['qps']:.1f} req/s "
+            f"({s['rows_per_sec']:.1f} rows/s); batch fill "
+            f"{s['batch_fill_ratio']:.2%} (avg {s['avg_batch_rows']} rows / "
+            f"{s['avg_requests_per_batch']} requests per batch); "
+            f"{s['errors']} errors; {s['swaps']} swaps "
+            f"({s['swap_compiles']} swap compiles)"
+        )
+
+
+#: process-wide default registry (servers may carry their own instance)
+serve_stats = ServeStats()

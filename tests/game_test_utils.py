@@ -273,3 +273,124 @@ def launch_multihost(module: str, args, n_processes: int = 2,
         )
         outs.append(out)
     return outs
+
+
+# ---------------------------------------------------------------------------
+# Avro fixture writing + a synthetic untrained GAME model (shared by
+# tests/test_serve.py and the bench.py serving section)
+# ---------------------------------------------------------------------------
+
+def game_example_schema():
+    """TrainingExampleAvro with two feature sections (fixedFeatures /
+    userFeatures) — the multi-section record shape the driver tests use."""
+    from photon_ml_tpu.io import schemas
+
+    return {
+        "name": "GameExampleAvro",
+        "namespace": "test",
+        "type": "record",
+        "fields": [
+            {"name": "uid", "type": ["null", "string"], "default": None},
+            {"name": "label", "type": "double"},
+            {"name": "fixedFeatures",
+             "type": {"type": "array", "items": schemas.FEATURE}},
+            {"name": "userFeatures",
+             "type": {"type": "array",
+                      "items": "com.linkedin.photon.avro.generated.FeatureAvro"}},
+            {"name": "metadataMap",
+             "type": ["null", {"type": "map", "values": "string"}],
+             "default": None},
+            {"name": "weight", "type": ["null", "double"], "default": None},
+            {"name": "offset", "type": ["null", "double"], "default": None},
+        ],
+    }
+
+
+def game_avro_records(data: "GameData", rows, truth: Dict[str, np.ndarray],
+                      offsets: Optional[np.ndarray] = None):
+    """make_glmix_data output -> GameExampleAvro record dicts (entity id in
+    metadataMap; nonzero features only; optional per-row offsets)."""
+    def feats(x_row, prefix):
+        return [
+            {"name": f"{prefix}{j}", "term": "", "value": float(v)}
+            for j, v in enumerate(x_row)
+            if v != 0.0
+        ]
+
+    vocab = data.id_vocabs["userId"]
+    for r in rows:
+        yield {
+            "uid": str(r),
+            "label": float(data.response[r]),
+            "fixedFeatures": feats(truth["x_fixed"][r], "f"),
+            "userFeatures": feats(truth["x_random"][r], "u"),
+            "metadataMap": {"userId": vocab[data.ids["userId"][r]]},
+            "weight": None,
+            "offset": float(offsets[r]) if offsets is not None else None,
+        }
+
+
+def write_game_avro(path: str, data: "GameData", rows,
+                    truth: Dict[str, np.ndarray],
+                    offsets: Optional[np.ndarray] = None) -> None:
+    from photon_ml_tpu.io import avro as avro_io
+
+    avro_io.write_container(
+        path, game_avro_records(data, rows, truth, offsets),
+        game_example_schema(),
+    )
+
+
+def save_synthetic_game_model(
+    model_dir: str,
+    rng: np.random.Generator,
+    d_fixed: int = 5,
+    d_random: int = 3,
+    num_users: int = 12,
+    scale: float = 1.0,
+):
+    """Persist a random (untrained) GAME model in the reference layout:
+    fixed effect 'fixed' on shard 'global' (features f0..f{d_fixed-1}) and
+    random effect 'per-user' on shard 'per_user' (features u0..) over
+    userId entities u0..u{num_users-1}. Returns (w_fixed, entity_means,
+    fixed_map, user_map) — what serving/scoring must reproduce."""
+    from photon_ml_tpu.io import model_io
+    from photon_ml_tpu.io.index_map import IndexMap, feature_key
+    from photon_ml_tpu.types import TaskType
+
+    fmap = IndexMap.build(
+        [feature_key(f"f{j}", "") for j in range(d_fixed)], add_intercept=True
+    )
+    umap = IndexMap.build(
+        [feature_key(f"u{j}", "") for j in range(d_random)], add_intercept=True
+    )
+    w_fixed = (rng.normal(size=len(fmap)) * scale).astype(np.float32)
+    entity_means = {
+        f"u{i}": (rng.normal(size=len(umap)) * scale).astype(np.float32)
+        for i in range(num_users)
+    }
+    model_io.save_fixed_effect(
+        model_dir, "fixed", TaskType.LOGISTIC_REGRESSION, w_fixed, fmap,
+        feature_shard_id="global",
+    )
+    model_io.save_random_effect(
+        model_dir, "per-user", TaskType.LOGISTIC_REGRESSION, entity_means,
+        umap, random_effect_id="userId", feature_shard_id="per_user",
+    )
+    return w_fixed, entity_means, fmap, umap
+
+
+def serve_requests_from_records(records) -> list:
+    """GameExampleAvro record dicts -> serve-protocol request rows (the
+    same features/ids/offset the batch driver reads from Avro)."""
+    return [
+        {
+            "features": {
+                "fixedFeatures": rec["fixedFeatures"],
+                "userFeatures": rec["userFeatures"],
+            },
+            "ids": {"userId": (rec.get("metadataMap") or {}).get("userId")},
+            "offset": rec.get("offset") or 0.0,
+        }
+        for rec in records
+    ]

@@ -80,6 +80,24 @@ def test_qualname_resolution():
     assert "<test>:C.m" in msg and lineno == 4
 
 
+def test_serve_package_in_scan_scope():
+    """The request hot path (photon_ml_tpu/serve) is inside the default
+    scan scope — a bare jax.jit cannot land in the serving layer without
+    tripping the tier-1 gate."""
+    pkg = os.path.join(REPO, "photon_ml_tpu")
+    scanned = set(lint_jit_sites.iter_py_files([pkg]))
+    serve_dir = os.path.join(pkg, "serve")
+    serve_files = {
+        os.path.join(serve_dir, f)
+        for f in os.listdir(serve_dir)
+        if f.endswith(".py")
+    }
+    assert serve_files, "serve package vanished?"
+    assert serve_files <= scanned
+    # and the scanner actually flags a bare site in a serve-shaped module
+    assert _violations("import jax\nscore = jax.jit(lambda b: b)\n")
+
+
 def test_package_is_clean():
     """THE gate: photon_ml_tpu carries no unannotated, unjustified jit
     sites (and no stale allowlist entries)."""

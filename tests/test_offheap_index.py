@@ -178,3 +178,82 @@ class TestDriverIntegration:
         _, result, _ = driver.results[driver.best_index]
         assert np.isfinite(result.objective_history[-1])
         assert result.objective_history[-1] < result.objective_history[0]
+
+
+class TestWriterBytesIdentity:
+    """The native (g++/ctypes) and pure-Python writers emit IDENTICAL
+    ``.pmix`` partition files for the same key set, and each reader opens
+    the other's output. The serving model store leans on this: a store
+    exported wherever a compiler happens to exist (or not) serves
+    everywhere, and two servers mmap'ing byte-identical files share
+    physical pages regardless of which toolchain built them."""
+
+    KEYS = sorted(set(_keys(400, seed=7)))
+
+    @staticmethod
+    def _store_bytes(store_dir):
+        out = {}
+        for name in sorted(os.listdir(store_dir)):
+            with open(os.path.join(store_dir, name), "rb") as f:
+                out[name] = f.read()
+        return out
+
+    @pytest.fixture()
+    def both_dirs(self, tmp_path):
+        if not offheap.native_available():
+            pytest.skip("native lib unavailable")
+        nat = str(tmp_path / "native")
+        py = str(tmp_path / "python")
+        offheap.build_offheap_store(
+            nat, self.KEYS, add_intercept=True, num_partitions=3
+        )
+        offheap.build_offheap_store(
+            py, self.KEYS, add_intercept=True, num_partitions=3,
+            force_python=True,
+        )
+        return nat, py
+
+    def test_partition_files_bitwise_identical(self, both_dirs):
+        nat, py = both_dirs
+        nat_bytes = self._store_bytes(nat)
+        py_bytes = self._store_bytes(py)
+        assert set(nat_bytes) == set(py_bytes)
+        pmix = [n for n in nat_bytes if n.endswith(offheap.PARTITION_SUFFIX)]
+        assert len(pmix) == 3
+        for name in nat_bytes:
+            assert nat_bytes[name] == py_bytes[name], f"{name} differs"
+
+    def test_each_reader_opens_the_others_output(self, both_dirs):
+        nat, py = both_dirs
+        # native reader on the pure-Python writer's store, and vice versa
+        for store_dir in (nat, py):
+            for force_python in (False, True):
+                store = offheap.OffHeapIndexMap(
+                    store_dir, force_python=force_python
+                )
+                assert len(store) == len(self.KEYS) + 1  # + intercept
+                for k in self.KEYS[:50]:
+                    idx = store.get_index(k)
+                    assert idx >= 0
+                    assert store.get_feature_name(idx) == k
+                assert store.get_index("no-such-key\x01") == -1
+                store.close()
+
+    def test_slab_index_writers_identical(self, tmp_path):
+        """Same identity for the serving entity->slab-row stores (the
+        feature machinery generalized — no intercept slot)."""
+        if not offheap.native_available():
+            pytest.skip("native lib unavailable")
+        entities = [f"user-{i:04d}" for i in range(117)]
+        nat = str(tmp_path / "rows-native")
+        py = str(tmp_path / "rows-python")
+        offheap.build_slab_index(nat, entities, num_partitions=2)
+        offheap.build_slab_index(py, entities, num_partitions=2, force_python=True)
+        assert self._store_bytes(nat) == self._store_bytes(py)
+        rows_nat = offheap.SlabRowIndex(py)  # cross-open
+        rows_py = offheap.SlabRowIndex(nat, force_python=True)
+        assert rows_nat.num_rows == rows_py.num_rows == len(entities)
+        for e in entities[:40]:
+            assert rows_nat.get_row(e) == rows_py.get_row(e) >= 0
+        rows_nat.close()
+        rows_py.close()
