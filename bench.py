@@ -1284,6 +1284,154 @@ def _bench_game5(extra, on_tpu):
     }
 
 
+def _bench_sparse_race(extra, on_tpu):
+    """Fused sparse per-entity kernel race (ops/fused_sparse.py) on a
+    SKEWED nnz distribution — the production per-entity regime: most rows
+    carry a handful of non-zeros, a few are dense-ish, and the dense
+    (E, M, D) slab pays full MXU/HBM cost for all of them. Races every
+    sparse family (XLA scatter, XLA two-pass segment-sum baseline, fused
+    single-pass Pallas GEVM incl. row-blocked variants) AND the dense
+    incumbent through the solver-identical vmapped value+grad closure;
+    records every candidate (failures with reasons — a candidate that
+    failed to compile reads as failed, not absent), then gates the
+    selected sparse family end-to-end through the compacted scheduler:
+    bitwise-equal coefficients vs the kernel-off (segment baseline) path
+    and ZERO extra XLA compiles after warmup (CompileStats-asserted)."""
+    import jax
+    import jax.numpy as jnp
+
+    from photon_ml_tpu.compile import compile_stats
+    from photon_ml_tpu.ops import fused_sparse
+    from photon_ml_tpu.ops.regularization import RegularizationContext
+    from photon_ml_tpu.optim.common import OptimizerConfig
+    from photon_ml_tpu.optim.scheduler import SolveSchedule, compacted_solve
+    from photon_ml_tpu.types import OptimizerType, TaskType
+
+    E = 1024 if on_tpu else 256
+    M, D = 64, 2048
+    rng = np.random.default_rng(17)
+    # skewed nnz over a WIDE feature space: 85% of rows draw 1-4 non-zeros,
+    # 15% draw 8-16 — the long-tail production shape (density < 1%) where
+    # the dense slab pays D=2048 MXU/HBM columns for a handful of non-zeros
+    nnz = np.where(
+        rng.random((E, M)) < 0.85,
+        rng.integers(1, 5, size=(E, M)),
+        rng.integers(8, 17, size=(E, M)),
+    )
+    x = np.zeros((E, M, D), np.float32)
+    for e in range(E):
+        for m in range(M):
+            cols = rng.choice(D, size=nnz[e, m], replace=False)
+            x[e, m, cols] = rng.normal(size=nnz[e, m])
+    w_true = (rng.normal(size=(E, D)) * 0.4).astype(np.float32)
+    z = np.einsum("emd,ed->em", x.astype(np.float64), w_true)
+    y = jnp.asarray((1.0 / (1.0 + np.exp(-z)) > rng.random((E, M))).astype(np.float32))
+    off = jnp.zeros((E, M), jnp.float32)
+    wt = jnp.ones((E, M), jnp.float32)
+
+    slab = fused_sparse.build_sparse_slab(x)
+    report = fused_sparse.race_sparse_kernels(
+        TaskType.LOGISTIC_REGRESSION, slab, x, y, off, wt
+    )
+    extra["sparse_race"] = report
+    stats = report["nnz"]
+    _log(
+        f"sparse_race: E={E} M={M} D={D} K={stats['padded_k']} "
+        f"(mean nnz {stats['mean_nnz']}, density {stats['density']}); "
+        f"winner={report['winner'] or 'dense'}"
+    )
+    for name, rec in sorted(report["candidates"].items()):
+        if "failed" in rec:
+            _log(f"  {name}: FAILED — {rec['failed']}")
+        else:
+            _log(f"  {name}: {rec['sec_per_pass']:.2e} s/pass")
+
+    timed = {
+        name: rec["sec_per_pass"]
+        for name, rec in report["candidates"].items()
+        if "sec_per_pass" in rec and name != "dense"
+    }
+    if not timed:
+        raise AssertionError(
+            "no sparse candidate survived the race "
+            f"({ {n: r.get('failed') for n, r in report['candidates'].items()} })"
+        )
+    best_sparse = min(timed, key=timed.get)
+    baseline_sec = timed.get(fused_sparse.SPARSE_BASELINE)
+    extra["sparse_race_selected"] = best_sparse
+    if baseline_sec:
+        extra["sparse_race_speedup_vs_xla2pass"] = round(
+            baseline_sec / timed[best_sparse], 3
+        )
+        _log(
+            f"sparse_race: selected {best_sparse} at "
+            f"{extra['sparse_race_speedup_vs_xla2pass']}x the "
+            f"two-pass XLA baseline"
+        )
+
+    # end-to-end gate through the compacted scheduler: the selected family
+    # must produce BITWISE the segment-baseline coefficients, and warm
+    # re-solves must add zero XLA compiles
+    cfg = OptimizerConfig(max_iterations=60, tolerance=1e-7)
+    kw = dict(
+        task=TaskType.LOGISTIC_REGRESSION,
+        optimizer=OptimizerType.LBFGS,
+        optimizer_config=cfg,
+        regularization=RegularizationContext.l2(0.5),
+    )
+    w0 = jnp.zeros((E, D), jnp.float32)
+    schedule = SolveSchedule(chunk_size=16)
+
+    def solve(family):
+        data = (slab.with_kernel(family), y, off, wt)
+        res = compacted_solve(data, w0, schedule=schedule,
+                              label=f"sparse_race[{family}]", **kw)
+        jax.block_until_ready(res.coefficients)
+        return res
+
+    ref = solve(fused_sparse.SPARSE_BASELINE)
+    got = solve(best_sparse)  # warmup (compiles the family's executables)
+    mark = compile_stats.watermark()
+    t0 = time.perf_counter()
+    got = solve(best_sparse)
+    t_sparse = time.perf_counter() - t0
+    if not mark.clean():
+        raise AssertionError(
+            f"{mark.new_traces()} new traces / {mark.new_xla_misses()} XLA "
+            "cache misses on a warm sparse re-solve — executable reuse "
+            "regressed"
+        )
+    bitwise = np.array_equal(
+        np.asarray(got.coefficients), np.asarray(ref.coefficients)
+    )
+    if not bitwise:
+        raise AssertionError(
+            f"solve through {best_sparse} is not bitwise-equal to the "
+            "kernel-off (segment baseline) path"
+        )
+    # the honest dense-vs-sparse end-to-end number (different arithmetic,
+    # so no bitwise claim — the race already decided who runs production)
+    dense_data = tuple(jnp.asarray(a) for a in (x, np.asarray(y), np.zeros((E, M), np.float32), np.ones((E, M), np.float32)))
+    compacted_solve(dense_data, w0, schedule=schedule, label="sparse_race[dense]", **kw)
+    t0 = time.perf_counter()
+    res_d = compacted_solve(dense_data, w0, schedule=schedule, label="sparse_race[dense]", **kw)
+    jax.block_until_ready(res_d.coefficients)
+    t_dense = time.perf_counter() - t0
+    extra["sparse_race_bitwise_vs_kernel_off"] = bool(bitwise)
+    extra["sparse_race_warm_new_compiles"] = 0
+    extra["sparse_race_solve_ms"] = round(t_sparse * 1e3, 2)
+    extra["sparse_race_dense_solve_ms"] = round(t_dense * 1e3, 2)
+    extra["sparse_race_solve_speedup_vs_dense"] = round(
+        t_dense / max(t_sparse, 1e-9), 3
+    )
+    _log(
+        f"sparse_race: end-to-end {best_sparse} solve {t_sparse*1e3:.1f}ms vs "
+        f"dense {t_dense*1e3:.1f}ms "
+        f"({extra['sparse_race_solve_speedup_vs_dense']}x), bitwise vs "
+        f"kernel-off, zero warm compiles"
+    )
+
+
 def _bench_compaction(extra, on_tpu):
     """Convergence-compacted solve scheduler (optim/scheduler.py) on a
     SKEWED convergence distribution — a few badly-conditioned entities next
@@ -1559,7 +1707,7 @@ def _bench_preempt(extra, on_tpu):
 
 
 SECTION_ORDER = (
-    "dense", "sparse", "game", "game5", "grid",
+    "dense", "sparse", "sparse_race", "game", "game5", "grid",
     "streaming", "streaming_pipeline", "compile_reuse", "compaction",
     "preemption_resume",
     "perhost", "scoring", "serving", "ingest",
@@ -1612,6 +1760,8 @@ def _run_sections(names, extra, errors, on_tpu, state=None, after=None):
                     state["value"] = value
             elif name == "sparse":
                 _bench_sparse(extra, on_tpu)
+            elif name == "sparse_race":
+                _bench_sparse_race(extra, on_tpu)
             elif name == "game":
                 _bench_game(extra, on_tpu)
             elif name == "game5":

@@ -184,6 +184,11 @@ class BucketedRandomEffectCoordinate:
     # bucket; the two compose per bucket. Scheduled buckets re-enter the
     # host between chunks, so the coordinate opts out of the outer CD jit.
     solve_schedule: Optional[object] = None
+    # sparse per-entity kernels (ops/fused_sparse.py), selected PER BUCKET:
+    # None = PHOTON_SPARSE_KERNEL (default off) | "auto" (each bucket races
+    # the sparse families and the dense incumbent on its own slab; skewed
+    # buckets can pick different winners) | a family name forced everywhere
+    sparse_kernel: Optional[str] = None
 
     def __post_init__(self):
         if self.solve_schedule is not None and self.mesh_ctx is not None:
@@ -210,6 +215,14 @@ class BucketedRandomEffectCoordinate:
                 regularization=self.regularization,
                 solve_schedule=self.solve_schedule,
                 solve_label=f"bucket{i}",
+                # per-bucket selection: each sub races/builds its own slab
+                # (same-ladder buckets land on the same (E, M, K) shapes
+                # and share solver executables either way). Under mesh_ctx
+                # the distributed solvers below pin sparse off at the shard
+                # level — racing/building slabs here would be pure waste
+                sparse_kernel=(
+                    self.sparse_kernel if self.mesh_ctx is None else "off"
+                ),
             )
             for i, ds in enumerate(b.datasets)
         ]

@@ -69,14 +69,22 @@ def entity_lane_fns(task, optimizer, optimizer_config, regularization,
     l1, l2 = _split_reg_weight(regularization, reg_weight)
     cfg = optimizer_config
 
+    def feats_of(x):
+        # the lane's features: a dense (M, D) array, or a per-lane
+        # SparseSlab view (ops/fused_sparse.py) — the slab already speaks
+        # the Features protocol, and its static ``kernel`` field routes
+        # the objective to the selected sparse family (fused Pallas GEVM /
+        # XLA scatter / segment-sum) without touching the solver kernels
+        return x if hasattr(x, "matvec") else DenseFeatures(x)
+
     def vg_of(x, y, off_e, w_e):
-        batch = GLMBatch(DenseFeatures(x), y, off_e, w_e)
+        batch = GLMBatch(feats_of(x), y, off_e, w_e)
         return lambda wt: obj.value_and_grad(wt, batch, norm, l2)
 
     if optimizer == OptimizerType.TRON:
 
         def hvp_of(x, y, off_e, w_e):
-            batch = GLMBatch(DenseFeatures(x), y, off_e, w_e)
+            batch = GLMBatch(feats_of(x), y, off_e, w_e)
             return lambda wt, v: obj.hessian_vector(wt, v, batch, norm, l2)
 
         def solve_one(x, y, off_e, w_e, w0):
@@ -135,6 +143,14 @@ class RandomEffectCoordinate:
     # telemetry label the compacted solves record under (solve_stats):
     # wrappers set e.g. "bucket3" / "streaming-re[block 7]"
     solve_label: str = "re_solve"
+    # sparse per-entity kernels (ops/fused_sparse.py). ``sparse_kernel``:
+    # None = PHOTON_SPARSE_KERNEL (default off) | "auto" (race the families
+    # and the dense incumbent on this dataset's own tensors) | a family
+    # name. ``sparse_slab``: a prebuilt slab from a wrapper (bucketed /
+    # streaming coordinates build per-bucket/per-block slabs host-side and
+    # pass them through jit; its ``kernel`` field carries the selection).
+    sparse_kernel: Optional[str] = None
+    sparse_slab: Optional[object] = None  # ops.fused_sparse.SparseSlab
 
     def __post_init__(self):
         if self.optimizer_config is None:
@@ -148,6 +164,33 @@ class RandomEffectCoordinate:
             # jit must call this coordinate's update raw (instance attr —
             # the class default stays True for one-shot coordinates)
             self.cd_jit = False
+        self._slab = self.sparse_slab
+        if self._slab is None:
+            from photon_ml_tpu.ops.fused_sparse import resolve_sparse_kernel
+
+            spec = resolve_sparse_kernel(self.sparse_kernel)
+            if spec is not None:
+                self._slab = self._build_slab(spec)
+
+    def _build_slab(self, spec: str):
+        """Host-side slab build + (for "auto") the per-dataset family race.
+        Needs concrete tensors: coordinates constructed under a trace must
+        receive a prebuilt ``sparse_slab`` instead (wrappers that construct
+        sub-coordinates inside jit/shard_map pin ``sparse_kernel="off"``)."""
+        from photon_ml_tpu.ops import fused_sparse
+
+        ds = self.dataset
+        if isinstance(ds.x, jax.core.Tracer):
+            raise ValueError(
+                "sparse-kernel selection builds the slab host-side and "
+                "cannot run under a trace; pass a prebuilt sparse_slab "
+                "when constructing this coordinate inside jit"
+            )
+        # None = the race handed the bucket back to the dense incumbent
+        return fused_sparse.build_and_select(
+            self.task, ds.x, ds.labels, ds.base_offsets, ds.weights,
+            spec, self.solve_label,
+        )
 
     @property
     def num_entities(self) -> int:
@@ -191,6 +234,10 @@ class RandomEffectCoordinate:
         """
         ds = self.dataset
         off = self.gathered_offsets(residual_offsets)
+        # the per-lane feature leaf: the dense (E, M, D) stack, or the
+        # bucketed sparse slab when a sparse family was selected — the
+        # solver kernels and the scheduler treat it as an opaque pytree
+        feats = self._slab if self._slab is not None else ds.x
 
         if self.solve_schedule is not None:
             if reg_weight is not None:
@@ -202,7 +249,7 @@ class RandomEffectCoordinate:
             from photon_ml_tpu.optim.scheduler import compacted_solve
 
             results = compacted_solve(
-                (ds.x, ds.labels, off, ds.weights),
+                (feats, ds.labels, off, ds.weights),
                 init_coefficients,
                 task=self.task,
                 optimizer=self.optimizer,
@@ -224,7 +271,7 @@ class RandomEffectCoordinate:
             self.task, self.optimizer, self.optimizer_config,
             self.regularization, reg_weight,
         )
-        results = jax.vmap(solve_one)(ds.x, ds.labels, off, ds.weights, init_coefficients)
+        results = jax.vmap(solve_one)(feats, ds.labels, off, ds.weights, init_coefficients)
         return results.coefficients, results
 
     # ------------------------------------------------------------------

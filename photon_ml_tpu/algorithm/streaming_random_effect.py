@@ -451,22 +451,34 @@ _BLOCK_UPDATE_JIT = None
 _BLOCK_SCORE_JIT = None
 
 
-def _block_coord(ds, task, optimizer, optimizer_config, regularization):
+def _block_coord(ds, task, optimizer, optimizer_config, regularization,
+                 sparse_slab=None):
+    # sparse_kernel="off": the STREAMING coordinate owns slab selection
+    # (_slab_for, host-side, cached). The sub-coordinate must never fall
+    # back to PHOTON_SPARSE_KERNEL itself — under the block jit ds.x is a
+    # tracer (the traced-construction guard would raise), and host-side a
+    # dense decision (slab=None) would be re-derived every update
     return RandomEffectCoordinate(
         dataset=ds, task=task, optimizer=optimizer,
         optimizer_config=optimizer_config, regularization=regularization,
+        sparse_kernel="off", sparse_slab=sparse_slab,
     )
 
 
-def _block_update(ds, local_resid, w0, **cfg):
+def _block_update(ds, local_resid, w0, slab=None, **cfg):
+    """``slab``: a prebuilt ops.fused_sparse.SparseSlab for this block
+    (None = dense path). It rides as a pytree ARGUMENT like the dataset,
+    so ladder-shaped slabs from different blocks share the executable; its
+    static ``kernel`` field keys the jit cache on the selected family."""
     global _BLOCK_UPDATE_JIT
     if _BLOCK_UPDATE_JIT is None:
         from photon_ml_tpu.compile import donation_enabled, instrumented_jit
 
-        def impl(ds, local_resid, w0, task, optimizer, optimizer_config,
+        def impl(ds, local_resid, w0, slab, task, optimizer, optimizer_config,
                  regularization):
             return _block_coord(
-                ds, task, optimizer, optimizer_config, regularization
+                ds, task, optimizer, optimizer_config, regularization,
+                sparse_slab=slab,
             ).update(local_resid, w0)
 
         _BLOCK_UPDATE_JIT = instrumented_jit(
@@ -475,7 +487,7 @@ def _block_update(ds, local_resid, w0, **cfg):
             static_argnames=_BLOCK_KERNEL_STATICS,
             donate_argnums=(2,) if donation_enabled() else (),
         )
-    return _BLOCK_UPDATE_JIT(ds, local_resid, w0, **cfg)
+    return _BLOCK_UPDATE_JIT(ds, local_resid, w0, slab, **cfg)
 
 
 def _block_score(ds, w, **cfg):
@@ -521,6 +533,11 @@ class StreamingRandomEffectCoordinate:
     # block reuse the same executables, and compaction composes with the
     # prefetch pipeline (block k+1 prefetches while block k's chunks run)
     solve_schedule: Optional[object] = None
+    # sparse per-entity kernels (ops/fused_sparse.py), selected per block
+    # SHAPE: None = PHOTON_SPARSE_KERNEL (default off) | "auto" | family.
+    # Block slabs are built host-side once (first epoch) and cached on the
+    # coordinate; ladder-shaped slabs reuse the shared block executable.
+    sparse_kernel: Optional[str] = None
 
     # streams per evaluation — CoordinateDescent must call update/score raw
     cd_jit = False
@@ -551,10 +568,14 @@ class StreamingRandomEffectCoordinate:
         self._shapes = [
             (b["num_entities"], b["local_dim"]) for b in self.manifest.blocks
         ]
+        from photon_ml_tpu.ops.fused_sparse import resolve_sparse_kernel
 
-    def _update_fn(self, ds, local_resid, w0):
+        self._sparse_spec = resolve_sparse_kernel(self.sparse_kernel)
+        self._sparse_slabs: dict = {}
+
+    def _update_fn(self, ds, local_resid, w0, slab=None):
         return _block_update(
-            ds, local_resid, w0,
+            ds, local_resid, w0, slab,
             task=self.task, optimizer=self.optimizer,
             optimizer_config=self.optimizer_config,
             regularization=self.regularization,
@@ -589,7 +610,8 @@ class StreamingRandomEffectCoordinate:
         )
 
     def _sub_for(self, ds: RandomEffectDataset,
-                 block: Optional[int] = None) -> RandomEffectCoordinate:
+                 block: Optional[int] = None,
+                 slab=None) -> RandomEffectCoordinate:
         return RandomEffectCoordinate(
             dataset=ds,
             task=self.task,
@@ -600,7 +622,39 @@ class StreamingRandomEffectCoordinate:
             solve_label=(
                 "streaming-re" if block is None else f"streaming-re[block {block}]"
             ),
+            # selection already happened in _slab_for — never re-resolve
+            # the env here (slab=None MEANS "this block stays dense")
+            sparse_kernel="off",
+            sparse_slab=slab,
         )
+
+    def _slab_for(self, i: int, ds: RandomEffectDataset):
+        """This block's sparse slab (None = dense path), built host-side on
+        first touch and cached — epochs re-stream the same immutable block
+        data, so the slab is epoch-invariant. "auto" races per block; the
+        race result is cached per (task, shape, platform) inside
+        fused_sparse, so same-ladder blocks race once."""
+        if self._sparse_spec is None:
+            return None
+        if i in self._sparse_slabs:
+            return self._sparse_slabs[i]
+        from photon_ml_tpu.ops import fused_sparse
+
+        slab = fused_sparse.build_and_select(
+            self.task, np.asarray(ds.x), ds.labels, ds.base_offsets,
+            ds.weights, self._sparse_spec, f"streaming-re[block {i}]",
+        )
+        if slab is not None:
+            # cache HOST-resident: the streaming contract keeps device
+            # memory O(one block) — device slabs cached per block would
+            # grow with the manifest across the first epoch. The upload
+            # rides each block call like the block tensors themselves
+            slab = fused_sparse.SparseSlab(
+                np.asarray(slab.idx), np.asarray(slab.val),
+                slab.dim, slab.kernel,
+            )
+        self._sparse_slabs[i] = slab
+        return slab
 
     def _partial_payload(self, new_state: SpilledREState, blocks_done: int,
                          inner: Optional[dict] = None) -> dict:
@@ -695,13 +749,14 @@ class StreamingRandomEffectCoordinate:
                     resid_host = np.asarray(residual_offsets)
                 local_resid = jnp.asarray(resid_host[row_sel])
             w0 = jnp.asarray(state.block(i))
+            slab = self._slab_for(i, ds)
             if self.solve_schedule is not None:
                 # compacted path: the per-block coordinate routes through
                 # the scheduler's process-shared chunk kernels (same-ladder
                 # blocks reuse executables; the prefetch pipeline keeps
                 # feeding blocks while chunks run)
                 try:
-                    coefs, res = self._sub_for(ds, block=i).update(
+                    coefs, res = self._sub_for(ds, block=i, slab=slab).update(
                         self._padded_resid(local_resid, ds), w0,
                         resume=(inner_resume if i == start_block else None),
                     )
@@ -714,7 +769,7 @@ class StreamingRandomEffectCoordinate:
                     ) from e
             else:
                 coefs, res = self._update_fn(
-                    ds, self._padded_resid(local_resid, ds), w0
+                    ds, self._padded_resid(local_resid, ds), w0, slab
                 )
             new_state.write(i, np.asarray(coefs))
             # pull the tracker to host NOW: keeping the vmapped OptResult

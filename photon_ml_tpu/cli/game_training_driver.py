@@ -530,6 +530,9 @@ class GameTrainingDriver:
                     regularization=cfg.regularization_context(),
                     solve_schedule=self.solve_schedule,
                     solve_label=name,
+                    # distributed solves pin sparse off at the shard level
+                    # — don't race/build a slab the solver will discard
+                    sparse_kernel="off" if p.distributed else None,
                 )
                 if p.distributed:
                     from photon_ml_tpu.parallel.distributed import (

@@ -130,6 +130,29 @@ def enable_persistent_cache(path: str) -> bool:
     return True
 
 
+def pallas_tpu_compiler_params(**kwargs: Any):
+    """Pallas TPU ``CompilerParams`` across jax versions.
+
+    Newer jax spells the Mosaic compiler-params struct
+    ``pallas.tpu.CompilerParams``; 0.4.x spells the same struct
+    ``TPUCompilerParams`` (and the very oldest releases only accept a plain
+    dict through ``compiler_params=``). Kernel call sites pass the modern
+    kwargs (``dimension_semantics=...``) and this resolves whichever
+    spelling the running jax has — the fused-GLM Pallas family must
+    compile on both the baked image and developer jax. (The fused-sparse
+    kernels pass no compiler params: their row-block grid axis carries a
+    sequential VMEM accumulator, so the default ordering is required.)
+    """
+    from jax.experimental.pallas import tpu as pltpu
+
+    cls = getattr(pltpu, "CompilerParams", None) or getattr(
+        pltpu, "TPUCompilerParams", None
+    )
+    if cls is None:  # ancient pallas: a bare dict is the accepted form
+        return dict(kwargs)
+    return cls(**kwargs)
+
+
 def ensure_cpu_collectives() -> None:
     """Select the Gloo CPU collectives implementation where it is opt-in.
 

@@ -39,6 +39,7 @@ from jax import lax
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from photon_ml_tpu.compat import pallas_tpu_compiler_params
 from photon_ml_tpu.ops.losses import PointwiseLoss, logistic
 
 DEFAULT_BLOCK_ROWS = 1024
@@ -245,7 +246,9 @@ def _fused_fn(loss: PointwiseLoss, block_rows: int, interpret: bool, vpu: bool =
                 pltpu.VMEM((1, 1), jnp.float32),
             ],
             # the grid axis is a pure reduction: no ordering constraint
-            compiler_params=pltpu.CompilerParams(dimension_semantics=("arbitrary",)),
+            compiler_params=pallas_tpu_compiler_params(
+                dimension_semantics=("arbitrary",)
+            ),
             interpret=interpret,
         )(*inputs)
         return _unpack_outputs(loss_sum, grad, sumd)
@@ -498,6 +501,11 @@ def reference_logistic_value_and_grad(x, y, weights, w, l2: float = 0.0):
 
 _autotune_cache: dict = {}
 _autotune_timings: dict = {}  # key -> {candidate: sec/pass} from the race
+# key -> {candidate: reason} for every candidate that did NOT produce a
+# timing — compile/run failures and eligibility skips. A candidate that
+# failed must READ as failed in the race record, not silently vanish
+# (bench postmortems need to distinguish "lost the race" from "never ran").
+_autotune_failures: dict = {}
 
 
 def _time_value_and_grad(vg_fn, w0, data, iters: int = 16) -> float:
@@ -582,11 +590,16 @@ def select_fused_block_rows(
 
     probe_data = (x, y, wt, off)
     timings = {}
+    failures = {}
     if mode != "1":
         timings[None] = _time_value_and_grad(xla_vg, w0, probe_data)
     interpret = not _on_tpu()
     for block in candidates:
         if _decode_block(block)[1] > n_probe:
+            failures[block] = (
+                f"skipped: block rows {_decode_block(block)[1]} > probe rows "
+                f"{n_probe}"
+            )
             continue
         try:
             fn = lambda w, data, b=block: fused_value_grad_parts(
@@ -594,9 +607,11 @@ def select_fused_block_rows(
                 block_rows=b, interpret=interpret,
             )[:2]
             timings[block] = _time_value_and_grad(fn, w0, probe_data)
-        except Exception:  # noqa: BLE001 — autotune probe: any compile/run failure just disqualifies the candidate
+        except Exception as e:  # noqa: BLE001 — autotune probe: any compile/run failure just disqualifies the candidate (recorded, not dropped)
+            failures[block] = f"failed: {type(e).__name__}: {e}"[:300]
             continue
     _autotune_timings[key] = dict(timings)
+    _autotune_failures[key] = failures
     if not timings:
         _autotune_cache[key] = None
         return None
@@ -629,4 +644,6 @@ def autotune_report(loss: PointwiseLoss, n: int, d: int, dtype=jnp.bfloat16) -> 
             "examples_per_sec": round(n_probe / sec, 1),
             "one_stream_gb_per_sec": round(x_bytes / sec / 1e9, 1),
         }
+    for cand, reason in _autotune_failures.get(key, {}).items():
+        candidates["{}:{}".format(*_decode_block(cand))] = {"failed": reason}
     return {"winner": _autotune_cache.get(key), "candidates": candidates}

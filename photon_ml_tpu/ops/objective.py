@@ -82,6 +82,23 @@ def _wmul(weights: Array, x: Array) -> Array:
     return jnp.where(weights > 0.0, weights * x, 0.0)
 
 
+def _row_sum(features, x: Array) -> Array:
+    """Scalar row reduction, slab-aware.
+
+    Sparse-slab batches reduce through the fixed-association pairwise tree
+    (``fused_sparse.tree_row_sum``) so every sparse family — the generic
+    scatter/segment path here AND the fused Pallas wrappers — produces the
+    bitwise-identical scalar in every fusion context (a plain ``reduce``'s
+    association order changes with producer fusion; a one-ulp loss value
+    flips line searches). Dense batches keep the plain ``jnp.sum``.
+    """
+    from photon_ml_tpu.ops.fused_sparse import SparseSlab, tree_row_sum
+
+    if isinstance(features, SparseSlab):
+        return tree_row_sum(x)
+    return jnp.sum(x)
+
+
 @dataclasses.dataclass(frozen=True)
 class GLMObjective:
     """Pure-function objective bundle for one pointwise loss.
@@ -115,7 +132,9 @@ class GLMObjective:
     # -- value --------------------------------------------------------------
     def value(self, w, batch, norm, l2_weight=0.0) -> Array:
         z = self.margins(w, batch, norm)
-        total = jnp.sum(_wmul(batch.weights, self.loss.loss(z, batch.labels)))
+        total = _row_sum(
+            batch.features, _wmul(batch.weights, self.loss.loss(z, batch.labels))
+        )
         total = _maybe_psum(total, self.axis_name)
         return total + 0.5 * l2_weight * jnp.sum(jnp.square(w))
 
@@ -132,13 +151,30 @@ class GLMObjective:
             )
             if norm.shifts is not None:
                 grad_eff = grad_eff - norm.shifts * sum_d
+        elif self._use_sparse_fused(batch):
+            # fused single-pass sparse GEVM over the bucketed slab (one
+            # load of idx/val feeds margin + loss + gradient scatter);
+            # bitwise-equal to the generic slab path by construction —
+            # verified at selection time (ops/fused_sparse.py)
+            from photon_ml_tpu.ops import fused_sparse
+
+            offsets = batch.offsets + norm.margin_shift(w_eff)
+            lv, grad_eff, sum_d = fused_sparse.fused_value_grad_parts(
+                self.loss, batch.features, batch.labels, batch.weights,
+                offsets, w_eff,
+            )
+            if norm.shifts is not None:
+                grad_eff = grad_eff - norm.shifts * sum_d
         else:
             z = batch.features.matvec(w_eff) + norm.margin_shift(w_eff) + batch.offsets
-            lv = jnp.sum(_wmul(batch.weights, self.loss.loss(z, batch.labels)))
+            lv = _row_sum(
+                batch.features,
+                _wmul(batch.weights, self.loss.loss(z, batch.labels)),
+            )
             d = _wmul(batch.weights, self.loss.d1(z, batch.labels))  # (N,)
             grad_eff = batch.features.rmatvec(d)
             if norm.shifts is not None:
-                grad_eff = grad_eff - norm.shifts * jnp.sum(d)
+                grad_eff = grad_eff - norm.shifts * _row_sum(batch.features, d)
         lv = _maybe_psum(lv, self.axis_name)
         grad_eff = _maybe_psum(grad_eff, self.axis_name)
         grad = grad_eff * norm.factors if norm.factors is not None else grad_eff
@@ -156,6 +192,18 @@ class GLMObjective:
             and batch.features.matrix.dtype != jnp.float64
         )
 
+    def _use_sparse_fused(self, batch: GLMBatch) -> bool:
+        """Static (trace-time) dispatch to the fused sparse-slab kernels:
+        the slab's ``kernel`` family is a static pytree aux, so per-bucket
+        selection changes the executable, never retraces mid-solve."""
+        from photon_ml_tpu.ops.fused_sparse import SparseSlab
+
+        return (
+            isinstance(batch.features, SparseSlab)
+            and batch.features.kernel.startswith("pallas")
+            and batch.features.val.dtype != jnp.float64
+        )
+
     def grad(self, w, batch, norm, l2_weight=0.0) -> Array:
         return self.value_and_grad(w, batch, norm, l2_weight)[1]
 
@@ -164,13 +212,28 @@ class GLMObjective:
         """H(w) @ v.  (HessianVectorAggregator.scala:90-116 algebra, batched.)"""
         w_eff = norm.effective_coefficients(w)
         v_eff = norm.effective_coefficients(v)
+        if self._use_sparse_fused(batch):
+            # fused sparse HVP: one load of the slab feeds BOTH
+            # contractions (z from w, z_v from v) and the transpose scatter
+            from photon_ml_tpu.ops import fused_sparse
+
+            offsets = batch.offsets + norm.margin_shift(w_eff)
+            hv_eff, sum_c = fused_sparse.fused_hvp_parts(
+                self.loss, batch.features, batch.labels, batch.weights,
+                offsets, w_eff, v_eff, norm.margin_shift(v_eff),
+            )
+            if norm.shifts is not None:
+                hv_eff = hv_eff - norm.shifts * sum_c
+            hv_eff = _maybe_psum(hv_eff, self.axis_name)
+            hv = hv_eff * norm.factors if norm.factors is not None else hv_eff
+            return hv + l2_weight * v
         z = batch.features.matvec(w_eff) + norm.margin_shift(w_eff) + batch.offsets
         d2 = _wmul(batch.weights, self.loss.d2(z, batch.labels))  # (N,)
         zv = batch.features.matvec(v_eff) + norm.margin_shift(v_eff)  # (x_i - shift).v_eff
         c = d2 * zv
         hv_eff = batch.features.rmatvec(c)
         if norm.shifts is not None:
-            hv_eff = hv_eff - norm.shifts * jnp.sum(c)
+            hv_eff = hv_eff - norm.shifts * _row_sum(batch.features, c)
         hv_eff = _maybe_psum(hv_eff, self.axis_name)
         hv = hv_eff * norm.factors if norm.factors is not None else hv_eff
         return hv + l2_weight * v
@@ -191,7 +254,7 @@ class GLMObjective:
             diag = (
                 diag
                 - 2.0 * norm.shifts * batch.features.rmatvec(d2)
-                + jnp.square(norm.shifts) * jnp.sum(d2)
+                + jnp.square(norm.shifts) * _row_sum(batch.features, d2)
             )
         diag = _maybe_psum(diag, self.axis_name)
         if norm.factors is not None:

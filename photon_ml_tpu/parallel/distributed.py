@@ -230,7 +230,13 @@ class DistributedRandomEffectSolver:
         return jax.device_put(w0, self.ctx.sharded())
 
     def _build(self):
-        coord = dataclasses.replace(self.coordinate, dataset=self._padded)
+        # sparse_kernel="off": replace re-runs __post_init__ — the mesh path
+        # has no per-shard slab selection, and the shard-level replace below
+        # runs under the shard_map trace where env re-resolution would raise
+        coord = dataclasses.replace(
+            self.coordinate, dataset=self._padded,
+            sparse_kernel="off", sparse_slab=None,
+        )
         ds = self._padded
 
         def solve_shard(x, labels, base_offsets, weights, row_index, w0, residuals):
@@ -247,7 +253,9 @@ class DistributedRandomEffectSolver:
                 num_entities=x.shape[0],
                 global_dim=ds.global_dim,
             )
-            local = dataclasses.replace(coord, dataset=shard_ds)
+            local = dataclasses.replace(
+                coord, dataset=shard_ds, sparse_kernel="off", sparse_slab=None
+            )
             coefs, results = local.update(residuals, w0)
             return coefs, results
 

@@ -825,8 +825,12 @@ class PerHostRandomEffectSolver:
     def _coordinate_for(self, ds):
         from photon_ml_tpu.algorithm.random_effect import RandomEffectCoordinate
 
+        # sparse_kernel="off": constructed inside jit(shard_map) — must not
+        # re-resolve PHOTON_SPARSE_KERNEL under the trace (no per-host slab
+        # selection on the mesh path)
         return RandomEffectCoordinate(
-            ds, self.task, self.optimizer, self.optimizer_config, self.regularization
+            ds, self.task, self.optimizer, self.optimizer_config,
+            self.regularization, sparse_kernel="off",
         )
 
     def update(self, residual_offsets: Array, init_coefficients: Array):

@@ -342,7 +342,7 @@ class ScoringServer:
         try:
             batch = self.featurize(rows, bundle)
             fut = self.batcher.submit(batch, score_fn=bundle.score_fn)
-        except BaseException:
+        except BaseException:  # noqa: BLE001 — unpin-and-reraise: the generation pin must not leak on ANY failure (incl. KeyboardInterrupt), or swap's drain fence waits forever
             bundle.end_request()
             raise
         fut.add_done_callback(bundle.end_request)

@@ -21,7 +21,7 @@ def test_list_sections_enumerates_all_sections():
     assert out.returncode == 0, out.stderr
     sections = out.stdout.split()
     assert sections == [
-        "dense", "sparse", "game", "game5", "grid",
+        "dense", "sparse", "sparse_race", "game", "game5", "grid",
         "streaming", "streaming_pipeline", "compile_reuse", "compaction",
         "preemption_resume",
         "perhost", "scoring", "serving", "ingest",

@@ -179,8 +179,14 @@ class TestManualDoubleBufferedVariant:
         lv = float(jnp.sum(wt * loss.loss(z, y)))
         d = wt * loss.d1(z, y)
         assert float(v_m) == pytest.approx(lv, rel=1e-5)
-        np.testing.assert_allclose(
-            np.asarray(g_m), np.asarray(d @ x), rtol=1e-4, atol=1e-4
+        # gradient columns can cancel catastrophically (poisson: row
+        # contributions ~1e3 summing to ~1e0), and interpreter-mode chunk
+        # accumulation order differs across jax versions — bound the error
+        # by the per-column |contribution| mass, not the tiny net value
+        col_mass = np.abs(np.asarray(d)) @ np.abs(np.asarray(x))
+        err = np.abs(np.asarray(g_m) - np.asarray(d @ x))
+        assert (err <= 1e-5 * col_mass + 1e-4).all(), (
+            f"max err {err.max()} vs col-mass-scaled bound"
         )
 
     def test_autotune_accepts_negative_candidates(self, monkeypatch):

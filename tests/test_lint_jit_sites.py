@@ -98,6 +98,19 @@ def test_serve_package_in_scan_scope():
     assert _violations("import jax\nscore = jax.jit(lambda b: b)\n")
 
 
+def test_fused_sparse_module_in_scan_scope():
+    """The sparse per-entity kernel family (ops/fused_sparse.py) is inside
+    the default scan scope — its race harness carries deliberate jit-ok
+    tags, and any NEW bare jax.jit there must trip the tier-1 gate."""
+    pkg = os.path.join(REPO, "photon_ml_tpu")
+    scanned = set(lint_jit_sites.iter_py_files([pkg]))
+    module = os.path.join(pkg, "ops", "fused_sparse.py")
+    assert os.path.exists(module), "fused_sparse module vanished?"
+    assert module in scanned
+    # and a bare site in a fused_sparse-shaped module is flagged
+    assert _violations("import jax\nrace = jax.jit(lambda w: w)\n")
+
+
 def test_package_is_clean():
     """THE gate: photon_ml_tpu carries no unannotated, unjustified jit
     sites (and no stale allowlist entries)."""
