@@ -252,7 +252,7 @@ def _read_schema_and_descriptor(path: str):
                     k = read_string(f)
                     meta[k] = read_bytes(f)
         schema = json.loads(meta["avro.schema"].decode())
-    except Exception:  # noqa: BLE001
+    except Exception:  # noqa: BLE001 — any malformed header degrades to the pure-Python reader
         return None
     names: Dict[str, Any] = {}
     from photon_ml_tpu.io.avro import _register

@@ -125,8 +125,8 @@ def _make_kernel(loss: PointwiseLoss):
         wl = jnp.where(wt > 0.0, wt * lv, 0.0)
         d = jnp.where(wt > 0.0, wt * loss.d1(z, y), 0.0)  # (BN, 1) f32
 
-        acc_loss[:] += jnp.sum(wl, keepdims=True).reshape(1, 1)
-        acc_sumd[:] += jnp.sum(d, keepdims=True).reshape(1, 1)
+        acc_loss[:] += jnp.sum(wl, keepdims=True).reshape(1, 1)  # lint: bitwise-reduction — pallas block-local accumulate; order pinned by the sequential grid
+        acc_sumd[:] += jnp.sum(d, keepdims=True).reshape(1, 1)  # lint: bitwise-reduction — pallas block-local accumulate; order pinned by the sequential grid
         acc_grad[:] += jnp.dot(
             d.astype(x.dtype).T, x, preferred_element_type=jnp.float32
         )  # (1, D)
@@ -188,9 +188,9 @@ def _make_vpu_kernel(loss: PointwiseLoss):
         wl = jnp.where(wt > 0.0, wt * lv, 0.0)
         d = jnp.where(wt > 0.0, wt * loss.d1(z, y), 0.0)  # (BN, 1)
 
-        acc_loss[:] += jnp.sum(wl, keepdims=True).reshape(1, 1)
-        acc_sumd[:] += jnp.sum(d, keepdims=True).reshape(1, 1)
-        acc_grad[:] += jnp.sum(x * d, axis=0, keepdims=True)  # (1, D)
+        acc_loss[:] += jnp.sum(wl, keepdims=True).reshape(1, 1)  # lint: bitwise-reduction — pallas block-local accumulate; order pinned by the sequential grid
+        acc_sumd[:] += jnp.sum(d, keepdims=True).reshape(1, 1)  # lint: bitwise-reduction — pallas block-local accumulate; order pinned by the sequential grid
+        acc_grad[:] += jnp.sum(x * d, axis=0, keepdims=True)  # (1, D)  # lint: bitwise-reduction — pallas block-local accumulate; order pinned by the sequential grid
 
         @pl.when(i == pl.num_programs(0) - 1)
         def _():
@@ -313,8 +313,8 @@ def _make_manual_kernel(loss: PointwiseLoss, block_rows: int):
                     dd.astype(x.dtype).T, x, preferred_element_type=jnp.float32
                 )
                 return (
-                    acc_loss + jnp.sum(wl, keepdims=True).reshape(1, 1),
-                    acc_sumd + jnp.sum(dd, keepdims=True).reshape(1, 1),
+                    acc_loss + jnp.sum(wl, keepdims=True).reshape(1, 1),  # lint: bitwise-reduction — pallas block-local accumulate; order pinned by the sequential grid
+                    acc_sumd + jnp.sum(dd, keepdims=True).reshape(1, 1),  # lint: bitwise-reduction — pallas block-local accumulate; order pinned by the sequential grid
                 )
 
             acc_grad[:] = jnp.zeros_like(acc_grad)
@@ -440,10 +440,10 @@ def _scan_value_grad_parts(loss, block, x, y, weights, offsets, w):
         # EXCLUDED, not multiplied (0 * inf = NaN for e.g. Poisson d1 at a
         # large margin)
         dvec = jnp.where(ww > 0, ww * loss.d1(z, yy), 0.0)
-        val = val + jnp.sum(jnp.where(ww > 0, ww * loss.loss(z, yy), 0.0))
+        val = val + jnp.sum(jnp.where(ww > 0, ww * loss.loss(z, yy), 0.0))  # lint: bitwise-reduction — dense-family canonical arithmetic; fused candidates are verified against THIS
         g = g + jnp.dot(dvec.astype(xx.dtype), xx,
                         preferred_element_type=jnp.float32)
-        ds = ds + jnp.sum(dvec)
+        ds = ds + jnp.sum(dvec)  # lint: bitwise-reduction — dense-family canonical arithmetic; fused candidates are verified against THIS
         return (val, g, ds), None
 
     init = (
@@ -472,14 +472,14 @@ def fused_logistic_value_and_grad(
     """
     n, d = x.shape
     if n == 0:
-        value = 0.5 * l2 * jnp.sum(jnp.square(w)) if l2 else jnp.float32(0.0)
+        value = 0.5 * l2 * jnp.sum(jnp.square(w)) if l2 else jnp.float32(0.0)  # lint: bitwise-reduction — l2 reg over the fixed (D,) w, not a slab batch axis
         return value, (l2 * w if l2 else jnp.zeros_like(w))
     value, grad, _ = fused_value_grad_parts(
         logistic, x, y, weights, jnp.zeros((n,), jnp.float32), w,
         block_rows=block_rows, interpret=interpret,
     )
     if l2:
-        value = value + 0.5 * l2 * jnp.sum(jnp.square(w))
+        value = value + 0.5 * l2 * jnp.sum(jnp.square(w))  # lint: bitwise-reduction — l2 reg over the fixed (D,) w, not a slab batch axis
         grad = grad + l2 * w
     return value, grad
 
@@ -490,7 +490,7 @@ def reference_logistic_value_and_grad(x, y, weights, w, l2: float = 0.0):
     loss = jnp.maximum(z, 0.0) + jnp.log1p(jnp.exp(-jnp.abs(z))) - y * z
     s = jax.nn.sigmoid(z)
     d = weights * (s - y)
-    value = jnp.sum(weights * loss) + 0.5 * l2 * jnp.sum(jnp.square(w))
+    value = jnp.sum(weights * loss) + 0.5 * l2 * jnp.sum(jnp.square(w))  # lint: bitwise-reduction — reference oracle; dense-family canonical arithmetic
     grad = d @ x.astype(jnp.float32) + l2 * w
     return value, grad
 
@@ -583,7 +583,7 @@ def select_fused_block_rows(
     def xla_vg(w, data):
         xx, yy, wwt, ooff = data
         z = jnp.dot(xx, w.astype(xx.dtype), preferred_element_type=jnp.float32) + ooff
-        val = jnp.sum(jnp.where(wwt > 0, wwt * loss.loss(z, yy), 0.0))
+        val = jnp.sum(jnp.where(wwt > 0, wwt * loss.loss(z, yy), 0.0))  # lint: bitwise-reduction — two-pass XLA baseline = the dense family's defined arithmetic
         dvec = jnp.where(wwt > 0, wwt * loss.d1(z, yy), 0.0)
         g = jnp.dot(dvec.astype(xx.dtype), xx, preferred_element_type=jnp.float32)
         return val, g

@@ -51,7 +51,7 @@ class NormalizationContext:
         if self.shifts is not None:
             if self.intercept_id is None:
                 raise ValueError("shift normalization requires an intercept column")
-            out = out.at[self.intercept_id].add(-jnp.sum(out * self.shifts))
+            out = out.at[self.intercept_id].add(-jnp.sum(out * self.shifts))  # lint: bitwise-reduction — (D,) shift dot over the fixed feature axis, not a slab batch axis
         return out
 
     def effective_coefficients(self, w: Array) -> Array:
@@ -60,7 +60,7 @@ class NormalizationContext:
     def margin_shift(self, w_eff: Array) -> Array:
         if self.shifts is None:
             return jnp.zeros((), w_eff.dtype)
-        return -jnp.sum(w_eff * self.shifts)
+        return -jnp.sum(w_eff * self.shifts)  # lint: bitwise-reduction — (D,) shift dot over the fixed feature axis, not a slab batch axis
 
     @property
     def is_identity(self) -> bool:

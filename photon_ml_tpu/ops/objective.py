@@ -136,7 +136,7 @@ class GLMObjective:
             batch.features, _wmul(batch.weights, self.loss.loss(z, batch.labels))
         )
         total = _maybe_psum(total, self.axis_name)
-        return total + 0.5 * l2_weight * jnp.sum(jnp.square(w))
+        return total + 0.5 * l2_weight * jnp.sum(jnp.square(w))  # lint: bitwise-reduction — l2 reg over the fixed (D,) w; pinned arithmetic of the bitwise gates
 
     # -- value + gradient (one fused pass) ----------------------------------
     def value_and_grad(self, w, batch, norm, l2_weight=0.0) -> Tuple[Array, Array]:
@@ -178,7 +178,7 @@ class GLMObjective:
         lv = _maybe_psum(lv, self.axis_name)
         grad_eff = _maybe_psum(grad_eff, self.axis_name)
         grad = grad_eff * norm.factors if norm.factors is not None else grad_eff
-        value = lv + 0.5 * l2_weight * jnp.sum(jnp.square(w))
+        value = lv + 0.5 * l2_weight * jnp.sum(jnp.square(w))  # lint: bitwise-reduction — l2 reg over the fixed (D,) w; pinned arithmetic of the bitwise gates
         grad = grad + l2_weight * w
         return value, grad
 

@@ -55,11 +55,11 @@ def summarize(batch: GLMBatch) -> BasicStatisticalSummary:
     MLlib colStats ignores sample weights, and so does the reference)."""
     x = batch.features.to_dense()
     present = (batch.weights > 0.0).astype(x.dtype)[:, None]  # (N, 1)
-    n = jnp.maximum(jnp.sum(present), 1.0)
+    n = jnp.maximum(jnp.sum(present), 1.0)  # lint: bitwise-reduction — one-shot column-stats census, off the solver's bitwise-gated path
     xm = x * present
-    mean = jnp.sum(xm, axis=0) / n
+    mean = jnp.sum(xm, axis=0) / n  # lint: bitwise-reduction — one-shot column-stats census, off the solver's bitwise-gated path
     # unbiased variance (MLlib convention)
-    var = (jnp.sum(jnp.square(xm), axis=0) - n * jnp.square(mean)) / jnp.maximum(n - 1.0, 1.0)
+    var = (jnp.sum(jnp.square(xm), axis=0) - n * jnp.square(mean)) / jnp.maximum(n - 1.0, 1.0)  # lint: bitwise-reduction — one-shot column-stats census, off the solver's bitwise-gated path
     big = jnp.asarray(jnp.finfo(x.dtype).max, x.dtype)
     x_or_neginf = jnp.where(present > 0, x, -big)
     x_or_posinf = jnp.where(present > 0, x, big)
@@ -67,10 +67,10 @@ def summarize(batch: GLMBatch) -> BasicStatisticalSummary:
         mean=mean,
         variance=jnp.maximum(var, 0.0),
         count=n,
-        num_nonzeros=jnp.sum((xm != 0.0).astype(x.dtype), axis=0),
+        num_nonzeros=jnp.sum((xm != 0.0).astype(x.dtype), axis=0),  # lint: bitwise-reduction — one-shot column-stats census, off the solver's bitwise-gated path
         max=jnp.max(x_or_neginf, axis=0),
         min=jnp.min(x_or_posinf, axis=0),
-        norm_l1=jnp.sum(jnp.abs(xm), axis=0),
-        norm_l2=jnp.sqrt(jnp.sum(jnp.square(xm), axis=0)),
-        mean_abs=jnp.sum(jnp.abs(xm), axis=0) / n,
+        norm_l1=jnp.sum(jnp.abs(xm), axis=0),  # lint: bitwise-reduction — one-shot column-stats census, off the solver's bitwise-gated path
+        norm_l2=jnp.sqrt(jnp.sum(jnp.square(xm), axis=0)),  # lint: bitwise-reduction — one-shot column-stats census, off the solver's bitwise-gated path
+        mean_abs=jnp.sum(jnp.abs(xm), axis=0) / n,  # lint: bitwise-reduction — one-shot column-stats census, off the solver's bitwise-gated path
     )

@@ -119,7 +119,7 @@ def _problem_fns(l1, bounds):
     """(F_of, reduced_pg) closures shared by init and advance."""
 
     def F_of(w, f):
-        return f + l1 * jnp.sum(jnp.abs(w))
+        return f + l1 * jnp.sum(jnp.abs(w))  # lint: bitwise-reduction — l1 reg over the fixed (D,) w, not a slab batch axis
 
     def reduced_pg(w, g):
         """(Pseudo-)gradient with bound-blocked components zeroed: at an

@@ -165,4 +165,4 @@ class GLMOptimizationProblem:
     def regularization_term_value(self, w: Array, reg_weight: Optional[Array] = None) -> Array:
         """lambda_1 * ||w||_1 + lambda_2/2 * ||w||^2 (GLOP.scala:235-278)."""
         l1, l2 = _split_reg_weight(self.regularization, reg_weight)
-        return l1 * jnp.sum(jnp.abs(w)) + 0.5 * l2 * jnp.sum(jnp.square(w))
+        return l1 * jnp.sum(jnp.abs(w)) + 0.5 * l2 * jnp.sum(jnp.square(w))  # lint: bitwise-reduction — l1/l2 reg over the fixed (D,) w, not a slab batch axis

@@ -262,7 +262,7 @@ def make_streaming_value_and_grad(
     )
 
     def add_reg(f, g, w, l2):
-        return f + 0.5 * l2 * jnp.sum(jnp.square(w)), g + l2 * w
+        return f + 0.5 * l2 * jnp.sum(jnp.square(w)), g + l2 * w  # lint: bitwise-reduction — l2 reg over the fixed (D,) w, not a slab batch axis
 
     add_reg = instrumented_jit(
         add_reg, site="streaming.vg_reg", donate_argnums=donate
@@ -360,7 +360,7 @@ def lbfgs_minimize_streaming(
     direction_fn, curvature_fn = _host_lbfgs_kernels()
 
     def F_of(w, f):
-        return f + l1 * jnp.sum(jnp.abs(w))
+        return f + l1 * jnp.sum(jnp.abs(w))  # lint: bitwise-reduction — l1 reg over the fixed (D,) w, not a slab batch axis
 
     def reduced_pg(w, g):
         pg = _pseudo_gradient(w, g, l1)
@@ -715,13 +715,13 @@ def streaming_summarize(source: ChunkedGLMSource):
         present = (wt > 0.0).astype(x.dtype)[:, None]
         xm = x * present
         return (
-            jnp.sum(present),
-            jnp.sum(xm, axis=0),
-            jnp.sum(jnp.square(xm), axis=0),
-            jnp.sum((xm != 0.0).astype(x.dtype), axis=0),
+            jnp.sum(present),  # lint: bitwise-reduction — one-shot streaming colStats pass, off the bitwise-gated solver path
+            jnp.sum(xm, axis=0),  # lint: bitwise-reduction — one-shot streaming colStats pass, off the bitwise-gated solver path
+            jnp.sum(jnp.square(xm), axis=0),  # lint: bitwise-reduction — one-shot streaming colStats pass, off the bitwise-gated solver path
+            jnp.sum((xm != 0.0).astype(x.dtype), axis=0),  # lint: bitwise-reduction — one-shot streaming colStats pass, off the bitwise-gated solver path
             jnp.max(jnp.where(present > 0, x, -jnp.inf), axis=0),
             jnp.min(jnp.where(present > 0, x, jnp.inf), axis=0),
-            jnp.sum(jnp.abs(xm), axis=0),
+            jnp.sum(jnp.abs(xm), axis=0),  # lint: bitwise-reduction — one-shot streaming colStats pass, off the bitwise-gated solver path
         )
 
     d = source.dim

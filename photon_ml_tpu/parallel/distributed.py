@@ -253,7 +253,7 @@ class DistributedRandomEffectSolver:
                 num_entities=x.shape[0],
                 global_dim=ds.global_dim,
             )
-            local = dataclasses.replace(
+            local = dataclasses.replace(  # lint: traced-construction — sparse pinned off + slab None make __post_init__ inert under the trace (regression-tested in test_fused_sparse)
                 coord, dataset=shard_ds, sparse_kernel="off", sparse_slab=None
             )
             coefs, results = local.update(residuals, w0)
@@ -407,7 +407,7 @@ class DistributedFactoredRandomEffectCoordinate:
                 num_entities=x.shape[0],
                 global_dim=ds.global_dim,
             )
-            local = dataclasses.replace(coord, dataset=shard_ds)
+            local = dataclasses.replace(coord, dataset=shard_ds)  # lint: traced-construction — factored coordinate has no sparse race in __post_init__; swap is a plain field rebind
             state, results = local.update(residuals, FactoredState(v0, mat0))
             return state.v, state.matrix, results
 

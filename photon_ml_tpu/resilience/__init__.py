@@ -32,7 +32,8 @@ import contextlib
 import dataclasses
 from typing import Iterator, Optional
 
-from photon_ml_tpu.resilience import faults, guards, preemption, retry
+from photon_ml_tpu.resilience import faults, guards, preemption, retry, sites
+from photon_ml_tpu.resilience.sites import FAULT_SITES, PREEMPT_SITES
 from photon_ml_tpu.resilience.faults import (
     FaultPlan,
     FaultSpec,
@@ -49,6 +50,9 @@ __all__ = [
     "guards",
     "preemption",
     "retry",
+    "sites",
+    "FAULT_SITES",
+    "PREEMPT_SITES",
     "PREEMPT_EXIT_CODE",
     "Preempted",
     "FaultPlan",

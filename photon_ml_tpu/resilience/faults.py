@@ -8,17 +8,13 @@ module makes those paths *testable in plain pytest*: production code calls
 :class:`FaultPlan` is active — installed either with the :func:`fault_scope`
 context manager or through the ``PHOTON_FAULTS`` environment variable.
 
-Named sites wired through the stack:
-
-  * ``io.read_block``       — per Avro container block read (io/avro.py)
-  * ``io.checkpoint_write`` — per checkpoint save attempt (checkpoint.py)
-  * ``io.index_load``       — index-map / off-heap store loads (io/)
-  * ``multihost.barrier``   — cross-host sync points (parallel/multihost.py)
-  * ``multihost.heartbeat`` — per-host heartbeat writes (parallel/multihost.py)
-  * ``optim.step``          — coordinate-descent updates (NaN corruption)
-  * ``preempt.signal``      — preemption polls (resilience/preemption.py);
-    a firing spec FLAGS a preemption request instead of raising (see
-    :func:`flag`), simulating a SIGTERM at a drain boundary
+Named sites wired through the stack are registered centrally in
+:data:`photon_ml_tpu.resilience.sites.FAULT_SITES` (re-exported here as
+:data:`KNOWN_SITES`); the ``fault-sites`` rule of ``tools/photon_lint``
+statically enforces that every production call site uses a registered
+name and that no registry entry goes stale. One site is special:
+``preempt.signal`` FLAGS a preemption request instead of raising (see
+:func:`flag`), simulating a SIGTERM at a drain boundary.
 
 ``PHOTON_FAULTS`` grammar (';'-separated site specs, ','-separated options)::
 
@@ -41,7 +37,10 @@ import random
 import threading
 from typing import Any, Dict, List, Optional, Tuple
 
+from photon_ml_tpu.resilience.sites import FAULT_SITES as KNOWN_SITES
+
 __all__ = [
+    "KNOWN_SITES",
     "InjectedIOError",
     "InjectedFatalError",
     "FaultSpec",

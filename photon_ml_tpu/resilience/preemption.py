@@ -42,6 +42,7 @@ import threading
 from typing import Any, Callable, Dict, Optional, Tuple, TypeVar
 
 from photon_ml_tpu.resilience import faults
+from photon_ml_tpu.resilience.sites import PREEMPT_SITES
 
 __all__ = [
     "PREEMPT_ENV",
@@ -68,8 +69,10 @@ PREEMPT_EXIT_CODE = 75
 
 PREEMPT_ENV = "PHOTON_PREEMPT_AT"
 
-#: Poll sites wired through the stack (the safe drain boundaries).
-SITES = ("cycle", "block", "chunk")
+#: Poll sites wired through the stack (the safe drain boundaries) —
+#: registered centrally in photon_ml_tpu.resilience.sites and enforced
+#: by the fault-sites photon_lint rule.
+SITES = PREEMPT_SITES
 
 
 class Preempted(RuntimeError):

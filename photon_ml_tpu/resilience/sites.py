@@ -1,0 +1,47 @@
+"""Central registry of resilience site names — THE invariant source.
+
+Every site string handed to the fault-injection machinery
+(:func:`photon_ml_tpu.resilience.faults.inject` / ``corrupt`` / ``flag``)
+and every preemption poll boundary
+(:func:`photon_ml_tpu.resilience.preemption.check`) must be registered
+here. The registry is enforced statically by the ``fault-sites`` rule of
+``tools/photon_lint`` (tier-1): an unregistered site string at a call site
+fails the lint, and so does a registry entry no call site uses — the two
+directions together keep this table exactly the set of live fault
+surfaces, so chaos plans (``PHOTON_FAULTS`` / ``PHOTON_PREEMPT_AT``) can
+be written against it without spelunking the tree.
+
+This module is imported by :mod:`photon_ml_tpu.resilience.faults` and
+:mod:`photon_ml_tpu.resilience.preemption` and must stay dependency-free
+(no jax, no package imports): the linter parses it with ``ast`` only, and
+``bench.py --list-sections``-style device-free tooling may import it.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Tuple
+
+__all__ = ["FAULT_SITES", "PREEMPT_SITES"]
+
+#: Named fault-injection sites wired through the stack: site -> where it
+#: fires. Keys are the exact string literals production code passes to
+#: ``faults.inject`` / ``faults.corrupt`` / ``faults.flag``.
+FAULT_SITES: Dict[str, str] = {
+    "io.read_block": "per Avro container block read (io/avro.py, io/avro_data.py)",
+    "io.checkpoint_write": "per checkpoint save attempt (checkpoint.py)",
+    "io.index_load": "index-map / off-heap store loads (io/index_map.py, io/offheap.py)",
+    "io.cache_read": "tensor-cache entry reads (io/tensor_cache.py)",
+    "io.cache_write": "tensor-cache entry commits (io/tensor_cache.py)",
+    "multihost.barrier": "cross-host sync points (parallel/multihost.py)",
+    "multihost.heartbeat": "per-host heartbeat writes (parallel/multihost.py)",
+    "optim.step": "coordinate-descent updates, NaN corruption (algorithm/coordinate_descent.py)",
+    "preempt.signal": "preemption polls; flags instead of raising (resilience/preemption.py)",
+}
+
+#: Preemption poll boundaries (the safe drain points) accepted by
+#: ``preemption.check`` and the ``PHOTON_PREEMPT_AT`` grammar.
+PREEMPT_SITES: Tuple[str, ...] = (
+    "cycle",  # coordinate-descent update/iteration boundary
+    "block",  # streaming random-effect block boundary
+    "chunk",  # compacted-solver chunk boundary (optim/scheduler.py)
+)

@@ -1,0 +1,56 @@
+"""Seeded violations for the broad-except rule (NOT in the scan scope —
+exercised only by tests/test_photon_lint.py). Expected finding lines are
+asserted by the test; keep them stable."""
+
+import builtins
+
+
+def bare():
+    try:
+        pass
+    except:  # line 11: bare except — always an error
+        pass
+
+
+def broad_name():
+    try:
+        pass
+    except Exception:  # line 18: unjustified broad except
+        pass
+
+
+def broad_attribute():
+    try:
+        pass
+    except builtins.Exception:  # line 25: PR-8 satellite — ast.Attribute escaped the legacy linter
+        pass
+
+
+def broad_tuple_multiline_tag_elsewhere():
+    try:
+        pass
+    except (ValueError,
+            BaseException):  # noqa: BLE001 — line 33: tag on the SECOND clause line must suppress
+        raise
+
+
+def broad_tuple_multiline_untagged():
+    try:
+        pass
+    except (ValueError,
+            Exception):  # line 41 clause, finding anchors to line 40
+        raise
+
+
+def tag_without_justification():
+    try:
+        pass
+    except Exception:  # noqa: BLE001
+        pass
+
+
+def bare_except_with_tag_still_fails():
+    try:
+        pass
+    except:  # noqa: BLE001 — line 55: a bare except can NEVER be justified
+        pass

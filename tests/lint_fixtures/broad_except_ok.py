@@ -1,0 +1,23 @@
+"""Clean sources for the broad-except rule: narrow handlers and justified
+suppressions (legacy and unified grammar) produce zero findings."""
+
+
+def narrow():
+    try:
+        pass
+    except (OSError, ValueError):
+        raise
+
+
+def justified_legacy():
+    try:
+        pass
+    except Exception:  # noqa: BLE001 — crossing a thread boundary intact
+        pass
+
+
+def justified_unified():
+    try:
+        pass
+    except BaseException:  # lint: broad-except — last-ditch fence, re-raised below
+        raise

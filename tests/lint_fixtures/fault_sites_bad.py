@@ -1,0 +1,27 @@
+"""Seeded violations for the fault-sites rule: unregistered site strings,
+computed site names, and bad preemption poll sites."""
+
+from photon_ml_tpu.resilience import faults as faults
+from photon_ml_tpu.resilience import preemption as preemption
+from photon_ml_tpu.resilience.faults import inject
+
+
+def read_block(path):
+    faults.inject("io.read_blokc", path=path)  # line 10: typo'd site
+
+
+def poll():
+    if preemption.check("cylce"):  # line 14: typo'd poll site
+        raise SystemExit(75)
+
+
+def dynamic(site):
+    inject(site)  # line 19: computed site — registry cannot vouch
+
+
+def corrupt_step(tree):
+    return faults.corrupt("optim.step_v2", tree)  # line 23: unregistered
+
+
+def keyword_site(path):
+    faults.inject(site="io.read_blokc", path=path)  # line 27: keyword form must be checked too

@@ -1,0 +1,20 @@
+"""Clean sources for the fault-sites rule: registered sites only, plus a
+justified dynamic-site suppression."""
+
+from photon_ml_tpu.resilience import faults, preemption
+
+
+def read_block(path, index):
+    faults.inject("io.read_block", path=path, block=index)
+
+
+def poll(step):
+    return preemption.check("cycle", step=step)
+
+
+def flag_preempt():
+    return faults.flag("preempt.signal", poll_site="cycle")
+
+
+def dynamic(site):
+    faults.inject(site)  # lint: fault-sites — fixture: test harness fans one plan over many sites

@@ -1,0 +1,29 @@
+"""Seeded violations for the jit-sites rule (pjit and named_call coverage
+included — the PR-8 satellite)."""
+
+import functools
+
+import jax
+from jax.experimental.pjit import pjit
+
+
+def f(x):
+    return x
+
+
+bare_call = jax.jit(f)  # line 14
+
+
+@jax.jit  # line 17
+def decorated(x):
+    return x
+
+
+@functools.partial(jax.jit)  # line 22
+def partial_decorated(x):
+    return x
+
+
+bare_pjit = pjit(f)  # line 27: pjit escaped the legacy linter
+bare_jax_pjit = jax.pjit(f)  # line 28
+named = jax.named_call(f)  # line 29: named_call outside an annotated jit

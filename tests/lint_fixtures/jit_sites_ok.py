@@ -1,0 +1,26 @@
+"""Clean sources for the jit-sites rule: annotated sites, instrumented_jit,
+justified tags, and named_call nested in an annotated jit."""
+
+import functools
+
+import jax
+from jax.experimental.pjit import pjit
+
+from photon_ml_tpu.compile import instrumented_jit
+
+
+def f(x):
+    return x
+
+
+donated = jax.jit(f, donate_argnums=(0,))
+static = pjit(f, static_argnames=("n",))
+instrumented = instrumented_jit(f, site="fixture")
+tagged = jax.jit(f)  # jit-ok: read-only oracle over shared probe inputs
+tagged_unified = jax.pjit(f)  # lint: jit-sites — fixture exercising the unified tag
+wrapped = jax.jit(jax.named_call(f), donate_argnums=(0,))
+
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def decorated(x):
+    return x

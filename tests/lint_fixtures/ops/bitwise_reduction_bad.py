@@ -1,0 +1,36 @@
+"""Seeded violations for the bitwise-reduction rule (lives under an
+``ops/`` path segment so the rule's directory scope applies)."""
+
+import jax.numpy as jnp
+from jax import lax
+
+
+def scalar_loss(per_row):
+    return jnp.sum(per_row)  # line 9: full reduce of a per-row vector
+
+
+def batch_axis(slab):
+    return jnp.sum(slab, axis=0)  # line 13: leading-axis reduce
+
+
+def method_form(slab):
+    return slab.sum(axis=(0, 1))  # line 17: tuple containing the batch axis
+
+
+def raw_reduce(slab):
+    return lax.reduce(slab, 0.0, lax.add, (0,))  # line 21: backend-ordered reduce
+
+
+def dynamic_axis(slab, ax):
+    return jnp.sum(slab, axis=ax)  # line 25: non-literal axis — cannot vouch
+
+
+def tree_row_sum(x):
+    # the blessed implementation itself is exempt by construction
+    n = x.shape[-1]
+    total = jnp.sum(x)  # exempt: inside tree_row_sum
+    return total, n
+
+
+def negative_batch_axis(slab):
+    return jnp.sum(slab, axis=-2)  # line 36: -2 on a 2-D slab IS the batch axis

@@ -1,0 +1,21 @@
+"""Clean sources for the bitwise-reduction rule: row-local reductions,
+numpy host-side sums, and a justified suppression."""
+
+import numpy as np
+import jax.numpy as jnp
+
+
+def row_local(slab):
+    return jnp.sum(slab, axis=-1)  # per-row K-axis reduce: fine
+
+
+def row_local_positive(slab):
+    return slab.sum(axis=1)
+
+
+def host_side(counts):
+    return np.sum(counts)
+
+
+def justified(per_row):
+    return jnp.sum(per_row)  # lint: bitwise-reduction — fixture: diagnostics-only census

@@ -1,0 +1,32 @@
+"""Seeded violations for the static-key-honesty rule: the PR 7
+normalize-then-keep-old-key shape — a static ``kernel`` jit cache key
+normalized in a branch while the raw value is still dispatched on."""
+
+
+class Slab:
+    def __init__(self, idx, val, kernel):
+        self.idx = idx
+        self.val = val
+        self.kernel = kernel
+
+
+def build(idx, val, kernel, f64):
+    fam = "scatter" if f64 else kernel  # normalization event
+    return Slab(idx, val, kernel=kernel)  # line 15: raw key after normalization
+
+
+def build_branchy(idx, val, spec, kernel):
+    if spec == "f64":
+        fam = normalize(kernel)  # normalization event (inside an if)
+    else:
+        fam = kernel
+    return Slab(idx, val, kernel=spec.kernel)  # line 23: attribute copy of the raw key
+
+
+def build_constant(idx, val, kernel, f64):
+    fam = "scatter" if f64 else kernel
+    return Slab(idx, val, kernel="pallas")  # line 28: constant key after normalization
+
+
+def normalize(kernel):
+    return kernel.split(":")[0]

@@ -1,0 +1,27 @@
+"""Clean sources for the static-key-honesty rule: the normalized binding
+IS the dispatched key, or no normalization happens at all."""
+
+
+class Slab:
+    def __init__(self, idx, val, kernel):
+        self.kernel = kernel
+
+
+def build_honest(idx, val, kernel, f64):
+    kernel = "scatter" if f64 else kernel  # normalized IN PLACE
+    return Slab(idx, val, kernel=kernel)
+
+
+def build_renamed(idx, val, kernel, f64):
+    fam = "scatter" if f64 else kernel
+    return Slab(idx, val, kernel=fam)  # dispatches on the normalized name
+
+
+def build_plain(idx, val, kernel):
+    return Slab(idx, val, kernel=kernel)  # no normalization: raw key is honest
+
+
+def build_justified(idx, val, kernel, f64):
+    fam = "scatter" if f64 else kernel
+    probe = Slab(idx, val, kernel=kernel)  # lint: static-key-honesty — fixture: probe deliberately keeps the raw key
+    return probe, fam

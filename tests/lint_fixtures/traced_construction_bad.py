@@ -1,0 +1,61 @@
+"""Seeded violations for the traced-construction rule: host-side
+construction reachable inside jit/shard_map/pallas_call bodies — the
+PR 7 streaming/mesh-path bug class, in every detected shape."""
+
+import dataclasses
+import functools
+import os
+
+import jax
+from jax.experimental.shard_map import shard_map
+from jax.experimental import pallas as pl
+
+from photon_ml_tpu.compile import instrumented_jit
+from photon_ml_tpu.ops.fused_sparse import build_sparse_slab
+
+
+def resolve_flavor(spec):
+    return spec or os.environ.get("PHOTON_FIXTURE", "off")
+
+
+@jax.jit  # traced root via decorator
+def env_under_jit(x):
+    if os.environ.get("PHOTON_FIXTURE"):  # line 23: env read under trace
+        return -x
+    return x
+
+
+@functools.partial(jax.jit, static_argnames=("k",))
+def resolver_under_jit(x, k):
+    flavor = resolve_flavor(k)  # line 30: resolve_* under trace
+    return x if flavor == "off" else -x
+
+
+def _helper(coord, x):
+    # reachable only THROUGH the traced root below: intra-file call graph
+    swapped = dataclasses.replace(coord, dataset=x)  # line 36: replace under trace
+    return swapped
+
+
+def _impl(coord, x):
+    return _helper(coord, x)
+
+
+UPDATE = instrumented_jit(_impl, site="fixture.update")
+
+
+def _shard_body(x):
+    slab = build_sparse_slab(x)  # line 46: slab build under shard_map
+    return slab.val
+
+
+def run_sharded(mesh, x):
+    return shard_map(_shard_body, mesh=mesh)(x)
+
+
+def _kernel(x_ref, o_ref):
+    o_ref[:] = x_ref[:] * float(os.getenv("PHOTON_SCALE", "1"))  # line 56: getenv in pallas body
+
+
+def run_pallas(x):
+    return pl.pallas_call(_kernel, out_shape=x)(x)

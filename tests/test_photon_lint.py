@@ -1,0 +1,428 @@
+"""tools/photon_lint: the unified JAX-invariant static-analysis framework.
+
+Replaces tests/test_lint_excepts.py + tests/test_lint_jit_sites.py: the
+two legacy package-clean gates are now ONE parametrized tier-1 test over
+every rule of the shared engine, plus engine-level coverage (suppression
+grammar, allowlist staleness, --json schema, exit codes) and a fixture
+corpus proving each rule fires on its seeded violation.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+pytestmark = pytest.mark.lint
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+sys.path.insert(0, os.path.join(REPO, "tools"))
+
+from tools.photon_lint import engine  # noqa: E402
+from tools.photon_lint.rules import RULES  # noqa: E402
+from tools.photon_lint.rules.fault_sites import FaultSitesRule  # noqa: E402
+from tools.photon_lint.rules.jit_sites import JitSitesRule  # noqa: E402
+
+FIXTURES = os.path.join(os.path.dirname(os.path.abspath(__file__)), "lint_fixtures")
+
+#: rule -> (bad fixture, pretend relpath or None, expected finding lines)
+CORPUS = {
+    "broad-except": (
+        "broad_except_bad.py", None, {11, 18, 25, 40, 48, 55},
+    ),
+    "jit-sites": (
+        "jit_sites_bad.py", None, {14, 17, 22, 27, 28, 29},
+    ),
+    "traced-construction": (
+        "traced_construction_bad.py", None, {18, 23, 30, 36, 48, 57},
+    ),
+    "bitwise-reduction": (
+        # the rule is scoped to ops//optim/ path segments, so the fixture
+        # is presented under a pretend ops/ relpath
+        os.path.join("ops", "bitwise_reduction_bad.py"),
+        "photon_ml_tpu/ops/fixture.py",
+        {9, 13, 17, 21, 25, 36},
+    ),
+    "static-key-honesty": (
+        "static_key_bad.py", None, {15, 23, 28},
+    ),
+    "fault-sites": (
+        "fault_sites_bad.py", None, {10, 14, 19, 23, 27},
+    ),
+}
+
+CLEAN = {
+    "broad-except": ("broad_except_ok.py", None),
+    "jit-sites": ("jit_sites_ok.py", None),
+    "traced-construction": ("traced_construction_ok.py", None),
+    "bitwise-reduction": (
+        os.path.join("ops", "bitwise_reduction_ok.py"),
+        "photon_ml_tpu/ops/fixture.py",
+    ),
+    "static-key-honesty": ("static_key_ok.py", None),
+    "fault-sites": ("fault_sites_ok.py", None),
+}
+
+
+def _scan_fixture(rule, fname, relpath):
+    with open(os.path.join(FIXTURES, fname), encoding="utf-8") as f:
+        src = f.read()
+    return engine.scan_source(
+        src, path=fname, relpath=relpath or fname, rule_names=[rule]
+    )
+
+
+# ---------------------------------------------------------------------------
+# the fixture corpus: every rule fires on its seeded bad example
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("rule", sorted(CORPUS))
+def test_rule_fires_on_seeded_violations(rule):
+    fname, relpath, expected = CORPUS[rule]
+    findings = _scan_fixture(rule, fname, relpath)
+    got = {f.line for f in findings if f.rule == rule}
+    assert got == expected, [str(f) for f in findings]
+
+
+@pytest.mark.parametrize("rule", sorted(CLEAN))
+def test_rule_clean_on_ok_fixture(rule):
+    fname, relpath = CLEAN[rule]
+    findings = _scan_fixture(rule, fname, relpath)
+    assert not [f for f in findings if f.rule == rule], [
+        str(f) for f in findings
+    ]
+
+
+# ---------------------------------------------------------------------------
+# THE tier-1 gate: the live tree lints clean under every rule
+# (replaces the two legacy test_package_is_clean tests)
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def live_scan():
+    """ONE full-scope scan with every rule (the engine parses each file
+    once and shares the tree across rules — the same pass tier-1 pays)."""
+    return engine.run(root=REPO)
+
+
+@pytest.mark.parametrize("rule", sorted(RULES) + ["suppression"])
+def test_live_tree_is_clean(rule, live_scan):
+    findings, stats = live_scan
+    assert stats["full_scope"] and stats["files_scanned"] > 100
+    mine = [f for f in findings if f.rule == rule]
+    assert not mine, "\n".join(str(f) for f in mine)
+
+
+def test_default_scope_covers_the_hot_paths():
+    """serve/, ops/fused_sparse.py, tools/ and bench.py are all inside the
+    default scan scope — a bare jit or broad except cannot land there
+    without tripping tier-1."""
+    paths = [os.path.join(REPO, p) for p in engine.DEFAULT_SCOPE]
+    scanned = {
+        os.path.relpath(p, REPO).replace(os.sep, "/")
+        for p in engine.iter_py_files(paths)
+    }
+    assert "bench.py" in scanned
+    assert "photon_ml_tpu/ops/fused_sparse.py" in scanned
+    assert "photon_ml_tpu/resilience/sites.py" in scanned
+    assert any(p.startswith("photon_ml_tpu/serve/") for p in scanned)
+    assert any(p.startswith("tools/photon_lint/") for p in scanned)
+
+
+# ---------------------------------------------------------------------------
+# engine: suppression-tag grammar
+# ---------------------------------------------------------------------------
+
+
+def test_suppression_requires_justification():
+    src = "try:\n    pass\nexcept Exception:  # lint: broad-except\n    pass\n"
+    findings = engine.scan_source(src, rule_names=["broad-except"])
+    rules = {f.rule for f in findings}
+    # the bare tag does NOT suppress, and is itself a finding
+    assert "broad-except" in rules and "suppression" in rules
+
+
+def test_suppression_with_justification_suppresses():
+    src = (
+        "try:\n    pass\n"
+        "except Exception:  # lint: broad-except — fence, re-raised\n"
+        "    raise\n"
+    )
+    assert not engine.scan_source(src, rule_names=["broad-except"])
+
+
+def test_legacy_tag_requires_justification():
+    src = "try:\n    pass\nexcept Exception:  # noqa: BLE001\n    pass\n"
+    findings = engine.scan_source(src, rule_names=["broad-except"])
+    assert {f.rule for f in findings} == {"broad-except", "suppression"}
+
+
+def test_unknown_rule_in_tag_is_a_finding():
+    src = "x = 1  # lint: no-such-rule — because\n"
+    findings = engine.scan_source(src, rule_names=["broad-except"])
+    assert any(
+        f.rule == "suppression" and "unknown rule" in f.message
+        for f in findings
+    )
+
+
+def test_tag_in_string_literal_does_not_count():
+    """Tags are matched via tokenize: a tag INSIDE a string neither
+    suppresses nor trips grammar validation."""
+    src = 's = "# lint: broad-except"\ntry:\n    pass\nexcept Exception:\n    pass\n'
+    findings = engine.scan_source(src, rule_names=["broad-except"])
+    assert {f.rule for f in findings} == {"broad-except"}
+
+
+def test_multiline_handler_tag_on_any_clause_line():
+    """PR-8 satellite: the tag may sit on any line of a multi-line
+    handler-type clause (the legacy linter only looked at node.lineno)."""
+    src = (
+        "try:\n    pass\n"
+        "except (ValueError,\n"
+        "        Exception):  # noqa: BLE001 — second clause line\n"
+        "    raise\n"
+    )
+    assert not engine.scan_source(src, rule_names=["broad-except"])
+
+
+def test_attribute_broad_except_flagged():
+    """PR-8 satellite: ``except builtins.Exception`` escaped the legacy
+    linter (ast.Attribute, not ast.Name)."""
+    src = "import builtins\ntry:\n    pass\nexcept builtins.Exception:\n    pass\n"
+    findings = engine.scan_source(src, rule_names=["broad-except"])
+    assert any("builtins.Exception" in f.message for f in findings)
+
+
+# ---------------------------------------------------------------------------
+# engine: allowlist staleness + fault-site registry integrity
+# ---------------------------------------------------------------------------
+
+
+def test_stale_jit_allowlist_entry_fails():
+    rule = JitSitesRule(root=REPO, allowlist={"x.py:gone": "was migrated"})
+    findings = engine.scan_source(
+        "VALUE = 1\n", path="x.py", relpath="x.py", rules=[rule]
+    )
+    assert not findings
+    stale = list(rule.finalize(full_scope=False))
+    assert stale and "stale" in stale[0][2]
+
+
+def test_live_jit_allowlist_entry_not_stale():
+    rule = JitSitesRule(root=REPO, allowlist={"x.py:f": "read-only"})
+    src = "import jax\ndef f(x):\n    return jax.jit(x)\n"
+    assert not engine.scan_source(src, path="x.py", relpath="x.py", rules=[rule])
+    assert not list(rule.finalize(full_scope=False))
+
+
+def test_unused_fault_registry_entry_fails():
+    rule = FaultSitesRule(
+        root=REPO,
+        fault_sites={"io.read_block": 10, "io.never_wired": 20},
+        preempt_sites={"cycle": 30},
+    )
+    src = (
+        "from photon_ml_tpu.resilience import faults, preemption\n"
+        "faults.inject('io.read_block')\n"
+        "preemption.check('cycle')\n"
+    )
+    assert not engine.scan_source(src, rules=[rule])
+    unused = list(rule.finalize(full_scope=True))
+    assert len(unused) == 1 and "io.never_wired" in unused[0][2]
+    # partial scans (--changed) must NOT report unused entries: the usage
+    # may simply be in an unscanned file
+    assert not list(rule.finalize(full_scope=False))
+
+
+def test_registry_parse_matches_runtime_module():
+    """The ast-parsed registry the rule enforces IS the module production
+    code imports."""
+    from photon_ml_tpu.resilience import sites
+
+    rule = FaultSitesRule(root=REPO)
+    assert set(rule._fault_sites) == set(sites.FAULT_SITES)
+    assert set(rule._preempt_sites) == set(sites.PREEMPT_SITES)
+    # and the wired sites the stack grew through PRs 1-7 are all present
+    assert {
+        "io.read_block", "io.checkpoint_write", "io.cache_read",
+        "multihost.barrier", "optim.step", "preempt.signal",
+    } <= set(sites.FAULT_SITES)
+    assert set(sites.PREEMPT_SITES) == {"cycle", "block", "chunk"}
+
+
+# ---------------------------------------------------------------------------
+# jit-sites: pjit / named_call coverage (PR-8 satellite)
+# ---------------------------------------------------------------------------
+
+
+def test_pjit_variants_flagged():
+    for src in (
+        "from jax.experimental.pjit import pjit\nf = pjit(lambda x: x)\n",
+        "import jax\nf = jax.pjit(lambda x: x)\n",
+    ):
+        findings = engine.scan_source(src, rule_names=["jit-sites"])
+        assert findings, src
+    # annotated pjit passes
+    assert not engine.scan_source(
+        "from jax.experimental.pjit import pjit\n"
+        "f = pjit(lambda x: x, donate_argnums=(0,))\n",
+        rule_names=["jit-sites"],
+    )
+
+
+def test_named_call_outside_annotated_jit_flagged():
+    findings = engine.scan_source(
+        "import jax\ng = jax.named_call(lambda x: x)\n",
+        rule_names=["jit-sites"],
+    )
+    assert findings and "named_call" in findings[0].message
+    # nested inside an annotated jit it is that site's plumbing
+    assert not engine.scan_source(
+        "import jax\n"
+        "g = jax.jit(jax.named_call(lambda x: x), donate_argnums=(0,))\n",
+        rule_names=["jit-sites"],
+    )
+
+
+def test_qualname_resolution_in_messages():
+    src = (
+        "import jax\n"
+        "class C:\n"
+        "    def m(self):\n"
+        "        return jax.jit(lambda x: x)\n"
+    )
+    (f,) = engine.scan_source(src, path="<test>", rule_names=["jit-sites"])
+    assert "<test>:C.m" in f.message and f.line == 4
+
+
+# ---------------------------------------------------------------------------
+# the CLI: --json schema, exit codes, --changed scoping, jax-free import
+# ---------------------------------------------------------------------------
+
+
+def _run_cli(*args, **kw):
+    return subprocess.run(
+        [sys.executable, "-m", "tools.photon_lint", *args],
+        capture_output=True, text=True, cwd=REPO, timeout=300, **kw,
+    )
+
+
+def test_cli_default_scope_clean_and_json_schema():
+    proc = _run_cli("--json")
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    payload = json.loads(proc.stdout)
+    assert payload["version"] == 1
+    assert payload["files_scanned"] > 100
+    assert set(RULES) <= set(payload["rules"])
+    assert "suppression" in payload["rules"]
+    assert len(payload["rules"]) >= 7  # 2 migrated + 4 new + suppression
+    assert payload["findings"] == [] and payload["counts"] == {}
+
+
+def test_cli_findings_exit_1_with_locations():
+    bad = os.path.join(FIXTURES, "jit_sites_bad.py")
+    proc = _run_cli("--rule", "jit-sites", bad)
+    assert proc.returncode == 1
+    assert "jit_sites_bad.py:14" in proc.stdout
+    payload = json.loads(_run_cli("--rule", "jit-sites", "--json", bad).stdout)
+    assert payload["counts"]["jit-sites"] == 6
+    f = payload["findings"][0]
+    assert set(f) == {"rule", "path", "line", "message"}
+
+
+def test_cli_unknown_rule_exit_2():
+    proc = _run_cli("--rule", "no-such-rule")
+    assert proc.returncode == 2 and "unknown rule" in proc.stderr
+
+
+def test_changed_scope_filter():
+    from tools.photon_lint.__main__ import scope_filter
+
+    names = [
+        "photon_ml_tpu/ops/objective.py",  # in scope
+        "bench.py",                        # in scope
+        "tools/photon_lint/engine.py",     # in scope
+        "tests/test_photon_lint.py",       # tests are NOT in the scan scope
+        "README.md",                       # not python
+        "photon_ml_tpu/does_not_exist.py", # deleted files are skipped
+    ]
+    got = {
+        os.path.relpath(p, REPO).replace(os.sep, "/")
+        for p in scope_filter(names, REPO)
+    }
+    assert got == {
+        "photon_ml_tpu/ops/objective.py", "bench.py",
+        "tools/photon_lint/engine.py",
+    }
+
+
+def test_changed_mode_runs_clean_and_fast():
+    """--changed is the pre-commit hook path: whatever the working tree
+    state, scanning only the diff must stay quick and clean."""
+    proc = subprocess.run(
+        [sys.executable, "-m", "tools.photon_lint", "--changed"],
+        capture_output=True, text=True, cwd=REPO, timeout=60,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+def test_runner_never_imports_jax():
+    """Like bench.py --list-sections: the linter must work on a host where
+    importing jax would crash outright (pre-commit, device-free CI)."""
+    tripwire = (
+        "import builtins, sys\n"
+        "real = builtins.__import__\n"
+        "def guard(name, *a, **k):\n"
+        "    if name == 'jax' or name.startswith(('jax.', 'photon_ml_tpu')):\n"
+        "        raise RuntimeError(f'{name} imported by photon_lint')\n"
+        "    return real(name, *a, **k)\n"
+        "builtins.__import__ = guard\n"
+        "from tools.photon_lint.__main__ import main\n"
+        "sys.exit(main(['--list-rules']))\n"
+    )
+    proc = subprocess.run(
+        [sys.executable, "-c", tripwire],
+        capture_output=True, text=True, cwd=REPO, timeout=120,
+    )
+    assert proc.returncode == 0, proc.stderr
+    assert "fault-sites" in proc.stdout
+
+
+# ---------------------------------------------------------------------------
+# legacy CLI shims: same findings through the shared engine
+# ---------------------------------------------------------------------------
+
+
+def test_legacy_shims_clean_on_live_tree(capsys):
+    import lint_excepts
+    import lint_jit_sites
+
+    for shim in (lint_excepts, lint_jit_sites):
+        rc = shim.main([])
+        out = capsys.readouterr()
+        assert rc == 0, f"{shim.__name__}:\n{out.out}{out.err}"
+
+
+def test_legacy_check_source_api_parity():
+    import lint_excepts
+    import lint_jit_sites
+
+    bad = "try:\n    pass\nexcept:\n    pass\n"
+    legacy = list(lint_excepts.check_source("<test>", bad))
+    via_engine = engine.scan_source(bad, path="<test>", rule_names=["broad-except"])
+    assert [ln for ln, _ in legacy] == [f.line for f in via_engine] == [3]
+
+    bad_jit = "import jax\nf = jax.jit(lambda x: x)\n"
+    legacy = list(lint_jit_sites.check_source("<test>", bad_jit))
+    via_engine = engine.scan_source(bad_jit, path="<test>", rule_names=["jit-sites"])
+    assert [ln for ln, _ in legacy] == [f.line for f in via_engine] == [2]
+    # the ALLOWLIST is the engine's (single source of truth)
+    from tools.photon_lint.rules.jit_sites import ALLOWLIST
+
+    assert lint_jit_sites.ALLOWLIST is ALLOWLIST

@@ -50,7 +50,7 @@ def main() -> int:
         import jax
 
         dev = jax.devices()[0]
-    except Exception as e:  # bounded failure — claim released/never taken
+    except Exception as e:  # noqa: BLE001 — bounded failure: claim released/never taken
         print(f"probe failed cleanly: {type(e).__name__}: {e}", file=sys.stderr)
         return 1
     print(dev.platform)

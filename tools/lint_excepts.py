@@ -1,91 +1,49 @@
 #!/usr/bin/env python
-"""Fail on new bare ``except:`` / unjustified broad ``except Exception``.
+"""Back-compat shim: broad-except linting now lives in tools/photon_lint.
 
-Silent broad excepts are how this codebase once swallowed truncated Avro
-shards and half-written checkpoints; the resilience subsystem narrows the
-existing ones, and this linter keeps new ones out:
-
-  * bare ``except:`` is always an error;
-  * ``except Exception`` / ``except BaseException`` (bound or not, alone or
-    in a tuple) is an error unless the handler line carries a
-    ``# noqa: BLE001`` annotation with a justification comment.
-
-Usage::
-
-    python tools/lint_excepts.py [paths...]   # default: photon_ml_tpu/
-
-Exit status 1 when violations exist, listing each as path:line: message.
-Runs from pytest too (tests/test_lint_excepts.py), so tier-1 enforces it.
+``python tools/lint_excepts.py [paths...]`` (default: photon_ml_tpu/, the
+original CLI contract) reports exactly the findings of the shared-engine
+``broad-except`` rule — i.e. the same output as
+``python -m tools.photon_lint --rule broad-except photon_ml_tpu/``.
+See tools/photon_lint/rules/broad_except.py for the rule itself.
 """
 
 from __future__ import annotations
 
-import ast
 import os
 import sys
 from typing import Iterator, List, Tuple
 
-ALLOW_TAG = "noqa: BLE001"
-BROAD = ("Exception", "BaseException")
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
 
+from tools.photon_lint import engine  # noqa: E402
+from tools.photon_lint.rules.broad_except import (  # noqa: E402,F401
+    BROAD,
+    BroadExceptRule,
+)
 
-def _broad_names(node: ast.ExceptHandler) -> List[str]:
-    """Names in this handler's type expression that are too broad."""
-    if node.type is None:
-        return ["bare"]
-    exprs = node.type.elts if isinstance(node.type, ast.Tuple) else [node.type]
-    return [e.id for e in exprs if isinstance(e, ast.Name) and e.id in BROAD]
+RULE = "broad-except"
+ALLOW_TAG = "noqa: BLE001"  # legacy tag, still honored (justification required)
 
 
 def check_source(path: str, source: str) -> Iterator[Tuple[int, str]]:
-    try:
-        tree = ast.parse(source, filename=path)
-    except SyntaxError as e:
-        yield (e.lineno or 0, f"syntax error: {e.msg}")
-        return
-    lines = source.splitlines()
-    for node in ast.walk(tree):
-        if not isinstance(node, ast.ExceptHandler):
-            continue
-        broad = _broad_names(node)
-        if not broad:
-            continue
-        line = lines[node.lineno - 1] if node.lineno <= len(lines) else ""
-        if node.type is None:
-            yield (node.lineno, "bare 'except:' (catch specific exceptions)")
-        elif ALLOW_TAG not in line:
-            yield (
-                node.lineno,
-                f"broad 'except {'/'.join(broad)}' without '# {ALLOW_TAG} — "
-                "<justification>' (narrow it, or annotate why broad is right)",
-            )
+    """Legacy single-source API: (lineno, message) per violation."""
+    for f in engine.scan_source(source, path=path, rule_names=[RULE]):
+        yield (f.line, f.message)
 
 
-def iter_py_files(paths: List[str]) -> Iterator[str]:
-    for p in paths:
-        if os.path.isfile(p):
-            yield p
-            continue
-        for root, dirs, files in os.walk(p):
-            dirs[:] = [d for d in dirs if not d.startswith((".", "__pycache__"))]
-            for f in sorted(files):
-                if f.endswith(".py"):
-                    yield os.path.join(root, f)
+iter_py_files = engine.iter_py_files
 
 
 def main(argv: List[str]) -> int:
-    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-    paths = argv or [os.path.join(repo_root, "photon_ml_tpu")]
-    violations = []
-    for path in iter_py_files(paths):
-        with open(path, encoding="utf-8") as f:
-            source = f.read()
-        for lineno, msg in check_source(path, source):
-            violations.append(f"{os.path.relpath(path, repo_root)}:{lineno}: {msg}")
-    for v in violations:
-        print(v)
-    if violations:
-        print(f"\n{len(violations)} broad-except violation(s)", file=sys.stderr)
+    paths = argv or [os.path.join(_REPO, "photon_ml_tpu")]
+    findings, _ = engine.run(paths=paths, rule_names=[RULE], root=_REPO)
+    for f in findings:
+        print(f"{f.path}:{f.line}: {f.message}")
+    if findings:
+        print(f"\n{len(findings)} broad-except violation(s)", file=sys.stderr)
         return 1
     return 0
 

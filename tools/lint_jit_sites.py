@@ -1,255 +1,54 @@
 #!/usr/bin/env python
-"""Fail on new hot-path ``jax.jit`` sites missing donation/static annotations.
+"""Back-compat shim: jit-site linting now lives in tools/photon_lint.
 
-The compile-once layer (photon_ml_tpu/compile/) gives every hot-path jit
-site three things a bare ``jax.jit(fn)`` lacks: compile telemetry
-(``instrumented_jit``), buffer donation (``donate_argnums`` — in-place
-state updates instead of double-buffered peaks), and deliberate static
-annotations. This linter keeps NEW bare sites out:
-
-  * a ``jax.jit(...)`` / ``functools.partial(jax.jit, ...)`` call (incl.
-    decorator position) that passes NONE of donate_argnums/donate_argnames/
-    static_argnums/static_argnames is an error, unless
-  * the line carries ``# jit-ok: <why no donation/static applies>``, or
-  * the site is in the explicit ALLOWLIST below (pre-layer sites, each
-    with the reason donation does not apply — shrink it, don't grow it).
-
-``instrumented_jit`` calls are exempt by construction: the telemetry
-wrapper IS the annotation (donation rides through its kwargs).
-
-Usage::
-
-    python tools/lint_jit_sites.py [paths...]   # default: photon_ml_tpu/
-
-Exit status 1 when violations exist. Runs from pytest too
-(tests/test_lint_jit_sites.py), so tier-1 enforces it alongside
-tools/lint_excepts.py.
+``python tools/lint_jit_sites.py [paths...]`` (default: photon_ml_tpu/,
+the original CLI contract) reports exactly the findings of the
+shared-engine ``jit-sites`` rule — i.e. the same output as
+``python -m tools.photon_lint --rule jit-sites photon_ml_tpu/`` — bare
+jax.jit/pjit/named_call sites AND stale ALLOWLIST entries alike. The
+ALLOWLIST itself lives in tools/photon_lint/rules/jit_sites.py (imported
+here for back-compat).
 """
 
 from __future__ import annotations
 
-import ast
 import os
 import sys
 from typing import Iterator, List, Tuple
 
-ALLOW_TAG = "jit-ok:"
-ANNOTATION_KWARGS = {
-    "donate_argnums", "donate_argnames", "static_argnums", "static_argnames",
-}
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
 
-# Pre-compile-layer sites, keyed "relpath:qualname" with why donation /
-# statics genuinely do not apply. A site moved onto instrumented_jit (or
-# annotated in place) should be DELETED from here -- stale entries fail
-# the lint.
-ALLOWLIST = {
-    # the wrapper that ADDS the annotations (its inner jax.jit forwards
-    # whatever donate/static kwargs the caller passed)
-    "photon_ml_tpu/compile/stats.py:instrumented_jit": "instrumented_jit internals",
-    # scoring: coefficient/feature tensors are read-only and reused across
-    # every scored batch -- nothing to donate
-    "photon_ml_tpu/cli/game_scoring_driver.py:_get_re_gather": "read-only scoring gathers",
-    "photon_ml_tpu/cli/game_scoring_driver.py:_get_factored_contrib": "read-only scoring gathers",
-    "photon_ml_tpu/cli/game_scoring_driver.py:GameScoringDriver._score_device": "read-only scoring matvec",
-    # multihost coordinate helpers: inputs are multihost-sharded slabs a
-    # donation would tear; scores fold out-of-place by design
-    "photon_ml_tpu/cli/game_multihost_driver.py:MultihostFixedEffectCoordinate.__init__": "sharded slabs reused per update",
-    "photon_ml_tpu/cli/game_multihost_driver.py:MultihostFixedEffectCoordinate.score": "sharded slabs reused per update",
-    # streaming FE margin kernel: w and the chunk are both read-only (the
-    # chunk is reused by the pipelined H2D double-buffer)
-    "photon_ml_tpu/algorithm/streaming_fixed_effect.py:StreamingFixedEffectCoordinate.__post_init__": "w + chunk read-only",
-    # one-shot summarization / diagnostics passes (run once per driver)
-    "photon_ml_tpu/optim/streaming.py:streaming_summarize.partial": "one-shot colStats pass",
-    "photon_ml_tpu/bootstrap.py:bootstrap_train": "one-shot diagnostic solve",
-    "photon_ml_tpu/diagnostics/independence.py:analyze": "one-shot O(n^2) census",
-    # in-memory GLM training entry points: w0 is the caller's warm-start
-    # array, explicitly reused across the lambda grid
-    "photon_ml_tpu/training.py:train_glm_grid": "warm-start w0 reused across grid",
-    "photon_ml_tpu/training.py:train_glm_grid_vmapped": "lane-stacked w0 reused across lanes",
-    # fused-GLM kernels: oracle/compare paths whose inputs race both
-    # autotune variants -- donation would delete the buffers the losing
-    # variant still reads
-    "photon_ml_tpu/ops/fused_glm.py:_fused_fn.call": "autotune race shares inputs",
-    "photon_ml_tpu/ops/fused_glm.py:_fused_fn_manual.call": "autotune race shares inputs",
-    "photon_ml_tpu/ops/fused_glm.py:_time_value_and_grad": "bench-only race harness",
-    # parallel/: shard_map wrappers over mesh-sharded slabs reused across
-    # updates (the slabs ARE the dataset; donating them would tear it)
-    "photon_ml_tpu/parallel/perhost_ingest.py:PerHostRandomEffectSolver.update": "dataset slabs reused per update",
-    "photon_ml_tpu/parallel/perhost_ingest.py:PerHostRandomEffectSolver.score": "dataset slabs reused",
-    "photon_ml_tpu/parallel/perhost_ingest.py:PerHostBucketedRandomEffectSolver.update": "dataset slabs reused per update",
-    "photon_ml_tpu/parallel/perhost_ingest.py:PerHostBucketedRandomEffectSolver.score": "dataset slabs reused",
-    "photon_ml_tpu/parallel/shuffle.py:_collective_reduce": "one-shot ingest collective",
-    "photon_ml_tpu/parallel/shuffle.py:exchange_rows": "one-shot ingest collective",
-    "photon_ml_tpu/parallel/distributed.py:DistributedFixedEffectSolver._build": "dataset slabs reused per update",
-    "photon_ml_tpu/parallel/distributed.py:DistributedRandomEffectSolver._build": "dataset slabs reused per update",
-    "photon_ml_tpu/parallel/distributed.py:DistributedRandomEffectSolver.score": "dataset slabs reused",
-    "photon_ml_tpu/parallel/distributed.py:DistributedFactoredRandomEffectCoordinate._build": "dataset slabs reused per update",
-    "photon_ml_tpu/parallel/distributed.py:DistributedFactoredRandomEffectCoordinate.score": "dataset slabs reused",
-    "photon_ml_tpu/parallel/perhost_factored.py:PerHostFactoredRandomEffectCoordinate.update": "dataset slabs reused per update",
-    "photon_ml_tpu/parallel/perhost_factored.py:PerHostFactoredRandomEffectCoordinate.score": "dataset slabs reused",
-    "photon_ml_tpu/parallel/perhost_factored.py:PerHostFactoredRandomEffectCoordinate.regularization_term": "tiny v-term psum",
-    "photon_ml_tpu/parallel/perhost_factored.py:PerHostFactoredRandomEffectCoordinate.random_effect_coefficients": "read-only export",
-}
+from tools.photon_lint import engine  # noqa: E402
+from tools.photon_lint.rules.jit_sites import (  # noqa: E402,F401
+    ALLOWLIST,
+    ANNOTATION_KWARGS,
+    JitSitesRule,
+)
 
-
-def _is_jax_jit(node: ast.AST) -> bool:
-    """``jax.jit`` attribute reference."""
-    return (
-        isinstance(node, ast.Attribute)
-        and node.attr == "jit"
-        and isinstance(node.value, ast.Name)
-        and node.value.id == "jax"
-    )
-
-
-def _jit_call_annotated(call: ast.Call) -> bool:
-    return any(kw.arg in ANNOTATION_KWARGS for kw in call.keywords)
-
-
-def _qualname_map(tree: ast.AST) -> dict:
-    """id(node) -> dotted enclosing qualname ('<module>' at top level)."""
-    out = {}
-
-    def walk(node, qual):
-        for child in ast.iter_child_nodes(node):
-            if isinstance(
-                child, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)
-            ):
-                child_qual = (
-                    child.name if qual == "<module>" else f"{qual}.{child.name}"
-                )
-            else:
-                child_qual = qual
-            out[id(child)] = child_qual
-            walk(child, child_qual)
-
-    out[id(tree)] = "<module>"
-    walk(tree, "<module>")
-    return out
+RULE = "jit-sites"
+ALLOW_TAG = "jit-ok:"  # legacy tag, still honored (justification required)
 
 
 def check_source(path: str, source: str, relpath: str = "") -> Iterator[Tuple[int, str]]:
-    try:
-        tree = ast.parse(source, filename=path)
-    except SyntaxError as e:
-        yield (e.lineno or 0, f"syntax error: {e.msg}")
-        return
-    lines = source.splitlines()
-    quals = _qualname_map(tree)
-    relpath = relpath or path
-    for node in ast.walk(tree):
-        # bare @jax.jit decorator (no call, so never annotated)
-        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
-            for dec in node.decorator_list:
-                if not _is_jax_jit(dec):
-                    continue
-                line = lines[dec.lineno - 1] if dec.lineno <= len(lines) else ""
-                if ALLOW_TAG in line:
-                    continue
-                site = f"{relpath}:{quals.get(id(node), '<module>')}"
-                if site in ALLOWLIST:
-                    continue
-                yield (
-                    dec.lineno,
-                    f"bare @jax.jit at {site} — hot-path sites go through "
-                    "photon_ml_tpu.compile.instrumented_jit (telemetry + "
-                    "donate_argnums); for a genuinely read-only site add "
-                    f"'# {ALLOW_TAG} <reason>' or an ALLOWLIST entry",
-                )
-        if not isinstance(node, ast.Call):
-            continue
-        # jax.jit(...) directly, or functools.partial(jax.jit, ...)
-        if _is_jax_jit(node.func):
-            call = node
-        elif (
-            isinstance(node.func, ast.Attribute)
-            and node.func.attr == "partial"
-            and node.args
-            and _is_jax_jit(node.args[0])
-        ):
-            call = node
-        else:
-            continue
-        if _jit_call_annotated(call):
-            continue
-        line = lines[call.lineno - 1] if call.lineno <= len(lines) else ""
-        if ALLOW_TAG in line:
-            continue
-        # qualname of the INNERMOST enclosing def/class containing this call
-        site = f"{relpath}:{quals.get(id(node), '<module>')}"
-        if site in ALLOWLIST:
-            continue
-        yield (
-            call.lineno,
-            f"bare jax.jit at {site} — hot-path sites go through "
-            "photon_ml_tpu.compile.instrumented_jit (telemetry + "
-            "donate_argnums); for a genuinely read-only site add "
-            f"'# {ALLOW_TAG} <reason>' or an ALLOWLIST entry",
-        )
+    """Legacy single-source API: (lineno, message) per violation."""
+    for f in engine.scan_source(
+        source, path=path, relpath=relpath or path, rule_names=[RULE]
+    ):
+        yield (f.line, f.message)
 
 
-def iter_py_files(paths: List[str]) -> Iterator[str]:
-    for p in paths:
-        if os.path.isfile(p):
-            yield p
-            continue
-        for root, dirs, files in os.walk(p):
-            dirs[:] = [d for d in dirs if not d.startswith((".", "__pycache__"))]
-            for f in sorted(files):
-                if f.endswith(".py"):
-                    yield os.path.join(root, f)
+iter_py_files = engine.iter_py_files
 
 
 def main(argv: List[str]) -> int:
-    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-    paths = argv or [os.path.join(repo_root, "photon_ml_tpu")]
-    violations = []
-    for path in iter_py_files(paths):
-        with open(path, encoding="utf-8") as f:
-            source = f.read()
-        rel = os.path.relpath(path, repo_root)
-        for lineno, msg in check_source(path, source, rel):
-            violations.append(f"{rel}:{lineno}: {msg}")
-    for v in violations:
-        print(v)
-    if violations:
-        print(f"\n{len(violations)} bare-jit violation(s)", file=sys.stderr)
-        return 1
-    # stale allowlist entries are errors too: a migrated site must shrink
-    # the list, or it silently stops protecting anything
-    live = set()
-    for path in iter_py_files(paths):
-        with open(path, encoding="utf-8") as f:
-            src = f.read()
-        rel = os.path.relpath(path, repo_root)
-        try:
-            tree = ast.parse(src)
-        except SyntaxError:
-            continue
-        quals = _qualname_map(tree)
-        for node in ast.walk(tree):
-            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) and any(
-                _is_jax_jit(dec) for dec in node.decorator_list
-            ):
-                live.add(f"{rel}:{quals.get(id(node), '<module>')}")
-            if isinstance(node, ast.Call) and (
-                _is_jax_jit(node.func)
-                or (
-                    isinstance(node.func, ast.Attribute)
-                    and node.func.attr == "partial"
-                    and node.args
-                    and _is_jax_jit(node.args[0])
-                )
-            ):
-                live.add(f"{rel}:{quals.get(id(node), '<module>')}")
-    stale = [k for k in ALLOWLIST if k.split(":")[0].startswith("photon_ml_tpu")
-             and k not in live
-             and any(k.split(":")[0] == os.path.relpath(p, repo_root)
-                     for p in iter_py_files(paths))]
-    if stale:
-        for k in stale:
-            print(f"stale ALLOWLIST entry (no bare jax.jit there anymore): {k}")
-        print(f"\n{len(stale)} stale allowlist entr(ies)", file=sys.stderr)
+    paths = argv or [os.path.join(_REPO, "photon_ml_tpu")]
+    findings, _ = engine.run(paths=paths, rule_names=[RULE], root=_REPO)
+    for f in findings:
+        print(f"{f.path}:{f.line}: {f.message}")
+    if findings:
+        print(f"\n{len(findings)} jit-site violation(s)", file=sys.stderr)
         return 1
     return 0
 

@@ -1,0 +1,23 @@
+"""photon-lint: static analysis for this repo's JAX invariants.
+
+One shared AST scan engine (:mod:`tools.photon_lint.engine`) + pluggable
+rules (:mod:`tools.photon_lint.rules`), each encoding a bug class PRs 1-7
+found and fixed by hand. Run everything with::
+
+    python -m tools.photon_lint               # full default scope
+    python -m tools.photon_lint --rule NAME   # one rule
+    python -m tools.photon_lint --changed     # git-diff-scoped (pre-commit)
+    python -m tools.photon_lint --json        # machine-readable findings
+
+Exit codes: 0 clean, 1 findings, 2 usage error.
+"""
+
+from tools.photon_lint.engine import (  # noqa: F401 (public API)
+    DEFAULT_SCOPE,
+    Finding,
+    Rule,
+    ScanFile,
+    iter_py_files,
+    run,
+    scan_source,
+)

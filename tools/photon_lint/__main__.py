@@ -1,0 +1,141 @@
+"""``python -m tools.photon_lint`` — the unified lint runner."""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+from typing import List, Optional, Sequence
+
+# allow `python tools/photon_lint/__main__.py` too (repo root on sys.path)
+_REPO = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+from tools.photon_lint import engine  # noqa: E402
+from tools.photon_lint.rules import RULES  # noqa: E402
+
+
+def scope_filter(names: Sequence[str], root: str) -> List[str]:
+    """Changed-file names (repo-relative) restricted to the default scan
+    scope: existing .py files under photon_ml_tpu/ or tools/, or bench.py."""
+    out: List[str] = []
+    for name in names:
+        name = name.strip().replace(os.sep, "/")
+        if not name.endswith(".py"):
+            continue
+        top = name.split("/", 1)[0]
+        if not (name == "bench.py" or top in ("photon_ml_tpu", "tools")):
+            continue
+        path = os.path.join(root, name)
+        if os.path.isfile(path):
+            out.append(path)
+    return out
+
+
+def changed_paths(root: str) -> List[str]:
+    """Working-tree changes vs HEAD (staged + unstaged + untracked)."""
+    names: List[str] = []
+    for cmd in (
+        ["git", "diff", "--name-only", "HEAD"],
+        ["git", "ls-files", "--others", "--exclude-standard"],
+    ):
+        proc = subprocess.run(
+            cmd, cwd=root, capture_output=True, text=True, timeout=30
+        )
+        if proc.returncode == 0:
+            names.extend(proc.stdout.splitlines())
+    return scope_filter(sorted(set(names)), root)
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m tools.photon_lint",
+        description="Static analysis for this repo's JAX invariants.",
+    )
+    parser.add_argument(
+        "paths", nargs="*",
+        help="files/dirs to scan (default: photon_ml_tpu/ tools/ bench.py)",
+    )
+    parser.add_argument(
+        "--rule", action="append", dest="rules", metavar="NAME",
+        help="run only this rule (repeatable)",
+    )
+    parser.add_argument(
+        "--json", action="store_true", help="machine-readable findings"
+    )
+    parser.add_argument(
+        "--changed", action="store_true",
+        help="scan only files changed vs HEAD (pre-commit speed; skips "
+        "cross-file unused-registry checks)",
+    )
+    parser.add_argument(
+        "--list-rules", action="store_true", help="list rules and exit"
+    )
+    args = parser.parse_args(argv)
+    root = engine.repo_root()
+
+    if args.list_rules:
+        for name, cls in RULES.items():
+            print(f"{name}: {cls.description}")
+        print(
+            f"{engine.SUPPRESSION_RULE}: (engine) suppression tags need a "
+            "known rule name and a justification"
+        )
+        return 0
+
+    if args.rules:
+        unknown = [r for r in args.rules if r not in RULES]
+        if unknown:
+            print(
+                f"unknown rule(s): {', '.join(unknown)} "
+                f"(known: {', '.join(RULES)})",
+                file=sys.stderr,
+            )
+            return 2
+
+    paths: Optional[Sequence[str]] = args.paths or None
+    if args.changed:
+        if args.paths:
+            print("--changed and explicit paths are exclusive", file=sys.stderr)
+            return 2
+        paths = changed_paths(root)
+        if not paths:
+            if not args.json:
+                print("photon-lint: no changed files in scan scope", file=sys.stderr)
+            else:
+                print(json.dumps({
+                    "version": 1, "files_scanned": 0, "findings": [],
+                    "counts": {}, "rules": list(RULES) + [engine.SUPPRESSION_RULE],
+                }))
+            return 0
+
+    findings, stats = engine.run(paths=paths, rule_names=args.rules, root=root)
+
+    if args.json:
+        counts: dict = {}
+        for f in findings:
+            counts[f.rule] = counts.get(f.rule, 0) + 1
+        print(json.dumps({
+            "version": 1,
+            "files_scanned": stats["files_scanned"],
+            "rules": stats["rules"],
+            "findings": [f.to_json() for f in findings],
+            "counts": counts,
+        }, indent=2))
+    else:
+        for f in findings:
+            print(f)
+        if findings:
+            print(
+                f"\nphoton-lint: {len(findings)} finding(s) across "
+                f"{stats['files_scanned']} file(s)",
+                file=sys.stderr,
+            )
+    return 1 if findings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

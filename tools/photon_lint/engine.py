@@ -1,0 +1,422 @@
+"""Shared AST scan engine for photon-lint.
+
+One ``ast.parse`` per file, shared by every rule; findings are
+``(rule, path, line, message)``; suppression tags carry MANDATORY
+justifications; allowlists fail on stale entries. Rules are small classes
+(see :mod:`tools.photon_lint.rules`) plugged into :data:`RULES`.
+
+Suppression-tag grammar (validated — a malformed tag is itself a finding
+under the engine-level ``suppression`` rule)::
+
+    # lint: <rule>[, <rule>...] — <justification>
+
+``--`` is accepted in place of the em-dash; the justification must be
+non-empty. A rule may additionally honor a legacy tag (``# noqa: BLE001``
+for ``broad-except``, ``# jit-ok:`` for ``jit-sites``), with the same
+justification requirement. Tags are matched against real comments
+(``tokenize``), never string literals. A tag suppresses a finding when it
+sits on any line of the finding's span (for multi-line ``except`` clauses
+the span covers the whole handler-type expression).
+
+The engine imports nothing heavier than the stdlib — in particular no jax
+and no photon_ml_tpu — so ``python -m tools.photon_lint`` works on a
+device-free host and is fast enough for a pre-commit hook.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import io
+import os
+import re
+import tokenize
+from typing import Dict, Iterable, Iterator, List, Optional, Sequence, Set, Tuple
+
+__all__ = [
+    "Finding",
+    "RawFinding",
+    "Rule",
+    "ScanFile",
+    "DEFAULT_SCOPE",
+    "iter_py_files",
+    "qualname_map",
+    "repo_root",
+    "run",
+    "scan_source",
+]
+
+#: Default scan scope, relative to the repo root.
+DEFAULT_SCOPE = ("photon_ml_tpu", "tools", "bench.py")
+
+#: Engine-level pseudo-rule name for suppression-tag grammar findings.
+SUPPRESSION_RULE = "suppression"
+
+_TAG_RE = re.compile(
+    r"#\s*lint:\s*(?P<rules>[A-Za-z0-9_-]+(?:\s*,\s*[A-Za-z0-9_-]+)*)"
+    r"(?:\s*(?:—|--)\s*(?P<why>.*?))?\s*$"
+)
+_TAG_PREFIX_RE = re.compile(r"#\s*lint:")
+
+
+def repo_root() -> str:
+    """The repository root (two levels above this file)."""
+    return os.path.dirname(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    )
+
+
+@dataclasses.dataclass
+class Finding:
+    """One reported violation."""
+
+    rule: str
+    path: str  # repo-relative
+    line: int
+    message: str
+
+    def __str__(self) -> str:
+        return f"{self.path}:{self.line}: [{self.rule}] {self.message}"
+
+    def to_json(self) -> Dict[str, object]:
+        return dataclasses.asdict(self)
+
+
+#: What rules yield from ``check``: (lineno, message) or
+#: (lineno, message, span_linenos) — the span is every line a suppression
+#: tag may legally sit on (defaults to just the finding line).
+RawFinding = Tuple
+
+
+class ScanFile:
+    """One source file, parsed exactly once and shared by every rule."""
+
+    def __init__(self, path: str, relpath: str, source: str):
+        self.path = path
+        self.relpath = relpath.replace(os.sep, "/")
+        self.source = source
+        self.lines = source.splitlines()
+        self.error: Optional[SyntaxError] = None
+        try:
+            self.tree: Optional[ast.AST] = ast.parse(source, filename=path)
+        except SyntaxError as e:
+            self.tree = None
+            self.error = e
+        self._comments: Optional[Dict[int, str]] = None
+        self._qualnames: Optional[Dict[int, str]] = None
+
+    def line(self, lineno: int) -> str:
+        if 1 <= lineno <= len(self.lines):
+            return self.lines[lineno - 1]
+        return ""
+
+    @property
+    def comments(self) -> Dict[int, str]:
+        """lineno -> comment text (including '#'), via tokenize — tags in
+        string literals never count."""
+        if self._comments is None:
+            out: Dict[int, str] = {}
+            try:
+                for tok in tokenize.generate_tokens(
+                    io.StringIO(self.source).readline
+                ):
+                    if tok.type == tokenize.COMMENT:
+                        out[tok.start[0]] = tok.string
+            except (tokenize.TokenError, IndentationError, SyntaxError):
+                pass
+            self._comments = out
+        return self._comments
+
+    @property
+    def qualnames(self) -> Dict[int, str]:
+        """id(node) -> dotted enclosing qualname (lazy, computed once)."""
+        if self._qualnames is None:
+            self._qualnames = (
+                qualname_map(self.tree) if self.tree is not None else {}
+            )
+        return self._qualnames
+
+
+class Rule:
+    """Base class for pluggable checkers.
+
+    Subclasses set ``name``/``description`` (and optionally
+    ``legacy_tag``), implement ``check(scan)`` yielding
+    :data:`RawFinding` tuples, and may implement ``finalize(full_scope)``
+    for cross-file checks (allowlist staleness, unused registry entries).
+    Instances are per-run: accumulating state across ``check`` calls and
+    reporting it from ``finalize`` is the intended pattern.
+    """
+
+    name: str = ""
+    description: str = ""
+    #: Legacy suppression tag additionally honored (e.g. "noqa: BLE001").
+    legacy_tag: Optional[str] = None
+
+    def __init__(self, root: Optional[str] = None):
+        self.root = root or repo_root()
+
+    def scope(self, relpath: str) -> bool:
+        """Whether this rule applies to ``relpath`` (repo-relative)."""
+        return True
+
+    def check(self, scan: ScanFile) -> Iterator[RawFinding]:
+        raise NotImplementedError
+
+    def finalize(self, full_scope: bool) -> Iterator[Tuple[str, int, str]]:
+        """Cross-file findings as (relpath, lineno, message)."""
+        return iter(())
+
+
+# ---------------------------------------------------------------------------
+# suppression tags
+# ---------------------------------------------------------------------------
+
+
+def _parse_tag(comment: str) -> Optional[Tuple[List[str], str]]:
+    """A ``# lint:`` comment -> (rule names, justification) or None when
+    the comment carries no lint tag at all."""
+    m = _TAG_RE.search(comment)
+    if m is None:
+        return None
+    rules = [r.strip() for r in m.group("rules").split(",")]
+    return rules, (m.group("why") or "").strip()
+
+
+def _legacy_justification(comment: str, tag: str) -> Optional[str]:
+    """Justification text following a legacy ``tag`` in ``comment``, or
+    None when the tag is absent."""
+    idx = comment.find(tag)
+    if idx < 0:
+        return None
+    return comment[idx + len(tag):].strip().lstrip("—-:").strip()
+
+
+def _suppressed(
+    scan: ScanFile, rule: Rule, span: Iterable[int]
+) -> bool:
+    """True when a JUSTIFIED tag for ``rule`` sits on any line of
+    ``span``. Unjustified tags never suppress (and are reported by
+    :func:`_tag_findings`)."""
+    for lineno in span:
+        comment = scan.comments.get(lineno)
+        if not comment:
+            continue
+        parsed = _parse_tag(comment)
+        if parsed is not None:
+            names, why = parsed
+            if rule.name in names and why:
+                return True
+        if rule.legacy_tag is not None:
+            why = _legacy_justification(comment, rule.legacy_tag)
+            if why is not None and why:
+                return True
+    return False
+
+
+def _tag_findings(
+    scan: ScanFile, active_rules: Sequence[Rule], known_names: Set[str]
+) -> Iterator[Finding]:
+    """Validate suppression-tag grammar: a tag without a justification or
+    naming an unknown rule is itself a finding."""
+    legacy = {r.legacy_tag: r.name for r in active_rules if r.legacy_tag}
+    # cheap substring probe before paying for tokenize: most files carry
+    # no tags at all (this is the difference between a ~6s and a ~2s scan)
+    if "lint:" not in scan.source and not any(
+        tag in scan.source for tag in legacy
+    ):
+        return
+    for lineno, comment in sorted(scan.comments.items()):
+        if _TAG_PREFIX_RE.search(comment):
+            parsed = _parse_tag(comment)
+            if parsed is None:
+                yield Finding(
+                    SUPPRESSION_RULE, scan.relpath, lineno,
+                    "malformed lint tag (want '# lint: <rule>[, <rule>] "
+                    "— <justification>')",
+                )
+                continue
+            names, why = parsed
+            for name in names:
+                if name not in known_names:
+                    yield Finding(
+                        SUPPRESSION_RULE, scan.relpath, lineno,
+                        f"lint tag names unknown rule {name!r}",
+                    )
+            if not why:
+                yield Finding(
+                    SUPPRESSION_RULE, scan.relpath, lineno,
+                    "lint tag lacks a justification (suppressions must say "
+                    "WHY: '# lint: <rule> — <justification>')",
+                )
+        for tag, rule_name in legacy.items():
+            why = _legacy_justification(comment, tag)
+            if why is not None and not why:
+                yield Finding(
+                    SUPPRESSION_RULE, scan.relpath, lineno,
+                    f"legacy '# {tag}' tag lacks a justification "
+                    f"(rule {rule_name!r} requires one)",
+                )
+
+
+# ---------------------------------------------------------------------------
+# helpers shared by rules
+# ---------------------------------------------------------------------------
+
+
+def qualname_map(tree: ast.AST) -> Dict[int, str]:
+    """id(node) -> dotted enclosing qualname ('<module>' at top level)."""
+    out: Dict[int, str] = {}
+
+    def walk(node: ast.AST, qual: str) -> None:
+        for child in ast.iter_child_nodes(node):
+            if isinstance(
+                child, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)
+            ):
+                child_qual = (
+                    child.name if qual == "<module>" else f"{qual}.{child.name}"
+                )
+            else:
+                child_qual = qual
+            out[id(child)] = child_qual
+            walk(child, child_qual)
+
+    out[id(tree)] = "<module>"
+    walk(tree, "<module>")
+    return out
+
+
+def iter_py_files(paths: Iterable[str]) -> Iterator[str]:
+    """Every .py file under ``paths`` (files pass through; dot/__pycache__
+    directories are pruned), in sorted walk order."""
+    for p in paths:
+        if os.path.isfile(p):
+            yield p
+            continue
+        for root, dirs, files in os.walk(p):
+            dirs[:] = [
+                d for d in dirs if not d.startswith((".", "__pycache__"))
+            ]
+            for f in sorted(files):
+                if f.endswith(".py"):
+                    yield os.path.join(root, f)
+
+
+# ---------------------------------------------------------------------------
+# the scan loop
+# ---------------------------------------------------------------------------
+
+
+def _rule_registry() -> Dict[str, type]:
+    from tools.photon_lint.rules import RULES
+
+    return RULES
+
+
+def known_rule_names() -> Set[str]:
+    return set(_rule_registry()) | {SUPPRESSION_RULE}
+
+
+def _normalize(raw: RawFinding) -> Tuple[int, str, List[int]]:
+    if len(raw) == 2:
+        lineno, message = raw
+        span = [lineno]
+    else:
+        lineno, message, span = raw
+        span = list(span)
+    return lineno, message, span
+
+
+def _scan_one(scan: ScanFile, rules: Sequence[Rule]) -> List[Finding]:
+    findings = list(_tag_findings(scan, rules, known_rule_names()))
+    if scan.tree is None:
+        findings.append(
+            Finding(
+                "parse", scan.relpath,
+                (scan.error.lineno or 0) if scan.error else 0,
+                f"syntax error: {scan.error.msg if scan.error else '?'}",
+            )
+        )
+        return findings
+    for rule in rules:
+        if not rule.scope(scan.relpath):
+            continue
+        for raw in rule.check(scan):
+            lineno, message, span = _normalize(raw)
+            if _suppressed(scan, rule, span):
+                continue
+            findings.append(Finding(rule.name, scan.relpath, lineno, message))
+    return findings
+
+
+def _instantiate(
+    rule_names: Optional[Sequence[str]], root: str
+) -> List[Rule]:
+    registry = _rule_registry()
+    if rule_names is None:
+        names = list(registry)
+    else:
+        unknown = [n for n in rule_names if n not in registry]
+        if unknown:
+            raise KeyError(
+                f"unknown rule(s) {unknown} — known: {sorted(registry)}"
+            )
+        names = list(dict.fromkeys(rule_names))
+    return [registry[n](root=root) for n in names]
+
+
+def scan_source(
+    source: str,
+    path: str = "<memory>",
+    relpath: Optional[str] = None,
+    rules: Optional[Sequence[Rule]] = None,
+    rule_names: Optional[Sequence[str]] = None,
+    root: Optional[str] = None,
+    finalize: bool = False,
+) -> List[Finding]:
+    """Scan a single in-memory source (fixture tests, legacy shims)."""
+    root = root or repo_root()
+    if rules is None:
+        rules = _instantiate(rule_names, root)
+    scan = ScanFile(path, relpath or path, source)
+    findings = _scan_one(scan, rules)
+    if finalize:
+        for rule in rules:
+            for rel, lineno, message in rule.finalize(False):
+                findings.append(Finding(rule.name, rel, lineno, message))
+    return findings
+
+
+def run(
+    paths: Optional[Sequence[str]] = None,
+    rule_names: Optional[Sequence[str]] = None,
+    root: Optional[str] = None,
+) -> Tuple[List[Finding], Dict[str, object]]:
+    """Scan ``paths`` (default: the full DEFAULT_SCOPE) with the selected
+    rules. Returns (findings, stats). Cross-file finalize checks that need
+    the whole tree (unused registry entries) only run on a full-scope scan;
+    per-file ones (allowlist staleness) always run."""
+    root = root or repo_root()
+    full_scope = paths is None
+    if paths is None:
+        paths = [os.path.join(root, p) for p in DEFAULT_SCOPE]
+    rules = _instantiate(rule_names, root)
+    findings: List[Finding] = []
+    files_scanned = 0
+    for path in iter_py_files(paths):
+        with open(path, encoding="utf-8") as f:
+            source = f.read()
+        relpath = os.path.relpath(os.path.abspath(path), root)
+        scan = ScanFile(path, relpath, source)
+        files_scanned += 1
+        findings.extend(_scan_one(scan, rules))
+    for rule in rules:
+        for rel, lineno, message in rule.finalize(full_scope):
+            findings.append(Finding(rule.name, rel, lineno, message))
+    findings.sort(key=lambda f: (f.path, f.line, f.rule))
+    stats = {
+        "files_scanned": files_scanned,
+        "rules": [r.name for r in rules] + [SUPPRESSION_RULE],
+        "full_scope": full_scope,
+    }
+    return findings, stats

@@ -1,0 +1,33 @@
+"""photon-lint rule registry.
+
+Every rule encodes an invariant a real PR bug-hunted by hand (the
+motivating incident is in each rule's module docstring and the README
+"Static analysis" table). Adding a rule = subclass
+:class:`tools.photon_lint.engine.Rule`, register the class here.
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+from tools.photon_lint.rules.broad_except import BroadExceptRule
+from tools.photon_lint.rules.jit_sites import JitSitesRule
+from tools.photon_lint.rules.traced_construction import TracedConstructionRule
+from tools.photon_lint.rules.bitwise_reduction import BitwiseReductionRule
+from tools.photon_lint.rules.static_key import StaticKeyRule
+from tools.photon_lint.rules.fault_sites import FaultSitesRule
+
+#: name -> rule class, in report order.
+RULES: Dict[str, type] = {
+    cls.name: cls
+    for cls in (
+        BroadExceptRule,
+        JitSitesRule,
+        TracedConstructionRule,
+        BitwiseReductionRule,
+        StaticKeyRule,
+        FaultSitesRule,
+    )
+}
+
+__all__ = ["RULES"]

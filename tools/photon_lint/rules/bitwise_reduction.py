@@ -1,0 +1,121 @@
+"""bitwise-reduction: slab batch-axis reductions go through tree_row_sum.
+
+Motivating incident (PR 7): XLA reassociates a plain ``reduce`` per
+fusion context — the SAME (M,) loss vector summed to values one ulp apart
+inside vs outside the streaming-block jit, which flipped an LBFGS line
+search at iteration 5 and broke the bitwise-equality gate every
+optimization in this repo is held to. The fix is a fixed-association
+pairwise tree (``ops.fused_sparse.tree_row_sum`` / the generic
+``ops.objective._row_sum``) whose adds XLA executes exactly as written.
+
+Scope: ``ops/`` and ``optim/`` (the solver arithmetic). Flagged: any
+``jnp.sum`` / ``jnp.nansum`` / ``lax.reduce`` / ``.sum(...)`` call that
+reduces the leading (batch/row) axis — no axis, ``axis=None``,
+``axis=0``, a tuple containing 0, or a non-literal axis. Row-local
+reductions (``axis=-1`` / ``axis=1``) are exempt, as are the bodies of
+``tree_row_sum`` / ``_row_sum`` themselves (they ARE the blessed
+implementation). Everything else either routes through the tree reduce or
+carries ``# lint: bitwise-reduction — <why this reduction is not on the
+solver's bitwise-gated path>``.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator, Optional
+
+from tools.photon_lint.engine import RawFinding, Rule, ScanFile
+
+#: Functions that ARE the fixed-association implementation.
+BLESSED_DEFS = {"tree_row_sum", "_row_sum"}
+
+_SCOPE_SEGMENTS = {"ops", "optim"}
+
+
+def _axis_flags(call: ast.Call, axis_pos: Optional[int]) -> bool:
+    """True when the reduction covers the leading axis (or we cannot tell)."""
+    axis: ast.AST = ast.Constant(value=None)
+    found = False
+    for kw in call.keywords:
+        if kw.arg == "axis":
+            axis = kw.value
+            found = True
+    if not found and axis_pos is not None and len(call.args) > axis_pos:
+        axis = call.args[axis_pos]
+        found = True
+    if isinstance(axis, ast.Constant):
+        if axis.value is None:
+            return True  # full reduce (incl. the implicit default)
+        if isinstance(axis.value, int):
+            return axis.value == 0
+        return True
+    if isinstance(axis, ast.Tuple):
+        for el in axis.elts:
+            if isinstance(el, ast.Constant) and el.value == 0:
+                return True
+        return any(not isinstance(el, ast.Constant) for el in axis.elts)
+    if isinstance(axis, ast.UnaryOp):
+        # negative literals parse as UnaryOp(USub, Constant). Only -1 (the
+        # within-row axis by repo convention) is exempt: -2 on a 2-D (M,D)
+        # slab IS the leading batch axis, and ndim is unknowable statically
+        return not (
+            isinstance(axis.op, ast.USub)
+            and isinstance(axis.operand, ast.Constant)
+            and axis.operand.value == 1
+        )
+    return True  # non-literal axis: conservatively flag (tag to justify)
+
+
+class BitwiseReductionRule(Rule):
+    name = "bitwise-reduction"
+    description = (
+        "bare jnp.sum/.sum/lax.reduce over slab batch axes in ops//optim/ "
+        "(PR 7: reassociated reduces flip line searches; use tree_row_sum)"
+    )
+
+    def scope(self, relpath: str) -> bool:
+        parts = relpath.split("/")
+        return any(p in _SCOPE_SEGMENTS for p in parts[:-1])
+
+    def check(self, scan: ScanFile) -> Iterator[RawFinding]:
+        if "sum" not in scan.source and "reduce" not in scan.source:
+            return
+        quals = scan.qualnames
+        for node in ast.walk(scan.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            func = node.func
+            if not isinstance(func, ast.Attribute):
+                continue
+            qual = quals.get(id(node), "<module>")
+            if qual.split(".")[-1] in BLESSED_DEFS:
+                continue
+            base = func.value.id if isinstance(func.value, ast.Name) else ""
+            kind = None
+            if func.attr in ("sum", "nansum") and base == "jnp":
+                if _axis_flags(node, axis_pos=1):
+                    kind = f"jnp.{func.attr}"
+            elif func.attr == "reduce" and (
+                base == "lax"
+                or (
+                    isinstance(func.value, ast.Attribute)
+                    and func.value.attr == "lax"
+                )
+            ):
+                kind = "lax.reduce"  # accumulation order backend-internal
+            elif func.attr == "sum" and base not in ("np", "numpy", "math", "jnp"):
+                # array.sum(...) method form
+                if _axis_flags(node, axis_pos=0):
+                    kind = ".sum()"
+            if kind is None:
+                continue
+            span = list(range(node.lineno, (node.end_lineno or node.lineno) + 1))
+            yield (
+                node.lineno,
+                f"{kind} over a leading/whole slab axis in {qual} — a plain "
+                "reduce's accumulation order changes with fusion context "
+                "(one-ulp drift flips line searches); route through "
+                "tree_row_sum/_row_sum, or justify with "
+                "'# lint: bitwise-reduction — <why>'",
+                span,
+            )

@@ -1,0 +1,77 @@
+"""broad-except: no bare/unjustified broad exception handlers.
+
+Motivating incident (PR 1): silent ``except Exception`` blocks swallowed
+truncated Avro shards and half-written checkpoints; the resilience
+subsystem narrowed them all, and this rule keeps new ones out.
+
+  * bare ``except:`` is always an error;
+  * ``except Exception`` / ``except BaseException`` — as a bare name OR an
+    attribute (``builtins.Exception``), bound or not, alone or in a tuple —
+    is an error unless justified via ``# lint: broad-except — <why>`` or
+    the legacy ``# noqa: BLE001 — <why>`` tag. The tag may sit on ANY line
+    of the handler-type clause (multi-line tuples included).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator, List
+
+from tools.photon_lint.engine import RawFinding, Rule, ScanFile
+
+BROAD = ("Exception", "BaseException")
+
+
+def _broad_names(node: ast.ExceptHandler) -> List[str]:
+    """Display names of too-broad types in this handler's type expression
+    (handles ``Exception`` and ``builtins.Exception`` spellings)."""
+    if node.type is None:
+        return ["bare"]
+    exprs = node.type.elts if isinstance(node.type, ast.Tuple) else [node.type]
+    out: List[str] = []
+    for e in exprs:
+        if isinstance(e, ast.Name) and e.id in BROAD:
+            out.append(e.id)
+        elif isinstance(e, ast.Attribute) and e.attr in BROAD:
+            base = e.value.id if isinstance(e.value, ast.Name) else "?"
+            out.append(f"{base}.{e.attr}")
+    return out
+
+
+class BroadExceptRule(Rule):
+    name = "broad-except"
+    description = (
+        "bare 'except:' / unjustified broad 'except Exception' handlers "
+        "(PR 1: silent excepts swallowed truncated Avro shards)"
+    )
+    legacy_tag = "noqa: BLE001"
+
+    def check(self, scan: ScanFile) -> Iterator[RawFinding]:
+        if "except" not in scan.source:
+            return
+        for node in ast.walk(scan.tree):
+            if not isinstance(node, ast.ExceptHandler):
+                continue
+            broad = _broad_names(node)
+            if not broad:
+                continue
+            if node.type is None:
+                # EMPTY suppression span: bare 'except:' is always an
+                # error — no tag can justify it (legacy parity)
+                yield (
+                    node.lineno,
+                    "bare 'except:' (catch specific exceptions)",
+                    [],
+                )
+                continue
+            # the suppression tag may sit on any line of the (possibly
+            # multi-line) handler-type clause
+            end = getattr(node.type, "end_lineno", None) or node.lineno
+            span = list(range(node.lineno, max(end, node.lineno) + 1))
+            yield (
+                node.lineno,
+                f"broad 'except {'/'.join(broad)}' without justification "
+                "(narrow it, or annotate why broad is right: "
+                "'# lint: broad-except — <why>' / '# noqa: BLE001 — <why>')",
+                span,
+            )

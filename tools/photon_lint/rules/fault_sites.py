@@ -1,0 +1,178 @@
+"""fault-sites: every fault/preemption site string is registered.
+
+Motivating incident (PRs 1+5): chaos plans (``PHOTON_FAULTS`` /
+``PHOTON_PREEMPT_AT``) are written against site NAMES; a typo'd or
+unregistered site at an injection point silently never fires, and a
+registry entry whose call site was refactored away leaves chaos tests
+asserting against dead surface. Both directions are enforced against the
+central registry, :mod:`photon_ml_tpu.resilience.sites`:
+
+  * every string literal passed to ``faults.inject`` / ``faults.corrupt``
+    / ``faults.flag`` must be a key of ``FAULT_SITES``; every
+    ``preemption.check`` site must be in ``PREEMPT_SITES``;
+  * a non-literal site argument is flagged (the registry cannot vouch for
+    a runtime-computed name) — suppress with a tag if genuinely dynamic;
+  * a registry entry with NO call site anywhere in the scan scope fails
+    (reported in finalize, full-scope scans only).
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from typing import Dict, Iterator, Optional, Set, Tuple
+
+from tools.photon_lint.engine import RawFinding, Rule, ScanFile
+
+REGISTRY_RELPATH = "photon_ml_tpu/resilience/sites.py"
+
+_FAULT_FUNCS = {"inject", "corrupt", "flag"}
+_FAULT_MODULES = {"faults", "_faults"}
+_PREEMPT_MODULES = {"preemption", "_preemption"}
+
+
+def _load_registry(root: str) -> Tuple[Dict[str, int], Dict[str, int], Optional[str]]:
+    """Parse the registry module with ast only (no package import):
+    returns ({fault site -> def lineno}, {preempt site -> lineno}, error)."""
+    path = os.path.join(root, REGISTRY_RELPATH)
+    try:
+        with open(path, encoding="utf-8") as f:
+            tree = ast.parse(f.read(), filename=path)
+    except (OSError, SyntaxError) as e:
+        return {}, {}, f"cannot load site registry {REGISTRY_RELPATH}: {e}"
+    faults: Dict[str, int] = {}
+    preempt: Dict[str, int] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign) and len(node.targets) == 1:
+            target = node.targets[0]
+        elif isinstance(node, ast.AnnAssign) and node.value is not None:
+            target = node.target
+        else:
+            continue
+        if not isinstance(target, ast.Name):
+            continue
+        if target.id == "FAULT_SITES" and isinstance(node.value, ast.Dict):
+            for key in node.value.keys:
+                if isinstance(key, ast.Constant) and isinstance(key.value, str):
+                    faults[key.value] = key.lineno
+        elif target.id == "PREEMPT_SITES" and isinstance(
+            node.value, (ast.Tuple, ast.List)
+        ):
+            for el in node.value.elts:
+                if isinstance(el, ast.Constant) and isinstance(el.value, str):
+                    preempt[el.value] = el.lineno
+    if not faults:
+        return faults, preempt, f"{REGISTRY_RELPATH} defines no FAULT_SITES"
+    return faults, preempt, None
+
+
+class FaultSitesRule(Rule):
+    name = "fault-sites"
+    description = (
+        "fault-injection / preemption site strings must exist in "
+        "photon_ml_tpu/resilience/sites.py; unused registry entries fail"
+    )
+
+    def __init__(self, root=None, fault_sites=None, preempt_sites=None):
+        super().__init__(root)
+        if fault_sites is None and preempt_sites is None:
+            self._fault_sites, self._preempt_sites, self._error = _load_registry(
+                self.root
+            )
+        else:
+            self._fault_sites = dict(fault_sites or {})
+            self._preempt_sites = dict(preempt_sites or {})
+            self._error = None
+        self._error_reported = False
+        self._used_faults: Set[str] = set()
+        self._used_preempt: Set[str] = set()
+
+    def check(self, scan: ScanFile) -> Iterator[RawFinding]:
+        if self._error is not None:
+            if not self._error_reported:
+                self._error_reported = True
+                yield (0, self._error)
+            return
+        # identifier probe: every matchable call mentions one of these
+        if not any(
+            probe in scan.source
+            for probe in ("faults", "preemption", "inject", "corrupt")
+        ):
+            return
+        # from-import tracking: `from ...faults import inject` etc.
+        bare_fault: Set[str] = set()
+        bare_preempt: Set[str] = set()
+        for node in ast.walk(scan.tree):
+            if isinstance(node, ast.ImportFrom) and node.module:
+                if node.module.endswith("resilience.faults"):
+                    for a in node.names:
+                        if a.name in _FAULT_FUNCS:
+                            bare_fault.add(a.asname or a.name)
+                elif node.module.endswith("resilience.preemption"):
+                    for a in node.names:
+                        if a.name == "check":
+                            bare_preempt.add(a.asname or a.name)
+        for node in ast.walk(scan.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            func = node.func
+            kind = None  # "fault" | "preempt"
+            if isinstance(func, ast.Attribute):
+                base = func.value.id if isinstance(func.value, ast.Name) else ""
+                if func.attr in _FAULT_FUNCS and base in _FAULT_MODULES:
+                    kind = "fault"
+                elif func.attr == "check" and base in _PREEMPT_MODULES:
+                    kind = "preempt"
+            elif isinstance(func, ast.Name):
+                if func.id in bare_fault:
+                    kind = "fault"
+                elif func.id in bare_preempt:
+                    kind = "preempt"
+            if kind is None:
+                continue
+            # the site may arrive positionally or as site=...; a call with
+            # neither is malformed and raises at runtime — skip it here
+            arg = node.args[0] if node.args else next(
+                (kw.value for kw in node.keywords if kw.arg == "site"), None
+            )
+            if arg is None:
+                continue
+            registry = (
+                self._fault_sites if kind == "fault" else self._preempt_sites
+            )
+            label = "fault" if kind == "fault" else "preemption poll"
+            if not (isinstance(arg, ast.Constant) and isinstance(arg.value, str)):
+                yield (
+                    node.lineno,
+                    f"{label} site must be a string literal from the "
+                    f"registry ({REGISTRY_RELPATH}) — a computed site name "
+                    "cannot be checked against chaos-plan grammars",
+                )
+                continue
+            site = arg.value
+            (self._used_faults if kind == "fault" else self._used_preempt).add(site)
+            if site not in registry:
+                yield (
+                    node.lineno,
+                    f"unregistered {label} site {site!r} — register it in "
+                    f"{REGISTRY_RELPATH} (PHOTON_FAULTS/PHOTON_PREEMPT_AT "
+                    "plans are written against the registry)",
+                )
+
+    def finalize(self, full_scope: bool) -> Iterator[Tuple[str, int, str]]:
+        if not full_scope or self._error is not None:
+            return
+        for site, lineno in sorted(self._fault_sites.items()):
+            if site not in self._used_faults:
+                yield (
+                    REGISTRY_RELPATH, lineno,
+                    f"unused registry entry {site!r}: no faults.inject/"
+                    "corrupt/flag call site uses it — delete it or wire it",
+                )
+        for site, lineno in sorted(self._preempt_sites.items()):
+            if site not in self._used_preempt:
+                yield (
+                    REGISTRY_RELPATH, lineno,
+                    f"unused registry entry {site!r}: no preemption.check "
+                    "poll site uses it — delete it or wire it",
+                )

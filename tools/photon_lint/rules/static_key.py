@@ -1,0 +1,128 @@
+"""static-key-honesty: a static jit cache key IS the value dispatched on.
+
+Motivating incident (PR 7): a forced ``pallas`` sparse family under
+float64 was normalized to the ``scatter`` schedule — but the slab kept
+``kernel="pallas"`` as its static jit-cache key. Telemetry lied, a
+duplicate executable compiled, and the race cache would happily reuse an
+f32 winner for an f64 slab where pallas is ineligible. The invariant: the
+moment a static-key value is normalized, EVERYTHING downstream (dispatch,
+construction, cache keys) uses the normalized name — never the raw one.
+
+The rule: inside one function, when a static-key name (``kernel``) is
+*conditionally normalized* — assigned from an expression that depends on
+the old value inside an ``if`` branch or via a conditional expression —
+every later call passing a ``kernel=...`` keyword must pass exactly the
+normalized binding. Passing the raw name, an attribute copy of it
+(``spec.kernel``), or a constant after the normalization point is
+flagged. Escape: ``# lint: static-key-honesty — <why>``.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterator, List, Set, Tuple
+
+from tools.photon_lint.engine import RawFinding, Rule, ScanFile
+
+#: Names treated as static jit-cache keys.
+KEY_NAMES = {"kernel"}
+
+
+def _names_in(node: ast.AST) -> Set[str]:
+    out: Set[str] = set()
+    for n in ast.walk(node):
+        if isinstance(n, ast.Name):
+            out.add(n.id)
+        elif isinstance(n, ast.Attribute):
+            out.add(n.attr)
+    return out
+
+
+def _parents(tree: ast.AST) -> Dict[int, ast.AST]:
+    out: Dict[int, ast.AST] = {}
+    for node in ast.walk(tree):
+        for child in ast.iter_child_nodes(node):
+            out[id(child)] = node
+    return out
+
+
+class StaticKeyRule(Rule):
+    name = "static-key-honesty"
+    description = (
+        "normalize-then-keep-old-key: a normalized static cache key "
+        "(kernel=...) must be the value actually dispatched on (PR 7: "
+        "f64-normalized pallas ran scatter under a lying 'pallas' key)"
+    )
+
+    def check(self, scan: ScanFile) -> Iterator[RawFinding]:
+        # identifier probe: no key name in the text => no finding possible
+        if not any(k in scan.source for k in KEY_NAMES):
+            return
+        parents = _parents(scan.tree)
+
+        def inside_if(node: ast.AST, stop: ast.AST) -> bool:
+            cur = parents.get(id(node))
+            while cur is not None and cur is not stop:
+                if isinstance(cur, ast.If):
+                    return True
+                cur = parents.get(id(cur))
+            return False
+
+        for fn in ast.walk(scan.tree):
+            if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            # (normalized-target, key, lineno) normalization events
+            events: List[Tuple[str, str, int]] = []
+            for node in ast.walk(fn):
+                if not (
+                    isinstance(node, ast.Assign)
+                    and len(node.targets) == 1
+                    and isinstance(node.targets[0], ast.Name)
+                ):
+                    continue
+                target = node.targets[0].id
+                rhs_names = _names_in(node.value)
+                keys = rhs_names & KEY_NAMES
+                if not keys:
+                    continue
+                conditional = inside_if(node, fn) or any(
+                    isinstance(n, ast.IfExp) for n in ast.walk(node.value)
+                )
+                if not conditional:
+                    continue
+                for key in keys:
+                    events.append((target, key, node.lineno))
+            if not events:
+                continue
+            for node in ast.walk(fn):
+                if not isinstance(node, ast.Call):
+                    continue
+                for kw in node.keywords:
+                    if kw.arg not in KEY_NAMES:
+                        continue
+                    relevant = [
+                        (t, k, ln) for (t, k, ln) in events
+                        if k == kw.arg and ln < node.lineno
+                    ]
+                    if not relevant:
+                        continue
+                    target, key, ln = max(relevant, key=lambda e: e[2])
+                    value = kw.value
+                    ok = isinstance(value, ast.Name) and (
+                        value.id == target
+                        or any(value.id == t for t, _, _ in relevant)
+                    )
+                    if ok:
+                        continue
+                    # the raw key (bare name or attribute copy) or a
+                    # constant after normalization = dishonest static key
+                    if key in _names_in(value) or isinstance(value, ast.Constant):
+                        yield (
+                            node.lineno,
+                            f"static key '{kw.arg}=' passed "
+                            f"{ast.unparse(value)!r} after '{key}' was "
+                            f"normalized into '{target}' at line {ln} — the "
+                            "static jit cache key must be the value actually "
+                            "dispatched on (PR 7: scatter ran under a lying "
+                            "'pallas' key); pass the normalized value",
+                        )

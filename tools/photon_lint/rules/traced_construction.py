@@ -1,0 +1,234 @@
+"""traced-construction: no host-side construction reachable under a trace.
+
+Motivating incident (PR 7, root-caused THREE times): env-var resolution
+(``PHOTON_SPARSE_KERNEL``), ``resolve_*`` calls, and
+``dataclasses.replace`` on coordinate dataclasses re-running
+``__post_init__`` were reached inside ``jit`` / ``shard_map`` /
+``pallas_call`` bodies — the streaming block-update jit saw a tracer
+where the slab builder expected host numpy, killing streaming update and
+score under the env var; the mesh path re-ran slab construction per
+shard. The fix is always the same: hoist construction to the host before
+the trace boundary (prebuilt ``sparse_slab=``, pinned ``sparse_kernel=``).
+
+This rule finds every function staged out by ``jax.jit`` / ``pjit`` /
+``instrumented_jit`` / ``shard_map`` / ``pallas_call`` (decorator,
+direct-call, or ``functools.partial`` form), walks the intra-file call
+graph reachable from those roots, and flags, anywhere in a traced body:
+
+  * ``os.environ`` reads / ``os.getenv`` calls — env resolution belongs
+    on the host, once;
+  * calls to ``resolve_*`` functions (the repo's host-side config
+    resolvers by convention);
+  * ``dataclasses.replace(...)`` — re-runs ``__post_init__`` under the
+    trace (the PR 7 mesh-path bug class);
+  * host-side slab builds (``build_sparse_slab`` / ``build_and_select``).
+
+Escape hatch: ``# lint: traced-construction — <why>`` on the offending
+line (e.g. a replace on a plain config pytree with no ``__post_init__``).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterator, List, Optional, Set
+
+from tools.photon_lint.engine import RawFinding, Rule, ScanFile
+
+#: Call names that stage their function argument out under a trace.
+TRACE_ENTRY_NAMES = {"shard_map", "pallas_call", "instrumented_jit"}
+
+#: Host-side heavyweight constructors that must never run under a trace.
+SLAB_BUILDERS = {"build_sparse_slab", "build_and_select"}
+
+
+def _callee_name(func: ast.AST) -> str:
+    if isinstance(func, ast.Attribute):
+        return func.attr
+    if isinstance(func, ast.Name):
+        return func.id
+    return ""
+
+
+def _is_jit_like(node: ast.AST) -> bool:
+    if isinstance(node, ast.Attribute):
+        if node.attr == "jit" and isinstance(node.value, ast.Name) and node.value.id == "jax":
+            return True
+        return node.attr == "pjit"
+    return isinstance(node, ast.Name) and node.id == "pjit"
+
+
+def _is_trace_entry(func: ast.AST) -> bool:
+    return _is_jit_like(func) or _callee_name(func) in TRACE_ENTRY_NAMES
+
+
+def _dataclasses_replace_names(tree: ast.AST) -> Set[str]:
+    """Local names bound to ``dataclasses.replace`` via from-imports."""
+    names: Set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ImportFrom) and node.module == "dataclasses":
+            for alias in node.names:
+                if alias.name == "replace":
+                    names.add(alias.asname or alias.name)
+    return names
+
+
+class TracedConstructionRule(Rule):
+    name = "traced-construction"
+    description = (
+        "os.environ / resolve_* / dataclasses.replace / slab builds "
+        "reachable inside jit/shard_map/pallas_call bodies (PR 7 bug class)"
+    )
+
+    def check(self, scan: ScanFile) -> Iterator[RawFinding]:
+        # identifier probe: a finding needs one of these spelled out AND a
+        # trace entry point; skip the call-graph build otherwise
+        src = scan.source
+        hazards = ("environ", "getenv", "resolve_", "replace", *SLAB_BUILDERS)
+        if not any(probe in src for probe in hazards):
+            return
+        if not any(
+            probe in src for probe in ("jit", "shard_map", "pallas_call")
+        ):
+            return
+        tree = scan.tree
+        quals = scan.qualnames
+        replace_aliases = _dataclasses_replace_names(tree)
+
+        # name -> defs (simple-name resolution is deliberately approximate:
+        # intra-file helpers are what tracing actually reaches)
+        defs: Dict[str, List[ast.AST]] = {}
+        for node in ast.walk(tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                defs.setdefault(node.name, []).append(node)
+
+        # roots: functions handed to a trace entry, by decorator or call
+        roots: List[ast.AST] = []
+        seen: Set[int] = set()
+
+        def add_root(node: Optional[ast.AST]) -> None:
+            if node is not None and id(node) not in seen:
+                seen.add(id(node))
+                roots.append(node)
+
+        for node in ast.walk(tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                for dec in node.decorator_list:
+                    if _is_trace_entry(dec) or (
+                        isinstance(dec, ast.Call)
+                        and (
+                            _is_trace_entry(dec.func)
+                            or (
+                                _callee_name(dec.func) == "partial"
+                                and dec.args
+                                and _is_trace_entry(dec.args[0])
+                            )
+                        )
+                    ):
+                        add_root(node)
+            if isinstance(node, ast.Call) and _is_trace_entry(node.func) and node.args:
+                target = node.args[0]
+                # unwrap jax.named_call(fn) / functools.partial(fn, ...)
+                while (
+                    isinstance(target, ast.Call)
+                    and _callee_name(target.func) in ("named_call", "partial")
+                    and target.args
+                ):
+                    target = target.args[0]
+                if isinstance(target, ast.Lambda):
+                    add_root(target)
+                elif isinstance(target, (ast.Name, ast.Attribute)):
+                    for d in defs.get(_callee_name(target), []):
+                        add_root(d)
+
+        # BFS the intra-file call graph from the traced roots. Calls are
+        # resolved for bare names and self./cls. receivers only — an attr
+        # call on an arbitrary object (x.update()) would collide with
+        # same-named HOST methods in this file and drown the rule in noise
+        def _resolvable(func: ast.AST) -> bool:
+            if isinstance(func, ast.Name):
+                return True
+            return (
+                isinstance(func, ast.Attribute)
+                and isinstance(func.value, ast.Name)
+                and func.value.id in ("self", "cls")
+            )
+
+        traced: List[ast.AST] = []
+        while roots:
+            fn = roots.pop()
+            traced.append(fn)
+            for node in ast.walk(fn):
+                if isinstance(node, ast.Call) and _resolvable(node.func):
+                    for d in defs.get(_callee_name(node.func), []):
+                        add_root(d)
+
+        flagged: Set[int] = set()
+        for fn in traced:
+            where = quals.get(id(fn), "<lambda>")
+            for node in ast.walk(fn):
+                lineno = getattr(node, "lineno", 0)
+                if id(node) in flagged:
+                    continue
+                if (
+                    isinstance(node, ast.Attribute)
+                    and node.attr == "environ"
+                    and isinstance(node.value, ast.Name)
+                    and node.value.id == "os"
+                ):
+                    flagged.add(id(node))
+                    yield (
+                        lineno,
+                        f"os.environ read reachable under a trace (in {where}) "
+                        "— resolve env config on the host, once, before the "
+                        "jit/shard_map/pallas boundary",
+                    )
+                if not isinstance(node, ast.Call):
+                    continue
+                callee = _callee_name(node.func)
+                if callee == "getenv" and isinstance(node.func, ast.Attribute) \
+                        and isinstance(node.func.value, ast.Name) \
+                        and node.func.value.id == "os":
+                    flagged.add(id(node))
+                    yield (
+                        lineno,
+                        f"os.getenv reachable under a trace (in {where}) — "
+                        "resolve env config on the host before the boundary",
+                    )
+                elif callee.startswith("resolve_"):
+                    flagged.add(id(node))
+                    yield (
+                        lineno,
+                        f"{callee}() reachable under a trace (in {where}) — "
+                        "resolvers are host-side config; pass the resolved "
+                        "value into the traced function instead",
+                    )
+                elif callee in SLAB_BUILDERS:
+                    flagged.add(id(node))
+                    yield (
+                        lineno,
+                        f"{callee}() reachable under a trace (in {where}) — "
+                        "slab construction is host-side numpy; build before "
+                        "the trace and pass the slab as a pytree arg",
+                    )
+                elif (
+                    callee == "replace"
+                    and (
+                        (
+                            isinstance(node.func, ast.Attribute)
+                            and isinstance(node.func.value, ast.Name)
+                            and node.func.value.id in ("dataclasses", "dc")
+                        )
+                        or (
+                            isinstance(node.func, ast.Name)
+                            and node.func.id in replace_aliases
+                        )
+                    )
+                ):
+                    flagged.add(id(node))
+                    yield (
+                        lineno,
+                        f"dataclasses.replace reachable under a trace (in "
+                        f"{where}) — replace re-runs __post_init__ under the "
+                        "trace (PR 7 mesh-path bug); construct on the host "
+                        "or thread the new values as arguments",
+                    )

@@ -69,7 +69,7 @@ def main():
     print(line, flush=True)
     try:
         payload = json.loads(line)
-    except Exception:
+    except Exception:  # noqa: BLE001 — a JSON-less bench is reported, not raised
         log("bench emitted no JSON")
         return 1
     if payload.get("platform") in ("tpu", "axon"):
