@@ -610,6 +610,347 @@ def _bench_perhost(extra, on_tpu):
     )
 
 
+def _perhost_worker_main(argv):
+    """Child mode (``--perhost-worker PID NPROCS PORT OUTDIR SCALE``): one
+    SPMD process of the entity-sharded streaming bench workload. SCALE
+    ``small`` runs a full streaming CD (streaming FE chunks + owner-computes
+    RE blocks) and records sec/iter + a bitwise digest; SCALE ``268m``
+    streams a 268,435,456-coefficient random effect (4,194,304 entities x
+    64 IDENTITY dims) through the per-host block path and records the
+    per-epoch sec/iter trajectory — the road-to-1B capture."""
+    import hashlib
+    import json as _json
+
+    i = argv.index("--perhost-worker")
+    pid, nprocs, port, outdir, scale = (
+        int(argv[i + 1]), int(argv[i + 2]), argv[i + 3], argv[i + 4],
+        argv[i + 5],
+    )
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+
+    from photon_ml_tpu.parallel import multihost
+
+    if nprocs > 1:
+        multihost.initialize(
+            coordinator_address=f"127.0.0.1:{port}", num_processes=nprocs,
+            process_id=pid,
+        )
+    from photon_ml_tpu.algorithm.coordinate_descent import CoordinateDescent
+    from photon_ml_tpu.algorithm.streaming_fixed_effect import (
+        PerHostStreamingFixedEffectCoordinate,
+    )
+    from photon_ml_tpu.data.game import RandomEffectDataConfig
+    from photon_ml_tpu.ops import losses as losses_mod
+    from photon_ml_tpu.ops.regularization import RegularizationContext
+    from photon_ml_tpu.optim.common import OptimizerConfig
+    from photon_ml_tpu.optim.problem import GLMOptimizationProblem
+    from photon_ml_tpu.parallel.mesh import MeshContext, data_mesh
+    from photon_ml_tpu.parallel.perhost_ingest import HostRows, csr_to_padded
+    from photon_ml_tpu.parallel.perhost_streaming import (
+        PerHostStreamingRandomEffectCoordinate,
+        build_perhost_streaming_manifest,
+    )
+    from photon_ml_tpu.types import OptimizerType, TaskType
+
+    ctx = MeshContext(data_mesh())
+    result = {"process": pid}
+    if scale == "small":
+        from game_test_utils import make_glmix_data
+
+        rng = np.random.default_rng(101)
+        data, _ = make_glmix_data(
+            rng, num_users=2000, rows_per_user_range=(4, 10),
+            d_fixed=16, d_random=16,
+        )
+        n = data.num_rows
+        feats = data.shards["per_user"]
+        fi, fv = csr_to_padded(feats, n)
+        vocab = data.id_vocabs["userId"]
+        lo = pid * (n // nprocs)
+        hi = n if pid == nprocs - 1 else (pid + 1) * (n // nprocs)
+        rows = HostRows(
+            entity_raw_ids=[vocab[j] for j in data.ids["userId"][lo:hi]],
+            row_index=np.arange(lo, hi, dtype=np.int64),
+            labels=data.response[lo:hi].astype(np.float32),
+            weights=data.weight[lo:hi].astype(np.float32),
+            offsets=data.offset[lo:hi].astype(np.float32),
+            feat_idx=fi[lo:hi], feat_val=fv[lo:hi], global_dim=feats.dim,
+        )
+        manifest = build_perhost_streaming_manifest(
+            rows, RandomEffectDataConfig("userId", "per_user"),
+            os.path.join(outdir, f"re-n{nprocs}-host{pid}"),
+            ctx, nprocs, pid, block_entities=512,
+        )
+        re_coord = PerHostStreamingRandomEffectCoordinate(
+            manifest, TaskType.LOGISTIC_REGRESSION,
+            optimizer=OptimizerType.LBFGS,
+            optimizer_config=OptimizerConfig(max_iterations=8, tolerance=1e-8),
+            regularization=RegularizationContext.l2(0.2),
+            state_root=os.path.join(outdir, f"state-n{nprocs}-host{pid}"),
+            ctx=ctx, num_processes=nprocs,
+        )
+        gf = data.shards["global"]
+        x_fe = np.zeros((n, gf.dim), np.float32)
+        x_fe[np.repeat(np.arange(n), np.diff(gf.indptr)), gf.indices] = gf.values
+        chunk_rows = 4096
+        chunk_sizes = [
+            min(chunk_rows, n - c * chunk_rows)
+            for c in range((n + chunk_rows - 1) // chunk_rows)
+        ]
+        owned = {}
+        for c in range(len(chunk_sizes)):
+            if c % nprocs != pid:
+                continue
+            s, e = c * chunk_rows, c * chunk_rows + chunk_sizes[c]
+
+            def load(s=s, e=e):
+                return {"x": x_fe[s:e], "y": data.response[s:e].astype(np.float32)}
+
+            owned[c] = load
+        fe_coord = PerHostStreamingFixedEffectCoordinate(
+            chunk_sizes, owned, gf.dim,
+            GLMOptimizationProblem(
+                TaskType.LOGISTIC_REGRESSION, OptimizerType.LBFGS,
+                OptimizerConfig(max_iterations=8, tolerance=1e-8),
+                RegularizationContext.l2(0.5),
+            ),
+            ctx=ctx, num_processes=nprocs,
+        )
+        labels = jnp.asarray(data.response.astype(np.float32))
+        weights = jnp.asarray(data.weight.astype(np.float32))
+        loss = losses_mod.for_task(TaskType.LOGISTIC_REGRESSION)
+        cd = CoordinateDescent(
+            {"fixed": fe_coord, "per-user": re_coord},
+            lambda s: jnp.sum(weights * loss.loss(s, labels)),
+        )
+        iters = 2
+        t0 = time.perf_counter()
+        res = cd.run(num_iterations=iters, num_rows=n)
+        elapsed = time.perf_counter() - t0
+        h = hashlib.sha256()
+        h.update(np.asarray(res.coefficients["fixed"]).tobytes())
+        h.update(np.asarray(res.total_scores).tobytes())
+        h.update(repr([float(v) for v in res.objective_history]).encode())
+        result.update(
+            sec_per_iter=elapsed / iters,
+            digest=h.hexdigest(),
+            rows=int(n), entities=2000,
+        )
+    elif scale == "268m":
+        # 4,194,304 entities x 64 IDENTITY dims = 268,435,456 coefficients,
+        # one row per entity; blocks of 65,536 entities stream from disk
+        # (env PHOTON_BENCH_268M_ENTITIES downsizes for smoke runs)
+        e_total = int(os.environ.get("PHOTON_BENCH_268M_ENTITIES", 4_194_304))
+        d_loc = 64
+        rng = np.random.default_rng(7)
+        lo = pid * (e_total // nprocs)
+        hi = e_total if pid == nprocs - 1 else (pid + 1) * (e_total // nprocs)
+        n_loc = hi - lo
+        width = len(str(e_total - 1))
+        raw_ids = [f"e{j:0{width}d}" for j in range(lo, hi)]
+        rows = HostRows(
+            entity_raw_ids=raw_ids,
+            row_index=np.arange(lo, hi, dtype=np.int64),
+            labels=(np.arange(lo, hi) % 2).astype(np.float32),
+            weights=np.ones(n_loc, np.float32),
+            offsets=np.zeros(n_loc, np.float32),
+            feat_idx=(np.arange(lo, hi, dtype=np.int64) % d_loc)
+            .astype(np.int32)[:, None],
+            feat_val=np.ones((n_loc, 1), np.float32),
+            global_dim=d_loc,
+        )
+        shared_vocab = [f"e{j:0{width}d}" for j in range(e_total)]
+        t0 = time.perf_counter()
+        manifest = build_perhost_streaming_manifest(
+            rows, RandomEffectDataConfig(
+                "entityId", "per_entity", projector="IDENTITY"
+            ),
+            os.path.join(outdir, f"re268m-host{pid}"),
+            ctx, nprocs, pid, block_entities=65536,
+            shared_vocab=shared_vocab,
+        )
+        t_build = time.perf_counter() - t0
+        coord = PerHostStreamingRandomEffectCoordinate(
+            manifest, TaskType.LOGISTIC_REGRESSION,
+            optimizer=OptimizerType.LBFGS,
+            optimizer_config=OptimizerConfig(
+                max_iterations=1, tolerance=1e-9, num_corrections=3
+            ),
+            regularization=RegularizationContext.l2(1.0),
+            state_root=os.path.join(outdir, f"state268m-host{pid}"),
+            ctx=ctx, num_processes=nprocs,
+        )
+        resid = jnp.zeros((e_total,), jnp.float32)
+        state = coord.initial_coefficients()
+        iter_secs = []
+        for _ in range(2):
+            t0 = time.perf_counter()
+            state, _ = coord.update(resid, state)
+            iter_secs.append(round(time.perf_counter() - t0, 2))
+        t0 = time.perf_counter()
+        scores = np.asarray(coord.score(state))
+        t_score = time.perf_counter() - t0
+        coefs = sum(
+            b["num_entities"] * b["local_dim"] for b in manifest.blocks
+        )
+        result.update(
+            coefficients_this_host=int(coefs),
+            coefficients_total=int(e_total * d_loc),
+            build_sec=round(t_build, 2),
+            iter_secs=iter_secs,
+            score_sec=round(t_score, 2),
+            blocks_owned=len(manifest.blocks),
+            blocks_total=manifest.num_blocks_total,
+            score_nonzero=int(np.count_nonzero(scores)),
+        )
+    else:
+        raise SystemExit(f"unknown perhost-worker scale {scale!r}")
+    path = os.path.join(outdir, f"perhost-n{nprocs}-{scale}-{pid}.json")
+    with open(path + ".tmp", "w") as f:
+        _json.dump(result, f)
+    os.replace(path + ".tmp", path)
+    return 0
+
+
+def _bench_perhost_streaming(extra, on_tpu):
+    """Entity-sharded multihost streaming CD (parallel/perhost_streaming):
+    sec/iter for 1 vs 2 processes on the SAME workload, the 1-vs-2-process
+    bitwise gate, and the >=268M-coefficient multi-process capture.
+    Collectives ride the Gloo CPU backend here (the harness is
+    subprocess-per-host on one machine), so the recorded "speedup" is an
+    honest measure of THIS capture — on one core, two processes time-share
+    and the win is capacity (per-host memory/disk halves), not wall-clock."""
+    import subprocess
+    import tempfile
+
+    here = os.path.abspath(__file__)
+    out = tempfile.mkdtemp(prefix="perhost-streaming-bench-")
+
+    def run_workers(nprocs, scale, timeout):
+        import socket
+
+        with socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            port = s.getsockname()[1]
+        env = dict(os.environ)
+        env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+        env["JAX_PLATFORMS"] = "cpu"
+        # children get FILES, not our pipes (the isolated-section rule): a
+        # pipe fills at ~64KB of XLA/JAX log noise, the blocked writer
+        # stalls its Gloo collective, and the whole cohort "times out"
+        # purely on log volume
+        log_paths = [
+            os.path.join(out, f"worker-n{nprocs}-{scale}-{p}.log")
+            for p in range(nprocs)
+        ]
+        procs = []
+        for p in range(nprocs):
+            with open(log_paths[p], "w") as lf:
+                procs.append(subprocess.Popen(
+                    [sys.executable, here, "--perhost-worker", str(p),
+                     str(nprocs), str(port), out, scale],
+                    stdout=subprocess.DEVNULL, stderr=lf, env=env,
+                ))
+
+        def tail(p_id):
+            try:
+                with open(log_paths[p_id]) as lf:
+                    return lf.read()[-1500:]
+            except OSError:
+                return "<no worker log>"
+
+        try:
+            for p_id, p in enumerate(procs):
+                try:
+                    p.communicate(timeout=timeout)
+                except subprocess.TimeoutExpired:
+                    p.kill()
+                    p.communicate()
+                    raise RuntimeError(
+                        f"perhost worker ({nprocs} proc, {scale}) exceeded "
+                        f"{timeout}s:\n{tail(p_id)}"
+                    )
+                if p.returncode != 0:
+                    raise RuntimeError(
+                        f"perhost worker failed rc={p.returncode}:\n{tail(p_id)}"
+                    )
+        except BaseException:  # noqa: BLE001 — cohort cleanup then re-raise, even on KeyboardInterrupt
+            # one worker failing/timing out strands its Gloo peers inside a
+            # collective with no timeout of their own — kill the whole
+            # cohort before re-raising, or the orphans contend with every
+            # later bench section (the r3 claim-orphan lesson, process form)
+            for p in procs:
+                if p.poll() is None:
+                    p.kill()
+                    p.wait()
+            raise
+        results = []
+        for p_id in range(nprocs):
+            with open(
+                os.path.join(out, f"perhost-n{nprocs}-{scale}-{p_id}.json")
+            ) as f:
+                results.append(json.load(f))
+        return results
+
+    try:
+        _bench_perhost_streaming_body(extra, run_workers)
+    finally:
+        # block files at 268M scale are GBs — never leak them on a failed
+        # run (a raised bitwise gate / worker timeout must still clean up)
+        import shutil
+
+        shutil.rmtree(out, ignore_errors=True)
+
+
+def _bench_perhost_streaming_body(extra, run_workers):
+    r1 = run_workers(1, "small", 1200)
+    r2 = run_workers(2, "small", 1800)
+    sec1 = r1[0]["sec_per_iter"]
+    sec2 = max(r["sec_per_iter"] for r in r2)
+    bitwise = r1[0]["digest"] == r2[0]["digest"] == r2[1]["digest"]
+    if not bitwise:
+        raise AssertionError(
+            "entity-sharded streaming CD is NOT bitwise host-count "
+            f"invariant: digests {r1[0]['digest'][:12]} vs "
+            f"{[r['digest'][:12] for r in r2]}"
+        )
+    extra["perhost_streaming_sec_per_iter_1proc"] = round(sec1, 3)
+    extra["perhost_streaming_sec_per_iter_2proc"] = round(sec2, 3)
+    extra["perhost_streaming_speedup_2proc"] = round(sec1 / sec2, 3)
+    extra["perhost_streaming_bitwise_equal"] = True
+    extra["perhost_streaming_config"] = dict(r1[0])
+    _log(
+        f"perhost streaming CD: {sec1:.3f}s/iter (1 proc) vs "
+        f"{sec2:.3f}s/iter (2 proc), speedup {sec1 / sec2:.2f}x, "
+        "1-vs-2-process BITWISE equal"
+    )
+
+    # ---- the >=268M-coefficient multi-process capture ---------------------
+    big = run_workers(2, "268m", 5100)
+    total = big[0]["coefficients_total"]
+    per_host = [b["coefficients_this_host"] for b in big]
+    extra["perhost_268m"] = {
+        "coefficients_total": total,
+        "coefficients_per_host": per_host,
+        "processes": 2,
+        "blocks_total": big[0]["blocks_total"],
+        "build_sec": max(b["build_sec"] for b in big),
+        "iter_secs": [max(a, b) for a, b in zip(
+            big[0]["iter_secs"], big[1]["iter_secs"]
+        )],
+        "score_sec": max(b["score_sec"] for b in big),
+    }
+    if total < 268_435_456 and not os.environ.get("PHOTON_BENCH_268M_ENTITIES"):
+        raise AssertionError(f"268M capture undersized: {total}")
+    _log(
+        f"perhost streaming 268M capture: {total:,} coefficients over 2 "
+        f"processes, sec/iter trajectory {extra['perhost_268m']['iter_secs']}"
+    )
+
+
 def _bench_streaming(extra, on_tpu):
     """Out-of-core fixed-effect solve (optim/streaming.py, VERDICT r3 #5):
     rows/sec through one chunk-streamed value+grad pass (mmap'd per-stream .npy chunks,
@@ -1710,12 +2051,18 @@ SECTION_ORDER = (
     "dense", "sparse", "sparse_race", "game", "game5", "grid",
     "streaming", "streaming_pipeline", "compile_reuse", "compaction",
     "preemption_resume",
-    "perhost", "scoring", "serving", "ingest",
+    "perhost", "perhost_streaming", "scoring", "serving", "ingest",
 )
 # orchestrator per-section deadlines (s): generous — tunnel compiles are slow,
 # and hitting a deadline DETACHES the child (never kills: r3 claim-orphan
 # postmortem — a killed claim-holder wedges the single-client tunnel)
-SECTION_DEADLINES = {"dense": 3600, "game": 3600, "game5": 2400, "grid": 2400}
+SECTION_DEADLINES = {"dense": 3600, "game": 3600, "game5": 2400, "grid": 2400,
+                     # 1-proc + 2-proc CD runs + the 268M two-process
+                     # capture, all subprocess-fenced with own timeouts —
+                     # the section deadline must EXCEED their sum
+                     # (1200 + 1800 + 5100) or a legitimately slow run is
+                     # detached even though every worker honored its fence
+                     "perhost_streaming": 8700}
 DEFAULT_SECTION_DEADLINE = 1800
 
 
@@ -1735,6 +2082,48 @@ def _dense_data():
 # after, instead of N duplicate tracebacks polluting the JSON tail
 _WEDGE_SIGNATURES = ("UNAVAILABLE", "TPU device error", "DEADLINE_EXCEEDED")
 
+# sections that never touch the device: still run after a failed preflight
+HOST_ONLY_SECTIONS = ("ingest",)
+
+
+def _device_preflight():
+    """Accelerator health probe BEFORE any section runs: one tiny jit and —
+    on a multi-device backend — one cross-device reduction, value-checked.
+    The BENCH_r05 postmortem: an unhealthy TPU wedged mid-section with
+    ``UNAVAILABLE: TPU device error`` and poisoned every later section in
+    the process; probing up front converts that into ONE structured
+    ``sections_failed`` reason per skipped section, recorded before any
+    work is lost. Returns (ok, reason)."""
+    try:
+        import jax
+        import jax.numpy as jnp
+
+        devs = jax.devices()
+        out = jax.jit(lambda x: x * 2.0 + 1.0)(jnp.arange(8.0))  # jit-ok: trivial preflight probe kernel, no state worth donating
+        got = np.asarray(jax.block_until_ready(out))
+        if not np.array_equal(got, np.arange(8.0) * 2.0 + 1.0):
+            return False, f"probe kernel returned wrong values: {got[:4]}"
+        if len(devs) > 1:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            from photon_ml_tpu.parallel.mesh import MeshContext, data_mesh
+
+            ctx = MeshContext(data_mesh())
+            arr = jax.device_put(
+                np.ones((len(devs), 4), np.float32),
+                NamedSharding(ctx.mesh, P(ctx.axis)),
+            )
+            red = jax.jit(  # jit-ok: preflight collective probe, no state worth donating
+                lambda a: a.sum(axis=0),
+                out_shardings=NamedSharding(ctx.mesh, P()),
+            )(arr)
+            rv = np.asarray(jax.block_until_ready(red))
+            if not np.array_equal(rv, np.full(4, float(len(devs)), np.float32)):
+                return False, f"collective probe returned wrong values: {rv}"
+        return True, None
+    except Exception as e:  # noqa: BLE001 — ANY probe failure means the device is unusable; that is the signal
+        return False, f"{type(e).__name__}: {str(e)[:200]}"
+
 
 def _run_sections(names, extra, errors, on_tpu, state=None, after=None):
     """Run the named bench sections in-process; returns the dense value.
@@ -1746,6 +2135,24 @@ def _run_sections(names, extra, errors, on_tpu, state=None, after=None):
     be host-only, e.g. ingest) but a repeat of the same signature degrades
     to a one-line pointer at the wedging section."""
     value = 0.0
+    device_names = [n for n in names if n not in HOST_ONLY_SECTIONS]
+    if device_names:
+        ok, reason = _device_preflight()
+        extra["preflight"] = {"ok": bool(ok)} if ok else {
+            "ok": False, "reason": reason
+        }
+        if not ok:
+            # structured up-front failure instead of letting an unhealthy
+            # device wedge mid-section (BENCH_r05 perhost/scoring mode)
+            _log(f"PREFLIGHT FAILED ({reason}); skipping device sections")
+            for n in device_names:
+                errors[n] = f"device preflight failed: {reason}"
+                extra.setdefault("sections_failed", {})[n] = (
+                    f"preflight: {reason}"[:200]
+                )
+            names = [n for n in names if n in HOST_ONLY_SECTIONS]
+            if after is not None:
+                after()
     wedged_by = None  # (section, signature) of the first wedge traceback
     for name in names:
         try:
@@ -1780,6 +2187,8 @@ def _run_sections(names, extra, errors, on_tpu, state=None, after=None):
                 _bench_preempt(extra, on_tpu)
             elif name == "perhost":
                 _bench_perhost(extra, on_tpu)
+            elif name == "perhost_streaming":
+                _bench_perhost_streaming(extra, on_tpu)
             elif name == "scoring":
                 _bench_scoring(extra, on_tpu)
             elif name == "serving":
@@ -1936,6 +2345,11 @@ def main():
         # plain return, NOT sys.exit: SystemExit would be caught by the
         # __main__ BaseException fence and append a bogus fatal JSON line
         _section_child_main(sys.argv)
+        return
+    if "--perhost-worker" in sys.argv:
+        # SPMD child of the perhost_streaming section (one process per
+        # simulated host); same plain-return rule as --section
+        _perhost_worker_main(sys.argv)
         return
 
     errors = {}

@@ -9,14 +9,22 @@ from photon_ml_tpu.algorithm.bucketed_random_effect import (
     BucketedRandomEffectCoordinate,
 )
 from photon_ml_tpu.algorithm.random_effect import RandomEffectCoordinate
+from photon_ml_tpu.algorithm.streaming_fixed_effect import (
+    PerHostStreamingFixedEffectCoordinate,
+    StreamingFixedEffectCoordinate,
+)
 from photon_ml_tpu.algorithm.streaming_random_effect import (
     SpilledREState,
     StreamingRandomEffectCoordinate,
     StreamingREManifest,
+    plan_entity_blocks,
     write_re_entity_blocks,
 )
 
 __all__ = [
+    "PerHostStreamingFixedEffectCoordinate",
+    "StreamingFixedEffectCoordinate",
+    "plan_entity_blocks",
     "BucketedRandomEffectCoordinate",
     "CoordinateDescent",
     "FactoredRandomEffectCoordinate",

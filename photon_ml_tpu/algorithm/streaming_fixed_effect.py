@@ -17,7 +17,7 @@ regularization_term); cd_jit=False — the orchestrator must call it raw
 from __future__ import annotations
 
 import dataclasses
-from typing import Optional, Tuple
+from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
@@ -30,6 +30,8 @@ from photon_ml_tpu.optim.problem import GLMOptimizationProblem, _split_reg_weigh
 from photon_ml_tpu.optim.streaming import (
     ChunkedGLMSource,
     lbfgs_minimize_streaming,
+    make_perhost_hvp,
+    make_perhost_value_and_grad,
     make_streaming_hvp,
     make_streaming_value_and_grad,
     tron_minimize_streaming,
@@ -37,6 +39,30 @@ from photon_ml_tpu.optim.streaming import (
 from photon_ml_tpu.types import OptimizerType, real_dtype
 
 Array = jax.Array
+
+
+def _streamed_update(problem: GLMOptimizationProblem, vg, hvp, l1_weight,
+                     init_coefficients: Array) -> Tuple[Array, OptResult]:
+    """THE streamed-update dispatch (bounds construction, TRON-vs-LBFGS
+    branch), shared by the single-host and per-host coordinates — one
+    definition, so the two can never drift apart (the same rule as the
+    shared per-chunk kernels in optim/streaming)."""
+    bounds = (
+        (problem.constraints.lower, problem.constraints.upper)
+        if problem.constraints is not None
+        else None
+    )
+    if hvp is not None:
+        res = tron_minimize_streaming(
+            vg, hvp, jnp.asarray(init_coefficients, real_dtype()),
+            problem.optimizer_config, bounds=bounds,
+        )
+    else:
+        res = lbfgs_minimize_streaming(
+            vg, jnp.asarray(init_coefficients, real_dtype()),
+            problem.optimizer_config, l1_weight=l1_weight, bounds=bounds,
+        )
+    return res.coefficients, res
 
 
 @dataclasses.dataclass
@@ -138,22 +164,9 @@ class StreamingFixedEffectCoordinate:
         self._live_source.loaders = self._residual_source(
             residual_offsets
         ).loaders
-        bounds = (
-            (self.problem.constraints.lower, self.problem.constraints.upper)
-            if self.problem.constraints is not None
-            else None
+        return _streamed_update(
+            self.problem, self._vg, self._hvp, self._l1, init_coefficients
         )
-        if self._hvp is not None:
-            res = tron_minimize_streaming(
-                self._vg, self._hvp, jnp.asarray(init_coefficients, real_dtype()),
-                self.problem.optimizer_config, bounds=bounds,
-            )
-        else:
-            res = lbfgs_minimize_streaming(
-                self._vg, jnp.asarray(init_coefficients, real_dtype()),
-                self.problem.optimizer_config, l1_weight=self._l1, bounds=bounds,
-            )
-        return res.coefficients, res
 
     def score(self, coefficients: Array) -> Array:
         """(N,) raw margins, streamed chunk by chunk through the prefetch +
@@ -172,6 +185,145 @@ class StreamingFixedEffectCoordinate:
         ):
             outs.append(self._margin_fn(coefficients, x)[:n_here])
         return jnp.concatenate(outs) if outs else jnp.zeros((0,), real_dtype())
+
+    def regularization_term(self, coefficients: Array) -> Array:
+        return self.problem.regularization_term_value(coefficients)
+
+
+@dataclasses.dataclass
+class PerHostStreamingFixedEffectCoordinate:
+    """Fixed-effect coordinate over a GLOBAL chunk list of which this host
+    owns a subset (per-host streaming coordinate descent,
+    parallel/perhost_streaming.py): every optimizer evaluation streams the
+    OWNED chunks through the same chunked value+gradient kernels as the
+    single-host coordinate, per-chunk partials merge exactly over the mesh
+    (one reduction — each global chunk is owned by exactly one host), and
+    every host replays the single-host sequential fold, so the whole LBFGS
+    / TRON trajectory is replicated AND bitwise-equal to the single-host
+    streaming run on the same chunk list (optim/streaming.py
+    make_perhost_value_and_grad). Scoring scatters owned-chunk margins into
+    the global (N,) vector and merges the disjoint writes exactly.
+
+    ``chunk_sizes`` is the global per-chunk row count list (chunks tile
+    [0, N) contiguously in order — in the multihost driver a chunk is one
+    input part file, so ownership falls out of the per-host file share with
+    no routing at all); ``owned_loaders`` maps this host's global chunk ids
+    to loaders yielding {"x", "y", optional "offsets"/"weights"} host dicts.
+    """
+
+    chunk_sizes: List[int]
+    owned_loaders: Dict[int, object]  # chunk id -> () -> host chunk dict
+    dim: int
+    problem: GLMOptimizationProblem
+    ctx: Optional[object] = None  # parallel.mesh.MeshContext
+    num_processes: int = 1
+    norm: NormalizationContext = dataclasses.field(
+        default_factory=NormalizationContext.identity
+    )
+    prefetch_depth: Optional[int] = None
+    bucketer: Optional[object] = None
+
+    # streams + reduces per evaluation: CoordinateDescent must call it raw
+    cd_jit = False
+
+    def __post_init__(self):
+        from photon_ml_tpu.compile import instrumented_jit, resolve_bucketer
+
+        if self.num_processes > 1 and self.ctx is None:
+            raise ValueError(
+                "PerHostStreamingFixedEffectCoordinate needs a MeshContext "
+                "to merge chunk partials across processes"
+            )
+        self.bucketer = resolve_bucketer(self.bucketer)
+        self._margin_fn = instrumented_jit(
+            lambda w, x: x @ self.norm.effective_coefficients(w)
+            + self.norm.margin_shift(self.norm.effective_coefficients(w)),
+            site="streaming_fe.perhost_margin",
+        )
+        self._owned_ids = sorted(self.owned_loaders)
+        self._chunk_starts = np.concatenate(
+            [[0], np.cumsum(self.chunk_sizes)]
+        ).astype(np.int64)
+        self.num_rows = int(self._chunk_starts[-1])
+        # mutable holder: the jitted per-chunk kernels are built ONCE by the
+        # factories below; each update swaps only the loaders (the same
+        # residual-view trick as StreamingFixedEffectCoordinate)
+        self._live_source = ChunkedGLMSource(
+            loaders=[self.owned_loaders[c] for c in self._owned_ids],
+            dim=self.dim,
+            num_rows=sum(int(self.chunk_sizes[c]) for c in self._owned_ids),
+        )
+        l1, l2 = _split_reg_weight(self.problem.regularization, None)
+        self._l1, self._l2 = float(l1), float(l2)
+        self._vg = make_perhost_value_and_grad(
+            self._live_source, self._owned_ids, len(self.chunk_sizes),
+            self.problem.objective, self.norm, self.ctx, self.num_processes,
+            l2_weight=self._l2, prefetch_depth=self.prefetch_depth,
+            bucketer=self.bucketer,
+        )
+        self._hvp = (
+            make_perhost_hvp(
+                self._live_source, self._owned_ids, len(self.chunk_sizes),
+                self.problem.objective, self.norm, self.ctx,
+                self.num_processes, l2_weight=self._l2,
+                prefetch_depth=self.prefetch_depth, bucketer=self.bucketer,
+            )
+            if self.problem.optimizer == OptimizerType.TRON else None
+        )
+
+    def initial_coefficients(self) -> Array:
+        return jnp.zeros((self.dim,), real_dtype())
+
+    def _residual_loaders(self, residual_offsets) -> List[object]:
+        """Owned-chunk views with the replicated (N,) residuals folded into
+        offsets — each chunk takes its contiguous global row slice."""
+        resid = np.asarray(residual_offsets)
+        loaders = []
+        for c in self._owned_ids:
+            lo = int(self._chunk_starts[c])
+            n_c = int(self.chunk_sizes[c])
+
+            def wrap(load=self.owned_loaders[c], lo=lo, n_c=n_c):
+                chunk = dict(load())
+                base = np.asarray(
+                    chunk.get("offsets", np.zeros(n_c, np.float32))
+                )
+                chunk["offsets"] = base + resid[lo : lo + n_c]
+                return chunk
+
+            loaders.append(wrap)
+        return loaders
+
+    def update(self, residual_offsets: Array, init_coefficients: Array
+               ) -> Tuple[Array, OptResult]:
+        self._live_source.loaders = self._residual_loaders(residual_offsets)
+        return _streamed_update(
+            self.problem, self._vg, self._hvp, self._l1, init_coefficients
+        )
+
+    def score(self, coefficients: Array) -> Array:
+        """(N,) raw margins: owned chunks stream through the shared margin
+        kernel, scatter into their contiguous global row slices, and the
+        disjoint per-host writes merge exactly over the mesh — bitwise the
+        single-host concatenation."""
+        from photon_ml_tpu.optim.streaming import pipelined_device_chunks
+        from photon_ml_tpu.parallel.perhost_streaming import merge_disjoint
+
+        self._live_source.loaders = [
+            self.owned_loaders[c] for c in self._owned_ids
+        ]
+        local = np.zeros(self.num_rows, real_dtype())
+        chunks = pipelined_device_chunks(
+            self._live_source, real_dtype(), self.prefetch_depth, self.bucketer
+        )
+        for c, (x, _, _, _) in zip(self._owned_ids, chunks):
+            n_c = int(self.chunk_sizes[c])
+            lo = int(self._chunk_starts[c])
+            # canonicalized chunks carry weight-0 pad rows: slice back
+            local[lo : lo + n_c] = np.asarray(
+                self._margin_fn(coefficients, x)
+            )[:n_c]
+        return jnp.asarray(merge_disjoint(local, self.ctx, self.num_processes))
 
     def regularization_term(self, coefficients: Array) -> Array:
         return self.problem.regularization_term_value(coefficients)

@@ -63,6 +63,124 @@ _DATASET_FIELDS = (
 )
 
 
+def plan_entity_blocks(
+    counts: np.ndarray,
+    *,
+    global_dim: int,
+    active_upper_bound: Optional[int] = None,
+    block_entities: Optional[int] = None,
+    memory_budget_bytes: Optional[int] = None,
+    itemsize: Optional[int] = None,
+) -> List[np.ndarray]:
+    """The entity blocking as a pure function of the (V,) per-entity row
+    counts (dense-vocab space): sort present entities by count (stable, so
+    similar-sized entities share a block and per-block padding stays tight),
+    then cut by ``block_entities`` or the memory budget. Extracted from
+    :func:`write_re_entity_blocks` so the MULTIHOST planner
+    (parallel/perhost_streaming.py) derives the IDENTICAL blocking from
+    collectively-merged counts — block composition is what makes the
+    per-host solves bitwise-equal to the single-host streaming run."""
+    counts = np.asarray(counts)
+    n = int(counts.sum())
+    present = np.nonzero(counts > 0)[0]
+    order = present[np.argsort(counts[present], kind="stable")]
+    cap = active_upper_bound or (int(counts.max()) if n else 1)
+    active = np.minimum(counts[order], cap)
+    if (block_entities is None) == (memory_budget_bytes is None):
+        raise ValueError(
+            "exactly one of block_entities / memory_budget_bytes is required"
+        )
+    itemsize = itemsize or np.dtype(real_dtype()).itemsize
+    blocks: List[np.ndarray] = []
+    if block_entities is not None:
+        for lo in range(0, len(order), block_entities):
+            blocks.append(np.sort(order[lo : lo + block_entities]))
+    else:
+        if memory_budget_bytes <= 0:
+            raise ValueError(
+                f"memory_budget_bytes must be positive, got {memory_budget_bytes}"
+            )
+        start = 0
+        while start < len(order):
+            end = start + 1
+            while end < len(order):
+                # padded x-stack estimate if [start, end] became one block:
+                # (end-start+1) entities x max-count x ~max nnz width
+                width = int(active[end])
+                est = (end - start + 1) * width * itemsize
+                # conservative local dim: entities see <= width * K features;
+                # use the shard's global dim as the hard upper bound
+                d_bound = min(global_dim, width * 64)
+                if est * d_bound > memory_budget_bytes:
+                    break
+                end += 1
+            blocks.append(np.sort(order[start:end]))
+            start = end
+    return blocks
+
+
+def build_block_payload(
+    data: GameData,
+    config: RandomEffectDataConfig,
+    entity_ids: np.ndarray,
+    bucketer=None,
+    memory_budget_bytes: Optional[int] = None,
+    label: str = "block",
+    row_to_global: Optional[np.ndarray] = None,
+) -> dict:
+    """One entity block's on-disk payload, built through the SAME
+    build_random_effect_dataset path as the in-memory coordinate.
+    ``data`` may be the FULL dataset (single-host) or a host-local subset
+    holding every row of ``entity_ids`` (the multihost owner-computes path);
+    in the latter case ``row_to_global`` maps local row positions to the
+    GLOBAL row ids recorded as the block's ``row_sel`` (what residual
+    gather and score scatter index)."""
+    from photon_ml_tpu.compile import canonicalize_re_arrays
+
+    re_id = config.random_effect_id
+    ids = data.ids[re_id]
+    row_sel = np.nonzero(np.isin(ids, entity_ids))[0]
+    filtered = _filter_game_data(
+        data, re_id, config.feature_shard_id, row_sel, entity_ids
+    )
+    ds = build_random_effect_dataset(filtered, config)
+    payload = {f: np.asarray(getattr(ds, f)) for f in _DATASET_FIELDS}
+    if bucketer is not None:
+        # canonical ladder shapes: the budget below is checked on the
+        # PADDED slab — the padded slab is what becomes resident
+        payload = canonicalize_re_arrays(payload, bucketer)
+    if memory_budget_bytes is not None and payload["x"].nbytes > memory_budget_bytes:
+        raise ValueError(
+            f"{label}: x-stack {payload['x'].nbytes}B exceeds the "
+            f"{memory_budget_bytes}B budget — lower active_upper_bound "
+            "or raise the budget (one entity's slab must fit)"
+        )
+    row_global = row_sel if row_to_global is None else row_to_global[row_sel]
+    payload["row_sel"] = np.asarray(row_global).astype(np.int64)
+    payload["entity_ids"] = np.asarray(entity_ids).astype(np.int64)
+    payload["dense_ids"] = filtered.ids[re_id].astype(np.int32)
+    del ds, filtered
+    return payload
+
+
+def write_block_file(out_dir: str, name: str, payload: dict) -> dict:
+    """Atomically write one block payload; returns its manifest meta entry."""
+    path = os.path.join(out_dir, name)
+    with open(path + ".tmp", "wb") as f:
+        np.savez(f, **payload)
+    os.replace(path + ".tmp", path)
+    return dict(
+        file=name,
+        # padded lane/local-dim counts: the shapes the solver and the
+        # spilled coefficient stacks actually carry (padded lanes
+        # scatter nothing — no row's entity_pos points at them)
+        num_entities=int(payload["x"].shape[0]),
+        local_dim=int(payload["x"].shape[2]),
+        num_rows=int(len(payload["row_sel"])),
+        x_bytes=int(payload["x"].nbytes),
+    )
+
+
 def write_re_entity_blocks(
     data: GameData,
     config: RandomEffectDataConfig,
@@ -102,7 +220,7 @@ def write_re_entity_blocks(
     in the manifest (callers including it in ``cache_key`` keep ladder
     changes from serving stale block shapes).
     """
-    from photon_ml_tpu.compile import canonicalize_re_arrays, resolve_bucketer
+    from photon_ml_tpu.compile import resolve_bucketer
 
     bucketer = resolve_bucketer(bucketer)
     if tensor_cache is not None and cache_key is not None:
@@ -130,92 +248,27 @@ def write_re_entity_blocks(
             "(a shared RANDOM projection matrix would have to be replicated "
             "into every block; use the in-memory coordinate)"
         )
-    if (block_entities is None) == (memory_budget_bytes is None):
-        raise ValueError(
-            "exactly one of block_entities / memory_budget_bytes is required"
-        )
     re_id = config.random_effect_id
     ids = data.ids[re_id]
     n = data.num_rows
     counts = np.bincount(ids, minlength=int(ids.max()) + 1 if n else 0)
-    present = np.nonzero(counts > 0)[0]
-    # similar-sized entities share a block -> per-block padding stays tight
-    order = present[np.argsort(counts[present], kind="stable")]
-    cap = config.active_upper_bound or (int(counts.max()) if n else 1)
-    active = np.minimum(counts[order], cap)
-
-    # row bytes per entity at the block's padded width are only known after
-    # grouping; bound with the entity's own active count (the sort makes the
-    # block max ~ the last entity's count, so this is near-exact)
-    itemsize = np.dtype(real_dtype()).itemsize  # 8 under PHOTON_ML_TPU_DTYPE=float64
-    blocks: List[np.ndarray] = []
-    if block_entities is not None:
-        for lo in range(0, len(order), block_entities):
-            blocks.append(np.sort(order[lo : lo + block_entities]))
-    else:
-        if memory_budget_bytes <= 0:
-            raise ValueError(
-                f"memory_budget_bytes must be positive, got {memory_budget_bytes}"
-            )
-        start = 0
-        while start < len(order):
-            end = start + 1
-            while end < len(order):
-                # padded x-stack estimate if [start, end] became one block:
-                # (end-start+1) entities x max-count x ~max nnz width
-                width = int(active[end])
-                est = (end - start + 1) * width * itemsize
-                # conservative local dim: entities see <= width * K features;
-                # use the shard's global dim as the hard upper bound
-                d_bound = min(
-                    data.shards[config.feature_shard_id].dim,
-                    width * 64,
-                )
-                if est * d_bound > memory_budget_bytes:
-                    break
-                end += 1
-            blocks.append(np.sort(order[start:end]))
-            start = end
+    blocks = plan_entity_blocks(
+        counts,
+        global_dim=data.shards[config.feature_shard_id].dim,
+        active_upper_bound=config.active_upper_bound,
+        block_entities=block_entities,
+        memory_budget_bytes=memory_budget_bytes,
+    )
 
     os.makedirs(out_dir, exist_ok=True)
     metas = []
     for i, entity_ids in enumerate(blocks):
-        row_sel = np.nonzero(np.isin(ids, entity_ids))[0]
-        filtered = _filter_game_data(
-            data, re_id, config.feature_shard_id, row_sel, entity_ids
+        payload = build_block_payload(
+            data, config, entity_ids, bucketer=bucketer,
+            memory_budget_bytes=memory_budget_bytes, label=f"block {i}",
         )
-        ds = build_random_effect_dataset(filtered, config)
-        payload = {f: np.asarray(getattr(ds, f)) for f in _DATASET_FIELDS}
-        if bucketer is not None:
-            # canonical ladder shapes: the budget below is checked on the
-            # PADDED slab — the padded slab is what becomes resident
-            payload = canonicalize_re_arrays(payload, bucketer)
-        if memory_budget_bytes is not None and payload["x"].nbytes > memory_budget_bytes:
-            raise ValueError(
-                f"block {i}: x-stack {payload['x'].nbytes}B exceeds the "
-                f"{memory_budget_bytes}B budget — lower active_upper_bound "
-                "or raise the budget (one entity's slab must fit)"
-            )
-        payload["row_sel"] = row_sel.astype(np.int64)
-        payload["entity_ids"] = entity_ids.astype(np.int64)
-        payload["dense_ids"] = filtered.ids[re_id].astype(np.int32)
-        path = os.path.join(out_dir, f"block-{i:05d}.npz")
-        with open(path + ".tmp", "wb") as f:
-            np.savez(f, **payload)
-        os.replace(path + ".tmp", path)
-        metas.append(
-            dict(
-                file=f"block-{i:05d}.npz",
-                # padded lane/local-dim counts: the shapes the solver and the
-                # spilled coefficient stacks actually carry (padded lanes
-                # scatter nothing — no row's entity_pos points at them)
-                num_entities=int(payload["x"].shape[0]),
-                local_dim=int(payload["x"].shape[2]),
-                num_rows=int(len(row_sel)),
-                x_bytes=int(payload["x"].nbytes),
-            )
-        )
-        del ds, payload, filtered
+        metas.append(write_block_file(out_dir, f"block-{i:05d}.npz", payload))
+        del payload
 
     manifest = dict(
         blocks=metas,

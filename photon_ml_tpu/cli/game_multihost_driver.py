@@ -394,14 +394,38 @@ def _main_once(mh_args: dict, p, restart: bool = False) -> dict:
 
     # ---- build DATASETS once (combo-invariant) ----------------------------
     fe_tensors: Dict[str, tuple] = {}
+    fe_chunks: Dict[str, tuple] = {}  # streaming: (chunk_sizes, owned, dim)
     re_datasets: Dict[str, object] = {}
+    streaming_manifests: Dict[str, object] = {}
+    # per-file row counts (identical on every host): the global chunk grid
+    # of the streaming fixed effect — chunk c IS input file c, so chunk
+    # ownership falls out of the per-host file share with no routing
+    g_file_counts = np.diff(np.append(file_base, n_global)).astype(np.int64)
     for name in p.updating_sequence:
         if name in p.fixed_effect_data_configs:
             spec = p.fixed_effect_data_configs[name]
             feats_parts, y_parts, o_parts, w_parts, id_parts = [], [], [], [], []
             dim = len(shard_maps[spec.feature_shard_id])
+            owned_loaders: Dict[int, object] = {}
             for ordinal, gd in gds:
                 f = gd.shards[spec.feature_shard_id]
+                if p.streaming_random_effects:
+                    # one chunk per input file, densified INSIDE the loader:
+                    # the streaming contract is one dense chunk resident at
+                    # a time — only the (much smaller) CSR shards persist
+                    def load(f=f, gd=gd, dim=dim):
+                        dense = np.zeros((gd.num_rows, dim), np.float32)
+                        rr = np.repeat(np.arange(gd.num_rows), np.diff(f.indptr))
+                        dense[rr, f.indices] = f.values
+                        return {
+                            "x": dense,
+                            "y": gd.response.astype(np.float32),
+                            "offsets": gd.offset.astype(np.float32),
+                            "weights": gd.weight.astype(np.float32),
+                        }
+
+                    owned_loaders[ordinal] = load
+                    continue
                 dense = np.zeros((gd.num_rows, dim), np.float32)
                 nnz = np.diff(f.indptr)
                 rows_rep = np.repeat(np.arange(gd.num_rows), nnz)
@@ -411,6 +435,11 @@ def _main_once(mh_args: dict, p, restart: bool = False) -> dict:
                 o_parts.append(gd.offset)
                 w_parts.append(gd.weight)
                 id_parts.append(file_base[ordinal] + np.arange(gd.num_rows))
+            if p.streaming_random_effects:
+                fe_chunks[name] = (
+                    [int(c) for c in g_file_counts], owned_loaders, dim
+                )
+                continue
             # upload ONCE: the device-resident coordinate is combo-invariant;
             # each combo rebinds only its optimization problem (rebind())
             fe_tensors[name] = MultihostFixedEffectCoordinate(
@@ -452,6 +481,69 @@ def _main_once(mh_args: dict, p, restart: bool = False) -> dict:
             rows = concat_host_rows(
                 parts, len(shard_maps[dc.feature_shard_id])
             )
+            if p.streaming_random_effects and name not in p.factored_configs:
+                # entity-sharded streaming: agree counts -> agreed global
+                # blocking -> route rows to block owners (one all_to_all) ->
+                # build ONLY the owned blocks under the per-host manifest
+                # layout (each host a private subdir — or a shard-scoped
+                # tensor-cache entry that can never cross-read a peer's)
+                from photon_ml_tpu.parallel.perhost_streaming import (
+                    build_perhost_streaming_manifest,
+                )
+
+                budget = (
+                    int(p.re_memory_budget_mb * 1e6)
+                    if p.re_memory_budget_mb is not None else None
+                )
+                cache = cache_key = None
+                if p.tensor_cache_dir:
+                    from photon_ml_tpu.compile import resolve_bucketer
+                    from photon_ml_tpu.io.tensor_cache import (
+                        TensorCache,
+                        process_shard_scope,
+                    )
+
+                    cache = TensorCache(
+                        p.tensor_cache_dir,
+                        shard_scope=process_shard_scope(
+                            mh.process_id, mh.num_processes
+                        ),
+                    )
+                    bk = resolve_bucketer(p.shape_canonicalization)
+                    # key on the GLOBAL file list (shared input dir): this
+                    # host's cached blocks hold rows routed from EVERY
+                    # host's files, so a peer's input change must miss
+                    # here. The resolved ladder spec is part of the key —
+                    # a --shape-canonicalization change alters the PADDED
+                    # block tensors a hit would serve
+                    cache_key = cache.key_for(
+                        all_files,
+                        {"kind": "perhost_streaming_re_blocks",
+                         "coord": name, "config": str(dc),
+                         "budget": budget, "n_files": len(all_files),
+                         "ladder": (
+                             f"{bk.base}:{bk.growth:g}"
+                             if bk is not None else None
+                         )},
+                    )
+                streaming_manifests[name] = build_perhost_streaming_manifest(
+                    rows, dc,
+                    os.path.join(
+                        p.output_dir, "streaming-re", name,
+                        f"process-{mh.process_id}",
+                    ),
+                    ctx, mh.num_processes, mh.process_id,
+                    block_entities=None if budget is not None else 1024,
+                    memory_budget_bytes=budget,
+                    bucketer=p.shape_canonicalization,
+                    tensor_cache=cache, cache_key=cache_key,
+                )
+                logger.info(
+                    f"streaming RE {name}: host {mh.process_id} owns "
+                    f"{len(streaming_manifests[name].blocks)}/"
+                    f"{streaming_manifests[name].num_blocks_total} blocks"
+                )
+                continue
             bucketed = (
                 p.bucketed_random_effects and name not in p.factored_configs
             )
@@ -465,6 +557,8 @@ def _main_once(mh_args: dict, p, restart: bool = False) -> dict:
                 projection_keep_intercept=dc.random_projection_intercept,
             )
 
+    stream_state_seq = [0]
+
     def build_coords(combo: Dict[str, CoordinateOptConfig]) -> Dict[str, object]:
         from photon_ml_tpu.parallel.perhost_factored import (
             PerHostFactoredRandomEffectCoordinate,
@@ -473,11 +567,43 @@ def _main_once(mh_args: dict, p, restart: bool = False) -> dict:
             BucketedShardedREData,
             PerHostBucketedRandomEffectSolver,
         )
+        from photon_ml_tpu.algorithm.streaming_fixed_effect import (
+            PerHostStreamingFixedEffectCoordinate,
+        )
+        from photon_ml_tpu.parallel.perhost_streaming import (
+            PerHostStreamingRandomEffectCoordinate,
+        )
 
         coords: Dict[str, object] = {}
         for name in p.updating_sequence:
             cfg = combo.get(name, CoordinateOptConfig())
-            if name in p.fixed_effect_data_configs:
+            if name in fe_chunks:
+                chunk_sizes, owned_loaders, dim = fe_chunks[name]
+                coords[name] = PerHostStreamingFixedEffectCoordinate(
+                    chunk_sizes, owned_loaders, dim,
+                    GLMOptimizationProblem(
+                        p.task_type, cfg.optimizer, cfg.optimizer_config(),
+                        cfg.regularization_context(),
+                    ),
+                    ctx=ctx, num_processes=mh.num_processes,
+                )
+            elif name in streaming_manifests:
+                stream_state_seq[0] += 1
+                coords[name] = PerHostStreamingRandomEffectCoordinate(
+                    manifest=streaming_manifests[name],
+                    task=p.task_type,
+                    optimizer=cfg.optimizer,
+                    optimizer_config=cfg.optimizer_config(),
+                    regularization=cfg.regularization_context(),
+                    # spilled state per host + combo instance, under OUR
+                    # output dir (never inside a shared cache entry)
+                    state_root=os.path.join(
+                        p.output_dir, "streaming-re-state",
+                        f"{name}-host{mh.process_id}-{stream_state_seq[0]}",
+                    ),
+                    ctx=ctx, num_processes=mh.num_processes,
+                )
+            elif name in p.fixed_effect_data_configs:
                 coords[name] = fe_tensors[name].rebind(
                     GLMOptimizationProblem(
                         p.task_type, cfg.optimizer, cfg.optimizer_config(),
@@ -638,7 +764,9 @@ def _main_once(mh_args: dict, p, restart: bool = False) -> dict:
     for name in p.updating_sequence:
         coord = coords[name]
         w = result.coefficients[name]
-        if isinstance(coord, MultihostFixedEffectCoordinate):
+        if name in p.fixed_effect_data_configs:
+            # replicated (D,) model either way — in-memory psum coordinate
+            # or the per-host streaming chunk coordinate
             if mh.coordinator_only_io():
                 spec = p.fixed_effect_data_configs[name]
                 model_io.save_fixed_effect(
@@ -652,6 +780,11 @@ def _main_once(mh_args: dict, p, restart: bool = False) -> dict:
             _save_factored_parts(
                 out, name, p, dc, coord, w,
                 shard_maps[dc.feature_shard_id], mh,
+            )
+        elif name in streaming_manifests:
+            dc = p.random_effect_data_configs[name]
+            _save_streaming_re_parts(
+                out, name, p, dc, coord, w, shard_maps[dc.feature_shard_id], mh
             )
         else:
             dc = p.random_effect_data_configs[name]
@@ -735,6 +868,38 @@ def _save_random_effect_parts(out, name, p, dc, coord, w, imap, mh):
                 valid = local["l2g"][lane] >= 0
                 dense[local["l2g"][lane][valid]] = local["w"][lane][valid]
             records.append(_model_record(raw, p.task_type, dense, None, imap))
+    avro_io.write_container(
+        os.path.join(base, COEFFICIENTS, f"part-{mh.process_id:05d}.avro"),
+        records,
+        schemas.BAYESIAN_LINEAR_MODEL,
+    )
+
+
+def _save_streaming_re_parts(out, name, p, dc, coord, state, imap, mh):
+    """Per-host streaming model save: each host writes ONE part file with
+    the entities whose blocks it owns (the spilled coefficient state never
+    crosses hosts; back-projection streams block metadata, not data slabs).
+    Owner-computes end to end — the write-side mirror of the solve."""
+    from photon_ml_tpu.io import avro as avro_io
+    from photon_ml_tpu.io import schemas
+    from photon_ml_tpu.io.model_io import (
+        COEFFICIENTS,
+        ID_INFO,
+        RANDOM_EFFECT,
+        _model_record,
+    )
+
+    base = os.path.join(out, RANDOM_EFFECT, name)
+    if mh.coordinator_only_io():
+        os.makedirs(os.path.join(base, COEFFICIENTS), exist_ok=True)
+        with open(os.path.join(base, ID_INFO), "w") as f:
+            f.write(f"{dc.random_effect_id}\n{dc.feature_shard_id}\n")
+    mh.barrier(f"re-dir-{name}")
+    means = coord.entity_means_by_raw_id(state)
+    records = [
+        _model_record(raw, p.task_type, np.asarray(vec, np.float32), None, imap)
+        for raw, vec in sorted(means.items())
+    ]
     avro_io.write_container(
         os.path.join(base, COEFFICIENTS, f"part-{mh.process_id:05d}.avro"),
         records,
@@ -884,7 +1049,9 @@ def _validate(p, mh, ctx, coords, result, logger, val_data):
     for name in p.updating_sequence:
         coord = coords[name]
         w = result.coefficients[name]
-        if isinstance(coord, MultihostFixedEffectCoordinate):
+        if name in p.fixed_effect_data_configs:
+            # replicated (D,) model: in-memory psum coordinate and the
+            # per-host streaming chunk coordinate score identically here
             spec = p.fixed_effect_data_configs[name]
             w_host = np.asarray(jax.device_get(w))
             local = np.zeros(nv, np.float32)
@@ -912,6 +1079,20 @@ def _validate(p, mh, ctx, coords, result, logger, val_data):
                     feat_idx=fi, feat_val=fv,
                     global_dim=f.dim,
                 ))
+            from photon_ml_tpu.parallel.perhost_streaming import (
+                PerHostStreamingRandomEffectCoordinate,
+                score_routed_rows_streaming,
+            )
+
+            if isinstance(coord, PerHostStreamingRandomEffectCoordinate):
+                # streaming models: route rows to the block-owner host, who
+                # dots them against its back-projected entity means
+                vrows = concat_host_rows(parts, coord.manifest.global_dim)
+                scores += score_routed_rows_streaming(
+                    coord.manifest, coord.entity_means_by_raw_id(w), vrows,
+                    nv, ctx, mh.num_processes, mh.process_id,
+                )
+                continue
             vrows = concat_host_rows(parts, coord.data.global_dim)
             if isinstance(coord, PerHostFactoredRandomEffectCoordinate):
                 # route against the flattened per-entity coefficients

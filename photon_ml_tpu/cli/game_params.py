@@ -454,11 +454,12 @@ class GameTrainingParams:
                     "size into tightly-padded blocks; drop "
                     "--bucketed-random-effects"
                 )
-            if self.distributed:
-                errors.append(
-                    "--streaming-random-effects is single-device (one block "
-                    "resident at a time); --distributed cannot compose"
-                )
+            # NOTE: --distributed composes with streaming since the
+            # entity-sharded multihost streaming PR: entities hash-partition
+            # across hosts (parallel/perhost_streaming.py), each host
+            # streams only the blocks it owns, and scores/chunk partials
+            # merge with exact mesh reductions — bitwise-equal to the
+            # single-host streaming run
             if self.fused_cycle:
                 errors.append(
                     "--streaming-random-effects streams per evaluation; "
@@ -544,7 +545,10 @@ def build_training_parser() -> argparse.ArgumentParser:
            "--distributed)")
     a("--streaming-random-effects", default="false",
       help="out-of-core random effects: entity-block stacks stream from "
-           "disk, one block resident per evaluation (DISK_ONLY analogue)")
+           "disk, one block resident per evaluation (DISK_ONLY analogue). "
+           "Composes with --distributed: entities hash-partition across "
+           "hosts, each host streams only the blocks it owns "
+           "(owner-computes; the multihost driver runs it per process)")
     a("--re-memory-budget-mb", default=None,
       help="cap the resident random-effect block slab (MB); implies "
            "--streaming-random-effects")

@@ -488,9 +488,8 @@ class GameTrainingDriver:
                     StreamingRandomEffectCoordinate,
                 )
 
-                coords[name] = StreamingRandomEffectCoordinate(
-                    self.streaming_manifests[name],
-                    p.task_type,
+                common = dict(
+                    task=p.task_type,
                     optimizer=cfg.optimizer,
                     optimizer_config=cfg.optimizer_config(),
                     regularization=cfg.regularization_context(),
@@ -505,6 +504,42 @@ class GameTrainingDriver:
                         f"{name}-{os.getpid()}-{self._next_stream_state_seq()}",
                     ),
                 )
+                if p.distributed:
+                    # entity-sharded streaming (the streaming x distributed
+                    # fence is gone): under this single-process driver the
+                    # mesh holds one process, so the merges are identities
+                    # and results are bitwise the plain streaming run's.
+                    # Genuinely multi-process runs MUST use the multihost
+                    # driver — its manifests are per-host partitions of an
+                    # agreed plan. This driver's manifest holds ALL blocks,
+                    # so wiring num_processes>1 here would psum P identical
+                    # full score vectors (P-times-counted, silently wrong):
+                    # refuse loudly instead.
+                    import jax as _jax
+
+                    from photon_ml_tpu.parallel.perhost_streaming import (
+                        PerHostStreamingRandomEffectCoordinate,
+                    )
+
+                    if _jax.process_count() > 1:
+                        raise ValueError(
+                            "--streaming-random-effects with --distributed "
+                            "under a multi-process runtime requires the "
+                            "multihost driver (game_multihost_driver): this "
+                            "driver's single-host manifest owns every block "
+                            "on every process, so merging would "
+                            f"{_jax.process_count()}x-count the scores"
+                        )
+                    coords[name] = PerHostStreamingRandomEffectCoordinate(
+                        manifest=self.streaming_manifests[name],
+                        ctx=self._mesh_context(),
+                        num_processes=1,
+                        **common,
+                    )
+                else:
+                    coords[name] = StreamingRandomEffectCoordinate(
+                        manifest=self.streaming_manifests[name], **common
+                    )
             elif p.bucketed_random_effects:
                 from photon_ml_tpu.algorithm.bucketed_random_effect import (
                     BucketedRandomEffectCoordinate,

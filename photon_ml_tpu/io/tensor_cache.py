@@ -55,6 +55,7 @@ __all__ = [
     "TensorCache",
     "content_key",
     "file_stat_token",
+    "process_shard_scope",
 ]
 
 CACHE_FORMAT = 1
@@ -77,14 +78,30 @@ def _canonical(config: Dict) -> str:
     return json.dumps(config, sort_keys=True, separators=(",", ":"), default=str)
 
 
-def content_key(sources: Iterable[str], config: Dict) -> str:
-    """SHA-256 content address of (source file stats, ingest config)."""
+def content_key(sources: Iterable[str], config: Dict,
+                shard_scope: Optional[str] = None) -> str:
+    """SHA-256 content address of (source file stats, ingest config[,
+    shard scope]). ``shard_scope=None`` hashes exactly as before the scope
+    existed, so unscoped caches keep their warm entries."""
     h = hashlib.sha256()
     h.update(f"format={CACHE_FORMAT}\n".encode())
     h.update(_canonical(file_stat_token(sources)).encode())
     h.update(b"\n")
     h.update(_canonical(config).encode())
+    if shard_scope is not None:
+        h.update(b"\nshard_scope=")
+        h.update(str(shard_scope).encode())
     return h.hexdigest()
+
+
+def process_shard_scope(process_index: int, num_processes: int,
+                        spec: Optional[str] = None) -> str:
+    """Canonical shard-scope token for per-host cache entries: process
+    coordinates plus an optional shard spec (e.g. the owned-block set).
+    A topology change (2 hosts -> 4) changes every host's token, so
+    re-sharded runs rebuild instead of cross-reading stale layouts."""
+    base = f"process={process_index}/{num_processes}"
+    return base if spec is None else f"{base};{spec}"
 
 
 @dataclasses.dataclass
@@ -103,11 +120,21 @@ class TensorCache:
     ``--io-retries`` / ``--io-retry-base-delay`` flags govern cache I/O
     exactly like every other filesystem path (avro, index maps,
     checkpoints). Pass an explicit :class:`RetryPolicy` to override.
+
+    ``shard_scope`` (e.g. :func:`process_shard_scope`) is folded into every
+    key this instance addresses: per-host builds on a SHARED filesystem
+    (the multihost streaming entity blocks, parallel/perhost_streaming.py)
+    produce per-host-different tensors from the same sources + config, so
+    without the scope token host A could serve host B's blocks — a silent
+    cross-read, not just a collision. ``None`` (the default) leaves keys
+    byte-identical to pre-scope caches, so existing entries stay warm.
     """
 
-    def __init__(self, root: str, policy: Optional[RetryPolicy] = None):
+    def __init__(self, root: str, policy: Optional[RetryPolicy] = None,
+                 shard_scope: Optional[str] = None):
         self.root = root
         self.policy = policy
+        self.shard_scope = shard_scope
         os.makedirs(root, exist_ok=True)
 
     @property
@@ -120,7 +147,7 @@ class TensorCache:
 
     # -- addressing ---------------------------------------------------------
     def key_for(self, sources: Iterable[str], config: Dict) -> str:
-        return content_key(sources, config)
+        return content_key(sources, config, shard_scope=self.shard_scope)
 
     def entry_dir(self, key: str) -> str:
         return os.path.join(self.root, key[:2], key)

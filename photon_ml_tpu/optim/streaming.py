@@ -227,6 +227,49 @@ def pipelined_device_chunks(
     yield from device_pipelined(host_chunks, place, depth=1)
 
 
+def _vg_chunk_kernels(objective: GLMObjective, norm: NormalizationContext):
+    """The per-chunk (value, gradient) accumulate kernel + the final reg
+    add, shared by the single-host AND per-host streamed passes: the
+    multihost bitwise-equality guarantee rests on the per-chunk arithmetic
+    being IDENTICAL in both, so there is exactly one definition."""
+    from photon_ml_tpu.compile import donation_enabled, instrumented_jit
+
+    donate = (0, 1) if donation_enabled() else ()
+
+    def acc_vg(f, g, w, x, y, off, wt):
+        batch = GLMBatch(DenseFeatures(x), y, off, wt)
+        fv, gv = objective.value_and_grad(w, batch, norm, 0.0)
+        return f + fv, g + gv
+
+    acc_vg = instrumented_jit(
+        acc_vg, site="streaming.vg_chunk", donate_argnums=donate
+    )
+
+    def add_reg(f, g, w, l2):
+        return f + 0.5 * l2 * jnp.sum(jnp.square(w)), g + l2 * w  # lint: bitwise-reduction — l2 reg over the fixed (D,) w, not a slab batch axis
+
+    add_reg = instrumented_jit(
+        add_reg, site="streaming.vg_reg", donate_argnums=donate
+    )
+    return acc_vg, add_reg
+
+
+def _hvp_chunk_kernel(objective: GLMObjective, norm: NormalizationContext):
+    """The per-chunk Hessian-vector accumulate kernel (one definition,
+    same rationale as :func:`_vg_chunk_kernels`)."""
+    from photon_ml_tpu.compile import donation_enabled, instrumented_jit
+
+    def acc_hvp(hv, w, v, x, y, off, wt):
+        batch = GLMBatch(DenseFeatures(x), y, off, wt)
+        return hv + objective.hessian_vector(w, v, batch, norm, 0.0)
+
+    return instrumented_jit(
+        acc_hvp,
+        site="streaming.hvp_chunk",
+        donate_argnums=(0,) if donation_enabled() else (),
+    )
+
+
 def make_streaming_value_and_grad(
     source: ChunkedGLMSource,
     objective: GLMObjective,
@@ -246,27 +289,10 @@ def make_streaming_value_and_grad(
     so values stay exact. The (f, g) accumulators are DONATED through the
     per-chunk kernel (in-place accumulation: no fresh gradient buffer per
     chunk)."""
-    from photon_ml_tpu.compile import donation_enabled, instrumented_jit
     from photon_ml_tpu.types import real_dtype
 
     dtype = dtype or real_dtype()
-    donate = (0, 1) if donation_enabled() else ()
-
-    def acc_vg(f, g, w, x, y, off, wt):
-        batch = GLMBatch(DenseFeatures(x), y, off, wt)
-        fv, gv = objective.value_and_grad(w, batch, norm, 0.0)
-        return f + fv, g + gv
-
-    acc_vg = instrumented_jit(
-        acc_vg, site="streaming.vg_chunk", donate_argnums=donate
-    )
-
-    def add_reg(f, g, w, l2):
-        return f + 0.5 * l2 * jnp.sum(jnp.square(w)), g + l2 * w  # lint: bitwise-reduction — l2 reg over the fixed (D,) w, not a slab batch axis
-
-    add_reg = instrumented_jit(
-        add_reg, site="streaming.vg_reg", donate_argnums=donate
-    )
+    acc_vg, add_reg = _vg_chunk_kernels(objective, norm)
 
     def vg(w: Array, l2_weight=l2_weight) -> Tuple[Array, Array]:
         f = jnp.zeros((), dtype)
@@ -278,6 +304,127 @@ def make_streaming_value_and_grad(
         return add_reg(f, g, w, jnp.asarray(l2_weight, dtype))
 
     return vg
+
+
+# ---------------------------------------------------------------------------
+# per-host streamed passes (multihost: each host owns a subset of the global
+# chunk list; partials merge EXACTLY across the mesh)
+# ---------------------------------------------------------------------------
+
+
+def make_perhost_value_and_grad(
+    source: ChunkedGLMSource,
+    owned_chunk_ids: Sequence[int],
+    num_chunks_global: int,
+    objective: GLMObjective,
+    norm: NormalizationContext,
+    ctx,
+    num_processes: int = 1,
+    l2_weight: float = 0.0,
+    dtype=None,
+    prefetch_depth: Optional[int] = None,
+    bucketer=None,
+):
+    """Mesh-aware :func:`make_streaming_value_and_grad`: ``source`` holds
+    only THIS host's chunks of a conceptually global chunk list (chunk c of
+    the source is global chunk ``owned_chunk_ids[c]``). Each owned chunk's
+    (value, gradient) partial is computed through the SAME per-chunk kernel
+    arithmetic as the single-host pass (zero accumulators: ``0 + x`` is the
+    IEEE identity), the per-chunk partials merge across hosts with one
+    reduction over the mesh (every global chunk is owned by exactly one
+    host, so the psum adds each partial to zeros — exact), and every host
+    replays the single-host pass's sequential fold over GLOBAL chunk order.
+    The result is therefore bitwise-equal to the single-host streamed pass
+    on the same chunk list, for any assignment of chunks to hosts — the
+    property the 2-process harness pins.
+
+    Cost model: one (n_chunks, 1+D) reduction per evaluation instead of the
+    single (1+D) psum a plain data-parallel pass would need — the price of
+    the bitwise-reproducible fold. No per-iteration shuffle anywhere (the
+    Spark anti-pattern, arXiv:1612.01437): rows never move after ingest.
+    """
+    from photon_ml_tpu.types import real_dtype
+
+    dtype = dtype or real_dtype()
+    owned = list(owned_chunk_ids)
+    # the SAME kernel builder as the single-host pass — one definition, so
+    # the per-chunk arithmetic can never drift between the two
+    acc_vg, add_reg = _vg_chunk_kernels(objective, norm)
+
+    def vg(w: Array, l2_weight=l2_weight) -> Tuple[Array, Array]:
+        parts = np.zeros((num_chunks_global, 1 + source.dim), dtype)
+        chunks = pipelined_device_chunks(source, dtype, prefetch_depth, bucketer)
+        for cid, (x, y, off, wt) in zip(owned, chunks):
+            f_c, g_c = acc_vg(
+                jnp.zeros((), dtype), jnp.zeros((source.dim,), dtype),
+                w, x, y, off, wt,
+            )
+            parts[cid, 0] = np.asarray(f_c)
+            parts[cid, 1:] = np.asarray(g_c)
+        merged = _merge_chunk_partials(parts, ctx, num_processes)
+        # replay the single-host sequential fold over global chunk order:
+        # scalar/elementwise IEEE adds, so the replay is bitwise-identical
+        # to the in-kernel running accumulation
+        f = np.zeros((), dtype)
+        g = np.zeros((source.dim,), dtype)
+        for c in range(num_chunks_global):
+            f = f + merged[c, 0]
+            g = g + merged[c, 1:]
+        return add_reg(
+            jnp.asarray(f), jnp.asarray(g), w, jnp.asarray(l2_weight, dtype)
+        )
+
+    return vg
+
+
+def make_perhost_hvp(
+    source: ChunkedGLMSource,
+    owned_chunk_ids: Sequence[int],
+    num_chunks_global: int,
+    objective: GLMObjective,
+    norm: NormalizationContext,
+    ctx,
+    num_processes: int = 1,
+    l2_weight: float = 0.0,
+    dtype=None,
+    prefetch_depth: Optional[int] = None,
+    bucketer=None,
+):
+    """Mesh-aware :func:`make_streaming_hvp` with the same exact-merge +
+    replayed-fold discipline as :func:`make_perhost_value_and_grad` (one
+    extra streamed pass per CG Hessian-vector product, reduced over the
+    mesh)."""
+    from photon_ml_tpu.types import real_dtype
+
+    dtype = dtype or real_dtype()
+    owned = list(owned_chunk_ids)
+    acc_hvp = _hvp_chunk_kernel(objective, norm)
+
+    def hvp(w: Array, v: Array, l2_weight=l2_weight) -> Array:
+        parts = np.zeros((num_chunks_global, source.dim), dtype)
+        chunks = pipelined_device_chunks(source, dtype, prefetch_depth, bucketer)
+        for cid, (x, y, off, wt) in zip(owned, chunks):
+            hv_c = acc_hvp(jnp.zeros((source.dim,), dtype), w, v, x, y, off, wt)
+            parts[cid] = np.asarray(hv_c)
+        merged = _merge_chunk_partials(parts, ctx, num_processes)
+        hv = np.zeros((source.dim,), dtype)
+        for c in range(num_chunks_global):
+            hv = hv + merged[c]
+        return jnp.asarray(hv) + jnp.asarray(l2_weight, dtype) * v
+
+    return hvp
+
+
+def _merge_chunk_partials(parts: np.ndarray, ctx, num_processes: int) -> np.ndarray:
+    """Exact cross-host merge of per-chunk partials (each global chunk is
+    written by exactly one host, zeros elsewhere). Delegates to
+    :func:`photon_ml_tpu.parallel.perhost_streaming.merge_disjoint` — the
+    lazy import keeps optim importable without the parallel package."""
+    if num_processes <= 1:
+        return parts
+    from photon_ml_tpu.parallel.perhost_streaming import merge_disjoint
+
+    return merge_disjoint(parts, ctx, num_processes)
 
 
 # ---------------------------------------------------------------------------
@@ -468,20 +615,10 @@ def make_streaming_hvp(
     like the value+grad factory; chunks stream through the same prefetch +
     double-buffered H2D pipeline, and the Hv accumulator is donated
     through the per-chunk kernel."""
-    from photon_ml_tpu.compile import donation_enabled, instrumented_jit
     from photon_ml_tpu.types import real_dtype
 
     dtype = dtype or real_dtype()
-
-    def acc_hvp(hv, w, v, x, y, off, wt):
-        batch = GLMBatch(DenseFeatures(x), y, off, wt)
-        return hv + objective.hessian_vector(w, v, batch, norm, 0.0)
-
-    acc_hvp = instrumented_jit(
-        acc_hvp,
-        site="streaming.hvp_chunk",
-        donate_argnums=(0,) if donation_enabled() else (),
-    )
+    acc_hvp = _hvp_chunk_kernel(objective, norm)
 
     def hvp(w: Array, v: Array, l2_weight=l2_weight) -> Array:
         hv = jnp.zeros((source.dim,), dtype)

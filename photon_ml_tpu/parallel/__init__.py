@@ -36,6 +36,13 @@ from photon_ml_tpu.parallel.perhost_ingest import (
     local_shards,
     per_host_re_dataset,
 )
+from photon_ml_tpu.parallel.perhost_streaming import (
+    EntityShardPlan,
+    PerHostStreamingManifest,
+    PerHostStreamingRandomEffectCoordinate,
+    build_perhost_streaming_manifest,
+    merge_disjoint,
+)
 
 __all__ = [
     "MeshContext",
@@ -56,4 +63,9 @@ __all__ = [
     "densify_row_ids",
     "local_shards",
     "per_host_re_dataset",
+    "EntityShardPlan",
+    "PerHostStreamingManifest",
+    "PerHostStreamingRandomEffectCoordinate",
+    "build_perhost_streaming_manifest",
+    "merge_disjoint",
 ]

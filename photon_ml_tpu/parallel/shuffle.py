@@ -293,3 +293,59 @@ def exchange_rows(
         int_rows.append(bi[keep])
         float_rows.append(bf[keep])
     return ExchangeResult(int_rows=int_rows, float_rows=float_rows)
+
+
+# ---------------------------------------------------------------------------
+# host-granular entity routing (the streaming owner-computes shuffle)
+# ---------------------------------------------------------------------------
+
+
+def route_rows_to_hosts(
+    dest_host: np.ndarray,
+    int_payload: np.ndarray,
+    float_payload: np.ndarray,
+    ctx: MeshContext,
+    num_processes: int,
+    process_id: int,
+) -> Tuple[np.ndarray, np.ndarray]:
+    """Move packed rows to their OWNER HOST (not device) with the same
+    one-``all_to_all`` exchange as :func:`exchange_rows`: each destination
+    host's rows are spread round-robin over its local devices for the
+    collective, then re-concatenated host-side on arrival. This is the
+    entity-routing step of per-host streaming coordinate descent
+    (parallel/perhost_streaming.py): rows move ONCE at ingest, to the host
+    that owns their entity's block — never again per iteration (the Spark
+    shuffle-per-pass anti-pattern this layout exists to beat).
+
+    ``int_payload[:, 0]`` must be a non-negative record id (the padding
+    sentinel, same contract as exchange_rows). Returns this host's received
+    ``(int_rows, float_rows)`` blocks (row order unspecified — callers sort
+    by their record id). Fault site ``multihost.entity_route`` fires before
+    the collective — also single-process, so chaos plans can target the
+    routing boundary without a multi-host harness.
+    """
+    from photon_ml_tpu.resilience import faults
+
+    faults.inject(
+        "multihost.entity_route",
+        process=process_id,
+        rows=int(len(dest_host)),
+    )
+    if num_processes <= 1:
+        return int_payload.astype(np.int32), float_payload.astype(np.float32)
+    local = max(ctx.num_devices // num_processes, 1)
+    # round-robin within each destination host's rows, so the per-device
+    # exchange cells stay balanced
+    order = np.argsort(dest_host, kind="stable")
+    rank_in_dest = np.empty(len(dest_host), np.int64)
+    sorted_dest = dest_host[order]
+    starts = np.searchsorted(sorted_dest, np.arange(num_processes), side="left")
+    rank_in_dest[order] = np.arange(len(dest_host)) - starts[sorted_dest]
+    dest_device = dest_host.astype(np.int64) * local + (rank_in_dest % local)
+    ex = exchange_rows(
+        dest_device, int_payload, float_payload, ctx, num_processes, process_id
+    )
+    return (
+        np.concatenate(ex.int_rows, axis=0) if ex.int_rows else int_payload[:0].astype(np.int32),
+        np.concatenate(ex.float_rows, axis=0) if ex.float_rows else float_payload[:0].astype(np.float32),
+    )

@@ -34,6 +34,9 @@ FAULT_SITES: Dict[str, str] = {
     "io.cache_write": "tensor-cache entry commits (io/tensor_cache.py)",
     "multihost.barrier": "cross-host sync points (parallel/multihost.py)",
     "multihost.heartbeat": "per-host heartbeat writes (parallel/multihost.py)",
+    "multihost.entity_route": "streaming entity-routing exchange (parallel/shuffle.py)",
+    "multihost.streaming_reduce": "exact cross-host streaming merges: score scatters, FE chunk partials, reg terms (parallel/perhost_streaming.py)",
+    "io.perhost_block_write": "per-host streaming entity-block writes (parallel/perhost_streaming.py)",
     "optim.step": "coordinate-descent updates, NaN corruption (algorithm/coordinate_descent.py)",
     "preempt.signal": "preemption polls; flags instead of raising (resilience/preemption.py)",
 }

@@ -1,0 +1,202 @@
+"""Worker for the 2-process entity-sharded STREAMING coordinate-descent
+harness (launched by test_perhost_streaming.py; also runnable by hand:
+
+    python tests/perhost_streaming_worker.py <proc_id> <nprocs> <port> <outdir>
+
+The full dataset is DEFINED globally (seeded); each process "decodes" only
+its contiguous row block (the per-host Avro-partition analogue), then runs
+the per-host streaming path end-to-end: entity-count agreement -> agreed
+global blocking -> entity routing (one all_to_all) -> owned-block build ->
+streaming CD over {streaming fixed effect (per-host chunks, exact mesh
+merges), streaming random effect (owner-computes block solves)}. The test
+asserts the run is BITWISE-equal to the single-host streaming run of the
+same data — the acceptance gate of the entity-sharded multihost streaming
+PR.
+
+Chaos mode (env PERHOST_LOSE_HOST=<pid>): that process dies hard
+(os._exit) after spilling its first block inside the update — a LOST host
+mid-block. The survivors' post-update barrier must convert the infinite
+hang into a diagnosable BarrierTimeoutError (PHOTON_BARRIER_TIMEOUT)."""
+
+import os
+import sys
+import time
+
+proc_id, nprocs, port, outdir = (
+    int(sys.argv[1]), int(sys.argv[2]), sys.argv[3], sys.argv[4]
+)
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+import jax.numpy as jnp
+
+from photon_ml_tpu.parallel import multihost
+
+mh = multihost.initialize(
+    coordinator_address=f"127.0.0.1:{port}", num_processes=nprocs,
+    process_id=proc_id,
+)
+ctx = mh.mesh_context()
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from game_test_utils import make_glmix_data  # noqa: E402
+
+from photon_ml_tpu.algorithm.coordinate_descent import CoordinateDescent  # noqa: E402
+from photon_ml_tpu.algorithm.streaming_fixed_effect import (  # noqa: E402
+    PerHostStreamingFixedEffectCoordinate,
+)
+from photon_ml_tpu.data.game import RandomEffectDataConfig  # noqa: E402
+from photon_ml_tpu.ops import losses as losses_mod  # noqa: E402
+from photon_ml_tpu.ops.regularization import RegularizationContext  # noqa: E402
+from photon_ml_tpu.optim.common import OptimizerConfig  # noqa: E402
+from photon_ml_tpu.optim.problem import GLMOptimizationProblem  # noqa: E402
+from photon_ml_tpu.parallel.mesh import MeshContext  # noqa: E402
+from photon_ml_tpu.parallel.perhost_ingest import HostRows, csr_to_padded  # noqa: E402
+from photon_ml_tpu.parallel.perhost_streaming import (  # noqa: E402
+    PerHostStreamingRandomEffectCoordinate,
+    build_perhost_streaming_manifest,
+)
+from photon_ml_tpu.types import OptimizerType, TaskType  # noqa: E402
+
+# ---- the globally seeded dataset (identical in every process) -------------
+rng = np.random.default_rng(97)
+data, _ = make_glmix_data(
+    rng, num_users=60, rows_per_user_range=(4, 16), d_fixed=5, d_random=4
+)
+N = data.num_rows
+D_FE = data.shards["global"].dim
+CHUNK_ROWS = 128
+BLOCK_ENTITIES = 16
+RE_CFG = RandomEffectDataConfig("userId", "per_user")
+FE_PROBLEM = GLMOptimizationProblem(
+    TaskType.LOGISTIC_REGRESSION, OptimizerType.LBFGS,
+    OptimizerConfig(max_iterations=6, tolerance=1e-8),
+    RegularizationContext.l2(0.5),
+)
+RE_OPT = OptimizerConfig(max_iterations=6, tolerance=1e-8)
+RE_REG = RegularizationContext.l2(0.2)
+
+# this host "decodes" only its contiguous row block of the random-effect rows
+lo = proc_id * (N // nprocs)
+hi = N if proc_id == nprocs - 1 else (proc_id + 1) * (N // nprocs)
+feats = data.shards["per_user"]
+fi_all, fv_all = csr_to_padded(feats, N)
+vocab0 = data.id_vocabs["userId"]
+host_rows = HostRows(
+    entity_raw_ids=[vocab0[i] for i in data.ids["userId"][lo:hi]],
+    row_index=np.arange(lo, hi, dtype=np.int64),
+    labels=data.response[lo:hi].astype(np.float32),
+    weights=data.weight[lo:hi].astype(np.float32),
+    offsets=data.offset[lo:hi].astype(np.float32),
+    feat_idx=fi_all[lo:hi],
+    feat_val=fv_all[lo:hi],
+    global_dim=feats.dim,
+)
+
+# ---- per-host streaming RE: agree -> plan -> route -> owned blocks --------
+# NO shared_vocab: the raw-id agreement collective is the production path
+manifest = build_perhost_streaming_manifest(
+    host_rows, RE_CFG, os.path.join(outdir, f"re-host{proc_id}"),
+    ctx, nprocs, proc_id, block_entities=BLOCK_ENTITIES,
+)
+re_coord = PerHostStreamingRandomEffectCoordinate(
+    manifest, TaskType.LOGISTIC_REGRESSION,
+    optimizer=OptimizerType.LBFGS, optimizer_config=RE_OPT,
+    regularization=RE_REG,
+    state_root=os.path.join(outdir, f"re-state-host{proc_id}"),
+    ctx=ctx, num_processes=nprocs,
+)
+
+lose = os.environ.get("PERHOST_LOSE_HOST")
+if lose is not None:
+    # ---- chaos: this host dies HARD after its first block spill ----------
+    from photon_ml_tpu.algorithm import streaming_random_effect as sre
+
+    if int(lose) == proc_id:
+        orig_write = sre.SpilledREState.write
+
+        def dying_write(self, i, arr):
+            orig_write(self, i, arr)
+            print("LOSTHOST-DYING", flush=True)
+            os._exit(17)
+
+        sre.SpilledREState.write = dying_write
+    mh.write_heartbeat(os.path.join(outdir, "heartbeats"), step=0)
+    try:
+        re_coord.update(
+            jnp.zeros((N,), jnp.float32), re_coord.initial_coefficients()
+        )
+        mh.barrier("post-update", timeout=float(
+            os.environ.get("PHOTON_BARRIER_TIMEOUT", "25")
+        ))
+        print("LOSTHOST-UNDETECTED", flush=True)  # should be unreachable
+        sys.exit(0)
+    except multihost.BarrierTimeoutError as e:
+        hb = mh.describe_heartbeats(os.path.join(outdir, "heartbeats"))
+        print(f"LOSTHOST-DETECTED BarrierTimeoutError: {e} | {hb}", flush=True)
+        sys.exit(3)
+
+# ---- per-host streaming FE: global chunk list, round-robin ownership ------
+x_fe = np.zeros((N, D_FE), np.float32)
+gf = data.shards["global"]
+nnz = np.diff(gf.indptr)
+x_fe[np.repeat(np.arange(N), nnz), gf.indices] = gf.values
+chunk_sizes = [
+    min(CHUNK_ROWS, N - c * CHUNK_ROWS)
+    for c in range((N + CHUNK_ROWS - 1) // CHUNK_ROWS)
+]
+owned_loaders = {}
+for c in range(len(chunk_sizes)):
+    if c % nprocs != proc_id:
+        continue
+    s = c * CHUNK_ROWS
+    e = s + chunk_sizes[c]
+
+    def load(s=s, e=e):
+        return {"x": x_fe[s:e], "y": data.response[s:e].astype(np.float32)}
+
+    owned_loaders[c] = load
+fe_coord = PerHostStreamingFixedEffectCoordinate(
+    chunk_sizes, owned_loaders, D_FE, FE_PROBLEM,
+    ctx=ctx, num_processes=nprocs,
+)
+
+# ---- one streaming CD run over both coordinates ---------------------------
+labels = jnp.asarray(data.response.astype(np.float32))
+weights = jnp.asarray(data.weight.astype(np.float32))
+loss = losses_mod.for_task(TaskType.LOGISTIC_REGRESSION)
+loss_fn = lambda s: jnp.sum(weights * loss.loss(s, labels))
+t0 = time.perf_counter()
+cd = CoordinateDescent({"fixed": fe_coord, "per-user": re_coord}, loss_fn)
+result = cd.run(num_iterations=2, num_rows=N)
+elapsed = time.perf_counter() - t0
+
+mh.barrier("cd-done")
+# every host writes ITS owned entities' back-projected means (the per-host
+# model-save layout: the coefficient state never crosses hosts)
+means = re_coord.entity_means_by_raw_id(result.coefficients["per-user"])
+np.savez(
+    os.path.join(outdir, f"means-host{proc_id}.npz"),
+    names=np.asarray(sorted(means), dtype=object),
+    stack=np.stack([means[k] for k in sorted(means)])
+    if means else np.zeros((0, 0)),
+)
+if mh.coordinator_only_io():
+    np.savez(
+        os.path.join(outdir, "run.npz"),
+        fe=np.asarray(result.coefficients["fixed"]),
+        total_scores=np.asarray(result.total_scores),
+        objectives=np.asarray(result.objective_history, np.float64),
+    )
+mh.barrier("saved")
+print(
+    f"PHSOK proc={proc_id} sec_per_iter={elapsed / 2:.3f} "
+    f"obj={result.objective_history[-1]:.9g}",
+    flush=True,
+)

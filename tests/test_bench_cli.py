@@ -24,7 +24,7 @@ def test_list_sections_enumerates_all_sections():
         "dense", "sparse", "sparse_race", "game", "game5", "grid",
         "streaming", "streaming_pipeline", "compile_reuse", "compaction",
         "preemption_resume",
-        "perhost", "scoring", "serving", "ingest",
+        "perhost", "perhost_streaming", "scoring", "serving", "ingest",
     ]
 
 

@@ -593,6 +593,39 @@ class TestBucketedRandomEffects:
         _, _, local_metrics = local_driver.results[local_driver.best_index]
         assert metrics["AUC"] == pytest.approx(local_metrics["AUC"], abs=5e-3)
 
+    def test_streaming_with_distributed_composes(
+        self, trained, game_avro_dirs, tmp_path
+    ):
+        """--streaming-random-effects + --distributed (the fence deleted by
+        the entity-sharded multihost streaming PR): the driver builds the
+        per-host streaming coordinate; on this single-process mesh its
+        merges are identities, so metrics match the plain path."""
+        from photon_ml_tpu.parallel.perhost_streaming import (
+            PerHostStreamingRandomEffectCoordinate,
+        )
+
+        local_driver, _, _ = trained
+        train_dir, val_dir, _ = game_avro_dirs
+        driver = game_training_driver.main(
+            [
+                "--train-input-dirs", train_dir,
+                "--validate-input-dirs", val_dir,
+                "--output-dir", str(tmp_path / "out"),
+                "--num-iterations", "2",
+                "--streaming-random-effects", "true",
+                "--distributed", "true",
+            ]
+            + COMMON_FLAGS
+        )
+        coords = driver._build_coordinates(driver.results[0][0])
+        assert isinstance(
+            coords["per-user"], PerHostStreamingRandomEffectCoordinate
+        )
+        assert coords["per-user"].num_processes == 1
+        _, _, metrics = driver.results[driver.best_index]
+        _, _, local_metrics = local_driver.results[local_driver.best_index]
+        assert metrics["AUC"] == pytest.approx(local_metrics["AUC"], abs=5e-3)
+
 
 class TestSolveCompaction:
     def test_solve_compaction_flag_matches_plain(

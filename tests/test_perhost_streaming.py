@@ -1,0 +1,591 @@
+"""Entity-sharded multihost streaming coordinate descent (the
+billion-coefficient path): per-host streaming block solves + exact mesh
+merges, pinned BITWISE against the single-host streaming run.
+
+Tier-1 (fast, single-process) coverage: the agreed plan reproduces the
+single-host blocking; the per-host coordinates degrade to bitwise copies of
+the plain streaming coordinates at num_processes=1; routing/reduction fault
+sites are chaos-injectable; the tensor cache's shard scope separates
+per-host entries. The 2-process harness (slow) proves the real thing:
+update + score + one full CD cycle over {streaming FE, streaming RE},
+2 processes x 4 virtual CPU devices, bitwise-equal to the single-host run
+— plus a lost-host-mid-block chaos injection that must surface a
+diagnosable BarrierTimeoutError instead of hanging the survivors."""
+
+import os
+import socket
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from game_test_utils import make_glmix_data
+
+from photon_ml_tpu.algorithm.streaming_fixed_effect import (
+    PerHostStreamingFixedEffectCoordinate,
+    StreamingFixedEffectCoordinate,
+)
+from photon_ml_tpu.algorithm.streaming_random_effect import (
+    StreamingRandomEffectCoordinate,
+    plan_entity_blocks,
+    write_re_entity_blocks,
+)
+from photon_ml_tpu.data.game import RandomEffectDataConfig
+from photon_ml_tpu.optim.common import OptimizerConfig
+from photon_ml_tpu.optim.problem import GLMOptimizationProblem
+from photon_ml_tpu.optim.streaming import ChunkedGLMSource
+from photon_ml_tpu.ops import losses as losses_mod
+from photon_ml_tpu.ops.regularization import RegularizationContext
+from photon_ml_tpu.parallel.mesh import MeshContext, data_mesh
+from photon_ml_tpu.parallel.perhost_ingest import HostRows, csr_to_padded
+from photon_ml_tpu.parallel.perhost_streaming import (
+    EntityShardPlan,
+    PerHostStreamingRandomEffectCoordinate,
+    build_perhost_streaming_manifest,
+    merge_disjoint,
+)
+from photon_ml_tpu.types import OptimizerType, TaskType
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+WORKER = os.path.join(REPO, "tests", "perhost_streaming_worker.py")
+
+RE_CFG = RandomEffectDataConfig("userId", "per_user")
+RE_OPT = OptimizerConfig(max_iterations=6, tolerance=1e-8)
+RE_REG = RegularizationContext.l2(0.2)
+
+
+def _sorted_vocab_data(rng=None, **kw):
+    """GLMix data with the entity vocabulary in SORTED order — the order
+    the per-host raw-id agreement (and the production sorted-set decode)
+    produces, so dense ids agree between the reference and the plan."""
+    rng = rng or np.random.default_rng(41)
+    data, _ = make_glmix_data(rng, **kw)
+    vocab = data.id_vocabs["userId"]
+    order = np.argsort(np.asarray(vocab, dtype=object))
+    remap = np.empty(len(vocab), np.int64)
+    remap[order] = np.arange(len(vocab))
+    data.ids["userId"] = remap[data.ids["userId"]].astype(np.int32)
+    data.id_vocabs["userId"] = [vocab[i] for i in order]
+    return data
+
+
+def _host_rows(data):
+    feats = data.shards["per_user"]
+    fi, fv = csr_to_padded(feats, data.num_rows)
+    vocab = data.id_vocabs["userId"]
+    return HostRows(
+        entity_raw_ids=[vocab[i] for i in data.ids["userId"]],
+        row_index=np.arange(data.num_rows, dtype=np.int64),
+        labels=data.response.astype(np.float32),
+        weights=data.weight.astype(np.float32),
+        offsets=data.offset.astype(np.float32),
+        feat_idx=fi, feat_val=fv, global_dim=feats.dim,
+    )
+
+
+@pytest.fixture(scope="module")
+def glmix():
+    return _sorted_vocab_data(
+        num_users=40, rows_per_user_range=(3, 12), d_fixed=4, d_random=3
+    )
+
+
+@pytest.fixture(scope="module")
+def mesh_ctx():
+    return MeshContext(data_mesh())
+
+
+class TestPlan:
+    def test_plan_matches_single_host_blocking(self, glmix, tmp_path):
+        """EntityShardPlan.build over the merged counts must reproduce the
+        single-host write_re_entity_blocks blocking exactly — block
+        composition is the bitwise foundation."""
+        ref = write_re_entity_blocks(
+            glmix, RE_CFG, str(tmp_path / "ref"), block_entities=16
+        )
+        ids = glmix.ids["userId"]
+        counts = np.bincount(ids, minlength=int(ids.max()) + 1)
+        plan = EntityShardPlan.build(
+            counts, 2, global_dim=glmix.shards["per_user"].dim,
+            block_entities=16,
+        )
+        assert len(plan.blocks) == len(ref.blocks)
+        for gi, ents in enumerate(plan.blocks):
+            z = np.load(os.path.join(ref.dir, ref.blocks[gi]["file"]))
+            np.testing.assert_array_equal(ents, z["entity_ids"])
+        # every present entity owned by exactly one block; owners in range
+        assert plan.num_entities == 40
+        assert set(plan.owners.tolist()) <= {0, 1}
+        owned = plan.owned_block_ids(0) + plan.owned_block_ids(1)
+        assert sorted(owned) == list(range(len(plan.blocks)))
+
+    def test_plan_budget_mode_matches(self, glmix, tmp_path):
+        budget = 8_000
+        ref = write_re_entity_blocks(
+            glmix, RE_CFG, str(tmp_path / "ref"), memory_budget_bytes=budget
+        )
+        ids = glmix.ids["userId"]
+        counts = np.bincount(ids, minlength=int(ids.max()) + 1)
+        blocks = plan_entity_blocks(
+            counts, global_dim=glmix.shards["per_user"].dim,
+            memory_budget_bytes=budget,
+        )
+        assert len(blocks) == len(ref.blocks)
+
+    def test_plan_requires_exactly_one_sizing(self):
+        with pytest.raises(ValueError, match="exactly one"):
+            plan_entity_blocks(np.asarray([3, 2]), global_dim=4)
+
+
+class TestSingleProcessBitwise:
+    """num_processes=1 perhost coordinates are bitwise copies of the plain
+    streaming coordinates (the merge is the identity); this plus the
+    host-count-invariant design is what the 2-process harness then proves
+    cross-host."""
+
+    def test_re_blocks_and_coordinate_bitwise(self, glmix, mesh_ctx, tmp_path):
+        ref_man = write_re_entity_blocks(
+            glmix, RE_CFG, str(tmp_path / "ref"), block_entities=16
+        )
+        ref = StreamingRandomEffectCoordinate(
+            ref_man, TaskType.LOGISTIC_REGRESSION,
+            OptimizerType.LBFGS, RE_OPT, RE_REG,
+            state_root=str(tmp_path / "ref-state"),
+        )
+        man = build_perhost_streaming_manifest(
+            _host_rows(glmix), RE_CFG, str(tmp_path / "ph"), mesh_ctx, 1, 0,
+            block_entities=16, shared_vocab=glmix.id_vocabs["userId"],
+        )
+        ph = PerHostStreamingRandomEffectCoordinate(
+            man, TaskType.LOGISTIC_REGRESSION,
+            OptimizerType.LBFGS, RE_OPT, RE_REG,
+            state_root=str(tmp_path / "ph-state"),
+            ctx=mesh_ctx, num_processes=1,
+        )
+        # identical block FILES (tensors byte-for-byte)
+        assert [b["file"] for b in man.blocks] == [b["file"] for b in ref_man.blocks]
+        for b in ref_man.blocks:
+            z1 = np.load(os.path.join(ref_man.dir, b["file"]))
+            z2 = np.load(os.path.join(man.dir, b["file"]))
+            for k in z1.files:
+                np.testing.assert_array_equal(z1[k], z2[k], err_msg=(b["file"], k))
+        resid = jnp.asarray(
+            np.random.default_rng(5).normal(size=glmix.num_rows)
+            .astype(np.float32)
+        )
+        s_ref, _ = ref.update(resid, ref.initial_coefficients())
+        s_ph, _ = ph.update(resid, ph.initial_coefficients())
+        np.testing.assert_array_equal(
+            np.asarray(ref.score(s_ref)), np.asarray(ph.score(s_ph))
+        )
+        assert float(ref.regularization_term(s_ref)) == float(
+            ph.regularization_term(s_ph)
+        )
+        assert ph.num_entities == 40
+
+    @pytest.mark.parametrize("opt", [OptimizerType.LBFGS, OptimizerType.TRON])
+    def test_fe_coordinate_bitwise(self, mesh_ctx, opt):
+        rng = np.random.default_rng(3)
+        n, d = 700, 6
+        x = rng.normal(size=(n, d)).astype(np.float32)
+        w_true = rng.normal(size=d).astype(np.float32)
+        y = (1 / (1 + np.exp(-x @ w_true)) > rng.random(n)).astype(np.float32)
+        prob = GLMOptimizationProblem(
+            TaskType.LOGISTIC_REGRESSION, opt,
+            OptimizerConfig(max_iterations=6, tolerance=1e-8),
+            RegularizationContext.l2(0.3),
+        )
+        src = ChunkedGLMSource.from_arrays(x, y, 128)
+        ref = StreamingFixedEffectCoordinate(src, prob)
+        sizes = [len(load()["y"]) for load in src.loaders]
+        ph = PerHostStreamingFixedEffectCoordinate(
+            sizes, dict(enumerate(src.loaders)), d, prob,
+            ctx=mesh_ctx, num_processes=1,
+        )
+        resid = jnp.asarray(rng.normal(size=n).astype(np.float32))
+        w_ref, _ = ref.update(resid, ref.initial_coefficients())
+        w_ph, _ = ph.update(resid, ph.initial_coefficients())
+        np.testing.assert_array_equal(np.asarray(w_ref), np.asarray(w_ph))
+        np.testing.assert_array_equal(
+            np.asarray(ref.score(w_ref)), np.asarray(ph.score(w_ph))
+        )
+
+    def test_merge_disjoint_single_process_identity(self, mesh_ctx):
+        a = np.random.default_rng(0).normal(size=(3, 5)).astype(np.float32)
+        out = merge_disjoint(a, mesh_ctx, 1)
+        np.testing.assert_array_equal(out, a)
+        a64 = a.astype(np.float64)
+        np.testing.assert_array_equal(merge_disjoint(a64, mesh_ctx, 1), a64)
+
+
+class TestFaultSites:
+    """The new multihost fault/preempt surfaces are chaos-injectable (and
+    therefore registered — photon-lint's fault-sites two-way check)."""
+
+    def test_block_write_fault_retried(self, glmix, mesh_ctx, tmp_path,
+                                       monkeypatch):
+        monkeypatch.setenv("PHOTON_FAULTS", "io.perhost_block_write:at=1")
+        man = build_perhost_streaming_manifest(
+            _host_rows(glmix), RE_CFG, str(tmp_path / "ph"), mesh_ctx, 1, 0,
+            block_entities=16, shared_vocab=glmix.id_vocabs["userId"],
+        )
+        assert len(man.blocks) == 3  # survived the injected write failure
+
+    def test_entity_route_fault_fires_single_process(self, glmix, mesh_ctx,
+                                                     tmp_path, monkeypatch):
+        from photon_ml_tpu.resilience.faults import InjectedIOError
+
+        monkeypatch.setenv(
+            "PHOTON_FAULTS", "multihost.entity_route:rate=1.0,seed=7"
+        )
+        with pytest.raises(InjectedIOError, match="entity_route"):
+            build_perhost_streaming_manifest(
+                _host_rows(glmix), RE_CFG, str(tmp_path / "ph"), mesh_ctx,
+                1, 0, block_entities=16,
+                shared_vocab=glmix.id_vocabs["userId"],
+            )
+
+    def test_streaming_reduce_fault_retried(self, mesh_ctx, monkeypatch):
+        monkeypatch.setenv("PHOTON_FAULTS", "multihost.streaming_reduce:at=1")
+        a = np.ones((4,), np.float32)
+        np.testing.assert_array_equal(merge_disjoint(a, mesh_ctx, 1), a)
+
+
+class TestShardScopedCache:
+    """Satellite: per-host cache entries on a shared filesystem must not
+    collide or cross-read — the shard scope is folded into every key."""
+
+    def test_scope_separates_hosts_same_sources(self, tmp_path):
+        from photon_ml_tpu.io.tensor_cache import (
+            TensorCache,
+            process_shard_scope,
+        )
+
+        src = tmp_path / "input.bin"
+        src.write_bytes(b"shared source file")
+        cfg = {"kind": "streaming_re_blocks", "coord": "per-user"}
+        c0 = TensorCache(
+            str(tmp_path / "cache"),
+            shard_scope=process_shard_scope(0, 2),
+        )
+        c1 = TensorCache(
+            str(tmp_path / "cache"),
+            shard_scope=process_shard_scope(1, 2),
+        )
+        k0, k1 = c0.key_for([str(src)], cfg), c1.key_for([str(src)], cfg)
+        assert k0 != k1  # same sources+config, different hosts: no collision
+        c0.put(k0, {"w": np.zeros(3, np.float32)}, meta={"host": 0})
+        c1.put(k1, {"w": np.ones(3, np.float32)}, meta={"host": 1})
+        # no cross-read: each host gets ITS tensors back
+        assert c0.get(k0).meta["host"] == 0
+        assert c1.get(k1).meta["host"] == 1
+        np.testing.assert_array_equal(c1.get(k1).arrays["w"], np.ones(3))
+        # a topology change re-scopes (2 hosts -> 4 must rebuild, not reuse)
+        c0b = TensorCache(
+            str(tmp_path / "cache"),
+            shard_scope=process_shard_scope(0, 4),
+        )
+        assert c0b.key_for([str(src)], cfg) != k0
+
+    def test_unscoped_keys_unchanged(self, tmp_path):
+        """shard_scope=None hashes exactly as before (existing caches stay
+        warm across this upgrade)."""
+        from photon_ml_tpu.io.tensor_cache import TensorCache, content_key
+
+        src = tmp_path / "input.bin"
+        src.write_bytes(b"x")
+        cache = TensorCache(str(tmp_path / "cache"))
+        assert cache.key_for([str(src)], {"a": 1}) == content_key(
+            [str(src)], {"a": 1}
+        )
+
+
+class TestParams:
+    """The streaming x distributed fence is GONE and the combination
+    parses; the neighbouring fences stay."""
+
+    def _parse(self, *extra):
+        from photon_ml_tpu.cli.game_params import parse_training_params
+
+        return parse_training_params([
+            "--train-input-dirs", "in", "--task-type", "LOGISTIC_REGRESSION",
+            "--output-dir", "out", "--updating-sequence", "fixed",
+            "--fixed-effect-data-configurations", "fixed:global,1",
+            *extra,
+        ])
+
+    def test_streaming_with_distributed_parses(self):
+        p = self._parse(
+            "--streaming-random-effects", "true", "--distributed", "true"
+        )
+        assert p.streaming_random_effects and p.distributed
+
+    def test_memory_budget_with_distributed_parses(self):
+        p = self._parse(
+            "--re-memory-budget-mb", "64", "--distributed", "true"
+        )
+        assert p.streaming_random_effects and p.re_memory_budget_mb == 64.0
+
+    def test_old_fence_error_gone(self):
+        import pytest as _pytest
+
+        try:
+            self._parse(
+                "--streaming-random-effects", "true", "--distributed", "true"
+            )
+        except ValueError as e:  # pragma: no cover - regression guard
+            _pytest.fail(f"streaming x distributed fence resurfaced: {e}")
+
+    def test_streaming_fused_cycle_fence_stays(self):
+        with pytest.raises(ValueError, match="fused-cycle|fused_cycle"):
+            self._parse(
+                "--streaming-random-effects", "true", "--fused-cycle", "true"
+            )
+
+    def test_streaming_bucketed_fence_stays(self):
+        with pytest.raises(ValueError, match="bucketed"):
+            self._parse(
+                "--streaming-random-effects", "true",
+                "--bucketed-random-effects", "true",
+            )
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _launch_workers(tmp_path, env_extra=None):
+    port = _free_port()
+    env = {**os.environ, **(env_extra or {})}
+    return [
+        subprocess.Popen(
+            [sys.executable, WORKER, str(i), "2", str(port), str(tmp_path)],
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+            text=True, cwd=REPO, env=env,
+        )
+        for i in range(2)
+    ]
+
+
+@pytest.mark.slow
+def test_two_process_streaming_cd_bitwise_vs_single_host(tmp_path):
+    """THE acceptance gate: the 2-process entity-sharded streaming CD run
+    (agree -> plan -> route -> owned blocks -> streaming CD with exact mesh
+    merges) is bitwise-equal to the single-host streaming run of the same
+    data — update + score + full CD cycles over both coordinates."""
+    procs = _launch_workers(tmp_path)
+    outs = []
+    for p in procs:
+        out, err = p.communicate(timeout=900)
+        assert p.returncode == 0, f"worker failed:\n{out[-3000:]}\n{err[-3000:]}"
+        outs.append(out)
+    assert all("PHSOK" in o for o in outs)
+
+    # ---- the single-host streaming reference (same seeded data) -----------
+    data = _sorted_vocab_data(
+        np.random.default_rng(97),
+        num_users=60, rows_per_user_range=(4, 16), d_fixed=5, d_random=4,
+    )
+    N = data.num_rows
+    man = write_re_entity_blocks(
+        data, RE_CFG, str(tmp_path / "ref-blocks"), block_entities=16
+    )
+    re_ref = StreamingRandomEffectCoordinate(
+        man, TaskType.LOGISTIC_REGRESSION,
+        OptimizerType.LBFGS, RE_OPT, RE_REG,
+        state_root=str(tmp_path / "ref-state"),
+    )
+    gf = data.shards["global"]
+    x_fe = np.zeros((N, gf.dim), np.float32)
+    x_fe[np.repeat(np.arange(N), np.diff(gf.indptr)), gf.indices] = gf.values
+    fe_ref = StreamingFixedEffectCoordinate(
+        ChunkedGLMSource.from_arrays(
+            x_fe, data.response.astype(np.float32), 128
+        ),
+        GLMOptimizationProblem(
+            TaskType.LOGISTIC_REGRESSION, OptimizerType.LBFGS,
+            OptimizerConfig(max_iterations=6, tolerance=1e-8),
+            RegularizationContext.l2(0.5),
+        ),
+    )
+    from photon_ml_tpu.algorithm.coordinate_descent import CoordinateDescent
+
+    labels = jnp.asarray(data.response.astype(np.float32))
+    weights = jnp.asarray(data.weight.astype(np.float32))
+    loss = losses_mod.for_task(TaskType.LOGISTIC_REGRESSION)
+    cd = CoordinateDescent(
+        {"fixed": fe_ref, "per-user": re_ref},
+        lambda s: jnp.sum(weights * loss.loss(s, labels)),
+    )
+    ref = cd.run(num_iterations=2, num_rows=N)
+
+    run = np.load(tmp_path / "run.npz")
+    np.testing.assert_array_equal(
+        run["fe"], np.asarray(ref.coefficients["fixed"])
+    )
+    np.testing.assert_array_equal(
+        run["total_scores"], np.asarray(ref.total_scores)
+    )
+    np.testing.assert_array_equal(
+        run["objectives"], np.asarray(ref.objective_history, np.float64)
+    )
+    # per-entity coefficients: the union of the two hosts' owned means must
+    # equal the single-host export exactly, entity for entity
+    ref_means = re_ref.entity_means_by_raw_id(ref.coefficients["per-user"])
+    merged = {}
+    for pid in range(2):
+        z = np.load(tmp_path / f"means-host{pid}.npz", allow_pickle=True)
+        for name, vec in zip(z["names"], z["stack"]):
+            assert name not in merged  # owner-computes: disjoint ownership
+            merged[str(name)] = vec
+    assert sorted(merged) == sorted(ref_means)
+    for k, vec in ref_means.items():
+        np.testing.assert_array_equal(merged[k], vec, err_msg=k)
+
+
+@pytest.mark.slow
+def test_multihost_driver_streaming_random_effects(tmp_path):
+    """Driver-level end-to-end: the 2-process multihost driver with
+    --streaming-random-effects runs the per-host streaming path (per-host
+    manifest layout under the output dir, per-file FE chunk passes,
+    per-host model parts) and matches the single-process streaming driver's
+    model and validation metrics."""
+    from game_test_utils import launch_multihost, make_glmix_data, write_game_avro
+
+    rng = np.random.default_rng(23)
+    data, truth = make_glmix_data(
+        rng, num_users=18, rows_per_user_range=(6, 16), d_fixed=4, d_random=3
+    )
+    n_all = data.num_rows
+    n = int(n_all * 0.85)
+    train_dir = tmp_path / "train"
+    val_dir = tmp_path / "validate"
+    train_dir.mkdir(); val_dir.mkdir()
+    bounds = np.linspace(0, n, 5).astype(int)  # 4 train parts (FE chunks)
+    for pi in range(4):
+        write_game_avro(
+            str(train_dir / f"part-{pi}.avro"), data,
+            range(bounds[pi], bounds[pi + 1]), truth,
+        )
+    vb = np.linspace(n, n_all, 3).astype(int)
+    # the two hosts must decode DIFFERENT max-nnz widths (real data skew):
+    # validation file 1's rows keep only their first random feature, so the
+    # routed-scoring exchange only works if the hosts collectively agree
+    # the record width before packing (regression for the width-agreement)
+    truth["x_random"][vb[1]:vb[2], 1:] = 0.0
+    for pi in range(2):
+        write_game_avro(
+            str(val_dir / f"part-{pi}.avro"), data,
+            range(vb[pi], vb[pi + 1]), truth,
+        )
+    from photon_ml_tpu.cli import feature_indexing, game_training_driver
+    from photon_ml_tpu.io import model_io
+    from photon_ml_tpu.io.offheap import load_shard_index_map
+
+    idx_dir = str(tmp_path / "index")
+    feature_indexing.main([
+        "--data-input-dirs", str(train_dir),
+        "--output-dir", idx_dir, "--partition-num", "1",
+        "--feature-shard-id-to-feature-section-keys-map",
+        "global:fixedFeatures|per_user:userFeatures",
+    ])
+    flags = [
+        "--train-input-dirs", str(train_dir),
+        "--validate-input-dirs", str(val_dir),
+        "--evaluator-type", "AUC",
+        "--task-type", "LOGISTIC_REGRESSION",
+        "--updating-sequence", "fixed,per-user",
+        "--feature-shard-id-to-feature-section-keys-map",
+        "global:fixedFeatures|per_user:userFeatures",
+        "--fixed-effect-optimization-configurations",
+        "fixed:30,1e-9,0.1,1,LBFGS,L2",
+        "--fixed-effect-data-configurations", "fixed:global,2",
+        "--random-effect-optimization-configurations",
+        "per-user:25,1e-9,0.5,1,LBFGS,L2",
+        "--random-effect-data-configurations",
+        "per-user:userId,per_user,2,-1,0,-1,index_map",
+        "--num-iterations", "2",
+        "--streaming-random-effects", "true",
+        "--offheap-indexmap-dir", idx_dir,
+        "--delete-output-dir-if-exists", "true",
+    ]
+    import json as _json
+
+    outs = launch_multihost(
+        "game_multihost_driver",
+        ["--output-dir", str(tmp_path / "mh-out")] + flags,
+        result_expr="print('MHVAL', json.dumps(res['validation_metrics']))",
+    )
+    mh_metrics = [
+        _json.loads(line.split("MHVAL ", 1)[1])
+        for o in outs for line in o.splitlines() if line.startswith("MHVAL")
+    ]
+    assert len(mh_metrics) == 2 and mh_metrics[0] == mh_metrics[1]
+
+    # per-host manifest layout on disk: each process built only ITS blocks
+    for pid in range(2):
+        assert (
+            tmp_path / "mh-out" / "streaming-re" / "per-user"
+            / f"process-{pid}" / "manifest.json"
+        ).exists()
+
+    sp = game_training_driver.main(
+        ["--output-dir", str(tmp_path / "sp-out")] + flags
+    )
+    sp_metrics = sp.results[sp.best_index][2]
+    assert mh_metrics[0]["AUC"] == pytest.approx(sp_metrics["AUC"], abs=2e-3)
+    imap_u = load_shard_index_map(idx_dir, "per_user")
+    re_mh, _, re_id, _ = model_io.load_random_effect(
+        str(tmp_path / "mh-out" / "best"), "per-user", imap_u
+    )
+    re_sp, _, _, _ = model_io.load_random_effect(
+        str(tmp_path / "sp-out" / "best"), "per-user", imap_u
+    )
+    assert re_id == "userId"
+    assert set(re_mh) == set(re_sp)  # every entity, real raw ids
+    for eid in re_sp:
+        np.testing.assert_allclose(
+            re_mh[eid], re_sp[eid], rtol=5e-3, atol=5e-4, err_msg=eid
+        )
+    # the model was written as per-host part files (owner-computes save)
+    parts = os.listdir(
+        tmp_path / "mh-out" / "best" / "random-effect" / "per-user"
+        / "coefficients"
+    )
+    assert len(parts) == 2
+
+
+@pytest.mark.slow
+def test_two_process_lost_host_mid_block_is_diagnosable(tmp_path):
+    """Chaos: host 1 dies HARD after its first block spill inside the
+    update. The survivor must NOT hang: either our cooperative barrier
+    deadline fires (BarrierTimeoutError naming the heartbeat diagnosis
+    path, the PR-5 health-fencing contract) or jax's coordination service
+    detects the dead peer's missed heartbeats first and fails the job with
+    an UNAVAILABLE diagnosis — both are diagnosable failures whose
+    recovery is the restart supervisor, and both must land well inside the
+    harness deadline."""
+    procs = _launch_workers(
+        tmp_path,
+        env_extra={"PERHOST_LOSE_HOST": "1", "PHOTON_BARRIER_TIMEOUT": "25"},
+    )
+    outs, codes = [], []
+    for p in procs:
+        out, err = p.communicate(timeout=600)  # the no-hang gate
+        outs.append(out + err)
+        codes.append(p.returncode)
+    assert codes[1] == 17, outs[1][-2000:]  # the lost host died where told
+    assert "LOSTHOST-DYING" in outs[1]
+    assert codes[0] != 0, outs[0][-2000:]  # survivor failed, not hung
+    assert "LOSTHOST-UNDETECTED" not in outs[0]
+    diagnosed = (
+        "LOSTHOST-DETECTED BarrierTimeoutError" in outs[0]  # our fence
+        or "heartbeat timeout" in outs[0]  # the runtime's fence beat ours
+        or "UNAVAILABLE" in outs[0]
+    )
+    assert diagnosed, outs[0][-2000:]
