@@ -541,6 +541,262 @@ def _bench_serving(extra, on_tpu):
         shutil.rmtree(tmp, ignore_errors=True)
 
 
+def _bench_serving_fleet(extra, on_tpu):
+    """Sharded serving fleet (photon_ml_tpu/serve/fleet): aggregate QPS and
+    p99 vs replica count (1/2/4) under concurrent traffic, the
+    bitwise-vs-single-store gate at 2 replicas, and a kill-one-replica
+    availability arm (heartbeat detection + degraded serving, no hang).
+
+    Replicas run as REAL subprocesses (the cli.fleet_driver replica mode
+    over TCP), each subprocess-fenced with its own timeout. Honesty note
+    (the perhost_streaming caveat, serving form): on one machine every
+    replica time-shares the same cores with the router and the client
+    threads, so QPS-vs-replicas here measures protocol/routing overhead
+    and CAPACITY (each replica's slab is ~1/N of the model), not the
+    linear throughput scaling a real N-host fleet gets. Replica children
+    are pinned to CPU — the TPU tunnel is single-client and must not be
+    claimed by N serving processes."""
+    import concurrent.futures
+    import shutil
+    import signal  # noqa: F401 — documents the kill arm's mechanism
+    import socket
+    import subprocess
+    import tempfile
+    import time as _time
+
+    from game_test_utils import (
+        game_avro_records,
+        make_glmix_data,
+        save_synthetic_game_model,
+        serve_requests_from_records,
+    )
+
+    from photon_ml_tpu.compile import ShapeBucketer
+    from photon_ml_tpu.serve import (
+        FleetStats,
+        ModelStore,
+        ScoringServer,
+        ServeStats,
+        build_model_store,
+    )
+    from photon_ml_tpu.serve.fleet import (
+        FleetRouter,
+        ServeShardPlan,
+        TcpReplicaClient,
+        build_fleet_stores,
+        load_fleet_meta,
+    )
+
+    tmp = tempfile.mkdtemp(prefix="bench-serving-fleet-")
+    here = os.path.dirname(os.path.abspath(__file__))
+    sections_flag = "global:fixedFeatures|per_user:userFeatures"
+    sections = {"global": ["fixedFeatures"], "per_user": ["userFeatures"]}
+    procs_alive = []
+
+    def spawn_replica(fleet_dir, r, n, hb_dir, timeout=240):
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"
+        log_path = os.path.join(tmp, f"replica-n{n}-{r}.log")
+        # stderr to a FILE, stdout a pipe only for the one READY line (the
+        # perhost lesson: children must never block on a full parent pipe)
+        with open(log_path, "w") as lf:
+            proc = subprocess.Popen(
+                [sys.executable, "-m", "photon_ml_tpu.cli.fleet_driver",
+                 "--fleet-dir", fleet_dir, "--replica-id", str(r),
+                 "--num-fleet-replicas", str(n), "--heartbeat-dir", hb_dir,
+                 "--feature-shard-id-to-feature-section-keys-map",
+                 sections_flag,
+                 "--max-batch-rows", "32", "--warm-nnz", "16"],
+                stdout=subprocess.PIPE, stderr=lf, text=True,
+                stdin=subprocess.DEVNULL, cwd=here, env=env,
+            )
+        procs_alive.append(proc)
+        deadline = _time.monotonic() + timeout
+        line = ""
+        # select-bounded wait: a crashed child (EOF) or a silently hung
+        # child must both hit THIS fence, not block readline forever or
+        # busy-spin on an empty closed stream
+        import select as _select
+
+        while _time.monotonic() < deadline:
+            if proc.poll() is not None:
+                break
+            ready, _, _ = _select.select([proc.stdout], [], [], 0.5)
+            if ready:
+                line = proc.stdout.readline().strip()
+                if line:
+                    break
+        if not line.startswith("READY "):
+            proc.kill()
+            with open(log_path) as f:
+                tail = f.read()[-1500:]
+            raise RuntimeError(
+                f"fleet replica {r}/{n} failed to come up within {timeout}s "
+                f"(got {line!r}):\n{tail}"
+            )
+        return proc, line.split()[1]
+
+    def tcp_shutdown(addr):
+        host, _, port = addr.rpartition(":")
+        try:
+            with socket.create_connection((host, int(port)), timeout=5) as s:
+                s.sendall(b'{"cmd": "shutdown"}\n')
+                s.recv(100)
+        except OSError:
+            pass
+
+    try:
+        rng = np.random.default_rng(19)
+        num_users = 128
+        d_fixed, d_random = 8, 6
+        data, truth = make_glmix_data(
+            rng, num_users=num_users, rows_per_user_range=(4, 8),
+            d_fixed=d_fixed, d_random=d_random,
+        )
+        offsets = rng.normal(size=data.num_rows).astype(np.float32)
+        model_dir = os.path.join(tmp, "model")
+        save_synthetic_game_model(
+            model_dir, rng, d_fixed=d_fixed, d_random=d_random,
+            num_users=num_users,
+        )
+        records = list(
+            game_avro_records(data, range(data.num_rows), truth, offsets)
+        )
+        reqs = serve_requests_from_records(records)
+
+        # single-store reference (the bitwise oracle)
+        store_dir = os.path.join(tmp, "store")
+        build_model_store(model_dir, store_dir, bucketer=ShapeBucketer())
+        server = ScoringServer(
+            ModelStore(store_dir), shard_sections=sections,
+            max_batch_rows=32, max_wait_ms=2.0, stats=ServeStats(),
+        )
+        server.warmup(warm_nnz=16)
+        single_scores = server.score_rows(reqs)
+        server.close()
+
+        def fire(router, requests, workers=16):
+            with concurrent.futures.ThreadPoolExecutor(workers) as pool:
+                futs = list(
+                    pool.map(lambda q: router.submit_rows([q]), requests)
+                )
+            return np.concatenate([f.result(timeout=120) for f in futs])
+
+        qps_vs_replicas = {}
+        bitwise = None
+        for n in (1, 2, 4):
+            fleet_dir = os.path.join(tmp, f"fleet-{n}")
+            build_fleet_stores(
+                model_dir, fleet_dir, num_replicas=n,
+                bucketer=ShapeBucketer(),
+            )
+            hb_dir = os.path.join(tmp, f"hb-{n}")
+            procs, addrs = [], []
+            for r in range(n):
+                p, addr = spawn_replica(fleet_dir, r, n, hb_dir)
+                procs.append(p)
+                addrs.append(addr)
+            router = FleetRouter(
+                load_fleet_meta(fleet_dir),
+                [TcpReplicaClient(a) for a in addrs],
+                heartbeat_dir=hb_dir, heartbeat_deadline_s=3.0,
+                request_timeout_s=60.0, stats=FleetStats(),
+            )
+            served = fire(router, reqs)  # warm connections + gate data
+            snap0 = router.stats.snapshot()
+            router.stats.reset()
+            fire(router, reqs)  # the measured pass
+            snap = router.stats.snapshot()
+            qps_vs_replicas[str(n)] = {
+                "qps": snap["qps"],
+                "p50_ms": snap["p50_ms"],
+                "p99_ms": snap["p99_ms"],
+                "scatter_calls": snap["scatter_calls"],
+            }
+            _log(
+                f"serving_fleet[{n} replica(s)]: {snap['qps']} req/s, "
+                f"p50 {snap['p50_ms']}ms / p99 {snap['p99_ms']}ms "
+                f"({snap['scatter_calls']} scatter calls; first pass "
+                f"degraded_rows={snap0['degraded_rows']})"
+            )
+            if n == 2:
+                bitwise = bool(np.array_equal(served, single_scores))
+
+                # ---- kill-one-replica availability arm --------------------
+                procs[1].kill()
+                t0 = _time.monotonic()
+                while 1 in router.live_replicas():
+                    if _time.monotonic() - t0 > 15.0:
+                        raise AssertionError(
+                            "router failed to mark the killed replica dead "
+                            "within the heartbeat deadline"
+                        )
+                    _time.sleep(0.2)
+                detect_s = _time.monotonic() - t0
+                router.stats.reset()
+                t0 = _time.monotonic()
+                degraded = fire(router, reqs)
+                degrade_pass_s = _time.monotonic() - t0
+                dsnap = router.stats.snapshot()
+                plan = ServeShardPlan.from_json(
+                    load_fleet_meta(fleet_dir)["plan"]
+                )
+                owners = plan.owners_of(
+                    [q["ids"]["userId"] for q in reqs]
+                )
+                exact = owners == 0
+                if not np.array_equal(degraded[exact], single_scores[exact]):
+                    raise AssertionError(
+                        "kill-one-replica: surviving replica's rows are "
+                        "not exact"
+                    )
+                extra["serving_fleet_kill_one"] = {
+                    "heartbeat_detect_s": round(detect_s, 2),
+                    "answered": int(len(degraded)),
+                    "requests": int(len(reqs)),
+                    "degraded_rows": int(dsnap["degraded_rows"]),
+                    "exact_rows": int(exact.sum()),
+                    "pass_seconds": round(degrade_pass_s, 2),
+                }
+                _log(
+                    f"serving_fleet kill-one: dead in {detect_s:.2f}s, "
+                    f"{len(degraded)}/{len(reqs)} answered "
+                    f"({int(exact.sum())} exact, "
+                    f"{dsnap['degraded_rows']} degraded rows)"
+                )
+            router.close()
+            for a in addrs:
+                tcp_shutdown(a)
+            for p in procs:
+                try:
+                    p.wait(timeout=60)
+                except subprocess.TimeoutExpired:
+                    p.kill()
+                    p.wait()
+        if not bitwise:
+            raise AssertionError(
+                "2-replica fleet scores are not bitwise-equal to the "
+                "single-store server"
+            )
+        extra["serving_fleet_qps_vs_replicas"] = qps_vs_replicas
+        extra["serving_fleet_bitwise_equal_to_single_store"] = True
+        extra["serving_fleet_config"] = {
+            "rows": int(data.num_rows), "entities": num_users,
+            "d_fixed": d_fixed, "d_random": d_random,
+            "note": (
+                "replicas time-share one machine's cores with the router "
+                "and clients; QPS-vs-replicas measures routing overhead "
+                "and capacity, not N-host scaling"
+            ),
+        }
+    finally:
+        for p in procs_alive:
+            if p.poll() is None:
+                p.kill()
+                p.wait()
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
 def _bench_perhost(extra, on_tpu):
     """Per-host ingest shuffle (parallel/shuffle + perhost_ingest): rows/sec
     through the full collective regroup — bucket-count psum, balanced owner
@@ -2051,7 +2307,8 @@ SECTION_ORDER = (
     "dense", "sparse", "sparse_race", "game", "game5", "grid",
     "streaming", "streaming_pipeline", "compile_reuse", "compaction",
     "preemption_resume",
-    "perhost", "perhost_streaming", "scoring", "serving", "ingest",
+    "perhost", "perhost_streaming", "scoring", "serving", "serving_fleet",
+    "ingest",
 )
 # orchestrator per-section deadlines (s): generous — tunnel compiles are slow,
 # and hitting a deadline DETACHES the child (never kills: r3 claim-orphan
@@ -2062,7 +2319,10 @@ SECTION_DEADLINES = {"dense": 3600, "game": 3600, "game5": 2400, "grid": 2400,
                      # the section deadline must EXCEED their sum
                      # (1200 + 1800 + 5100) or a legitimately slow run is
                      # detached even though every worker honored its fence
-                     "perhost_streaming": 8700}
+                     "perhost_streaming": 8700,
+                     # 3 fleets (1/2/4 replicas) of warmed subprocess
+                     # replicas + the kill arm, each spawn fenced at 240s
+                     "serving_fleet": 3600}
 DEFAULT_SECTION_DEADLINE = 1800
 
 
@@ -2193,6 +2453,8 @@ def _run_sections(names, extra, errors, on_tpu, state=None, after=None):
                 _bench_scoring(extra, on_tpu)
             elif name == "serving":
                 _bench_serving(extra, on_tpu)
+            elif name == "serving_fleet":
+                _bench_serving_fleet(extra, on_tpu)
             elif name == "ingest":
                 _bench_ingest(extra)
         except Exception:  # noqa: BLE001 — per-section fence: failure recorded in errors, bench continues

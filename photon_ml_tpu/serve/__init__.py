@@ -19,6 +19,10 @@ framework; transport is the deployment's problem):
 
 Driver: ``photon_ml_tpu.cli.serve_driver`` (``bench.py serving`` publishes
 latency/QPS vs micro-batch size and the swap proof).
+
+Fleet: :mod:`photon_ml_tpu.serve.fleet` shards the store across replicas
+behind a consistent-hash router for models that cannot fit one host
+(``bench.py serving_fleet``; driver ``photon_ml_tpu.cli.fleet_driver``).
 """
 
 from __future__ import annotations
@@ -30,10 +34,11 @@ from photon_ml_tpu.serve.model_store import (
     is_model_store,
 )
 from photon_ml_tpu.serve.server import ScoringServer, serve_json_lines
-from photon_ml_tpu.serve.stats import ServeStats, serve_stats
+from photon_ml_tpu.serve.stats import FleetStats, ServeStats, serve_stats
 from photon_ml_tpu.serve.swap import ModelSwapper
 
 __all__ = [
+    "FleetStats",
     "MicroBatcher",
     "ModelStore",
     "ModelSwapper",

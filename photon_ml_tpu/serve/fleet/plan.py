@@ -1,0 +1,244 @@
+"""Serving shard plan: which fleet replica owns which entities.
+
+The training side already solved deterministic entity partitioning
+(PR 9, :mod:`photon_ml_tpu.parallel.perhost_streaming`): hash entities
+into stable buckets, cost the buckets, and bin-pack buckets onto owners
+with the greedy balanced partitioner — every participant derives the
+identical assignment from the same inputs with no coordination. The
+serving fleet reuses EXACTLY that machinery
+(:func:`~photon_ml_tpu.parallel.shuffle.stable_entity_keys` /
+:func:`~photon_ml_tpu.parallel.shuffle.bucket_of` /
+:func:`~photon_ml_tpu.parallel.shuffle.balanced_bucket_owners`) so:
+
+  * the router maps a request's raw entity id -> bucket -> owner replica
+    with two array lookups and ZERO model state (a thin router — it never
+    opens a slab or a feature map);
+  * the export side (:func:`build_fleet_stores`) filters each replica's
+    store to exactly the entities the router will send it;
+  * the plan is a small explicit placement object (the DrJAX framing,
+    arXiv:2403.07128) that travels in ``fleet.json`` and is VALIDATED on
+    swap — a new model generation must carry the identical plan, or
+    routing and slab ownership would silently diverge.
+
+Consistent hashing note: ownership is per-bucket, not per-replica-modulo,
+so a future re-shard (ROADMAP "elastic entity re-sharding") moves only the
+buckets whose owner changed.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from photon_ml_tpu.parallel.shuffle import (
+    balanced_bucket_owners,
+    bucket_of,
+    stable_entity_keys,
+)
+
+#: default bucket count: plenty of granularity for balanced packing at
+#: small fleet sizes while keeping the plan object tiny
+DEFAULT_NUM_BUCKETS = 64
+
+FLEET_META_FILE = "fleet.json"
+FLEET_FORMAT = "game-serve-fleet"
+FLEET_VERSION = 1
+REPLICA_DIR_FMT = "replica-{r}"
+
+
+@dataclasses.dataclass(frozen=True)
+class ServeShardPlan:
+    """bucket -> owner replica, derived deterministically from the model's
+    entity population (counts per bucket) alone."""
+
+    num_replicas: int
+    num_buckets: int
+    owners: np.ndarray  # (num_buckets,) int32 owner replica per bucket
+
+    @classmethod
+    def build(
+        cls,
+        entity_ids: List[str],
+        num_replicas: int,
+        num_buckets: int = DEFAULT_NUM_BUCKETS,
+    ) -> "ServeShardPlan":
+        """Plan from the model's entity ids (union across coordinates):
+        bucket-count the population, then balanced bin-packing of buckets
+        onto replicas — identical on every builder for identical inputs."""
+        if num_replicas < 1:
+            raise ValueError(f"num_replicas must be >= 1, got {num_replicas}")
+        if num_buckets < num_replicas:
+            raise ValueError(
+                f"num_buckets ({num_buckets}) must be >= num_replicas "
+                f"({num_replicas})"
+            )
+        counts = np.zeros(num_buckets, np.int64)
+        if entity_ids:
+            buckets = bucket_of(stable_entity_keys(entity_ids), num_buckets)
+            counts += np.bincount(buckets, minlength=num_buckets)
+        owners = balanced_bucket_owners(counts, num_replicas)
+        return cls(
+            num_replicas=num_replicas,
+            num_buckets=num_buckets,
+            owners=owners.astype(np.int32),
+        )
+
+    # -- routing -------------------------------------------------------------
+    def bucket_of_raw(self, raw_id: str) -> int:
+        return int(bucket_of(stable_entity_keys([str(raw_id)]), self.num_buckets)[0])
+
+    def owner_of(self, raw_id: Optional[str]) -> int:
+        """Owner replica of an entity id; -1 for a row with no id (its
+        random-effect contribution is 0 wherever it is computed)."""
+        if raw_id is None:
+            return -1
+        return int(self.owners[self.bucket_of_raw(raw_id)])
+
+    def owners_of(self, raw_ids: List[Optional[str]]) -> np.ndarray:
+        """(n,) int32 owner per raw id (-1 for None) — the vectorized form
+        the router uses per request batch."""
+        out = np.full(len(raw_ids), -1, np.int32)
+        present = [i for i, r in enumerate(raw_ids) if r is not None]
+        if present:
+            ids = [str(raw_ids[i]) for i in present]
+            owned = self.owners[bucket_of(stable_entity_keys(ids), self.num_buckets)]
+            out[np.asarray(present)] = owned
+        return out
+
+    # -- (de)serialization ---------------------------------------------------
+    def to_json(self) -> dict:
+        return {
+            "num_replicas": self.num_replicas,
+            "num_buckets": self.num_buckets,
+            "owners": [int(o) for o in self.owners],
+        }
+
+    @classmethod
+    def from_json(cls, obj: dict) -> "ServeShardPlan":
+        owners = np.asarray(obj["owners"], np.int32)
+        if len(owners) != int(obj["num_buckets"]):
+            raise ValueError(
+                f"plan owners length {len(owners)} != num_buckets "
+                f"{obj['num_buckets']}"
+            )
+        return cls(
+            num_replicas=int(obj["num_replicas"]),
+            num_buckets=int(obj["num_buckets"]),
+            owners=owners,
+        )
+
+    def same_assignment(self, other: "ServeShardPlan") -> bool:
+        """True when routing under ``self`` and ``other`` is identical —
+        the fleet-swap compatibility requirement (a plan change means slabs
+        moved; that is a re-shard, not a swap)."""
+        return (
+            self.num_replicas == other.num_replicas
+            and self.num_buckets == other.num_buckets
+            and bool(np.array_equal(self.owners, other.owners))
+        )
+
+
+def replica_store_dir(fleet_dir: str, replica: int) -> str:
+    return os.path.join(fleet_dir, REPLICA_DIR_FMT.format(r=replica))
+
+
+def build_fleet_stores(
+    model_dir: str,
+    fleet_dir: str,
+    num_replicas: int,
+    num_buckets: int = DEFAULT_NUM_BUCKETS,
+    bucketer=None,
+    num_partitions: int = 1,
+    force_python: bool = False,
+) -> dict:
+    """Export one saved GAME model into ``num_replicas`` sharded serving
+    stores plus a ``fleet.json`` plan.
+
+    Replica r's store (``<fleet_dir>/replica-r/``) carries the FULL feature
+    index and fixed-effect vectors (replicated — any replica can compute a
+    fixed contribution) and only the random-effect slab rows of the
+    entities the plan assigns to r. The union of the replica slabs is
+    exactly the single-store export, partitioned disjointly.
+    """
+    from photon_ml_tpu.io import avro as avro_io
+    from photon_ml_tpu.io import model_io
+    from photon_ml_tpu.serve.model_store import build_model_store
+
+    # entity population (union across RE coordinates) for bucket costing
+    layout = model_io.list_game_model(model_dir)
+    entity_ids: List[str] = []
+    for name in layout[model_io.RANDOM_EFFECT]:
+        for rec in avro_io.read_directory(
+            os.path.join(
+                model_dir, model_io.RANDOM_EFFECT, name, model_io.COEFFICIENTS
+            )
+        ):
+            entity_ids.append(str(rec["modelId"]))
+    all_ids = sorted(set(entity_ids))
+    plan = ServeShardPlan.build(all_ids, num_replicas, num_buckets)
+    # ONE vectorized ownership pass; the per-replica filter is then a set
+    # probe per record, not a per-record hash round-trip x num_replicas
+    owners = plan.owners_of(all_ids)
+    owned_ids = [
+        frozenset(i for i, o in zip(all_ids, owners) if o == r)
+        for r in range(num_replicas)
+    ]
+
+    os.makedirs(fleet_dir, exist_ok=True)
+    replica_meta: List[dict] = []
+    for r in range(num_replicas):
+        meta = build_model_store(
+            model_dir,
+            replica_store_dir(fleet_dir, r),
+            num_partitions=num_partitions,
+            bucketer=bucketer,
+            force_python=force_python,
+            entity_filter=owned_ids[r].__contains__,
+        )
+        replica_meta.append(
+            {
+                "replica": r,
+                "store_dir": os.path.abspath(replica_store_dir(fleet_dir, r)),
+                "entities": {e["name"]: e["entities"] for e in meta["random"]},
+            }
+        )
+    # coordinate order comes from the LAST store meta — every replica store
+    # lists the same coordinates in the same order (same source model)
+    fleet_meta = {
+        "format": FLEET_FORMAT,
+        "version": FLEET_VERSION,
+        "source_model_dir": os.path.abspath(model_dir),
+        "task": meta["task"],
+        "plan": plan.to_json(),
+        "fixed": meta["fixed"],
+        "random": [
+            {"name": e["name"], "re_id": e["re_id"], "shard": e["shard"]}
+            for e in meta["random"]
+        ],
+        "replicas": replica_meta,
+    }
+    tmp = os.path.join(fleet_dir, FLEET_META_FILE + ".tmp")
+    with open(tmp, "w") as f:
+        json.dump(fleet_meta, f, indent=1)
+    os.replace(tmp, os.path.join(fleet_dir, FLEET_META_FILE))
+    return fleet_meta
+
+
+def is_fleet_dir(path: str) -> bool:
+    try:
+        with open(os.path.join(path, FLEET_META_FILE)) as f:
+            return json.load(f).get("format") == FLEET_FORMAT
+    except (OSError, ValueError):
+        return False
+
+
+def load_fleet_meta(fleet_dir: str) -> dict:
+    with open(os.path.join(fleet_dir, FLEET_META_FILE)) as f:
+        meta = json.load(f)
+    if meta.get("format") != FLEET_FORMAT:
+        raise IOError(f"{fleet_dir} is not a {FLEET_FORMAT} directory")
+    return meta

@@ -39,7 +39,7 @@ import dataclasses
 import json
 import logging
 import os
-from typing import Dict, List, Optional
+from typing import Callable, Dict, List, Optional
 
 import numpy as np
 
@@ -94,6 +94,7 @@ def build_model_store(
     num_partitions: int = 1,
     bucketer: Optional[ShapeBucketer] = None,
     force_python: bool = False,
+    entity_filter: Optional[Callable[[str], bool]] = None,
 ) -> dict:
     """Export a saved GAME model dir into the serving layout. Returns the
     written meta dict.
@@ -103,6 +104,12 @@ def build_model_store(
     time. Features a request carries that the model never weighted resolve
     to index -1 and drop out, which contributes exactly the 0.0 their zero
     coefficient would have.
+
+    ``entity_filter`` (serve/fleet sharded export) keeps only the matching
+    random-effect entities in each slab while the feature vocabulary,
+    feature index order, and fixed-effect vectors stay the FULL model's —
+    every fleet replica agrees bitwise on the feature space and fixed
+    coefficients, and owns only its slab partition.
     """
     layout = model_io.list_game_model(model_dir)
     fixed_entries = []
@@ -189,6 +196,8 @@ def build_model_store(
         base = os.path.join(store_dir, RANDOM_DIR, name)
         os.makedirs(base, exist_ok=True)
         recs = random_recs[name]
+        if entity_filter is not None:
+            recs = [r for r in recs if entity_filter(str(r["modelId"]))]
         entity_ids = sorted(str(rec["modelId"]) for rec in recs)
         build_slab_index(
             os.path.join(base, ROWS_DIR),
@@ -198,7 +207,11 @@ def build_model_store(
         )
         rows = SlabRowIndex(os.path.join(base, ROWS_DIR), force_python=force_python)
         n_entities = rows.num_rows
-        padded = bucketer.canon(n_entities) if bucketer is not None else n_entities
+        padded = (
+            bucketer.canon(max(n_entities, 1))
+            if bucketer is not None
+            else n_entities
+        )
         slab = np.zeros((max(padded, 1), len(maps[shard])), np.float32)
         for rec in recs:
             row = rows.get_row(str(rec["modelId"]))

@@ -147,5 +147,78 @@ class ServeStats:
         )
 
 
+class FleetStats(ServeStats):
+    """Router-side fleet telemetry on top of the per-server registry:
+    scatter fan-out, hedges, routed retries, degraded rows (a dead owner's
+    random-effect contribution replaced by the cold-entity 0), and
+    fleet-swap accounting. The request/latency/QPS surface is inherited so
+    the serve driver's stats command works unchanged against a router."""
+
+    def __init__(self, max_samples: int = 100_000):
+        super().__init__(max_samples)
+        self.scatter_calls = 0
+        self.hedges = 0
+        self.reroutes = 0
+        self.routed_retries = 0
+        self.stale_rescores = 0
+        self.degraded_rows = 0
+        self.dead_replica_skips = 0
+
+    def record_scatter(self, num_subrequests: int) -> None:
+        with self._lock:
+            self.scatter_calls += num_subrequests
+
+    def record_hedge(self) -> None:
+        with self._lock:
+            self.hedges += 1
+
+    def record_reroute(self) -> None:
+        with self._lock:
+            self.reroutes += 1
+
+    def record_routed_retry(self) -> None:
+        with self._lock:
+            self.routed_retries += 1
+
+    def record_stale_rescore(self) -> None:
+        with self._lock:
+            self.stale_rescores += 1
+
+    def record_degraded_rows(self, n: int) -> None:
+        with self._lock:
+            self.degraded_rows += n
+
+    def record_dead_replica_skip(self) -> None:
+        with self._lock:
+            self.dead_replica_skips += 1
+
+    def snapshot(self) -> Dict[str, float]:
+        snap = super().snapshot()
+        with self._lock:
+            snap.update(
+                {
+                    "scatter_calls": self.scatter_calls,
+                    "hedges": self.hedges,
+                    "reroutes": self.reroutes,
+                    "routed_retries": self.routed_retries,
+                    "stale_rescores": self.stale_rescores,
+                    "degraded_rows": self.degraded_rows,
+                    "dead_replica_skips": self.dead_replica_skips,
+                }
+            )
+        return snap
+
+    def reset(self) -> None:
+        super().reset()
+        with self._lock:
+            self.scatter_calls = 0
+            self.hedges = 0
+            self.reroutes = 0
+            self.routed_retries = 0
+            self.stale_rescores = 0
+            self.degraded_rows = 0
+            self.dead_replica_skips = 0
+
+
 #: process-wide default registry (servers may carry their own instance)
 serve_stats = ServeStats()

@@ -348,6 +348,7 @@ def save_synthetic_game_model(
     d_random: int = 3,
     num_users: int = 12,
     scale: float = 1.0,
+    task=None,
 ):
     """Persist a random (untrained) GAME model in the reference layout:
     fixed effect 'fixed' on shard 'global' (features f0..f{d_fixed-1}) and
@@ -364,17 +365,18 @@ def save_synthetic_game_model(
     umap = IndexMap.build(
         [feature_key(f"u{j}", "") for j in range(d_random)], add_intercept=True
     )
+    task = task or TaskType.LOGISTIC_REGRESSION
     w_fixed = (rng.normal(size=len(fmap)) * scale).astype(np.float32)
     entity_means = {
         f"u{i}": (rng.normal(size=len(umap)) * scale).astype(np.float32)
         for i in range(num_users)
     }
     model_io.save_fixed_effect(
-        model_dir, "fixed", TaskType.LOGISTIC_REGRESSION, w_fixed, fmap,
+        model_dir, "fixed", task, w_fixed, fmap,
         feature_shard_id="global",
     )
     model_io.save_random_effect(
-        model_dir, "per-user", TaskType.LOGISTIC_REGRESSION, entity_means,
+        model_dir, "per-user", task, entity_means,
         umap, random_effect_id="userId", feature_shard_id="per_user",
     )
     return w_fixed, entity_means, fmap, umap

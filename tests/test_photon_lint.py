@@ -134,6 +134,21 @@ def test_default_scope_covers_the_hot_paths():
     assert any(p.startswith("tools/photon_lint/") for p in scanned)
 
 
+def test_fleet_package_in_scan_scope():
+    """The serving-fleet package (PR 11) is inside the default scan scope,
+    module by module — a bare jit, broad except, or unregistered fault
+    site in the router/replica/swap path cannot land without tripping
+    tier-1."""
+    paths = [os.path.join(REPO, p) for p in engine.DEFAULT_SCOPE]
+    scanned = {
+        os.path.relpath(p, REPO).replace(os.sep, "/")
+        for p in engine.iter_py_files(paths)
+    }
+    for mod in ("plan", "replica", "router", "swap", "transport", "__init__"):
+        assert f"photon_ml_tpu/serve/fleet/{mod}.py" in scanned
+    assert "photon_ml_tpu/cli/fleet_driver.py" in scanned
+
+
 # ---------------------------------------------------------------------------
 # engine: suppression-tag grammar
 # ---------------------------------------------------------------------------

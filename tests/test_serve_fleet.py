@@ -1,0 +1,859 @@
+"""Sharded serving fleet tests (photon_ml_tpu/serve/fleet).
+
+Covers the fleet acceptance claims:
+
+  * ServeShardPlan: deterministic, balanced, stable across builders;
+    refused on swap when the assignment differs.
+  * Sharded export: replica slabs partition the single store's entities
+    disjointly; fixed effects and feature maps replicate bitwise.
+  * BITWISE gate: 2-replica fleet scores (scatter -> owner contributions
+    -> pinned-order sum) == the single-store PR 6 server == the batch
+    scoring driver, under concurrent traffic.
+  * Fleet-wide atomic swap: zero new compiles (watermark), zero dropped
+    requests, and every in-flight request scores entirely at ONE
+    generation (old or new, never a mix); any prepare/barrier failure
+    aborts with the old generation intact everywhere.
+  * Chaos: injected route failure fails ONE request cleanly; an injected
+    scatter failure is retried to a bitwise-intact result; a replica lost
+    mid-request degrades (fixed reroutes exactly, random falls back to
+    the cold-entity 0) and recovers after the probe cooldown — never a
+    hang.
+  * Multi-process arms (slow): replica subprocesses over TCP — bitwise,
+    fleet swap under live traffic, kill -9 one replica with heartbeat
+    detection inside the deadline.
+"""
+
+import concurrent.futures
+import io
+import json
+import os
+import signal
+import socket
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from game_test_utils import (
+    game_avro_records,
+    make_glmix_data,
+    save_synthetic_game_model,
+    serve_requests_from_records,
+    write_game_avro,
+)
+
+from photon_ml_tpu.compile import ShapeBucketer, compile_stats
+from photon_ml_tpu.resilience import faults
+from photon_ml_tpu.serve import (
+    FleetStats,
+    ModelStore,
+    ScoringServer,
+    ServeStats,
+    build_model_store,
+)
+from photon_ml_tpu.serve.fleet import (
+    FleetRouter,
+    FleetSwapError,
+    FleetSwapper,
+    LocalReplicaClient,
+    NoLiveReplicaError,
+    ReplicaEngine,
+    ServeShardPlan,
+    build_fleet_stores,
+    is_fleet_dir,
+    load_fleet_meta,
+    replica_store_dir,
+)
+
+pytestmark = pytest.mark.fleet
+
+SECTIONS = {"global": ["fixedFeatures"], "per_user": ["userFeatures"]}
+SECTIONS_FLAG = "global:fixedFeatures|per_user:userFeatures"
+NUM_USERS = 10
+
+
+@pytest.fixture(scope="module")
+def fleet_world(tmp_path_factory):
+    """One synthetic model + requests + single store + 2-replica fleet
+    export + a perturbed second model/fleet for swap arms."""
+    base = tmp_path_factory.mktemp("fleet")
+    rng = np.random.default_rng(1142)
+    data, truth = make_glmix_data(
+        rng, num_users=NUM_USERS, rows_per_user_range=(6, 12),
+        d_fixed=5, d_random=3,
+    )
+    offsets = rng.normal(size=data.num_rows).astype(np.float32)
+    model_dir = str(base / "model")
+    save_synthetic_game_model(
+        model_dir, rng, d_fixed=5, d_random=3, num_users=NUM_USERS
+    )
+    in_dir = base / "in"
+    in_dir.mkdir()
+    write_game_avro(
+        str(in_dir / "part-0.avro"), data, range(data.num_rows), truth, offsets
+    )
+    store_dir = str(base / "store")
+    build_model_store(model_dir, store_dir, bucketer=ShapeBucketer())
+    fleet_dir = str(base / "fleet")
+    meta = build_fleet_stores(
+        model_dir, fleet_dir, num_replicas=2, bucketer=ShapeBucketer()
+    )
+    model2 = str(base / "model2")
+    save_synthetic_game_model(
+        model2, np.random.default_rng(1143), d_fixed=5, d_random=3,
+        num_users=NUM_USERS,
+    )
+    fleet2 = str(base / "fleet2")
+    build_fleet_stores(
+        model2, fleet2, num_replicas=2, bucketer=ShapeBucketer()
+    )
+    records = list(game_avro_records(data, range(data.num_rows), truth, offsets))
+    return {
+        "base": base,
+        "model_dir": model_dir,
+        "model2": model2,
+        "in_dir": str(in_dir),
+        "store_dir": store_dir,
+        "fleet_dir": fleet_dir,
+        "fleet2": fleet2,
+        "meta": meta,
+        "records": records,
+        "requests": serve_requests_from_records(records),
+    }
+
+
+def _single_server(world, **kw):
+    server = ScoringServer(
+        ModelStore(world["store_dir"]), shard_sections=SECTIONS,
+        max_batch_rows=kw.pop("max_batch_rows", 16),
+        max_wait_ms=kw.pop("max_wait_ms", 1.0), stats=ServeStats(), **kw,
+    )
+    server.warmup(warm_nnz=8)
+    return server
+
+
+def _engines(fleet_dir, n=2, **kw):
+    engines = []
+    for r in range(n):
+        e = ReplicaEngine(
+            ModelStore(replica_store_dir(fleet_dir, r)),
+            replica_id=r, num_replicas=n, shard_sections=SECTIONS,
+            max_batch_rows=16, max_wait_ms=1.0, stats=ServeStats(), **kw,
+        )
+        e.warmup(warm_nnz=8)
+        engines.append(e)
+    return engines
+
+
+def _local_fleet(world, fleet_dir=None, n=2, **router_kw):
+    fleet_dir = fleet_dir or world["fleet_dir"]
+    engines = _engines(fleet_dir, n)
+    clients = [LocalReplicaClient(e) for e in engines]
+    router = FleetRouter(
+        load_fleet_meta(fleet_dir), clients, stats=FleetStats(), **router_kw
+    )
+    return router, engines, clients
+
+
+def _close_fleet(router, engines):
+    router.close()
+    for e in engines:
+        e.close()
+
+
+def _run_scoring_driver(world, out_dir):
+    from photon_ml_tpu.cli import game_scoring_driver
+
+    return game_scoring_driver.main([
+        "--input-dirs", world["in_dir"],
+        "--game-model-input-dir", world["model_dir"],
+        "--output-dir", str(out_dir),
+        "--offheap-indexmap-dir", os.path.join(world["store_dir"], "features"),
+        "--feature-shard-id-to-feature-section-keys-map", SECTIONS_FLAG,
+        "--delete-output-dir-if-exists", "true",
+    ])
+
+
+# ---------------------------------------------------------------------------
+# ServeShardPlan
+# ---------------------------------------------------------------------------
+
+
+class TestServeShardPlan:
+    def test_deterministic_and_balanced(self):
+        ids = [f"user-{i}" for i in range(1000)]
+        p1 = ServeShardPlan.build(ids, num_replicas=4, num_buckets=64)
+        p2 = ServeShardPlan.build(ids, num_replicas=4, num_buckets=64)
+        assert p1.same_assignment(p2)
+        owners = p1.owners_of(ids)
+        counts = np.bincount(owners, minlength=4)
+        # balanced blocking: no replica more than ~2x the mean
+        assert counts.min() > 0
+        assert counts.max() <= 2 * counts.mean()
+
+    def test_owner_of_matches_vectorized(self):
+        ids = [f"e{i}" for i in range(50)]
+        plan = ServeShardPlan.build(ids, num_replicas=3, num_buckets=12)
+        vec = plan.owners_of(ids + [None])
+        for i, raw in enumerate(ids):
+            assert plan.owner_of(raw) == vec[i]
+        assert vec[-1] == -1
+        assert plan.owner_of(None) == -1
+
+    def test_json_roundtrip_and_mismatch(self):
+        plan = ServeShardPlan.build([f"e{i}" for i in range(20)], 2, 8)
+        again = ServeShardPlan.from_json(
+            json.loads(json.dumps(plan.to_json()))
+        )
+        assert plan.same_assignment(again)
+        other = ServeShardPlan.build([f"e{i}" for i in range(20)], 2, 16)
+        assert not plan.same_assignment(other)
+        with pytest.raises(ValueError, match="owners length"):
+            ServeShardPlan.from_json(
+                {"num_replicas": 2, "num_buckets": 8, "owners": [0, 1]}
+            )
+
+    def test_build_validation(self):
+        with pytest.raises(ValueError, match="num_replicas"):
+            ServeShardPlan.build(["a"], 0)
+        with pytest.raises(ValueError, match="num_buckets"):
+            ServeShardPlan.build(["a"], 4, num_buckets=2)
+
+
+# ---------------------------------------------------------------------------
+# Sharded export
+# ---------------------------------------------------------------------------
+
+
+class TestFleetStores:
+    def test_fleet_layout_and_meta(self, fleet_world):
+        assert is_fleet_dir(fleet_world["fleet_dir"])
+        assert not is_fleet_dir(fleet_world["store_dir"])
+        meta = load_fleet_meta(fleet_world["fleet_dir"])
+        assert meta["plan"]["num_replicas"] == 2
+        assert [e["name"] for e in meta["fixed"]] == ["fixed"]
+        assert [e["name"] for e in meta["random"]] == ["per-user"]
+        assert meta["random"][0]["re_id"] == "userId"
+
+    def test_slabs_partition_disjointly(self, fleet_world):
+        full = ModelStore(fleet_world["store_dir"])
+        plan = ServeShardPlan.from_json(fleet_world["meta"]["plan"])
+        owned = {r: set() for r in range(2)}
+        for r in range(2):
+            shard = ModelStore(replica_store_dir(fleet_world["fleet_dir"], r))
+            for i in range(NUM_USERS):
+                raw = f"u{i}"
+                if shard.entity_row("per-user", raw) >= 0:
+                    owned[r].add(raw)
+                    # every present entity row carries the full store's
+                    # exact coefficient vector
+                    re_full = full.random[0]
+                    re_shard = shard.random[0]
+                    np.testing.assert_array_equal(
+                        np.sort(np.asarray(
+                            re_shard.slab[shard.entity_row("per-user", raw)]
+                        )),
+                        np.sort(np.asarray(
+                            re_full.slab[full.entity_row("per-user", raw)]
+                        )),
+                    )
+                    assert plan.owner_of(raw) == r
+            shard.close()
+        assert owned[0] | owned[1] == {f"u{i}" for i in range(NUM_USERS)}
+        assert not (owned[0] & owned[1])
+        full.close()
+
+    def test_fixed_and_features_replicated(self, fleet_world):
+        full = ModelStore(fleet_world["store_dir"])
+        for r in range(2):
+            shard = ModelStore(replica_store_dir(fleet_world["fleet_dir"], r))
+            np.testing.assert_array_equal(
+                np.asarray(shard.fixed[0].coefficients),
+                np.asarray(full.fixed[0].coefficients),
+            )
+            for s in full.feature_maps:
+                assert shard.shard_dim(s) == full.shard_dim(s)
+            shard.close()
+        full.close()
+
+
+# ---------------------------------------------------------------------------
+# Bitwise parity — THE fleet gate
+# ---------------------------------------------------------------------------
+
+
+class TestFleetParity:
+    def test_fleet_bitwise_equal_single_store_and_driver(
+        self, fleet_world, tmp_path
+    ):
+        drv = _run_scoring_driver(fleet_world, tmp_path / "drv")
+        server = _single_server(fleet_world)
+        single = server.score_rows(fleet_world["requests"])
+        server.close()
+        assert np.array_equal(single, drv.scores)
+
+        router, engines, _ = _local_fleet(fleet_world)
+        with concurrent.futures.ThreadPoolExecutor(8) as pool:
+            futs = list(pool.map(
+                lambda q: router.submit_rows([q]), fleet_world["requests"]
+            ))
+        served = np.concatenate([f.result(timeout=60) for f in futs])
+        assert np.array_equal(served, single)
+        snap = router.stats.snapshot()
+        assert snap["requests"] == len(fleet_world["requests"])
+        assert snap["degraded_rows"] == 0
+        assert snap["scatter_calls"] >= snap["requests"]
+        _close_fleet(router, engines)
+
+    def test_single_replica_fleet_matches(self, fleet_world, tmp_path):
+        fleet1 = str(tmp_path / "fleet1")
+        build_fleet_stores(
+            fleet_world["model_dir"], fleet1, num_replicas=1,
+            bucketer=ShapeBucketer(),
+        )
+        server = _single_server(fleet_world)
+        single = server.score_rows(fleet_world["requests"])
+        server.close()
+        router, engines, _ = _local_fleet(fleet_world, fleet_dir=fleet1, n=1)
+        served = router.score_rows(fleet_world["requests"])
+        assert np.array_equal(served, single)
+        _close_fleet(router, engines)
+
+    def test_cold_entity_and_empty(self, fleet_world):
+        router, engines, _ = _local_fleet(fleet_world)
+        req = fleet_world["requests"][0]
+        cold = dict(req, ids={"userId": "never-seen-user"})
+        bare = dict(req, ids={})
+        np.testing.assert_array_equal(
+            router.score_rows([cold]), router.score_rows([bare])
+        )
+        assert router.score_rows([]).shape == (0,)
+        _close_fleet(router, engines)
+
+    def test_multi_row_requests(self, fleet_world):
+        server = _single_server(fleet_world)
+        single = server.score_rows(fleet_world["requests"])
+        server.close()
+        router, engines, _ = _local_fleet(fleet_world)
+        served = router.score_rows(fleet_world["requests"])
+        assert np.array_equal(served, single)
+        _close_fleet(router, engines)
+
+
+# ---------------------------------------------------------------------------
+# Fleet-wide atomic swap
+# ---------------------------------------------------------------------------
+
+
+class TestFleetSwap:
+    def _fleet_scores(self, world, fleet_dir):
+        router, engines, _ = _local_fleet(world, fleet_dir=fleet_dir)
+        scores = router.score_rows(world["requests"])
+        _close_fleet(router, engines)
+        return scores
+
+    def test_swap_atomic_zero_compiles_zero_drops(self, fleet_world):
+        old_ref = self._fleet_scores(fleet_world, fleet_world["fleet_dir"])
+        new_ref = self._fleet_scores(fleet_world, fleet_world["fleet2"])
+        # the two generations disagree on every row (so a mixed-generation
+        # score could not hide)
+        assert not np.any(old_ref == new_ref)
+
+        router, engines, _ = _local_fleet(fleet_world)
+        swapper = FleetSwapper(router)
+        reqs = fleet_world["requests"]
+        wm = compile_stats.watermark()
+        with concurrent.futures.ThreadPoolExecutor(8) as pool:
+            futs = [pool.submit(router.score_rows, [q]) for q in reqs]
+            report = swapper.swap(fleet_world["fleet2"])
+            results = [f.result(timeout=60) for f in futs]
+        assert report["new_compiles"] == 0
+        assert report["dropped_requests"] == 0
+        assert report["commit_failures"] == []
+        assert wm.new_traces() == 0
+        assert len(results) == len(reqs)
+        # no mixed generations: every in-flight request matches EXACTLY one
+        # generation's reference, bitwise
+        for i, r in enumerate(results):
+            assert len(r) == 1
+            assert r[0] == old_ref[i] or r[0] == new_ref[i]
+        # post-swap traffic serves the new model
+        after = router.score_rows(reqs)
+        assert np.array_equal(after, new_ref)
+        assert router.generation == 1
+        assert all(e.epoch == 1 for e in engines)
+        assert router.stats.snapshot()["swaps"] == 1
+        _close_fleet(router, engines)
+
+    def test_swap_aborts_on_prepare_failure(self, fleet_world, tmp_path):
+        """A missing shard store on ONE replica aborts the whole roll; the
+        old generation keeps serving everywhere."""
+        import shutil
+
+        broken = str(tmp_path / "broken-fleet")
+        shutil.copytree(fleet_world["fleet2"], broken)
+        shutil.rmtree(replica_store_dir(broken, 1))
+        router, engines, _ = _local_fleet(fleet_world)
+        before = router.score_rows(fleet_world["requests"][:4])
+        with pytest.raises(FleetSwapError, match="aborted"):
+            FleetSwapper(router).swap(broken)
+        assert router.generation == 0
+        assert all(e.epoch == 0 for e in engines)
+        after = router.score_rows(fleet_world["requests"][:4])
+        np.testing.assert_array_equal(before, after)
+        _close_fleet(router, engines)
+
+    def test_swap_refuses_plan_mismatch(self, fleet_world, tmp_path):
+        other = str(tmp_path / "threeway")
+        build_fleet_stores(
+            fleet_world["model2"], other, num_replicas=3,
+            bucketer=ShapeBucketer(),
+        )
+        router, engines, _ = _local_fleet(fleet_world)
+        with pytest.raises(FleetSwapError, match="re-shard"):
+            FleetSwapper(router).swap(other)
+        assert router.generation == 0
+        _close_fleet(router, engines)
+
+    def test_requests_submitted_before_swap_stay_on_old_generation(
+        self, fleet_world
+    ):
+        """The PR 6 pinning contract, router form: a request SUBMITTED
+        before the flip scores the old generation even if it is still
+        queued when the swap lands (the swapper fences replica retirement
+        on the old generation's drain). Without submission pinning, a
+        burst of queued requests silently re-scores on the new model."""
+        old_ref = self._fleet_scores(fleet_world, fleet_world["fleet_dir"])
+        router, engines, _ = _local_fleet(fleet_world, max_request_workers=2)
+        reqs = fleet_world["requests"]
+        # saturate the 2 request workers so most submissions sit queued
+        # across the swap, then flip
+        futs = [router.submit_rows([q]) for q in reqs]
+        report = FleetSwapper(router).swap(fleet_world["fleet2"])
+        results = np.concatenate([f.result(timeout=60) for f in futs])
+        assert report["generation"] == 1
+        np.testing.assert_array_equal(results, old_ref)
+        assert router.stats.snapshot()["stale_rescores"] == 0
+        _close_fleet(router, engines)
+
+    def test_fresh_router_joins_swapped_fleet(self, fleet_world):
+        """A router restarted against a fleet that already swapped must
+        adopt the fleet's epoch (sync at startup, stale fast-forward as
+        the safety net) instead of erroring at generation 0 forever."""
+        new_ref = self._fleet_scores(fleet_world, fleet_world["fleet2"])
+        router, engines, clients = _local_fleet(fleet_world)
+        FleetSwapper(router).swap(fleet_world["fleet2"])
+        # a SECOND router over the same (now epoch-1) engines, born at 0
+        router2 = FleetRouter(
+            load_fleet_meta(fleet_world["fleet_dir"]), clients,
+            stats=FleetStats(),
+        )
+        assert router2.sync_generation() == 1
+        served = router2.score_rows(fleet_world["requests"])
+        np.testing.assert_array_equal(served, new_ref)
+        # and WITHOUT the sync, the stale fast-forward still recovers in
+        # one re-score instead of spinning
+        router3 = FleetRouter(
+            load_fleet_meta(fleet_world["fleet_dir"]), clients,
+            stats=FleetStats(),
+        )
+        served3 = router3.score_rows(fleet_world["requests"])
+        np.testing.assert_array_equal(served3, new_ref)
+        assert router3.stats.snapshot()["stale_rescores"] >= 1
+        assert router3.generation == 1
+        router2.close()  # LocalReplicaClient.close is a no-op: safe to share
+        router3.close()
+        _close_fleet(router, engines)
+
+    def test_commit_straggler_redriven_on_next_swap(
+        self, fleet_world, tmp_path
+    ):
+        """A commit message lost in transit must not wedge the fleet: the
+        lagging replica keeps serving the staged epoch, and the NEXT swap
+        re-drives the commit before rolling forward."""
+        router, engines, clients = _local_fleet(fleet_world)
+        # manual partial roll to epoch 1: prepare everywhere, flip, but
+        # "lose" replica 1's commit
+        for r in range(2):
+            resp = clients[r].call({
+                "cmd": "prepare",
+                "store_dir": replica_store_dir(fleet_world["fleet2"], r),
+                "epoch": 1,
+            })
+            assert resp["ok"], resp
+        router.flip_generation(1)
+        assert clients[0].call({"cmd": "commit", "epoch": 1})["ok"]
+        assert engines[0].epoch == 1 and engines[1].epoch == 0
+        # the straggler's staged bundle still answers generation-1 reads
+        assert len(router.score_rows(fleet_world["requests"][:4])) == 4
+        # next swap: commit(1) is re-driven on replica 1, then the fleet
+        # rolls to epoch 2
+        fleet3 = str(tmp_path / "fleet3")
+        build_fleet_stores(
+            fleet_world["model_dir"], fleet3, num_replicas=2,
+            bucketer=ShapeBucketer(),
+        )
+        report = FleetSwapper(router).swap(fleet3)
+        assert report["generation"] == 2
+        assert report["commit_failures"] == []
+        assert all(e.epoch == 2 for e in engines)
+        _close_fleet(router, engines)
+
+    def test_barrier_fault_aborts_cleanly(self, fleet_world):
+        """An injected barrier failure between prepare and flip abandons
+        every staged bundle — the fleet swap is all-or-nothing."""
+        router, engines, _ = _local_fleet(fleet_world)
+        plan = faults.FaultPlan(
+            [faults.FaultSpec("serve.fleet_swap_barrier", at=1)]
+        )
+        with faults.fault_scope(plan):
+            with pytest.raises(FleetSwapError, match="barrier"):
+                FleetSwapper(router).swap(fleet_world["fleet2"])
+        assert router.generation == 0
+        assert all(e.epoch == 0 for e in engines)
+        # nothing staged leaks; the NEXT swap succeeds
+        report = FleetSwapper(router).swap(fleet_world["fleet2"])
+        assert report["generation"] == 1
+        assert report["new_compiles"] == 0
+        _close_fleet(router, engines)
+
+
+# ---------------------------------------------------------------------------
+# Chaos: route faults, scatter faults, lost replicas
+# ---------------------------------------------------------------------------
+
+
+class TestFleetChaos:
+    def test_injected_route_failure_fails_one_request(self, fleet_world):
+        router, engines, _ = _local_fleet(fleet_world)
+        plan = faults.FaultPlan([faults.FaultSpec("serve.route", at=1)])
+        with faults.fault_scope(plan):
+            with pytest.raises(OSError):
+                router.score_rows(fleet_world["requests"][:1])
+            # the router keeps serving after the failed request
+            scores = router.score_rows(fleet_world["requests"][:2])
+        assert len(scores) == 2
+        _close_fleet(router, engines)
+
+    def test_injected_scatter_failure_retries_bitwise(self, fleet_world):
+        server = _single_server(fleet_world)
+        ref = server.score_rows(fleet_world["requests"])
+        server.close()
+        router, engines, _ = _local_fleet(fleet_world)
+        plan = faults.FaultPlan(
+            [faults.FaultSpec("serve.replica_scatter", at=1)]
+        )
+        with faults.fault_scope(plan):
+            served = router.score_rows(fleet_world["requests"])
+        # the routed retry recovered the sub-request: result still bitwise
+        assert np.array_equal(served, ref)
+        assert router.stats.snapshot()["routed_retries"] >= 1
+        _close_fleet(router, engines)
+
+    def test_replica_lost_mid_request_degrades_and_recovers(
+        self, fleet_world
+    ):
+        """Kill replica 1's client: its random-effect rows degrade to the
+        cold-entity fallback (exactly offset+fixed, computed by reroute),
+        nothing hangs, and the replica rejoins after the probe cooldown."""
+        server = _single_server(fleet_world)
+        ref = server.score_rows(fleet_world["requests"])
+        # reference for total degradation of per-user: strip the ids
+        cold_reqs = [
+            dict(q, ids={}) for q in fleet_world["requests"]
+        ]
+        cold_ref = server.score_rows(cold_reqs)
+        server.close()
+
+        router, engines, clients = _local_fleet(
+            fleet_world, probe_cooldown_s=0.2
+        )
+        plan = ServeShardPlan.from_json(fleet_world["meta"]["plan"])
+        owners = plan.owners_of(
+            [q["ids"]["userId"] for q in fleet_world["requests"]]
+        )
+        clients[1].fail_mode = "killed"
+        t0 = time.monotonic()
+        served = router.score_rows(fleet_world["requests"])
+        assert time.monotonic() - t0 < 30.0  # degraded, not hung
+        # replica-0 rows unaffected; replica-1 rows = cold-entity fallback
+        for i in range(len(served)):
+            expect = ref[i] if owners[i] == 0 else cold_ref[i]
+            assert served[i] == expect, i
+        snap = router.stats.snapshot()
+        assert snap["degraded_rows"] > 0
+        assert snap["routed_retries"] >= 1
+
+        # circuit broken: later requests skip the dead replica outright
+        router.score_rows(fleet_world["requests"][:2])
+        assert 1 not in router.live_replicas()
+
+        # recovery: heal the client, wait out the probe cooldown, and the
+        # full bitwise result returns
+        clients[1].fail_mode = None
+        time.sleep(0.25)
+        healed = router.score_rows(fleet_world["requests"])
+        np.testing.assert_array_equal(healed, ref)
+        assert 1 in router.live_replicas()
+        _close_fleet(router, engines)
+
+    def test_all_replicas_dead_raises_not_hangs(self, fleet_world):
+        router, engines, clients = _local_fleet(fleet_world)
+        for c in clients:
+            c.fail_mode = "killed"
+        # early requests burn through retries/reroutes and degrade what
+        # they can (each breaks the circuits it touched); once every
+        # replica is circuit-broken the failure is structured, not a hang
+        raised = False
+        for _ in range(5):
+            try:
+                router.score_rows(fleet_world["requests"][:1])
+            except NoLiveReplicaError:
+                raised = True
+                break
+        assert raised
+        _close_fleet(router, engines)
+
+
+# ---------------------------------------------------------------------------
+# Smoothed-hinge SVM through the fleet (scenario-diversity satellite)
+# ---------------------------------------------------------------------------
+
+
+class TestSmoothedHingeFleet:
+    def test_smoothed_hinge_model_serves_through_fleet(self, tmp_path):
+        """A SMOOTHED_HINGE_LOSS_LINEAR_SVM model exports, shards, and
+        serves through the fleet; the task survives into both metas and
+        scores are bitwise the single store's (GAME serving scores are raw
+        margins for every loss family — the loss only shapes training)."""
+        from photon_ml_tpu.types import TaskType
+
+        rng = np.random.default_rng(7)
+        data, truth = make_glmix_data(
+            rng, num_users=6, rows_per_user_range=(4, 8), d_fixed=4,
+            d_random=2,
+        )
+        model_dir = str(tmp_path / "svm-model")
+        save_synthetic_game_model(
+            model_dir, rng, d_fixed=4, d_random=2, num_users=6,
+            task=TaskType.SMOOTHED_HINGE_LOSS_LINEAR_SVM,
+        )
+        records = list(game_avro_records(data, range(data.num_rows), truth))
+        reqs = serve_requests_from_records(records)
+        store_dir = str(tmp_path / "svm-store")
+        build_model_store(model_dir, store_dir, bucketer=ShapeBucketer())
+        store = ModelStore(store_dir)
+        assert store.meta["task"] == "SMOOTHED_HINGE_LOSS_LINEAR_SVM"
+        server = ScoringServer(
+            store, shard_sections=SECTIONS, max_batch_rows=16,
+            max_wait_ms=1.0, stats=ServeStats(),
+        )
+        server.warmup(warm_nnz=8)
+        single = server.score_rows(reqs)
+        server.close()
+
+        fleet_dir = str(tmp_path / "svm-fleet")
+        meta = build_fleet_stores(
+            model_dir, fleet_dir, num_replicas=2, bucketer=ShapeBucketer()
+        )
+        assert meta["task"] == "SMOOTHED_HINGE_LOSS_LINEAR_SVM"
+        engines = _engines(fleet_dir, 2)
+        router = FleetRouter(
+            meta, [LocalReplicaClient(e) for e in engines],
+            stats=FleetStats(),
+        )
+        served = router.score_rows(reqs)
+        assert np.array_equal(served, single)
+        _close_fleet(router, engines)
+
+
+# ---------------------------------------------------------------------------
+# Fleet params
+# ---------------------------------------------------------------------------
+
+
+class TestFleetParams:
+    def test_parse_validation(self):
+        from photon_ml_tpu.cli.game_params import GameFleetParams
+
+        with pytest.raises(ValueError, match="fleet-dir"):
+            GameFleetParams().validate()
+        with pytest.raises(ValueError, match="game-model-input-dir"):
+            GameFleetParams(fleet_dir="f", build_fleet_stores=True).validate()
+        with pytest.raises(ValueError, match="num-buckets"):
+            GameFleetParams(
+                fleet_dir="f", replica_id=0, num_fleet_replicas=4,
+                num_buckets=2,
+            ).validate()
+        with pytest.raises(ValueError, match="replica-id"):
+            GameFleetParams(
+                fleet_dir="f", replica_id=5, num_fleet_replicas=2,
+            ).validate()
+        with pytest.raises(ValueError, match="replica-addresses"):
+            GameFleetParams(fleet_dir="f", num_fleet_replicas=2).validate()
+        with pytest.raises(ValueError, match="hedge-ms"):
+            GameFleetParams(
+                fleet_dir="f", replica_id=0, hedge_ms=-1.0,
+            ).validate()
+        # valid: replica mode and router mode
+        GameFleetParams(fleet_dir="f", replica_id=0).validate()
+        GameFleetParams(
+            fleet_dir="f", num_fleet_replicas=2,
+            replica_addresses=["a:1", "b:2"],
+        ).validate()
+
+    def test_mode_resolution(self):
+        from photon_ml_tpu.cli.game_params import GameFleetParams
+
+        assert GameFleetParams(
+            fleet_dir="f", build_fleet_stores=True, game_model_input_dir="m"
+        ).mode() == "build"
+        assert GameFleetParams(fleet_dir="f", replica_id=1).mode() == "replica"
+        assert GameFleetParams(fleet_dir="f").mode() == "router"
+
+
+# ---------------------------------------------------------------------------
+# Multi-process fleet (TCP replicas as real subprocesses)
+# ---------------------------------------------------------------------------
+
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _spawn_replica(fleet_dir, r, n, hb_dir):
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    proc = subprocess.Popen(
+        [
+            sys.executable, "-m", "photon_ml_tpu.cli.fleet_driver",
+            "--fleet-dir", fleet_dir,
+            "--replica-id", str(r),
+            "--num-fleet-replicas", str(n),
+            "--heartbeat-dir", hb_dir,
+            "--feature-shard-id-to-feature-section-keys-map", SECTIONS_FLAG,
+            "--max-batch-rows", "16",
+            "--warm-nnz", "8",
+        ],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+        stdin=subprocess.DEVNULL, cwd=REPO, env=env,
+    )
+    line = proc.stdout.readline().strip()
+    assert line.startswith("READY "), (line, proc.stderr.read()[-2000:])
+    return proc, line.split()[1]
+
+
+def _tcp_shutdown(addr):
+    host, _, port = addr.rpartition(":")
+    try:
+        with socket.create_connection((host, int(port)), timeout=5) as s:
+            s.sendall(b'{"cmd": "shutdown"}\n')
+            s.recv(100)
+    except OSError:
+        pass
+
+
+@pytest.mark.slow
+class TestFleetMultiProcess:
+    @pytest.fixture()
+    def tcp_fleet(self, fleet_world, tmp_path):
+        from photon_ml_tpu.serve.fleet import TcpReplicaClient
+
+        hb_dir = str(tmp_path / "hb")
+        procs, addrs = [], []
+        try:
+            for r in range(2):
+                p, addr = _spawn_replica(
+                    fleet_world["fleet_dir"], r, 2, hb_dir
+                )
+                procs.append(p)
+                addrs.append(addr)
+            clients = [TcpReplicaClient(a) for a in addrs]
+            router = FleetRouter(
+                load_fleet_meta(fleet_world["fleet_dir"]), clients,
+                heartbeat_dir=hb_dir, heartbeat_deadline_s=2.0,
+                request_timeout_s=20.0, hedge_ms=2000.0,
+                probe_cooldown_s=0.5, stats=FleetStats(),
+            )
+            yield {
+                "router": router, "procs": procs, "addrs": addrs,
+                "hb_dir": hb_dir,
+            }
+        finally:
+            for a in addrs:
+                _tcp_shutdown(a)
+            for p in procs:
+                try:
+                    p.wait(timeout=30)
+                except subprocess.TimeoutExpired:
+                    p.kill()
+
+    def test_two_process_fleet_bitwise_and_swap(self, fleet_world, tcp_fleet):
+        """THE multi-process acceptance arm: subprocess replicas over TCP
+        serve bitwise-identical scores, and a fleet swap under concurrent
+        traffic is compile-free, drop-free, and generation-atomic."""
+        router = tcp_fleet["router"]
+        server = _single_server(fleet_world)
+        ref = server.score_rows(fleet_world["requests"])
+        server.close()
+        with concurrent.futures.ThreadPoolExecutor(8) as pool:
+            futs = list(pool.map(
+                lambda q: router.submit_rows([q]), fleet_world["requests"]
+            ))
+        served = np.concatenate([f.result(timeout=120) for f in futs])
+        assert np.array_equal(served, ref)
+
+        old_fleet = router.score_rows(fleet_world["requests"])
+        swapper = FleetSwapper(router)
+        with concurrent.futures.ThreadPoolExecutor(8) as pool:
+            futs = [
+                pool.submit(router.score_rows, [q])
+                for q in fleet_world["requests"]
+            ]
+            report = swapper.swap(fleet_world["fleet2"])
+            results = [f.result(timeout=120) for f in futs]
+        assert report["new_compiles"] == 0
+        assert report["commit_failures"] == []
+        assert all(len(r) == 1 for r in results)
+        new_fleet = router.score_rows(fleet_world["requests"])
+        assert not np.any(old_fleet == new_fleet)
+        for i, r in enumerate(results):
+            assert r[0] == old_fleet[i] or r[0] == new_fleet[i]
+        # compiles: the swap probe + post-swap traffic compiled nothing on
+        # any replica
+        assert router.new_request_compiles() == 0
+
+    def test_kill_one_replica_keeps_serving(self, fleet_world, tcp_fleet):
+        """Kill -9 replica 1 mid-traffic: heartbeats go stale, the router
+        stops dispatching within the deadline, and traffic keeps flowing
+        (documented degradation: dead owner's RE rows -> cold-entity 0) —
+        never a hang."""
+        router = tcp_fleet["router"]
+        ref = router.score_rows(fleet_world["requests"])
+        assert len(ref) == len(fleet_world["requests"])
+
+        tcp_fleet["procs"][1].kill()
+        t0 = time.monotonic()
+        while 1 in router.live_replicas():
+            assert time.monotonic() - t0 < 10.0, (
+                "router failed to mark the killed replica dead within the "
+                "heartbeat deadline"
+            )
+            time.sleep(0.2)
+        detect_s = time.monotonic() - t0
+        # detection rides the heartbeat deadline (2s) + one write interval
+        assert detect_s < 8.0
+
+        t0 = time.monotonic()
+        served = router.score_rows(fleet_world["requests"])
+        assert time.monotonic() - t0 < 30.0
+        assert len(served) == len(fleet_world["requests"])
+        # replica-0-owned rows are still exact
+        plan = ServeShardPlan.from_json(fleet_world["meta"]["plan"])
+        owners = plan.owners_of(
+            [q["ids"]["userId"] for q in fleet_world["requests"]]
+        )
+        exact = owners == 0
+        assert exact.any()
+        np.testing.assert_array_equal(served[exact], ref[exact])
+        assert router.stats.snapshot()["degraded_rows"] > 0
