@@ -913,6 +913,15 @@ def _perhost_worker_main(argv):
 
     ctx = MeshContext(data_mesh())
     result = {"process": pid}
+    # every policy resolved ONCE from the env (photon_ml_tpu.compile.plan):
+    # the compaction/sparse bench arm exports PHOTON_SOLVE_CHUNK /
+    # PHOTON_SPARSE_KERNEL and reuses this same worker; the default arm
+    # resolves all-off, so its path is byte-identical to before
+    from photon_ml_tpu.compile.plan import ExecutionPlan
+
+    exec_plan = ExecutionPlan.resolve(
+        distributed=(nprocs > 1), streaming=True, num_processes=nprocs
+    )
     if scale == "small":
         from game_test_utils import make_glmix_data
 
@@ -939,13 +948,20 @@ def _perhost_worker_main(argv):
             rows, RandomEffectDataConfig("userId", "per_user"),
             os.path.join(outdir, f"re-n{nprocs}-host{pid}"),
             ctx, nprocs, pid, block_entities=512,
+            bucketer=exec_plan.bucketer,
         )
         re_coord = PerHostStreamingRandomEffectCoordinate(
             manifest, TaskType.LOGISTIC_REGRESSION,
             optimizer=OptimizerType.LBFGS,
-            optimizer_config=OptimizerConfig(max_iterations=8, tolerance=1e-8),
+            # a realistic convergence profile (room to converge + a
+            # practical tolerance): most lanes finish early, stragglers
+            # run long — the skew the compaction arm's ledger measures
+            optimizer_config=OptimizerConfig(
+                max_iterations=30, tolerance=1e-6
+            ),
             regularization=RegularizationContext.l2(0.2),
             state_root=os.path.join(outdir, f"state-n{nprocs}-host{pid}"),
+            plan=exec_plan,
             ctx=ctx, num_processes=nprocs,
         )
         gf = data.shards["global"]
@@ -983,18 +999,44 @@ def _perhost_worker_main(argv):
             lambda s: jnp.sum(weights * loss.loss(s, labels)),
         )
         iters = 2
+
+        def run_digest():
+            res = cd.run(num_iterations=iters, num_rows=n)
+            h = hashlib.sha256()
+            h.update(np.asarray(res.coefficients["fixed"]).tobytes())
+            h.update(np.asarray(res.total_scores).tobytes())
+            h.update(repr([float(v) for v in res.objective_history]).encode())
+            return h.hexdigest()
+
         t0 = time.perf_counter()
-        res = cd.run(num_iterations=iters, num_rows=n)
+        digest = run_digest()
         elapsed = time.perf_counter() - t0
-        h = hashlib.sha256()
-        h.update(np.asarray(res.coefficients["fixed"]).tobytes())
-        h.update(np.asarray(res.total_scores).tobytes())
-        h.update(repr([float(v) for v in res.objective_history]).encode())
         result.update(
             sec_per_iter=elapsed / iters,
-            digest=h.hexdigest(),
+            digest=digest,
             rows=int(n), entities=2000,
         )
+        if exec_plan.schedule is not None:
+            # the compaction arm's honesty package: the lane-iteration
+            # ledger this run actually executed, plus a fully-warm RERUN
+            # (every kernel already traced) that must compile NOTHING new
+            # and reproduce the digest bit-for-bit
+            from photon_ml_tpu.compile import compile_stats
+            from photon_ml_tpu.optim.scheduler import solve_stats
+
+            result["lane_ledger"] = solve_stats.totals()
+            wm = compile_stats.watermark()
+            t0 = time.perf_counter()
+            warm_digest = run_digest()
+            warm_elapsed = time.perf_counter() - t0
+            if warm_digest != digest:
+                raise AssertionError(
+                    "compacted rerun diverged from its own first run: "
+                    f"{digest[:12]} vs {warm_digest[:12]}"
+                )
+            result["warm_sec_per_iter"] = warm_elapsed / iters
+            result["warm_new_traces"] = wm.new_traces()
+            result["warm_new_xla_misses"] = wm.new_xla_misses()
     elif scale == "268m":
         # 4,194,304 entities x 64 IDENTITY dims = 268,435,456 coefficients,
         # one row per entity; blocks of 65,536 entities stream from disk
@@ -1037,6 +1079,10 @@ def _perhost_worker_main(argv):
             ),
             regularization=RegularizationContext.l2(1.0),
             state_root=os.path.join(outdir, f"state268m-host{pid}"),
+            # env-resolved plan: the default capture runs flags-off; the
+            # same knob that drives the compaction arm can drive a
+            # compacted 268M capture without touching this file
+            plan=exec_plan,
             ctx=ctx, num_processes=nprocs,
         )
         resid = jnp.zeros((e_total,), jnp.float32)
@@ -1085,7 +1131,7 @@ def _bench_perhost_streaming(extra, on_tpu):
     here = os.path.abspath(__file__)
     out = tempfile.mkdtemp(prefix="perhost-streaming-bench-")
 
-    def run_workers(nprocs, scale, timeout):
+    def run_workers(nprocs, scale, timeout, env_extra=None):
         import socket
 
         with socket.socket() as s:
@@ -1094,6 +1140,17 @@ def _bench_perhost_streaming(extra, on_tpu):
         env = dict(os.environ)
         env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
         env["JAX_PLATFORMS"] = "cpu"
+        # the flags-off baseline arms must stay flags-off: pin the
+        # worker plan's env knobs so an ambient PHOTON_SOLVE_CHUNK /
+        # PHOTON_SPARSE_KERNEL (a leftover local experiment) cannot turn
+        # the "uncompacted" arm compacted and void the comparison — the
+        # compaction arm switches them on EXPLICITLY via env_extra
+        env.update({
+            "PHOTON_SOLVE_CHUNK": "off",
+            "PHOTON_SPARSE_KERNEL": "off",
+            "PHOTON_SHAPE_LADDER": "off",
+        })
+        env.update(env_extra or {})
         # children get FILES, not our pipes (the isolated-section rule): a
         # pipe fills at ~64KB of XLA/JAX log noise, the blocked writer
         # stalls its Gloo collective, and the whole cohort "times out"
@@ -1182,6 +1239,64 @@ def _bench_perhost_streaming_body(extra, run_workers):
         f"perhost streaming CD: {sec1:.3f}s/iter (1 proc) vs "
         f"{sec2:.3f}s/iter (2 proc), speedup {sec1 / sec2:.2f}x, "
         "1-vs-2-process BITWISE equal"
+    )
+
+    # ---- compaction + sparse arm on the billion-coefficient path ----------
+    # the SAME workload through the SAME workers with the execution plan's
+    # env knobs on: convergence-compacted block solves (PR 4) + the
+    # sparse-kernel race (PR 7), previously fenced off this path. Honesty
+    # package: the lane-iteration ledger actually executed, sec/iter next
+    # to the uncompacted arm, a bitwise digest gate against the flags-off
+    # run, and a fully-warm rerun that must compile ZERO new XLA programs
+    # (CompileStats watermark, asserted in the worker).
+    rc = run_workers(
+        2, "small", 1800,
+        env_extra={"PHOTON_SOLVE_CHUNK": "4", "PHOTON_SPARSE_KERNEL": "auto"},
+    )
+    if not all(r["digest"] == r1[0]["digest"] for r in rc):
+        raise AssertionError(
+            "compacted+sparse perhost streaming CD is NOT bitwise-equal to "
+            f"the flags-off run: {r1[0]['digest'][:12]} vs "
+            f"{[r['digest'][:12] for r in rc]}"
+        )
+    sec_c = max(r["sec_per_iter"] for r in rc)
+    sec_cw = max(r["warm_sec_per_iter"] for r in rc)
+    # updates are owner-computes, so each worker's solve_stats ledger
+    # covers only ITS owned blocks — the fleet-wide ledger is the SUM
+    ledger = {
+        k: sum(r["lane_ledger"][k] for r in rc)
+        for k in rc[0]["lane_ledger"]
+    }
+    for r in rc:
+        if r["warm_new_traces"] or r["warm_new_xla_misses"]:
+            raise AssertionError(
+                "compacted warm rerun compiled something new: "
+                f"{[(r['warm_new_traces'], r['warm_new_xla_misses']) for r in rc]}"
+            )
+    saved = ledger["saved_lane_iterations"]
+    base_li = ledger["baseline_lane_iterations"]
+    extra["perhost_streaming_compaction"] = {
+        "sec_per_iter_2proc": round(sec_c, 3),
+        "warm_sec_per_iter_2proc": round(sec_cw, 3),
+        "uncompacted_sec_per_iter_2proc": round(sec2, 3),
+        "lane_iterations_executed": ledger["executed_lane_iterations"],
+        "lane_iterations_baseline": base_li,
+        "lane_iterations_saved": saved,
+        "lane_iterations_saved_pct": round(
+            100.0 * saved / base_li, 1
+        ) if base_li else 0.0,
+        "sparse_kernel": "auto",
+        "chunk": 4,
+        "bitwise_equal_to_uncompacted": True,
+        "warm_new_xla_compiles": 0,
+    }
+    _log(
+        f"perhost streaming compaction+sparse arm (2 proc): {sec_c:.3f}s/iter "
+        f"cold, {sec_cw:.3f}s/iter warm vs {sec2:.3f}s/iter uncompacted; "
+        f"lane-iterations {ledger['executed_lane_iterations']} vs "
+        f"{base_li} one-shot (saved {saved}, "
+        f"{100.0 * saved / base_li if base_li else 0.0:.1f}%), digest "
+        "BITWISE-equal, warm rerun compiled 0 new XLA programs"
     )
 
     # ---- the >=268M-coefficient multi-process capture ---------------------
@@ -2314,12 +2429,13 @@ SECTION_ORDER = (
 # and hitting a deadline DETACHES the child (never kills: r3 claim-orphan
 # postmortem — a killed claim-holder wedges the single-client tunnel)
 SECTION_DEADLINES = {"dense": 3600, "game": 3600, "game5": 2400, "grid": 2400,
-                     # 1-proc + 2-proc CD runs + the 268M two-process
-                     # capture, all subprocess-fenced with own timeouts —
-                     # the section deadline must EXCEED their sum
-                     # (1200 + 1800 + 5100) or a legitimately slow run is
-                     # detached even though every worker honored its fence
-                     "perhost_streaming": 8700,
+                     # 1-proc + 2-proc + compacted-2-proc CD runs + the
+                     # 268M two-process capture, all subprocess-fenced with
+                     # own timeouts — the section deadline must EXCEED
+                     # their sum (1200 + 1800 + 1800 + 5100) or a
+                     # legitimately slow run is detached even though every
+                     # worker honored its fence
+                     "perhost_streaming": 10500,
                      # 3 fleets (1/2/4 replicas) of warmed subprocess
                      # replicas + the kill arm, each spawn fenced at 240s
                      "serving_fleet": 3600}

@@ -181,8 +181,10 @@ class BucketedRandomEffectCoordinate:
     # one-shot): each bucket's vmapped solve runs chunked with active-lane
     # repacking — bucketing fixes the PADDING waste of skewed entity sizes,
     # compaction fixes the ITERATION waste of skewed convergence within a
-    # bucket; the two compose per bucket. Scheduled buckets re-enter the
-    # host between chunks, so the coordinate opts out of the outer CD jit.
+    # bucket; the two compose per bucket, and BOTH compose with mesh_ctx
+    # (scheduled buckets GSPMD-shard their entity axis instead of going
+    # through the shard_map engine). Scheduled buckets re-enter the host
+    # between chunks, so the coordinate opts out of the outer CD jit.
     solve_schedule: Optional[object] = None
     # sparse per-entity kernels (ops/fused_sparse.py), selected PER BUCKET:
     # None = PHOTON_SPARSE_KERNEL (default off) | "auto" (each bucket races
@@ -191,12 +193,6 @@ class BucketedRandomEffectCoordinate:
     sparse_kernel: Optional[str] = None
 
     def __post_init__(self):
-        if self.solve_schedule is not None and self.mesh_ctx is not None:
-            raise ValueError(
-                "solve compaction gathers active lanes host-side and cannot "
-                "compose with mesh-sharded bucket solves; drop mesh_ctx or "
-                "solve_schedule"
-            )
         if self.bundle is None:
             self.bundle = BucketedDatasetBundle.build(
                 self.data, self.config, self.max_buckets, self.bucketer
@@ -218,10 +214,18 @@ class BucketedRandomEffectCoordinate:
                 # per-bucket selection: each sub races/builds its own slab
                 # (same-ladder buckets land on the same (E, M, K) shapes
                 # and share solver executables either way). Under mesh_ctx
-                # the distributed solvers below pin sparse off at the shard
-                # level — racing/building slabs here would be pure waste
+                # the solvers pin sparse off at the shard level — racing/
+                # building slabs here would be pure waste
                 sparse_kernel=(
                     self.sparse_kernel if self.mesh_ctx is None else "off"
+                ),
+                # compaction x mesh COMPOSES (the old fence is gone): a
+                # scheduled sub under mesh_ctx pads + GSPMD-shards its
+                # bucket's entity axis and runs the shared chunk kernels
+                # over the sharded arrays — bucketing handles the size
+                # skew, compaction the iteration skew, sharding the scale
+                mesh_ctx=(
+                    self.mesh_ctx if self.solve_schedule is not None else None
                 ),
             )
             for i, ds in enumerate(b.datasets)
@@ -231,7 +235,9 @@ class BucketedRandomEffectCoordinate:
             # CoordinateDescent jit must call update raw
             self.cd_jit = False
         self._solvers = None
-        if self.mesh_ctx is not None:
+        if self.mesh_ctx is not None and self.solve_schedule is None:
+            # one-shot mesh solves keep the measured shard_map engine;
+            # scheduled ones already sharded inside the subs above
             from photon_ml_tpu.parallel.distributed import (
                 DistributedRandomEffectSolver,
             )
@@ -339,32 +345,137 @@ class BucketedRandomEffectCoordinate:
     def initial_coefficients(self) -> Tuple[Array, ...]:
         return tuple(u.initial_coefficients() for u in self._units())
 
+    def _bucket_shapes(self) -> List[List[int]]:
+        """Per-bucket coefficient-stack shapes — the resume fingerprint
+        (ladder/mesh padding included, so a config change that alters the
+        stacks is caught; same refuse-to-resume rule as SpilledREState)."""
+        return [
+            [int(s.dataset.num_entities), int(s.dataset.local_dim)]
+            for s in self._subs
+        ]
+
+    def _partial_payload(self, finished: List[Array], bucket: int,
+                         inner: Optional[dict] = None) -> dict:
+        """Preemption ``partial`` payload: the finished buckets'
+        coefficients (device state — unlike streaming's disk spills they
+        must ride the snapshot) plus, for a mid-chunk interruption, the
+        in-flight bucket's scheduler snapshot nested with prefixed keys —
+        the same shape the streaming coordinate persists."""
+        meta = {
+            "kind": "bucketed_re",
+            "bucket": bucket,
+            "shapes": self._bucket_shapes(),
+            "inner": inner["meta"] if inner is not None else None,
+        }
+        arrays = {
+            f"done.{j}": np.asarray(w) for j, w in enumerate(finished)
+        }
+        if inner is not None:
+            arrays.update(
+                {f"inner.{k}": v for k, v in inner["arrays"].items()}
+            )
+        return {"meta": meta, "arrays": arrays}
+
     def update(
-        self, residual_offsets: Array, state: Tuple[Array, ...]
+        self, residual_offsets: Array, state: Tuple[Array, ...],
+        resume: Optional[dict] = None,
     ) -> Tuple[Tuple[Array, ...], tuple]:
         """Each bucket gathers ITS rows' residuals (row indices were
         remapped to global order at build time) and solves independently —
-        buckets are disjoint entity sets, so no cross-bucket coupling."""
+        buckets are disjoint entity sets, so no cross-bucket coupling.
+
+        Bucket boundaries are PREEMPTION drain points (site ``"bucket"``),
+        and a scheduled bucket's chunk pauses drain mid-solve: either
+        interruption raises :class:`~photon_ml_tpu.resilience.preemption.
+        Preempted` carrying the finished buckets' coefficients (+ the
+        paused scheduler carries for a mid-chunk drain). Passing that
+        payload back as ``resume`` continues from the interrupted bucket —
+        finished buckets are not recomputed (``None`` tracker
+        placeholders), and the coefficients are bitwise those of an
+        uninterrupted update (chunked resume is bitwise at any boundary,
+        the PR 4 contract)."""
         from photon_ml_tpu.resilience import preemption as _preemption
 
-        new_state = []
-        results = []
-        for unit, row_sel, w0 in zip(self._units(), self._row_sels, state):
+        units = self._units()
+        start_bucket = 0
+        inner_resume = None
+        new_state: List[Array] = []
+        if resume is not None:
+            m = resume["meta"]
+            if m.get("kind") != "bucketed_re":
+                raise ValueError(
+                    f"resume payload kind {m.get('kind')!r} is not a "
+                    "bucketed-RE progress snapshot"
+                )
+            shapes = self._bucket_shapes()
+            saved_shapes = [list(map(int, s)) for s in (m.get("shapes") or [])]
+            if saved_shapes != shapes:
+                # same rule as SpilledREState.__checkpoint_from_ref__:
+                # blindly scattering done.* coefficients into buckets whose
+                # membership changed (max_buckets / ladder / mesh config
+                # drifted since the emergency save) would silently train
+                # the wrong entities — refuse loudly instead
+                raise ValueError(
+                    "bucketed resume snapshot does not match this "
+                    f"coordinate's buckets ({saved_shapes[:3]}... vs "
+                    f"{shapes[:3]}...) — the buckets were rebuilt "
+                    "differently since the emergency checkpoint; refusing "
+                    "to resume"
+                )
+            start_bucket = int(m["bucket"])
+            new_state = [
+                jnp.asarray(resume["arrays"][f"done.{j}"])
+                for j in range(start_bucket)
+            ]
+            if m.get("inner") is not None:
+                inner_resume = {
+                    "meta": m["inner"],
+                    "arrays": {
+                        k[len("inner."):]: v
+                        for k, v in (resume.get("arrays") or {}).items()
+                        if k.startswith("inner.")
+                    },
+                }
+        # finished buckets' tracker summaries are telemetry, not state —
+        # they are not recomputed on resume (streaming does the same)
+        results: List[object] = [None] * start_bucket
+        for bi, (unit, row_sel, w0) in enumerate(
+            zip(units, self._row_sels, state)
+        ):
+            if bi < start_bucket:
+                continue
             local_resid = residual_offsets[jnp.asarray(row_sel)]
             try:
-                coefs, res = unit.update(local_resid, w0)
+                if self.solve_schedule is not None:
+                    coefs, res = unit.update(
+                        local_resid, w0,
+                        resume=(inner_resume if bi == start_bucket else None),
+                    )
+                else:
+                    coefs, res = unit.update(local_resid, w0)
             except _preemption.Preempted as e:
-                # a scheduled bucket drained at a chunk boundary. This
-                # coordinate does not implement mid-bucket resume (the
-                # snapshot carries no bucket index), so DROP the partial:
-                # the emergency checkpoint lands at the previous update
-                # boundary and the relaunch recomputes this coordinate
-                # whole — correct, just not mid-solve-granular
+                # mid-chunk inside bucket bi: wrap the scheduler snapshot
+                # with this coordinate's bucket progress and unwind — the
+                # emergency checkpoint resumes mid-bucket, bitwise
                 raise _preemption.Preempted(
-                    str(e), site=e.site, partial=None
+                    str(e), site=e.site,
+                    partial=self._partial_payload(new_state, bi, e.partial),
                 ) from e
             new_state.append(coefs)
             results.append(res)
+            # bucket-boundary drains only make sense on the host-driven
+            # (scheduled) path: a one-shot bucketed update runs inside the
+            # outer CoordinateDescent jit, where a poll would execute at
+            # trace time and a snapshot would capture tracers
+            if self.solve_schedule is not None and bi + 1 < len(
+                units
+            ) and _preemption.check("bucket", bucket=bi):
+                raise _preemption.Preempted(
+                    f"preempted at bucket boundary (bucket {bi + 1}/"
+                    f"{len(units)}): {_preemption.reason()}",
+                    site="bucket",
+                    partial=self._partial_payload(new_state, bi + 1),
+                )
         return tuple(new_state), tuple(results)
 
     def score(self, state: Tuple[Array, ...]) -> Array:

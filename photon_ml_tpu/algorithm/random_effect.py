@@ -151,6 +151,18 @@ class RandomEffectCoordinate:
     # pass them through jit; its ``kernel`` field carries the selection).
     sparse_kernel: Optional[str] = None
     sparse_slab: Optional[object] = None  # ops.fused_sparse.SparseSlab
+    # GSPMD entity sharding for SCHEDULED solves (parallel.mesh.MeshContext):
+    # the dataset's entity axis is padded to a device multiple and sharded
+    # over the mesh, and the scheduler's shared chunk kernels run over the
+    # sharded arrays — XLA partitions the vmapped lanes across devices
+    # while the compaction loop stays host-side OUTSIDE the mesh program.
+    # Numerical contract: same as the shard_map engine (allclose at f32 —
+    # XLA may fuse a lane's sample/feature reductions differently per
+    # per-device batch size); the BITWISE host-count guarantee lives on
+    # the owner-computes streaming path, which never re-partitions lanes.
+    # One-shot mesh solves keep using the shard_map engine
+    # (parallel.distributed.DistributedRandomEffectSolver).
+    mesh_ctx: Optional[object] = None
 
     def __post_init__(self):
         if self.optimizer_config is None:
@@ -159,6 +171,25 @@ class RandomEffectCoordinate:
                 if self.optimizer == OptimizerType.TRON
                 else OptimizerConfig.lbfgs_default()
             )
+        self._true_entities = self.dataset.num_entities
+        if self.mesh_ctx is not None:
+            if self.solve_schedule is None:
+                raise ValueError(
+                    "mesh_ctx on RandomEffectCoordinate is the GSPMD-"
+                    "sharded scheduled path and needs a solve_schedule; "
+                    "one-shot mesh solves use parallel.distributed."
+                    "DistributedRandomEffectSolver"
+                )
+            from photon_ml_tpu.parallel.distributed import (
+                pad_and_shard_re_dataset,
+            )
+
+            self.dataset = pad_and_shard_re_dataset(self.dataset, self.mesh_ctx)
+            # sparse slabs stay dense under the mesh: the bucketed-COO
+            # slab build is a host-side single-device construct (the
+            # execution plan records this as a pinned decision)
+            self.sparse_kernel = "off"
+            self.sparse_slab = None
         if self.solve_schedule is not None:
             # chunk pauses re-enter the host: the outer CoordinateDescent
             # jit must call this coordinate's update raw (instance attr —
@@ -195,6 +226,12 @@ class RandomEffectCoordinate:
     @property
     def num_entities(self) -> int:
         return self.dataset.num_entities
+
+    @property
+    def true_entities(self) -> int:
+        """Real (pre-mesh-padding) entity count — what exports and exact
+        reductions slice to."""
+        return self._true_entities
 
     @property
     def local_dim(self) -> int:
@@ -259,6 +296,17 @@ class RandomEffectCoordinate:
                 label=self.solve_label,
                 resume=resume,
             )
+            if self.mesh_ctx is not None:
+                # the coefficient slab keeps the sharded padded shape (the
+                # carry contract); trackers trim to real entities at the
+                # source, like the shard_map engine
+                from photon_ml_tpu.parallel.distributed import (
+                    trim_entity_tracker,
+                )
+
+                return results.coefficients, trim_entity_tracker(
+                    results, self._true_entities, self.num_entities
+                )
             return results.coefficients, results
 
         if resume is not None:
@@ -326,6 +374,11 @@ class RandomEffectCoordinate:
         from photon_ml_tpu.optim.problem import _split_reg_weight
 
         l1, l2 = _split_reg_weight(self.regularization, reg_weight)
+        if self.mesh_ctx is not None:
+            # slice the mesh padding off so the reduction runs over exactly
+            # the unsharded coordinate's array shape — the term stays
+            # bitwise-equal by construction, not by pad-lanes-are-zero
+            coefficients = coefficients[: self._true_entities]
         return l1 * jnp.sum(jnp.abs(coefficients)) + 0.5 * l2 * jnp.sum(
             jnp.square(coefficients)
         )

@@ -85,6 +85,10 @@ class StreamingFixedEffectCoordinate:
     # shares the other chunks' compiled partial. None = PHOTON_SHAPE_LADDER
     # (default off); accepts a ShapeBucketer or a spec string.
     bucketer: Optional[object] = None
+    # the resolved execution plan (photon_ml_tpu.compile.plan): fills the
+    # ladder / prefetch policies above when unset — a plan already
+    # consumed the env vars, so unset fields do not re-resolve them
+    plan: Optional[object] = None
 
     # streams per evaluation: CoordinateDescent must not wrap update/score
     # in an outer jit (same contract as the multihost coordinates)
@@ -93,6 +97,11 @@ class StreamingFixedEffectCoordinate:
     def __post_init__(self):
         from photon_ml_tpu.compile import resolve_bucketer
 
+        if self.plan is not None:
+            if self.bucketer is None:
+                self.bucketer = self.plan.bucketer or "off"
+            if self.prefetch_depth is None:
+                self.prefetch_depth = self.plan.prefetch_depth
         self.bucketer = resolve_bucketer(self.bucketer)
         self._margin_fn = jax.jit(
             lambda w, x: x @ self.norm.effective_coefficients(w)
@@ -222,6 +231,9 @@ class PerHostStreamingFixedEffectCoordinate:
     )
     prefetch_depth: Optional[int] = None
     bucketer: Optional[object] = None
+    # resolved execution plan (photon_ml_tpu.compile.plan): fills ladder /
+    # prefetch when unset (authoritative — no env re-resolution under it)
+    plan: Optional[object] = None
 
     # streams + reduces per evaluation: CoordinateDescent must call it raw
     cd_jit = False
@@ -234,6 +246,11 @@ class PerHostStreamingFixedEffectCoordinate:
                 "PerHostStreamingFixedEffectCoordinate needs a MeshContext "
                 "to merge chunk partials across processes"
             )
+        if self.plan is not None:
+            if self.bucketer is None:
+                self.bucketer = self.plan.bucketer or "off"
+            if self.prefetch_depth is None:
+                self.prefetch_depth = self.plan.prefetch_depth
         self.bucketer = resolve_bucketer(self.bucketer)
         self._margin_fn = instrumented_jit(
             lambda w, x: x @ self.norm.effective_coefficients(w)

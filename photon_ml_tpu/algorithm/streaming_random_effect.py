@@ -591,11 +591,25 @@ class StreamingRandomEffectCoordinate:
     # Block slabs are built host-side once (first epoch) and cached on the
     # coordinate; ladder-shaped slabs reuse the shared block executable.
     sparse_kernel: Optional[str] = None
+    # the resolved execution plan (photon_ml_tpu.compile.plan): fills the
+    # solve-schedule / sparse-kernel / prefetch policies above when they
+    # are unset, so drivers thread ONE resolved object instead of three
+    # flags. A plan is authoritative — it already consumed the env vars
+    # (and may have pinned a policy), so unset fields do NOT re-resolve
+    # the environment underneath it.
+    plan: Optional[object] = None
 
     # streams per evaluation — CoordinateDescent must call update/score raw
     cd_jit = False
 
     def __post_init__(self):
+        if self.plan is not None:
+            if self.solve_schedule is None:
+                self.solve_schedule = self.plan.schedule
+            if self.sparse_kernel is None:
+                self.sparse_kernel = self.plan.sparse_kernel or "off"
+            if self.prefetch_depth is None:
+                self.prefetch_depth = self.plan.prefetch_depth
         if self.state_root is None:
             # unique per coordinate INSTANCE: grid combos each build their
             # own coordinate over the shared manifest, and a shared epoch

@@ -247,6 +247,38 @@ def main(argv: Optional[List[str]] = None) -> dict:
             raise SystemExit(preemption.PREEMPT_EXIT_CODE) from e
 
 
+def _check_multihost_support(p) -> None:
+    """Loud scope checks for this driver (unit-testable without launching
+    processes): flags it does not implement are rejected, never silently
+    ignored."""
+    unsupported = [
+        flag for flag, on in (
+            ("--compute-variance", p.compute_variance),
+            ("--fused-cycle", p.fused_cycle),
+            ("--vmapped-grid", p.vmapped_grid != "false"),
+        ) if on
+    ]
+    if unsupported:
+        raise ValueError(
+            f"multihost driver does not implement {unsupported} — "
+            "rejecting rather than silently ignoring (the sharded slabs "
+            "are non-addressable, so an outer jit over the whole cycle "
+            "cannot close over them)"
+        )
+    from photon_ml_tpu.optim.scheduler import resolve_schedule
+
+    if (resolve_schedule(p.solve_compaction) is not None
+            and not p.streaming_random_effects):
+        raise ValueError(
+            "multihost driver composes --solve-compaction with "
+            "--streaming-random-effects (each host compacts its owned "
+            "blocks through the shared chunk kernels; updates are "
+            "owner-computes, no collective) — the in-memory shard_map "
+            "random-effect solver cannot pause at chunk boundaries; add "
+            "--streaming-random-effects or drop --solve-compaction"
+        )
+
+
 def _main_once(mh_args: dict, p, restart: bool = False) -> dict:
     mh = multihost.initialize(
         coordinator_address=mh_args["coordinator"],
@@ -288,20 +320,26 @@ def _main_once(mh_args: dict, p, restart: bool = False) -> dict:
                 "compilation-cache API; compiling uncached"
             )
 
-    unsupported = [
-        flag for flag, on in (
-            ("--compute-variance", p.compute_variance),
-            ("--fused-cycle", p.fused_cycle),
-            ("--vmapped-grid", p.vmapped_grid != "false"),
-        ) if on
-    ]
-    if unsupported:
-        raise ValueError(
-            f"multihost driver does not implement {unsupported} — "
-            "rejecting rather than silently ignoring (the sharded slabs "
-            "are non-addressable, so an outer jit over the whole cycle "
-            "cannot close over them)"
-        )
+    _check_multihost_support(p)
+    # the execution plan (photon_ml_tpu.compile.plan) threads the shape
+    # ladder + solve schedule + sparse selection through the per-host
+    # streaming coordinates — the PR 4 compaction scheduler and the PR 7
+    # sparse races now run ON the billion-coefficient path, per host, with
+    # no collective in the update (owner-computes)
+    from photon_ml_tpu.compile.plan import ExecutionPlan
+
+    plan = ExecutionPlan.resolve(
+        shape_canonicalization=p.shape_canonicalization,
+        solve_compaction=p.solve_compaction,
+        distributed=True,
+        streaming=p.streaming_random_effects,
+        bucketed=p.bucketed_random_effects,
+        fused_cycle=p.fused_cycle,
+        num_processes=mh.num_processes,
+    )
+    logger.info(plan.describe())
+    for line in plan.describe_decisions():
+        logger.info(f"execution plan: {line}")
     for cname, dc in p.random_effect_data_configs.items():
         proj = dc.projector.upper()
         if proj not in ("INDEX_MAP", "IDENTITY", "RANDOM"):
@@ -497,7 +535,6 @@ def _main_once(mh_args: dict, p, restart: bool = False) -> dict:
                 )
                 cache = cache_key = None
                 if p.tensor_cache_dir:
-                    from photon_ml_tpu.compile import resolve_bucketer
                     from photon_ml_tpu.io.tensor_cache import (
                         TensorCache,
                         process_shard_scope,
@@ -509,7 +546,7 @@ def _main_once(mh_args: dict, p, restart: bool = False) -> dict:
                             mh.process_id, mh.num_processes
                         ),
                     )
-                    bk = resolve_bucketer(p.shape_canonicalization)
+                    bk = plan.bucketer
                     # key on the GLOBAL file list (shared input dir): this
                     # host's cached blocks hold rows routed from EVERY
                     # host's files, so a peer's input change must miss
@@ -535,7 +572,10 @@ def _main_once(mh_args: dict, p, restart: bool = False) -> dict:
                     ctx, mh.num_processes, mh.process_id,
                     block_entities=None if budget is not None else 1024,
                     memory_budget_bytes=budget,
-                    bucketer=p.shape_canonicalization,
+                    # "off", never None: the plan already consumed
+                    # PHOTON_SHAPE_LADDER — None would let the builder
+                    # re-resolve the env underneath an explicit off
+                    bucketer=plan.bucketer or "off",
                     tensor_cache=cache, cache_key=cache_key,
                 )
                 logger.info(
@@ -586,6 +626,7 @@ def _main_once(mh_args: dict, p, restart: bool = False) -> dict:
                         cfg.regularization_context(),
                     ),
                     ctx=ctx, num_processes=mh.num_processes,
+                    plan=plan,
                 )
             elif name in streaming_manifests:
                 stream_state_seq[0] += 1
@@ -601,6 +642,10 @@ def _main_once(mh_args: dict, p, restart: bool = False) -> dict:
                         p.output_dir, "streaming-re-state",
                         f"{name}-host{mh.process_id}-{stream_state_seq[0]}",
                     ),
+                    # the plan threads the solve schedule, the per-block
+                    # sparse-kernel race, and the prefetch depth — the
+                    # PR 4 / PR 7 wins on the billion-coefficient path
+                    plan=plan,
                     ctx=ctx, num_processes=mh.num_processes,
                 )
             elif name in p.fixed_effect_data_configs:
@@ -796,6 +841,10 @@ def _main_once(mh_args: dict, p, restart: bool = False) -> dict:
     from photon_ml_tpu.compile import compile_stats
 
     logger.info(compile_stats.summary())
+    if plan.schedule is not None:
+        from photon_ml_tpu.optim.scheduler import solve_stats
+
+        logger.info(solve_stats.summary())
     logger.close()
     return {
         "objective_history": result.objective_history,

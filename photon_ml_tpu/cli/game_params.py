@@ -415,60 +415,50 @@ class GameTrainingParams:
                 "--divergence-guard must be 'off', 'rollback', or "
                 f"'skip_cycle', got {self.divergence_guard!r}"
             )
+        # policy composition is resolved ONCE by the execution plan
+        # (photon_ml_tpu.compile.plan): the old pairwise fence lattice is
+        # gone — compaction composes with --distributed (GSPMD-sharded
+        # chunk kernels) and with streaming (owner-computes per-host block
+        # compaction), streaming subsumes --bucketed-random-effects with a
+        # recorded decision, and only the genuinely impossible pairs (host
+        # re-entry inside --fused-cycle's one-XLA-program iterations;
+        # --vmapped-grid true with chunk pauses) still error, raised by
+        # the plan itself so parser and drivers share one rule set.
+        # (--checkpoint-dir composes with streaming: the spilled state
+        # checkpoints BY REFERENCE, SpilledREState.__checkpoint_ref__.)
+        # a broken spec is reported AND normalized to "off" so the plan's
+        # spec-independent fence checks below still run — validate() keeps
+        # its report-everything-at-once contract
+        ladder_spec = self.shape_canonicalization
         try:
             from photon_ml_tpu.compile import resolve_bucketer
 
-            resolve_bucketer(self.shape_canonicalization)
+            resolve_bucketer(ladder_spec)
         except ValueError as e:
             errors.append(f"--shape-canonicalization: {e}")
-        solve_schedule = None
+            ladder_spec = "off"
+        compaction_spec = self.solve_compaction
         try:
             from photon_ml_tpu.optim.scheduler import resolve_schedule
 
-            solve_schedule = resolve_schedule(self.solve_compaction)
+            resolve_schedule(compaction_spec)
         except ValueError as e:
             errors.append(f"--solve-compaction: {e}")
-        if solve_schedule is not None:
-            # loud scope fences: the scheduler re-enters the host between
-            # chunks, so anything that compiles whole updates/iterations
-            # into one XLA program (or shards lanes over the mesh) cannot
-            # compose with it
-            if self.distributed:
-                errors.append(
-                    "--solve-compaction gathers active lanes host-side; "
-                    "--distributed (mesh-sharded lanes) cannot compose"
-                )
-            if self.fused_cycle:
-                errors.append(
-                    "--solve-compaction pauses the solve at chunk "
-                    "boundaries; --fused-cycle (one XLA program per "
-                    "iteration) cannot compose"
-                )
-        if self.streaming_random_effects:
-            # loud scope fences: the streaming coordinate re-enters the host
-            # per evaluation, so anything that wraps it in one XLA program
-            # or serializes its state as device arrays cannot compose
-            if self.bucketed_random_effects:
-                errors.append(
-                    "--streaming-random-effects already sorts entities by "
-                    "size into tightly-padded blocks; drop "
-                    "--bucketed-random-effects"
-                )
-            # NOTE: --distributed composes with streaming since the
-            # entity-sharded multihost streaming PR: entities hash-partition
-            # across hosts (parallel/perhost_streaming.py), each host
-            # streams only the blocks it owns, and scores/chunk partials
-            # merge with exact mesh reductions — bitwise-equal to the
-            # single-host streaming run
-            if self.fused_cycle:
-                errors.append(
-                    "--streaming-random-effects streams per evaluation; "
-                    "--fused-cycle (one XLA program per iteration) cannot "
-                    "compose"
-                )
-            # NOTE: --checkpoint-dir composes with streaming since the
-            # preemption-safe training PR: the spilled coefficient handle
-            # checkpoints BY REFERENCE (SpilledREState.__checkpoint_ref__)
+            compaction_spec = "off"
+        try:
+            from photon_ml_tpu.compile.plan import ExecutionPlan
+
+            ExecutionPlan.resolve(
+                shape_canonicalization=ladder_spec,
+                solve_compaction=compaction_spec,
+                distributed=self.distributed,
+                streaming=self.streaming_random_effects,
+                bucketed=self.bucketed_random_effects,
+                fused_cycle=self.fused_cycle,
+                vmapped_grid=self.vmapped_grid,
+            )
+        except ValueError as e:
+            errors.append(str(e))
         if self.max_restarts < 0:
             errors.append("--max-restarts must be >= 0")
         if self.checkpoint_async and not self.checkpoint_dir:
@@ -572,7 +562,11 @@ def build_training_parser() -> argparse.ArgumentParser:
            "ladder-sized batches between chunks (bitwise-equal results, "
            "straggler lanes stop burning whole-batch iterations): "
            "off | on | CHUNK iterations per chunk (e.g. 8). Default defers "
-           "to PHOTON_SOLVE_CHUNK")
+           "to PHOTON_SOLVE_CHUNK. Composes with --distributed "
+           "(GSPMD-sharded chunk kernels), --bucketed-random-effects, and "
+           "--streaming-random-effects incl. the multihost per-host path "
+           "(per-block owner-computes compaction); only --fused-cycle and "
+           "--vmapped-grid true cannot pause at chunk boundaries")
     a("--vmapped-grid", default="false",
       help="train the lambda grid through the shared-compile grid API (ONE "
            "compiled cycle serves every combo; lambda-only grids on plain "

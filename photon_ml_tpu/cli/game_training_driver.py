@@ -138,26 +138,23 @@ class GameTrainingDriver:
     def __init__(self, params: GameTrainingParams, logger: Optional[PhotonLogger] = None):
         params.validate()
         self.params = params
-        from photon_ml_tpu.compile import compile_stats, resolve_bucketer
+        from photon_ml_tpu.compile import ExecutionPlan, compile_stats
 
-        # the canonical shape ladder every dataset build below feeds through
-        # (None = off); compile telemetry is always on — the summary lands
-        # in the run log either way
-        self.bucketer = resolve_bucketer(params.shape_canonicalization)
-        # convergence-compacted random-effect solves (None = one-shot):
-        # resolved once so every combo's coordinates share the policy.
-        # Compacted rungs ride the SAME ladder --shape-canonicalization
-        # configured (when it did) — one rung vocabulary across block
-        # padding and lane compaction, as documented
-        import dataclasses as _dc
-
-        from photon_ml_tpu.optim.scheduler import resolve_schedule
-
-        self.solve_schedule = resolve_schedule(params.solve_compaction)
-        if self.solve_schedule is not None and self.bucketer is not None:
-            self.solve_schedule = _dc.replace(
-                self.solve_schedule, bucketer=self.bucketer
-            )
+        # ONE execution plan resolves every orthogonal policy — shape
+        # ladder, solve schedule (ladder-bound), sharding mode, sparse
+        # selection — and records every composition decision; the
+        # coordinates below all read from it instead of re-resolving flags
+        self.plan = ExecutionPlan.resolve(
+            shape_canonicalization=params.shape_canonicalization,
+            solve_compaction=params.solve_compaction,
+            distributed=params.distributed,
+            streaming=params.streaming_random_effects,
+            bucketed=params.bucketed_random_effects,
+            fused_cycle=params.fused_cycle,
+            vmapped_grid=params.vmapped_grid,
+        )
+        self.bucketer = self.plan.bucketer
+        self.solve_schedule = self.plan.schedule
         compile_stats.install_xla_listeners()
         self._own_logger = logger is None
         self.logger = logger or PhotonLogger(
@@ -367,7 +364,8 @@ class GameTrainingDriver:
                     # budget must not silently pass BOTH sizing modes
                     block_entities=None if budget is not None else 1024,
                     memory_budget_bytes=budget,
-                    bucketer=self.bucketer,
+                    # "off", never None: the plan consumed the env already
+                    bucketer=self.bucketer or "off",
                     tensor_cache=cache,
                     cache_key=(
                         cache.key_for(
@@ -397,7 +395,7 @@ class GameTrainingDriver:
                 )
 
                 self.bucketed_bundles[name] = BucketedDatasetBundle.build(
-                    self.train_data, cfg, bucketer=self.bucketer
+                    self.train_data, cfg, bucketer=self.bucketer or "off"
                 )
                 continue
             self.re_datasets[name] = build_random_effect_dataset(
@@ -493,7 +491,10 @@ class GameTrainingDriver:
                     optimizer=cfg.optimizer,
                     optimizer_config=cfg.optimizer_config(),
                     regularization=cfg.regularization_context(),
-                    solve_schedule=self.solve_schedule,
+                    # the plan threads schedule + sparse selection +
+                    # prefetch in one object (compaction and the sparse
+                    # race now reach the streaming path)
+                    plan=self.plan,
                     # spilled state goes under OUR output dir, never inside
                     # the manifest dir (a --tensor-cache hit points that at
                     # the shared cache entry, which must stay run-agnostic);
@@ -557,6 +558,7 @@ class GameTrainingDriver:
                     solve_schedule=self.solve_schedule,
                 )
             else:
+                scheduled_mesh = p.distributed and self.solve_schedule is not None
                 re = RandomEffectCoordinate(
                     self.re_datasets[name],
                     p.task_type,
@@ -568,8 +570,16 @@ class GameTrainingDriver:
                     # distributed solves pin sparse off at the shard level
                     # — don't race/build a slab the solver will discard
                     sparse_kernel="off" if p.distributed else None,
+                    # compaction x mesh (the old fence is gone): the
+                    # coordinate pads + GSPMD-shards its entity axis and
+                    # runs the scheduler's shared chunk kernels over the
+                    # sharded arrays — the compaction loop stays host-side
+                    # outside the mesh program (the mesh path's allclose
+                    # numerical contract, like the shard_map engine)
+                    mesh_ctx=self._mesh_context() if scheduled_mesh else None,
                 )
-                if p.distributed:
+                if p.distributed and not scheduled_mesh:
+                    # one-shot mesh solves keep the measured shard_map engine
                     from photon_ml_tpu.parallel.distributed import (
                         DistributedRandomEffectSolver,
                     )
@@ -1088,9 +1098,15 @@ class GameTrainingDriver:
                             )
 
                             ds = self.re_datasets[name]
+                            # mesh-scheduled coordinates compute variances
+                            # over their PADDED entity axis; slice back to
+                            # this (unpadded) dataset's extent, same as
+                            # the means path above
                             entity_variances = self._rows_by_raw_id(
                                 name,
-                                np.asarray(global_coefficients(ds, re_var)),
+                                np.asarray(global_coefficients(
+                                    ds, re_var[: ds.num_entities]
+                                )),
                             )
                 model_io.save_random_effect(
                     output_dir,
@@ -1171,14 +1187,9 @@ class GameTrainingDriver:
                     "--persistent-cache requested but this jax has no "
                     "compilation-cache API; compiling uncached"
                 )
-        if self.bucketer is not None:
-            self.logger.info(
-                f"shape canonicalization: {self.bucketer.describe()}"
-            )
-        if self.solve_schedule is not None:
-            self.logger.info(
-                f"solve compaction: {self.solve_schedule.describe()}"
-            )
+        self.logger.info(self.plan.describe())
+        for line in self.plan.describe_decisions():
+            self.logger.info(f"execution plan: {line}")
         try:
             with self.timer.measure("prepare-feature-maps"):
                 self.prepare_feature_maps()

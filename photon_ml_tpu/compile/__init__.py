@@ -33,6 +33,7 @@ from photon_ml_tpu.compile.canonical import (
     pad_glm_chunk,
     resolve_bucketer,
 )
+from photon_ml_tpu.compile.plan import ExecutionPlan, PlanDecision, PlanError
 from photon_ml_tpu.compile.stats import (
     CompileStats,
     CompileWatermark,
@@ -55,6 +56,9 @@ def donation_enabled() -> bool:
 __all__ = [
     "CompileStats",
     "CompileWatermark",
+    "ExecutionPlan",
+    "PlanDecision",
+    "PlanError",
     "ShapeBucketer",
     "canonicalize_re_arrays",
     "canonicalize_re_dataset",

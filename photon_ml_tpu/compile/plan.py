@@ -1,0 +1,229 @@
+"""Composable execution plans: ONE resolution of the orthogonal policies.
+
+The coordinate-update path is governed by five orthogonal policies that
+used to be resolved piecemeal (driver flags, env vars, per-class
+constructor fences): the canonical **shape ladder**, the **solve
+schedule** (one-shot vs convergence-compacted chunks), the **sharding**
+mode (single device / GSPMD mesh / per-host streaming), the
+**sparse-kernel** family selection, and the **checkpoint/preemption**
+hooks (prefetch depth rides along as the streaming knob). The pairwise
+fence lattice that grew around them (``--solve-compaction`` x
+``--distributed``, streaming x bucketed, bucketed compaction x
+``mesh_ctx``, ...) fenced the measured wins — the 71%-lane-iteration
+scheduler (PR 4) and the raced sparse kernels (PR 7) — off the
+billion-coefficient multihost streaming path (PR 9), which is exactly
+where skewed convergence and sparse rows pay most.
+
+:class:`ExecutionPlan` replaces the lattice with one resolution:
+
+  * **impossible** pairs raise :class:`PlanError` at resolve time (kept
+    fences, each pinned by a test): anything that must re-enter the host
+    mid-solve — compacted chunk pauses, streaming block loads — cannot
+    live inside ``--fused-cycle``'s one-XLA-program-per-iteration.
+  * **subsumed** pairs resolve to the stronger policy with a recorded
+    :class:`PlanDecision` (streaming already sorts entities into
+    tightly-padded size blocks, so ``--bucketed-random-effects`` is
+    redundant under it, not an error).
+  * **composable** pairs compose for real: compaction under
+    ``--distributed`` runs the scheduler's shared chunk kernels over
+    entity-sharded arrays (GSPMD partitions the vmapped lanes; the
+    host-side compaction loop is outside the mesh program), and the
+    per-host streaming coordinate compacts + races sparse kernels on its
+    owned blocks with no collective in the update at all
+    (owner-computes). Sparse slabs are pinned dense under the in-memory
+    GSPMD mesh (the bucketed-COO slab build is a single-device,
+    host-side construct — a recorded decision, not a silent drop).
+
+Snap ML (arXiv:1803.06333) gets its hierarchical GLM speedups from
+composing node-level solver acceleration with cluster-level partitioning;
+DrJAX (arXiv:2403.07128) shows such MapReduce-style loops compose in JAX
+when sharding is a policy of one program rather than a separate code
+path. This module is that composition, resolved once and threaded
+through the four random-effect coordinates and both streaming
+algorithms.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Tuple
+
+from photon_ml_tpu.compile.canonical import ShapeBucketer, resolve_bucketer
+
+__all__ = ["ExecutionPlan", "PlanDecision", "PlanError"]
+
+
+class PlanError(ValueError):
+    """A policy combination that is genuinely impossible (host re-entry
+    inside a single compiled program) — the only fences the plan keeps."""
+
+
+@dataclasses.dataclass(frozen=True)
+class PlanDecision:
+    """One recorded policy adjustment made during resolution — the audit
+    trail that replaces silent per-class drops (drivers log these)."""
+
+    policy: str  # which policy was adjusted ("schedule", "sparse", ...)
+    action: str  # "subsumed" | "pinned" | "composed"
+    reason: str
+
+    def describe(self) -> str:
+        return f"{self.policy} {self.action}: {self.reason}"
+
+
+@dataclasses.dataclass(frozen=True)
+class ExecutionPlan:
+    """The resolved, immutable execution policy of one training run.
+
+    ``schedule`` already carries the plan's ladder (``bucketer`` is bound
+    into it at resolve time), so compacted lane rungs and padded
+    block/bucket shapes share ONE rung vocabulary. ``sharding`` is
+    ``"none"`` | ``"mesh"`` (single-process GSPMD entity sharding) |
+    ``"perhost_streaming"`` (owner-computes multihost blocks).
+    ``sparse_kernel`` is the resolved family spec (None = dense).
+    """
+
+    bucketer: Optional[ShapeBucketer] = None
+    schedule: Optional[object] = None  # optim.scheduler.SolveSchedule
+    sharding: str = "none"
+    sparse_kernel: Optional[str] = None
+    prefetch_depth: Optional[int] = None
+    streaming: bool = False
+    fused_cycle: bool = False
+    num_processes: int = 1
+    decisions: Tuple[PlanDecision, ...] = ()
+
+    @classmethod
+    def resolve(
+        cls,
+        *,
+        shape_canonicalization: Optional[str] = None,
+        solve_compaction: Optional[object] = None,
+        distributed: bool = False,
+        streaming: bool = False,
+        bucketed: bool = False,
+        fused_cycle: bool = False,
+        vmapped_grid: str = "false",
+        sparse_kernel: Optional[str] = None,
+        prefetch_depth: Optional[int] = None,
+        num_processes: int = 1,
+    ) -> "ExecutionPlan":
+        """Resolve every policy once (env fallbacks included:
+        ``PHOTON_SHAPE_LADDER`` / ``PHOTON_SOLVE_CHUNK`` /
+        ``PHOTON_SPARSE_KERNEL``), apply the composition rules, and
+        return the plan. Raises :class:`PlanError` only for the pairs
+        that are impossible by construction."""
+        from photon_ml_tpu.ops.fused_sparse import resolve_sparse_kernel
+        from photon_ml_tpu.optim.scheduler import resolve_schedule
+
+        bucketer = resolve_bucketer(shape_canonicalization)
+        schedule = resolve_schedule(solve_compaction)
+        sparse = resolve_sparse_kernel(sparse_kernel)
+        # resolved to a concrete int HERE (PHOTON_PREFETCH_DEPTH consumed
+        # once), so coordinates reading the plan never re-resolve the env
+        from photon_ml_tpu.io.pipeline import resolve_depth
+
+        prefetch_depth = resolve_depth(prefetch_depth)
+        decisions = []
+
+        # ---- impossible pairs (the fences the plan KEEPS) -----------------
+        if fused_cycle and schedule is not None:
+            raise PlanError(
+                "--solve-compaction pauses the solve at chunk "
+                "boundaries; --fused-cycle (one XLA program per "
+                "iteration) cannot compose"
+            )
+        if fused_cycle and streaming:
+            raise PlanError(
+                "--streaming-random-effects streams per evaluation; "
+                "--fused-cycle (one XLA program per iteration) cannot "
+                "compose"
+            )
+        if vmapped_grid == "true" and schedule is not None:
+            raise PlanError(
+                "--vmapped-grid true cannot compose with "
+                "--solve-compaction: chunk pauses re-enter the host "
+                "inside the compiled grid cycle; use --vmapped-grid auto "
+                "to fall back to the per-combo grid"
+            )
+
+        # ---- subsumed pairs ----------------------------------------------
+        if streaming and bucketed:
+            decisions.append(PlanDecision(
+                "bucketed", "subsumed",
+                "streaming already sorts entities by size into "
+                "tightly-padded blocks; --bucketed-random-effects is "
+                "redundant and the streaming coordinate serves both",
+            ))
+            bucketed = False
+
+        # ---- sharding mode + composition notes ----------------------------
+        sharding = "none"
+        if distributed:
+            sharding = "perhost_streaming" if streaming else "mesh"
+        if sharding == "mesh" and schedule is not None:
+            decisions.append(PlanDecision(
+                "schedule", "composed",
+                "compacted solves under --distributed run the shared "
+                "chunk kernels over entity-sharded arrays (GSPMD "
+                "partitions the vmapped lanes; the compaction loop stays "
+                "host-side outside the mesh program) — same allclose "
+                "numerical contract as the one-shot shard_map engine",
+            ))
+        if sharding == "mesh" and sparse is not None:
+            decisions.append(PlanDecision(
+                "sparse", "pinned",
+                "sparse slabs stay dense under the in-memory GSPMD mesh "
+                "(the bucketed-COO slab build is a host-side, "
+                "single-device construct); the per-host streaming path "
+                "races sparse kernels per owned block instead",
+            ))
+            sparse = None
+        if sharding == "perhost_streaming" and schedule is not None:
+            decisions.append(PlanDecision(
+                "schedule", "composed",
+                "per-host streaming updates are owner-computes (no "
+                "collective), so each host compacts its owned blocks "
+                "independently through the shared chunk kernels",
+            ))
+
+        # ladder binds INTO the schedule: compacted lane rungs and padded
+        # block shapes share one rung vocabulary (the PR 4 contract)
+        if schedule is not None and bucketer is not None:
+            schedule = dataclasses.replace(schedule, bucketer=bucketer)
+
+        return cls(
+            bucketer=bucketer,
+            schedule=schedule,
+            sharding=sharding,
+            sparse_kernel=sparse,
+            prefetch_depth=prefetch_depth,
+            streaming=streaming,
+            fused_cycle=fused_cycle,
+            num_processes=max(int(num_processes), 1),
+            decisions=tuple(decisions),
+        )
+
+    # ------------------------------------------------------------------
+    def bucketed_subsumed(self) -> bool:
+        """True when streaming subsumed --bucketed-random-effects (the
+        driver then routes the coordinate through streaming and logs it)."""
+        return any(
+            d.policy == "bucketed" and d.action == "subsumed"
+            for d in self.decisions
+        )
+
+    def describe(self) -> str:
+        """One log line: every resolved policy, explicit about 'off'."""
+        parts = [
+            f"ladder={self.bucketer.describe() if self.bucketer else 'off'}",
+            (f"schedule={self.schedule.describe()}"
+             if self.schedule is not None else "schedule=one-shot"),
+            f"sharding={self.sharding}",
+            f"sparse={self.sparse_kernel or 'off'}",
+            f"streaming={'on' if self.streaming else 'off'}",
+        ]
+        return "execution plan: " + " ".join(parts)
+
+    def describe_decisions(self) -> Tuple[str, ...]:
+        return tuple(d.describe() for d in self.decisions)

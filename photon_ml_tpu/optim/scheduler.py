@@ -36,6 +36,16 @@ pattern); drivers log ``solve_stats.summary()`` next to the compile stats.
 
 Env control: ``PHOTON_SOLVE_CHUNK`` = ``off`` (default) | ``on`` | K
 (chunk size), the same resolve pattern as ``PHOTON_SHAPE_LADDER``.
+
+Composition (photon_ml_tpu.compile.plan resolves it once per run): the
+chunk kernels take their data as pytree ARGUMENTS, so the same host loop
+drives unsharded solves, GSPMD entity-sharded solves (the mesh path:
+sharded operands partition the vmapped lanes across devices; this loop
+never enters the mesh program), and the per-host streaming block solves
+(owner-computes: each host compacts its owned blocks independently —
+the billion-coefficient path). The only non-compositions are the ones
+with no host boundary to pause at (``--fused-cycle``, the compiled
+traced-lambda grid cycle), raised loudly by the plan.
 """
 
 from __future__ import annotations

@@ -581,6 +581,14 @@ class PerHostStreamingRandomEffectCoordinate(StreamingRandomEffectCoordinate):
     single-host value. Updates need NO collective at all (owner-computes:
     each entity's rows live with its coefficients)."""
 
+    # Composable policies (photon_ml_tpu.compile.plan threads them via the
+    # inherited ``plan`` field): a solve schedule compacts each owned
+    # block's lanes through the scheduler's process-shared chunk kernels,
+    # and the sparse-kernel race selects per owned block — both run with
+    # NO collective (updates are owner-computes), so the compacted/sparse
+    # run stays bitwise-equal to the one-shot perhost run and to the
+    # single-host streaming run (2-process harness-pinned).
+
     ctx: Optional[MeshContext] = None
     num_processes: int = 1
 

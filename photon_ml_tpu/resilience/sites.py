@@ -50,4 +50,5 @@ PREEMPT_SITES: Tuple[str, ...] = (
     "cycle",  # coordinate-descent update/iteration boundary
     "block",  # streaming random-effect block boundary
     "chunk",  # compacted-solver chunk boundary (optim/scheduler.py)
+    "bucket",  # scheduled bucketed-RE bucket boundary (algorithm/bucketed_random_effect.py)
 )

@@ -99,17 +99,29 @@ host_rows = HostRows(
     global_dim=feats.dim,
 )
 
+# ---- the execution plan: every policy resolved ONCE from the env ----------
+# (PHOTON_SOLVE_CHUNK / PHOTON_SPARSE_KERNEL / PHOTON_SHAPE_LADDER) — the
+# all-flags-on harness arm drives compaction + the sparse race through the
+# same worker by exporting the env vars; the default run resolves all-off
+from photon_ml_tpu.compile.plan import ExecutionPlan  # noqa: E402
+
+exec_plan = ExecutionPlan.resolve(
+    distributed=(nprocs > 1), streaming=True, num_processes=nprocs
+)
+
 # ---- per-host streaming RE: agree -> plan -> route -> owned blocks --------
 # NO shared_vocab: the raw-id agreement collective is the production path
 manifest = build_perhost_streaming_manifest(
     host_rows, RE_CFG, os.path.join(outdir, f"re-host{proc_id}"),
     ctx, nprocs, proc_id, block_entities=BLOCK_ENTITIES,
+    bucketer=exec_plan.bucketer,
 )
 re_coord = PerHostStreamingRandomEffectCoordinate(
     manifest, TaskType.LOGISTIC_REGRESSION,
     optimizer=OptimizerType.LBFGS, optimizer_config=RE_OPT,
     regularization=RE_REG,
     state_root=os.path.join(outdir, f"re-state-host{proc_id}"),
+    plan=exec_plan,
     ctx=ctx, num_processes=nprocs,
 )
 
@@ -164,6 +176,7 @@ for c in range(len(chunk_sizes)):
     owned_loaders[c] = load
 fe_coord = PerHostStreamingFixedEffectCoordinate(
     chunk_sizes, owned_loaders, D_FE, FE_PROBLEM,
+    plan=exec_plan,
     ctx=ctx, num_processes=nprocs,
 )
 
@@ -195,8 +208,17 @@ if mh.coordinator_only_io():
         objectives=np.asarray(result.objective_history, np.float64),
     )
 mh.barrier("saved")
+sched_note = ""
+if exec_plan.schedule is not None:
+    from photon_ml_tpu.optim.scheduler import solve_stats
+
+    t = solve_stats.totals()
+    sched_note = (
+        f" compaction_saved={t['saved_lane_iterations']}"
+        f"/{t['baseline_lane_iterations']}"
+    )
 print(
     f"PHSOK proc={proc_id} sec_per_iter={elapsed / 2:.3f} "
-    f"obj={result.objective_history[-1]:.9g}",
+    f"obj={result.objective_history[-1]:.9g}{sched_note}",
     flush=True,
 )

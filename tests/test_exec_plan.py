@@ -1,0 +1,282 @@
+"""Composable execution plans (photon_ml_tpu.compile.plan): ONE resolution
+of ladder x schedule x sharding x sparse x checkpoint policies, the fence
+lattice reduced to the genuinely impossible pairs, and the all-flags-on
+matrix — streaming + distributed + --solve-compaction +
+PHOTON_SPARSE_KERNEL=auto + --shape-canonicalization + a mid-run
+preemption — pinned BITWISE-equal to the flags-off streaming baseline
+through the full training driver (the 2-process arm of the same claim
+lives in test_perhost_streaming.py)."""
+
+import os
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from game_test_utils import make_glmix_data, write_game_avro
+
+from photon_ml_tpu.compile.plan import ExecutionPlan, PlanDecision, PlanError
+
+pytestmark = pytest.mark.plan
+
+
+class TestPlanResolution:
+    def test_defaults_everything_off(self, monkeypatch):
+        for var in ("PHOTON_SHAPE_LADDER", "PHOTON_SOLVE_CHUNK",
+                    "PHOTON_SPARSE_KERNEL"):
+            monkeypatch.delenv(var, raising=False)
+        p = ExecutionPlan.resolve()
+        assert p.bucketer is None and p.schedule is None
+        assert p.sharding == "none" and p.sparse_kernel is None
+        assert p.decisions == ()
+        assert "schedule=one-shot" in p.describe()
+
+    def test_env_fallbacks_resolve_once(self, monkeypatch):
+        monkeypatch.setenv("PHOTON_SHAPE_LADDER", "4:2")
+        monkeypatch.setenv("PHOTON_SOLVE_CHUNK", "5")
+        monkeypatch.setenv("PHOTON_SPARSE_KERNEL", "segment")
+        p = ExecutionPlan.resolve(streaming=True)
+        assert p.bucketer.base == 4
+        assert p.schedule.chunk_size == 5
+        # the ladder binds INTO the schedule: one rung vocabulary
+        assert p.schedule.bucketer is p.bucketer
+        assert p.sparse_kernel == "segment"
+
+    def test_fused_cycle_fences_are_plan_errors(self):
+        with pytest.raises(PlanError, match="fused-cycle"):
+            ExecutionPlan.resolve(solve_compaction="on", fused_cycle=True)
+        with pytest.raises(PlanError, match="fused-cycle"):
+            ExecutionPlan.resolve(streaming=True, fused_cycle=True)
+
+    def test_vmapped_grid_true_fence(self):
+        with pytest.raises(PlanError, match="--vmapped-grid true"):
+            ExecutionPlan.resolve(solve_compaction="4", vmapped_grid="true")
+        # auto falls back at the driver (documented), never errors here
+        p = ExecutionPlan.resolve(solve_compaction="4", vmapped_grid="auto")
+        assert p.schedule.chunk_size == 4
+
+    def test_streaming_subsumes_bucketed(self):
+        p = ExecutionPlan.resolve(streaming=True, bucketed=True)
+        assert p.bucketed_subsumed()
+        assert any(
+            d == PlanDecision(d.policy, "subsumed", d.reason)
+            and d.policy == "bucketed"
+            for d in p.decisions
+        )
+
+    def test_mesh_pins_sparse_and_composes_schedule(self, monkeypatch):
+        monkeypatch.setenv("PHOTON_SPARSE_KERNEL", "auto")
+        p = ExecutionPlan.resolve(solve_compaction="8", distributed=True)
+        assert p.sharding == "mesh"
+        assert p.schedule.chunk_size == 8
+        assert p.sparse_kernel is None  # pinned dense under GSPMD
+        actions = {(d.policy, d.action) for d in p.decisions}
+        assert ("sparse", "pinned") in actions
+        assert ("schedule", "composed") in actions
+
+    def test_perhost_streaming_keeps_sparse_and_schedule(self, monkeypatch):
+        monkeypatch.setenv("PHOTON_SPARSE_KERNEL", "auto")
+        p = ExecutionPlan.resolve(
+            solve_compaction="8", distributed=True, streaming=True,
+            num_processes=2,
+        )
+        assert p.sharding == "perhost_streaming"
+        assert p.schedule is not None and p.sparse_kernel == "auto"
+        assert ("schedule", "composed") in {
+            (d.policy, d.action) for d in p.decisions
+        }
+
+
+class TestMultihostSupport:
+    """The multihost driver's loud scope checks (unit-tested without
+    launching processes): compaction without streaming is refused with a
+    pinned message — the in-memory shard_map solver has no chunk pauses."""
+
+    def _params(self, **kw):
+        from photon_ml_tpu.cli.game_params import GameTrainingParams
+        from photon_ml_tpu.types import TaskType
+
+        return GameTrainingParams(
+            train_input_dirs=["/in"], output_dir="/out",
+            task_type=TaskType.LOGISTIC_REGRESSION,
+            updating_sequence=["fixed"], **kw,
+        )
+
+    def test_compaction_without_streaming_refused(self):
+        from photon_ml_tpu.cli.game_multihost_driver import (
+            _check_multihost_support,
+        )
+
+        with pytest.raises(
+            ValueError,
+            match="composes --solve-compaction with --streaming-random-effects",
+        ):
+            _check_multihost_support(self._params(solve_compaction="4"))
+
+    def test_compaction_with_streaming_accepted(self):
+        from photon_ml_tpu.cli.game_multihost_driver import (
+            _check_multihost_support,
+        )
+
+        _check_multihost_support(self._params(
+            solve_compaction="4", streaming_random_effects=True
+        ))
+
+
+# ---------------------------------------------------------------------------
+# the all-flags-on matrix through the full training driver
+# ---------------------------------------------------------------------------
+
+MATRIX_FLAGS = [
+    "--task-type", "LOGISTIC_REGRESSION",
+    "--feature-shard-id-to-feature-section-keys-map",
+    "global:fixedFeatures|per_user:userFeatures",
+    # RE-only sequence: every all-flags policy below acts on the random
+    # effect, and the FE mesh solve carries a different (allclose, not
+    # bitwise) numerical contract that would dilute this gate
+    "--updating-sequence", "per-user",
+    "--random-effect-data-configurations",
+    "per-user:userId,per_user,1,-1,-1,-1,INDEX_MAP",
+    "--random-effect-optimization-configurations",
+    "per-user:25,1e-8,0.2,1,LBFGS,L2",
+    "--num-iterations", "2",
+    "--streaming-random-effects", "true",
+    # the ladder rides BOTH sides of the matrix comparison: its on-vs-off
+    # equivalence is PR 3's separate, small-extent-regime contract (M-axis
+    # padding reassociates the sample reduction outside it), while the
+    # bitwise claim under proof here is compaction x sharding x sparse x
+    # preemption on top of the same padded shapes
+    "--shape-canonicalization", "on",
+    "--delete-output-dir-if-exists", "true",
+]
+
+
+@pytest.fixture(scope="module")
+def matrix_train_dir(tmp_path_factory):
+    base = tmp_path_factory.mktemp("exec-plan-matrix")
+    rng = np.random.default_rng(19)
+    data, truth = make_glmix_data(
+        rng, num_users=14, rows_per_user_range=(6, 18), d_fixed=4, d_random=3
+    )
+    train = base / "train"
+    train.mkdir()
+    write_game_avro(
+        str(train / "part-0.avro"), data, range(data.num_rows), truth
+    )
+    return str(train)
+
+
+def _run_matrix_driver(train_dir, out_dir, extra=(), env=()):
+    from photon_ml_tpu.cli import game_training_driver
+    from photon_ml_tpu.resilience import preemption
+
+    preemption.reset()
+    old = {}
+    try:
+        for k, v in env:
+            old[k] = os.environ.get(k)
+            os.environ[k] = v
+        return game_training_driver.main(
+            ["--train-input-dirs", train_dir, "--output-dir", out_dir]
+            + MATRIX_FLAGS + list(extra)
+        )
+    finally:
+        for k, v in old.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+        preemption.reset()
+
+
+def _matrix_means(driver):
+    coord = driver.combo_coords[driver.best_index]["per-user"]
+    result = driver.results[driver.best_index][1]
+    return result, coord.entity_means_by_raw_id(
+        result.coefficients["per-user"]
+    )
+
+
+def test_mesh_scheduled_variance_export_survives_padding(
+    matrix_train_dir, tmp_path
+):
+    """--distributed + --solve-compaction + --compute-variance on the
+    in-memory (GSPMD mesh) path: the coordinate computes variances over
+    its PADDED entity axis (14 users pad to 16 on the 8-device mesh);
+    save_models must slice back to the dataset extent instead of crashing
+    in global_coefficients after the whole run trained."""
+    flags = [f for f in MATRIX_FLAGS]
+    i = flags.index("--streaming-random-effects")
+    del flags[i:i + 2]  # the in-memory mesh path, not streaming
+    from photon_ml_tpu.cli import game_training_driver
+
+    driver = game_training_driver.main(
+        ["--train-input-dirs", matrix_train_dir,
+         "--output-dir", str(tmp_path / "var-out")]
+        + flags
+        + ["--distributed", "true", "--solve-compaction", "3",
+           "--compute-variance", "true"]
+    )
+    # the model (incl. variances) saved without a padding shape mismatch
+    assert os.path.isdir(tmp_path / "var-out" / "best")
+    coord = driver.combo_coords[driver.best_index]["per-user"]
+    assert coord.mesh_ctx is not None
+    assert coord.num_entities % 8 == 0 and coord.true_entities == 14
+
+
+@pytest.mark.preempt
+def test_all_flags_on_matrix_bitwise_vs_flags_off(
+    matrix_train_dir, tmp_path
+):
+    """THE matrix gate: streaming + --distributed + --solve-compaction +
+    PHOTON_SPARSE_KERNEL=auto + --shape-canonicalization on + a mid-chunk
+    preemption with an in-process supervised relaunch — every policy the
+    old fence lattice forbade at once — trains BITWISE-equal to the
+    flags-off streaming baseline (per-entity means, total scores, and the
+    objective trajectory)."""
+    from photon_ml_tpu.optim.scheduler import solve_stats
+
+    baseline = _run_matrix_driver(
+        matrix_train_dir, str(tmp_path / "base-out")
+    )
+    base_result, base_means = _matrix_means(baseline)
+
+    solve_stats.reset()
+    allon = _run_matrix_driver(
+        matrix_train_dir, str(tmp_path / "allon-out"),
+        extra=(
+            "--distributed", "true",
+            "--solve-compaction", "3",
+            "--checkpoint-dir", str(tmp_path / "ckpt"),
+            "--max-restarts", "2",
+        ),
+        env=(
+            ("PHOTON_SPARSE_KERNEL", "auto"),
+            # drain mid-chunk INSIDE a streaming block: the deepest nested
+            # resume path (scheduler snapshot inside block progress)
+            ("PHOTON_PREEMPT_AT", "chunk:2"),
+        ),
+    )
+    # every policy genuinely engaged
+    assert allon.plan.sharding == "perhost_streaming"
+    assert allon.plan.schedule is not None and allon.plan.bucketer is not None
+    ledger = solve_stats.totals()
+    assert ledger["solves"] > 0 and ledger["executed_lane_iterations"] > 0
+    from photon_ml_tpu.parallel.perhost_streaming import (
+        PerHostStreamingRandomEffectCoordinate,
+    )
+
+    coord = allon.combo_coords[allon.best_index]["per-user"]
+    assert isinstance(coord, PerHostStreamingRandomEffectCoordinate)
+    assert coord.solve_schedule is not None
+
+    allon_result, allon_means = _matrix_means(allon)
+    assert sorted(allon_means) == sorted(base_means)
+    for eid, w in base_means.items():
+        np.testing.assert_array_equal(allon_means[eid], w, err_msg=eid)
+    np.testing.assert_array_equal(
+        np.asarray(allon_result.total_scores),
+        np.asarray(base_result.total_scores),
+    )
+    assert allon_result.objective_history == base_result.objective_history

@@ -216,9 +216,48 @@ class TestSolveCompactionFlag:
             self._parse("--solve-compaction", "sideways")
 
     def test_fused_cycle_fence(self):
+        """The ONE genuinely impossible pair the execution plan keeps:
+        chunk pauses re-enter the host, --fused-cycle is one XLA program
+        per iteration (pinned — this fence is proven, not assumed)."""
         with pytest.raises(ValueError, match="fused-cycle"):
             self._parse("--solve-compaction", "on", "--fused-cycle", "true")
 
-    def test_distributed_fence(self):
-        with pytest.raises(ValueError, match="distributed"):
-            self._parse("--solve-compaction", "8", "--distributed", "true")
+    def test_distributed_composes(self):
+        """The --solve-compaction x --distributed fence is DELETED: the
+        plan composes them (GSPMD-sharded chunk kernels; the compaction
+        loop stays host-side outside the mesh program)."""
+        p = self._parse("--solve-compaction", "8", "--distributed", "true")
+        assert p.distributed and p.solve_compaction == "8"
+        from photon_ml_tpu.compile.plan import ExecutionPlan
+
+        plan = ExecutionPlan.resolve(
+            solve_compaction=p.solve_compaction, distributed=True
+        )
+        assert plan.sharding == "mesh" and plan.schedule.chunk_size == 8
+        assert any(d.action == "composed" for d in plan.decisions)
+
+    def test_spec_error_and_fence_reported_together(self):
+        """validate() keeps its report-everything-at-once contract: a bad
+        ladder spec is normalized to 'off' for the fence checks, so the
+        spec error AND the streaming x fused-cycle fence land in ONE
+        error list instead of surfacing across two runs."""
+        with pytest.raises(ValueError) as ei:
+            self._parse(
+                "--shape-canonicalization", "sideways",
+                "--streaming-random-effects", "true",
+                "--fused-cycle", "true",
+            )
+        msg = str(ei.value)
+        assert "--shape-canonicalization" in msg and "fused-cycle" in msg
+
+    def test_vmapped_grid_true_fence_is_loud(self):
+        """--vmapped-grid true x --solve-compaction: the silent runtime
+        fallback is now a loud validate-time error (pinned message);
+        'auto' keeps the documented fallback."""
+        with pytest.raises(
+            ValueError,
+            match="--vmapped-grid true cannot compose with --solve-compaction",
+        ):
+            self._parse("--vmapped-grid", "true", "--solve-compaction", "4")
+        p = self._parse("--vmapped-grid", "auto", "--solve-compaction", "4")
+        assert p.vmapped_grid == "auto"

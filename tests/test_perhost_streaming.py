@@ -340,17 +340,31 @@ class TestParams:
             _pytest.fail(f"streaming x distributed fence resurfaced: {e}")
 
     def test_streaming_fused_cycle_fence_stays(self):
+        """Genuinely impossible (host streaming inside one XLA program) —
+        the execution plan keeps this fence, pinned here."""
         with pytest.raises(ValueError, match="fused-cycle|fused_cycle"):
             self._parse(
                 "--streaming-random-effects", "true", "--fused-cycle", "true"
             )
 
-    def test_streaming_bucketed_fence_stays(self):
-        with pytest.raises(ValueError, match="bucketed"):
-            self._parse(
-                "--streaming-random-effects", "true",
-                "--bucketed-random-effects", "true",
-            )
+    def test_streaming_bucketed_subsumed_not_fenced(self):
+        """The streaming x bucketed fence is DELETED: streaming already
+        sorts entities into tightly-padded size blocks, so the plan
+        SUBSUMES --bucketed-random-effects with a recorded decision and
+        the combination parses."""
+        p = self._parse(
+            "--streaming-random-effects", "true",
+            "--bucketed-random-effects", "true",
+        )
+        assert p.streaming_random_effects and p.bucketed_random_effects
+        from photon_ml_tpu.compile.plan import ExecutionPlan
+
+        plan = ExecutionPlan.resolve(streaming=True, bucketed=True)
+        assert plan.bucketed_subsumed()
+        assert any(
+            d.policy == "bucketed" and d.action == "subsumed"
+            for d in plan.decisions
+        )
 
 
 def _free_port() -> int:
@@ -361,7 +375,15 @@ def _free_port() -> int:
 
 def _launch_workers(tmp_path, env_extra=None):
     port = _free_port()
-    env = {**os.environ, **(env_extra or {})}
+    # pin the worker plan's env knobs so the flags-off arms stay flags-off
+    # under any ambient environment; the all-flags arm overrides explicitly
+    env = {
+        **os.environ,
+        "PHOTON_SOLVE_CHUNK": "off",
+        "PHOTON_SPARSE_KERNEL": "off",
+        "PHOTON_SHAPE_LADDER": "off",
+        **(env_extra or {}),
+    }
     return [
         subprocess.Popen(
             [sys.executable, WORKER, str(i), "2", str(port), str(tmp_path)],
@@ -372,21 +394,10 @@ def _launch_workers(tmp_path, env_extra=None):
     ]
 
 
-@pytest.mark.slow
-def test_two_process_streaming_cd_bitwise_vs_single_host(tmp_path):
-    """THE acceptance gate: the 2-process entity-sharded streaming CD run
-    (agree -> plan -> route -> owned blocks -> streaming CD with exact mesh
-    merges) is bitwise-equal to the single-host streaming run of the same
-    data — update + score + full CD cycles over both coordinates."""
-    procs = _launch_workers(tmp_path)
-    outs = []
-    for p in procs:
-        out, err = p.communicate(timeout=900)
-        assert p.returncode == 0, f"worker failed:\n{out[-3000:]}\n{err[-3000:]}"
-        outs.append(out)
-    assert all("PHSOK" in o for o in outs)
-
-    # ---- the single-host streaming reference (same seeded data) -----------
+def _single_host_reference(tmp_path):
+    """The flags-off single-host streaming CD run of the workers' seeded
+    dataset — the fenced baseline BOTH worker arms (flags-off and
+    all-flags-on) must match bitwise."""
     data = _sorted_vocab_data(
         np.random.default_rng(97),
         num_users=60, rows_per_user_range=(4, 16), d_fixed=5, d_random=4,
@@ -423,7 +434,11 @@ def test_two_process_streaming_cd_bitwise_vs_single_host(tmp_path):
         lambda s: jnp.sum(weights * loss.loss(s, labels)),
     )
     ref = cd.run(num_iterations=2, num_rows=N)
+    ref_means = re_ref.entity_means_by_raw_id(ref.coefficients["per-user"])
+    return ref, ref_means
 
+
+def _assert_workers_match_reference(tmp_path, ref, ref_means):
     run = np.load(tmp_path / "run.npz")
     np.testing.assert_array_equal(
         run["fe"], np.asarray(ref.coefficients["fixed"])
@@ -436,7 +451,6 @@ def test_two_process_streaming_cd_bitwise_vs_single_host(tmp_path):
     )
     # per-entity coefficients: the union of the two hosts' owned means must
     # equal the single-host export exactly, entity for entity
-    ref_means = re_ref.entity_means_by_raw_id(ref.coefficients["per-user"])
     merged = {}
     for pid in range(2):
         z = np.load(tmp_path / f"means-host{pid}.npz", allow_pickle=True)
@@ -446,6 +460,52 @@ def test_two_process_streaming_cd_bitwise_vs_single_host(tmp_path):
     assert sorted(merged) == sorted(ref_means)
     for k, vec in ref_means.items():
         np.testing.assert_array_equal(merged[k], vec, err_msg=k)
+
+
+@pytest.mark.slow
+def test_two_process_streaming_cd_bitwise_vs_single_host(tmp_path):
+    """THE acceptance gate: the 2-process entity-sharded streaming CD run
+    (agree -> plan -> route -> owned blocks -> streaming CD with exact mesh
+    merges) is bitwise-equal to the single-host streaming run of the same
+    data — update + score + full CD cycles over both coordinates."""
+    procs = _launch_workers(tmp_path)
+    outs = []
+    for p in procs:
+        out, err = p.communicate(timeout=900)
+        assert p.returncode == 0, f"worker failed:\n{out[-3000:]}\n{err[-3000:]}"
+        outs.append(out)
+    assert all("PHSOK" in o for o in outs)
+    ref, ref_means = _single_host_reference(tmp_path)
+    _assert_workers_match_reference(tmp_path, ref, ref_means)
+
+
+@pytest.mark.slow
+def test_two_process_all_flags_on_bitwise_vs_single_host(tmp_path):
+    """The composable-execution-plan acceptance gate at multihost scale:
+    the SAME 2-process harness with --solve-compaction (PHOTON_SOLVE_CHUNK)
+    AND the sparse-kernel race (PHOTON_SPARSE_KERNEL=auto) switched on
+    through the workers' env-resolved ExecutionPlan stays bitwise-equal to
+    the flags-off single-host streaming reference: compacted perhost solve
+    == one-shot perhost solve == single-host solve. (The shape ladder rides
+    both sides of its own comparison in the single-process matrix test —
+    its on-vs-off contract is PR 3's regime-limited one.)"""
+    procs = _launch_workers(
+        tmp_path,
+        env_extra={
+            "PHOTON_SOLVE_CHUNK": "3",
+            "PHOTON_SPARSE_KERNEL": "auto",
+        },
+    )
+    outs = []
+    for p in procs:
+        out, err = p.communicate(timeout=900)
+        assert p.returncode == 0, f"worker failed:\n{out[-3000:]}\n{err[-3000:]}"
+        outs.append(out)
+    assert all("PHSOK" in o for o in outs)
+    # compaction genuinely engaged (the worker reports its ledger)
+    assert all("compaction_saved=" in o for o in outs)
+    ref, ref_means = _single_host_reference(tmp_path)
+    _assert_workers_match_reference(tmp_path, ref, ref_means)
 
 
 @pytest.mark.slow
@@ -511,6 +571,11 @@ def test_multihost_driver_streaming_random_effects(tmp_path):
         "per-user:userId,per_user,2,-1,0,-1,index_map",
         "--num-iterations", "2",
         "--streaming-random-effects", "true",
+        # threads the solve schedule through BOTH drivers' execution plans
+        # (the multihost build_coords hands it to the per-host coordinate;
+        # compaction is bitwise, so the cross-driver parity bound is
+        # unchanged) — driver-level proof of the composable-plan wiring
+        "--solve-compaction", "4",
         "--offheap-indexmap-dir", idx_dir,
         "--delete-output-dir-if-exists", "true",
     ]

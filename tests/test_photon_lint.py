@@ -149,6 +149,20 @@ def test_fleet_package_in_scan_scope():
     assert "photon_ml_tpu/cli/fleet_driver.py" in scanned
 
 
+def test_exec_plan_module_in_scan_scope():
+    """The execution-plan module (compile/plan.py) is inside the default
+    scan scope: its resolve() consults env vars and constructs policy
+    objects — exactly what the jit-sites / traced-construction rules
+    exist to police if it ever leaks into a staged context."""
+    paths = [os.path.join(REPO, p) for p in engine.DEFAULT_SCOPE]
+    scanned = {
+        os.path.relpath(p, REPO).replace(os.sep, "/")
+        for p in engine.iter_py_files(paths)
+    }
+    assert "photon_ml_tpu/compile/plan.py" in scanned
+    assert "photon_ml_tpu/compile/__init__.py" in scanned
+
+
 # ---------------------------------------------------------------------------
 # engine: suppression-tag grammar
 # ---------------------------------------------------------------------------
@@ -268,7 +282,7 @@ def test_registry_parse_matches_runtime_module():
         "io.read_block", "io.checkpoint_write", "io.cache_read",
         "multihost.barrier", "optim.step", "preempt.signal",
     } <= set(sites.FAULT_SITES)
-    assert set(sites.PREEMPT_SITES) == {"cycle", "block", "chunk"}
+    assert set(sites.PREEMPT_SITES) == {"cycle", "block", "chunk", "bucket"}
 
 
 # ---------------------------------------------------------------------------

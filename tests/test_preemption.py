@@ -566,30 +566,137 @@ class TestMidChunkPreemption:
 
 
 class TestBucketedPreemption:
-    def test_mid_chunk_in_bucket_drops_partial_cleanly(self, glmix):
-        """The bucketed coordinate has no mid-bucket resume: a chunk-level
-        preemption must surface WITHOUT a partial (so the emergency
-        checkpoint lands at the update boundary and the relaunch recomputes
-        the coordinate whole) — never a TypeError on the resume path."""
+    """Mid-bucket preemption RESUME: the 'bucketed drops the partial'
+    carve-out is gone — a chunk-level drain inside bucket j snapshots the
+    finished buckets' coefficients + the paused scheduler carries, and
+    resuming continues bitwise from exactly that point."""
+
+    def _bucketed(self, glmix, **kw):
         from photon_ml_tpu.algorithm.bucketed_random_effect import (
             BucketedRandomEffectCoordinate,
         )
 
-        coord = BucketedRandomEffectCoordinate(
+        return BucketedRandomEffectCoordinate(
             data=glmix,
             config=RandomEffectDataConfig("userId", "per_user"),
             task=TaskType.LOGISTIC_REGRESSION,
+            optimizer_config=OptimizerConfig(max_iterations=30, tolerance=1e-9),
             regularization=RegularizationContext.l2(0.2),
             solve_schedule=SolveSchedule(chunk_size=3),
+            **kw,
         )
+
+    @pytest.mark.slow  # ~11s of chunk kernels; the CD-level test below
+    # pins the same mid-chunk resume bitwise inside tier-1
+    def test_mid_chunk_in_bucket_carries_partial(self, glmix):
+        coord = self._bucketed(glmix)
+        resid = jnp.zeros((glmix.num_rows,), jnp.float32)
+        clean_state, _ = coord.update(resid, coord.initial_coefficients())
+
         preemption.install_plan({"chunk": 2})
         with pytest.raises(Preempted) as ei:
-            coord.update(
-                jnp.zeros((glmix.num_rows,), jnp.float32),
-                coord.initial_coefficients(),
+            coord.update(resid, coord.initial_coefficients())
+        preemption.reset()
+        partial = ei.value.partial
+        assert partial is not None and ei.value.site == "chunk"
+        assert partial["meta"]["kind"] == "bucketed_re"
+        assert partial["meta"]["inner"]["kind"] == "scheduler"
+
+        # resume from the snapshot: bitwise-equal to the uninterrupted run
+        resumed_state, results = coord.update(
+            resid, coord.initial_coefficients(), resume=partial
+        )
+        for j, (wa, wb) in enumerate(zip(clean_state, resumed_state)):
+            np.testing.assert_array_equal(
+                np.asarray(wa), np.asarray(wb), err_msg=f"bucket {j}"
             )
-        assert ei.value.partial is None
-        assert ei.value.site == "chunk"
+        # finished buckets' tracker summaries are placeholders, not redone
+        assert all(
+            results[j] is None
+            for j in range(int(partial["meta"]["bucket"]))
+        )
+
+    def test_bucket_boundary_drain_and_resume(self, glmix):
+        """PHOTON_PREEMPT_AT grammar covers the new 'bucket' site: the
+        drain lands BETWEEN buckets (no inner snapshot) and resumes
+        bitwise."""
+        coord = self._bucketed(glmix)
+        assert len(coord.buckets) >= 2  # the drain needs a real boundary
+        resid = jnp.zeros((glmix.num_rows,), jnp.float32)
+        clean_state, _ = coord.update(resid, coord.initial_coefficients())
+
+        os.environ["PHOTON_PREEMPT_AT"] = "bucket:1"
+        try:
+            with pytest.raises(Preempted) as ei:
+                coord.update(resid, coord.initial_coefficients())
+        finally:
+            os.environ.pop("PHOTON_PREEMPT_AT", None)
+            preemption.reset()
+        partial = ei.value.partial
+        assert ei.value.site == "bucket"
+        assert partial["meta"]["bucket"] == 1
+        assert partial["meta"]["inner"] is None
+
+        resumed_state, _ = coord.update(
+            resid, coord.initial_coefficients(), resume=partial
+        )
+        for j, (wa, wb) in enumerate(zip(clean_state, resumed_state)):
+            np.testing.assert_array_equal(
+                np.asarray(wa), np.asarray(wb), err_msg=f"bucket {j}"
+            )
+
+    def test_resume_refuses_rebuilt_buckets(self, glmix):
+        """Same refuse-to-resume rule as SpilledREState: a snapshot whose
+        bucket shapes no longer match (config drifted since the emergency
+        save) must raise, never scatter coefficients into wrong buckets."""
+        coord = self._bucketed(glmix)
+        resid = jnp.zeros((glmix.num_rows,), jnp.float32)
+        preemption.install_plan({"chunk": 2})
+        with pytest.raises(Preempted) as ei:
+            coord.update(resid, coord.initial_coefficients())
+        preemption.reset()
+        partial = ei.value.partial
+        tampered = {
+            "meta": {**partial["meta"],
+                     "shapes": [[1, 1]] * len(partial["meta"]["shapes"])},
+            "arrays": partial["arrays"],
+        }
+        with pytest.raises(ValueError, match="refusing to resume"):
+            coord.update(
+                resid, coord.initial_coefficients(), resume=tampered
+            )
+
+    def test_mid_bucket_emergency_checkpoint_resume_bitwise(
+        self, glmix, tmp_path
+    ):
+        """End-to-end through CoordinateDescent + the emergency
+        checkpoint: the interrupted step's bucketed partial persists and
+        the relaunched run resumes MID-BUCKET, bitwise-equal to the
+        uninterrupted descent (the PR 5 drain path without its bucketed
+        carve-out)."""
+        n = glmix.num_rows
+        clean = _cd(glmix, self._bucketed(glmix)).run(2, n)
+
+        ck_dir = str(tmp_path / "ckpt")
+        preemption.install_plan({"chunk": 2})
+        with pytest.raises(Preempted) as ei:
+            _cd(glmix, self._bucketed(glmix)).run(
+                2, n, CoordinateDescentCheckpointer(ck_dir)
+            )
+        assert ei.value.partial["meta"]["kind"] == "bucketed_re"
+
+        preemption.reset()
+        resumed = _cd(glmix, self._bucketed(glmix)).run(
+            2, n, CoordinateDescentCheckpointer(ck_dir)
+        )
+        assert clean.objective_history == resumed.objective_history
+        for wa, wb in zip(
+            clean.coefficients["re"], resumed.coefficients["re"]
+        ):
+            np.testing.assert_array_equal(np.asarray(wa), np.asarray(wb))
+        np.testing.assert_array_equal(
+            np.asarray(clean.total_scores), np.asarray(resumed.total_scores)
+        )
 
 
 class TestMidBlockPreemption:

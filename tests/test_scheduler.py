@@ -353,19 +353,98 @@ class TestCoordinateWiring:
         for a, b in zip(st_plain, st_sched):
             assert np.array_equal(np.asarray(a), np.asarray(b))
 
-    def test_bucketed_rejects_mesh(self, glmix):
+    @pytest.mark.slow  # ~9s of GSPMD compiles; tier-1 still drives this
+    # path end-to-end via test_exec_plan's mesh-scheduled driver run
+    def test_plain_coordinate_composes_with_mesh(self, glmix):
+        """RandomEffectCoordinate(mesh_ctx=...) — the GSPMD-sharded
+        scheduled path behind the deleted --solve-compaction x
+        --distributed fence: pads + shards the entity axis, trims the
+        tracker to real entities, and matches the unsharded scheduled
+        solve under the mesh path's allclose contract."""
+        from photon_ml_tpu.parallel.mesh import MeshContext, data_mesh
+
+        ds = build_random_effect_dataset(
+            glmix, RandomEffectDataConfig("userId", "per_user")
+        )
+        kw = dict(
+            task=TaskType.LOGISTIC_REGRESSION,
+            optimizer_config=OptimizerConfig(max_iterations=20, tolerance=1e-9),
+            regularization=RegularizationContext.l2(0.1),
+            solve_schedule=SolveSchedule(chunk_size=4),
+        )
+        plain = RandomEffectCoordinate(ds, **kw)
+        mesh = RandomEffectCoordinate(
+            ds, mesh_ctx=MeshContext(data_mesh()), **kw
+        )
+        assert mesh.num_entities % 8 == 0  # padded to the device multiple
+        resid = jnp.zeros((glmix.num_rows,), jnp.float32)
+        w_plain, _ = plain.update(resid, plain.initial_coefficients())
+        w_mesh, trk = mesh.update(resid, mesh.initial_coefficients())
+        assert np.asarray(trk.reason).shape[0] == mesh.true_entities
+        np.testing.assert_allclose(
+            np.asarray(w_plain), np.asarray(w_mesh)[: mesh.true_entities],
+            rtol=1e-6, atol=1e-6,
+        )
+        np.testing.assert_allclose(
+            np.asarray(plain.score(w_plain)), np.asarray(mesh.score(w_mesh)),
+            rtol=1e-6, atol=1e-6,
+        )
+
+    def test_plain_coordinate_mesh_requires_schedule(self, glmix):
+        from photon_ml_tpu.parallel.mesh import MeshContext, data_mesh
+
+        ds = build_random_effect_dataset(
+            glmix, RandomEffectDataConfig("userId", "per_user")
+        )
+        with pytest.raises(ValueError, match="one-shot mesh solves"):
+            RandomEffectCoordinate(
+                ds, task=TaskType.LOGISTIC_REGRESSION,
+                mesh_ctx=MeshContext(data_mesh()),
+            )
+
+    @pytest.mark.slow  # per-bucket GSPMD compiles (~27s); the plain-
+    # coordinate mesh test above pins the same mechanism in tier-1
+    def test_bucketed_composes_with_mesh(self, glmix):
+        """The bucketed-compaction x mesh_ctx fence is DELETED: scheduled
+        buckets GSPMD-shard their entity axis over the mesh and run the
+        shared chunk kernels — same allclose contract as the one-shot
+        shard_map engine (XLA may fuse a lane's reductions differently per
+        per-device batch; the BITWISE guarantee is the streaming
+        owner-computes path's, pinned elsewhere)."""
         from photon_ml_tpu.algorithm.bucketed_random_effect import (
             BucketedRandomEffectCoordinate,
         )
+        from photon_ml_tpu.parallel.mesh import MeshContext, data_mesh
 
-        with pytest.raises(ValueError, match="mesh"):
-            BucketedRandomEffectCoordinate(
-                data=glmix,
-                config=RandomEffectDataConfig("userId", "per_user"),
-                task=TaskType.LOGISTIC_REGRESSION,
-                mesh_ctx=object(),
-                solve_schedule=SolveSchedule(),
+        kw = dict(
+            data=glmix,
+            config=RandomEffectDataConfig("userId", "per_user"),
+            task=TaskType.LOGISTIC_REGRESSION,
+            optimizer_config=OptimizerConfig(max_iterations=20, tolerance=1e-9),
+            regularization=RegularizationContext.l2(0.1),
+            solve_schedule=SolveSchedule(chunk_size=4),
+        )
+        plain = BucketedRandomEffectCoordinate(**kw)
+        mesh = BucketedRandomEffectCoordinate(
+            mesh_ctx=MeshContext(data_mesh()), **kw
+        )
+        resid = jnp.zeros((glmix.num_rows,), jnp.float32)
+        w_plain, _ = plain.update(resid, plain.initial_coefficients())
+        w_mesh, _ = mesh.update(resid, mesh.initial_coefficients())
+        for j, (sub, wa, wb) in enumerate(zip(plain._subs, w_plain, w_mesh)):
+            np.testing.assert_allclose(
+                np.asarray(wa),
+                np.asarray(wb)[: sub.dataset.num_entities],
+                rtol=1e-6, atol=1e-6, err_msg=f"bucket {j}",
             )
+        np.testing.assert_allclose(
+            np.asarray(plain.score(w_plain)), np.asarray(mesh.score(w_mesh)),
+            rtol=1e-6, atol=1e-6,
+        )
+        np.testing.assert_allclose(
+            float(plain.regularization_term(w_plain)),
+            float(mesh.regularization_term(w_mesh)), rtol=1e-6,
+        )
 
     def test_streaming_coordinate_bitwise(self, glmix, tmp_path):
         from photon_ml_tpu.algorithm.streaming_random_effect import (
