@@ -2418,11 +2418,327 @@ def _bench_preempt(extra, on_tpu):
     extra["preempt_new_compiles_on_resume"] = int(new_compiles)
 
 
+def _bench_retrain_delta(extra, on_tpu):
+    """Incremental delta retraining (photon_ml_tpu/retrain): the daily
+    90%-unchanged workload. Arms: (1) cold day-2 retrain vs delta retrain
+    warm-started from day-1 — the delta run must reach the cold run's
+    final objective/AUC in <= 50% of its wall-clock, with every frozen
+    block's coefficients BITWISE-equal to the day-1 model; (2) a fully
+    warm rerun (nothing changed) short-circuits with ZERO new XLA compiles
+    (CompileStats watermark); (3) a day-3 delta retrain + store export +
+    live ScoringServer swap while request traffic flows (0 new compiles,
+    0 dropped requests)."""
+    import concurrent.futures
+    import dataclasses as _dc
+    import shutil
+    import tempfile
+    import threading
+
+    from game_test_utils import (
+        dense_to_csr,
+        game_avro_records,
+        serve_requests_from_records,
+        write_game_avro,
+    )
+
+    from photon_ml_tpu.cli import game_training_driver
+    from photon_ml_tpu.compile import compile_stats
+    from photon_ml_tpu.data.game import GameData
+    from photon_ml_tpu.io import model_io
+    from photon_ml_tpu.serve import (
+        ModelStore,
+        ModelSwapper,
+        ScoringServer,
+        ServeStats,
+    )
+
+    tmp = tempfile.mkdtemp(prefix="bench-retrain-")
+    try:
+        # --- workload: per-file user cohorts with uniform row counts, so
+        # the count-sorted entity blocking preserves cohort order and one
+        # mutated file dirties ~1/num_files of the blocks (the daily
+        # cohort shape: yesterday's members mostly quiet today)
+        num_files = 10
+        users_per_file = 96 if on_tpu else 60
+        num_users = num_files * users_per_file
+        d_fixed, d_random = 8, 6
+        rng = np.random.default_rng(31)
+        rows_per_user = np.full(num_users, 24)
+        n = int(rows_per_user.sum())
+        user_of_row = np.repeat(
+            np.arange(num_users, dtype=np.int32), rows_per_user
+        )
+        x_fixed = rng.normal(size=(n, d_fixed)).astype(np.float32)
+        x_random = rng.normal(size=(n, d_random)).astype(np.float32)
+        w_fixed = rng.normal(size=d_fixed).astype(np.float32)
+        w_users = (rng.normal(size=(num_users, d_random)) * 1.2).astype(
+            np.float32
+        )
+        margin = x_fixed @ w_fixed + np.sum(
+            x_random * w_users[user_of_row], axis=1
+        )
+        y = (1.0 / (1.0 + np.exp(-margin)) > rng.random(n)).astype(np.float32)
+        gd = GameData(
+            response=y, offset=np.zeros(n, np.float32),
+            weight=np.ones(n, np.float32),
+            ids={"userId": user_of_row},
+            id_vocabs={"userId": [f"u{i:05d}" for i in range(num_users)]},
+            shards={"global": dense_to_csr(x_fixed),
+                    "per_user": dense_to_csr(x_random)},
+        )
+        truth = {"x_fixed": x_fixed, "x_random": x_random}
+        # last 4 rows of EVERY user are validation (deterministic, so
+        # per-user train counts stay uniform and the count-sorted blocking
+        # stays file-aligned); the validation file never moves
+        user_start = np.concatenate(
+            [[0], np.cumsum(rows_per_user)[:-1]]
+        )
+        pos_in_user = np.arange(n) - user_start[user_of_row]
+        val_mask = pos_in_user >= rows_per_user[user_of_row] - 4
+        train_dir = os.path.join(tmp, "train")
+        val_dir = os.path.join(tmp, "validate")
+        os.makedirs(train_dir)
+        os.makedirs(val_dir)
+        file_rows = []
+        for k in range(num_files):
+            in_file = (
+                (user_of_row >= users_per_file * k)
+                & (user_of_row < users_per_file * (k + 1))
+                & ~val_mask
+            )
+            rows = np.nonzero(in_file)[0]
+            file_rows.append(rows)
+            write_game_avro(
+                os.path.join(train_dir, f"part-{k}.avro"), gd, rows, truth
+            )
+        write_game_avro(
+            os.path.join(val_dir, "part-0.avro"), gd,
+            np.nonzero(val_mask)[0], truth,
+        )
+
+        def mutate_file(k, seed):
+            """Day rollover: file k's labels move (same rows, same users —
+            the store slab shapes stay swap-compatible)."""
+            mrng = np.random.default_rng(seed)
+            y2 = np.array(gd.response)
+            rows = file_rows[k]
+            flip = rows[mrng.random(len(rows)) < 0.2]
+            y2[flip] = 1.0 - y2[flip]
+            time.sleep(0.02)  # mtime_ns must move on coarse filesystems
+            write_game_avro(
+                os.path.join(train_dir, f"part-{k}.avro"),
+                _dc.replace(gd, response=y2), rows, truth,
+            )
+
+        def run(out, warm_from=None, export=None, cache="tcache"):
+            # the cold day-2 arm gets its OWN cache dir: both the cold and
+            # delta runs then pay the same full-decode miss on the changed
+            # file set, so the measured delta win is the retrain loop's
+            # (block reuse + solve skip + warm starts), not a same-cache
+            # run-order artifact
+            args = [
+                "--train-input-dirs", train_dir,
+                "--validate-input-dirs", val_dir,
+                "--output-dir", out,
+                "--task-type", "LOGISTIC_REGRESSION",
+                "--feature-shard-id-to-feature-section-keys-map",
+                "global:fixedFeatures|per_user:userFeatures",
+                "--updating-sequence", "fixed,per-user",
+                "--fixed-effect-data-configurations", "fixed:global,1",
+                "--random-effect-data-configurations",
+                "per-user:userId,per_user,1,-1,-1,-1,INDEX_MAP",
+                "--fixed-effect-optimization-configurations",
+                "fixed:100,1e-10,0.01,1,LBFGS,L2",
+                "--random-effect-optimization-configurations",
+                "per-user:100,1e-10,0.1,1,LBFGS,L2",
+                "--evaluator-type", "AUC",
+                "--delete-output-dir-if-exists", "true",
+                # uniform per-user counts: every full block already shares
+                # one (E, M, D) shape, so the solver executable is reused
+                # across blocks without the shape ladder; blocks of 12
+                # users -> 5 blocks per file cohort, cut on cohort
+                # boundaries (60 % 12 == 0)
+                "--re-memory-budget-mb", "0.0068",
+                "--num-iterations", "6",
+                "--tensor-cache", os.path.join(tmp, cache),
+            ]
+            if warm_from:
+                args += ["--warm-start-from", warm_from]
+            if export:
+                args += ["--export-serve-store", export]
+            t0 = time.perf_counter()
+            driver = game_training_driver.main(args)
+            return driver, time.perf_counter() - t0
+
+        def best_metrics(driver):
+            _, result, metrics = driver.results[driver.best_index]
+            return float(result.objective_history[-1]), float(metrics["AUC"])
+
+        # --- day 1: the prior (also warms every executable in-process,
+        # so the cold-vs-delta day-2 comparison below is compile-fair)
+        day1_out = os.path.join(tmp, "day1")
+        store1 = os.path.join(tmp, "store1")
+        d1, t_day1 = run(day1_out, export=store1)
+        n_blocks = len(d1.streaming_manifests["per-user"].blocks)
+        _log(f"retrain_delta: day-1 prior trained in {t_day1:.1f}s "
+             f"({n_blocks} streaming blocks)")
+
+        # --- day 2: one of ten files moves
+        mutate_file(num_files - 1, seed=41)
+        cold_out = os.path.join(tmp, "day2-cold")
+        d_cold, t_cold = run(cold_out, cache="tcache-cold")
+        obj_cold, auc_cold = best_metrics(d_cold)
+        delta_out = os.path.join(tmp, "day2-delta")
+        store2 = os.path.join(tmp, "store2")
+        d_delta, t_delta = run(delta_out, warm_from=day1_out, export=store2)
+        obj_delta, auc_delta = best_metrics(d_delta)
+        deltas = d_delta.block_deltas["per-user"]
+        frozen = d_delta._frozen_blocks["per-user"]
+        _log(
+            f"retrain_delta: day-2 cold {t_cold:.1f}s "
+            f"(obj {obj_cold:.5g}, AUC {auc_cold:.4f}) vs delta "
+            f"{t_delta:.1f}s (obj {obj_delta:.5g}, AUC {auc_delta:.4f}); "
+            f"{len(frozen)}/{len(deltas)} blocks frozen"
+        )
+        if t_delta > 0.5 * t_cold:
+            raise AssertionError(
+                f"delta retrain took {t_delta:.1f}s > 50% of the cold "
+                f"retrain's {t_cold:.1f}s"
+            )
+        if obj_delta > obj_cold * 1.02 or auc_delta < auc_cold - 0.01:
+            raise AssertionError(
+                f"delta retrain did not reach the cold run's quality: "
+                f"obj {obj_delta:.6g} vs {obj_cold:.6g}, "
+                f"AUC {auc_delta:.4f} vs {auc_cold:.4f}"
+            )
+
+        # --- bitwise gate: every frozen block's entities carry the day-1
+        # coefficients bit-for-bit
+        imap = d_delta.shard_index_maps["per_user"]
+        means1, _, _, _ = model_io.load_random_effect(
+            os.path.join(day1_out, "best"), "per-user", imap)
+        means2, _, _, _ = model_io.load_random_effect(
+            os.path.join(delta_out, "best"), "per-user", imap)
+        m_delta = d_delta.streaming_manifests["per-user"]
+        frozen_entities = 0
+        for i in frozen:
+            bm = m_delta.load_block_meta(i)
+            for v in bm.entity_ids:
+                raw = m_delta.vocab[v]
+                if not np.array_equal(means1[raw], means2[raw]):
+                    raise AssertionError(
+                        f"frozen block {i} entity {raw} is not bitwise-"
+                        "equal to the prior model"
+                    )
+                frozen_entities += 1
+        _log(f"retrain_delta: {frozen_entities} frozen-block entities "
+             "bitwise-equal to the day-1 model")
+
+        # --- fully warm rerun: nothing changed since day-2-delta
+        wm = compile_stats.watermark()
+        rerun_out = os.path.join(tmp, "day2-rerun")
+        d_rerun, t_rerun = run(rerun_out, warm_from=delta_out)
+        rerun_compiles = wm.new_traces()
+        if not (d_rerun.delta_plan and d_rerun.delta_plan.short_circuit):
+            raise AssertionError("unchanged rerun did not short-circuit")
+        if rerun_compiles != 0:
+            raise AssertionError(
+                f"{rerun_compiles} new traces on the fully warm rerun"
+            )
+        _log(f"retrain_delta: fully warm rerun {t_rerun:.2f}s, "
+             "0 new XLA compiles, prior model reused wholesale")
+
+        # --- day 3: delta retrain + hot swap while traffic flows against
+        # the day-2 store
+        sections = {"global": ["fixedFeatures"], "per_user": ["userFeatures"]}
+        sample_rows = np.nonzero(val_mask)[0][:64]
+        reqs = serve_requests_from_records(
+            list(game_avro_records(gd, sample_rows, truth))
+        )
+        server = ScoringServer(
+            ModelStore(store2), shard_sections=sections,
+            max_batch_rows=32, max_wait_ms=2.0, stats=ServeStats(),
+        )
+        server.warmup(warm_nnz=16)
+        stop = threading.Event()
+        served = {"n": 0, "errors": 0}
+
+        def traffic():
+            i = 0
+            while not stop.is_set():
+                try:
+                    out = server.score_rows([reqs[i % len(reqs)]])
+                    if out is None or len(out) != 1:
+                        served["errors"] += 1
+                    served["n"] += 1
+                except Exception:  # noqa: BLE001 — any scoring failure during the swap window is exactly what this arm counts
+                    served["errors"] += 1
+                i += 1
+
+        threads = [threading.Thread(target=traffic) for _ in range(4)]
+        for th in threads:
+            th.start()
+        try:
+            mutate_file(0, seed=43)
+            day3_out = os.path.join(tmp, "day3")
+            store3 = os.path.join(tmp, "store3")
+            d3, t_day3 = run(day3_out, warm_from=delta_out, export=store3)
+            swapper = ModelSwapper(server)
+            report = swapper.swap(store3)
+        finally:
+            stop.set()
+            for th in threads:
+                th.join()
+        server.close()
+        _log(
+            f"retrain_delta: day-3 delta retrain {t_day3:.1f}s under live "
+            f"traffic ({served['n']} requests, {served['errors']} errors); "
+            f"swap gen {report['generation']}, "
+            f"{report['new_compiles']} new compiles, "
+            f"{report['dropped_requests']} drops"
+        )
+        if report["new_compiles"] != 0 or served["errors"] != 0:
+            raise AssertionError(
+                f"mid-retrain swap arm must be compile-free and lossless "
+                f"(compiles={report['new_compiles']}, "
+                f"errors={served['errors']})"
+            )
+
+        extra["retrain_config"] = {
+            "files": num_files, "users": num_users,
+            "rows": int(n), "blocks": n_blocks,
+            "dirty_files_per_day": 1,
+        }
+        extra["retrain_day1_s"] = round(t_day1, 2)
+        extra["retrain_cold_s"] = round(t_cold, 2)
+        extra["retrain_delta_s"] = round(t_delta, 2)
+        extra["retrain_speedup_vs_cold"] = round(t_cold / t_delta, 2)
+        extra["retrain_cold_objective"] = obj_cold
+        extra["retrain_delta_objective"] = obj_delta
+        extra["retrain_cold_auc"] = auc_cold
+        extra["retrain_delta_auc"] = auc_delta
+        extra["retrain_blocks_frozen"] = len(frozen)
+        extra["retrain_blocks_total"] = len(deltas)
+        extra["retrain_frozen_entities_bitwise"] = int(frozen_entities)
+        extra["retrain_warm_rerun_s"] = round(t_rerun, 2)
+        extra["retrain_warm_rerun_new_compiles"] = int(rerun_compiles)
+        extra["retrain_day3_delta_s"] = round(t_day3, 2)
+        extra["retrain_swap_new_compiles"] = int(report["new_compiles"])
+        extra["retrain_swap_dropped_requests"] = int(
+            report["dropped_requests"]
+        )
+        extra["retrain_traffic_requests_during_retrain"] = int(served["n"])
+        extra["retrain_traffic_errors"] = int(served["errors"])
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
 SECTION_ORDER = (
     "dense", "sparse", "sparse_race", "game", "game5", "grid",
     "streaming", "streaming_pipeline", "compile_reuse", "compaction",
     "preemption_resume",
     "perhost", "perhost_streaming", "scoring", "serving", "serving_fleet",
+    "retrain_delta",
     "ingest",
 )
 # orchestrator per-section deadlines (s): generous — tunnel compiles are slow,
@@ -2438,7 +2754,10 @@ SECTION_DEADLINES = {"dense": 3600, "game": 3600, "game5": 2400, "grid": 2400,
                      "perhost_streaming": 10500,
                      # 3 fleets (1/2/4 replicas) of warmed subprocess
                      # replicas + the kill arm, each spawn fenced at 240s
-                     "serving_fleet": 3600}
+                     "serving_fleet": 3600,
+                     # 5 full GAME training runs (day-1 prior, day-2
+                     # cold + delta, warm rerun, day-3 under traffic)
+                     "retrain_delta": 3600}
 DEFAULT_SECTION_DEADLINE = 1800
 
 
@@ -2571,6 +2890,8 @@ def _run_sections(names, extra, errors, on_tpu, state=None, after=None):
                 _bench_serving(extra, on_tpu)
             elif name == "serving_fleet":
                 _bench_serving_fleet(extra, on_tpu)
+            elif name == "retrain_delta":
+                _bench_retrain_delta(extra, on_tpu)
             elif name == "ingest":
                 _bench_ingest(extra)
         except Exception:  # noqa: BLE001 — per-section fence: failure recorded in errors, bench continues

@@ -304,7 +304,7 @@ class CoordinateDescent:
             n: jnp.broadcast_to(
                 (w0 := (
                     init_params[n]
-                    if init_params is not None
+                    if init_params is not None and n in init_params
                     else self.coordinates[n].initial_coefficients()
                 )), (1,) + w0.shape
             )
@@ -316,8 +316,12 @@ class CoordinateDescent:
             # mirror run(initial_params=...): a warm-started coordinate
             # contributes its CURRENT scores from step zero, broadcast
             # to the lane axis — otherwise the first grid cycle trains
-            # every combo against zero offsets, defeating the warm start
+            # every combo against zero offsets, defeating the warm start.
+            # Names MISSING from init_params (e.g. a coordinate new since
+            # the prior model) start cold, exactly like run().
             for n in names:
+                if n not in init_params:
+                    continue
                 s0 = self.coordinates[n].score(jnp.asarray(init_params[n], dt))
                 scores0[n] = jnp.broadcast_to(s0, (1, num_rows)).astype(dt)
                 total0 = total0 + scores0[n]
@@ -438,6 +442,7 @@ class CoordinateDescent:
         num_rows: int,
         checkpointer: Optional["CoordinateDescentCheckpointer"] = None,
         initial_params: Optional[Dict[str, object]] = None,
+        frozen: Optional[set] = None,
     ) -> CoordinateDescentResult:
         """Run the descent; with a ``checkpointer``, state is saved after
         every coordinate update and a restart resumes from the last complete
@@ -448,8 +453,37 @@ class CoordinateDescent:
         run's coefficients (the grid-sweep warm start,
         ModelTraining.scala:158-191 semantics); missing names fall back to
         the coordinate's own initialization. A restored checkpoint takes
-        precedence over both."""
+        precedence over both.
+
+        ``frozen`` (the delta-retrain skip, photon_ml_tpu.retrain) names
+        coordinates whose data AND configuration are unchanged since the
+        prior run: they carry their ``initial_params`` coefficients and the
+        step-zero scores forward BITWISE without ever solving — the
+        objective still counts their loss/regularization contribution and
+        histories/checkpoints stay step-aligned, so a frozen coordinate is
+        indistinguishable from a converged one to everything downstream.
+        Every frozen name must be warm-started (freezing an uninitialized
+        coordinate would freeze zeros)."""
         names = list(self.coordinates)
+        frozen = frozenset(frozen or ())
+        if frozen:
+            unknown = frozen - set(names)
+            if unknown:
+                raise ValueError(f"frozen coordinates {sorted(unknown)} are "
+                                 "not in the updating sequence")
+            unseeded = [n for n in frozen
+                        if initial_params is None or n not in initial_params]
+            if unseeded:
+                raise ValueError(
+                    f"frozen coordinates {sorted(unseeded)} have no "
+                    "initial_params — freezing needs the prior coefficients"
+                )
+            if self.fused_cycle:
+                raise ValueError(
+                    "frozen coordinates cannot compose with fused_cycle "
+                    "(per-coordinate skip lives outside the compiled "
+                    "iteration); use the per-update path"
+                )
         params = {
             n: (
                 initial_params[n]
@@ -662,7 +696,7 @@ class CoordinateDescent:
                 step += 1
                 if step <= start_step:
                     continue  # already completed before the restart
-                if not skip_rest_of_cycle:
+                if not skip_rest_of_cycle and name not in frozen:
                     partial = total - scores[name]  # sum of the OTHER coordinates
                     t0 = time.perf_counter()
                     try:
@@ -724,7 +758,8 @@ class CoordinateDescent:
                     params[name] = new_params
                     total = partial + new_score
                     scores[name] = new_score
-                # else: guard abandoned this cycle — state is unchanged, but
+                # else: guard abandoned this cycle OR the coordinate is
+                # frozen (delta retrain) — state is unchanged, but
                 # histories and checkpoints below stay step-aligned
 
                 # objective = loss(total scores) + sum of reg terms

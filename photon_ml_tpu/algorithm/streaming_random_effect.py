@@ -181,6 +181,38 @@ def write_block_file(out_dir: str, name: str, payload: dict) -> dict:
     )
 
 
+def write_streaming_manifest_json(
+    out_dir: str,
+    metas: List[dict],
+    *,
+    num_rows: int,
+    global_dim: int,
+    vocab: List[str],
+    random_effect_id: str,
+    feature_shard_id: str,
+    ladder: Optional[str],
+) -> None:
+    """Atomically commit a block directory's ``manifest.json`` — shared by
+    the cold builder below and the delta builder
+    (:func:`photon_ml_tpu.retrain.delta.build_delta_streaming_manifest`),
+    so the two layouts cannot drift apart."""
+    manifest = dict(
+        blocks=metas,
+        num_rows=int(num_rows),
+        global_dim=int(global_dim),
+        vocab=list(vocab),
+        random_effect_id=random_effect_id,
+        feature_shard_id=feature_shard_id,
+        ladder=ladder,
+    )
+    with open(os.path.join(out_dir, "manifest.json.tmp"), "w") as f:
+        json.dump(manifest, f)
+    os.replace(
+        os.path.join(out_dir, "manifest.json.tmp"),
+        os.path.join(out_dir, "manifest.json"),
+    )
+
+
 def write_re_entity_blocks(
     data: GameData,
     config: RandomEffectDataConfig,
@@ -270,20 +302,14 @@ def write_re_entity_blocks(
         metas.append(write_block_file(out_dir, f"block-{i:05d}.npz", payload))
         del payload
 
-    manifest = dict(
-        blocks=metas,
+    write_streaming_manifest_json(
+        out_dir, metas,
         num_rows=int(n),
         global_dim=int(data.shards[config.feature_shard_id].dim),
         vocab=list(data.id_vocabs[re_id]),
         random_effect_id=re_id,
         feature_shard_id=config.feature_shard_id,
         ladder=(f"{bucketer.base}:{bucketer.growth:g}" if bucketer else None),
-    )
-    with open(os.path.join(out_dir, "manifest.json.tmp"), "w") as f:
-        json.dump(manifest, f)
-    os.replace(
-        os.path.join(out_dir, "manifest.json.tmp"),
-        os.path.join(out_dir, "manifest.json"),
     )
     return StreamingREManifest.load(out_dir)
 
@@ -346,7 +372,8 @@ class StreamingREManifest:
         return self._block_from_host(self.load_block_host(i))
 
     def iter_blocks(
-        self, prefetch_depth: Optional[int] = None, start: int = 0
+        self, prefetch_depth: Optional[int] = None, start: int = 0,
+        indices: Optional[List[int]] = None,
     ) -> "Iterator[Tuple[int, RandomEffectDataset, np.ndarray, np.ndarray]]":
         """Yield ``(i, dataset, row_sel, dense_ids)`` for every block from
         ``start`` on, with the async pipeline (io/pipeline.py): up to
@@ -358,7 +385,10 @@ class StreamingREManifest:
         identical either way, so results are bit-identical with the
         pipeline on or off. ``start`` (a preemption resume) skips finished
         blocks BEFORE the prefetcher reads them, so resume cost is
-        proportional to the remaining work, not the whole epoch."""
+        proportional to the remaining work, not the whole epoch.
+        ``indices`` (the delta-retrain skip path) streams exactly the named
+        blocks in the given order instead of ``range(start, n)`` — frozen
+        blocks are never read from disk at all."""
         from photon_ml_tpu.io.pipeline import (
             Prefetcher,
             device_pipelined,
@@ -367,13 +397,14 @@ class StreamingREManifest:
 
         depth = resolve_depth(prefetch_depth)
         n = len(self.blocks)
+        seq = list(indices) if indices is not None else list(range(start, n))
         if depth <= 0:
-            for i in range(start, n):
+            for i in seq:
                 ds, row_sel, dense_ids = self.load_block(i)
                 yield i, ds, row_sel, dense_ids
             return
         host_blocks = Prefetcher(
-            lambda: (self.load_block_host(i) for i in range(start, n)),
+            lambda: (self.load_block_host(i) for i in seq),
             depth=depth,
             name="re-block-prefetch",
         )
@@ -598,6 +629,15 @@ class StreamingRandomEffectCoordinate:
     # (and may have pinned a policy), so unset fields do NOT re-resolve
     # the environment underneath it.
     plan: Optional[object] = None
+    # delta-retrain skip set (photon_ml_tpu.retrain): block indices whose
+    # data AND entity membership are unchanged since the prior run. Their
+    # solve is SKIPPED — coefficients carry forward bitwise from the
+    # (warm-seeded) incoming state without even reading the data slab —
+    # and their score contribution is computed once and cached (frozen
+    # coefficients over frozen rows are epoch-invariant). The caller
+    # guarantees the incoming state holds the prior model's coefficients
+    # for these blocks (retrain.warm.seed_spilled_state).
+    frozen_blocks: Optional[frozenset] = None
 
     # streams per evaluation — CoordinateDescent must call update/score raw
     cd_jit = False
@@ -639,6 +679,17 @@ class StreamingRandomEffectCoordinate:
 
         self._sparse_spec = resolve_sparse_kernel(self.sparse_kernel)
         self._sparse_slabs: dict = {}
+        self.frozen_blocks = frozenset(self.frozen_blocks or ())
+        bad = [i for i in self.frozen_blocks
+               if not 0 <= i < len(self.manifest.blocks)]
+        if bad:
+            raise ValueError(
+                f"frozen_blocks {sorted(bad)} out of range for a "
+                f"{len(self.manifest.blocks)}-block manifest"
+            )
+        # frozen block -> (row_sel, host scores): epoch-invariant by the
+        # frozen contract, so one streaming pass covers the whole descent
+        self._frozen_scores: dict = {}
 
     def _update_fn(self, ds, local_resid, w0, slab=None):
         return _block_update(
@@ -728,7 +779,11 @@ class StreamingRandomEffectCoordinate:
         """Preemption ``partial`` payload: per-block progress (the finished
         blocks' coefficients are ALREADY durable in the epoch dir) plus, for
         a mid-chunk interruption, the in-flight block's scheduler snapshot
-        nested with prefixed array keys."""
+        nested with prefixed array keys. ``blocks_done`` counts ACTIVE
+        (non-frozen) blocks — with no frozen set that is exactly the block
+        index, the pre-delta semantics; the frozen set itself is not
+        persisted because the relaunched driver re-derives the identical
+        delta plan from the same durable inputs."""
         meta = {
             "kind": "streaming_re",
             "epoch": self._epoch,
@@ -800,15 +855,24 @@ class StreamingRandomEffectCoordinate:
             )
             start_block = 0
         resid_host = None
-        # finished blocks were solved and spilled before the interruption;
-        # their tracker summaries are telemetry and are not recomputed
-        summaries = [None] * start_block
+        n_blocks = len(self.manifest.blocks)
+        # frozen (delta-unchanged) blocks never solve: their coefficients
+        # carry forward bitwise from the warm-seeded incoming state — an
+        # atomic per-block copy, no slab read, no solver iterations
+        for i in sorted(self.frozen_blocks):
+            new_state.write(i, state.block(i))
+        active = [i for i in range(n_blocks) if i not in self.frozen_blocks]
+        # finished blocks were solved and spilled before the interruption
+        # (and frozen blocks never solve); tracker summaries are telemetry
+        # and are not recomputed — None placeholders, one slot per block
+        summaries: List[Optional[object]] = [None] * n_blocks
         # pipelined block loop: block k+1 reads from disk + transfers H2D
         # on the background stage while block k's vmapped solve runs —
-        # resume starts the pipeline AT the first unfinished block
-        for i, ds, row_sel, _ in self.manifest.iter_blocks(
-            self.prefetch_depth, start=start_block
-        ):
+        # resume starts the pipeline AT the first unfinished active block
+        for k, (i, ds, row_sel, _) in enumerate(self.manifest.iter_blocks(
+            self.prefetch_depth, indices=active[start_block:]
+        )):
+            done = start_block + k  # active blocks completed before this one
             if isinstance(residual_offsets, jax.Array):
                 local_resid = residual_offsets[jnp.asarray(row_sel)]
             else:
@@ -825,14 +889,16 @@ class StreamingRandomEffectCoordinate:
                 try:
                     coefs, res = self._sub_for(ds, block=i, slab=slab).update(
                         self._padded_resid(local_resid, ds), w0,
-                        resume=(inner_resume if i == start_block else None),
+                        resume=(inner_resume if k == 0 else None),
                     )
                 except _preemption.Preempted as e:
                     # mid-chunk inside block i: wrap the scheduler snapshot
                     # with this coordinate's block progress and unwind
                     raise _preemption.Preempted(
                         str(e), site=e.site,
-                        partial=self._partial_payload(new_state, i, e.partial),
+                        partial=self._partial_payload(
+                            new_state, done, e.partial
+                        ),
                     ) from e
             else:
                 coefs, res = self._update_fn(
@@ -841,27 +907,44 @@ class StreamingRandomEffectCoordinate:
             new_state.write(i, np.asarray(coefs))
             # pull the tracker to host NOW: keeping the vmapped OptResult
             # as device arrays would pin every block's buffers alive
-            summaries.append(jax.tree.map(np.asarray, res))
+            summaries[i] = jax.tree.map(np.asarray, res)
             del ds, coefs, res
-            if i + 1 < len(self.manifest.blocks) and _preemption.check(
+            if done + 1 < len(active) and _preemption.check(
                 "block", block=i, epoch=self._epoch
             ):
                 raise _preemption.Preempted(
-                    f"preempted at block boundary (block {i + 1}/"
-                    f"{len(self.manifest.blocks)}, epoch {self._epoch}): "
+                    f"preempted at block boundary ({done + 1}/"
+                    f"{len(active)} active blocks, epoch {self._epoch}): "
                     f"{_preemption.reason()}",
                     site="block",
-                    partial=self._partial_payload(new_state, i + 1),
+                    partial=self._partial_payload(new_state, done + 1),
                 )
         return new_state, tuple(summaries)
 
     def score(self, state: SpilledREState) -> Array:
         total = np.zeros(self.manifest.num_rows, real_dtype())
-        for i, ds, row_sel, _ in self.manifest.iter_blocks(self.prefetch_depth):
+        # frozen blocks: coefficients and rows are epoch-invariant, so the
+        # first pass's scores serve every later call without touching disk
+        stream = []
+        for i in range(len(self.manifest.blocks)):
+            cached = (
+                self._frozen_scores.get(i) if i in self.frozen_blocks else None
+            )
+            if cached is not None:
+                row_sel, vals = cached
+                total[row_sel] = vals
+            else:
+                stream.append(i)
+        for i, ds, row_sel, _ in self.manifest.iter_blocks(
+            self.prefetch_depth, indices=stream
+        ):
             w = jnp.asarray(state.block(i))
             # ladder-padded blocks score their pad rows too (entity_pos -1
             # -> 0); slice back to the block's real rows
-            total[row_sel] = np.asarray(self._score_fn(ds, w))[: len(row_sel)]
+            vals = np.asarray(self._score_fn(ds, w))[: len(row_sel)]
+            total[row_sel] = vals
+            if i in self.frozen_blocks:
+                self._frozen_scores[i] = (np.asarray(row_sel), vals)
             del ds, w
         return jnp.asarray(total)
 

@@ -329,6 +329,17 @@ class GameTrainingParams:
     # --tensor-cache for a fully warm restart (cached tensors + cached
     # executables)
     persistent_cache_dir: Optional[str] = None
+    # incremental delta retraining (photon_ml_tpu.retrain): the prior run's
+    # OUTPUT dir (it holds retrain.json + the saved model). The delta
+    # planner diffs the new inputs against it; unchanged coordinates/blocks
+    # skip their solves bitwise, dirty work warm-starts from the prior
+    # model, and an all-unchanged rerun short-circuits to the prior model
+    # wholesale. A missing/corrupt prior degrades to a recorded cold run.
+    warm_start_from: Optional[str] = None
+    # export the trained best model as an mmap'd serving store
+    # (serve/model_store.py) right after save — the artifact a live
+    # ScoringServer/fleet hot-swaps in (the retrain->swap loop's handoff)
+    export_serve_store: Optional[str] = None
     # canonical shape ladder (photon_ml_tpu.compile): "off" | "on" |
     # "BASE:GROWTH" — dynamic dims (entity blocks/buckets, chunk rows)
     # round up a geometric ladder with masked padding so N near-identical
@@ -463,6 +474,18 @@ class GameTrainingParams:
             errors.append("--max-restarts must be >= 0")
         if self.checkpoint_async and not self.checkpoint_dir:
             errors.append("--checkpoint-async needs --checkpoint-dir")
+        if self.warm_start_from:
+            import os as _os
+
+            if _os.path.abspath(self.warm_start_from) == _os.path.abspath(
+                self.output_dir
+            ):
+                errors.append(
+                    "--warm-start-from must point at a PRIOR run's output "
+                    "dir, not this run's --output-dir (preparing the "
+                    "output dir would destroy the prior model the warm "
+                    "start reads)"
+                )
         if errors:
             raise ValueError("; ".join(errors))
 
@@ -551,6 +574,17 @@ def build_training_parser() -> argparse.ArgumentParser:
       help="persistent XLA compilation cache dir: warm driver runs skip "
            "compilation entirely (composes with --tensor-cache for a "
            "fully warm restart)")
+    a("--warm-start-from", dest="warm_start_from", default=None,
+      help="prior run's output dir (holds retrain.json + the saved "
+           "model): delta retraining — unchanged coordinates/entity "
+           "blocks skip their solves bitwise, dirty work re-solves "
+           "warm-started from the prior model, an all-unchanged rerun "
+           "reuses the prior model wholesale; a missing/corrupt prior "
+           "degrades to a recorded cold run")
+    a("--export-serve-store", dest="export_serve_store", default=None,
+      help="after save, export the best model as an mmap'd serving store "
+           "at this dir (serve/model_store.py) — the artifact a live "
+           "scoring server hot-swaps in")
     a("--shape-canonicalization", default="off",
       help="round dynamic dims (entity blocks/buckets, chunk rows) up a "
            "geometric ladder of canonical shapes with masked padding, so "
@@ -642,6 +676,8 @@ def parse_training_params(argv: Optional[List[str]] = None) -> GameTrainingParam
         ),
         tensor_cache_dir=ns.tensor_cache_dir,
         persistent_cache_dir=ns.persistent_cache_dir,
+        warm_start_from=ns.warm_start_from,
+        export_serve_store=ns.export_serve_store,
         shape_canonicalization=ns.shape_canonicalization,
         solve_compaction=ns.solve_compaction,
         vmapped_grid=(

@@ -167,6 +167,10 @@ class Driver:
                     self.diagnose()
             self.logger.info(self.timer.summary())
             self.logger.info(compile_stats.summary())
+            if p.tensor_cache_dir:
+                from photon_ml_tpu.io.tensor_cache import cache_stats
+
+                self.logger.info(cache_stats.summary())
             if p.persistent_cache_dir and compile_stats.xla_cache_misses == 0:
                 self.logger.info(
                     "persistent cache fully warm: zero new XLA compiles"

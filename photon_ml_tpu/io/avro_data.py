@@ -195,6 +195,27 @@ def collect_feature_keys(
     return sorted(keys)
 
 
+def collect_entity_ids(
+    paths: Sequence[str], id_types: Sequence[str]
+) -> Dict[str, set]:
+    """Raw entity-id sets per id type across ``paths`` — the delta-retrain
+    planner's dirty-set probe (photon_ml_tpu.retrain): reading only the
+    CHANGED files' id columns identifies every entity whose data moved,
+    without re-ingesting the unchanged majority. Ids resolve exactly like
+    :func:`read_game_data` (record field first, then metadataMap); a row
+    missing an id type simply contributes nothing to that type's set (the
+    planner's job is classification, not validation)."""
+    out: Dict[str, set] = {t: set() for t in id_types}
+    for rec in _iter_records(paths):
+        meta = rec.get("metadataMap") or {}
+        for t in id_types:
+            if t in rec and rec[t] is not None:
+                out[t].add(str(rec[t]))
+            elif t in meta:
+                out[t].add(meta[t])
+    return out
+
+
 def read_training_examples(
     paths: Sequence[str],
     index_map: IndexMap,

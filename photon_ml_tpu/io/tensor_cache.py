@@ -44,6 +44,7 @@ import json
 import os
 import shutil
 import tempfile
+import threading
 from typing import Callable, Dict, Iterable, Optional, Tuple
 
 import numpy as np
@@ -52,7 +53,9 @@ from photon_ml_tpu.resilience import RetryError, RetryPolicy, call_with_retry, f
 
 __all__ = [
     "CACHE_FORMAT",
+    "CacheStats",
     "TensorCache",
+    "cache_stats",
     "content_key",
     "file_stat_token",
     "process_shard_scope",
@@ -60,6 +63,99 @@ __all__ = [
 
 CACHE_FORMAT = 1
 _META = "meta.json"
+
+
+class CacheStats:
+    """Process-wide tensor-cache effectiveness counters (the cache analogue
+    of ``compile_stats`` / ``solve_stats``): every :class:`TensorCache`
+    instance reports here, and the CLI drivers log :meth:`summary` next to
+    the compile/solve summaries — before this registry, whether the cache
+    actually saved work was invisible outside ad-hoc HIT log lines.
+
+    ``bytes_reused`` counts the on-disk bytes a hit handed back instead of
+    rebuilding (array entries: the served ``.npy`` payloads; directory
+    entries: the committed entry tree). ``broken`` counts entries that
+    degraded to a miss after surviving retries (swept + rebuilt)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.reset()
+
+    def reset(self) -> None:
+        with getattr(self, "_lock", threading.Lock()):
+            self.hits = 0
+            self.misses = 0
+            self.writes = 0
+            self.invalidations = 0
+            self.broken = 0
+            self.bytes_reused = 0
+            self.bytes_written = 0
+
+    def record_hit(self, nbytes: int = 0) -> None:
+        with self._lock:
+            self.hits += 1
+            self.bytes_reused += int(nbytes)
+
+    def record_miss(self) -> None:
+        with self._lock:
+            self.misses += 1
+
+    def record_broken(self) -> None:
+        with self._lock:
+            self.broken += 1
+
+    def record_write(self, nbytes: int = 0) -> None:
+        with self._lock:
+            self.writes += 1
+            self.bytes_written += int(nbytes)
+
+    def record_invalidation(self) -> None:
+        with self._lock:
+            self.invalidations += 1
+
+    def snapshot(self) -> Dict[str, int]:
+        with self._lock:
+            return {
+                "hits": self.hits,
+                "misses": self.misses,
+                "writes": self.writes,
+                "invalidations": self.invalidations,
+                "broken": self.broken,
+                "bytes_reused": self.bytes_reused,
+                "bytes_written": self.bytes_written,
+            }
+
+    def summary(self) -> str:
+        s = self.snapshot()
+        total = s["hits"] + s["misses"]
+        rate = (100.0 * s["hits"] / total) if total else 0.0
+        return (
+            f"tensor cache: {s['hits']} hits / {s['misses']} misses "
+            f"({rate:.0f}% hit rate), {s['writes']} writes, "
+            f"{s['invalidations']} invalidations, {s['broken']} broken "
+            f"entries, {s['bytes_reused']}B reused / "
+            f"{s['bytes_written']}B written"
+        )
+
+
+#: THE process-wide registry (like ``compile_stats``): every TensorCache
+#: reports here unless constructed with an explicit ``stats=``.
+cache_stats = CacheStats()
+
+
+def _tree_bytes(path: str) -> int:
+    """Total file bytes under ``path`` (best effort — telemetry only)."""
+    total = 0
+    try:
+        for root, _, files in os.walk(path):
+            for f in files:
+                try:
+                    total += os.path.getsize(os.path.join(root, f))
+                except OSError:
+                    pass
+    except OSError:
+        pass
+    return total
 
 
 def file_stat_token(paths: Iterable[str]) -> list:
@@ -131,10 +227,12 @@ class TensorCache:
     """
 
     def __init__(self, root: str, policy: Optional[RetryPolicy] = None,
-                 shard_scope: Optional[str] = None):
+                 shard_scope: Optional[str] = None,
+                 stats: Optional[CacheStats] = None):
         self.root = root
         self.policy = policy
         self.shard_scope = shard_scope
+        self.stats = stats if stats is not None else cache_stats
         os.makedirs(root, exist_ok=True)
 
     @property
@@ -164,6 +262,7 @@ class TensorCache:
         entry = self.entry_dir(key)
         meta_path = os.path.join(entry, _META)
         if not os.path.exists(meta_path):
+            self.stats.record_miss()
             return None
         try:
             def read():
@@ -177,13 +276,17 @@ class TensorCache:
                     )
                 return CacheEntry(arrays=arrays, meta=meta.get("meta", {}))
 
-            return call_with_retry(
+            hit = call_with_retry(
                 read, self._policy, describe=f"tensor-cache read {key[:12]}"
             )
+            self.stats.record_hit(sum(a.nbytes for a in hit.arrays.values()))
+            return hit
         except (RetryError, OSError, ValueError, json.JSONDecodeError):
             # a cache must never fail the run it exists to speed up: sweep
             # the broken entry (best effort) and report a miss
             shutil.rmtree(entry, ignore_errors=True)
+            self.stats.record_broken()
+            self.stats.record_miss()
             return None
 
     def put(self, key: str, arrays: Dict[str, np.ndarray], meta: Optional[Dict] = None) -> str:
@@ -210,6 +313,7 @@ class TensorCache:
         path); a read fault that survives retries degrades to a miss."""
         entry = self.entry_dir(key)
         if not os.path.exists(os.path.join(entry, _META)):
+            self.stats.record_miss()
             return None
         try:
             def probe():
@@ -218,12 +322,42 @@ class TensorCache:
                     json.load(f)
                 return entry
 
-            return call_with_retry(
+            out = call_with_retry(
                 probe, self._policy, describe=f"tensor-cache probe {key[:12]}"
             )
+            self.stats.record_hit(_tree_bytes(entry))
+            return out
         except (RetryError, OSError, json.JSONDecodeError):
             shutil.rmtree(entry, ignore_errors=True)
+            self.stats.record_broken()
+            self.stats.record_miss()
             return None
+
+    def invalidate(self, key: str) -> bool:
+        """Drop the committed entry at ``key`` (cache hygiene: the delta
+        retrain loop invalidates prior-run keys it has superseded so the
+        store stays bounded instead of accreting one dead whole-set entry
+        per day). Returns True when an entry was removed. A removal that
+        stays broken after retries is LOGGED as a no-op, never raised — a
+        failed invalidation leaves a never-again-addressed entry behind,
+        which is wasteful but harmless (content addressing means it can
+        never serve stale data)."""
+        entry = self.entry_dir(key)
+        if not os.path.exists(os.path.join(entry, _META)):
+            return False
+        try:
+            def drop():
+                faults.inject("io.cache_invalidate", key=key, entry=entry)
+                shutil.rmtree(entry)
+
+            call_with_retry(
+                drop, self._policy,
+                describe=f"tensor-cache invalidate {key[:12]}",
+            )
+            self.stats.record_invalidation()
+            return True
+        except (RetryError, OSError):
+            return False
 
     def build_dir(self, key: str, build: Callable[[str], None]) -> str:
         """Populate a fresh entry directory through ``build(tmp_dir)`` and
@@ -254,6 +388,7 @@ class TensorCache:
                     pass  # lost the commit race; the winner's entry serves
                 else:
                     raise
+            self.stats.record_write(_tree_bytes(entry))
             return entry
         finally:
             shutil.rmtree(tmp, ignore_errors=True)

@@ -32,6 +32,8 @@ FAULT_SITES: Dict[str, str] = {
     "io.index_load": "index-map / off-heap store loads (io/index_map.py, io/offheap.py)",
     "io.cache_read": "tensor-cache entry reads (io/tensor_cache.py)",
     "io.cache_write": "tensor-cache entry commits (io/tensor_cache.py)",
+    "io.cache_invalidate": "tensor-cache entry invalidation, delta-retrain cache hygiene (io/tensor_cache.py)",
+    "retrain.delta_plan": "delta-retrain prior manifest/model reads; failure degrades to a recorded cold run (retrain/manifest.py, retrain/delta.py)",
     "multihost.barrier": "cross-host sync points (parallel/multihost.py)",
     "multihost.heartbeat": "per-host heartbeat writes (parallel/multihost.py)",
     "multihost.entity_route": "streaming entity-routing exchange (parallel/shuffle.py)",

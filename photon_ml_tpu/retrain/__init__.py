@@ -1,0 +1,74 @@
+"""Incremental delta retraining (the daily retrain->swap loop).
+
+Production GLMix retrains daily on data that is mostly yesterday's data —
+the per-member/per-item random effects change only where new events
+arrived (GLMix, KDD'16), and Snap ML (arXiv:1803.06333) shows hierarchical
+reuse of cached state is the dominant lever for GLM training throughput.
+This package connects the repo's durable, content-addressed ingredients
+(tensor-cache keys, streaming entity-block files, saved models, the warm
+serve swap) into a loop that SKIPS unchanged work:
+
+  * :mod:`~photon_ml_tpu.retrain.manifest` — the ``retrain.json`` record a
+    training run leaves behind: source-file stat tokens, ingest-config
+    identity, per-coordinate cache keys and streaming-manifest locations,
+    and the saved model it produced. The next run's delta planner diffs
+    against it.
+  * :mod:`~photon_ml_tpu.retrain.delta` — the planner: classify every
+    input file (``unchanged | changed | new | removed``), every coordinate
+    (``unchanged | dirty | new``), and — inside a dirty streaming
+    random-effect coordinate — every entity block, pinning the prior
+    run's blocking so unchanged blocks are REUSED bitwise (payload arrays
+    copied, solve skipped) while only dirty/new blocks rebuild and
+    re-solve, warm-started from the prior model.
+  * :mod:`~photon_ml_tpu.retrain.warm` — warm-start coefficient builders:
+    a saved model's per-entity global-space rows gathered back into each
+    coordinate's local solve space (bitwise round trip for unchanged
+    entities).
+
+Failure discipline: a corrupted prior manifest, a vanished prior model, or
+a lost cache entry degrades to a RECORDED cold solve for the affected
+coordinate/block (``retrain.delta_plan`` / ``io.cache_read`` fault sites,
+chaos-covered) — never a wrong warm result.
+"""
+
+from photon_ml_tpu.retrain.delta import (
+    BlockDelta,
+    CoordinateDelta,
+    DeltaPlan,
+    FileDelta,
+    build_delta_streaming_manifest,
+    diff_files,
+    dirty_set_digest,
+    plan_delta,
+    probe_dirty_entities,
+)
+from photon_ml_tpu.retrain.manifest import (
+    RETRAIN_MANIFEST,
+    RetrainManifest,
+    load_prior_manifest,
+)
+from photon_ml_tpu.retrain.warm import (
+    dense_random_effect_init,
+    fixed_effect_init,
+    random_effect_entity_means,
+    seed_spilled_state,
+)
+
+__all__ = [
+    "BlockDelta",
+    "CoordinateDelta",
+    "DeltaPlan",
+    "FileDelta",
+    "RETRAIN_MANIFEST",
+    "RetrainManifest",
+    "build_delta_streaming_manifest",
+    "dense_random_effect_init",
+    "diff_files",
+    "dirty_set_digest",
+    "fixed_effect_init",
+    "load_prior_manifest",
+    "plan_delta",
+    "probe_dirty_entities",
+    "random_effect_entity_means",
+    "seed_spilled_state",
+]

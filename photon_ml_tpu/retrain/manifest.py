@@ -1,0 +1,129 @@
+"""The ``retrain.json`` record one training run leaves for the next.
+
+Every GAME training run writes this next to its saved models (atomic
+tmp+rename, like every other commit in the repo). It captures the run's
+IDENTITY in the same content-addressed vocabulary the tensor cache uses —
+source-file stat tokens (:func:`photon_ml_tpu.io.tensor_cache.
+file_stat_token`), the ingest-config inputs and digest, and per-coordinate
+cache keys / streaming-manifest locations — plus the model it produced, so
+the next run's delta planner (:mod:`photon_ml_tpu.retrain.delta`) can
+answer "what changed since yesterday?" from stat calls and one small JSON
+read, without touching the data.
+
+Reading the PRIOR run's manifest is the delta loop's single point of
+trust, so it carries the ``retrain.delta_plan`` fault site: an injected or
+real corruption surfaces as an exception the driver catches and records as
+a cold run — a broken prior must cost a cold retrain, never produce a
+wrong warm one.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+from typing import Dict, List, Optional
+
+from photon_ml_tpu.resilience import faults
+
+__all__ = [
+    "MANIFEST_FORMAT",
+    "RETRAIN_MANIFEST",
+    "CoordinateRecord",
+    "RetrainManifest",
+    "load_prior_manifest",
+]
+
+RETRAIN_MANIFEST = "retrain.json"
+MANIFEST_FORMAT = 1
+
+
+@dataclasses.dataclass
+class CoordinateRecord:
+    """One coordinate's identity in the prior run.
+
+    ``kind`` is ``"fixed" | "random" | "streaming_random" | "factored"``.
+    ``opt_config`` is the repr of the SELECTED combo's optimization config
+    (lambda, optimizer, ...): a config change means the prior coefficients
+    are a warm start, not a reusable result. ``streaming_manifest_dir``
+    points at the durable entity-block layout the delta build pins its
+    blocking to (may live inside a shared tensor-cache entry)."""
+
+    kind: str
+    opt_config: str = ""
+    cache_key: Optional[str] = None
+    streaming_manifest_dir: Optional[str] = None
+
+
+@dataclasses.dataclass
+class RetrainManifest:
+    """Everything the next run's planner needs about this run."""
+
+    output_dir: str
+    model_dir: str  # the saved best model (model_io layout)
+    task: str
+    file_stats: List[list]  # [path, size, mtime_ns] per training input
+    # config that determines the ingest OUTPUT given the input files,
+    # known BEFORE feature maps exist (sections, intercepts, id types,
+    # ladder, offheap dir): the planner's cheap pre-ingest equality check
+    ingest_inputs: Dict[str, object]
+    # digest of the FULL ingest cache config (incl. index-map digests,
+    # known only after feature maps build): gates block-level reuse — a
+    # feature-space change shifts every gather index, so reuse is off
+    ingest_digest: str
+    updating_sequence: List[str]
+    coordinates: Dict[str, CoordinateRecord]
+    # the whole-set ingest tensor-cache key (cache hygiene: the next delta
+    # run invalidates it once superseded — it can never hit again)
+    data_cache_key: Optional[str] = None
+    # validation-side identity (validation file stats + evaluator specs):
+    # gates the SHORT-CIRCUIT only — a changed validation set must re-score
+    # even when training has nothing to do (coordinate freezing still
+    # applies, so the re-score run skips every solve)
+    eval_identity: Dict[str, object] = dataclasses.field(default_factory=dict)
+    format: int = MANIFEST_FORMAT
+
+    # ------------------------------------------------------------------
+    def save(self, directory: str) -> str:
+        path = os.path.join(directory, RETRAIN_MANIFEST)
+        payload = dataclasses.asdict(self)
+        with open(path + ".tmp", "w") as f:
+            json.dump(payload, f, indent=1, sort_keys=True)
+        os.replace(path + ".tmp", path)
+        return path
+
+    @classmethod
+    def load(cls, directory: str) -> "RetrainManifest":
+        with open(os.path.join(directory, RETRAIN_MANIFEST)) as f:
+            raw = json.load(f)
+        if int(raw.get("format", -1)) != MANIFEST_FORMAT:
+            raise ValueError(
+                f"retrain manifest format {raw.get('format')!r} != "
+                f"{MANIFEST_FORMAT} — prior run predates/postdates this "
+                "planner; retrain cold"
+            )
+        coords = {
+            name: CoordinateRecord(**rec)
+            for name, rec in raw.pop("coordinates").items()
+        }
+        return cls(coordinates=coords, **raw)
+
+    def stat_by_path(self) -> Dict[str, tuple]:
+        return {p: (int(size), int(mtime)) for p, size, mtime in self.file_stats}
+
+
+def load_prior_manifest(prior_dir: str) -> RetrainManifest:
+    """The prior run's manifest from its output dir (``--warm-start-from``).
+
+    Carries the ``retrain.delta_plan`` fault site and VALIDATES the model
+    reference: a manifest whose saved model has since vanished is as
+    useless as a corrupt one. Any failure here raises — the driver catches,
+    records the cold-degrade decision, and trains cold."""
+    faults.inject("retrain.delta_plan", prior_dir=prior_dir)
+    manifest = RetrainManifest.load(prior_dir)
+    if not os.path.isdir(manifest.model_dir):
+        raise FileNotFoundError(
+            f"prior retrain manifest at {prior_dir} references model dir "
+            f"{manifest.model_dir}, which no longer exists"
+        )
+    return manifest

@@ -1,0 +1,132 @@
+"""Warm-start coefficient builders from a saved GAME model.
+
+The saved model (io/model_io, reference Avro layout) stores per-entity
+coefficient rows in the GLOBAL feature space keyed by raw entity id and
+feature NAME — the only representation stable across runs (dense vocab
+ids and local projection spaces are run-relative). These builders gather
+those rows back into each coordinate's solve space:
+
+  * fixed effect: a (D,) vector aligned to the CURRENT index map by name;
+  * in-memory random effect: an (E, D_loc) stack gathered through the new
+    dataset's per-entity ``local_to_global`` projection;
+  * streaming random effect: a seeded
+    :class:`~photon_ml_tpu.algorithm.streaming_random_effect.
+    SpilledREState` (one ``coefs-*.npy`` per block).
+
+Exactness: export writes each float32 coefficient as a double and reload
+narrows it back — an exact round trip — and the local->global scatter
+(:func:`~photon_ml_tpu.algorithm.random_effect.global_coefficients`)
+writes disjoint positions per entity, so gathering back through the same
+``local_to_global`` reproduces the prior local coefficients BITWISE for
+any entity whose projection is unchanged. That is what lets an unchanged
+block skip its solve and still export bitwise-identical rows.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from photon_ml_tpu.io import model_io
+from photon_ml_tpu.types import real_dtype
+
+__all__ = [
+    "dense_random_effect_init",
+    "fixed_effect_init",
+    "random_effect_entity_means",
+    "seed_spilled_state",
+]
+
+
+def fixed_effect_init(model_dir: str, name: str, index_map) -> Optional[np.ndarray]:
+    """The prior fixed-effect vector aligned to the CURRENT index map by
+    feature name (new features init at 0; dropped features drop), or None
+    when the prior model has no such coordinate."""
+    base = os.path.join(model_dir, model_io.FIXED_EFFECT, name)
+    if not os.path.isdir(base):
+        return None
+    means, _, _, _ = model_io.load_fixed_effect(model_dir, name, index_map)
+    return np.asarray(means, real_dtype())
+
+
+def random_effect_entity_means(
+    model_dir: str, name: str, index_map
+) -> Optional[Dict[str, np.ndarray]]:
+    """Prior per-entity global-space rows keyed by raw entity id, aligned
+    to the CURRENT index map by name; None when the coordinate is absent
+    (or is a factored model, whose latent state does not round-trip
+    through dense rows — factored coordinates retrain cold)."""
+    base = os.path.join(model_dir, model_io.RANDOM_EFFECT, name)
+    if not os.path.isdir(base):
+        return None
+    if model_io.is_factored_random_effect(model_dir, name):
+        return None
+    means, _, _, _ = model_io.load_random_effect(model_dir, name, index_map)
+    return {k: np.asarray(v, real_dtype()) for k, v in means.items()}
+
+
+def _gather_local(
+    row_global: np.ndarray, local_to_global: np.ndarray
+) -> np.ndarray:
+    """One entity's global-space row gathered into its local solve space
+    (-1 projection slots stay 0)."""
+    valid = local_to_global >= 0
+    out = np.zeros(local_to_global.shape, row_global.dtype)
+    out[valid] = row_global[local_to_global[valid]]
+    return out
+
+
+def dense_random_effect_init(
+    entity_means: Dict[str, np.ndarray],
+    *,
+    vocab: List[str],
+    pos_of_vocab: np.ndarray,
+    local_to_global: np.ndarray,
+) -> np.ndarray:
+    """(E, D_loc) warm stack for an in-memory random-effect coordinate:
+    every entity with a prior row gathers it through its own projection;
+    entities new to the model start at 0 (the cold init)."""
+    w = np.zeros(local_to_global.shape, real_dtype())
+    for vi, raw in enumerate(vocab):
+        p = int(pos_of_vocab[vi])
+        if p >= 0 and raw in entity_means:
+            w[p] = _gather_local(
+                entity_means[raw].astype(real_dtype()), local_to_global[p]
+            )
+    return w
+
+
+def seed_spilled_state(
+    manifest, entity_means: Dict[str, np.ndarray], state_dir: str
+):
+    """A :class:`SpilledREState` under ``state_dir`` seeded from the prior
+    model, one ``coefs-*.npy`` per block of ``manifest`` (metadata-only:
+    never loads a data slab). Blocks whose every entity carries a prior
+    row — the unchanged blocks — hold the prior coefficients bitwise."""
+    from photon_ml_tpu.algorithm.streaming_random_effect import (
+        SpilledREState,
+        _positions_of_dense,
+    )
+
+    shapes = [(b["num_entities"], b["local_dim"]) for b in manifest.blocks]
+    state = SpilledREState(dir=state_dir, shapes=shapes)
+    for i in range(len(manifest.blocks)):
+        meta = manifest.load_block_meta(i)
+        pos_of_dense = _positions_of_dense(meta)
+        w = np.zeros(shapes[i], real_dtype())
+        touched = False
+        for j, vi in enumerate(meta.entity_ids):
+            raw = manifest.vocab[vi]
+            p = int(pos_of_dense[j])
+            if p >= 0 and raw in entity_means:
+                w[p] = _gather_local(
+                    entity_means[raw].astype(real_dtype()),
+                    np.asarray(meta.local_to_global[p]),
+                )
+                touched = True
+        if touched:
+            state.write(i, w)
+        # untouched blocks stay unwritten: SpilledREState serves zeros
+    return state

@@ -1,0 +1,116 @@
+"""Drift gate: bench.py SECTION_ORDER, the per-section deadlines, the
+_run_sections dispatch, and test_bench_cli's pinned expected list must stay
+in sync AUTOMATICALLY. Every PR so far hand-edited all three surfaces when
+adding a section; from now on drift is a test failure, not a review catch.
+
+Pure AST — imports neither bench.py nor jax, so it runs anywhere (same
+contract as bench --list-sections)."""
+
+import ast
+import os
+
+BENCH = os.path.join(os.path.dirname(__file__), os.pardir, "bench.py")
+CLI_TEST = os.path.join(os.path.dirname(__file__), "test_bench_cli.py")
+
+
+def _bench_tree():
+    with open(BENCH) as f:
+        return ast.parse(f.read())
+
+
+def _top_level_assign(tree, name):
+    for node in tree.body:
+        if isinstance(node, ast.Assign) and any(
+            isinstance(t, ast.Name) and t.id == name for t in node.targets
+        ):
+            return node.value
+    raise AssertionError(f"bench.py no longer defines {name} at top level")
+
+
+def _section_order(tree):
+    value = _top_level_assign(tree, "SECTION_ORDER")
+    assert isinstance(value, (ast.Tuple, ast.List)), (
+        "SECTION_ORDER must stay a literal tuple (the --list-sections "
+        "no-jax contract parses it, and so does this gate)"
+    )
+    return [ast.literal_eval(e) for e in value.elts]
+
+
+def test_section_deadline_keys_are_sections():
+    tree = _bench_tree()
+    order = _section_order(tree)
+    deadlines = ast.literal_eval(_top_level_assign(tree, "SECTION_DEADLINES"))
+    stale = sorted(set(deadlines) - set(order))
+    assert not stale, (
+        f"SECTION_DEADLINES has entries for unknown sections {stale} — "
+        "deleted/renamed section left a stale deadline"
+    )
+    default = ast.literal_eval(
+        _top_level_assign(tree, "DEFAULT_SECTION_DEADLINE")
+    )
+    assert isinstance(default, int) and default > 0
+
+
+def test_dispatch_covers_every_section():
+    """Every SECTION_ORDER name must appear as a string constant inside
+    _run_sections (the elif dispatch) — a section listed but not
+    dispatchable silently no-ops."""
+    tree = _bench_tree()
+    order = _section_order(tree)
+    run_sections = next(
+        (n for n in tree.body
+         if isinstance(n, ast.FunctionDef) and n.name == "_run_sections"),
+        None,
+    )
+    assert run_sections is not None, "bench.py lost _run_sections"
+    consts = {
+        n.value for n in ast.walk(run_sections)
+        if isinstance(n, ast.Constant) and isinstance(n.value, str)
+    }
+    missing = [s for s in order if s not in consts]
+    assert not missing, (
+        f"sections {missing} are in SECTION_ORDER but never dispatched in "
+        "_run_sections"
+    )
+
+
+def test_host_only_sections_are_sections():
+    tree = _bench_tree()
+    order = _section_order(tree)
+    host_only = ast.literal_eval(_top_level_assign(tree, "HOST_ONLY_SECTIONS"))
+    stale = sorted(set(host_only) - set(order))
+    assert not stale, f"HOST_ONLY_SECTIONS names unknown sections {stale}"
+
+
+def test_cli_test_expected_list_matches_section_order():
+    """The pinned list in test_bench_cli.test_list_sections_enumerates_all_
+    sections must equal SECTION_ORDER — the historical three-surface
+    hand-edit, now enforced."""
+    order = _section_order(_bench_tree())
+    with open(CLI_TEST) as f:
+        cli_tree = ast.parse(f.read())
+    fn = next(
+        (n for n in cli_tree.body
+         if isinstance(n, ast.FunctionDef)
+         and n.name == "test_list_sections_enumerates_all_sections"),
+        None,
+    )
+    assert fn is not None, (
+        "test_bench_cli lost test_list_sections_enumerates_all_sections"
+    )
+    lists = [
+        ast.literal_eval(n)
+        for n in ast.walk(fn)
+        if isinstance(n, ast.List)
+        and all(isinstance(e, ast.Constant) for e in n.elts)
+    ]
+    expected = next((l for l in lists if len(l) > 3), None)
+    assert expected is not None, (
+        "could not find the expected-sections list literal in "
+        "test_bench_cli — keep it a plain list literal so this gate can "
+        "parse it"
+    )
+    assert expected == order, (
+        "test_bench_cli's expected section list drifted from bench.py "
+        f"SECTION_ORDER:\n  bench: {order}\n  test:  {expected}"
+    )

@@ -1,0 +1,992 @@
+"""Incremental delta retraining (photon_ml_tpu.retrain).
+
+Covers the planner (file/coordinate/block classification), the bitwise
+warm-start round trip, frozen coordinates in coordinate descent, the delta
+streaming-block build (prior blocking pinned, payload reuse, row-count
+guard), chaos degrade-to-cold for the new fault sites, the CacheStats
+registry, and the driver loop end-to-end: prior run -> all-unchanged
+short-circuit -> 90%-style delta run with frozen blocks bitwise-equal to
+the prior model -> warm-started lambda grid.
+"""
+
+import dataclasses
+import json
+import os
+import shutil
+import time
+
+import numpy as np
+import pytest
+
+from photon_ml_tpu import retrain
+from photon_ml_tpu.io import model_io
+from photon_ml_tpu.io.tensor_cache import CacheStats, TensorCache, cache_stats
+from photon_ml_tpu.resilience import faults
+from photon_ml_tpu.resilience.sites import FAULT_SITES
+from photon_ml_tpu.retrain.manifest import CoordinateRecord, RetrainManifest
+
+from game_test_utils import make_glmix_data, write_game_avro
+
+pytestmark = pytest.mark.retrain
+
+
+# ---------------------------------------------------------------------------
+# shared synthetic workload: files partitioned BY USER GROUP so a changed
+# file dirties only its own entities (the daily-delta shape)
+# ---------------------------------------------------------------------------
+
+NUM_USERS = 30
+USERS_PER_FILE = 6  # 5 files; mutating one dirties ~20% of users
+
+
+def _write_partitioned(train_dir, gd, truth, mutate_file=None, drop_rows=0):
+    """Write (or, with ``mutate_file``, rewrite ONLY that file) the
+    user-partitioned daily layout — the unmutated files keep their stats."""
+    user_of_row = gd.ids["userId"]
+    os.makedirs(train_dir, exist_ok=True)
+    file_rows = []
+    for k in range(NUM_USERS // USERS_PER_FILE):
+        rows = np.nonzero(
+            (user_of_row >= USERS_PER_FILE * k)
+            & (user_of_row < USERS_PER_FILE * (k + 1))
+        )[0]
+        if k == mutate_file and drop_rows:
+            rows = rows[:-drop_rows]
+        file_rows.append(rows)
+        if mutate_file is None or k == mutate_file:
+            write_game_avro(
+                os.path.join(train_dir, f"part-{k}.avro"), gd, rows, truth
+            )
+    return file_rows
+
+
+def _flags(train_dir, out_dir, extra=()):
+    return [
+        "--train-input-dirs", train_dir,
+        "--output-dir", out_dir,
+        "--task-type", "LOGISTIC_REGRESSION",
+        "--feature-shard-id-to-feature-section-keys-map",
+        "global:fixedFeatures|per_user:userFeatures",
+        "--updating-sequence", "fixed,per-user",
+        "--fixed-effect-data-configurations", "fixed:global,1",
+        "--random-effect-data-configurations",
+        "per-user:userId,per_user,1,-1,-1,-1,INDEX_MAP",
+        "--fixed-effect-optimization-configurations",
+        "fixed:20,1e-7,0.01,1,LBFGS,L2",
+        "--random-effect-optimization-configurations",
+        "per-user:15,1e-6,0.1,1,LBFGS,L2",
+        "--delete-output-dir-if-exists", "true",
+        "--re-memory-budget-mb", "0.001",  # blocks of 6 = one per file
+        "--num-iterations", "2",
+    ] + list(extra)
+
+
+@pytest.fixture(scope="module")
+def delta_runs(tmp_path_factory):
+    """prior cold run -> unchanged rerun (short-circuit) -> delta run with
+    one mutated file. One fixture, many asserts — driver runs are the
+    expensive part of this suite."""
+    from photon_ml_tpu.cli import game_training_driver
+
+    base = tmp_path_factory.mktemp("retrain")
+    rng = np.random.default_rng(11)
+    # uniform per-user counts: the count-sorted blocking then preserves
+    # vocab (= file cohort) order, and the 0.001MB budget cuts blocks of
+    # exactly 6 entities — one block per file, so mutating one file
+    # dirties exactly one block and freezes the other four
+    gd, truth = make_glmix_data(
+        rng, num_users=NUM_USERS, rows_per_user_range=(10, 11),
+        d_fixed=5, d_random=3,
+    )
+    train_dir = str(base / "train")
+    _write_partitioned(train_dir, gd, truth)
+    cache_dir = str(base / "tcache")
+
+    out1 = str(base / "run1")
+    d1 = game_training_driver.main(
+        _flags(train_dir, out1, ["--tensor-cache", cache_dir])
+    )
+
+    out2 = str(base / "run2")
+    d2 = game_training_driver.main(
+        _flags(train_dir, out2,
+               ["--tensor-cache", cache_dir, "--warm-start-from", out1])
+    )
+
+    # mutate the LAST file: drop 2 rows (entities stay, data moves)
+    time.sleep(0.02)  # mtime_ns must move even on coarse filesystems
+    _write_partitioned(
+        train_dir, gd, truth, mutate_file=NUM_USERS // USERS_PER_FILE - 1,
+        drop_rows=2,
+    )
+    out3 = str(base / "run3")
+    d3 = game_training_driver.main(
+        _flags(train_dir, out3,
+               ["--tensor-cache", cache_dir, "--warm-start-from", out1])
+    )
+    return dict(
+        base=base, train_dir=train_dir, gd=gd, truth=truth,
+        d1=d1, out1=out1, d2=d2, out2=out2, d3=d3, out3=out3,
+    )
+
+
+# ---------------------------------------------------------------------------
+# planner units
+# ---------------------------------------------------------------------------
+
+
+def _tiny_manifest(tmp_path, files, **over):
+    from photon_ml_tpu.io.tensor_cache import file_stat_token
+
+    model_dir = os.path.join(str(tmp_path), "model")
+    os.makedirs(model_dir, exist_ok=True)
+    kw = dict(
+        output_dir=str(tmp_path),
+        model_dir=model_dir,
+        task="LOGISTIC_REGRESSION",
+        file_stats=file_stat_token(files),
+        ingest_inputs={"sections": {}, "id_types": ["userId"]},
+        ingest_digest="d0",
+        updating_sequence=["fixed", "per-user"],
+        coordinates={
+            "fixed": CoordinateRecord(kind="fixed", opt_config="cfgA"),
+            "per-user": CoordinateRecord(kind="random", opt_config="cfgB"),
+        },
+    )
+    kw.update(over)
+    return RetrainManifest(**kw)
+
+
+def _touch(path, content=b"x"):
+    with open(path, "wb") as f:
+        f.write(content)
+
+
+class TestDiffFiles:
+    def test_classification(self, tmp_path):
+        a, b, c = (str(tmp_path / n) for n in ("a", "b", "c"))
+        for p in (a, b, c):
+            _touch(p)
+        m = _tiny_manifest(tmp_path, [a, b, c])
+        time.sleep(0.02)
+        _touch(b, b"different content entirely")
+        d = str(tmp_path / "d")
+        _touch(d)
+        fd = retrain.diff_files(m.stat_by_path(), [a, b, d])
+        assert fd.unchanged == (os.path.abspath(a),)
+        assert fd.changed == (os.path.abspath(b),)
+        assert fd.new == (os.path.abspath(d),)
+        assert fd.removed == (os.path.abspath(c),)
+        assert not fd.clean
+
+    def test_clean(self, tmp_path):
+        a = str(tmp_path / "a")
+        _touch(a)
+        m = _tiny_manifest(tmp_path, [a])
+        fd = retrain.diff_files(m.stat_by_path(), [a])
+        assert fd.clean
+
+
+class TestPlanDelta:
+    def _plan(self, tmp_path, files, combo=None, **over):
+        m = _tiny_manifest(tmp_path, files, **over)
+        return m, retrain.plan_delta(
+            m, files,
+            task=over.get("task", "LOGISTIC_REGRESSION"),
+            updating_sequence=["fixed", "per-user"],
+            ingest_inputs=m.ingest_inputs,
+            combo_configs=(
+                {"fixed": "cfgA", "per-user": "cfgB"} if combo is None else combo
+            ),
+        )
+
+    def test_all_unchanged_short_circuits(self, tmp_path):
+        a = str(tmp_path / "a")
+        _touch(a)
+        _, plan = self._plan(tmp_path, [a])
+        assert plan.short_circuit
+        assert plan.frozen_coordinates() == {"fixed", "per-user"}
+
+    def test_changed_file_dirties_everything(self, tmp_path):
+        a = str(tmp_path / "a")
+        _touch(a)
+        m = _tiny_manifest(tmp_path, [a])
+        time.sleep(0.02)
+        _touch(a, b"new day new bytes")
+        plan = retrain.plan_delta(
+            m, [a], task="LOGISTIC_REGRESSION",
+            updating_sequence=["fixed", "per-user"],
+            ingest_inputs=m.ingest_inputs,
+            combo_configs={"fixed": "cfgA", "per-user": "cfgB"},
+        )
+        assert not plan.short_circuit
+        assert {c.status for c in plan.coordinates.values()} == {"dirty"}
+
+    def test_config_change_blocks_freezing(self, tmp_path):
+        a = str(tmp_path / "a")
+        _touch(a)
+        _, plan = self._plan(
+            tmp_path, [a], combo={"fixed": "cfgA", "per-user": "DIFFERENT"}
+        )
+        assert not plan.short_circuit
+        assert plan.coordinates["fixed"].status == "unchanged"
+        assert plan.coordinates["per-user"].status == "dirty"
+
+    def test_new_coordinate_mixes_frozen_and_cold(self, tmp_path):
+        a = str(tmp_path / "a")
+        _touch(a)
+        m = _tiny_manifest(tmp_path, [a])
+        plan = retrain.plan_delta(
+            m, [a], task="LOGISTIC_REGRESSION",
+            updating_sequence=["fixed", "per-user", "per-item"],
+            ingest_inputs=m.ingest_inputs,
+            combo_configs={"fixed": "cfgA", "per-user": "cfgB",
+                           "per-item": "cfgC"},
+        )
+        assert not plan.short_circuit  # sequence grew
+        assert plan.coordinates["per-item"].status == "new"
+        assert plan.coordinates["fixed"].status == "unchanged"
+
+    def test_changed_validation_side_blocks_short_circuit(self, tmp_path):
+        """Training identical but the validation inputs/evaluators moved:
+        no wholesale short-circuit (the run must re-score) — yet every
+        coordinate stays frozen, so it still solves nothing."""
+        a = str(tmp_path / "a")
+        _touch(a)
+        m = _tiny_manifest(
+            tmp_path, [a], eval_identity={"validate_files": [["v", 1, 2]]}
+        )
+        plan = retrain.plan_delta(
+            m, [a], task="LOGISTIC_REGRESSION",
+            updating_sequence=["fixed", "per-user"],
+            ingest_inputs=m.ingest_inputs,
+            combo_configs={"fixed": "cfgA", "per-user": "cfgB"},
+            eval_identity={"validate_files": [["v2", 9, 9]]},
+        )
+        assert not plan.short_circuit
+        assert plan.frozen_coordinates() == {"fixed", "per-user"}
+        assert any("validation" in d.reason for d in plan.decisions)
+
+    def test_multi_combo_grid_disables_freezing(self, tmp_path):
+        a = str(tmp_path / "a")
+        _touch(a)
+        m = _tiny_manifest(tmp_path, [a])
+        plan = retrain.plan_delta(
+            m, [a], task="LOGISTIC_REGRESSION",
+            updating_sequence=["fixed", "per-user"],
+            ingest_inputs=m.ingest_inputs,
+            combo_configs=None,  # multi-combo grid
+        )
+        assert not plan.short_circuit
+        assert {c.status for c in plan.coordinates.values()} == {"dirty"}
+
+
+class TestManifestRoundTrip:
+    def test_save_load(self, tmp_path):
+        a = str(tmp_path / "a")
+        _touch(a)
+        m = _tiny_manifest(tmp_path, [a], data_cache_key="k123")
+        m.save(str(tmp_path))
+        loaded = RetrainManifest.load(str(tmp_path))
+        assert loaded.coordinates["fixed"].opt_config == "cfgA"
+        assert loaded.data_cache_key == "k123"
+        assert loaded.stat_by_path() == m.stat_by_path()
+
+    def test_format_mismatch_raises(self, tmp_path):
+        a = str(tmp_path / "a")
+        _touch(a)
+        m = _tiny_manifest(tmp_path, [a])
+        path = m.save(str(tmp_path))
+        with open(path) as f:
+            raw = json.load(f)
+        raw["format"] = 999
+        with open(path, "w") as f:
+            json.dump(raw, f)
+        with pytest.raises(ValueError, match="format"):
+            RetrainManifest.load(str(tmp_path))
+
+    def test_vanished_model_dir_rejected(self, tmp_path):
+        a = str(tmp_path / "a")
+        _touch(a)
+        m = _tiny_manifest(tmp_path, [a])
+        m.save(str(tmp_path))
+        shutil.rmtree(m.model_dir)
+        with pytest.raises(FileNotFoundError):
+            retrain.load_prior_manifest(str(tmp_path))
+
+
+# ---------------------------------------------------------------------------
+# fault sites + chaos degrade
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.faults
+class TestFaultSites:
+    def test_sites_registered(self):
+        assert "retrain.delta_plan" in FAULT_SITES
+        assert "io.cache_invalidate" in FAULT_SITES
+
+    def test_delta_plan_fault_raises_into_caller(self, tmp_path):
+        a = str(tmp_path / "a")
+        _touch(a)
+        m = _tiny_manifest(tmp_path, [a])
+        m.save(str(tmp_path))
+        plan = faults.parse_fault_env("retrain.delta_plan:rate=1.0,seed=1")
+        with faults.fault_scope(plan):
+            with pytest.raises(faults.InjectedIOError):
+                retrain.load_prior_manifest(str(tmp_path))
+        # without the fault the same manifest loads fine
+        assert retrain.load_prior_manifest(str(tmp_path)).task
+
+    def test_malformed_but_parseable_manifest_degrades_to_cold(self, tmp_path):
+        """Valid JSON, right format, garbage file_stats entries: the
+        classification step itself must degrade, not crash the run."""
+        from photon_ml_tpu.cli.game_params import parse_training_params
+        from photon_ml_tpu.cli.game_training_driver import GameTrainingDriver
+
+        train_dir = str(tmp_path / "train")
+        os.makedirs(train_dir)
+        a = os.path.join(train_dir, "part-0.avro")
+        _touch(a)
+        m = _tiny_manifest(tmp_path, [a])
+        path = m.save(str(tmp_path))
+        with open(path) as f:
+            raw = json.load(f)
+        raw["file_stats"] = [[a, 123]]  # missing mtime — malformed token
+        with open(path, "w") as f:
+            json.dump(raw, f)
+        params = parse_training_params(_flags(
+            train_dir, str(tmp_path / "out"),
+            ["--warm-start-from", str(tmp_path)],
+        ))
+        driver = GameTrainingDriver(params)
+        driver._maybe_plan_delta([a])
+        assert driver.delta_plan is None and driver.retrain_prior is None
+
+    def test_corrupt_manifest_degrades_driver_to_cold(self, tmp_path):
+        """The driver records a cold run when the prior manifest is
+        garbage — the delta plan stays None, nothing raises."""
+        from photon_ml_tpu.cli.game_params import parse_training_params
+        from photon_ml_tpu.cli.game_training_driver import GameTrainingDriver
+
+        train_dir = str(tmp_path / "train")
+        os.makedirs(train_dir)
+        a = os.path.join(train_dir, "part-0.avro")
+        _touch(a)
+        prior_dir = str(tmp_path / "prior")
+        os.makedirs(prior_dir)
+        with open(os.path.join(prior_dir, "retrain.json"), "w") as f:
+            f.write("{this is not json")
+        params = parse_training_params(_flags(train_dir, str(tmp_path / "out"),
+                                              ["--warm-start-from", prior_dir]))
+        driver = GameTrainingDriver(params)
+        driver._maybe_plan_delta([a])
+        assert driver.retrain_prior is None
+        assert driver.delta_plan is None
+
+    def test_injected_fault_degrades_driver_to_cold(self, tmp_path):
+        from photon_ml_tpu.cli.game_params import parse_training_params
+        from photon_ml_tpu.cli.game_training_driver import GameTrainingDriver
+
+        train_dir = str(tmp_path / "train")
+        os.makedirs(train_dir)
+        a = os.path.join(train_dir, "part-0.avro")
+        _touch(a)
+        m = _tiny_manifest(tmp_path, [a])
+        m.save(str(tmp_path))
+        params = parse_training_params(_flags(
+            train_dir, str(tmp_path / "out"),
+            ["--warm-start-from", str(tmp_path)],
+        ))
+        driver = GameTrainingDriver(params)
+        plan = faults.parse_fault_env("retrain.delta_plan:rate=1.0,seed=1")
+        with faults.fault_scope(plan):
+            driver._maybe_plan_delta([a])
+        assert driver.delta_plan is None  # recorded cold, never wrong-warm
+
+    def test_cache_invalidate_fault_degrades_to_noop(self, tmp_path):
+        stats = CacheStats()
+        cache = TensorCache(str(tmp_path / "c"), stats=stats)
+        key = cache.key_for([], {"k": 1})
+        cache.put(key, {"a": np.arange(4)})
+        plan = faults.parse_fault_env("io.cache_invalidate:rate=1.0,seed=1")
+        with faults.fault_scope(plan):
+            assert cache.invalidate(key) is False  # logged no-op, no raise
+        assert cache.has(key)  # entry intact — harmless, never stale-served
+        assert stats.invalidations == 0
+        assert cache.invalidate(key) is True
+        assert not cache.has(key)
+        assert stats.invalidations == 1
+
+
+# ---------------------------------------------------------------------------
+# CacheStats registry
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.pipeline
+class TestCacheStats:
+    def test_counters(self, tmp_path):
+        stats = CacheStats()
+        cache = TensorCache(str(tmp_path / "c"), stats=stats)
+        key = cache.key_for([], {"k": 1})
+        assert cache.get(key) is None
+        assert stats.misses == 1
+        cache.put(key, {"a": np.arange(8, dtype=np.float32)})
+        assert stats.writes == 1 and stats.bytes_written > 0
+        hit = cache.get(key)
+        assert hit is not None
+        assert stats.hits == 1 and stats.bytes_reused >= 32
+        s = stats.summary()
+        assert "1 hits" in s and "1 misses" in s
+
+    def test_broken_entry_counts(self, tmp_path):
+        stats = CacheStats()
+        cache = TensorCache(str(tmp_path / "c"), stats=stats)
+        key = cache.key_for([], {"k": 2})
+        cache.put(key, {"a": np.arange(4)})
+        # rot the payload: meta promises an array the entry no longer has
+        os.remove(os.path.join(cache.entry_dir(key), "a.npy"))
+        assert cache.get(key) is None
+        assert stats.broken == 1
+
+    def test_process_registry_is_default(self, tmp_path):
+        before = cache_stats.snapshot()["misses"]
+        cache = TensorCache(str(tmp_path / "c"))
+        assert cache.get(cache.key_for([], {"k": 3})) is None
+        assert cache_stats.snapshot()["misses"] == before + 1
+
+
+# ---------------------------------------------------------------------------
+# warm-start round trip + frozen CD coordinates
+# ---------------------------------------------------------------------------
+
+
+class TestWarmRoundTrip:
+    def test_dense_re_round_trip_bitwise(self, tmp_path, rng):
+        """export -> reload -> gather reproduces the local coefficients
+        bitwise (the property that makes frozen blocks exact)."""
+        from photon_ml_tpu.algorithm.random_effect import global_coefficients
+        from photon_ml_tpu.data.game import (
+            RandomEffectDataConfig,
+            build_random_effect_dataset,
+        )
+        from photon_ml_tpu.io.index_map import IndexMap, feature_key
+        from photon_ml_tpu.types import TaskType
+
+        gd, truth = make_glmix_data(rng, num_users=8,
+                                    rows_per_user_range=(5, 9), d_random=3)
+        cfg = RandomEffectDataConfig(
+            random_effect_id="userId", feature_shard_id="per_user",
+        )
+        ds = build_random_effect_dataset(gd, cfg)
+        w_local = rng.normal(size=np.asarray(ds.local_to_global).shape).astype(
+            np.float32
+        )
+        wg = np.asarray(global_coefficients(ds, w_local))
+        imap = IndexMap.build([feature_key(f"u{j}", "") for j in range(3)],
+                              add_intercept=False)
+        vocab = gd.id_vocabs["userId"]
+        entity_pos = np.asarray(ds.entity_pos)
+        ids = gd.ids["userId"]
+        pos_of_vocab = np.full(len(vocab), -1, np.int32)
+        known = entity_pos >= 0
+        pos_of_vocab[ids[known]] = entity_pos[known]
+        means = {}
+        for vi, raw in enumerate(vocab):
+            if pos_of_vocab[vi] >= 0:
+                means[raw] = wg[pos_of_vocab[vi]]
+        model_io.save_random_effect(
+            str(tmp_path), "per-user", TaskType.LOGISTIC_REGRESSION,
+            means, imap, random_effect_id="userId",
+            feature_shard_id="per_user",
+        )
+        reloaded = retrain.random_effect_entity_means(
+            str(tmp_path), "per-user", imap
+        )
+        w_back = retrain.dense_random_effect_init(
+            reloaded, vocab=vocab, pos_of_vocab=pos_of_vocab,
+            local_to_global=np.asarray(ds.local_to_global),
+        )
+        ltg = np.asarray(ds.local_to_global)
+        valid = ltg >= 0
+        assert np.array_equal(w_back[valid], w_local[valid])
+
+    def test_factored_prior_returns_none(self, tmp_path):
+        from photon_ml_tpu.io.index_map import IndexMap, feature_key
+
+        model_io.save_factored_random_effect(
+            str(tmp_path), "per-user",
+            {"u0": np.array([0.5, 0.5])}, np.ones((2, 3), np.float32),
+            random_effect_id="userId", feature_shard_id="per_user",
+        )
+        imap = IndexMap.build([feature_key("u0", "")], add_intercept=False)
+        assert retrain.random_effect_entity_means(
+            str(tmp_path), "per-user", imap
+        ) is None
+
+
+class TestFrozenCoordinates:
+    def _cd(self, gd, truth):
+        import jax.numpy as jnp
+
+        from photon_ml_tpu.algorithm.coordinate_descent import CoordinateDescent
+        from photon_ml_tpu.algorithm.fixed_effect import FixedEffectCoordinate
+        from photon_ml_tpu.algorithm.random_effect import RandomEffectCoordinate
+        from photon_ml_tpu.data.game import (
+            RandomEffectDataConfig,
+            build_fixed_effect_batch,
+            build_random_effect_dataset,
+        )
+        from photon_ml_tpu.ops import losses as losses_mod
+        from photon_ml_tpu.optim.problem import GLMOptimizationProblem
+        from photon_ml_tpu.types import TaskType
+
+        task = TaskType.LOGISTIC_REGRESSION
+        coords = {
+            "fixed": FixedEffectCoordinate(
+                build_fixed_effect_batch(gd, "global", dense=True),
+                GLMOptimizationProblem(task=task),
+            ),
+            "per-user": RandomEffectCoordinate(
+                build_random_effect_dataset(
+                    gd, RandomEffectDataConfig(
+                        random_effect_id="userId",
+                        feature_shard_id="per_user",
+                    )
+                ),
+                task,
+            ),
+        }
+        loss = losses_mod.for_task(task)
+        labels = jnp.asarray(gd.response)
+        weights = jnp.asarray(gd.weight)
+
+        def loss_fn(total):
+            return jnp.sum(weights * loss.loss(total, labels))
+
+        return coords, CoordinateDescent(coords, loss_fn)
+
+    def test_frozen_coordinate_carries_params_bitwise(self, rng):
+        gd, truth = make_glmix_data(rng, num_users=6,
+                                    rows_per_user_range=(5, 9))
+        _, cd1 = self._cd(gd, truth)
+        r1 = cd1.run(2, gd.num_rows)
+        _, cd2 = self._cd(gd, truth)
+        init = {k: np.asarray(v) for k, v in r1.coefficients.items()}
+        import jax.numpy as jnp
+
+        r2 = cd2.run(
+            2, gd.num_rows,
+            initial_params={k: jnp.asarray(v) for k, v in init.items()},
+            frozen={"per-user"},
+        )
+        assert np.array_equal(
+            np.asarray(r2.coefficients["per-user"]), init["per-user"]
+        )
+        # the unfrozen coordinate genuinely trained
+        assert len(r2.objective_history) == 4
+
+    def test_run_grid_accepts_partial_init_params(self, rng):
+        """A coordinate missing from init_params (new since the prior
+        model) starts cold in run_grid, exactly like run() — no KeyError."""
+        import jax.numpy as jnp
+
+        gd, truth = make_glmix_data(rng, num_users=4,
+                                    rows_per_user_range=(5, 8))
+        _, cd1 = self._cd(gd, truth)
+        r1 = cd1.run(1, gd.num_rows)
+        _, cd2 = self._cd(gd, truth)
+        results = cd2.run_grid(
+            {"fixed": jnp.asarray([0.0, 0.5]),
+             "per-user": jnp.asarray([0.1, 1.0])},
+            1, gd.num_rows,
+            init_params={"fixed": jnp.asarray(r1.coefficients["fixed"])},
+        )
+        assert len(results) == 2
+        for r in results:
+            assert np.isfinite(r.objective_history[-1])
+
+    def test_frozen_requires_initial_params(self, rng):
+        gd, truth = make_glmix_data(rng, num_users=4,
+                                    rows_per_user_range=(5, 8))
+        _, cd = self._cd(gd, truth)
+        with pytest.raises(ValueError, match="initial_params"):
+            cd.run(1, gd.num_rows, frozen={"per-user"})
+
+    def test_frozen_unknown_name_raises(self, rng):
+        gd, truth = make_glmix_data(rng, num_users=4,
+                                    rows_per_user_range=(5, 8))
+        _, cd = self._cd(gd, truth)
+        with pytest.raises(ValueError, match="not in the updating"):
+            cd.run(1, gd.num_rows, initial_params={}, frozen={"nope"})
+
+
+# ---------------------------------------------------------------------------
+# delta streaming-block build (unit level: no driver)
+# ---------------------------------------------------------------------------
+
+
+def _subset_game_data(gd, keep):
+    """GameData restricted to the kept row indices (CSR resliced)."""
+    from photon_ml_tpu.data.game import GameData, HostFeatures
+
+    keep = np.asarray(keep)
+    shards = {}
+    for s, f in gd.shards.items():
+        counts = np.diff(f.indptr)[keep]
+        parts_i, parts_v = [], []
+        for r in keep:
+            parts_i.append(f.indices[f.indptr[r]:f.indptr[r + 1]])
+            parts_v.append(f.values[f.indptr[r]:f.indptr[r + 1]])
+        shards[s] = HostFeatures(
+            np.concatenate([[0], np.cumsum(counts)]).astype(np.int64),
+            (np.concatenate(parts_i) if parts_i else np.zeros(0)).astype(np.int32),
+            (np.concatenate(parts_v) if parts_v else np.zeros(0)).astype(np.float32),
+            f.dim,
+        )
+    return GameData(
+        response=gd.response[keep], offset=gd.offset[keep],
+        weight=gd.weight[keep],
+        ids={k: v[keep] for k, v in gd.ids.items()},
+        id_vocabs=dict(gd.id_vocabs), shards=shards,
+    )
+
+
+class TestDeltaBlockBuild:
+    @pytest.fixture()
+    def prior_blocks(self, tmp_path, rng):
+        from photon_ml_tpu.algorithm.streaming_random_effect import (
+            write_re_entity_blocks,
+        )
+        from photon_ml_tpu.data.game import RandomEffectDataConfig
+
+        gd, truth = make_glmix_data(
+            rng, num_users=20, rows_per_user_range=(6, 10), d_random=3
+        )
+        cfg = RandomEffectDataConfig(
+            random_effect_id="userId", feature_shard_id="per_user",
+        )
+        manifest = write_re_entity_blocks(
+            gd, cfg, str(tmp_path / "prior-blocks"), block_entities=5
+        )
+        return gd, cfg, manifest
+
+    def test_unchanged_blocks_reuse_payload_bitwise(self, tmp_path, prior_blocks):
+        gd, cfg, prior = prior_blocks
+        vocab = gd.id_vocabs["userId"]
+        dirty_raw = {vocab[3]}  # one dirty entity
+        manifest, deltas = retrain.build_delta_streaming_manifest(
+            gd, cfg, str(tmp_path / "new-blocks"), prior, dirty_raw,
+            block_entities=5,
+        )
+        statuses = {d.status for d in deltas}
+        assert "unchanged" in statuses
+        assert len(deltas) == len(prior.blocks)
+        for d in deltas:
+            if d.status != "unchanged":
+                continue
+            old = np.load(os.path.join(
+                prior.dir, prior.blocks[d.prior_index]["file"]))
+            new = np.load(os.path.join(
+                manifest.dir, manifest.blocks[d.index]["file"]))
+            for field in ("x", "labels", "weights", "entity_pos",
+                          "local_to_global", "row_sel", "entity_ids"):
+                assert np.array_equal(old[field], new[field]), field
+
+    def test_dirty_entities_dirty_their_block(self, tmp_path, prior_blocks):
+        gd, cfg, prior = prior_blocks
+        vocab = gd.id_vocabs["userId"]
+        dirty_raw = {vocab[3]}
+        _, deltas = retrain.build_delta_streaming_manifest(
+            gd, cfg, str(tmp_path / "nb"), prior, dirty_raw, block_entities=5,
+        )
+        # the block holding entity 3 must be dirty with the recorded reason
+        dirty = [d for d in deltas if d.status == "dirty"]
+        assert dirty and any("dirty entities" in d.reason for d in dirty)
+
+    def test_row_count_guard_demotes_to_dirty(self, tmp_path, prior_blocks):
+        """An entity that silently LOST rows (not in any changed file's new
+        content) must not reuse the stale payload."""
+        gd, cfg, prior = prior_blocks
+        ids = gd.ids["userId"]
+        victim = int(ids[0])
+        drop = np.nonzero(ids == victim)[0][:1]
+        keep = np.setdiff1d(np.arange(gd.num_rows), drop)
+        gd2 = _subset_game_data(gd, keep)
+        _, deltas = retrain.build_delta_streaming_manifest(
+            gd2, cfg, str(tmp_path / "nb"), prior, set(), block_entities=5,
+        )
+        demoted = [d for d in deltas if "row count moved" in d.reason]
+        assert len(demoted) == 1 and demoted[0].status == "dirty"
+
+    def test_lost_prior_block_file_degrades_to_rebuild(self, tmp_path, prior_blocks):
+        gd, cfg, prior = prior_blocks
+        os.remove(os.path.join(prior.dir, prior.blocks[0]["file"]))
+        manifest, deltas = retrain.build_delta_streaming_manifest(
+            gd, cfg, str(tmp_path / "nb"), prior, set(), block_entities=5,
+        )
+        assert any("unreadable" in d.reason for d in deltas)
+        # every block still written and loadable — never a missing block
+        assert len(manifest.blocks) == len(prior.blocks)
+        for i in range(len(manifest.blocks)):
+            manifest.load_block(i)
+
+    def test_new_entities_append_as_new_blocks(self, tmp_path, rng, prior_blocks):
+        gd, cfg, prior = prior_blocks
+        # prior manifest built over users 0..14 only: rebuild a prior with
+        # a SUBSET vocab by slicing rows of users < 15
+        from photon_ml_tpu.algorithm.streaming_random_effect import (
+            write_re_entity_blocks,
+        )
+
+        ids = gd.ids["userId"]
+        sub = _subset_game_data(gd, np.nonzero(ids < 15)[0])
+        # re-densify the subset's vocab (15 users)
+        sub.id_vocabs["userId"] = gd.id_vocabs["userId"][:15]
+        prior_sub = write_re_entity_blocks(
+            sub, cfg, str(tmp_path / "prior-sub"), block_entities=5
+        )
+        _, deltas = retrain.build_delta_streaming_manifest(
+            gd, cfg, str(tmp_path / "nb"), prior_sub, set(), block_entities=5,
+        )
+        assert any(d.status == "new" for d in deltas)
+
+    def test_pinned_block_outgrowing_budget_reblocks(self, tmp_path):
+        """Daily growth steady state: a pinned block whose rows grew past
+        the memory budget must re-block fresh (recorded), not fail a
+        retrain a cold run of the same config would survive."""
+        from photon_ml_tpu.algorithm.streaming_random_effect import (
+            write_re_entity_blocks,
+        )
+        from photon_ml_tpu.data.game import (
+            GameData,
+            RandomEffectDataConfig,
+        )
+        from game_test_utils import dense_to_csr
+
+        rng = np.random.default_rng(5)
+
+        def mk(rows_per_user):
+            n = int(np.sum(rows_per_user))
+            user_of_row = np.repeat(
+                np.arange(len(rows_per_user), dtype=np.int32), rows_per_user
+            )
+            return GameData(
+                response=(rng.random(n) > 0.5).astype(np.float32),
+                offset=np.zeros(n, np.float32),
+                weight=np.ones(n, np.float32),
+                ids={"userId": user_of_row},
+                id_vocabs={"userId": [f"u{i}" for i in range(len(rows_per_user))]},
+                shards={
+                    "global": dense_to_csr(
+                        rng.normal(size=(n, 4)).astype(np.float32)),
+                    "per_user": dense_to_csr(
+                        rng.normal(size=(n, 3)).astype(np.float32)),
+                },
+            )
+
+        cfg = RandomEffectDataConfig(
+            random_effect_id="userId", feature_shard_id="per_user",
+        )
+        prior = write_re_entity_blocks(
+            mk(np.full(12, 6)), cfg, str(tmp_path / "p"),
+            memory_budget_bytes=600,
+        )
+        grown = np.full(12, 6)
+        grown[0] = 30  # user 0's data grew 5x since yesterday
+        gd2 = mk(grown)
+        # every entity dirty: this test is about the budget demotion, not
+        # payload reuse (the synthetic day-2 rows are all different)
+        manifest, deltas = retrain.build_delta_streaming_manifest(
+            gd2, cfg, str(tmp_path / "nb"), prior,
+            set(gd2.id_vocabs["userId"]), memory_budget_bytes=600,
+        )
+        assert any("outgrew the budget" in d.reason for d in deltas)
+        # every block written respects the budget and loads
+        for i in range(len(manifest.blocks)):
+            assert manifest.blocks[i]["x_bytes"] <= 600
+            manifest.load_block(i)
+
+    def test_cache_hit_recovers_classifications(self, tmp_path, prior_blocks):
+        gd, cfg, prior = prior_blocks
+        cache = TensorCache(str(tmp_path / "cache"), stats=CacheStats())
+        key = "k" * 64
+        m1, d1 = retrain.build_delta_streaming_manifest(
+            gd, cfg, str(tmp_path / "nb"), prior, set(), block_entities=5,
+            tensor_cache=cache, cache_key=key,
+        )
+        m2, d2 = retrain.build_delta_streaming_manifest(
+            gd, cfg, str(tmp_path / "nb2"), prior, set(), block_entities=5,
+            tensor_cache=cache, cache_key=key,
+        )
+        assert m2.dir == m1.dir  # served from the cache entry
+        assert [(d.index, d.status) for d in d2] == [
+            (d.index, d.status) for d in d1
+        ]
+
+
+# ---------------------------------------------------------------------------
+# driver end-to-end: the retrain loop
+# ---------------------------------------------------------------------------
+
+
+class TestDriverDeltaLoop:
+    def test_prior_run_writes_manifest(self, delta_runs):
+        out1 = delta_runs["out1"]
+        m = RetrainManifest.load(out1)
+        assert m.coordinates["per-user"].kind == "streaming_random"
+        assert os.path.isdir(m.coordinates["per-user"].streaming_manifest_dir)
+        assert m.data_cache_key
+
+    def test_unchanged_rerun_short_circuits_bitwise(self, delta_runs):
+        d2, out1, out2 = (delta_runs[k] for k in ("d2", "out1", "out2"))
+        assert d2.delta_plan is not None and d2.delta_plan.short_circuit
+        assert d2.results == []  # no training happened
+        # the re-exported model is byte-identical to the prior
+        for root, _, files in os.walk(os.path.join(out1, "best")):
+            rel = os.path.relpath(root, os.path.join(out1, "best"))
+            for f in files:
+                a = os.path.join(root, f)
+                b = os.path.join(out2, "best", rel, f)
+                with open(a, "rb") as fa, open(b, "rb") as fb:
+                    assert fa.read() == fb.read(), (rel, f)
+
+    def test_delta_run_freezes_unchanged_blocks(self, delta_runs):
+        d3 = delta_runs["d3"]
+        deltas = d3.block_deltas["per-user"]
+        frozen = d3._frozen_blocks["per-user"]
+        assert frozen  # some blocks genuinely skipped their solves
+        assert {d.status for d in deltas} >= {"unchanged", "dirty"}
+        assert frozen == {d.index for d in deltas if d.status == "unchanged"}
+
+    def test_frozen_block_entities_bitwise_equal_prior(self, delta_runs):
+        d1, d3 = delta_runs["d1"], delta_runs["d3"]
+        out1, out3 = delta_runs["out1"], delta_runs["out3"]
+        imap = d3.shard_index_maps["per_user"]
+        means1, _, _, _ = model_io.load_random_effect(
+            os.path.join(out1, "best"), "per-user", imap)
+        means3, _, _, _ = model_io.load_random_effect(
+            os.path.join(out3, "best"), "per-user", imap)
+        m3 = d3.streaming_manifests["per-user"]
+        frozen_raws = set()
+        for i in d3._frozen_blocks["per-user"]:
+            bm = m3.load_block_meta(i)
+            frozen_raws.update(m3.vocab[v] for v in bm.entity_ids)
+        assert frozen_raws
+        for raw in frozen_raws:
+            assert np.array_equal(means1[raw], means3[raw]), raw
+
+    def test_dirty_blocks_actually_resolve(self, delta_runs):
+        """Dirty entities see new data — their coefficients must move."""
+        d3 = delta_runs["d3"]
+        out1, out3 = delta_runs["out1"], delta_runs["out3"]
+        imap = d3.shard_index_maps["per_user"]
+        means1, _, _, _ = model_io.load_random_effect(
+            os.path.join(out1, "best"), "per-user", imap)
+        means3, _, _, _ = model_io.load_random_effect(
+            os.path.join(out3, "best"), "per-user", imap)
+        dirty = d3.delta_plan.dirty_entities["userId"]
+        assert dirty
+        moved = [r for r in dirty if not np.array_equal(means1[r], means3[r])]
+        assert moved  # warm-started, but genuinely re-solved on new data
+
+    def test_superseded_ingest_entry_invalidated(self, delta_runs):
+        d1, d3 = delta_runs["d1"], delta_runs["d3"]
+        cache = d3._tensor_cache()
+        assert not cache.has(d1._data_cache_key)  # superseded + invalidated
+        assert cache.has(d3._data_cache_key)
+
+    def test_delta_manifest_chains(self, delta_runs):
+        """run3's manifest supports a FOURTH run warm-starting from it."""
+        m = RetrainManifest.load(delta_runs["out3"])
+        assert os.path.isdir(m.coordinates["per-user"].streaming_manifest_dir)
+        loaded = retrain.load_prior_manifest(delta_runs["out3"])
+        assert loaded.model_dir.endswith("best")
+
+
+class TestUnchangedStreamingReuse:
+    def test_unchanged_coordinate_reuses_prior_layout_verbatim(self, delta_runs):
+        """Sibling config change (fixed lambda moved, files clean): the
+        streaming coordinate is unchanged — its prior block layout must be
+        opened verbatim (no rebuild) and its coefficients stay bitwise."""
+        from photon_ml_tpu.cli import game_training_driver
+
+        out3 = delta_runs["out3"]
+        d3 = delta_runs["d3"]
+        train_dir = delta_runs["train_dir"]
+        out4 = str(delta_runs["base"] / "run4")
+        flags = _flags(train_dir, out4, ["--warm-start-from", out3])
+        flags[flags.index("fixed:20,1e-7,0.01,1,LBFGS,L2")] = (
+            "fixed:20,1e-7,0.5,1,LBFGS,L2"  # only the FIXED lambda moves
+        )
+        d4 = game_training_driver.main(flags)
+        prior_rec = RetrainManifest.load(out3).coordinates["per-user"]
+        assert d4.delta_plan.coordinates["per-user"].status == "unchanged"
+        assert os.path.samefile(
+            d4.streaming_manifests["per-user"].dir,
+            prior_rec.streaming_manifest_dir,
+        )
+        imap = d4.shard_index_maps["per_user"]
+        means3, _, _, _ = model_io.load_random_effect(
+            os.path.join(out3, "best"), "per-user", imap)
+        means4, _, _, _ = model_io.load_random_effect(
+            os.path.join(out4, "best"), "per-user", imap)
+        for raw, row in means3.items():
+            assert np.array_equal(row, means4[raw]), raw
+        # the fixed coordinate genuinely re-solved at the new lambda
+        f3, _, _, _ = model_io.load_fixed_effect(
+            os.path.join(out3, "best"), "fixed",
+            d4.shard_index_maps["global"])
+        f4, _, _, _ = model_io.load_fixed_effect(
+            os.path.join(out4, "best"), "fixed",
+            d4.shard_index_maps["global"])
+        assert not np.array_equal(f3, f4)
+
+
+class TestWarmGrid:
+    def test_grid_lanes_warm_start_from_prior(self, tmp_path, rng):
+        """Lambda-grid delta run: every lane seeds from the prior selected
+        model through run_grid(init_params=) — the PR-2 hook generalized."""
+        from photon_ml_tpu.cli import game_training_driver
+
+        gd, truth = make_glmix_data(
+            rng, num_users=8, rows_per_user_range=(8, 12), d_fixed=4,
+            d_random=3,
+        )
+        train_dir = str(tmp_path / "train")
+        os.makedirs(train_dir)
+        write_game_avro(os.path.join(train_dir, "part-0.avro"), gd,
+                        range(gd.num_rows), truth)
+        common = [
+            "--task-type", "LOGISTIC_REGRESSION",
+            "--feature-shard-id-to-feature-section-keys-map",
+            "global:fixedFeatures|per_user:userFeatures",
+            "--updating-sequence", "fixed,per-user",
+            "--fixed-effect-data-configurations", "fixed:global,1",
+            "--random-effect-data-configurations",
+            "per-user:userId,per_user,1,-1,-1,-1,INDEX_MAP",
+            "--fixed-effect-optimization-configurations",
+            "fixed:25,1e-7,0.01,1,LBFGS,L2",
+            "--delete-output-dir-if-exists", "true",
+            "--num-iterations", "2",
+        ]
+        out1 = str(tmp_path / "run1")
+        game_training_driver.main(
+            ["--train-input-dirs", train_dir, "--output-dir", out1,
+             "--random-effect-optimization-configurations",
+             "per-user:25,1e-6,0.1,1,LBFGS,L2"] + common
+        )
+        out2 = str(tmp_path / "run2")
+        d2 = game_training_driver.main(
+            ["--train-input-dirs", train_dir, "--output-dir", out2,
+             "--warm-start-from", out1,
+             "--vmapped-grid", "true",
+             "--random-effect-optimization-configurations",
+             "per-user:25,1e-6,0.1,1,LBFGS,L2;"
+             "per-user:25,1e-6,1.0,1,LBFGS,L2"] + common
+        )
+        assert len(d2.results) == 2  # both lambda lanes trained
+        assert d2._warm_init() is not None  # lanes seeded from the prior
+        for _, result, _ in d2.results:
+            assert np.isfinite(result.objective_history[-1])
