@@ -2733,11 +2733,248 @@ def _bench_retrain_delta(extra, on_tpu):
         shutil.rmtree(tmp, ignore_errors=True)
 
 
+def _bench_quantized_serving(extra, on_tpu):
+    """Quantized serving slabs (serve/quantize.py): the repo's first
+    measured accuracy/speed dial. Races f32 vs bf16 vs int8 stores of ONE
+    model on store slab bytes, export+open time, warm QPS, p50/p99, and
+    the realized max per-score quantization error vs the PINNED budget
+    recorded in store meta. Gates: int8 slab bytes <= ~30% and bf16 <=
+    ~55% of f32; every quantized score inside its budget; the f32 default
+    still BITWISE-equal to the batch scoring driver; an int8 -> int8 warm
+    swap under live traffic compiles nothing and drops nothing."""
+    import concurrent.futures
+    import shutil
+    import tempfile
+
+    from game_test_utils import (
+        game_avro_records,
+        make_glmix_data,
+        save_synthetic_game_model,
+        serve_requests_from_records,
+        serving_score_budget,
+        write_game_avro,
+    )
+
+    from photon_ml_tpu.cli import game_scoring_driver
+    from photon_ml_tpu.compile import ShapeBucketer, compile_stats
+    from photon_ml_tpu.serve import (
+        ModelStore,
+        ModelSwapper,
+        ScoringServer,
+        ServeStats,
+        build_model_store,
+    )
+
+    tmp = tempfile.mkdtemp(prefix="bench-quantized-serving-")
+    try:
+        rng = np.random.default_rng(29)
+        # wide-enough slabs that the byte ratios are payload, not headers.
+        # d_random = 31 puts the dense-request nnz (31 features +
+        # intercept = 32) EXACTLY on a ladder rung, so the server's padded
+        # reduction width equals the batch driver's and the f32 bitwise
+        # gate is exact (off-rung widths split the f32 partial sums
+        # differently — ulp noise, which the bitwise gate would refuse)
+        num_users = 4096 if on_tpu else 2048
+        d_fixed, d_random = 8, 31
+        data, truth = make_glmix_data(
+            rng, num_users=num_users, rows_per_user_range=(1, 3),
+            d_fixed=d_fixed, d_random=d_random,
+        )
+        offsets = rng.normal(size=data.num_rows).astype(np.float32)
+        model_dir = os.path.join(tmp, "model")
+        save_synthetic_game_model(
+            model_dir, rng, d_fixed=d_fixed, d_random=d_random,
+            num_users=num_users,
+        )
+        in_dir = os.path.join(tmp, "in")
+        os.makedirs(in_dir)
+        write_game_avro(
+            os.path.join(in_dir, "part-0.avro"), data,
+            range(data.num_rows), truth, offsets,
+        )
+        records = list(
+            game_avro_records(data, range(data.num_rows), truth, offsets)
+        )
+        reqs = serve_requests_from_records(records)[:512]
+        sections = {"global": ["fixedFeatures"], "per_user": ["userFeatures"]}
+
+        def re_slab_bytes(store_dir):
+            base = os.path.join(store_dir, "random", "per-user")
+            total = os.path.getsize(os.path.join(base, "slab.npy"))
+            scales = os.path.join(base, "scales.npy")
+            if os.path.exists(scales):
+                total += os.path.getsize(scales)
+            return total
+
+        def fire(server, requests, workers=32):
+            with concurrent.futures.ThreadPoolExecutor(workers) as pool:
+                futs = list(
+                    pool.map(lambda q: server.submit_rows([q]), requests)
+                )
+            return np.concatenate([f.result() for f in futs])
+
+        arms = {}
+        stores = {}
+        served = {}
+        for dt in ("f32", "bf16", "int8"):
+            store_dir = os.path.join(tmp, f"store-{dt}")
+            t0 = time.perf_counter()
+            meta = build_model_store(
+                model_dir, store_dir, bucketer=ShapeBucketer(),
+                store_dtype=dt,
+            )
+            t_export = time.perf_counter() - t0
+            t0 = time.perf_counter()
+            store = ModelStore(store_dir)
+            t_open = time.perf_counter() - t0
+            stores[dt] = (store_dir, meta)
+            server = ScoringServer(
+                store, shard_sections=sections,
+                max_batch_rows=32, max_wait_ms=2.0, stats=ServeStats(),
+            )
+            server.warmup(warm_nnz=32)
+            fire(server, reqs)  # warm pass
+            server.stats.reset()
+            out = fire(server, reqs)  # measured pass
+            served[dt] = out
+            snap = server.stats.snapshot()
+            arms[dt] = {
+                "slab_bytes": re_slab_bytes(store_dir),
+                "export_ms": round(t_export * 1e3, 1),
+                "open_ms": round(t_open * 1e3, 2),
+                "qps": snap["qps"],
+                "p50_ms": snap["p50_ms"],
+                "p99_ms": snap["p99_ms"],
+            }
+            server.close()
+            _log(
+                f"quantized_serving[{dt}]: {arms[dt]['slab_bytes']} slab "
+                f"bytes, open {arms[dt]['open_ms']}ms, "
+                f"{snap['qps']} req/s, p50 {snap['p50_ms']}ms / "
+                f"p99 {snap['p99_ms']}ms"
+            )
+
+        # --- accuracy gates -------------------------------------------------
+        # f32: BITWISE vs the batch scoring driver (the untouched oracle)
+        drv = game_scoring_driver.main([
+            "--input-dirs", in_dir,
+            "--game-model-input-dir", model_dir,
+            "--output-dir", os.path.join(tmp, "score-out"),
+            "--offheap-indexmap-dir",
+            os.path.join(stores["f32"][0], "features"),
+            "--feature-shard-id-to-feature-section-keys-map",
+            "global:fixedFeatures|per_user:userFeatures",
+            "--delete-output-dir-if-exists", "true",
+        ])
+        f32_bitwise = bool(
+            np.array_equal(served["f32"], drv.scores[: len(reqs)])
+        )
+        if not f32_bitwise:
+            raise AssertionError(
+                "f32 store is no longer bitwise-equal to the batch "
+                "scoring driver — the default path regressed"
+            )
+        # quantized: realized per-score error inside the pinned budget —
+        # through the SAME policy helpers the serve/fleet tests assert
+        # with (tolerances.py owns the slack; no hand-rolled bound here)
+        from tolerances import assert_within_budget, quant_score_budget
+
+        for dt in ("bf16", "int8"):
+            budget = quant_score_budget(
+                1.0,
+                serving_score_budget(stores[dt][1], reqs, sections),
+                ref_scores=served["f32"],
+            )
+            err = np.abs(
+                served[dt].astype(np.float64) - served["f32"]
+            )
+            arms[dt]["max_score_err"] = float(err.max())
+            arms[dt]["max_score_budget"] = float(budget.max())
+            arms[dt]["coeff_err_budget"] = stores[dt][1]["random"][0][
+                "quantization"
+            ]["coeff_err_budget"]
+            assert_within_budget(
+                served[dt], served["f32"], budget,
+                err_msg=f"{dt} serving vs the f32 server",
+            )
+            _log(
+                f"quantized_serving[{dt}]: max per-score err "
+                f"{err.max():.3e} within budget (max budget "
+                f"{budget.max():.3e})"
+            )
+
+        # --- byte-ratio gates ----------------------------------------------
+        f32_bytes = arms["f32"]["slab_bytes"]
+        bf16_ratio = arms["bf16"]["slab_bytes"] / f32_bytes
+        int8_ratio = arms["int8"]["slab_bytes"] / f32_bytes
+        if bf16_ratio > 0.55 or int8_ratio > 0.30:
+            raise AssertionError(
+                f"store byte ratios missed the dial: bf16 {bf16_ratio:.3f} "
+                f"(<= 0.55), int8 {int8_ratio:.3f} (<= 0.30)"
+            )
+        _log(
+            f"quantized_serving: slab bytes f32 {f32_bytes} / "
+            f"bf16 {bf16_ratio:.1%} / int8 {int8_ratio:.1%}"
+        )
+
+        # --- warm-swap arm: int8 -> int8 under live traffic ----------------
+        model2 = os.path.join(tmp, "model2")
+        save_synthetic_game_model(
+            model2, np.random.default_rng(31), d_fixed=d_fixed,
+            d_random=d_random, num_users=num_users,
+        )
+        store2 = os.path.join(tmp, "store2-int8")
+        build_model_store(
+            model2, store2, bucketer=ShapeBucketer(), store_dtype="int8"
+        )
+        server = ScoringServer(
+            ModelStore(stores["int8"][0]), shard_sections=sections,
+            max_batch_rows=32, max_wait_ms=2.0, stats=ServeStats(),
+        )
+        server.warmup(warm_nnz=32)
+        swapper = ModelSwapper(server)
+        wm = compile_stats.watermark()
+        with concurrent.futures.ThreadPoolExecutor(16) as pool:
+            futs = [pool.submit(server.score_rows, [q]) for q in reqs[:256]]
+            report = swapper.swap(store2)
+            results = [f.result() for f in futs]
+        dropped = sum(1 for r in results if r is None or len(r) != 1)
+        server.close()
+        _log(
+            f"quantized_serving swap[int8->int8]: "
+            f"{report['new_compiles']} new compiles "
+            f"({wm.new_traces()} traces in window), {dropped} dropped"
+        )
+        if report["new_compiles"] != 0 or dropped != 0:
+            raise AssertionError(
+                f"quantized warm swap must be compile-free and lossless "
+                f"(compiles={report['new_compiles']}, dropped={dropped})"
+            )
+
+        extra["quantized_serving_arms"] = arms
+        extra["quantized_serving_bytes_ratio"] = {
+            "bf16_vs_f32": round(bf16_ratio, 4),
+            "int8_vs_f32": round(int8_ratio, 4),
+        }
+        extra["quantized_serving_f32_bitwise_equal_to_driver"] = f32_bitwise
+        extra["quantized_serving_swap_new_compiles"] = int(
+            report["new_compiles"]
+        )
+        extra["quantized_serving_swap_dropped_requests"] = int(dropped)
+        extra["quantized_serving_config"] = {
+            "entities": num_users, "d_fixed": d_fixed,
+            "d_random": d_random, "requests": len(reqs),
+        }
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
 SECTION_ORDER = (
     "dense", "sparse", "sparse_race", "game", "game5", "grid",
     "streaming", "streaming_pipeline", "compile_reuse", "compaction",
     "preemption_resume",
     "perhost", "perhost_streaming", "scoring", "serving", "serving_fleet",
+    "quantized_serving",
     "retrain_delta",
     "ingest",
 )
@@ -2757,7 +2994,10 @@ SECTION_DEADLINES = {"dense": 3600, "game": 3600, "game5": 2400, "grid": 2400,
                      "serving_fleet": 3600,
                      # 5 full GAME training runs (day-1 prior, day-2
                      # cold + delta, warm rerun, day-3 under traffic)
-                     "retrain_delta": 3600}
+                     "retrain_delta": 3600,
+                     # 3 store exports + 3 warmed servers + a batch-driver
+                     # oracle run + the int8 swap arm
+                     "quantized_serving": 1800}
 DEFAULT_SECTION_DEADLINE = 1800
 
 
@@ -2890,6 +3130,8 @@ def _run_sections(names, extra, errors, on_tpu, state=None, after=None):
                 _bench_serving(extra, on_tpu)
             elif name == "serving_fleet":
                 _bench_serving_fleet(extra, on_tpu)
+            elif name == "quantized_serving":
+                _bench_quantized_serving(extra, on_tpu)
             elif name == "retrain_delta":
                 _bench_retrain_delta(extra, on_tpu)
             elif name == "ingest":

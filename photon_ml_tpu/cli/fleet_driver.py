@@ -77,6 +77,7 @@ class GameFleetDriver:
             num_replicas=p.num_fleet_replicas,
             num_buckets=p.num_buckets,
             bucketer=resolve_bucketer(p.shape_canonicalization),
+            store_dtype=p.store_dtype,
         )
         for rep in self.fleet_meta["replicas"]:
             self.logger.info(
@@ -103,7 +104,31 @@ class GameFleetDriver:
                     f"persistent XLA cache: {p.persistent_cache_dir}"
                 )
         compile_stats.install_xla_listeners()
+        from photon_ml_tpu.serve.fleet import load_fleet_meta
+
+        # fleet.json BEFORE the store open: load_fleet_meta raises on a
+        # mixed-dtype fleet, and an already-open store would leak its
+        # mmaps on that raise
+        fleet_dtype = load_fleet_meta(p.fleet_dir).get("store_dtype") or "f32"
         store = ModelStore(replica_store_dir(p.fleet_dir, p.replica_id))
+        if store.store_dtype != fleet_dtype:
+            # the replica-side half of the mixed-dtype refusal, for the
+            # stores load_fleet_meta could not read from the router's
+            # host (its meta path recorded remote/unreadable): this store
+            # was (re-)exported out of band at a different dtype than the
+            # fleet plan it would serve under
+            store.close()
+            raise RuntimeError(
+                f"replica {p.replica_id}'s store is {store.store_dtype} "
+                f"but fleet.json pins store_dtype {fleet_dtype}; refusing "
+                "to serve a mixed-dtype fleet — re-export the whole fleet"
+            )
+        fp = store.footprint()
+        self.logger.info(
+            f"replica store footprint: dtype {fp['store_dtype']}, "
+            f"{fp['slab_bytes_disk']} slab bytes on disk, "
+            f"{fp['mapped_bytes']} bytes mapped"
+        )
         self.engine = ReplicaEngine(
             store,
             replica_id=p.replica_id,
@@ -161,7 +186,8 @@ class GameFleetDriver:
         self.logger.info(
             f"fleet router up: {self.router.num_replicas} replicas, "
             f"generation {self.router.generation}, live "
-            f"{sorted(self.router.live_replicas())}"
+            f"{sorted(self.router.live_replicas())}, store dtype "
+            f"{self.fleet_meta.get('store_dtype') or 'f32'}"
         )
         try:
             self.handled = serve_json_lines(

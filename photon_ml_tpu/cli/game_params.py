@@ -340,6 +340,10 @@ class GameTrainingParams:
     # (serve/model_store.py) right after save — the artifact a live
     # ScoringServer/fleet hot-swaps in (the retrain->swap loop's handoff)
     export_serve_store: Optional[str] = None
+    # slab storage policy for --export-serve-store (serve/quantize.py):
+    # f32 (bitwise default) | bf16 | int8 (per-row absmax scales); the
+    # quantized dtypes carry a pinned export-verified error budget
+    store_dtype: str = "f32"
     # canonical shape ladder (photon_ml_tpu.compile): "off" | "on" |
     # "BASE:GROWTH" — dynamic dims (entity blocks/buckets, chunk rows)
     # round up a geometric ladder with masked padding so N near-identical
@@ -474,6 +478,12 @@ class GameTrainingParams:
             errors.append("--max-restarts must be >= 0")
         if self.checkpoint_async and not self.checkpoint_dir:
             errors.append("--checkpoint-async needs --checkpoint-dir")
+        try:
+            from photon_ml_tpu.serve.quantize import validate_store_dtype
+
+            validate_store_dtype(self.store_dtype)
+        except ValueError as e:
+            errors.append(f"--store-dtype: {e}")
         if self.warm_start_from:
             import os as _os
 
@@ -498,6 +508,14 @@ class GameTrainingParams:
             merged.update(re)
             combos.append(merged)
         return combos
+
+
+def _store_dtype_choices() -> List[str]:
+    """The ONE source of truth for the --store-dtype argparse choices —
+    lazy like the validate() imports so parser construction stays cheap."""
+    from photon_ml_tpu.serve.quantize import STORE_DTYPES
+
+    return list(STORE_DTYPES)
 
 
 def build_training_parser() -> argparse.ArgumentParser:
@@ -585,6 +603,11 @@ def build_training_parser() -> argparse.ArgumentParser:
       help="after save, export the best model as an mmap'd serving store "
            "at this dir (serve/model_store.py) — the artifact a live "
            "scoring server hot-swaps in")
+    a("--store-dtype", default="f32", choices=_store_dtype_choices(),
+      help="slab storage policy for --export-serve-store: f32 keeps the "
+           "bitwise-to-the-driver contract; bf16/int8 (per-row absmax "
+           "scales) halve/quarter the slab bytes under a pinned, "
+           "export-verified quantization-error budget")
     a("--shape-canonicalization", default="off",
       help="round dynamic dims (entity blocks/buckets, chunk rows) up a "
            "geometric ladder of canonical shapes with masked padding, so "
@@ -678,6 +701,7 @@ def parse_training_params(argv: Optional[List[str]] = None) -> GameTrainingParam
         persistent_cache_dir=ns.persistent_cache_dir,
         warm_start_from=ns.warm_start_from,
         export_serve_store=ns.export_serve_store,
+        store_dtype=ns.store_dtype,
         shape_canonicalization=ns.shape_canonicalization,
         solve_compaction=ns.solve_compaction,
         vmapped_grid=(
@@ -807,12 +831,22 @@ class GameServeParams:
     # export the model store from --game-model-input-dir then exit
     build_store_only: bool = False
     num_store_partitions: int = 1
+    # slab storage policy when THIS driver exports the store (f32 | bf16 |
+    # int8); an already-built store serves at whatever dtype it was
+    # exported with (logged at startup next to the footprint gauges)
+    store_dtype: str = "f32"
     log_path: Optional[str] = None
 
     def validate(self) -> None:
         errors = []
         if not self.model_store_dir:
             errors.append("--model-store-dir is required")
+        try:
+            from photon_ml_tpu.serve.quantize import validate_store_dtype
+
+            validate_store_dtype(self.store_dtype)
+        except ValueError as e:
+            errors.append(f"--store-dtype: {e}")
         if self.build_store_only and not self.game_model_input_dir:
             errors.append("--build-store-only needs --game-model-input-dir")
         if self.max_batch_rows < 1:
@@ -882,6 +916,10 @@ def build_serve_parser() -> argparse.ArgumentParser:
       help="export --game-model-input-dir into --model-store-dir, then exit")
     a("--num-store-partitions", type=int, default=1,
       help="pmix partitions for the store's feature/entity lookups")
+    a("--store-dtype", default="f32", choices=_store_dtype_choices(),
+      help="slab storage policy when exporting the store here: f32 "
+           "(bitwise default) | bf16 | int8 with per-row absmax scales, "
+           "under a pinned export-verified quantization-error budget")
     a("--log-path", default=None, help="log file (default: stderr only)")
     return p
 
@@ -898,6 +936,9 @@ class GameFleetParams:
     game_model_input_dir: Optional[str] = None
     num_fleet_replicas: int = 2
     num_buckets: int = 64
+    # build mode: slab storage policy for EVERY replica store (recorded in
+    # fleet.json; a mixed-dtype fleet is refused at load)
+    store_dtype: str = "f32"
     # replica mode: serve this replica's shard store over TCP
     replica_id: Optional[int] = None
     port: int = 0
@@ -960,6 +1001,12 @@ class GameFleetParams:
         if self.heartbeat_deadline_s <= 0:
             errors.append("--heartbeat-deadline-s must be > 0")
         try:
+            from photon_ml_tpu.serve.quantize import validate_store_dtype
+
+            validate_store_dtype(self.store_dtype)
+        except ValueError as e:
+            errors.append(f"--store-dtype: {e}")
+        try:
             from photon_ml_tpu.compile import resolve_bucketer
 
             resolve_bucketer(self.shape_canonicalization)
@@ -988,6 +1035,10 @@ def build_fleet_parser() -> argparse.ArgumentParser:
     a("--num-buckets", type=int, default=64,
       help="consistent-hash bucket count (granularity of the balanced "
            "blocking; must be >= the replica count)")
+    a("--store-dtype", default="f32", choices=_store_dtype_choices(),
+      help="build mode: slab storage policy for every replica store "
+           "(one dial per fleet, recorded in fleet.json; mixed-dtype "
+           "fleets are refused at load)")
     a("--replica-id", type=int, default=None,
       help="run THIS replica (serves its shard store over TCP until a "
            "shutdown message)")
@@ -1028,6 +1079,7 @@ def parse_fleet_params(argv: Optional[List[str]] = None) -> GameFleetParams:
         game_model_input_dir=ns.game_model_input_dir,
         num_fleet_replicas=ns.num_fleet_replicas,
         num_buckets=ns.num_buckets,
+        store_dtype=ns.store_dtype,
         replica_id=ns.replica_id,
         port=ns.port,
         host=ns.host,
@@ -1067,6 +1119,7 @@ def parse_serve_params(argv: Optional[List[str]] = None) -> GameServeParams:
         assert_warm=_truthy(ns.assert_warm),
         build_store_only=_truthy(ns.build_store_only),
         num_store_partitions=ns.num_store_partitions,
+        store_dtype=ns.store_dtype,
         log_path=ns.log_path,
     )
     params.validate()

@@ -1824,11 +1824,12 @@ class GameTrainingDriver:
             build_model_store(
                 best_dir, p.export_serve_store,
                 bucketer=self.bucketer or ShapeBucketer(),
+                store_dtype=p.store_dtype,
             )
         self.logger.info(
-            f"serving store exported: {p.export_serve_store} (swap it "
-            "into a live server via serve.swap.ModelSwapper / the fleet "
-            "generation barrier)"
+            f"serving store exported: {p.export_serve_store} "
+            f"(dtype {p.store_dtype}; swap it into a live server via "
+            "serve.swap.ModelSwapper / the fleet generation barrier)"
         )
 
 

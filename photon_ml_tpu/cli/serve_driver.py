@@ -66,9 +66,16 @@ class GameServeDriver:
                 p.model_store_dir,
                 num_partitions=p.num_store_partitions,
                 bucketer=resolve_bucketer(p.shape_canonicalization),
+                store_dtype=p.store_dtype,
             )
         store = ModelStore(p.model_store_dir)
         self.logger.info(store.describe())
+        fp = store.footprint()
+        self.logger.info(
+            f"store footprint: dtype {fp['store_dtype']}, "
+            f"{fp['slab_bytes_disk']} slab bytes on disk, "
+            f"{fp['mapped_bytes']} bytes mapped"
+        )
         return store
 
     def start(self):

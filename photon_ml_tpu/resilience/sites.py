@@ -41,6 +41,7 @@ FAULT_SITES: Dict[str, str] = {
     "io.perhost_block_write": "per-host streaming entity-block writes (parallel/perhost_streaming.py)",
     "optim.step": "coordinate-descent updates, NaN corruption (algorithm/coordinate_descent.py)",
     "preempt.signal": "preemption polls; flags instead of raising (resilience/preemption.py)",
+    "serve.dequant": "quantized-store open gate: scale-sidecar/budget validation before a bf16/int8 slab may serve (serve/model_store.py)",
     "serve.route": "fleet router request-routing entry (serve/fleet/router.py)",
     "serve.replica_scatter": "per sub-request dispatch to a slab-owner replica (serve/fleet/router.py)",
     "serve.fleet_swap_barrier": "fleet-wide swap generation barrier, between prepare-all and commit (serve/fleet/swap.py)",

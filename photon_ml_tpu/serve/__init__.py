@@ -33,6 +33,7 @@ from photon_ml_tpu.serve.model_store import (
     build_model_store,
     is_model_store,
 )
+from photon_ml_tpu.serve.quantize import STORE_DTYPES
 from photon_ml_tpu.serve.server import ScoringServer, serve_json_lines
 from photon_ml_tpu.serve.stats import FleetStats, ServeStats, serve_stats
 from photon_ml_tpu.serve.swap import ModelSwapper
@@ -43,6 +44,7 @@ __all__ = [
     "ModelStore",
     "ModelSwapper",
     "RowBatch",
+    "STORE_DTYPES",
     "ScoringServer",
     "ServeStats",
     "build_model_store",

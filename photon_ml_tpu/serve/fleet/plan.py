@@ -154,6 +154,7 @@ def build_fleet_stores(
     bucketer=None,
     num_partitions: int = 1,
     force_python: bool = False,
+    store_dtype: str = "f32",
 ) -> dict:
     """Export one saved GAME model into ``num_replicas`` sharded serving
     stores plus a ``fleet.json`` plan.
@@ -163,6 +164,12 @@ def build_fleet_stores(
     fixed contribution) and only the random-effect slab rows of the
     entities the plan assigns to r. The union of the replica slabs is
     exactly the single-store export, partitioned disjointly.
+
+    ``store_dtype`` applies to EVERY replica store (the one dial for the
+    whole fleet, recorded in ``fleet.json``): a mixed-dtype fleet would
+    give requests different error characteristics depending on which
+    replica owns their entity, so :func:`load_fleet_meta` refuses one
+    loudly.
     """
     from photon_ml_tpu.io import avro as avro_io
     from photon_ml_tpu.io import model_io
@@ -190,6 +197,10 @@ def build_fleet_stores(
 
     os.makedirs(fleet_dir, exist_ok=True)
     replica_meta: List[dict] = []
+    # fleet-wide pinned quantization budget per coordinate: the MAX of the
+    # replica slabs' realized/budget errors (a request's entity lives on
+    # exactly one replica, so the worst replica bounds every score)
+    fleet_quant: Dict[str, dict] = {}
     for r in range(num_replicas):
         meta = build_model_store(
             model_dir,
@@ -198,7 +209,16 @@ def build_fleet_stores(
             bucketer=bucketer,
             force_python=force_python,
             entity_filter=owned_ids[r].__contains__,
+            store_dtype=store_dtype,
         )
+        for e in meta["random"]:
+            q = e.get("quantization") or {}
+            agg = fleet_quant.setdefault(
+                e["name"],
+                {"realized_max_abs_coeff_err": 0.0, "coeff_err_budget": 0.0},
+            )
+            for k in agg:
+                agg[k] = max(agg[k], float(q.get(k) or 0.0))
         replica_meta.append(
             {
                 "replica": r,
@@ -212,11 +232,17 @@ def build_fleet_stores(
         "format": FLEET_FORMAT,
         "version": FLEET_VERSION,
         "source_model_dir": os.path.abspath(model_dir),
+        "store_dtype": store_dtype,
         "task": meta["task"],
         "plan": plan.to_json(),
         "fixed": meta["fixed"],
         "random": [
-            {"name": e["name"], "re_id": e["re_id"], "shard": e["shard"]}
+            {
+                "name": e["name"],
+                "re_id": e["re_id"],
+                "shard": e["shard"],
+                "quantization": fleet_quant[e["name"]],
+            }
             for e in meta["random"]
         ],
         "replicas": replica_meta,
@@ -237,8 +263,30 @@ def is_fleet_dir(path: str) -> bool:
 
 
 def load_fleet_meta(fleet_dir: str) -> dict:
+    """Read + validate ``fleet.json``. A mixed-dtype fleet (replica store
+    metas disagreeing with the fleet's ``store_dtype``) is refused HERE,
+    loudly — per-request error characteristics must not depend on which
+    replica owns the entity. Replica stores whose meta is unreadable from
+    this host are skipped (the replica process re-validates its own store
+    against this value at startup)."""
     with open(os.path.join(fleet_dir, FLEET_META_FILE)) as f:
         meta = json.load(f)
     if meta.get("format") != FLEET_FORMAT:
         raise IOError(f"{fleet_dir} is not a {FLEET_FORMAT} directory")
+    fleet_dtype = meta.get("store_dtype") or "f32"
+    mixed = []
+    for rep in meta.get("replicas") or []:
+        try:
+            with open(os.path.join(rep["store_dir"], "meta.json")) as rf:
+                rep_dtype = json.load(rf).get("store_dtype") or "f32"
+        except (OSError, ValueError, KeyError):
+            continue  # remote/missing replica store: its process validates
+        if rep_dtype != fleet_dtype:
+            mixed.append(f"replica {rep.get('replica')}: {rep_dtype}")
+    if mixed:
+        raise IOError(
+            f"{fleet_dir} is a MIXED-DTYPE fleet (fleet.json says "
+            f"{fleet_dtype!r} but {'; '.join(mixed)}); refusing to route — "
+            "re-export the whole fleet at one store_dtype"
+        )
     return meta

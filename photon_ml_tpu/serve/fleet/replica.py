@@ -170,10 +170,11 @@ class ReplicaEngine(ScoringServer):
                 out[FIXED_PREFIX + name] = np.asarray(jax.device_get(c))[:n_real]
         if want_random:
             wanted = set(want_random)
-            for name, _re_id, shard, slab in bundle.random:
+            for name, _re_id, shard, slab, scales in bundle.random:
                 if name in wanted:
-                    c = self._re_kernel(
+                    c = self._re_contrib(
                         slab,
+                        scales,
                         jnp.asarray(batch.ent_row[name]),
                         idx_dev[shard],
                         val_dev[shard],
@@ -246,6 +247,9 @@ class ReplicaEngine(ScoringServer):
             old_epoch = self._epoch
             self._epoch = epoch
             self._epoch_bundles[epoch] = bundle
+        # gauges flip with the install (prepare must NOT record them —
+        # an aborted swap's staged store never serves)
+        self.stats.record_store_footprint(**bundle.store.footprint())
         self._retire(old_epoch, old)
         return {"epoch": epoch}
 

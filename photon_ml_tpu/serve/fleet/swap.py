@@ -71,13 +71,25 @@ class FleetSwapper:
         :class:`FleetSwapError` (old generation intact fleet-wide) on an
         incompatible plan, a prepare failure, or a barrier failure.
         """
-        meta = load_fleet_meta(fleet_dir)
+        meta = load_fleet_meta(fleet_dir)  # refuses a mixed-dtype fleet
         new_plan = ServeShardPlan.from_json(meta["plan"])
         if not self.router.plan.same_assignment(new_plan):
             raise FleetSwapError(
                 "refusing fleet swap: the new export's shard plan differs "
                 "from the serving plan (slab ownership would diverge from "
                 "routing — that is a re-shard, not a swap)"
+            )
+        cur_dtype = self.router.meta.get("store_dtype") or "f32"
+        new_dtype = meta.get("store_dtype") or "f32"
+        if cur_dtype != new_dtype:
+            # a fleet-wide uniform dtype change is a legitimate roll, but
+            # never a compile-free one: every replica's prepare probe
+            # re-traces the gather kernels on the new slab dtype. Surface
+            # it up front (the per-replica validate reports it too).
+            logger.warning(
+                "fleet swap changes store dtype %s -> %s: the prepare "
+                "probes will compile the new gather executables",
+                cur_dtype, new_dtype,
             )
         self._redrive_commits()
         epoch = self.router.generation + 1
@@ -133,6 +145,10 @@ class FleetSwapper:
         # retire the old epoch under them), then commit every replica ------
         old_epoch = self.router.generation
         self.router.flip_generation(epoch)
+        # the fleet now serves the new export everywhere: adopt its meta
+        # wholesale (dtype, per-coordinate quantization budgets, replica
+        # store dirs) — the plan is already enforced identical above
+        self.router.meta = meta
         if not self.router.drain_generation(old_epoch, self.prepare_timeout_s):
             # stragglers fall back to the stale-rescore safety net (the
             # request re-scores wholesale at the current generation) —

@@ -17,12 +17,17 @@ then serves it with zero parse work per process:
                                   SlabRowIndex` — the feature-index
                                   machinery generalized to coefficient
                                   slabs)
-    ``random/<name>/slab.npy``    (E_pad, D) f32 per-entity coefficient
-                                  slab, row order = the rows store's index
+    ``random/<name>/slab.npy``    (E_pad, D) per-entity coefficient slab
+                                  (f32, or bf16-as-uint16 / int8 under a
+                                  quantized ``store_dtype`` — see
+                                  :mod:`photon_ml_tpu.serve.quantize`),
+                                  row order = the rows store's index
                                   order, entity count padded up the PR-3
                                   shape ladder so a model swap that stays
                                   within the rung reuses every compiled
                                   executable
+    ``random/<name>/scales.npy``  (E_pad,) f32 per-row absmax scale
+                                  sidecar (int8 stores only)
 
 Opening the store is a handful of mmaps (the page cache is the share
 mechanism — concurrent servers on one host map the same physical pages,
@@ -54,17 +59,27 @@ from photon_ml_tpu.io.offheap import (
     build_offheap_store,
     build_slab_index,
 )
+from photon_ml_tpu.resilience import faults
+from photon_ml_tpu.serve import quantize
 
 logger = logging.getLogger(__name__)
 
 STORE_FORMAT = "game-serve-store"
-STORE_VERSION = 1
+# version 2: optional quantized slabs (store_dtype + scale sidecars +
+# pinned error budgets in meta). A version-1 store (no store_dtype key)
+# still opens — it is exactly a version-2 f32 store.
+STORE_VERSION = 2
 META_FILE = "meta.json"
 FEATURES_DIR = "features"
 FIXED_DIR = "fixed"
 RANDOM_DIR = "random"
 ROWS_DIR = "rows"
 SLAB_FILE = "slab.npy"
+SCALES_FILE = "scales.npy"
+
+#: on-disk slab dtype per store_dtype (bf16 travels as its raw bit
+#: pattern so plain numpy can mmap it)
+_DISK_DTYPE = {"f32": np.float32, "bf16": np.uint16, "int8": np.int8}
 
 
 def _scan_records(model_dir: str, kind: str, name: str) -> List[dict]:
@@ -95,9 +110,17 @@ def build_model_store(
     bucketer: Optional[ShapeBucketer] = None,
     force_python: bool = False,
     entity_filter: Optional[Callable[[str], bool]] = None,
+    store_dtype: str = "f32",
 ) -> dict:
     """Export a saved GAME model dir into the serving layout. Returns the
     written meta dict.
+
+    ``store_dtype`` (``f32`` | ``bf16`` | ``int8``) selects the slab
+    storage policy (:mod:`photon_ml_tpu.serve.quantize`): ``f32`` keeps
+    the bitwise-to-the-batch-driver contract; the quantized dtypes trade
+    a pinned, export-time-verified coefficient error budget for 2x/4x
+    smaller slabs. Fixed-effect vectors stay f32 under every policy (they
+    are ``(D,)`` and replicated — the slabs are the serving bytes).
 
     The feature space is scanned FROM THE MODEL ITSELF (every name/term its
     coefficient records mention) — no training inputs needed at export
@@ -111,6 +134,7 @@ def build_model_store(
     every fleet replica agrees bitwise on the feature space and fixed
     coefficients, and owns only its slab partition.
     """
+    quantize.validate_store_dtype(store_dtype)
     layout = model_io.list_game_model(model_dir)
     fixed_entries = []
     for name in layout[model_io.FIXED_EFFECT]:
@@ -173,6 +197,7 @@ def build_model_store(
     meta: dict = {
         "format": STORE_FORMAT,
         "version": STORE_VERSION,
+        "store_dtype": store_dtype,
         "task": model_io.schemas.TASK_BY_MODEL_CLASS.get(
             task, "LOGISTIC_REGRESSION"
         ),
@@ -218,14 +243,24 @@ def build_model_store(
             means, _ = model_io._record_to_dense(rec, maps[shard])
             slab[row] = means
         rows.close()
-        np.save(os.path.join(base, SLAB_FILE), slab)
+        stored, scales = quantize.quantize_slab(slab, store_dtype)
+        # the pinned-budget gate: realized error vs the analytic budget,
+        # computed against the TRUE slab — an over-budget slab fails the
+        # export here and never serves
+        err_report = quantize.slab_error_report(
+            slab, stored, scales, store_dtype
+        )
+        np.save(os.path.join(base, SLAB_FILE), stored)
+        if scales is not None:
+            np.save(os.path.join(base, SCALES_FILE), scales)
         meta["random"].append(
             {
                 "name": name,
                 "re_id": re_id,
                 "shard": shard,
                 "entities": n_entities,
-                "padded_rows": int(slab.shape[0]),
+                "padded_rows": int(stored.shape[0]),
+                "quantization": err_report,
             }
         )
 
@@ -259,8 +294,18 @@ class RandomEffectSlab:
     re_id: str
     shard: str
     rows: SlabRowIndex  # entity raw id -> slab row
-    slab: np.ndarray  # (E_pad, D) f32 memmap
+    slab: np.ndarray  # (E_pad, D) memmap (f32 / bf16-as-uint16 / int8)
     entities: int  # real (unpadded) entity count
+    store_dtype: str = "f32"
+    scales: Optional[np.ndarray] = None  # (E_pad,) f32 memmap (int8 only)
+    quantization: Optional[dict] = None  # realized/budget coeff error
+
+    def dequantized(self) -> np.ndarray:
+        """The f32 coefficient values the device kernels serve (for f32
+        stores, the slab itself) — the host-oracle view of this slab."""
+        return quantize.dequantize_slab(
+            self.slab, self.scales, self.store_dtype
+        )
 
 
 class ModelStore:
@@ -274,6 +319,17 @@ class ModelStore:
             self.meta = json.load(f)
         if self.meta.get("format") != STORE_FORMAT:
             raise IOError(f"{store_dir} is not a {STORE_FORMAT} directory")
+        if int(self.meta.get("version") or 1) > STORE_VERSION:
+            raise IOError(
+                f"{store_dir} is a version-{self.meta['version']} store; "
+                f"this build reads <= {STORE_VERSION} — upgrade the serving "
+                "binary before pointing it at this export"
+            )
+        # version-1 stores carry no store_dtype key: they are f32 exports
+        self.store_dtype: str = self.meta.get("store_dtype") or "f32"
+        quantize.validate_store_dtype(self.store_dtype)
+        if self.store_dtype == "bf16":
+            quantize._bf16()  # fail at OPEN, not first gather, if absent
         self.feature_maps: Dict[str, OffHeapIndexMap] = {
             shard: OffHeapIndexMap(
                 os.path.join(store_dir, FEATURES_DIR, shard),
@@ -295,6 +351,8 @@ class ModelStore:
         self.random: List[RandomEffectSlab] = []
         for e in self.meta["random"]:
             base = os.path.join(store_dir, RANDOM_DIR, e["name"])
+            slab = np.load(os.path.join(base, SLAB_FILE), mmap_mode="r")
+            scales = self._open_quantized(base, e, slab)
             self.random.append(
                 RandomEffectSlab(
                     e["name"],
@@ -303,10 +361,71 @@ class ModelStore:
                     SlabRowIndex(
                         os.path.join(base, ROWS_DIR), force_python=force_python
                     ),
-                    np.load(os.path.join(base, SLAB_FILE), mmap_mode="r"),
+                    slab,
                     int(e["entities"]),
+                    store_dtype=self.store_dtype,
+                    scales=scales,
+                    quantization=e.get("quantization"),
                 )
             )
+
+    def _open_quantized(
+        self, base: str, entry: dict, slab: np.ndarray
+    ) -> Optional[np.ndarray]:
+        """Open-time dequantization gate for one coordinate: the slab's
+        on-disk dtype, the recorded error budget, and (int8) the scale
+        sidecar are all validated BEFORE the store can serve — a corrupt
+        sidecar or over-budget meta fails the open actionably; it never
+        degrades to serving garbage coefficients."""
+        name = entry["name"]
+        want = _DISK_DTYPE[self.store_dtype]
+        if slab.dtype != want:
+            raise IOError(
+                f"store {self.store_dir} coordinate {name!r}: slab dtype "
+                f"{slab.dtype} does not match store_dtype "
+                f"{self.store_dtype!r} (expected {np.dtype(want)}); the "
+                "export is inconsistent — re-export the store"
+            )
+        if self.store_dtype == "f32":
+            return None
+        faults.inject("serve.dequant", coordinate=name)
+        q = entry.get("quantization") or {}
+        realized = q.get("realized_max_abs_coeff_err")
+        budget = q.get("coeff_err_budget")
+        # `not (realized <= budget)` so a NaN smuggled into the meta (or
+        # written by a pre-fix exporter from a NaN-corrupted slab) is
+        # refused — NaN fails every comparison, including this gate's
+        if realized is None or budget is None or not (realized <= budget):
+            raise IOError(
+                f"store {self.store_dir} coordinate {name!r}: quantized "
+                f"slab has no valid pinned error budget in meta "
+                f"(realized={realized!r}, budget={budget!r}); refusing to "
+                "serve an unverified quantized export"
+            )
+        if self.store_dtype != "int8":
+            return None
+        try:
+            scales = np.load(os.path.join(base, SCALES_FILE), mmap_mode="r")
+        except (OSError, ValueError) as e:
+            raise IOError(
+                f"store {self.store_dir} coordinate {name!r}: int8 scale "
+                f"sidecar {SCALES_FILE} is missing or unreadable ({e}); "
+                "the store cannot dequantize — re-export it"
+            ) from e
+        if (
+            scales.dtype != np.float32
+            or scales.shape != (slab.shape[0],)
+            or not bool(np.all(np.isfinite(scales)))
+            or not bool(np.all(np.asarray(scales) > 0))
+        ):
+            raise IOError(
+                f"store {self.store_dir} coordinate {name!r}: int8 scale "
+                f"sidecar is corrupt (dtype {scales.dtype}, shape "
+                f"{scales.shape}; scales must be finite and > 0); "
+                "refusing to serve garbage coefficients — re-export the "
+                "store"
+            )
+        return scales
 
     # -- lookups ------------------------------------------------------------
     def shard_dim(self, shard: str) -> int:
@@ -332,13 +451,45 @@ class ModelStore:
         through an identical feature space."""
         return os.path.join(self.store_dir, FEATURES_DIR)
 
+    def footprint(self) -> dict:
+        """Store-footprint gauges for :class:`~photon_ml_tpu.serve.stats.
+        ServeStats`: slab bytes on disk (slab files + scale sidecars
+        ONLY — the quantization dial's denominator; fixed-effect vectors
+        are f32 under every policy), bytes mapped into this process
+        (slabs + scales + fixed), and the storage dtype."""
+        disk = 0
+        mapped = 0
+        for f in self.fixed:
+            mapped += int(f.coefficients.nbytes)
+        for r in self.random:
+            base = os.path.join(self.store_dir, RANDOM_DIR, r.name)
+            mapped += int(r.slab.nbytes)
+            disk += self._file_size(os.path.join(base, SLAB_FILE))
+            if r.scales is not None:
+                mapped += int(r.scales.nbytes)
+                disk += self._file_size(os.path.join(base, SCALES_FILE))
+        return {
+            "slab_bytes_disk": disk,
+            "mapped_bytes": mapped,
+            "store_dtype": self.store_dtype,
+        }
+
+    @staticmethod
+    def _file_size(path: str) -> int:
+        try:
+            return os.path.getsize(path)
+        except OSError:
+            return 0
+
     def describe(self) -> str:
         re_desc = ", ".join(
             f"{r.name}({r.entities} entities, slab {tuple(r.slab.shape)})"
             for r in self.random
         )
+        fp = self.footprint()
         return (
-            f"model store {self.store_dir}: "
+            f"model store {self.store_dir} "
+            f"[{self.store_dtype}, {fp['slab_bytes_disk']} slab bytes]: "
             f"{len(self.fixed)} fixed / {len(self.random)} random "
             f"[{re_desc}]"
         )

@@ -72,6 +72,24 @@ def _fixed_contrib_impl(w, idx, vals):
     return jnp.sum(w[idx].astype(acc) * vals.astype(acc), axis=-1)
 
 
+def _re_gather_dequant_impl(slab, scales, ent_pos, idx, vals):
+    """Quantized-store variant of the driver's ``_re_gather_contrib_impl``:
+    gather the stored elements (bf16 or int8), dequantize ON the gathered
+    ``(n, k)`` tile — widen to f32, multiply by the per-slab-row scale —
+    then the identical masked K-sum. Only the gathered elements ever
+    widen; the resident slab stays at its storage width on device. For
+    bf16 stores ``scales`` is all-ones (``x * 1.0`` is exact in f32, so
+    one kernel body serves both quantized dtypes; the executables differ
+    by slab input dtype exactly as the ladder expects)."""
+    import jax.numpy as jnp
+
+    safe_e = jnp.maximum(ent_pos, 0)
+    gathered = slab[safe_e[:, None], idx].astype(jnp.float32)
+    gathered = gathered * scales[safe_e][:, None]
+    valid = ent_pos[:, None] >= 0
+    return jnp.sum(jnp.where(valid, gathered * vals, 0.0), axis=-1)
+
+
 def _concat_futures(parts: List) -> "Future":
     """One Future resolving to the row-concatenation of ``parts`` (first
     part failure wins; remaining parts are ignored once failed)."""
@@ -112,7 +130,7 @@ class _ModelBundle:
     generation: int
     store: ModelStore
     fixed: List[tuple]  # (name, shard, w_dev)
-    random: List[tuple]  # (name, re_id, shard, slab_dev)
+    random: List[tuple]  # (name, re_id, shard, slab_dev, scales_dev|None)
     score_fn: Optional[Callable] = None  # bound by the server after build
     _inflight: int = 0
     _retired: bool = False
@@ -183,9 +201,20 @@ class ScoringServer:
         self._re_kernel = instrumented_jit(
             _re_gather_contrib_impl, site="serve.re_gather"
         )
+        # quantized stores gather through the dequantize variant under the
+        # SAME instrumented site — warm-swap accounting and the ladder see
+        # one gather site whatever the storage dtype; the f32 default
+        # keeps the untouched driver kernel (bitwise by construction)
+        self._re_dequant_kernel = instrumented_jit(
+            _re_gather_dequant_impl, site="serve.re_gather"
+        )
         self._generation = 0
         self._swap_lock = threading.Lock()
         self._model = self._build_bundle(store)
+        # footprint gauges update at INSTALL, not bundle build — a staged
+        # fleet bundle whose swap aborts must not leave the stats
+        # describing a store that never served
+        self.stats.record_store_footprint(**store.footprint())
         # the default scores against the CURRENT generation at call time —
         # binding a specific bundle's closure here would pin generation 1's
         # device slabs (and its store) for the server's whole life
@@ -201,10 +230,30 @@ class ScoringServer:
     # -- model install / swap ----------------------------------------------
     def _build_bundle(self, store: ModelStore) -> _ModelBundle:
         """Upload a store's coefficients to the device (outside any lock —
-        slow) and bind its scoring closure."""
+        slow) and bind its scoring closure. Quantized slabs upload AT
+        their storage width (bf16/int8 device residency — the footprint
+        win travels to the device) plus the f32 scale vector; dequantize
+        happens per gathered element inside the kernel."""
         import jax.numpy as jnp
 
         self._generation += 1
+        random = []
+        for r in store.random:
+            if r.store_dtype == "f32":
+                entry = (jnp.asarray(r.slab, jnp.float32), None)
+            elif r.store_dtype == "bf16":
+                from photon_ml_tpu.serve.quantize import _bf16
+
+                entry = (
+                    jnp.asarray(np.asarray(r.slab).view(_bf16())),
+                    jnp.ones(r.slab.shape[0], jnp.float32),
+                )
+            else:  # int8
+                entry = (
+                    jnp.asarray(r.slab, jnp.int8),
+                    jnp.asarray(r.scales, jnp.float32),
+                )
+            random.append((r.name, r.re_id, r.shard) + entry)
         bundle = _ModelBundle(
             generation=self._generation,
             store=store,
@@ -212,10 +261,7 @@ class ScoringServer:
                 (f.name, f.shard, jnp.asarray(f.coefficients, jnp.float32))
                 for f in store.fixed
             ],
-            random=[
-                (r.name, r.re_id, r.shard, jnp.asarray(r.slab, jnp.float32))
-                for r in store.random
-            ],
+            random=random,
         )
         bundle.score_fn = lambda batch: self._score_with(bundle, batch)
         return bundle
@@ -227,6 +273,7 @@ class ScoringServer:
         new = self._build_bundle(store)
         with self._swap_lock:
             old, self._model = self._model, new
+        self.stats.record_store_footprint(**store.footprint())
         return old
 
     @property
@@ -254,14 +301,23 @@ class ScoringServer:
         total = jnp.asarray(batch.offset, jnp.float32)
         for _name, shard, w in bundle.fixed:
             total = total + self._fixed_kernel(w, idx_dev[shard], val_dev[shard])
-        for name, _re_id, shard, slab in bundle.random:
-            total = total + self._re_kernel(
+        for name, _re_id, shard, slab, scales in bundle.random:
+            total = total + self._re_contrib(
                 slab,
+                scales,
                 jnp.asarray(batch.ent_row[name]),
                 idx_dev[shard],
                 val_dev[shard],
             )
         return np.asarray(jax.device_get(total))
+
+    def _re_contrib(self, slab, scales, ent_dev, idx_dev, val_dev):
+        """One random-effect coordinate's contribution: the untouched f32
+        driver kernel when the slab is f32 (bitwise contract), the
+        dequantize-on-gather kernel for bf16/int8 slabs."""
+        if scales is None:
+            return self._re_kernel(slab, ent_dev, idx_dev, val_dev)
+        return self._re_dequant_kernel(slab, scales, ent_dev, idx_dev, val_dev)
 
     def featurize(
         self, rows: List[dict], bundle: Optional[_ModelBundle] = None

@@ -50,6 +50,11 @@ class ServeStats:
         self.errors = 0
         self.swaps = 0
         self.swap_compiles = 0
+        # store-footprint gauges (set at bundle install, overwritten by a
+        # swap — they always describe the CURRENTLY serving store)
+        self.store_slab_bytes = 0
+        self.store_mapped_bytes = 0
+        self.store_dtype: Optional[str] = None
         self._first_ts: Optional[float] = None
         self._last_ts: Optional[float] = None
 
@@ -79,6 +84,17 @@ class ServeStats:
         with self._lock:
             self.swaps += 1
             self.swap_compiles += new_compiles
+
+    def record_store_footprint(
+        self, slab_bytes_disk: int, mapped_bytes: int, store_dtype: str
+    ) -> None:
+        """Gauge update from :meth:`ModelStore.footprint` — recorded at
+        every bundle install so the summary always shows the bytes and
+        dtype of the store actually serving."""
+        with self._lock:
+            self.store_slab_bytes = int(slab_bytes_disk)
+            self.store_mapped_bytes = int(mapped_bytes)
+            self.store_dtype = store_dtype
 
     # -- reading ------------------------------------------------------------
     def snapshot(self) -> Dict[str, float]:
@@ -115,6 +131,9 @@ class ServeStats:
                 ),
                 "swaps": self.swaps,
                 "swap_compiles": self.swap_compiles,
+                "store_slab_bytes": self.store_slab_bytes,
+                "store_mapped_bytes": self.store_mapped_bytes,
+                "store_dtype": self.store_dtype or "",
             }
 
     def reset(self) -> None:
@@ -129,6 +148,8 @@ class ServeStats:
             self.errors = 0
             self.swaps = 0
             self.swap_compiles = 0
+            # store footprint gauges survive reset: they describe the
+            # store currently serving, not traffic since the last reset
             self._first_ts = None
             self._last_ts = None
 
@@ -143,7 +164,10 @@ class ServeStats:
             f"{s['batch_fill_ratio']:.2%} (avg {s['avg_batch_rows']} rows / "
             f"{s['avg_requests_per_batch']} requests per batch); "
             f"{s['errors']} errors; {s['swaps']} swaps "
-            f"({s['swap_compiles']} swap compiles)"
+            f"({s['swap_compiles']} swap compiles); store "
+            f"{s['store_dtype'] or 'n/a'}: "
+            f"{s['store_slab_bytes'] / 1e6:.2f}MB slabs on disk / "
+            f"{s['store_mapped_bytes'] / 1e6:.2f}MB mapped"
         )
 
 

@@ -70,6 +70,12 @@ class ModelSwapper:
         future recompile or a refused swap; empty = compile-free roll)."""
         cur = self.server.store
         problems = []
+        if cur.store_dtype != new_store.store_dtype:
+            problems.append(
+                f"store dtype changed: {cur.store_dtype} -> "
+                f"{new_store.store_dtype} (the gather kernels re-trace on "
+                "the new slab dtype; the first post-swap batch compiles)"
+            )
         if sorted(cur.feature_maps) != sorted(new_store.feature_maps):
             problems.append(
                 f"feature shards changed: {sorted(cur.feature_maps)} -> "

@@ -396,3 +396,67 @@ def serve_requests_from_records(records) -> list:
         }
         for rec in records
     ]
+
+
+# ---------------------------------------------------------------------------
+# Device-vs-oracle scoring comparison with a quantization error budget
+# (shared by tests/test_serve.py, tests/test_serve_fleet.py, and the
+# bench.py quantized_serving section)
+# ---------------------------------------------------------------------------
+
+
+def serving_score_budget(
+    store_meta: dict, requests: list, shard_sections: Dict[str, list]
+) -> np.ndarray:
+    """(n,) per-score quantization budget for ``requests`` against a
+    serving store's meta: each random-effect coordinate contributes
+    ``||values||_1`` (its shard's sections, intercept included) times the
+    coordinate's PINNED ``coeff_err_budget`` from the export. All-zero
+    for f32 stores — where the contract is bitwise, the budget says so."""
+    n = len(requests)
+    budget = np.zeros(n, np.float64)
+    for entry in store_meta.get("random") or []:
+        coeff = float(
+            (entry.get("quantization") or {}).get("coeff_err_budget") or 0.0
+        )
+        if coeff == 0.0:
+            continue
+        sections = shard_sections.get(entry["shard"]) or ["features"]
+        for i, req in enumerate(requests):
+            feats = req.get("features") or {}
+            if isinstance(feats, list):
+                feats = {"features": feats}
+            l1 = 1.0  # the intercept slot's value
+            for section in sections:
+                for f in feats.get(section) or []:
+                    l1 += abs(float(f["value"]))
+            budget[i] += l1 * coeff
+    return budget
+
+
+def assert_scores_match_store(
+    served, oracle_scores, store_meta: dict, requests: list,
+    shard_sections: Dict[str, list], err_msg: str = "",
+):
+    """The serving oracle comparison, budget-aware: BITWISE for an f32
+    store (the existing contract, untouched), the pinned per-score
+    quantization budget for bf16/int8 stores."""
+    from tolerances import assert_within_budget, quant_score_budget
+
+    served = np.asarray(served)
+    oracle_scores = np.asarray(oracle_scores)
+    if (store_meta.get("store_dtype") or "f32") == "f32":
+        assert np.array_equal(served, oracle_scores), (
+            f"f32-store scores must stay BITWISE-equal to the oracle "
+            f"(max diff {np.max(np.abs(served - oracle_scores)):.3e}). "
+            + err_msg
+        )
+        return
+    budget = serving_score_budget(store_meta, requests, shard_sections)
+    # the per-coordinate l1 * coeff products are already summed in
+    # `budget`, so the policy call just adds the shared f32-noise slack
+    assert_within_budget(
+        served, oracle_scores,
+        quant_score_budget(1.0, budget, ref_scores=oracle_scores),
+        err_msg=err_msg,
+    )

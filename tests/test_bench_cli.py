@@ -25,7 +25,7 @@ def test_list_sections_enumerates_all_sections():
         "streaming", "streaming_pipeline", "compile_reuse", "compaction",
         "preemption_resume",
         "perhost", "perhost_streaming", "scoring", "serving",
-        "serving_fleet", "retrain_delta", "ingest",
+        "serving_fleet", "quantized_serving", "retrain_delta", "ingest",
     ]
 
 

@@ -79,6 +79,7 @@ class TestPartition:
 
 
 class TestEquality:
+    @pytest.mark.slow  # ~15s: tier-1 rides the 870s budget's edge (ROADMAP re-anchor note); test_in_coordinate_descent keeps the bucketed-equality contract tier-1 (and the scheduler/preemption bucket pins exercise the same solves)
     def test_matches_unbucketed(self, rng):
         sizes = [3, 5, 6, 9, 17, 33, 150]  # heavily skewed
         data = _skewed_glmix(rng, sizes)
@@ -146,6 +147,7 @@ class TestEquality:
         assert np.all(np.isfinite(np.asarray(result.total_scores)))
 
 
+@pytest.mark.slow  # ~15s: tier-1 rides the 870s budget's edge (ROADMAP re-anchor note); the bucketed x --distributed composition stays tier-1 at the driver level via test_game_drivers TestBucketedDistributedDriver
 def test_bucketed_composes_with_entity_sharding(rng):
     """mesh_ctx set: every bucket entity-shards over the mesh (per-bucket
     DistributedRandomEffectSolver) and must match the single-device

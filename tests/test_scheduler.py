@@ -328,6 +328,7 @@ class TestCoordinateWiring:
                 reg_weight=jnp.asarray(0.5),
             )
 
+    @pytest.mark.slow  # ~18s: tier-1 rides the 870s budget's edge (ROADMAP re-anchor note); the streaming-coordinate wiring pin above and the per-regularizer compacted-solve pins keep the scheduler bitwise contract tier-1
     def test_bucketed_coordinate_bitwise(self, glmix):
         from photon_ml_tpu.algorithm.bucketed_random_effect import (
             BucketedRandomEffectCoordinate,

@@ -722,3 +722,262 @@ class TestServeDriver:
             ).validate()
         # defaults are valid
         GameServeParams(model_store_dir="x").validate()
+
+
+# ---------------------------------------------------------------------------
+# Quantized serving stores (store_dtype bf16/int8; serve/quantize.py)
+# ---------------------------------------------------------------------------
+
+
+class TestQuantizedStore:
+    """The accuracy/speed dial: bf16/int8 slabs under a PINNED error
+    budget, with the f32 default untouched (bitwise stays bitwise)."""
+
+    @pytest.fixture(scope="class")
+    def q_world(self, serving_world, tmp_path_factory):
+        base = tmp_path_factory.mktemp("qstores")
+        stores = {"f32": serving_world["store_dir"]}
+        metas = {"f32": ModelStore(serving_world["store_dir"]).meta}
+        for dt in ("bf16", "int8"):
+            sd = str(base / f"store-{dt}")
+            metas[dt] = build_model_store(
+                serving_world["model_dir"], sd,
+                bucketer=ShapeBucketer(), store_dtype=dt,
+            )
+            stores[dt] = sd
+        return {"base": base, "stores": stores, "metas": metas}
+
+    def _server(self, store_dir):
+        server = ScoringServer(
+            ModelStore(store_dir), shard_sections=SECTIONS,
+            max_batch_rows=16, max_wait_ms=1.0, stats=ServeStats(),
+        )
+        server.warmup(warm_nnz=8)
+        return server
+
+    def test_export_bytes_and_pinned_budget(self, q_world):
+        from photon_ml_tpu.serve import quantize
+
+        slab_path = os.path.join(
+            q_world["stores"]["f32"], "random", "per-user", "slab.npy"
+        )
+        f32_bytes = os.path.getsize(slab_path)
+        true_slab = np.asarray(
+            ModelStore(q_world["stores"]["f32"]).random[0].slab
+        )
+        for dt in ("bf16", "int8"):
+            store = ModelStore(q_world["stores"][dt])
+            assert store.store_dtype == dt
+            re = store.random[0]
+            q = re.quantization
+            # the pinned-budget contract: realized error recorded at
+            # export, within the analytic budget
+            assert 0 < q["realized_max_abs_coeff_err"] <= q["coeff_err_budget"]
+            # realized error against the TRUE slab honors the per-row bound
+            row_budget = quantize.row_coeff_budget(
+                dt, np.max(np.abs(true_slab), axis=1)
+            )
+            err = np.abs(re.dequantized().astype(np.float64) - true_slab)
+            assert np.all(err <= row_budget[:, None])
+            # bytes: the dial actually pays (raw slab payloads; npy
+            # headers wash out at real sizes but count against us here)
+            got = os.path.getsize(
+                os.path.join(
+                    q_world["stores"][dt], "random", "per-user", "slab.npy"
+                )
+            )
+            if dt == "bf16":
+                assert got <= 0.55 * f32_bytes + 128
+            else:
+                scales = os.path.getsize(
+                    os.path.join(
+                        q_world["stores"][dt], "random", "per-user",
+                        "scales.npy",
+                    )
+                )
+                assert got + scales <= 0.55 * f32_bytes + 256
+            store.close()
+
+    def test_version1_meta_opens_as_f32_and_future_version_refused(
+        self, q_world, tmp_path
+    ):
+        import shutil
+
+        v1 = str(tmp_path / "v1-store")
+        shutil.copytree(q_world["stores"]["f32"], v1)
+        meta_path = os.path.join(v1, "meta.json")
+        with open(meta_path) as f:
+            meta = json.load(f)
+        # a PR-6-era export: version 1, no store_dtype / quantization keys
+        meta["version"] = 1
+        meta.pop("store_dtype", None)
+        for e in meta["random"]:
+            e.pop("quantization", None)
+        with open(meta_path, "w") as f:
+            json.dump(meta, f)
+        store = ModelStore(v1)
+        assert store.store_dtype == "f32"
+        assert store.random[0].scales is None
+        store.close()
+        meta["version"] = 99
+        with open(meta_path, "w") as f:
+            json.dump(meta, f)
+        with pytest.raises(IOError, match="version-99"):
+            ModelStore(v1)
+
+    def test_quantized_scores_within_budget_f32_bitwise(self, q_world, serving_world):
+        from game_test_utils import assert_scores_match_store
+
+        reqs = serving_world["requests"]
+        f32_server = self._server(q_world["stores"]["f32"])
+        oracle = f32_server.score_rows(reqs)
+        f32_server.close()
+        for dt in ("f32", "bf16", "int8"):
+            server = self._server(q_world["stores"][dt])
+            served = server.score_rows(reqs)
+            # f32 goes through the helper's BITWISE branch; bf16/int8
+            # through the pinned per-score budget from store meta
+            assert_scores_match_store(
+                served, oracle, server.store.meta, reqs, SECTIONS,
+                err_msg=f"store_dtype={dt}",
+            )
+            if dt != "f32":
+                assert not np.array_equal(served, oracle), (
+                    "quantized scores bitwise-equal to f32 — the dtype "
+                    "dial is not actually engaged"
+                )
+            server.close()
+
+    def test_same_dtype_swap_compile_free_dtype_change_flagged(
+        self, q_world, serving_world, tmp_path
+    ):
+        # a second int8 export of a perturbed model (same shapes)
+        model2 = str(tmp_path / "model2")
+        save_synthetic_game_model(
+            model2, np.random.default_rng(77), d_fixed=5, d_random=3,
+            num_users=10,
+        )
+        store2 = str(tmp_path / "store2-int8")
+        build_model_store(
+            model2, store2, bucketer=ShapeBucketer(), store_dtype="int8"
+        )
+        server = self._server(q_world["stores"]["int8"])
+        swapper = ModelSwapper(server)
+        report = swapper.swap(store2)
+        assert report["new_compiles"] == 0
+        assert report["shape_compatible"]
+        assert report["dropped_requests"] == 0
+        # dtype change is a loud validation problem (and refused under
+        # require_compatible) — never a silent recompile
+        problems = swapper.validate_compatible(
+            ModelStore(q_world["stores"]["bf16"])
+        )
+        assert any("dtype" in p for p in problems)
+        from photon_ml_tpu.checkpoint import CheckpointRefError
+
+        with pytest.raises(CheckpointRefError, match="dtype"):
+            swapper.swap(q_world["stores"]["bf16"], require_compatible=True)
+        server.close()
+
+    def test_corrupt_scale_sidecar_refuses_open(self, q_world, tmp_path):
+        import shutil
+
+        broken = str(tmp_path / "broken-int8")
+        shutil.copytree(q_world["stores"]["int8"], broken)
+        scales_path = os.path.join(broken, "random", "per-user", "scales.npy")
+        n_rows = np.load(scales_path).shape[0]
+        # non-finite scales: mmap-able but poisonous — must refuse, not serve
+        np.save(scales_path, np.full(n_rows, np.nan, np.float32))
+        with pytest.raises(IOError, match="corrupt"):
+            ModelStore(broken)
+        # unreadable garbage: ditto, with the actionable re-export message
+        with open(scales_path, "wb") as f:
+            f.write(b"not an npy file")
+        with pytest.raises(IOError, match="missing or unreadable"):
+            ModelStore(broken)
+        os.unlink(scales_path)
+        with pytest.raises(IOError, match="missing or unreadable"):
+            ModelStore(broken)
+
+    def test_over_budget_meta_refuses_open(self, q_world, tmp_path):
+        import shutil
+
+        tampered = str(tmp_path / "tampered-int8")
+        shutil.copytree(q_world["stores"]["int8"], tampered)
+        meta_path = os.path.join(tampered, "meta.json")
+        with open(meta_path) as f:
+            meta = json.load(f)
+        q = meta["random"][0]["quantization"]
+        q["realized_max_abs_coeff_err"] = q["coeff_err_budget"] * 2
+        with open(meta_path, "w") as f:
+            json.dump(meta, f)
+        with pytest.raises(IOError, match="budget"):
+            ModelStore(tampered)
+
+    def test_serve_dequant_fault_injection(self, q_world):
+        from photon_ml_tpu.resilience import faults
+
+        plan = faults.FaultPlan(
+            [faults.FaultSpec(site="serve.dequant", at=1)]
+        )
+        with faults.fault_scope(plan):
+            with pytest.raises(OSError, match="serve.dequant"):
+                ModelStore(q_world["stores"]["int8"])
+        assert plan.fire_count("serve.dequant") == 1
+        # f32 stores never pass the dequant gate (no quantized slabs)
+        plan2 = faults.FaultPlan(
+            [faults.FaultSpec(site="serve.dequant", at=1)]
+        )
+        with faults.fault_scope(plan2):
+            ModelStore(q_world["stores"]["f32"]).close()
+        assert plan2.fire_count("serve.dequant") == 0
+
+    def test_store_footprint_gauges(self, q_world):
+        server = self._server(q_world["stores"]["int8"])
+        snap = server.stats.snapshot()
+        assert snap["store_dtype"] == "int8"
+        assert snap["store_slab_bytes"] > 0
+        assert snap["store_mapped_bytes"] > 0
+        assert "int8" in server.stats.summary()
+        server.close()
+
+    def test_export_over_budget_slab_fails(self, tmp_path):
+        """A quantization whose realized error exceeds the analytic
+        budget must fail the EXPORT (never write a serving store)."""
+        from photon_ml_tpu.serve import quantize
+
+        slab = np.random.default_rng(3).normal(size=(8, 6)).astype(np.float32)
+        stored, scales = quantize.quantize_slab(slab, "int8")
+        with pytest.raises(IOError, match="budget"):
+            # a tampered quantization (wrong scales) realizes over budget
+            quantize.slab_error_report(slab, stored, scales * 2.0, "int8")
+
+    def test_non_finite_slab_fails_export_and_open(self, q_world, tmp_path):
+        """A NaN coefficient (the optim.step corruption fault mode) must
+        FAIL the budget gate, not slide through it — every comparison
+        against a NaN realized error is False, so the gate must be
+        written as `not (realized <= budget)`."""
+        import shutil
+
+        from photon_ml_tpu.serve import quantize
+
+        slab = np.random.default_rng(4).normal(size=(8, 6)).astype(np.float32)
+        slab[3, 2] = np.nan
+        for dt in ("bf16", "int8"):
+            stored, scales = quantize.quantize_slab(slab, dt)
+            with pytest.raises(IOError, match="budget"):
+                quantize.slab_error_report(slab, stored, scales, dt)
+        # a NaN smuggled into an already-written store's meta (e.g. by a
+        # pre-fix exporter) is refused at open the same way
+        tampered = str(tmp_path / "nan-meta-int8")
+        shutil.copytree(q_world["stores"]["int8"], tampered)
+        meta_path = os.path.join(tampered, "meta.json")
+        with open(meta_path) as f:
+            meta = json.load(f)
+        meta["random"][0]["quantization"]["realized_max_abs_coeff_err"] = (
+            float("nan")
+        )
+        with open(meta_path, "w") as f:
+            json.dump(meta, f)
+        with pytest.raises(IOError, match="budget"):
+            ModelStore(tampered)

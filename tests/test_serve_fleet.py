@@ -857,3 +857,92 @@ class TestFleetMultiProcess:
         assert exact.any()
         np.testing.assert_array_equal(served[exact], ref[exact])
         assert router.stats.snapshot()["degraded_rows"] > 0
+
+
+# ---------------------------------------------------------------------------
+# Quantized fleets (store_dtype in fleet.json; serve/quantize.py)
+# ---------------------------------------------------------------------------
+
+
+class TestQuantizedFleetMeta:
+    def test_mixed_dtype_fleet_refused(self, fleet_world, tmp_path):
+        """fleet.json pins ONE store_dtype; a replica store re-exported
+        out of band at another dtype is refused loudly at load."""
+        fleet_dir = str(tmp_path / "mixed")
+        build_fleet_stores(
+            fleet_world["model_dir"], fleet_dir, num_replicas=2,
+            bucketer=ShapeBucketer(), store_dtype="f32",
+        )
+        meta = load_fleet_meta(fleet_dir)  # consistent: loads fine
+        assert (meta.get("store_dtype") or "f32") == "f32"
+        # re-export replica 1's store as int8 behind the fleet's back
+        build_model_store(
+            fleet_world["model_dir"],
+            replica_store_dir(fleet_dir, 1),
+            bucketer=ShapeBucketer(), store_dtype="int8",
+        )
+        with pytest.raises(IOError, match="MIXED-DTYPE"):
+            load_fleet_meta(fleet_dir)
+
+    def test_fleet_meta_carries_pinned_budget(self, fleet_world, tmp_path):
+        fleet_dir = str(tmp_path / "int8-fleet")
+        meta = build_fleet_stores(
+            fleet_world["model_dir"], fleet_dir, num_replicas=2,
+            bucketer=ShapeBucketer(), store_dtype="int8",
+        )
+        assert meta["store_dtype"] == "int8"
+        q = meta["random"][0]["quantization"]
+        # the fleet budget is the max over replica slabs: positive, and at
+        # least every replica store's own realized error
+        assert 0 < q["realized_max_abs_coeff_err"] <= q["coeff_err_budget"]
+        for r in range(2):
+            rs = ModelStore(replica_store_dir(fleet_dir, r))
+            rq = rs.random[0].quantization
+            assert rq["realized_max_abs_coeff_err"] <= (
+                q["realized_max_abs_coeff_err"]
+            )
+            rs.close()
+
+
+@pytest.mark.slow
+class TestQuantizedFleet:
+    """Multi-replica quantized serving (slow-marked per the tier-1 budget
+    note; the single-store budget/bitwise pins above stay tier-1)."""
+
+    def test_int8_fleet_within_budget_and_swap_compile_free(
+        self, fleet_world, tmp_path
+    ):
+        from game_test_utils import assert_scores_match_store
+
+        fleet_dir = str(tmp_path / "qfleet")
+        meta = build_fleet_stores(
+            fleet_world["model_dir"], fleet_dir, num_replicas=2,
+            bucketer=ShapeBucketer(), store_dtype="int8",
+        )
+        # f32 single-store oracle
+        single = _single_server(fleet_world)
+        oracle = single.score_rows(fleet_world["requests"])
+        single.close()
+        router, engines, _ = _local_fleet(fleet_world, fleet_dir=fleet_dir)
+        try:
+            served = np.concatenate([
+                router.score_rows([q]) for q in fleet_world["requests"]
+            ])
+            assert_scores_match_store(
+                served, oracle, meta, fleet_world["requests"], SECTIONS,
+                err_msg="int8 2-replica fleet vs f32 single store",
+            )
+            assert not np.array_equal(served, oracle)
+            # fleet-wide warm swap to a second int8 export of the SAME
+            # model: prepare probes must reuse the warmed int8 executables
+            fleet2 = str(tmp_path / "qfleet2")
+            build_fleet_stores(
+                fleet_world["model2"], fleet2, num_replicas=2,
+                bucketer=ShapeBucketer(), store_dtype="int8",
+            )
+            report = FleetSwapper(router).swap(fleet2)
+            assert report["new_compiles"] == 0
+            assert report["dropped_requests"] == 0
+            assert report["commit_failures"] == []
+        finally:
+            _close_fleet(router, engines)

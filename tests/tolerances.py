@@ -69,3 +69,49 @@ def assert_allclose(
     np.testing.assert_allclose(
         a, d, rtol=rtol, atol=atol, err_msg=err_msg or f"({kind} @ {dt})"
     )
+
+
+# ---------------------------------------------------------------------------
+# Quantized-serving error budgets (photon_ml_tpu/serve/quantize.py)
+#
+# A quantized serving store (store_dtype bf16/int8) trades bitwise parity
+# for a PINNED per-coefficient error budget recorded in store meta at
+# export. Scores inherit an analytic per-score bound from it:
+#
+#   |score_q - score_f32|  <=  sum_RE ||values||_1 * coeff_err_budget
+#
+# (fixed-effect vectors stay f32, so only random-effect coordinates
+# contribute), plus a small slack for the f32 rounding noise between the
+# two kernel runs. These helpers are the ONE budget policy the serve
+# tests, fleet tests, and the quantized_serving bench section share —
+# a budgeted comparison, not a tolerance guess.
+# ---------------------------------------------------------------------------
+
+
+def quant_score_budget(coeff_err_budget, values_l1, ref_scores=None):
+    """(n,) per-score error budget: ``||v||_1 * coeff budget`` plus f32
+    summation-noise slack (absolute + relative to the reference score —
+    the quantized and f32 kernels run the identical op sequence, so their
+    rounding disagreement is a few ulps of the score magnitude)."""
+    budget = np.asarray(values_l1, np.float64) * float(coeff_err_budget)
+    slack = 1e-6
+    if ref_scores is not None:
+        slack = slack + 1e-6 * np.abs(np.asarray(ref_scores, np.float64))
+    return budget + slack
+
+
+def assert_within_budget(actual, desired, budget, err_msg: str = ""):
+    """Elementwise ``|actual - desired| <= budget`` (a hard pinned bound,
+    NOT an allclose tolerance) with a worst-offender diagnostic."""
+    a = np.asarray(actual, np.float64)
+    d = np.asarray(desired, np.float64)
+    b = np.broadcast_to(np.asarray(budget, np.float64), a.shape)
+    diff = np.abs(a - d)
+    if np.all(diff <= b):
+        return
+    i = int(np.argmax(diff - b))
+    raise AssertionError(
+        f"score exceeds its pinned quantization budget at row {i}: "
+        f"|{a[i]:.8g} - {d[i]:.8g}| = {diff[i]:.3e} > budget {b[i]:.3e} "
+        f"({int((diff > b).sum())}/{a.size} rows over). {err_msg}"
+    )
