@@ -1322,6 +1322,401 @@ def _bench_perhost_streaming_body(extra, run_workers):
     )
 
 
+def _elastic_worker_main(argv):
+    """Child mode (``--elastic-worker PID NPROCS PORT OUTDIR ARM``): one
+    SPMD process of the elastic re-sharding bench workload
+    (parallel/elastic.py). Arms:
+
+      * ``fresh`` — uninterrupted streaming CD on the SURVIVOR topology
+        (2 owner hosts). Doubles as the bitwise reference AND the honest
+        full-restart cost: the pre-elastic recovery for a lost host was
+        supervised relaunch + full re-ingest + retrain (per-host layouts
+        could not restore across a topology change), i.e. this arm's
+        build+train wall-clock — conservatively EXCLUDING process
+        startup/jax init, which a real relaunch also pays.
+      * ``elastic`` — 3 virtual owners on the 2 processes (owner 2
+        co-located with process 0); owner 2 is reclaimed just before the
+        fleet's first epoch-2 block solve, both processes drain at their
+        streaming boundaries, agree plan v2, move ONLY the delta blocks
+        (+ spilled coefficients), and resume through the plan-versioned
+        checkpoint. Recovery cost is measured drain -> finish.
+    """
+    import hashlib
+    import json as _json
+
+    i = argv.index("--elastic-worker")
+    pid, nprocs, port, outdir, arm = (
+        int(argv[i + 1]), int(argv[i + 2]), argv[i + 3], argv[i + 4],
+        argv[i + 5],
+    )
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+
+    from photon_ml_tpu.parallel import multihost
+
+    if nprocs > 1:
+        multihost.initialize(
+            coordinator_address=f"127.0.0.1:{port}", num_processes=nprocs,
+            process_id=pid,
+        )
+    from game_test_utils import make_glmix_data
+
+    from photon_ml_tpu.algorithm.coordinate_descent import CoordinateDescent
+    from photon_ml_tpu.algorithm.streaming_fixed_effect import (
+        PerHostStreamingFixedEffectCoordinate,
+    )
+    from photon_ml_tpu.checkpoint import CoordinateDescentCheckpointer
+    from photon_ml_tpu.compile.plan import ExecutionPlan
+    from photon_ml_tpu.data.game import RandomEffectDataConfig
+    from photon_ml_tpu.ops import losses as losses_mod
+    from photon_ml_tpu.ops.regularization import RegularizationContext
+    from photon_ml_tpu.optim.common import OptimizerConfig
+    from photon_ml_tpu.optim.problem import GLMOptimizationProblem
+    from photon_ml_tpu.parallel.elastic import (
+        ElasticMonitor,
+        ElasticSession,
+        FleetMembership,
+        ReplanRequired,
+        declare_lost_hosts,
+    )
+    from photon_ml_tpu.parallel.mesh import MeshContext, data_mesh
+    from photon_ml_tpu.parallel.perhost_ingest import HostRows, csr_to_padded
+    from photon_ml_tpu.parallel.perhost_streaming import (
+        PerHostStreamingRandomEffectCoordinate,
+        build_perhost_streaming_manifest,
+    )
+    from photon_ml_tpu.types import OptimizerType, TaskType
+
+    ctx = MeshContext(data_mesh())
+    exec_plan = ExecutionPlan.resolve(
+        distributed=(nprocs > 1), streaming=True, num_processes=nprocs
+    )
+    rng = np.random.default_rng(707)
+    data, _ = make_glmix_data(
+        rng, num_users=600, rows_per_user_range=(4, 10),
+        d_fixed=8, d_random=8,
+    )
+    # sorted entity vocabulary — the production sorted-set decode order
+    vocab0 = data.id_vocabs["userId"]
+    order = np.argsort(np.asarray(vocab0, dtype=object))
+    remap = np.empty(len(vocab0), np.int64)
+    remap[order] = np.arange(len(vocab0))
+    data.ids["userId"] = remap[data.ids["userId"]].astype(np.int32)
+    data.id_vocabs["userId"] = [vocab0[j] for j in order]
+    n = data.num_rows
+    feats = data.shards["per_user"]
+    fi, fv = csr_to_padded(feats, n)
+    vocab = data.id_vocabs["userId"]
+    lo = pid * (n // nprocs)
+    hi = n if pid == nprocs - 1 else (pid + 1) * (n // nprocs)
+    rows = HostRows(
+        entity_raw_ids=[vocab[j] for j in data.ids["userId"][lo:hi]],
+        row_index=np.arange(lo, hi, dtype=np.int64),
+        labels=data.response[lo:hi].astype(np.float32),
+        weights=data.weight[lo:hi].astype(np.float32),
+        offsets=data.offset[lo:hi].astype(np.float32),
+        feat_idx=fi[lo:hi], feat_val=fv[lo:hi], global_dim=feats.dim,
+    )
+    if arm == "elastic":
+        membership = FleetMembership(1, [0, 1, 2], {0: 0, 1: 1, 2: 0})
+    elif arm == "fresh":
+        membership = FleetMembership.initial(nprocs)
+    else:
+        raise SystemExit(f"unknown elastic-worker arm {arm!r}")
+    fleet_dir = os.path.join(outdir, f"fleet-{arm}")
+    monitor = ElasticMonitor(
+        fleet_dir, membership, process_id=pid,
+        heartbeat_deadline=30.0, min_poll_interval=0.0,
+        num_processes=nprocs,
+    )
+    session = ElasticSession(
+        fleet_dir, pid, nprocs, monitor, barrier_timeout=180.0
+    )
+    elastic_arg = monitor if arm == "elastic" else None
+    t_start = time.perf_counter()
+    manifest = build_perhost_streaming_manifest(
+        rows, RandomEffectDataConfig("userId", "per_user"),
+        os.path.join(outdir, f"re-{arm}-host{pid}"),
+        ctx, nprocs, pid, block_entities=64,
+        bucketer=exec_plan.bucketer, membership=membership,
+    )
+    t_build = time.perf_counter() - t_start
+
+    def make_re(man, initial_epoch=0):
+        return PerHostStreamingRandomEffectCoordinate(
+            man, TaskType.LOGISTIC_REGRESSION,
+            optimizer=OptimizerType.LBFGS,
+            optimizer_config=OptimizerConfig(
+                max_iterations=20, tolerance=1e-7
+            ),
+            regularization=RegularizationContext.l2(0.2),
+            state_root=os.path.join(outdir, f"state-{arm}-host{pid}"),
+            plan=exec_plan, elastic=elastic_arg,
+            initial_epoch=initial_epoch,
+            ctx=ctx, num_processes=nprocs,
+        )
+
+    re_coord = make_re(manifest)
+    if arm == "elastic":
+        # EVERY process reclaims virtual owner 2 at its OWN epoch-2
+        # boundary (atomic idempotent marker writes), so no drain depends
+        # on the peer's timing: process 1 fires at update ENTRY (always
+        # drains before its collectives), process 0 just before its first
+        # epoch-2 block solve (drains MID-EPOCH at the block boundary)
+        _fired = {"done": False}
+
+        def _reclaim():
+            _fired["done"] = True
+            monitor.silence_host(2)
+            declare_lost_hosts(
+                fleet_dir, [2], reason="bench: virtual owner reclaimed"
+            )
+
+        if pid == 0:
+            _orig_slab = re_coord._slab_for
+            _calls = {"n": 0}
+            _first_epoch2 = len(manifest.blocks) + 1
+
+            def _slab_hook(i, ds, _orig=_orig_slab):
+                _calls["n"] += 1
+                if not _fired["done"] and _calls["n"] == _first_epoch2:
+                    _reclaim()
+                return _orig(i, ds)
+
+            re_coord._slab_for = _slab_hook
+        else:
+            _orig_update = re_coord.update
+
+            def _entry_trigger(resid, state, resume=None,
+                               _orig=_orig_update):
+                if (not _fired["done"] and re_coord._epoch >= 1
+                        and resume is None):
+                    _reclaim()
+                return _orig(resid, state, resume=resume)
+
+            re_coord.update = _entry_trigger
+    gf = data.shards["global"]
+    x_fe = np.zeros((n, gf.dim), np.float32)
+    x_fe[np.repeat(np.arange(n), np.diff(gf.indptr)), gf.indices] = gf.values
+    chunk_rows = 1024
+    chunk_sizes = [
+        min(chunk_rows, n - c * chunk_rows)
+        for c in range((n + chunk_rows - 1) // chunk_rows)
+    ]
+    owned = {}
+    for c in range(len(chunk_sizes)):
+        if c % nprocs != pid:
+            continue
+        s, e = c * chunk_rows, c * chunk_rows + chunk_sizes[c]
+
+        def load(s=s, e=e):
+            return {"x": x_fe[s:e], "y": data.response[s:e].astype(np.float32)}
+
+        owned[c] = load
+    fe_coord = PerHostStreamingFixedEffectCoordinate(
+        chunk_sizes, owned, gf.dim,
+        GLMOptimizationProblem(
+            TaskType.LOGISTIC_REGRESSION, OptimizerType.LBFGS,
+            OptimizerConfig(max_iterations=8, tolerance=1e-8),
+            RegularizationContext.l2(0.5),
+        ),
+        plan=exec_plan, elastic=elastic_arg,
+        ctx=ctx, num_processes=nprocs,
+    )
+    labels = jnp.asarray(data.response.astype(np.float32))
+    weights = jnp.asarray(data.weight.astype(np.float32))
+    loss = losses_mod.for_task(TaskType.LOGISTIC_REGRESSION)
+    loss_fn = lambda s: jnp.sum(weights * loss.loss(s, labels))  # noqa: E731
+    ck = CoordinateDescentCheckpointer(
+        os.path.join(outdir, f"ckpt-{arm}-host{pid}"),
+        run_fingerprint="elastic-bench", save_every=1,
+    )
+    t_drain = None
+    replans = 0
+    replan_sec = 0.0
+    moved = total_blocks = 0
+    t_train0 = time.perf_counter()
+    while True:
+        cd = CoordinateDescent(
+            {"fixed": fe_coord, "per-user": re_coord}, loss_fn
+        )
+        try:
+            run_res = cd.run(num_iterations=2, num_rows=n, checkpointer=ck)
+            break
+        except ReplanRequired as e:
+            if t_drain is None:
+                t_drain = time.perf_counter()
+            replans += 1
+            old_epoch = re_coord._epoch
+            t_r = time.perf_counter()
+            rr = session.replan(
+                re_coord.manifest, e.proposal,
+                state_dir=re_coord.replan_state_dirs(), epoch=old_epoch,
+            )
+            replan_sec += time.perf_counter() - t_r
+            moved, total_blocks = rr.blocks_moved, rr.blocks_total
+            exec_plan = exec_plan.record_replan(
+                rr.plan_version, rr.decisions[0]
+            )
+            re_coord = make_re(rr.manifest, initial_epoch=old_epoch + 1)
+    t_end = time.perf_counter()
+    h = hashlib.sha256()
+    h.update(np.asarray(run_res.coefficients["fixed"]).tobytes())
+    h.update(np.asarray(run_res.total_scores).tobytes())
+    h.update(repr([float(v) for v in run_res.objective_history]).encode())
+    result = dict(
+        process=pid, arm=arm, digest=h.hexdigest(),
+        build_sec=round(t_build, 3),
+        train_sec=round(t_end - t_train0, 3),
+        total_sec=round(t_end - t_start, 3),
+        rows=int(n), entities=600,
+    )
+    if arm == "elastic":
+        if replans == 0:
+            raise SystemExit("elastic arm never drained — trigger broken")
+        result.update(
+            replans=replans,
+            replan_sec=round(replan_sec, 3),
+            recovery_sec=round(t_end - t_drain, 3),
+            blocks_moved=int(moved),
+            blocks_total=int(total_blocks),
+            plan_version=int(monitor.membership.version),
+        )
+    path = os.path.join(outdir, f"elastic-{arm}-{pid}.json")
+    with open(path + ".tmp", "w") as f:
+        _json.dump(result, f)
+    os.replace(path + ".tmp", path)
+    return 0
+
+
+def _bench_elastic_reshard(extra, on_tpu):
+    """Elastic re-shard cost vs full-restart cost on the small perhost
+    streaming workload (parallel/elastic.py): kill one of 3 virtual owners
+    mid-epoch, re-plan the fleet in place, and finish — against the
+    pre-elastic recovery (relaunch + re-ingest + retrain from scratch on
+    the survivor topology, measured as the fresh arm's build+train).
+    Gates: the elastic run's digest is BITWISE-equal to the fresh
+    survivor-topology run's, blocks genuinely moved (with blocks-moved /
+    blocks-total accounting), and recovery costs less than the restart."""
+    import shutil
+    import socket
+    import subprocess
+    import tempfile
+
+    here = os.path.abspath(__file__)
+    out = tempfile.mkdtemp(prefix="elastic-reshard-bench-")
+
+    def run_workers(arm, timeout, nprocs=2):
+        with socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            port = s.getsockname()[1]
+        env = dict(os.environ)
+        env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+        env["JAX_PLATFORMS"] = "cpu"
+        # the comparison must be flags-off on both arms: pin the plan's
+        # env knobs (same rule as the perhost_streaming section)
+        env.update({
+            "PHOTON_SOLVE_CHUNK": "off",
+            "PHOTON_SPARSE_KERNEL": "off",
+            "PHOTON_SHAPE_LADDER": "off",
+        })
+        log_paths = [
+            os.path.join(out, f"worker-{arm}-{p}.log") for p in range(nprocs)
+        ]
+        procs = []
+        for p in range(nprocs):
+            with open(log_paths[p], "w") as lf:
+                procs.append(subprocess.Popen(
+                    [sys.executable, here, "--elastic-worker", str(p),
+                     str(nprocs), str(port), out, arm],
+                    stdout=subprocess.DEVNULL, stderr=lf, env=env,
+                ))
+
+        def tail(p_id):
+            try:
+                with open(log_paths[p_id]) as lf:
+                    return lf.read()[-1500:]
+            except OSError:
+                return "<no worker log>"
+
+        try:
+            for p_id, p in enumerate(procs):
+                try:
+                    p.communicate(timeout=timeout)
+                except subprocess.TimeoutExpired:
+                    p.kill()
+                    p.communicate()
+                    raise RuntimeError(
+                        f"elastic worker ({arm}) exceeded {timeout}s:\n"
+                        f"{tail(p_id)}"
+                    )
+                if p.returncode != 0:
+                    raise RuntimeError(
+                        f"elastic worker ({arm}) failed "
+                        f"rc={p.returncode}:\n{tail(p_id)}"
+                    )
+        except BaseException:  # noqa: BLE001 — cohort cleanup then re-raise (a stranded Gloo peer contends with every later section)
+            for p in procs:
+                if p.poll() is None:
+                    p.kill()
+                    p.wait()
+            raise
+        results = []
+        for p_id in range(nprocs):
+            with open(os.path.join(out, f"elastic-{arm}-{p_id}.json")) as f:
+                results.append(json.load(f))
+        return results
+
+    try:
+        fresh = run_workers("fresh", 1500)
+        el = run_workers("elastic", 1800)
+    finally:
+        shutil.rmtree(out, ignore_errors=True)
+
+    digests = {r["digest"] for r in fresh} | {r["digest"] for r in el}
+    if len(digests) != 1:
+        raise AssertionError(
+            "elastic re-shard run is NOT bitwise-equal to the fresh "
+            f"survivor-topology run: fresh {[r['digest'][:12] for r in fresh]}"
+            f" vs elastic {[r['digest'][:12] for r in el]}"
+        )
+    moved = el[0]["blocks_moved"]
+    total = el[0]["blocks_total"]
+    if moved <= 0:
+        raise AssertionError("elastic arm re-planned but moved no blocks")
+    # the pre-elastic recovery: full restart on the survivor topology
+    # (re-ingest + retrain; process startup excluded — conservative)
+    restart_sec = max(r["total_sec"] for r in fresh)
+    recovery_sec = max(r["recovery_sec"] for r in el)
+    replan_sec = max(r["replan_sec"] for r in el)
+    if not recovery_sec < restart_sec:
+        raise AssertionError(
+            f"elastic recovery ({recovery_sec:.2f}s) is not cheaper than "
+            f"the full restart ({restart_sec:.2f}s) on this workload"
+        )
+    extra["elastic_reshard_recovery_sec"] = round(recovery_sec, 3)
+    extra["elastic_reshard_replan_sec"] = round(replan_sec, 3)
+    extra["elastic_reshard_restart_sec"] = round(restart_sec, 3)
+    extra["elastic_reshard_speedup_vs_restart"] = round(
+        restart_sec / recovery_sec, 2
+    )
+    extra["elastic_reshard_blocks_moved"] = int(moved)
+    extra["elastic_reshard_blocks_total"] = int(total)
+    extra["elastic_reshard_bitwise_equal"] = True
+    extra["elastic_reshard_config"] = {
+        k: fresh[0][k] for k in ("rows", "entities")
+    }
+    _log(
+        f"elastic re-shard: lost 1/3 virtual owners mid-epoch, re-planned "
+        f"+ resumed in {recovery_sec:.2f}s (re-plan {replan_sec:.2f}s, "
+        f"{moved}/{total} blocks moved) vs {restart_sec:.2f}s full restart "
+        f"({restart_sec / recovery_sec:.1f}x), digest BITWISE-equal to the "
+        "fresh survivor-topology run"
+    )
+
+
 def _bench_streaming(extra, on_tpu):
     """Out-of-core fixed-effect solve (optim/streaming.py, VERDICT r3 #5):
     rows/sec through one chunk-streamed value+grad pass (mmap'd per-stream .npy chunks,
@@ -2973,7 +3368,8 @@ SECTION_ORDER = (
     "dense", "sparse", "sparse_race", "game", "game5", "grid",
     "streaming", "streaming_pipeline", "compile_reuse", "compaction",
     "preemption_resume",
-    "perhost", "perhost_streaming", "scoring", "serving", "serving_fleet",
+    "perhost", "perhost_streaming", "elastic_reshard", "scoring", "serving",
+    "serving_fleet",
     "quantized_serving",
     "retrain_delta",
     "ingest",
@@ -2989,6 +3385,9 @@ SECTION_DEADLINES = {"dense": 3600, "game": 3600, "game5": 2400, "grid": 2400,
                      # legitimately slow run is detached even though every
                      # worker honored its fence
                      "perhost_streaming": 10500,
+                     # fresh-survivor + elastic 2-process cohorts, each
+                     # subprocess-fenced (1500 + 1800) — deadline > sum
+                     "elastic_reshard": 3600,
                      # 3 fleets (1/2/4 replicas) of warmed subprocess
                      # replicas + the kill arm, each spawn fenced at 240s
                      "serving_fleet": 3600,
@@ -3124,6 +3523,8 @@ def _run_sections(names, extra, errors, on_tpu, state=None, after=None):
                 _bench_perhost(extra, on_tpu)
             elif name == "perhost_streaming":
                 _bench_perhost_streaming(extra, on_tpu)
+            elif name == "elastic_reshard":
+                _bench_elastic_reshard(extra, on_tpu)
             elif name == "scoring":
                 _bench_scoring(extra, on_tpu)
             elif name == "serving":
@@ -3291,6 +3692,11 @@ def main():
         # SPMD child of the perhost_streaming section (one process per
         # simulated host); same plain-return rule as --section
         _perhost_worker_main(sys.argv)
+        return
+    if "--elastic-worker" in sys.argv:
+        # SPMD child of the elastic_reshard section (fresh-survivor and
+        # mid-epoch-re-plan arms); same plain-return rule as --section
+        _elastic_worker_main(sys.argv)
         return
 
     errors = {}

@@ -41,6 +41,18 @@ from photon_ml_tpu.types import OptimizerType, real_dtype
 Array = jax.Array
 
 
+def _elastic_entry_drain(monitor, where: str) -> None:
+    """Fixed-effect drain hook: both FE coordinates poll the elastic
+    monitor only at whole-evaluation entries (parallel/elastic.py — the
+    streamed evaluations may contain collectives, so mid-evaluation drains
+    could strand a peer inside one)."""
+    if monitor is None:
+        return
+    from photon_ml_tpu.parallel.elastic import drain_if_replan_pending
+
+    drain_if_replan_pending(monitor, where=where)
+
+
 def _streamed_update(problem: GLMOptimizationProblem, vg, hvp, l1_weight,
                      init_coefficients: Array) -> Tuple[Array, OptResult]:
     """THE streamed-update dispatch (bounds construction, TRON-vs-LBFGS
@@ -89,6 +101,13 @@ class StreamingFixedEffectCoordinate:
     # ladder / prefetch policies above when unset — a plan already
     # consumed the env vars, so unset fields do not re-resolve them
     plan: Optional[object] = None
+    # elastic re-sharding monitor (parallel/elastic.ElasticMonitor): polled
+    # at update/score ENTRY only — the streamed optimizer evaluations may
+    # contain collectives (the per-host variant's chunk merges), so the
+    # safe fixed-effect drain boundaries are between whole evaluations; a
+    # re-planned update simply re-runs, which is bitwise (the update is a
+    # pure function of (residuals, w0)). None = off.
+    elastic: Optional[object] = None
 
     # streams per evaluation: CoordinateDescent must not wrap update/score
     # in an outer jit (same contract as the multihost coordinates)
@@ -168,6 +187,7 @@ class StreamingFixedEffectCoordinate:
 
     def update(self, residual_offsets: Array, init_coefficients: Array
                ) -> Tuple[Array, OptResult]:
+        _elastic_entry_drain(self.elastic, "streaming-FE update entry")
         # swap the live source's loaders to the residual view; the jitted
         # chunk kernel built once in __post_init__ is reused across updates
         self._live_source.loaders = self._residual_source(
@@ -183,6 +203,7 @@ class StreamingFixedEffectCoordinate:
         margin contributions, FixedEffectModel.scala:91-100)."""
         from photon_ml_tpu.optim.streaming import pipelined_device_chunks
 
+        _elastic_entry_drain(self.elastic, "streaming-FE score entry")
         outs = []
         # canonicalized chunks carry weight-0 pad rows: slice each chunk's
         # margins back to its real row count so the (N,) layout is unchanged
@@ -234,6 +255,11 @@ class PerHostStreamingFixedEffectCoordinate:
     # resolved execution plan (photon_ml_tpu.compile.plan): fills ladder /
     # prefetch when unset (authoritative — no env re-resolution under it)
     plan: Optional[object] = None
+    # elastic drain hook, polled ONLY at update/score entry (the chunk
+    # merges inside an evaluation are collectives — see the single-host
+    # coordinate's note); FE chunk ownership itself is per PHYSICAL
+    # process, so a virtual-owner re-plan never moves chunks
+    elastic: Optional[object] = None
 
     # streams + reduces per evaluation: CoordinateDescent must call it raw
     cd_jit = False
@@ -313,6 +339,7 @@ class PerHostStreamingFixedEffectCoordinate:
 
     def update(self, residual_offsets: Array, init_coefficients: Array
                ) -> Tuple[Array, OptResult]:
+        _elastic_entry_drain(self.elastic, "perhost-FE update entry")
         self._live_source.loaders = self._residual_loaders(residual_offsets)
         return _streamed_update(
             self.problem, self._vg, self._hvp, self._l1, init_coefficients
@@ -326,6 +353,7 @@ class PerHostStreamingFixedEffectCoordinate:
         from photon_ml_tpu.optim.streaming import pipelined_device_chunks
         from photon_ml_tpu.parallel.perhost_streaming import merge_disjoint
 
+        _elastic_entry_drain(self.elastic, "perhost-FE score entry")
         self._live_source.loaders = [
             self.owned_loaders[c] for c in self._owned_ids
         ]

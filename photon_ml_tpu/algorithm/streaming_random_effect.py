@@ -466,15 +466,22 @@ class SpilledREState:
     dir: str
     shapes: List[Tuple[int, int]]
 
+    def _path(self, i: int) -> str:
+        """Block i's spill file. The per-host subclass
+        (parallel/perhost_streaming.PerHostSpilledREState) names files by
+        GLOBAL block id instead, so an elastic re-plan moves a block's
+        coefficients as one file copy."""
+        return os.path.join(self.dir, f"coefs-{i:05d}.npy")
+
     def block(self, i: int) -> np.ndarray:
-        path = os.path.join(self.dir, f"coefs-{i:05d}.npy")
+        path = self._path(i)
         if not os.path.exists(path):
             return np.zeros(self.shapes[i], real_dtype())
         return np.load(path)
 
     def write(self, i: int, arr: np.ndarray) -> None:
         os.makedirs(self.dir, exist_ok=True)
-        path = os.path.join(self.dir, f"coefs-{i:05d}.npy")
+        path = self._path(i)
         with open(path + ".tmp", "wb") as f:
             np.save(f, np.asarray(arr))
         os.replace(path + ".tmp", path)
@@ -638,6 +645,20 @@ class StreamingRandomEffectCoordinate:
     # guarantees the incoming state holds the prior model's coefficients
     # for these blocks (retrain.warm.seed_spilled_state).
     frozen_blocks: Optional[frozenset] = None
+    # elastic re-sharding monitor (parallel/elastic.ElasticMonitor, or any
+    # object with poll() -> Optional[proposal]): polled at the SAME safe
+    # boundaries as the preemption flag — update entry, every block
+    # boundary, score entry. A pending membership proposal unwinds with
+    # ReplanRequired (a Preempted subclass, so CD's emergency-checkpoint
+    # machinery runs) carrying the per-block progress. None = off.
+    elastic: Optional[object] = None
+    # epoch numbering floor for a coordinate REBUILT mid-run (an elastic
+    # re-plan rebinds the coordinate onto the re-based manifest): fresh
+    # epochs continue ABOVE the interrupted run's numbering so new spill
+    # dirs never collide with ones the checkpointed state still references
+    # (update()'s GC additionally never removes its own input dir). 0 = a
+    # fresh run, the pre-elastic numbering.
+    initial_epoch: int = 0
 
     # streams per evaluation — CoordinateDescent must call update/score raw
     cd_jit = False
@@ -671,7 +692,9 @@ class StreamingRandomEffectCoordinate:
             self.state_root = os.path.join(
                 base, f"state-{os.getpid()}-{_instance_seq}"
             )
-        self._epoch = 0
+        self._epoch = int(self.initial_epoch)
+        self._last_input_state_dir: Optional[str] = None
+        self._last_output_state_dir: Optional[str] = None
         self._shapes = [
             (b["num_entities"], b["local_dim"]) for b in self.manifest.blocks
         ]
@@ -717,15 +740,43 @@ class StreamingRandomEffectCoordinate:
             return local_resid
         return jnp.pad(local_resid, (0, n_pad - local_resid.shape[0]))
 
+    def _make_state(self, dir_path: str) -> SpilledREState:
+        """State-object factory — the per-host coordinate overrides it to
+        spill files keyed by GLOBAL block id (elastic re-plan transfers)."""
+        return SpilledREState(dir=dir_path, shapes=self._shapes)
+
+    def replan_state_dirs(self) -> List[str]:
+        """The spill dirs an elastic re-plan must re-base
+        (parallel/elastic.py): the INPUT of the last/in-flight update —
+        the w0 source every checkpoint written BEFORE that update
+        references — plus, when it exists, the last completed update's
+        OUTPUT, which a boundary checkpoint taken AFTER the update (a
+        drain at a fixed-effect boundary restores from it) references
+        instead. A moved block's coefficients are copied into both, so
+        the restore is correct no matter which safe boundary drained."""
+        dirs: List[str] = []
+        for d in (self._last_input_state_dir, self._last_output_state_dir):
+            if d is not None and d not in dirs:
+                dirs.append(d)
+        return dirs
+
+    def _elastic_drain(self, partial=None, where: str = "") -> None:
+        """Poll the elastic monitor (local, throttled); a pending
+        membership proposal unwinds with ReplanRequired. ``partial`` may be
+        a zero-arg callable built only when a drain actually fires."""
+        if self.elastic is None:
+            return
+        from photon_ml_tpu.parallel.elastic import drain_if_replan_pending
+
+        drain_if_replan_pending(self.elastic, partial=partial, where=where)
+
     # -- coordinate protocol ------------------------------------------------
     @property
     def num_entities(self) -> int:
         return self.manifest.num_entities
 
     def initial_coefficients(self) -> SpilledREState:
-        return SpilledREState(
-            dir=os.path.join(self.state_root, "init"), shapes=self._shapes
-        )
+        return self._make_state(os.path.join(self.state_root, "init"))
 
     def _sub_for(self, ds: RandomEffectDataset,
                  block: Optional[int] = None,
@@ -774,27 +825,47 @@ class StreamingRandomEffectCoordinate:
         self._sparse_slabs[i] = slab
         return slab
 
-    def _partial_payload(self, new_state: SpilledREState, blocks_done: int,
+    def _partial_payload(self, new_state: SpilledREState, done_blocks,
                          inner: Optional[dict] = None) -> dict:
         """Preemption ``partial`` payload: per-block progress (the finished
         blocks' coefficients are ALREADY durable in the epoch dir) plus, for
         a mid-chunk interruption, the in-flight block's scheduler snapshot
-        nested with prefixed array keys. ``blocks_done`` counts ACTIVE
-        (non-frozen) blocks — with no frozen set that is exactly the block
-        index, the pre-delta semantics; the frozen set itself is not
-        persisted because the relaunched driver re-derives the identical
-        delta plan from the same durable inputs."""
+        nested with prefixed array keys. ``done_blocks`` lists the LOCAL
+        indices of the ACTIVE (non-frozen) blocks finished this epoch;
+        ``blocks_done`` (its count) is kept for older payloads, whose
+        prefix-of-the-active-order semantics :meth:`_resume_done_locals`
+        still honors. The frozen set itself is not persisted because the
+        relaunched driver re-derives the identical delta plan from the same
+        durable inputs."""
+        done = sorted(int(i) for i in done_blocks)
         meta = {
             "kind": "streaming_re",
             "epoch": self._epoch,
             "epoch_dir": new_state.dir,
-            "blocks_done": blocks_done,
+            "blocks_done": len(done),
+            "done_blocks": done,
             "inner": inner["meta"] if inner is not None else None,
         }
         arrays = {}
         if inner is not None:
             arrays = {f"inner.{k}": v for k, v in inner["arrays"].items()}
         return {"meta": meta, "arrays": arrays}
+
+    def _resume_done_locals(self, m: dict, active) -> set:
+        """The LOCAL indices already solved this epoch, from a resume
+        payload. Explicit ``done_blocks`` wins (an elastic re-plan leaves
+        arbitrary done SETS, not prefixes — the per-host subclass maps them
+        through global block ids); older payloads carry only the prefix
+        count."""
+        if m.get("done_blocks") is not None:
+            return {int(i) for i in m["done_blocks"]}
+        return set(active[: int(m["blocks_done"])])
+
+    def _resume_inner_ok(self, m: dict) -> bool:
+        """Whether the nested mid-chunk scheduler snapshot may resume (the
+        per-host subclass drops it across a plan-version change: re-solving
+        that block whole is bitwise-equal, PR 4/5 pinned)."""
+        return True
 
     def update(
         self, residual_offsets: Array, state: SpilledREState,
@@ -819,6 +890,12 @@ class StreamingRandomEffectCoordinate:
         coefficients are bitwise those of an uninterrupted update."""
         import shutil
 
+        # the exact spill the incoming (checkpointed) parameters reference:
+        # an elastic re-plan copies moved blocks' coefficient files into it,
+        # so the session needs its path (parallel/elastic.py)
+        self._last_input_state_dir = getattr(state, "dir", None)
+        n_blocks = len(self.manifest.blocks)
+        active = [i for i in range(n_blocks) if i not in self.frozen_blocks]
         inner_resume = None
         if resume is not None:
             m = resume["meta"]
@@ -828,12 +905,12 @@ class StreamingRandomEffectCoordinate:
                     "streaming-RE progress snapshot"
                 )
             # continue the interrupted epoch IN PLACE: its dir already holds
-            # blocks 0..blocks_done-1 (each spilled atomically); no GC here —
-            # the previous epoch must survive as this update's input
+            # the done blocks (each spilled atomically); no GC here — the
+            # previous epoch must survive as this update's input
             self._epoch = int(m["epoch"])
-            new_state = SpilledREState(dir=m["epoch_dir"], shapes=self._shapes)
-            start_block = int(m["blocks_done"])
-            if m.get("inner") is not None:
+            new_state = self._make_state(m["epoch_dir"])
+            done_locals = set(self._resume_done_locals(m, active))
+            if m.get("inner") is not None and self._resume_inner_ok(m):
                 inner_resume = {
                     "meta": m["inner"],
                     "arrays": {
@@ -843,36 +920,43 @@ class StreamingRandomEffectCoordinate:
                     },
                 }
         else:
+            # a proposal already pending means the whole update re-runs
+            # after the re-plan — drain BEFORE any work (and before the
+            # epoch advances)
+            self._elastic_drain(where="streaming-RE update entry")
             self._epoch += 1
             for old in range(1, self._epoch - 1):
-                shutil.rmtree(
-                    os.path.join(self.state_root, f"epoch-{old}"),
-                    ignore_errors=True,
-                )
-            new_state = SpilledREState(
-                dir=os.path.join(self.state_root, f"epoch-{self._epoch}"),
-                shapes=self._shapes,
+                old_dir = os.path.join(self.state_root, f"epoch-{old}")
+                if (getattr(state, "dir", None) is not None
+                        and os.path.abspath(old_dir)
+                        == os.path.abspath(state.dir)):
+                    # never GC the spill this update READS from — a
+                    # re-planned coordinate's epoch numbering jumps past
+                    # its input's (initial_epoch), putting it in GC range
+                    continue
+                shutil.rmtree(old_dir, ignore_errors=True)
+            new_state = self._make_state(
+                os.path.join(self.state_root, f"epoch-{self._epoch}")
             )
-            start_block = 0
+            done_locals = set()
         resid_host = None
-        n_blocks = len(self.manifest.blocks)
         # frozen (delta-unchanged) blocks never solve: their coefficients
         # carry forward bitwise from the warm-seeded incoming state — an
         # atomic per-block copy, no slab read, no solver iterations
         for i in sorted(self.frozen_blocks):
             new_state.write(i, state.block(i))
-        active = [i for i in range(n_blocks) if i not in self.frozen_blocks]
         # finished blocks were solved and spilled before the interruption
         # (and frozen blocks never solve); tracker summaries are telemetry
         # and are not recomputed — None placeholders, one slot per block
         summaries: List[Optional[object]] = [None] * n_blocks
+        pending = [i for i in active if i not in done_locals]
         # pipelined block loop: block k+1 reads from disk + transfers H2D
         # on the background stage while block k's vmapped solve runs —
-        # resume starts the pipeline AT the first unfinished active block
+        # resume streams ONLY the unfinished blocks (a re-plan leaves done
+        # SETS, not prefixes, so the pending list is explicit)
         for k, (i, ds, row_sel, _) in enumerate(self.manifest.iter_blocks(
-            self.prefetch_depth, indices=active[start_block:]
+            self.prefetch_depth, indices=pending
         )):
-            done = start_block + k  # active blocks completed before this one
             if isinstance(residual_offsets, jax.Array):
                 local_resid = residual_offsets[jnp.asarray(row_sel)]
             else:
@@ -897,7 +981,7 @@ class StreamingRandomEffectCoordinate:
                     raise _preemption.Preempted(
                         str(e), site=e.site,
                         partial=self._partial_payload(
-                            new_state, done, e.partial
+                            new_state, done_locals, e.partial
                         ),
                     ) from e
             else:
@@ -909,19 +993,32 @@ class StreamingRandomEffectCoordinate:
             # as device arrays would pin every block's buffers alive
             summaries[i] = jax.tree.map(np.asarray, res)
             del ds, coefs, res
-            if done + 1 < len(active) and _preemption.check(
-                "block", block=i, epoch=self._epoch
-            ):
-                raise _preemption.Preempted(
-                    f"preempted at block boundary ({done + 1}/"
-                    f"{len(active)} active blocks, epoch {self._epoch}): "
-                    f"{_preemption.reason()}",
-                    site="block",
-                    partial=self._partial_payload(new_state, done + 1),
+            done_locals.add(i)
+            if len(done_locals) < len(active):
+                if _preemption.check("block", block=i, epoch=self._epoch):
+                    raise _preemption.Preempted(
+                        f"preempted at block boundary ({len(done_locals)}/"
+                        f"{len(active)} active blocks, epoch {self._epoch}):"
+                        f" {_preemption.reason()}",
+                        site="block",
+                        partial=self._partial_payload(new_state, done_locals),
+                    )
+                # elastic drain at the SAME boundary: the partial payload is
+                # built only if a proposal is actually pending
+                self._elastic_drain(
+                    partial=lambda: self._partial_payload(
+                        new_state, done_locals
+                    ),
+                    where=f"block boundary (epoch {self._epoch})",
                 )
+        self._last_output_state_dir = new_state.dir
         return new_state, tuple(summaries)
 
     def score(self, state: SpilledREState) -> Array:
+        # drain BEFORE the streaming pass (and, in the per-host subclass,
+        # before its merge collective): hosts that finished their update
+        # without hitting a block-boundary poll converge here
+        self._elastic_drain(where="streaming-RE score entry")
         total = np.zeros(self.manifest.num_rows, real_dtype())
         # frozen blocks: coefficients and rows are epoch-invariant, so the
         # first pass's scores serve every later call without touching disk

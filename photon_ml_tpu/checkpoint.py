@@ -37,6 +37,17 @@ Preemption extensions (resilience/preemption.py):
     (:meth:`~photon_ml_tpu.parallel.multihost.MultihostContext.
     agree_restore_step`) so no host resumes a step another host failed to
     commit.
+  * Restore is PLAN-VERSIONED for elastic re-sharding (parallel/
+    elastic.py): the per-host spilled-state reference
+    (:class:`~photon_ml_tpu.parallel.perhost_streaming.
+    PerHostSpilledREState`) records per-GLOBAL-block-id shapes and which
+    blocks had written coefficients, so a checkpoint written under entity-
+    shard plan v1 restores under plan v2 — the rebuild validates every
+    still-owned block per global id (and the presence of every recorded
+    coefficient file after the re-base transfer) instead of demanding the
+    old positional shape list. The mid-coordinate ``partial`` payload is
+    keyed the same way (``done_global_ids``), so a mid-epoch drain resumes
+    onto the re-planned owner map.
 """
 
 from __future__ import annotations

@@ -534,9 +534,11 @@ def _main_once(mh_args: dict, p, restart: bool = False) -> dict:
                     if p.re_memory_budget_mb is not None else None
                 )
                 cache = cache_key = None
+                block_cache = block_key_base = None
                 if p.tensor_cache_dir:
                     from photon_ml_tpu.io.tensor_cache import (
                         TensorCache,
+                        content_key,
                         process_shard_scope,
                     )
 
@@ -553,15 +555,25 @@ def _main_once(mh_args: dict, p, restart: bool = False) -> dict:
                     # here. The resolved ladder spec is part of the key —
                     # a --shape-canonicalization change alters the PADDED
                     # block tensors a hit would serve
-                    cache_key = cache.key_for(
-                        all_files,
-                        {"kind": "perhost_streaming_re_blocks",
-                         "coord": name, "config": str(dc),
-                         "budget": budget, "n_files": len(all_files),
-                         "ladder": (
-                             f"{bk.base}:{bk.growth:g}"
-                             if bk is not None else None
-                         )},
+                    key_config = {
+                        "kind": "perhost_streaming_re_blocks",
+                        "coord": name, "config": str(dc),
+                        "budget": budget, "n_files": len(all_files),
+                        "ladder": (
+                            f"{bk.base}:{bk.growth:g}"
+                            if bk is not None else None
+                        ),
+                    }
+                    cache_key = cache.key_for(all_files, key_config)
+                    # per-BLOCK entries keyed on owned-block IDENTITY with
+                    # NO process scope: a block's tensors are a pure
+                    # function of the global data + plan, so a membership/
+                    # topology change keeps every unmoved block's entry
+                    # warm — the old scoped dir key rebuilt the whole host
+                    # layout on ANY fleet change
+                    block_cache = TensorCache(p.tensor_cache_dir)
+                    block_key_base = content_key(
+                        all_files, dict(key_config, entry="block")
                     )
                 streaming_manifests[name] = build_perhost_streaming_manifest(
                     rows, dc,
@@ -577,6 +589,7 @@ def _main_once(mh_args: dict, p, restart: bool = False) -> dict:
                     # re-resolve the env underneath an explicit off
                     bucketer=plan.bucketer or "off",
                     tensor_cache=cache, cache_key=cache_key,
+                    block_cache=block_cache, block_key_base=block_key_base,
                 )
                 logger.info(
                     f"streaming RE {name}: host {mh.process_id} owns "

@@ -1794,6 +1794,9 @@ class GameTrainingDriver:
                     streaming_manifest_dir=(
                         os.path.abspath(sm.dir) if sm is not None else None
                     ),
+                    shard_plan_version=int(
+                        getattr(sm, "plan_version", 1) if sm is not None else 1
+                    ),
                 )
             manifest = RetrainManifest(
                 output_dir=os.path.abspath(p.output_dir),

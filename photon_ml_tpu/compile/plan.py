@@ -91,6 +91,11 @@ class ExecutionPlan:
     streaming: bool = False
     fused_cycle: bool = False
     num_processes: int = 1
+    # the entity-shard plan version this run executes under (elastic
+    # re-sharding, parallel/elastic.py): 1 for a fresh topology; every
+    # re-plan returns a successor via record_replan, so the audit trail
+    # names each membership change next to the policy decisions
+    shard_plan_version: int = 1
     decisions: Tuple[PlanDecision, ...] = ()
 
     @classmethod
@@ -205,6 +210,20 @@ class ExecutionPlan:
         )
 
     # ------------------------------------------------------------------
+    def record_replan(self, new_version: int, reason: str) -> "ExecutionPlan":
+        """A successor plan for an elastic re-shard: same policies, bumped
+        ``shard_plan_version``, and a recorded :class:`PlanDecision` — so
+        every membership change lands in the same audit trail drivers
+        already log (no silent topology drift)."""
+        return dataclasses.replace(
+            self,
+            shard_plan_version=int(new_version),
+            decisions=self.decisions + (PlanDecision(
+                "sharding", "replanned",
+                f"entity shard plan v{int(new_version)}: {reason}",
+            ),),
+        )
+
     def bucketed_subsumed(self) -> bool:
         """True when streaming subsumed --bucketed-random-effects (the
         driver then routes the coordinate through streaming and logs it)."""
@@ -219,7 +238,9 @@ class ExecutionPlan:
             f"ladder={self.bucketer.describe() if self.bucketer else 'off'}",
             (f"schedule={self.schedule.describe()}"
              if self.schedule is not None else "schedule=one-shot"),
-            f"sharding={self.sharding}",
+            (f"sharding={self.sharding}"
+             + (f"@plan-v{self.shard_plan_version}"
+                if self.shard_plan_version != 1 else "")),
             f"sparse={self.sparse_kernel or 'off'}",
             f"streaming={'on' if self.streaming else 'off'}",
         ]

@@ -19,7 +19,7 @@ from photon_ml_tpu.parallel.mesh import (
     pad_rows,
     pad_leading,
 )
-from photon_ml_tpu.parallel import multihost, shuffle
+from photon_ml_tpu.parallel import elastic, multihost, shuffle
 from photon_ml_tpu.parallel.distributed import (
     DistributedFactoredRandomEffectCoordinate,
     DistributedFixedEffectSolver,
@@ -38,6 +38,7 @@ from photon_ml_tpu.parallel.perhost_ingest import (
 )
 from photon_ml_tpu.parallel.perhost_streaming import (
     EntityShardPlan,
+    PerHostSpilledREState,
     PerHostStreamingManifest,
     PerHostStreamingRandomEffectCoordinate,
     build_perhost_streaming_manifest,
@@ -49,6 +50,7 @@ __all__ = [
     "data_mesh",
     "pad_rows",
     "pad_leading",
+    "elastic",
     "multihost",
     "shuffle",
     "DistributedFactoredRandomEffectCoordinate",
@@ -64,6 +66,7 @@ __all__ = [
     "local_shards",
     "per_host_re_dataset",
     "EntityShardPlan",
+    "PerHostSpilledREState",
     "PerHostStreamingManifest",
     "PerHostStreamingRandomEffectCoordinate",
     "build_perhost_streaming_manifest",

@@ -1,0 +1,1056 @@
+"""Elastic entity re-sharding: re-plan the fleet instead of restarting it.
+
+The per-host streaming path (parallel/perhost_streaming.py) treated fleet
+membership as fixed: a lost host meant supervised relaunch of the whole
+cohort from the agreed checkpoint, and capacity arriving mid-run was
+wasted. This module makes membership a versioned, re-plannable object:
+
+  1. **detect** — every owner host heartbeats into a shared fleet
+     directory; a beat older than the deadline (multihost.lost_hosts), an
+     operator-declared loss (``lost-hosts.json``), or an operator
+     scale-up request (``scale-request.json``) produces a membership
+     PROPOSAL (atomic first-writer-wins file);
+  2. **drain** — the streaming coordinates poll the monitor at their
+     existing safe boundaries (the ``block`` preemption drain of the
+     random-effect block loop; update/score entry for the fixed effect)
+     and unwind with :class:`ReplanRequired` — a
+     :class:`~photon_ml_tpu.resilience.preemption.Preempted` subclass, so
+     coordinate descent's emergency-checkpoint machinery makes the
+     completed work durable exactly as for a preemption;
+  3. **agree** — survivors meet at a file-based re-plan barrier (fault
+     site ``multihost.replan_barrier``; deadline-bounded — a barrier that
+     cannot complete falls back to the supervised-relaunch path with a
+     logged decision, never a hang), exchange per-host records, and every
+     survivor derives the IDENTICAL new plan
+     (shuffle.balanced_owners_over_hosts over the persisted block costs:
+     deterministic, no extra collective);
+  4. **delta-transfer** — ONLY the blocks whose physical owner changed
+     move, as file copies between host block dirs (block payload files
+     are durable and addressable; no Avro re-decode, no re-route of
+     unchanged blocks). A copy that stays broken after retries (fault
+     site ``io.block_transfer``) degrades to a per-block-cache fetch and
+     then to a RECORDED cold rebuild — never a wrong result (the rebuilt
+     meta must match the original byte accounting);
+  5. **re-base** — per-host manifests, owner maps, spilled coefficient
+     state (files named by GLOBAL block id, so a moved block's
+     coefficients are one more file copy), and the mid-epoch
+     ``done_blocks`` progress re-base onto the new plan version;
+  6. **resume** — the CD cycle continues, bitwise-equal to a fresh run on
+     the new topology (every block's solve is a pure deterministic
+     function of (block tensors, residuals, incoming coefficients), all
+     of which are topology-invariant — the PR 9 foundation).
+
+Synchronization honesty: drains are LOCAL observations of the shared
+proposal file. The random-effect update contains no collective, so every
+host converges to the barrier from any block boundary; regions that DO
+contain collectives (fixed-effect updates, score merges) are only entered
+after an entry poll. A proposal that lands between two hosts' entry polls
+of the same collective-bearing region leaves one host inside a collective
+while the other waits at the barrier — the barrier DEADLINE converts that
+race into the recorded supervised-relaunch fallback, never a wrong result
+and never an unbounded hang. Physical process death is the same story at
+full strength: the dead peer can never ack the barrier (and the Gloo
+collectives over the original process set are unusable anyway), so the
+cohort falls back to supervised relaunch — where the plan-versioned
+checkpoint restore re-plans at restore time instead of re-ingesting.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import logging
+import os
+import shutil
+import time
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from photon_ml_tpu.resilience import preemption as _preemption
+
+logger = logging.getLogger(__name__)
+
+__all__ = [
+    "ElasticError",
+    "ElasticMonitor",
+    "ElasticSession",
+    "FleetMembership",
+    "ReplanBarrierError",
+    "ReplanRequired",
+    "ReshardResult",
+    "commit_membership",
+    "pending_proposal",
+    "propose_membership",
+    "read_membership",
+    "request_scale_up",
+    "declare_lost_hosts",
+]
+
+MEMBERSHIP_FILE = "membership.json"
+PROPOSALS_DIR = "proposals"
+ACKS_DIR = "acks"
+HEARTBEATS_DIR = "heartbeats"
+LOST_HOSTS_FILE = "lost-hosts.json"
+SCALE_REQUEST_FILE = "scale-request.json"
+
+
+class ElasticError(RuntimeError):
+    """A re-shard step that cannot proceed safely (the caller's recovery
+    is the supervised-relaunch path)."""
+
+
+class ReplanBarrierError(ElasticError):
+    """The re-plan barrier did not complete within its deadline (or its
+    entry fault survived retries): the fleet could not agree the new plan
+    version. Deliberately NOT retried in place — the recovery path is the
+    existing supervised relaunch, recorded as a decision by the caller."""
+
+
+class ReplanRequired(_preemption.Preempted):
+    """Raised at a safe drain boundary once a membership-change proposal
+    is visible: a :class:`Preempted` subclass, so coordinate descent's
+    emergency-checkpoint handler makes the completed work durable before
+    unwinding to the caller, who runs :meth:`ElasticSession.replan` and
+    resumes."""
+
+    def __init__(self, message: str, site: str = "block",
+                 partial=None, proposal: Optional[dict] = None):
+        super().__init__(message, site=site, partial=partial)
+        self.proposal = proposal
+
+
+# ---------------------------------------------------------------------------
+# membership: the versioned fleet descriptor
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class FleetMembership:
+    """The versioned owner-host set of one training fleet.
+
+    ``hosts`` are LOGICAL owner ids — the unit of elasticity. ``binding``
+    maps each logical owner to the PHYSICAL process that runs its blocks;
+    in production the binding is the identity (one owner per process), in
+    the harness several virtual owners share a process so membership can
+    change without killing the Gloo collectives. The shard plan assigns
+    blocks to logical owners; everything physical (routing destinations,
+    block dirs, transfers) goes through the binding."""
+
+    version: int
+    hosts: List[int]
+    binding: Dict[int, int]
+
+    def __post_init__(self):
+        self.version = int(self.version)
+        self.hosts = sorted(int(h) for h in self.hosts)
+        self.binding = {int(k): int(v) for k, v in self.binding.items()}
+        missing = [h for h in self.hosts if h not in self.binding]
+        if missing:
+            raise ValueError(
+                f"membership v{self.version} hosts {missing} have no "
+                "physical binding"
+            )
+
+    @classmethod
+    def initial(cls, num_hosts: int) -> "FleetMembership":
+        """v1: one logical owner per physical process, identity binding —
+        exactly the pre-elastic owner model, so plans built under it are
+        byte-identical to the un-versioned ones."""
+        return cls(
+            version=1,
+            hosts=list(range(num_hosts)),
+            binding={h: h for h in range(num_hosts)},
+        )
+
+    def physical_of(self, host: int) -> int:
+        return self.binding[int(host)]
+
+    def physical_owners(self, owners: np.ndarray) -> np.ndarray:
+        """(B,) logical owner ids -> (B,) physical process ids."""
+        owners = np.asarray(owners, np.int64)
+        # size the lookup past BOTH the binding keys and the queried ids,
+        # so an owner above the largest bound host still lands on the
+        # diagnostic ValueError below, not a raw IndexError
+        hi = max(
+            max(self.binding, default=0),
+            int(owners.max()) if owners.size else 0,
+        )
+        table = np.full(hi + 1, -1, np.int32)
+        for h, p in self.binding.items():
+            table[h] = p
+        phys = table[owners]
+        if (phys < 0).any():
+            bad = sorted(set(owners[phys < 0].tolist()))
+            raise ValueError(
+                f"plan owners {bad} are not in membership v{self.version}"
+            )
+        return phys.astype(np.int32)
+
+    def my_hosts(self, process_id: int) -> List[int]:
+        return [h for h in self.hosts if self.binding[h] == int(process_id)]
+
+    def without(self, lost: Sequence[int]) -> "FleetMembership":
+        lost_set = {int(h) for h in lost}
+        survivors = [h for h in self.hosts if h not in lost_set]
+        if not survivors:
+            raise ElasticError(
+                f"membership v{self.version}: losing {sorted(lost_set)} "
+                "would leave no owners — nothing to re-plan onto"
+            )
+        return FleetMembership(
+            version=self.version + 1,
+            hosts=survivors,
+            binding={h: self.binding[h] for h in survivors},
+        )
+
+    def with_added(self, added: Dict[int, int]) -> "FleetMembership":
+        hosts = list(self.hosts)
+        binding = dict(self.binding)
+        for h, p in added.items():
+            if int(h) in binding:
+                raise ElasticError(
+                    f"membership v{self.version}: host {h} already present"
+                )
+            hosts.append(int(h))
+            binding[int(h)] = int(p)
+        return FleetMembership(
+            version=self.version + 1, hosts=hosts, binding=binding
+        )
+
+    def to_meta(self) -> dict:
+        return {
+            "version": self.version,
+            "hosts": list(self.hosts),
+            "binding": {str(h): p for h, p in self.binding.items()},
+        }
+
+    @classmethod
+    def from_meta(cls, meta: dict) -> "FleetMembership":
+        return cls(
+            version=int(meta["version"]),
+            hosts=[int(h) for h in meta["hosts"]],
+            binding={int(h): int(p) for h, p in meta["binding"].items()},
+        )
+
+
+# ---------------------------------------------------------------------------
+# fleet-dir coordination files
+# ---------------------------------------------------------------------------
+
+
+def _atomic_write_json(path: str, payload: dict) -> None:
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    tmp = f"{path}.tmp-{os.getpid()}"
+    with open(tmp, "w") as f:
+        json.dump(payload, f)
+    os.replace(tmp, path)
+
+
+def read_membership(fleet_dir: str) -> Optional[FleetMembership]:
+    """The committed membership, or None before the first commit. Fault
+    site ``multihost.membership`` (op=read), retried under the I/O policy."""
+    from photon_ml_tpu import resilience
+    from photon_ml_tpu.resilience import faults
+
+    path = os.path.join(fleet_dir, MEMBERSHIP_FILE)
+
+    def read_once() -> Optional[dict]:
+        faults.inject("multihost.membership", op="read", path=path)
+        if not os.path.exists(path):
+            return None
+        with open(path) as f:
+            return json.load(f)
+
+    meta = resilience.call_with_retry(
+        read_once, resilience.current_config().io_policy,
+        describe="membership read",
+    )
+    return FleetMembership.from_meta(meta) if meta is not None else None
+
+
+def commit_membership(fleet_dir: str, membership: FleetMembership) -> str:
+    """Atomically commit the agreed membership (fault site
+    ``multihost.membership``, op=commit, retried)."""
+    from photon_ml_tpu import resilience
+    from photon_ml_tpu.resilience import faults
+
+    path = os.path.join(fleet_dir, MEMBERSHIP_FILE)
+
+    def write_once() -> None:
+        faults.inject(
+            "multihost.membership", op="commit",
+            version=membership.version, path=path,
+        )
+        _atomic_write_json(path, membership.to_meta())
+
+    resilience.call_with_retry(
+        write_once, resilience.current_config().io_policy,
+        describe=f"membership v{membership.version} commit",
+    )
+    return path
+
+
+def _proposal_path(fleet_dir: str, version: int) -> str:
+    return os.path.join(fleet_dir, PROPOSALS_DIR, f"proposal-v{version}.json")
+
+
+def propose_membership(
+    fleet_dir: str, new: FleetMembership, reason: str
+) -> dict:
+    """Publish a membership proposal: atomic FIRST-writer-wins (hard link
+    of a private temp file), so two hosts detecting the same loss
+    concurrently agree on one proposal object — the loser reads the
+    winner's file back."""
+    path = _proposal_path(fleet_dir, new.version)
+    payload = dict(new.to_meta(), reason=reason, proposed_at=time.time())
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    tmp = f"{path}.tmp-{os.getpid()}"
+    with open(tmp, "w") as f:
+        json.dump(payload, f)
+    try:
+        os.link(tmp, path)
+    except FileExistsError:
+        pass  # a peer proposed first; its file is THE proposal
+    finally:
+        os.unlink(tmp)
+    with open(path) as f:
+        return json.load(f)
+
+
+def pending_proposal(
+    fleet_dir: str, current_version: int
+) -> Optional[dict]:
+    """The next-version proposal if one is published (cheap stat — this is
+    polled at every drain boundary)."""
+    path = _proposal_path(fleet_dir, current_version + 1)
+    if not os.path.exists(path):
+        return None
+    try:
+        with open(path) as f:
+            return json.load(f)
+    except (OSError, json.JSONDecodeError):
+        return None  # mid-publish; the next poll sees the complete file
+
+
+def declare_lost_hosts(fleet_dir: str, hosts: Sequence[int],
+                       reason: str = "operator-declared loss") -> None:
+    """Operator entry point: declare owners lost without waiting for the
+    heartbeat deadline (e.g. a cluster manager's reclamation notice). The
+    file is archived by the re-plan that removes every declared host, so
+    a later scale-up may re-add them without re-triggering the loss."""
+    _atomic_write_json(
+        os.path.join(fleet_dir, LOST_HOSTS_FILE),
+        {"hosts": [int(h) for h in hosts], "reason": reason},
+    )
+
+
+def request_scale_up(fleet_dir: str, added: Dict[int, int],
+                     reason: str = "operator scale-up") -> None:
+    """Operator entry point: request new owners ``{logical: physical}`` be
+    folded into the plan when the fleet next drains. The file is archived
+    by the re-plan that adds every requested host; a binding to a
+    physical process outside the live cohort is refused at re-plan time
+    (blocks bound there would be silently orphaned)."""
+    _atomic_write_json(
+        os.path.join(fleet_dir, SCALE_REQUEST_FILE),
+        {"add": {str(h): int(p) for h, p in added.items()},
+         "reason": reason},
+    )
+
+
+# ---------------------------------------------------------------------------
+# the monitor (detect + propose + drain)
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class ElasticMonitor:
+    """Polled at the streaming coordinates' safe boundaries: writes this
+    process's owner heartbeats, detects membership changes (peer heartbeat
+    past the deadline, operator-declared loss, scale-up request), publishes
+    the proposal, and reports any pending proposal so the caller can drain.
+
+    ``poll`` is LOCAL — no collective, so it is safe at boundaries hosts
+    reach different numbers of times (the module docstring's
+    synchronization argument)."""
+
+    fleet_dir: str
+    membership: FleetMembership
+    process_id: int = 0
+    # heartbeat-driven loss detection deadline (seconds); None disables it
+    # (operator files still work)
+    heartbeat_deadline: Optional[float] = None
+    min_poll_interval: float = 0.2
+    # live physical cohort size: scale-up requests binding owners outside
+    # [0, num_processes) are REJECTED at proposal time (publishing such a
+    # proposal would wedge the fleet — the session-side check could only
+    # refuse it forever). None skips the check (single-process tests).
+    num_processes: Optional[int] = None
+    clock: Callable[[], float] = time.time
+
+    def __post_init__(self):
+        os.makedirs(os.path.join(self.fleet_dir, HEARTBEATS_DIR),
+                    exist_ok=True)
+        self._silenced: set = set()
+        self._last_poll = -float("inf")
+        self._last_beat = -float("inf")
+        self._last_detect = -float("inf")
+        self._started = self.clock()
+        # every membership change restarts the detection grace window (see
+        # install_membership): a just-added owner must not be declared
+        # lost before its first post-re-plan beat, and a RE-added owner's
+        # stale pre-removal heartbeat file must not re-trigger the loss
+        self._membership_since = self._started
+
+    def install_membership(self, membership: FleetMembership) -> None:
+        """Adopt a newly agreed membership AND restart the loss-detection
+        grace window — the membership change counts as an implicit fresh
+        beat for every owner (each gets one full deadline to beat under
+        the new plan before it can be declared lost)."""
+        self.membership = membership
+        self._membership_since = self.clock()
+
+    # -- harness / graceful-retirement hook --------------------------------
+    def silence_host(self, host: int) -> None:
+        """Stop heartbeating for one of MY logical owners — how a virtual
+        owner 'dies' (spot reclamation of its capacity) without killing
+        the physical process. Peers detect it through the deadline."""
+        self._silenced.add(int(host))
+
+    def my_hosts(self) -> List[int]:
+        return self.membership.my_hosts(self.process_id)
+
+    def beat(self, step: Optional[int] = None) -> None:
+        from photon_ml_tpu.parallel import multihost
+
+        for h in self.my_hosts():
+            if h not in self._silenced:
+                multihost.write_host_heartbeat(
+                    os.path.join(self.fleet_dir, HEARTBEATS_DIR), h,
+                    step=step,
+                )
+
+    # -- detection ----------------------------------------------------------
+    def _detect_lost(self, now: float) -> Tuple[List[int], str]:
+        lost: List[int] = []
+        reason = ""
+        path = os.path.join(self.fleet_dir, LOST_HOSTS_FILE)
+        if os.path.exists(path):
+            try:
+                with open(path) as f:
+                    declared = json.load(f)
+                declared_hosts = [
+                    int(h) for h in declared.get("hosts", [])
+                    if int(h) in self.membership.hosts
+                ]
+                if declared_hosts:
+                    lost.extend(declared_hosts)
+                    reason = declared.get("reason", "operator-declared loss")
+            except (OSError, json.JSONDecodeError):
+                pass
+        if self.heartbeat_deadline is not None and (
+            now - self._last_detect >= self.heartbeat_deadline / 5.0
+        ):
+            # the ages scan parses every heartbeat file — O(fleet) small
+            # reads on (possibly shared/remote) storage — so it runs on a
+            # deadline-proportional throttle, NOT at every drain poll; the
+            # operator-file checks above stay per-poll (two cheap stats)
+            self._last_detect = now
+            from photon_ml_tpu.parallel import multihost
+
+            ages = multihost.read_heartbeat_ages(
+                os.path.join(self.fleet_dir, HEARTBEATS_DIR)
+            )
+            # the membership change is an implicit beat: cap every age at
+            # the time since the current membership was adopted, so a
+            # re-added owner's STALE pre-removal heartbeat file cannot
+            # re-trigger the loss before it gets a chance to beat
+            since_change = now - self._membership_since
+            ages = {h: min(a, since_change) for h, a in ages.items()}
+            # my own live owners are alive by construction; my SILENCED
+            # owners are judged by their (stale) beats like any peer's
+            candidates = [
+                h for h in self.membership.hosts
+                if not (h in self.my_hosts() and h not in self._silenced)
+            ]
+            stale = multihost.lost_hosts(
+                ages, candidates, self.heartbeat_deadline,
+                missing_grace_elapsed=since_change,
+            )
+            stale = [h for h in stale if h not in lost]
+            if stale:
+                lost.extend(stale)
+                reason = (reason + "; " if reason else "") + (
+                    f"heartbeat past {self.heartbeat_deadline:g}s deadline"
+                )
+        return lost, reason
+
+    def _detect_scale_up(self) -> Optional[Tuple[Dict[int, int], str]]:
+        path = os.path.join(self.fleet_dir, SCALE_REQUEST_FILE)
+        if not os.path.exists(path):
+            return None
+        try:
+            with open(path) as f:
+                req = json.load(f)
+        except (OSError, json.JSONDecodeError):
+            return None
+        added = {
+            int(h): int(p) for h, p in (req.get("add") or {}).items()
+            if int(h) not in self.membership.hosts
+        }
+        if self.num_processes is not None:
+            bad = {h: p for h, p in added.items()
+                   if not 0 <= p < self.num_processes}
+            if bad:
+                # validate BEFORE publishing: proposals are first-writer-
+                # wins and never retracted, so a bad binding must never
+                # become one (it would wedge every later re-plan attempt)
+                logger.warning(
+                    "ignoring scale-up request binding owners %s outside "
+                    "the live cohort [0, %d) — fix scale-request.json",
+                    sorted(bad), self.num_processes,
+                )
+                added = {h: p for h, p in added.items() if h not in bad}
+        if not added:
+            return None  # already folded in (or empty/invalid request)
+        return added, req.get("reason", "operator scale-up")
+
+    # -- the poll ------------------------------------------------------------
+    def poll(self, step: Optional[int] = None,
+             force: bool = False) -> Optional[dict]:
+        """One throttled monitor pass; returns the pending membership
+        proposal (this poll's or a peer's) or None."""
+        now = self.clock()
+        if not force and now - self._last_poll < self.min_poll_interval:
+            return None
+        self._last_poll = now
+        # beats only need to land well inside the deadline — not at every
+        # drain poll (each beat is one atomic write per owned owner)
+        beat_every = (self.heartbeat_deadline / 3.0
+                      if self.heartbeat_deadline else 1.0)
+        if force or now - self._last_beat >= beat_every:
+            self._last_beat = now
+            self.beat(step=step)
+        prop = pending_proposal(self.fleet_dir, self.membership.version)
+        if prop is not None:
+            return prop
+        lost, reason = self._detect_lost(now)
+        if lost:
+            try:
+                survivors = self.membership.without(lost)
+            except ElasticError as e:
+                # a declaration naming EVERY owner is not a re-plannable
+                # event — ignore it here (with the why) rather than let a
+                # non-Preempted error crash past the drain machinery; the
+                # operator's real tool for decommission is plain shutdown
+                logger.warning(
+                    "ignoring degenerate loss declaration %s: %s",
+                    sorted(set(lost)), e,
+                )
+                return None
+            return propose_membership(
+                self.fleet_dir, survivors,
+                reason=f"lost owners {sorted(set(lost))}: {reason}",
+            )
+        scale = self._detect_scale_up()
+        if scale is not None:
+            added, reason = scale
+            return propose_membership(
+                self.fleet_dir, self.membership.with_added(added),
+                reason=f"scale-up owners {sorted(added)}: {reason}",
+            )
+        return None
+
+
+# ---------------------------------------------------------------------------
+# the re-plan session (agree -> delta-transfer -> re-base)
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class ReshardResult:
+    """What one host's re-plan produced."""
+
+    membership: FleetMembership
+    plan_version: int
+    manifest: object  # the re-based PerHostStreamingManifest
+    moved: List[Tuple[int, int, int]]  # (gid, old physical, new physical)
+    incoming: List[int]  # gids copied/rebuilt onto THIS host
+    rebuilt: List[int]  # incoming gids that degraded to a cold rebuild
+    blocks_total: int
+    epoch: int  # the (possibly mid-flight) epoch the drain interrupted
+    decisions: List[str] = dataclasses.field(default_factory=list)
+
+    @property
+    def blocks_moved(self) -> int:
+        return len(self.moved)
+
+
+def _copy_with_transfer_site(src: str, dst: str, gid: int, what: str) -> None:
+    """One retried file copy under the ``io.block_transfer`` fault site
+    (tmp + atomic rename, so a torn copy is never addressable)."""
+    from photon_ml_tpu import resilience
+    from photon_ml_tpu.resilience import faults
+
+    def copy_once() -> None:
+        faults.inject("io.block_transfer", block=int(gid), what=what,
+                      src=src, dst=dst)
+        os.makedirs(os.path.dirname(dst), exist_ok=True)
+        tmp = f"{dst}.tmp-{os.getpid()}"
+        shutil.copyfile(src, tmp)
+        os.replace(tmp, dst)
+
+    resilience.call_with_retry(
+        copy_once, resilience.current_config().io_policy,
+        describe=f"{what} transfer (block {gid})",
+    )
+
+
+@dataclasses.dataclass
+class ElasticSession:
+    """One physical process's handle on the elastic protocol.
+
+    ``num_processes`` is the PHYSICAL cohort that must ack the re-plan
+    barrier — virtual-owner elasticity keeps it constant; a dead physical
+    process can never ack, which is exactly how the barrier deadline
+    routes real process death to the supervised-relaunch fallback."""
+
+    fleet_dir: str
+    process_id: int
+    num_processes: int
+    monitor: ElasticMonitor
+    barrier_timeout: float = 60.0
+    # optional per-block tensor cache (UNSCOPED: block content is
+    # topology-invariant) consulted when a direct peer copy stays broken
+    block_cache: Optional[object] = None
+    block_key_base: Optional[str] = None
+
+    def __post_init__(self):
+        self._pending: Optional[dict] = None
+
+    # -- phase 1: publish my record -----------------------------------------
+    def replan_prepare(
+        self,
+        manifest,
+        proposal: dict,
+        *,
+        state_dir=None,
+        epoch: int = 0,
+        rebuild_block: Optional[Callable[[int], dict]] = None,
+    ) -> None:
+        """Write this host's re-plan record (its block dir, durable state
+        location, and per-block metadata) for the proposed version. Split
+        from :meth:`replan_finish` so single-process tests can drive a
+        whole simulated fleet through the protocol."""
+        from photon_ml_tpu.parallel.perhost_streaming import EntityShardPlan
+
+        new_mem = FleetMembership.from_meta(proposal)
+        bad_phys = sorted({
+            p for p in new_mem.binding.values()
+            if not 0 <= p < self.num_processes
+        })
+        if bad_phys:
+            # an owner bound outside the live cohort would leave its blocks
+            # with NO hosting process: nobody copies them, every survivor's
+            # manifest excludes them, and training would silently drop
+            # those entities — refuse before any record is published
+            raise ElasticError(
+                f"proposal v{new_mem.version} binds owners to physical "
+                f"processes {bad_phys} outside the live cohort "
+                f"[0, {self.num_processes}) — blocks bound there would be "
+                "silently orphaned; fix the scale request's binding"
+            )
+        cur = self.monitor.membership
+        if new_mem.version != cur.version + 1:
+            raise ElasticError(
+                f"proposal v{new_mem.version} does not follow membership "
+                f"v{cur.version} — a missed re-plan needs the supervised-"
+                "relaunch path (restore re-plans from the checkpoint)"
+            )
+        old_plan = EntityShardPlan.from_sidecars(manifest.dir)
+        if old_plan is None:
+            raise ElasticError(
+                f"{manifest.dir} has no plan sidecar — manifests built "
+                "before plan versioning cannot re-plan in flight"
+            )
+        if old_plan.version != cur.version:
+            raise ElasticError(
+                f"plan sidecar v{old_plan.version} does not match "
+                f"membership v{cur.version}"
+            )
+        owned = [int(g) for g in manifest.global_block_ids]
+        # one entry per live spill dir (the coordinate's
+        # replan_state_dirs(): the last update's INPUT plus — when a
+        # later boundary checkpoint references it — its OUTPUT), matched
+        # ACROSS hosts by dir basename (epoch-N / init): CD steps are
+        # lockstep, so corresponding dirs carry corresponding epochs
+        if state_dir is None:
+            state_dirs: List[str] = []
+        elif isinstance(state_dir, (str, os.PathLike)):
+            state_dirs = [os.fspath(state_dir)]
+        else:
+            state_dirs = [os.fspath(d) for d in state_dir]
+        state_entries = []
+        for d in state_dirs:
+            gids = []
+            if os.path.isdir(d):
+                gids = [
+                    g for g in owned
+                    if os.path.exists(
+                        os.path.join(d, f"coefs-g{g:05d}.npy")
+                    )
+                ]
+            state_entries.append({
+                "name": os.path.basename(os.path.abspath(d)),
+                "dir": os.path.abspath(d),
+                "gids": [int(g) for g in gids],
+            })
+        record = {
+            "process": int(self.process_id),
+            "block_dir": os.path.abspath(manifest.dir),
+            "state_dirs": state_entries,
+            "epoch": int(epoch),
+            "owned_old": owned,
+            "blocks_meta": {
+                str(g): m for g, m in zip(owned, manifest.blocks)
+            },
+        }
+        _atomic_write_json(self._ack_path(new_mem.version, "json"), record)
+        self._pending = {
+            "proposal": proposal,
+            "new_mem": new_mem,
+            "manifest": manifest,
+            "old_plan": old_plan,
+            "record": record,
+            "epoch": int(epoch),
+            "state_dirs": state_dirs,
+            "rebuild_block": rebuild_block,
+        }
+
+    def _ack_path(self, version: int, kind: str, process: Optional[int] = None
+                  ) -> str:
+        p = self.process_id if process is None else process
+        return os.path.join(
+            self.fleet_dir, ACKS_DIR, f"v{version}",
+            f"host-{p}.{kind}",
+        )
+
+    def _wait_all(self, version: int, kind: str, describe: str) -> None:
+        deadline = time.monotonic() + self.barrier_timeout
+        expected = list(range(self.num_processes))
+        while True:
+            missing = [
+                q for q in expected
+                if not os.path.exists(self._ack_path(version, kind, q))
+            ]
+            if not missing:
+                return
+            if time.monotonic() > deadline:
+                raise ReplanBarrierError(
+                    f"re-plan {describe} barrier (v{version}) timed out "
+                    f"after {self.barrier_timeout:g}s waiting for physical "
+                    f"processes {missing} — a peer is wedged, dead, or "
+                    "never drained (check the owner heartbeat ages); "
+                    "falling back to supervised relaunch is the recovery "
+                    "path"
+                )
+            time.sleep(0.05)
+
+    # -- phase 2: agree + transfer + re-base --------------------------------
+    def replan_finish(self) -> ReshardResult:
+        from photon_ml_tpu import resilience
+        from photon_ml_tpu.resilience import RetryError, faults
+        from photon_ml_tpu.algorithm.streaming_random_effect import (
+            write_block_file,
+        )
+        from photon_ml_tpu.parallel.perhost_streaming import (
+            PerHostStreamingManifest,
+            commit_perhost_manifest,
+        )
+
+        if self._pending is None:
+            raise ElasticError("replan_finish without replan_prepare")
+        ctx = self._pending
+        self._pending = None
+        new_mem: FleetMembership = ctx["new_mem"]
+        old_mem = self.monitor.membership
+        manifest = ctx["manifest"]
+        old_plan = ctx["old_plan"]
+
+        # ---- the agreement barrier (deadline-bounded, fault-injectable) ---
+        def enter() -> None:
+            faults.inject(
+                "multihost.replan_barrier",
+                version=new_mem.version, process=self.process_id,
+            )
+
+        try:
+            resilience.call_with_retry(
+                enter, resilience.current_config().io_policy,
+                describe=f"re-plan barrier v{new_mem.version}",
+            )
+        except RetryError as e:
+            raise ReplanBarrierError(
+                f"re-plan barrier v{new_mem.version} entry failed after "
+                f"retries: {e} — falling back to supervised relaunch"
+            ) from e
+        self._wait_all(new_mem.version, "json", "record")
+        records: Dict[int, dict] = {}
+        for q in range(self.num_processes):
+            with open(self._ack_path(new_mem.version, "json", q)) as f:
+                records[q] = json.load(f)
+
+        # ---- the deterministic new plan: THE replan primitive the unit
+        # tests pin, not a parallel inline re-derivation ---------------------
+        new_plan = old_plan.replan(new_mem.hosts, version=new_mem.version)
+        moved = old_plan.moved_blocks(new_plan, old_mem, new_mem)
+        old_phys = old_mem.physical_owners(old_plan.owners)
+        new_phys = new_mem.physical_owners(new_plan.owners)
+        n_blocks = len(new_plan.owners)
+        incoming = [g for g, _, np_ in moved if np_ == self.process_id]
+
+        # ---- delta transfer: block payload files --------------------------
+        my_dir = ctx["record"]["block_dir"]
+        blocks_meta: Dict[int, dict] = {
+            int(g): m for g, m in zip(
+                ctx["record"]["owned_old"], manifest.blocks
+            )
+        }
+        rebuilt: List[int] = []
+        decisions: List[str] = []
+        for g in incoming:
+            src_rec = records[int(old_phys[g])]
+            meta = src_rec["blocks_meta"].get(str(g))
+            if meta is None:
+                raise ElasticError(
+                    f"block {g}: old owner process {int(old_phys[g])} has "
+                    "no metadata for it — plan sidecars disagree"
+                )
+            fname = meta["file"]
+            dst = os.path.join(my_dir, fname)
+            try:
+                _copy_with_transfer_site(
+                    os.path.join(src_rec["block_dir"], fname), dst, g,
+                    what="block",
+                )
+            except RetryError as copy_err:
+                got = self._fetch_from_block_cache(g)
+                if got is None:
+                    if ctx["rebuild_block"] is None:
+                        raise ElasticError(
+                            f"block {g} transfer failed after retries "
+                            f"({copy_err}) and no rebuild_block callback "
+                            "is available — refusing to continue with a "
+                            "missing block"
+                        ) from copy_err
+                    got = ctx["rebuild_block"](g)
+                    decisions.append(
+                        f"block {g}: transfer failed after retries "
+                        f"({copy_err}); degraded to a cold rebuild"
+                    )
+                else:
+                    decisions.append(
+                        f"block {g}: transfer failed after retries "
+                        f"({copy_err}); served from the per-block tensor "
+                        "cache"
+                    )
+                new_meta = write_block_file(my_dir, fname, got)
+                if new_meta != meta:
+                    raise ElasticError(
+                        f"block {g}: cold-rebuilt payload accounting "
+                        f"{new_meta} does not match the original {meta} — "
+                        "refusing to serve a divergent block"
+                    )
+                rebuilt.append(g)
+            blocks_meta[g] = meta
+
+        # ---- delta transfer: spilled coefficient state --------------------
+        # every live spill dir the peers listed, copied by matching dir
+        # NAME (epoch-N / init): whichever epoch the eventual checkpoint
+        # restore references, the moved-in block's file is present there
+        my_state_dirs = ctx["state_dirs"]
+        if my_state_dirs:
+            my_root = os.path.dirname(os.path.abspath(my_state_dirs[0]))
+            prev_owned = set(ctx["record"]["owned_old"])
+            for g in incoming:
+                if g in prev_owned:
+                    continue
+                src_rec = records[int(old_phys[g])]
+                fname = f"coefs-g{g:05d}.npy"
+                for entry in src_rec.get("state_dirs") or []:
+                    if g not in set(entry["gids"]):
+                        continue  # never written there: zeros by design
+                    try:
+                        _copy_with_transfer_site(
+                            os.path.join(entry["dir"], fname),
+                            os.path.join(my_root, entry["name"], fname),
+                            g, what="state",
+                        )
+                    except RetryError as e:
+                        # coefficients are TRAINING STATE — there is no
+                        # cold rebuild that preserves bitwise equality;
+                        # fail loud so the caller takes the supervised-
+                        # relaunch path
+                        raise ElasticError(
+                            f"block {g} coefficient-state transfer failed "
+                            f"after retries ({e}); resuming without it "
+                            "would silently zero trained coefficients — "
+                            "fall back to supervised relaunch"
+                        ) from e
+
+        # ---- re-base my manifest + plan sidecars --------------------------
+        new_owned = [g for g in range(n_blocks)
+                     if int(new_phys[g]) == self.process_id]
+        commit_perhost_manifest(
+            my_dir,
+            [blocks_meta[g] for g in new_owned],
+            manifest,
+            owned_gids=new_owned,
+            owners=new_plan.owners,
+            block_of=new_plan.block_of_vocab,
+            plan_version=new_mem.version,
+            membership=new_mem,
+            block_costs=new_plan.block_costs,
+        )
+
+        # ---- the done barrier: no peer resumes (and GC's epochs / rewrites
+        # state) while another is still copying from its dirs --------------
+        _atomic_write_json(
+            self._ack_path(new_mem.version, "done"),
+            {"process": self.process_id, "done_at": time.time()},
+        )
+        self._wait_all(new_mem.version, "done", "transfer-done")
+
+        # ---- commit AFTER every host's durable layout reached v+1: a
+        # transfer failure / done-barrier timeout must leave membership.json
+        # at the OLD version (consistent with the failing host's sidecars
+        # and with the still-live loss declaration), so the supervised-
+        # relaunch fallback recovers from a coherent state ------------------
+        if self.process_id == 0:
+            commit_membership(self.fleet_dir, new_mem)
+            # consume satisfied operator files BEFORE releasing anyone back
+            # to polling: a stale lost-hosts.json would otherwise re-propose
+            # removing an owner a later scale-up re-added (an infinite
+            # replan livelock), and a stale scale request would re-add a
+            # removed owner forever
+            self._consume_operator_files(new_mem)
+            _atomic_write_json(
+                self._ack_path(new_mem.version, "committed"),
+                {"process": self.process_id, "committed_at": time.time()},
+            )
+        else:
+            deadline = time.monotonic() + self.barrier_timeout
+            commit_path = self._ack_path(new_mem.version, "committed", 0)
+            while not os.path.exists(commit_path):
+                if time.monotonic() > deadline:
+                    raise ReplanBarrierError(
+                        f"membership v{new_mem.version} commit marker did "
+                        "not appear within the deadline — process 0 died "
+                        "between the done barrier and the commit; falling "
+                        "back to supervised relaunch"
+                    )
+                time.sleep(0.05)
+
+        self.monitor.install_membership(new_mem)
+        new_manifest = PerHostStreamingManifest.load(my_dir)
+        reason = ctx["proposal"].get("reason", "membership change")
+        decisions.insert(0, (
+            f"shard plan re-planned to v{new_mem.version} ({reason}): "
+            f"{len(moved)}/{n_blocks} blocks moved fleet-wide, "
+            f"{len(incoming)} onto process {self.process_id} "
+            f"({len(rebuilt)} cold-rebuilt), hosts {new_mem.hosts}"
+        ))
+        for d in decisions:
+            logger.info("elastic re-shard: %s", d)
+        return ReshardResult(
+            membership=new_mem,
+            plan_version=new_mem.version,
+            manifest=new_manifest,
+            moved=moved,
+            incoming=incoming,
+            rebuilt=rebuilt,
+            blocks_total=n_blocks,
+            epoch=ctx["epoch"],
+            decisions=decisions,
+        )
+
+    def _consume_operator_files(self, new_mem: FleetMembership) -> None:
+        """Archive operator request files the committed membership has
+        fully satisfied (renamed, not deleted — they stay inspectable).
+        A partially satisfied file is KEPT so the remaining change
+        triggers the next re-plan."""
+        lost_path = os.path.join(self.fleet_dir, LOST_HOSTS_FILE)
+        try:
+            with open(lost_path) as f:
+                declared = json.load(f)
+            hosts = {int(h) for h in declared.get("hosts", [])}
+            if hosts and not (hosts & set(new_mem.hosts)):
+                os.replace(
+                    lost_path,
+                    f"{lost_path}.consumed-v{new_mem.version}",
+                )
+        except (OSError, json.JSONDecodeError):
+            pass
+        scale_path = os.path.join(self.fleet_dir, SCALE_REQUEST_FILE)
+        try:
+            with open(scale_path) as f:
+                req = json.load(f)
+            added = {int(h) for h in (req.get("add") or {})}
+            if added and added <= set(new_mem.hosts):
+                os.replace(
+                    scale_path,
+                    f"{scale_path}.consumed-v{new_mem.version}",
+                )
+        except (OSError, json.JSONDecodeError):
+            pass
+
+    def _fetch_from_block_cache(self, gid: int) -> Optional[dict]:
+        if self.block_cache is None or self.block_key_base is None:
+            return None
+        hit = self.block_cache.get(f"{self.block_key_base}-g{gid:05d}")
+        if hit is None:
+            return None
+        return {k: np.asarray(v) for k, v in hit.arrays.items()}
+
+    # -- the one-call path the workers/drivers use --------------------------
+    def replan(
+        self,
+        manifest,
+        proposal: dict,
+        *,
+        state_dir=None,
+        epoch: int = 0,
+        rebuild_block: Optional[Callable[[int], dict]] = None,
+    ) -> ReshardResult:
+        """detect(ed) -> agree -> delta-transfer -> re-base, one call.
+        ``state_dir`` is a path OR a sequence of paths (the coordinate's
+        ``replan_state_dirs()``) naming every live spill dir to re-base."""
+        self.replan_prepare(
+            manifest, proposal, state_dir=state_dir, epoch=epoch,
+            rebuild_block=rebuild_block,
+        )
+        return self.replan_finish()
+
+
+def drain_if_replan_pending(monitor: Optional[ElasticMonitor],
+                            partial=None, where: str = "") -> None:
+    """The coordinates' drain hook: poll the monitor (local, throttled)
+    and unwind with :class:`ReplanRequired` if a membership proposal is
+    pending. ``partial`` carries mid-epoch progress exactly like a
+    preemption payload."""
+    if monitor is None:
+        return
+    prop = monitor.poll()
+    if prop is None:
+        return
+    if callable(partial):
+        partial = partial()
+    raise ReplanRequired(
+        f"membership change proposed (v{prop['version']}"
+        f"{': ' + prop['reason'] if prop.get('reason') else ''})"
+        f"{' at ' + where if where else ''} — draining for re-plan",
+        site="block",
+        partial=partial,
+        proposal=prop,
+    )

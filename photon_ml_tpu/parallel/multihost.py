@@ -103,6 +103,85 @@ def _call_with_deadline(fn, timeout: float, describe: str) -> None:
         raise box[0]
 
 
+def write_host_heartbeat(
+    directory: str, host_id: int, step: Optional[int] = None
+) -> str:
+    """Atomic heartbeat write for one (logical or physical) host id —
+    tmp+rename through the retry machinery, fault site
+    ``multihost.heartbeat``. The file format is shared by the per-process
+    beats (:meth:`MultihostContext.write_heartbeat`) and the per-logical-
+    owner beats of elastic re-sharding (parallel/elastic.py), so one
+    ``describe_heartbeats``-style reader diagnoses both."""
+    from photon_ml_tpu import resilience
+    from photon_ml_tpu.resilience import faults
+
+    path = os.path.join(directory, f"{HEARTBEAT_PREFIX}{int(host_id)}.json")
+
+    def write_once() -> None:
+        faults.inject("multihost.heartbeat", process=int(host_id), path=path)
+        os.makedirs(directory, exist_ok=True)
+        payload = {
+            "process": int(host_id),
+            "time": time.time(),
+            "step": step,
+        }
+        with open(path + ".tmp", "w") as f:
+            json.dump(payload, f)
+        os.replace(path + ".tmp", path)
+
+    resilience.call_with_retry(
+        write_once,
+        resilience.current_config().io_policy,
+        describe=f"heartbeat host {host_id}",
+    )
+    return path
+
+
+def read_heartbeat_ages(directory: str) -> Dict[int, float]:
+    """host id -> seconds since its last heartbeat (missing hosts absent
+    from the map). Read-only, best-effort: unreadable beats are logged and
+    skipped."""
+    ages: Dict[int, float] = {}
+    if not os.path.isdir(directory):
+        return ages
+    now = time.time()
+    for name in sorted(os.listdir(directory)):
+        if not name.startswith(HEARTBEAT_PREFIX) or not name.endswith(".json"):
+            continue
+        try:
+            with open(os.path.join(directory, name)) as f:
+                payload = json.load(f)
+            ages[int(payload["process"])] = now - float(payload["time"])
+        except (OSError, ValueError, KeyError) as e:
+            logger.warning("unreadable heartbeat %s: %s", name, e)
+    return ages
+
+
+def lost_hosts(
+    ages: Dict[int, float],
+    expected: Sequence[int],
+    deadline: float,
+    missing_grace_elapsed: Optional[float] = None,
+) -> List[int]:
+    """Heartbeat-driven loss detection with a deadline: the expected hosts
+    whose last beat is older than ``deadline`` seconds. A host MISSING from
+    ``ages`` entirely (never beat) only counts as lost once
+    ``missing_grace_elapsed`` (the observer's own uptime) exceeds the
+    deadline — otherwise a slow-starting peer would be declared dead at
+    the first poll. Pure function of its inputs so detection is unit-
+    testable without wall-clock sleeps (parallel/elastic.py drives it)."""
+    lost: List[int] = []
+    for h in sorted(int(x) for x in expected):
+        age = ages.get(h)
+        if age is None:
+            if (missing_grace_elapsed is not None
+                    and missing_grace_elapsed > deadline):
+                lost.append(h)
+        elif age > deadline:
+            lost.append(h)
+    return lost
+
+
 def initialize(
     coordinator_address: Optional[str] = None,
     num_processes: Optional[int] = None,
@@ -286,56 +365,29 @@ class MultihostContext:
             )
         return agreed if agreed >= 0 else None
 
-    def write_heartbeat(self, directory: str, step: Optional[int] = None) -> str:
+    def write_heartbeat(
+        self, directory: str, step: Optional[int] = None,
+        host_id: Optional[int] = None,
+    ) -> str:
         """Write this host's heartbeat file (atomic tmp+rename, retried;
         fault site ``multihost.heartbeat``). Every host calls this at its
         safe boundaries; the coordinator reads the ages back with
-        :meth:`heartbeat_ages` so a wedged host is diagnosable by name."""
-        from photon_ml_tpu import resilience
-        from photon_ml_tpu.resilience import faults
-
-        path = os.path.join(directory, f"{HEARTBEAT_PREFIX}{self.process_id}.json")
-
-        def write_once() -> None:
-            faults.inject(
-                "multihost.heartbeat", process=self.process_id, path=path
-            )
-            os.makedirs(directory, exist_ok=True)
-            payload = {
-                "process": self.process_id,
-                "time": time.time(),
-                "step": step,
-            }
-            with open(path + ".tmp", "w") as f:
-                json.dump(payload, f)
-            os.replace(path + ".tmp", path)
-
-        resilience.call_with_retry(
-            write_once,
-            resilience.current_config().io_policy,
-            describe=f"heartbeat process {self.process_id}",
+        :meth:`heartbeat_ages` so a wedged host is diagnosable by name.
+        ``host_id`` overrides the beat's identity — a process hosting
+        several LOGICAL owners (elastic re-sharding, parallel/elastic.py)
+        beats once per owner it carries."""
+        return write_host_heartbeat(
+            directory,
+            self.process_id if host_id is None else host_id,
+            step=step,
         )
-        return path
 
     def heartbeat_ages(self, directory: str) -> Dict[int, float]:
         """process id -> seconds since its last heartbeat (missing hosts
         absent from the map — a host that NEVER beat is the loudest
         diagnosis of all). Read-only; any host may call it, the coordinator
         logs it."""
-        ages: Dict[int, float] = {}
-        if not os.path.isdir(directory):
-            return ages
-        now = time.time()
-        for name in sorted(os.listdir(directory)):
-            if not name.startswith(HEARTBEAT_PREFIX) or not name.endswith(".json"):
-                continue
-            try:
-                with open(os.path.join(directory, name)) as f:
-                    payload = json.load(f)
-                ages[int(payload["process"])] = now - float(payload["time"])
-            except (OSError, ValueError, KeyError) as e:
-                logger.warning("unreadable heartbeat %s: %s", name, e)
-        return ages
+        return read_heartbeat_ages(directory)
 
     def describe_heartbeats(self, directory: str) -> str:
         """Coordinator-log line: per-host heartbeat age (and who is MISSING

@@ -27,7 +27,12 @@ agreed entity blocking**:
 Because block composition, block tensor bytes, per-block solves, and every
 cross-host reduction are exact, an N-process run is **bitwise-equal to the
 single-host streaming run on the same data** — pinned by the 2-process
-harness (tests/test_perhost_streaming.py). DrJAX (arXiv:2403.07128) showed
+harness (tests/test_perhost_streaming.py). The same invariance is what
+makes the fleet ELASTIC (parallel/elastic.py): the blocking never depends
+on membership, so a membership change re-runs only the deterministic
+balanced owner assignment (:meth:`EntityShardPlan.replan`), moves ONLY the
+delta blocks as file copies, and resumes bitwise-equal to a fresh run on
+the new topology. DrJAX (arXiv:2403.07128) showed
 the MapReduce framing maps onto JAX collectives; Snap ML (arXiv:1803.06333)
 showed hierarchical local-solve + reduce wins for exactly this workload —
 per-entity solves are embarrassingly parallel once each entity's rows live
@@ -37,7 +42,9 @@ on one host.
 from __future__ import annotations
 
 import dataclasses
+import hashlib
 import json
+import logging
 import os
 from typing import Dict, List, Optional, Sequence, Tuple
 
@@ -47,6 +54,7 @@ import jax
 import jax.numpy as jnp
 
 from photon_ml_tpu.algorithm.streaming_random_effect import (
+    SpilledREState,
     StreamingREManifest,
     StreamingRandomEffectCoordinate,
     build_block_payload,
@@ -57,7 +65,7 @@ from photon_ml_tpu.data.game import GameData, HostFeatures, RandomEffectDataConf
 from photon_ml_tpu.parallel.mesh import MeshContext
 from photon_ml_tpu.parallel.perhost_ingest import HostRows, _pad_to
 from photon_ml_tpu.parallel.shuffle import (
-    balanced_bucket_owners,
+    balanced_owners_over_hosts,
     collective_max,
     collective_sum,
     route_rows_to_hosts,
@@ -65,6 +73,8 @@ from photon_ml_tpu.parallel.shuffle import (
 from photon_ml_tpu.types import real_dtype
 
 Array = jax.Array
+
+logger = logging.getLogger(__name__)
 
 # fixed-width UTF-8 raw entity ids for the vocabulary agreement collective
 # (same format/limit as the ingest exchange, perhost_ingest.RAW_ID_BYTES)
@@ -194,15 +204,28 @@ def agree_entity_counts(
 
 @dataclasses.dataclass
 class EntityShardPlan:
-    """The globally agreed entity blocking and block->host assignment —
-    deterministic from (counts, config, num_processes) alone, so every host
-    derives the identical plan with no extra collective."""
+    """The globally agreed entity blocking and block->owner assignment —
+    deterministic from (counts, config, owner-host set) alone, so every
+    host derives the identical plan with no extra collective.
+
+    VERSIONED and RE-PLANNABLE (elastic re-sharding, parallel/elastic.py):
+    the blocking itself is a pure function of the per-entity counts — it
+    never changes with membership — so :meth:`replan` keeps the blocks and
+    re-runs only the deterministic balanced owner assignment over the new
+    host set. ``owners`` holds LOGICAL owner ids (the unit of elasticity);
+    a :class:`~photon_ml_tpu.parallel.elastic.FleetMembership` binds them
+    to physical processes. The default (``hosts=None``) is the identity
+    over ``range(num_processes)`` — byte-identical to the pre-versioned
+    plans."""
 
     blocks: List[np.ndarray]  # per block: sorted dense entity ids
-    owners: np.ndarray  # (n_blocks,) int32 owner PROCESS per block
+    owners: np.ndarray  # (n_blocks,) int32 owner HOST (logical) per block
     block_of_vocab: np.ndarray  # (V,) int32 owning block per entity, -1 absent
     num_entities: int  # present entities across all blocks
     num_processes: int
+    version: int = 1
+    hosts: Optional[List[int]] = None  # logical owner ids; None = identity
+    block_costs: Optional[np.ndarray] = None  # (n_blocks,) int64 solve cost
 
     @classmethod
     def build(
@@ -214,6 +237,8 @@ class EntityShardPlan:
         active_upper_bound: Optional[int] = None,
         block_entities: Optional[int] = None,
         memory_budget_bytes: Optional[int] = None,
+        hosts: Optional[Sequence[int]] = None,
+        version: int = 1,
     ) -> "EntityShardPlan":
         counts = np.asarray(counts)
         blocks = plan_entity_blocks(
@@ -226,11 +251,16 @@ class EntityShardPlan:
         cap = active_upper_bound or (int(counts.max()) if counts.sum() else 1)
         # block cost ~ active rows it will solve; the greedy min-heap
         # bin-packing is the RandomEffectIdPartitioner analogue at block
-        # granularity (deterministic on every host)
+        # granularity (deterministic on every host). Persisted in the plan
+        # sidecar so a RE-plan re-balances without re-deriving counts.
         costs = np.asarray(
             [int(np.minimum(counts[b], cap).sum()) for b in blocks], np.int64
         )
-        owners = balanced_bucket_owners(costs, max(num_processes, 1))
+        host_list = (
+            sorted(int(h) for h in hosts) if hosts is not None
+            else list(range(max(num_processes, 1)))
+        )
+        owners = balanced_owners_over_hosts(costs, host_list)
         block_of = np.full(len(counts), -1, np.int32)
         for gi, ents in enumerate(blocks):
             block_of[ents] = gi
@@ -240,11 +270,88 @@ class EntityShardPlan:
             block_of_vocab=block_of,
             num_entities=int((counts > 0).sum()),
             num_processes=max(num_processes, 1),
+            version=int(version),
+            hosts=host_list,
+            block_costs=costs,
         )
 
-    def owned_block_ids(self, process_id: int) -> List[int]:
+    def host_list(self) -> List[int]:
+        return (list(self.hosts) if self.hosts is not None
+                else list(range(self.num_processes)))
+
+    def replan(self, hosts: Sequence[int],
+               version: Optional[int] = None) -> "EntityShardPlan":
+        """The same blocking re-assigned over a NEW owner-host set: blocks
+        and costs are untouched (block composition is membership-invariant
+        — the bitwise foundation), only the deterministic balanced owner
+        map re-runs. Every survivor derives the identical v+1 plan."""
+        if self.block_costs is None:
+            raise ValueError(
+                "plan carries no block costs (pre-versioned sidecar) — "
+                "cannot re-plan; rebuild the manifest instead"
+            )
+        host_list = sorted(int(h) for h in hosts)
+        owners = balanced_owners_over_hosts(self.block_costs, host_list)
+        return dataclasses.replace(
+            self,
+            owners=owners.astype(np.int32),
+            hosts=host_list,
+            version=self.version + 1 if version is None else int(version),
+        )
+
+    def moved_blocks(self, new_plan: "EntityShardPlan",
+                     old_membership, new_membership
+                     ) -> List[Tuple[int, int, int]]:
+        """The DELTA between two plan versions at physical granularity:
+        ``(block gid, old physical owner, new physical owner)`` for every
+        block whose hosting process changes — exactly the file copies an
+        elastic re-shard performs (everything else stays put)."""
+        old_phys = old_membership.physical_owners(self.owners)
+        new_phys = new_membership.physical_owners(new_plan.owners)
+        return [
+            (gi, int(old_phys[gi]), int(new_phys[gi]))
+            for gi in range(len(self.owners))
+            if old_phys[gi] != new_phys[gi]
+        ]
+
+    @classmethod
+    def from_sidecars(cls, dir_path: str) -> Optional["EntityShardPlan"]:
+        """Reconstruct the FULL plan from a manifest dir's sidecars (the
+        block entity lists fall out of ``block_of_vocab`` — blocks store
+        sorted dense ids, which is exactly what the inverse map yields).
+        None for pre-versioned layouts (no plan.json). This is what the
+        elastic session re-plans FROM, so the replan()/moved_blocks()
+        methods the unit tests pin are the methods production executes."""
+        meta, owners, block_of = load_plan_sidecars(dir_path)
+        if meta is None:
+            return None
+        n_blocks = len(owners)
+        present = np.nonzero(block_of >= 0)[0]
+        order = present[np.argsort(block_of[present], kind="stable")]
+        bounds = np.searchsorted(block_of[order], np.arange(n_blocks + 1))
+        blocks = [
+            np.sort(order[bounds[g]:bounds[g + 1]]).astype(np.int64)
+            for g in range(n_blocks)
+        ]
+        return cls(
+            blocks=blocks,
+            owners=owners.astype(np.int32),
+            block_of_vocab=block_of.astype(np.int32),
+            num_entities=int(meta["num_entities"]),
+            num_processes=int(meta.get("num_processes", 1)),
+            version=int(meta["version"]),
+            hosts=[int(h) for h in meta["hosts"]],
+            block_costs=np.asarray(meta["block_costs"], np.int64),
+        )
+
+    def owned_block_ids(self, process_id: int,
+                        membership=None) -> List[int]:
+        if membership is None:
+            return [gi for gi in range(len(self.blocks))
+                    if int(self.owners[gi]) == process_id]
+        phys = membership.physical_owners(self.owners)
         return [gi for gi in range(len(self.blocks))
-                if int(self.owners[gi]) == process_id]
+                if int(phys[gi]) == process_id]
 
 
 # ---------------------------------------------------------------------------
@@ -254,6 +361,87 @@ class EntityShardPlan:
 
 _PLAN_BLOCK_OF = "plan-block-of.npy"
 _PLAN_OWNERS = "plan-owners.npy"
+_PLAN_META = "plan.json"
+
+
+def _plan_array_sha(arr: np.ndarray) -> str:
+    return hashlib.sha256(
+        np.ascontiguousarray(np.asarray(arr, np.int32)).tobytes()
+    ).hexdigest()
+
+
+def write_plan_sidecars(
+    dir_path: str,
+    owners: np.ndarray,
+    block_of: np.ndarray,
+    *,
+    version: int,
+    hosts: Sequence[int],
+    binding: Dict[int, int],
+    block_costs: np.ndarray,
+    num_entities: int,
+    num_processes: int = 1,
+) -> None:
+    """Persist the plan next to the blocks: the two routing arrays plus
+    ``plan.json`` — version, logical host set, logical->physical binding,
+    and the per-block costs a re-plan re-balances over. Everything an
+    elastic session (or a relaunched cohort restoring a v1 checkpoint
+    under v2) needs is durable and addressable here."""
+    # tmp+rename like every other commit on this path: an elastic re-base
+    # OVERWRITES live sidecars, and a crash mid-np.save must never leave a
+    # torn owners array next to the previous version's plan.json. The
+    # arrays land FIRST and plan.json is the COMMIT POINT: it records the
+    # arrays' digests, so a crash between the three renames (new arrays,
+    # old plan.json) is detected as a tear by load/from_sidecars instead
+    # of silently mixing plan versions.
+    block_of = np.asarray(block_of, np.int32)
+    owners = np.asarray(owners, np.int32)
+    for name, arr in ((_PLAN_BLOCK_OF, block_of), (_PLAN_OWNERS, owners)):
+        tmp_npy = os.path.join(dir_path, name + ".tmp.npy")
+        np.save(tmp_npy, arr)
+        os.replace(tmp_npy, os.path.join(dir_path, name))
+    meta = {
+        "version": int(version),
+        "hosts": [int(h) for h in hosts],
+        "binding": {str(h): int(p) for h, p in binding.items()},
+        "block_costs": [int(c) for c in np.asarray(block_costs)],
+        "num_entities": int(num_entities),
+        "num_processes": int(num_processes),
+        "owners_sha": _plan_array_sha(owners),
+        "block_of_sha": _plan_array_sha(block_of),
+    }
+    tmp = os.path.join(dir_path, _PLAN_META + ".tmp")
+    with open(tmp, "w") as f:
+        json.dump(meta, f)
+    os.replace(tmp, os.path.join(dir_path, _PLAN_META))
+
+
+def load_plan_sidecars(
+    dir_path: str,
+) -> Tuple[Optional[dict], np.ndarray, np.ndarray]:
+    """(plan meta or None for pre-versioned layouts, owners, block_of)."""
+    owners = np.load(os.path.join(dir_path, _PLAN_OWNERS))
+    block_of = np.load(os.path.join(dir_path, _PLAN_BLOCK_OF))
+    meta_path = os.path.join(dir_path, _PLAN_META)
+    meta = None
+    if os.path.exists(meta_path):
+        with open(meta_path) as f:
+            meta = json.load(f)
+        want = meta.get("owners_sha")
+        if want is not None and (
+            want != _plan_array_sha(owners)
+            or meta.get("block_of_sha") != _plan_array_sha(block_of)
+        ):
+            # a crash between the three sidecar renames: the arrays and
+            # plan.json belong to DIFFERENT plan versions — loudly refuse
+            # rather than compute an empty delta from mixed state
+            raise ValueError(
+                f"plan sidecars in {dir_path} are torn (array digests do "
+                "not match plan.json) — a re-base crashed mid-commit; "
+                "rebuild this host's manifest (supervised relaunch "
+                "re-ingests)"
+            )
+    return meta, owners, block_of
 
 
 @dataclasses.dataclass
@@ -262,21 +450,86 @@ class PerHostStreamingManifest(StreamingREManifest):
     the blocks this host owns (files named by GLOBAL block index), while
     ``num_rows`` / ``vocab`` / the plan sidecars describe the global run.
     Loaded with the base machinery — the streaming coordinate's update loop
-    runs unchanged over the owned blocks."""
+    runs unchanged over the owned blocks. ``plan_version`` tracks elastic
+    re-plans (parallel/elastic.py re-bases the manifest in place)."""
 
     global_block_ids: List[int] = dataclasses.field(default_factory=list)
     num_blocks_total: int = 0
     num_entities_global: int = 0
     process_index: int = 0
     num_processes: int = 1
+    plan_version: int = 1
 
     def plan_arrays(self) -> Tuple[np.ndarray, np.ndarray]:
-        """(block_of_vocab, owners) sidecars — what validation-time row
-        routing needs to find an entity's owner host."""
+        """(block_of_vocab, owners) sidecars — owners are LOGICAL host ids
+        (identical to physical under the default identity binding)."""
         return (
             np.load(os.path.join(self.dir, _PLAN_BLOCK_OF)),
             np.load(os.path.join(self.dir, _PLAN_OWNERS)),
         )
+
+    def physical_plan_arrays(self) -> Tuple[np.ndarray, np.ndarray]:
+        """(block_of_vocab, PHYSICAL owner process per block) — what
+        validation-time row routing needs. Resolves the logical owners
+        through the plan sidecar's binding; pre-versioned sidecars (no
+        plan.json) are identity-bound already."""
+        meta, owners, block_of = load_plan_sidecars(self.dir)
+        if meta is None:
+            return block_of, owners
+        binding = {int(h): int(p) for h, p in meta["binding"].items()}
+        table = np.full(max(binding) + 1, -1, np.int32)
+        for h, p in binding.items():
+            table[h] = p
+        return block_of, table[owners.astype(np.int64)]
+
+
+def commit_perhost_manifest(
+    dir_path: str,
+    metas: List[dict],
+    base,
+    *,
+    owned_gids: Sequence[int],
+    owners: np.ndarray,
+    block_of: np.ndarray,
+    plan_version: int,
+    membership,
+    block_costs: np.ndarray,
+) -> None:
+    """Atomically (re)write a per-host ``manifest.json`` + plan sidecars.
+    ONE definition shared by the initial build (:func:`_write_owned_blocks`)
+    and the elastic re-base (parallel/elastic.ElasticSession.replan_finish)
+    so the two layouts cannot drift. ``base`` supplies the global,
+    membership-invariant fields (num_rows/vocab/...)."""
+    write_plan_sidecars(
+        dir_path, owners, block_of,
+        version=plan_version,
+        hosts=membership.hosts,
+        binding=membership.binding,
+        block_costs=block_costs,
+        num_entities=int(base.num_entities_global),
+        num_processes=int(base.num_processes),
+    )
+    manifest = dict(
+        blocks=list(metas),
+        num_rows=int(base.num_rows),
+        global_dim=int(base.global_dim),
+        vocab=list(base.vocab),
+        random_effect_id=base.random_effect_id,
+        feature_shard_id=base.feature_shard_id,
+        ladder=base.ladder,
+        global_block_ids=[int(g) for g in owned_gids],
+        num_blocks_total=int(len(owners)),
+        num_entities_global=int(base.num_entities_global),
+        process_index=int(base.process_index),
+        num_processes=int(base.num_processes),
+        plan_version=int(plan_version),
+    )
+    with open(os.path.join(dir_path, "manifest.json.tmp"), "w") as f:
+        json.dump(manifest, f)
+    os.replace(
+        os.path.join(dir_path, "manifest.json.tmp"),
+        os.path.join(dir_path, "manifest.json"),
+    )
 
 
 def build_perhost_streaming_manifest(
@@ -292,6 +545,9 @@ def build_perhost_streaming_manifest(
     shared_vocab: Optional[List[str]] = None,
     tensor_cache=None,
     cache_key: Optional[str] = None,
+    membership=None,
+    block_cache=None,
+    block_key_base: Optional[str] = None,
 ) -> PerHostStreamingManifest:
     """The per-host streaming ingest: agree on the vocabulary + counts,
     derive the global plan, route this host's rows to their entity's block
@@ -311,6 +567,20 @@ def build_perhost_streaming_manifest(
     hit. Hit/miss is agreed COLLECTIVELY: the row-routing exchange below is
     a collective, so one host skipping it while another rebuilds would
     deadlock the mesh — everyone rebuilds unless every host hits.
+
+    ``membership`` (parallel/elastic.FleetMembership) makes the plan's
+    owners LOGICAL host ids bound to physical processes — the versioned,
+    re-plannable owner model; None is the identity over processes (the
+    pre-elastic behavior, byte-identical plans).
+
+    ``block_cache`` + ``block_key_base`` enable PER-BLOCK tensor-cache
+    entries keyed on owned-block IDENTITY (global inputs + block id), with
+    NO process scope: a block's tensors are a pure function of the global
+    data and the plan — identical no matter which host builds them — so a
+    membership change keeps every unmoved block's entry warm (the old
+    dir-level shard-scoped key rebuilt everything on any topology change),
+    and the elastic transfer path can serve a moved block from the cache
+    when its file copy fails.
     """
     from photon_ml_tpu.compile import resolve_bucketer
 
@@ -399,11 +669,18 @@ def build_perhost_streaming_manifest(
         active_upper_bound=config.active_upper_bound,
         block_entities=block_entities,
         memory_budget_bytes=memory_budget_bytes,
+        hosts=(membership.hosts if membership is not None else None),
+        version=(membership.version if membership is not None else 1),
+    )
+    phys_owners = (
+        membership.physical_owners(plan.owners)
+        if membership is not None else plan.owners
     )
 
     # ---- route rows to their block's owner host ---------------------------
     host_data, row_to_global = _route_and_assemble(
-        rows, dense, vocab, plan, config, ctx, num_processes, process_id
+        rows, dense, vocab, plan, phys_owners, config, ctx, num_processes,
+        process_id,
     )
 
     # ---- build the owned blocks -------------------------------------------
@@ -411,6 +688,8 @@ def build_perhost_streaming_manifest(
         _write_owned_blocks(
             dir_path, host_data, row_to_global, config, plan, vocab,
             bucketer, memory_budget_bytes, n_global, process_id,
+            membership=membership, block_cache=block_cache,
+            block_key_base=block_key_base,
         )
 
     if tensor_cache is not None and cache_key is not None:
@@ -453,6 +732,7 @@ def _route_and_assemble(
     dense: np.ndarray,
     vocab: List[str],
     plan: EntityShardPlan,
+    phys_owners: np.ndarray,
     config: RandomEffectDataConfig,
     ctx: Optional[MeshContext],
     num_processes: int,
@@ -462,9 +742,11 @@ def _route_and_assemble(
     the received rows — sorted by GLOBAL row id, so the owner's local data
     is exactly the single-host dataset restricted to its entities (the
     bitwise foundation: identical filtered rows -> identical block tensors).
-    Returns (host-local GameData in the GLOBAL dense entity space,
-    local row position -> global row id)."""
-    dest_host = plan.owners[plan.block_of_vocab[dense]].astype(np.int64)
+    ``phys_owners`` is the per-block PHYSICAL destination (the plan's
+    logical owners resolved through the membership binding). Returns
+    (host-local GameData in the GLOBAL dense entity space, local row
+    position -> global row id)."""
+    dest_host = np.asarray(phys_owners)[plan.block_of_vocab[dense]].astype(np.int64)
     fi, fv = _agree_padded_features(rows, ctx, num_processes)
     int_payload = np.concatenate(
         [rows.row_index.astype(np.int32)[:, None],
@@ -514,18 +796,42 @@ def _write_owned_blocks(
     memory_budget_bytes: Optional[int],
     n_global: int,
     process_id: int,
+    membership=None,
+    block_cache=None,
+    block_key_base: Optional[str] = None,
 ) -> None:
-    from photon_ml_tpu import resilience
-    from photon_ml_tpu.resilience import faults
+    import types
 
-    owned = plan.owned_block_ids(process_id)
+    from photon_ml_tpu import resilience
+    from photon_ml_tpu.resilience import RetryError, faults
+
+    owned = plan.owned_block_ids(process_id, membership)
     metas = []
+    cache_hits = 0
     for gi in owned:
-        payload = build_block_payload(
-            host_data, config, plan.blocks[gi], bucketer=bucketer,
-            memory_budget_bytes=memory_budget_bytes, label=f"block {gi}",
-            row_to_global=row_to_global,
+        payload = None
+        block_key = (
+            f"{block_key_base}-g{gi:05d}"
+            if block_cache is not None and block_key_base is not None
+            else None
         )
+        built_fresh = False
+        if block_key is not None:
+            hit = block_cache.get(block_key)
+            if hit is not None:
+                # per-block entries are UNSCOPED: block gi's tensors are a
+                # pure function of the global data + plan, identical no
+                # matter which host built them — so a survivor (or a new
+                # owner) reuses them across any membership change
+                payload = {k: np.asarray(v) for k, v in hit.arrays.items()}
+                cache_hits += 1
+        if payload is None:
+            payload = build_block_payload(
+                host_data, config, plan.blocks[gi], bucketer=bucketer,
+                memory_budget_bytes=memory_budget_bytes, label=f"block {gi}",
+                row_to_global=row_to_global,
+            )
+            built_fresh = True
 
         def write_once(gi=gi, payload=payload):
             faults.inject(
@@ -537,31 +843,152 @@ def _write_owned_blocks(
             write_once, resilience.current_config().io_policy,
             describe=f"per-host block {gi} write",
         ))
+        if block_key is not None and built_fresh:
+            try:
+                block_cache.put(block_key, payload)
+            except RetryError as e:
+                logger.warning(
+                    "per-block cache write for block %d failed after "
+                    "retries (%s); continuing uncached", gi, e,
+                )
         del payload
-    np.save(os.path.join(dir_path, _PLAN_BLOCK_OF),
-            plan.block_of_vocab.astype(np.int32))
-    np.save(os.path.join(dir_path, _PLAN_OWNERS),
-            plan.owners.astype(np.int32))
-    manifest = dict(
-        blocks=metas,
+    if cache_hits:
+        logger.info(
+            "per-host streaming build: %d/%d owned blocks served from the "
+            "per-block tensor cache (owned-block-identity keys)",
+            cache_hits, len(owned),
+        )
+    mem = membership
+    if mem is None:
+        from photon_ml_tpu.parallel.elastic import FleetMembership
+
+        mem = FleetMembership.initial(plan.num_processes)
+    base = types.SimpleNamespace(
         num_rows=int(n_global),
         global_dim=int(host_data.shards[config.feature_shard_id].dim),
         vocab=list(vocab),
         random_effect_id=config.random_effect_id,
         feature_shard_id=config.feature_shard_id,
         ladder=(f"{bucketer.base}:{bucketer.growth:g}" if bucketer else None),
-        global_block_ids=[int(gi) for gi in owned],
-        num_blocks_total=int(len(plan.blocks)),
         num_entities_global=int(plan.num_entities),
         process_index=int(process_id),
         num_processes=int(plan.num_processes),
     )
-    with open(os.path.join(dir_path, "manifest.json.tmp"), "w") as f:
-        json.dump(manifest, f)
-    os.replace(
-        os.path.join(dir_path, "manifest.json.tmp"),
-        os.path.join(dir_path, "manifest.json"),
+    commit_perhost_manifest(
+        dir_path, metas, base,
+        owned_gids=owned,
+        owners=plan.owners,
+        block_of=plan.block_of_vocab,
+        plan_version=plan.version,
+        membership=mem,
+        block_costs=(
+            plan.block_costs if plan.block_costs is not None
+            else np.zeros(len(plan.blocks), np.int64)
+        ),
     )
+
+
+# ---------------------------------------------------------------------------
+# per-host spilled state: files keyed by GLOBAL block id
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class PerHostSpilledREState(SpilledREState):
+    """Per-host spilled coordinate state whose files are named by GLOBAL
+    block id (``coefs-g<gid>.npy``), not local position: an elastic
+    re-plan moves a block's coefficients between hosts as ONE file copy
+    that keeps its name, and the checkpoint reference carries per-global-id
+    shapes — so a checkpoint written under plan v1 restores under plan v2
+    (the rebuild validates every still-owned block's shape and the
+    presence of every coefficient file the save recorded, instead of the
+    base class's positional shapes-list equality)."""
+
+    global_ids: List[int] = dataclasses.field(default_factory=list)
+    plan_version: int = 1
+
+    def _path(self, i: int) -> str:
+        return os.path.join(
+            self.dir, f"coefs-g{int(self.global_ids[i]):05d}.npy"
+        )
+
+    def __checkpoint_ref__(self) -> dict:
+        return {
+            "kind": "perhost_spilled_re_state",
+            "dir": self.dir,
+            "plan_version": int(self.plan_version),
+            "shapes_by_gid": {
+                str(int(g)): [int(x) for x in s]
+                for g, s in zip(self.global_ids, self.shapes)
+            },
+            "written_gids": [
+                int(g) for i, g in enumerate(self.global_ids)
+                if os.path.exists(self._path(i))
+            ],
+            "written": os.path.isdir(self.dir),
+        }
+
+    def __checkpoint_from_ref__(self, ref: dict) -> "PerHostSpilledREState":
+        from photon_ml_tpu.checkpoint import CheckpointRefError
+
+        if ref.get("kind") == "spilled_re_state":
+            raise CheckpointRefError(
+                "checkpoint holds a pre-elastic positional per-host spill "
+                "ref; per-host states are now keyed by global block id "
+                "(see MIGRATION.md) — falling back to an older step or a "
+                "fresh epoch"
+            )
+        if ref.get("kind") != "perhost_spilled_re_state":
+            raise CheckpointRefError(
+                f"checkpoint ref kind {ref.get('kind')!r} is not a per-host "
+                "spilled streaming state — coordinate types changed since "
+                "the save"
+            )
+        if int(ref.get("plan_version", 1)) != int(self.plan_version):
+            logger.info(
+                "restoring per-host spilled state across a plan change "
+                "(saved v%s, restoring under v%s) — shapes re-validated "
+                "per global block id",
+                ref.get("plan_version", 1), self.plan_version,
+            )
+        shapes_by_gid = {
+            int(g): tuple(int(x) for x in s)
+            for g, s in ref.get("shapes_by_gid", {}).items()
+        }
+        for g, s in zip(self.global_ids, self.shapes):
+            want = shapes_by_gid.get(int(g))
+            if want is not None and want != tuple(int(x) for x in s):
+                raise CheckpointRefError(
+                    f"block {g}: checkpoint shape {want} does not match "
+                    f"this manifest's {tuple(s)} — the streaming blocks "
+                    "were rebuilt differently; refusing to resume"
+                )
+        if ref.get("written") and not os.path.isdir(ref["dir"]):
+            raise CheckpointRefError(
+                f"spilled coefficient dir {ref['dir']} referenced by this "
+                "checkpoint no longer exists — restoring would silently "
+                "zero trained coefficients; falling back to an older step"
+            )
+        out = PerHostSpilledREState(
+            dir=ref["dir"], shapes=list(self.shapes),
+            global_ids=list(self.global_ids),
+            plan_version=int(self.plan_version),
+        )
+        # blocks the SAVE recorded as written and this plan still owns
+        # must be present after the re-base transfer — a missing file
+        # would serve zeros for trained coefficients
+        written = {int(g) for g in ref.get("written_gids", [])}
+        missing = [
+            int(g) for i, g in enumerate(self.global_ids)
+            if int(g) in written and not os.path.exists(out._path(i))
+        ]
+        if missing:
+            raise CheckpointRefError(
+                f"blocks {missing} had coefficients at save time but their "
+                f"files are missing from {ref['dir']} after the re-base — "
+                "refusing to resume onto zeros"
+            )
+        return out
 
 
 # ---------------------------------------------------------------------------
@@ -615,6 +1042,51 @@ class PerHostStreamingRandomEffectCoordinate(StreamingRandomEffectCoordinate):
             or self.manifest.num_entities
         )
 
+    # -- elastic re-sharding hooks (parallel/elastic.py) --------------------
+    def _make_state(self, dir_path: str) -> PerHostSpilledREState:
+        return PerHostSpilledREState(
+            dir=dir_path, shapes=list(self._shapes),
+            global_ids=list(int(g) for g in self._global_ids),
+            plan_version=int(getattr(self.manifest, "plan_version", 1)),
+        )
+
+    def _partial_payload(self, new_state, done_blocks,
+                         inner: Optional[dict] = None) -> dict:
+        payload = super()._partial_payload(new_state, done_blocks, inner)
+        # progress keyed by GLOBAL block id + plan version: after a
+        # re-plan, still-owned done blocks map back to (new) local indices
+        # and moved-away ones drop out (their new owner re-solves them —
+        # deterministic, so bitwise either way)
+        payload["meta"]["done_global_ids"] = [
+            int(self._global_ids[i]) for i in sorted(done_blocks)
+        ]
+        payload["meta"]["plan_version"] = int(
+            getattr(self.manifest, "plan_version", 1)
+        )
+        return payload
+
+    def _resume_done_locals(self, m: dict, active) -> set:
+        if m.get("done_global_ids") is not None:
+            local_of = {int(g): i for i, g in enumerate(self._global_ids)}
+            done = {
+                local_of[int(g)] for g in m["done_global_ids"]
+                if int(g) in local_of
+            }
+            return done & set(active)
+        return super()._resume_done_locals(m, active)
+
+    def _resume_inner_ok(self, m: dict) -> bool:
+        cur = int(getattr(self.manifest, "plan_version", 1))
+        saved = m.get("plan_version")
+        if saved is not None and int(saved) != cur:
+            logger.info(
+                "dropping mid-chunk scheduler snapshot across plan change "
+                "(saved v%s -> v%s): the block re-solves whole, which is "
+                "bitwise-equal to the chunked resume", saved, cur,
+            )
+            return False
+        return True
+
     def score(self, state) -> Array:
         local = np.asarray(super().score(state))
         return jnp.asarray(merge_disjoint(local, self.ctx, self.num_processes))
@@ -665,7 +1137,11 @@ def score_routed_rows_streaming(
             f"{num_rows_out} scoring rows exceed the int32 id space of the "
             "routing exchange; shard the scoring pass"
         )
-    block_of, owners = manifest.plan_arrays()
+    # PHYSICAL owners: the plan sidecar's logical owners resolved through
+    # the membership binding (identity for pre-elastic layouts) — and
+    # re-based in place by any elastic re-plan, so routed scoring always
+    # targets the CURRENT owner of a block
+    block_of, owners = manifest.physical_plan_arrays()
     varr = np.asarray(manifest.vocab, dtype=object)
     raw = np.asarray(rows.entity_raw_ids, dtype=object)
     pos = np.searchsorted(varr, raw) if len(varr) else np.zeros(len(raw), np.int64)

@@ -196,6 +196,23 @@ def balanced_bucket_owners(global_counts: np.ndarray, num_devices: int) -> np.nd
     return owners
 
 
+def balanced_owners_over_hosts(
+    costs: np.ndarray, hosts: Sequence[int]
+) -> np.ndarray:
+    """(B,) int32 owner HOST ID per block for an arbitrary live-host set:
+    the same deterministic min-heap packing as :func:`balanced_bucket_owners`
+    but assigning onto an explicit (sorted) host-id list instead of
+    ``range(n)`` — the re-plan primitive of elastic entity re-sharding
+    (parallel/elastic.py). Every survivor derives the IDENTICAL map from
+    the identical (costs, survivor set), so a membership change needs no
+    extra agreement collective beyond the membership itself."""
+    host_ids = np.asarray(sorted(int(h) for h in hosts), np.int32)
+    if len(host_ids) == 0:
+        raise ValueError("cannot assign block owners over an empty host set")
+    slots = balanced_bucket_owners(np.asarray(costs), len(host_ids))
+    return host_ids[slots]
+
+
 # ---------------------------------------------------------------------------
 # the row exchange (all_to_all over the mesh axis)
 # ---------------------------------------------------------------------------

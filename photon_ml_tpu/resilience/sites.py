@@ -37,6 +37,9 @@ FAULT_SITES: Dict[str, str] = {
     "multihost.barrier": "cross-host sync points (parallel/multihost.py)",
     "multihost.heartbeat": "per-host heartbeat writes (parallel/multihost.py)",
     "multihost.entity_route": "streaming entity-routing exchange (parallel/shuffle.py)",
+    "multihost.membership": "elastic fleet-membership reads/commits (parallel/elastic.py)",
+    "multihost.replan_barrier": "elastic re-plan barrier entry; a failure that survives retries falls back to supervised relaunch (parallel/elastic.py)",
+    "io.block_transfer": "delta block/state file copies during an elastic re-shard; a failed block copy degrades to a recorded cold rebuild (parallel/elastic.py)",
     "multihost.streaming_reduce": "exact cross-host streaming merges: score scatters, FE chunk partials, reg terms (parallel/perhost_streaming.py)",
     "io.perhost_block_write": "per-host streaming entity-block writes (parallel/perhost_streaming.py)",
     "optim.step": "coordinate-descent updates, NaN corruption (algorithm/coordinate_descent.py)",

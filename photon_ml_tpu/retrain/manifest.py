@@ -53,6 +53,12 @@ class CoordinateRecord:
     opt_config: str = ""
     cache_key: Optional[str] = None
     streaming_manifest_dir: Optional[str] = None
+    # the entity-shard plan version the streaming layout was built/last
+    # re-based under (elastic re-sharding, parallel/elastic.py); 1 for
+    # single-host layouts. A future multihost delta retrain compares it
+    # against the live plan so topology drift is a recorded re-plan, not
+    # a silent blanket rebuild.
+    shard_plan_version: int = 1
 
 
 @dataclasses.dataclass

@@ -1,0 +1,316 @@
+"""Worker for the 2-process ELASTIC re-sharding harness (launched by
+test_elastic_reshard.py; also runnable by hand:
+
+    ELASTIC_MODE=loss python tests/elastic_reshard_worker.py <pid> 2 <port> <dir>
+
+Fleet model: 3 VIRTUAL owner hosts on 2 physical processes (owner 2
+co-located with process 0) — the unit of elasticity is the virtual owner,
+so membership can change while the Gloo collectives over the fixed
+physical cohort stay alive (real physical-process death is the supervised-
+relaunch fallback, by design).
+
+Arms (env ELASTIC_MODE):
+  * ``loss``    — v1 hosts {0,1,2}; after process 0 spills the FIRST block
+    of epoch 2 (mid-epoch, mid-final-CD-iteration), virtual owner 2 is
+    reclaimed: its heartbeats stop and the loss is declared. Both
+    processes drain at their streaming boundaries (ReplanRequired -> CD's
+    emergency checkpoint), agree plan v2, move ONLY the delta blocks (+
+    their spilled coefficients), re-base, and RESUME through the
+    plan-versioned checkpoint restore — no supervised relaunch.
+  * ``scaleup`` — v1 hosts {0,1}; at the same trigger point an operator
+    scale-up request adds owner 2 (bound to process 1); blocks
+    redistribute onto it and the run resumes identically.
+
+Either way the finished run must be BITWISE-equal to an uninterrupted run
+on the final topology — the test compares against the single-host
+streaming reference, which PR 9 pins equal to every topology."""
+
+import os
+import sys
+import time
+
+proc_id, nprocs, port, outdir = (
+    int(sys.argv[1]), int(sys.argv[2]), sys.argv[3], sys.argv[4]
+)
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+import jax.numpy as jnp
+
+from photon_ml_tpu.parallel import multihost
+
+mh = multihost.initialize(
+    coordinator_address=f"127.0.0.1:{port}", num_processes=nprocs,
+    process_id=proc_id,
+)
+ctx = mh.mesh_context()
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from game_test_utils import make_glmix_data  # noqa: E402
+
+from photon_ml_tpu.algorithm.coordinate_descent import CoordinateDescent  # noqa: E402
+from photon_ml_tpu.algorithm.streaming_fixed_effect import (  # noqa: E402
+    PerHostStreamingFixedEffectCoordinate,
+)
+from photon_ml_tpu.checkpoint import CoordinateDescentCheckpointer  # noqa: E402
+from photon_ml_tpu.compile.plan import ExecutionPlan  # noqa: E402
+from photon_ml_tpu.data.game import RandomEffectDataConfig  # noqa: E402
+from photon_ml_tpu.ops import losses as losses_mod  # noqa: E402
+from photon_ml_tpu.ops.regularization import RegularizationContext  # noqa: E402
+from photon_ml_tpu.optim.common import OptimizerConfig  # noqa: E402
+from photon_ml_tpu.optim.problem import GLMOptimizationProblem  # noqa: E402
+from photon_ml_tpu.parallel.elastic import (  # noqa: E402
+    ElasticMonitor,
+    ElasticSession,
+    FleetMembership,
+    ReplanBarrierError,
+    ReplanRequired,
+    declare_lost_hosts,
+    request_scale_up,
+)
+from photon_ml_tpu.parallel.perhost_ingest import HostRows, csr_to_padded  # noqa: E402
+from photon_ml_tpu.parallel.perhost_streaming import (  # noqa: E402
+    PerHostStreamingRandomEffectCoordinate,
+    build_perhost_streaming_manifest,
+)
+from photon_ml_tpu.types import OptimizerType, TaskType  # noqa: E402
+
+MODE = os.environ.get("ELASTIC_MODE", "loss")
+
+# ---- the globally seeded dataset (identical in every process) -------------
+rng = np.random.default_rng(97)
+data, _ = make_glmix_data(
+    rng, num_users=60, rows_per_user_range=(4, 16), d_fixed=5, d_random=4
+)
+N = data.num_rows
+D_FE = data.shards["global"].dim
+CHUNK_ROWS = 128
+BLOCK_ENTITIES = 16
+RE_CFG = RandomEffectDataConfig("userId", "per_user")
+FE_PROBLEM = GLMOptimizationProblem(
+    TaskType.LOGISTIC_REGRESSION, OptimizerType.LBFGS,
+    OptimizerConfig(max_iterations=6, tolerance=1e-8),
+    RegularizationContext.l2(0.5),
+)
+RE_OPT = OptimizerConfig(max_iterations=6, tolerance=1e-8)
+RE_REG = RegularizationContext.l2(0.2)
+
+lo = proc_id * (N // nprocs)
+hi = N if proc_id == nprocs - 1 else (proc_id + 1) * (N // nprocs)
+feats = data.shards["per_user"]
+fi_all, fv_all = csr_to_padded(feats, N)
+vocab0 = data.id_vocabs["userId"]
+host_rows = HostRows(
+    entity_raw_ids=[vocab0[i] for i in data.ids["userId"][lo:hi]],
+    row_index=np.arange(lo, hi, dtype=np.int64),
+    labels=data.response[lo:hi].astype(np.float32),
+    weights=data.weight[lo:hi].astype(np.float32),
+    offsets=data.offset[lo:hi].astype(np.float32),
+    feat_idx=fi_all[lo:hi],
+    feat_val=fv_all[lo:hi],
+    global_dim=feats.dim,
+)
+
+exec_plan = ExecutionPlan.resolve(
+    distributed=(nprocs > 1), streaming=True, num_processes=nprocs
+)
+
+# ---- membership + fleet coordination dir ----------------------------------
+if MODE == "loss":
+    membership = FleetMembership(1, [0, 1, 2], {0: 0, 1: 1, 2: 0})
+elif MODE == "scaleup":
+    membership = FleetMembership.initial(nprocs)
+else:
+    raise SystemExit(f"unknown ELASTIC_MODE {MODE!r}")
+fleet_dir = os.path.join(outdir, "fleet")
+monitor = ElasticMonitor(
+    fleet_dir, membership, process_id=proc_id,
+    heartbeat_deadline=15.0, min_poll_interval=0.0,
+    num_processes=nprocs,
+)
+session = ElasticSession(
+    fleet_dir, proc_id, nprocs, monitor, barrier_timeout=90.0,
+)
+
+# ---- per-host streaming RE over the VERSIONED plan ------------------------
+manifest = build_perhost_streaming_manifest(
+    host_rows, RE_CFG, os.path.join(outdir, f"re-host{proc_id}"),
+    ctx, nprocs, proc_id, block_entities=BLOCK_ENTITIES,
+    bucketer=exec_plan.bucketer, membership=membership,
+)
+
+
+def make_re_coord(man, initial_epoch=0):
+    return PerHostStreamingRandomEffectCoordinate(
+        man, TaskType.LOGISTIC_REGRESSION,
+        optimizer=OptimizerType.LBFGS, optimizer_config=RE_OPT,
+        regularization=RE_REG,
+        state_root=os.path.join(outdir, f"re-state-host{proc_id}"),
+        plan=exec_plan, elastic=monitor, initial_epoch=initial_epoch,
+        ctx=ctx, num_processes=nprocs,
+    )
+
+
+re_coord = make_re_coord(manifest)
+
+# ---- the mid-epoch trigger (process 0, after epoch 2's first spill) -------
+fired = {"done": False}
+
+
+def _fire_change():
+    if MODE == "loss":
+        # virtual owner 2's capacity is reclaimed: its heartbeats stop and
+        # the loss is declared (the cluster-manager notice; pure heartbeat
+        # detection is deadline-bound and unit-covered)
+        monitor.silence_host(2)
+        declare_lost_hosts(fleet_dir, [2], reason="virtual owner reclaimed")
+    else:
+        request_scale_up(fleet_dir, {2: 1}, reason="capacity arrived")
+    print("TRIGGERED membership change", flush=True)
+
+
+# EVERY process self-triggers the change at its own epoch-2 boundary (the
+# marker writes are atomic and idempotent — identical content), so no
+# process's drain depends on ANOTHER process's timing: process 1 fires at
+# its epoch-2 update ENTRY, before its entry poll, so it always drains
+# before entering any collective; process 0 fires just before its first
+# epoch-2 block solve and drains MID-EPOCH at the first block boundary
+# with a done_global_ids partial. (A one-sided trigger raced under CPU
+# contention: the peer could pass its last poll before the marker landed
+# and block in the score merge — the exact fallback-race the module
+# documents, which a deterministic harness must not roll dice on.)
+if proc_id == 0:
+    _orig_slab = re_coord._slab_for
+    _calls = {"n": 0}
+
+    def _slab_hook(i, ds, _orig=_orig_slab, _first_epoch2=len(manifest.blocks) + 1):
+        _calls["n"] += 1
+        if not fired["done"] and _calls["n"] == _first_epoch2:
+            fired["done"] = True
+            _fire_change()
+        return _orig(i, ds)
+
+    re_coord._slab_for = _slab_hook
+else:
+    _orig_update = re_coord.update
+
+    def _entry_trigger_update(resid, state, resume=None, _orig=_orig_update):
+        if not fired["done"] and re_coord._epoch >= 1 and resume is None:
+            fired["done"] = True
+            _fire_change()
+        return _orig(resid, state, resume=resume)
+
+    re_coord.update = _entry_trigger_update
+
+# ---- per-host streaming FE (chunk ownership is per PHYSICAL process) ------
+x_fe = np.zeros((N, D_FE), np.float32)
+gf = data.shards["global"]
+nnz = np.diff(gf.indptr)
+x_fe[np.repeat(np.arange(N), nnz), gf.indices] = gf.values
+chunk_sizes = [
+    min(CHUNK_ROWS, N - c * CHUNK_ROWS)
+    for c in range((N + CHUNK_ROWS - 1) // CHUNK_ROWS)
+]
+owned_loaders = {}
+for c in range(len(chunk_sizes)):
+    if c % nprocs != proc_id:
+        continue
+    s = c * CHUNK_ROWS
+    e = s + chunk_sizes[c]
+
+    def load(s=s, e=e):
+        return {"x": x_fe[s:e], "y": data.response[s:e].astype(np.float32)}
+
+    owned_loaders[c] = load
+fe_coord = PerHostStreamingFixedEffectCoordinate(
+    chunk_sizes, owned_loaders, D_FE, FE_PROBLEM,
+    plan=exec_plan, elastic=monitor,
+    ctx=ctx, num_processes=nprocs,
+)
+
+# ---- streaming CD with the elastic re-plan loop ---------------------------
+labels = jnp.asarray(data.response.astype(np.float32))
+weights = jnp.asarray(data.weight.astype(np.float32))
+loss = losses_mod.for_task(TaskType.LOGISTIC_REGRESSION)
+loss_fn = lambda s: jnp.sum(weights * loss.loss(s, labels))
+ck = CoordinateDescentCheckpointer(
+    os.path.join(outdir, f"ckpt-host{proc_id}"),
+    run_fingerprint="elastic-harness", save_every=1,
+)
+
+t0 = time.perf_counter()
+replans = 0
+blocks_moved = blocks_total = 0
+while True:
+    cd = CoordinateDescent({"fixed": fe_coord, "per-user": re_coord}, loss_fn)
+    try:
+        result = cd.run(num_iterations=2, num_rows=N, checkpointer=ck)
+        break
+    except ReplanRequired as e:
+        replans += 1
+        print(
+            f"DRAINED proc={proc_id} for proposal v{e.proposal['version']} "
+            f"(partial={'yes' if e.partial else 'no'})",
+            flush=True,
+        )
+        old_epoch = re_coord._epoch
+        try:
+            res = session.replan(
+                re_coord.manifest, e.proposal,
+                state_dir=re_coord.replan_state_dirs(),
+                epoch=old_epoch,
+            )
+        except ReplanBarrierError as err:
+            # the recorded fallback: the supervisor path takes over
+            print(f"supervised-relaunch fallback: {err}", flush=True)
+            raise
+        exec_plan = exec_plan.record_replan(
+            res.plan_version, res.decisions[0]
+        )
+        print("PLANDECISION " + exec_plan.describe_decisions()[-1], flush=True)
+        print(
+            f"replanned_to_v{res.plan_version} proc={proc_id} "
+            f"blocks_moved={res.blocks_moved}/{res.blocks_total} "
+            f"incoming={len(res.incoming)} rebuilt={len(res.rebuilt)}",
+            flush=True,
+        )
+        blocks_moved, blocks_total = res.blocks_moved, res.blocks_total
+        # re-bind the RE coordinate onto the re-based manifest; epochs
+        # continue ABOVE the interrupted numbering; the checkpoint restore
+        # (plan-versioned refs + done_global_ids) resumes mid-epoch
+        re_coord = make_re_coord(res.manifest, initial_epoch=old_epoch + 1)
+elapsed = time.perf_counter() - t0
+
+if replans == 0:
+    print("ELASTIC-NEVER-TRIGGERED", flush=True)
+    sys.exit(4)
+
+mh.barrier("cd-done")
+means = re_coord.entity_means_by_raw_id(result.coefficients["per-user"])
+np.savez(
+    os.path.join(outdir, f"means-host{proc_id}.npz"),
+    names=np.asarray(sorted(means), dtype=object),
+    stack=np.stack([means[k] for k in sorted(means)])
+    if means else np.zeros((0, 0)),
+)
+if mh.coordinator_only_io():
+    np.savez(
+        os.path.join(outdir, "run.npz"),
+        fe=np.asarray(result.coefficients["fixed"]),
+        total_scores=np.asarray(result.total_scores),
+        objectives=np.asarray(result.objective_history, np.float64),
+    )
+mh.barrier("saved")
+print(
+    f"ELASTICOK proc={proc_id} mode={MODE} replans={replans} "
+    f"blocks_moved={blocks_moved}/{blocks_total} "
+    f"plan_version={monitor.membership.version} "
+    f"elapsed={elapsed:.2f}s obj={result.objective_history[-1]:.9g}",
+    flush=True,
+)

@@ -24,7 +24,8 @@ def test_list_sections_enumerates_all_sections():
         "dense", "sparse", "sparse_race", "game", "game5", "grid",
         "streaming", "streaming_pipeline", "compile_reuse", "compaction",
         "preemption_resume",
-        "perhost", "perhost_streaming", "scoring", "serving",
+        "perhost", "perhost_streaming", "elastic_reshard", "scoring",
+        "serving",
         "serving_fleet", "quantized_serving", "retrain_delta", "ingest",
     ]
 

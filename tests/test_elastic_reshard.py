@@ -1,0 +1,998 @@
+"""Elastic entity re-sharding: versioned EntityShardPlans, the
+detect -> agree -> delta-transfer -> re-base -> resume protocol
+(parallel/elastic.py), and its chaos surfaces.
+
+Fast single-process coverage simulates an N-host fleet by building each
+physical host's manifest from the full dataset (routing is the identity at
+num_processes=1, and block content is host-invariant — the PR 9 bitwise
+foundation), then drives the real session protocol end to end: plan
+version round trips, replan determinism, delta transfer with byte-equal
+blocks, mid-epoch drain + resume bitwise, checkpoint-written-under-v1
+restores-under-v2, the per-block cache satellite, and chaos for the three
+new fault sites. The 2-process loss/scale-up arms live in
+tests/elastic_reshard_worker.py (slow-marked)."""
+
+import json
+import os
+import socket
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from game_test_utils import make_glmix_data
+
+from photon_ml_tpu.algorithm.streaming_random_effect import (
+    StreamingRandomEffectCoordinate,
+    write_re_entity_blocks,
+)
+from photon_ml_tpu.data.game import RandomEffectDataConfig
+from photon_ml_tpu.optim.common import OptimizerConfig
+from photon_ml_tpu.ops.regularization import RegularizationContext
+from photon_ml_tpu.parallel.elastic import (
+    ElasticError,
+    ElasticMonitor,
+    ElasticSession,
+    FleetMembership,
+    ReplanBarrierError,
+    ReplanRequired,
+    declare_lost_hosts,
+    read_membership,
+    request_scale_up,
+)
+from photon_ml_tpu.parallel.perhost_ingest import HostRows, csr_to_padded
+from photon_ml_tpu.parallel.perhost_streaming import (
+    EntityShardPlan,
+    PerHostSpilledREState,
+    PerHostStreamingRandomEffectCoordinate,
+    build_perhost_streaming_manifest,
+    load_plan_sidecars,
+)
+from photon_ml_tpu.types import OptimizerType, TaskType
+
+pytestmark = pytest.mark.elastic
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+WORKER = os.path.join(REPO, "tests", "elastic_reshard_worker.py")
+
+RE_CFG = RandomEffectDataConfig("userId", "per_user")
+RE_OPT = OptimizerConfig(max_iterations=6, tolerance=1e-8)
+RE_REG = RegularizationContext.l2(0.2)
+# 8 entities/block over the 40-user fixture -> 5 blocks: enough that a
+# 3-host -> 2-host re-plan genuinely MOVES blocks (3 blocks over 3 hosts
+# happens to re-balance onto the same physical owners)
+BLOCK_ENTITIES = 8
+# shape ladder on BOTH the fleet builds and the single-host reference:
+# the 5 block shapes collapse onto ~2 compiled executables, keeping this
+# file's tier-1 cost down (the comparison stays bitwise — identical
+# ladder on both sides)
+LADDER = "8:2.0"
+
+
+def _sorted_vocab_data(rng=None, **kw):
+    rng = rng or np.random.default_rng(41)
+    data, _ = make_glmix_data(rng, **kw)
+    vocab = data.id_vocabs["userId"]
+    order = np.argsort(np.asarray(vocab, dtype=object))
+    remap = np.empty(len(vocab), np.int64)
+    remap[order] = np.arange(len(vocab))
+    data.ids["userId"] = remap[data.ids["userId"]].astype(np.int32)
+    data.id_vocabs["userId"] = [vocab[i] for i in order]
+    return data
+
+
+def _host_rows(data):
+    feats = data.shards["per_user"]
+    fi, fv = csr_to_padded(feats, data.num_rows)
+    vocab = data.id_vocabs["userId"]
+    return HostRows(
+        entity_raw_ids=[vocab[i] for i in data.ids["userId"]],
+        row_index=np.arange(data.num_rows, dtype=np.int64),
+        labels=data.response.astype(np.float32),
+        weights=data.weight.astype(np.float32),
+        offsets=data.offset.astype(np.float32),
+        feat_idx=fi, feat_val=fv, global_dim=feats.dim,
+    )
+
+
+@pytest.fixture(scope="module")
+def glmix():
+    return _sorted_vocab_data(
+        num_users=40, rows_per_user_range=(3, 12), d_fixed=4, d_random=3
+    )
+
+
+def _copy_membership(m: FleetMembership) -> FleetMembership:
+    return FleetMembership(m.version, list(m.hosts), dict(m.binding))
+
+
+def _build_fleet(data, tmp_path, membership, tag="fleet", **kw):
+    """One manifest per PHYSICAL process of the membership. Routing is the
+    identity at num_processes=1 and every block is a pure function of the
+    global data + plan, so the produced per-host layouts are byte-identical
+    to a real multi-process build's (the PR 9 invariant the 2-process
+    harness pins)."""
+    rows = _host_rows(data)
+    manifests = {}
+    for p in sorted(set(membership.binding.values())):
+        manifests[p] = build_perhost_streaming_manifest(
+            rows, RE_CFG, str(tmp_path / f"{tag}-host{p}"), None, 1, p,
+            block_entities=BLOCK_ENTITIES, bucketer=LADDER,
+            shared_vocab=data.id_vocabs["userId"],
+            membership=_copy_membership(membership), **kw,
+        )
+    return manifests
+
+
+def _coord(man, tmp_path, tag, **kw):
+    return PerHostStreamingRandomEffectCoordinate(
+        man, TaskType.LOGISTIC_REGRESSION,
+        OptimizerType.LBFGS, RE_OPT, RE_REG,
+        state_root=str(tmp_path / f"state-{tag}"),
+        ctx=None, num_processes=1, **kw,
+    )
+
+
+def _reference(data, tmp_path):
+    man = write_re_entity_blocks(
+        data, RE_CFG, str(tmp_path / "ref-blocks"),
+        block_entities=BLOCK_ENTITIES, bucketer=LADDER,
+    )
+    coord = StreamingRandomEffectCoordinate(
+        man, TaskType.LOGISTIC_REGRESSION,
+        OptimizerType.LBFGS, RE_OPT, RE_REG,
+        state_root=str(tmp_path / "ref-state"),
+    )
+    return man, coord
+
+
+def _resid(data, seed=5):
+    return jnp.asarray(
+        np.random.default_rng(seed).normal(size=data.num_rows)
+        .astype(np.float32)
+    )
+
+
+def _run_fleet_replan(fleet_dir, membership, manifests, proposal, *,
+                      state_dirs=None, epochs=None, rebuild=None,
+                      block_cache=None, block_key_base=None, timeout=30):
+    """Drive every physical host's session concurrently (the file-based
+    barrier needs all records before any host finishes)."""
+    phys = sorted(set(membership.binding.values()))
+    results, errors = {}, {}
+
+    def run(p):
+        try:
+            mon = ElasticMonitor(
+                str(fleet_dir), _copy_membership(membership), process_id=p
+            )
+            sess = ElasticSession(
+                str(fleet_dir), p, len(phys), mon, barrier_timeout=timeout,
+                block_cache=block_cache, block_key_base=block_key_base,
+            )
+            results[p] = sess.replan(
+                manifests[p], proposal,
+                state_dir=(state_dirs or {}).get(p),
+                epoch=(epochs or {}).get(p, 0),
+                rebuild_block=(rebuild or {}).get(p),
+            )
+        except BaseException as e:  # noqa: BLE001 — surfaced to the test below
+            errors[p] = e
+
+    threads = [threading.Thread(target=run, args=(p,)) for p in phys]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=timeout + 30)
+    if errors:
+        raise next(iter(errors.values()))
+    return results
+
+
+def _proposal_for(fleet_dir, membership, process_id=0):
+    mon = ElasticMonitor(
+        str(fleet_dir), _copy_membership(membership), process_id=process_id
+    )
+    prop = mon.poll(force=True)
+    assert prop is not None, "monitor saw no membership change"
+    return prop
+
+
+# ---------------------------------------------------------------------------
+# the versioned plan
+# ---------------------------------------------------------------------------
+
+
+class TestPlanVersioning:
+    def test_build_records_version_hosts_costs(self, glmix, tmp_path):
+        mem = FleetMembership.initial(2)
+        man = _build_fleet(glmix, tmp_path, mem)[0]
+        assert man.plan_version == 1
+        meta, owners, block_of = load_plan_sidecars(man.dir)
+        assert meta is not None
+        assert meta["version"] == 1
+        assert meta["hosts"] == [0, 1]
+        assert meta["binding"] == {"0": 0, "1": 1}
+        assert len(meta["block_costs"]) == man.num_blocks_total
+        assert len(owners) == man.num_blocks_total
+
+    def test_default_hosts_match_preversioned_assignment(self, glmix):
+        """hosts=None must reproduce the pre-elastic owner map exactly —
+        existing 2-process layouts (and their bitwise pins) are unchanged."""
+        from photon_ml_tpu.parallel.shuffle import balanced_bucket_owners
+
+        ids = glmix.ids["userId"]
+        counts = np.bincount(ids, minlength=int(ids.max()) + 1)
+        plan = EntityShardPlan.build(
+            counts, 2, global_dim=glmix.shards["per_user"].dim,
+            block_entities=16,
+        )
+        np.testing.assert_array_equal(
+            plan.owners, balanced_bucket_owners(plan.block_costs, 2)
+        )
+
+    def test_replan_is_deterministic_and_keeps_blocks(self, glmix):
+        ids = glmix.ids["userId"]
+        counts = np.bincount(ids, minlength=int(ids.max()) + 1)
+        plan = EntityShardPlan.build(
+            counts, 3, global_dim=glmix.shards["per_user"].dim,
+            block_entities=16, hosts=[0, 1, 2],
+        )
+        a = plan.replan([0, 2])
+        b = plan.replan([2, 0])  # order-insensitive: survivor SET decides
+        np.testing.assert_array_equal(a.owners, b.owners)
+        assert a.version == b.version == 2
+        assert set(a.owners.tolist()) <= {0, 2}
+        # the blocking is membership-invariant
+        for x, y in zip(plan.blocks, a.blocks):
+            np.testing.assert_array_equal(x, y)
+        np.testing.assert_array_equal(plan.block_costs, a.block_costs)
+        assert a.replan([0]).version == 3
+
+    def test_delta_is_only_the_changed_owners(self, glmix):
+        ids = glmix.ids["userId"]
+        counts = np.bincount(ids, minlength=int(ids.max()) + 1)
+        mem = FleetMembership(1, [0, 1, 2], {0: 0, 1: 1, 2: 1})
+        plan = EntityShardPlan.build(
+            counts, 2, global_dim=glmix.shards["per_user"].dim,
+            block_entities=16, hosts=mem.hosts,
+        )
+        mem2 = mem.without([2])
+        plan2 = plan.replan(mem2.hosts)
+        moved = plan.moved_blocks(plan2, mem, mem2)
+        old_phys = mem.physical_owners(plan.owners)
+        new_phys = mem2.physical_owners(plan2.owners)
+        moved_gids = {g for g, _, _ in moved}
+        for g in range(len(plan.owners)):
+            if g in moved_gids:
+                assert old_phys[g] != new_phys[g]
+            else:
+                assert old_phys[g] == new_phys[g]
+
+
+# ---------------------------------------------------------------------------
+# the full session protocol (simulated fleet, real files)
+# ---------------------------------------------------------------------------
+
+
+class TestReplanEndToEnd:
+    def test_loss_redistributes_blocks_byte_identical(self, glmix, tmp_path):
+        """Lose virtual owner 2 (its blocks lived on physical 1): survivors
+        agree v2, ONLY the delta blocks move as file copies, and the
+        re-based fleet solves to the single-host reference bitwise."""
+        mem = FleetMembership(1, [0, 1, 2], {0: 0, 1: 1, 2: 1})
+        manifests = _build_fleet(glmix, tmp_path, mem)
+        ref_man, ref_coord = _reference(glmix, tmp_path)
+        fleet = tmp_path / "fleet-dir"
+        declare_lost_hosts(str(fleet), [2], reason="spot reclamation")
+        prop = _proposal_for(fleet, mem)
+        assert prop["version"] == 2 and prop["hosts"] == [0, 1]
+        results = _run_fleet_replan(fleet, mem, manifests, prop)
+
+        total = results[0].blocks_total
+        assert results[0].plan_version == 2
+        assert results[0].moved == results[1].moved  # agreed delta
+        assert 0 < results[0].blocks_moved <= total
+        owned0 = results[0].manifest.global_block_ids
+        owned1 = results[1].manifest.global_block_ids
+        assert sorted(owned0 + owned1) == list(range(total))
+        committed = read_membership(str(fleet))
+        assert committed is not None and committed.version == 2
+
+        # every owned block file is byte-identical to the single-host build
+        for p, res in results.items():
+            man = res.manifest
+            assert man.plan_version == 2
+            for b in man.blocks:
+                ref = np.load(os.path.join(ref_man.dir, b["file"]))
+                got = np.load(os.path.join(man.dir, b["file"]))
+                for k in ref.files:
+                    np.testing.assert_array_equal(
+                        ref[k], got[k], err_msg=(p, b["file"], k)
+                    )
+
+        # and the re-based fleet trains to the reference bitwise
+        resid = _resid(glmix)
+        s_ref, _ = ref_coord.update(resid, ref_coord.initial_coefficients())
+        ref_means = ref_coord.entity_means_by_raw_id(s_ref)
+        merged = {}
+        for p, res in results.items():
+            coord = _coord(res.manifest, tmp_path, f"post-{p}")
+            s, _ = coord.update(resid, coord.initial_coefficients())
+            for k, v in coord.entity_means_by_raw_id(s).items():
+                assert k not in merged  # disjoint ownership
+                merged[k] = v
+        assert sorted(merged) == sorted(ref_means)
+        for k in ref_means:
+            np.testing.assert_array_equal(merged[k], ref_means[k], err_msg=k)
+
+    def test_scale_up_moves_blocks_to_new_owner(self, glmix, tmp_path):
+        mem = FleetMembership(1, [0, 1], {0: 0, 1: 1})
+        manifests = _build_fleet(glmix, tmp_path, mem)
+        fleet = tmp_path / "fleet-dir"
+        request_scale_up(str(fleet), {2: 0}, reason="capacity arrived")
+        prop = _proposal_for(fleet, mem, process_id=1)
+        assert prop["hosts"] == [0, 1, 2] and prop["binding"]["2"] == 0
+        results = _run_fleet_replan(fleet, mem, manifests, prop)
+        assert results[0].plan_version == 2
+        # the new owner's blocks landed somewhere real: ownership is still
+        # a partition and the plan now names three hosts
+        meta, owners, _ = load_plan_sidecars(results[0].manifest.dir)
+        assert meta["hosts"] == [0, 1, 2]
+        assert set(owners.tolist()) == {0, 1, 2}
+
+    def test_replan_refuses_binding_outside_cohort(self, glmix, tmp_path):
+        """A scale-up typo binding an owner to a nonexistent physical
+        process must refuse LOUDLY: its blocks would have no hosting
+        process and training would silently drop those entities."""
+        mem = FleetMembership.initial(2)
+        manifests = _build_fleet(glmix, tmp_path, mem, tag="oc")
+        mon = ElasticMonitor(
+            str(tmp_path / "oc-f"), _copy_membership(mem), 0
+        )
+        sess = ElasticSession(str(tmp_path / "oc-f"), 0, 2, mon)
+        bad = dict(mem.with_added({2: 7}).to_meta(), reason="typo")
+        with pytest.raises(ElasticError, match="orphaned"):
+            sess.replan_prepare(manifests[0], bad)
+
+    def test_operator_files_consumed_no_livelock(self, glmix, tmp_path):
+        """Regression: lost-hosts.json / scale-request.json are archived
+        once fully folded into a committed membership — re-adding a
+        previously-lost owner must not ping-pong remove/add proposals."""
+        mem = FleetMembership(1, [0, 1, 2], {0: 0, 1: 1, 2: 1})
+        manifests = _build_fleet(glmix, tmp_path, mem, tag="lv")
+        fleet = tmp_path / "lv-fleet"
+        declare_lost_hosts(str(fleet), [2])
+        prop = _proposal_for(fleet, mem)
+        results = _run_fleet_replan(fleet, mem, manifests, prop)
+        assert not (fleet / "lost-hosts.json").exists()
+        assert (fleet / "lost-hosts.json.consumed-v2").exists()
+        mem2 = results[0].membership
+        manifests2 = {p: r.manifest for p, r in results.items()}
+        request_scale_up(str(fleet), {2: 1}, reason="capacity back")
+        prop2 = _proposal_for(fleet, mem2, process_id=1)
+        assert prop2["hosts"] == [0, 1, 2]
+        results2 = _run_fleet_replan(fleet, mem2, manifests2, prop2)
+        assert not (fleet / "scale-request.json").exists()
+        # the settled fleet proposes NOTHING further (the livelock check)
+        mem3 = results2[0].membership
+        for p in (0, 1):
+            mon = ElasticMonitor(
+                str(fleet), _copy_membership(mem3), process_id=p
+            )
+            assert mon.poll(force=True) is None
+
+    def test_plan_sidecar_roundtrip_reconstructs_plan(self, glmix, tmp_path):
+        """EntityShardPlan.from_sidecars rebuilds the FULL plan (blocks
+        included — the inverse of block_of_vocab) so the session's re-plan
+        runs the same replan()/moved_blocks() methods the unit tests pin."""
+        mem = FleetMembership(1, [0, 1, 2], {0: 0, 1: 1, 2: 1})
+        man = _build_fleet(glmix, tmp_path, mem, tag="rt")[0]
+        built = EntityShardPlan.from_sidecars(man.dir)
+        assert built is not None
+        ids = glmix.ids["userId"]
+        counts = np.bincount(ids, minlength=int(ids.max()) + 1)
+        ref = EntityShardPlan.build(
+            counts, 1, global_dim=glmix.shards["per_user"].dim,
+            block_entities=BLOCK_ENTITIES, hosts=mem.hosts,
+        )
+        assert built.version == ref.version and built.hosts == ref.hosts
+        np.testing.assert_array_equal(built.owners, ref.owners)
+        np.testing.assert_array_equal(built.block_costs, ref.block_costs)
+        np.testing.assert_array_equal(built.block_of_vocab, ref.block_of_vocab)
+        assert len(built.blocks) == len(ref.blocks)
+        for a, b in zip(built.blocks, ref.blocks):
+            np.testing.assert_array_equal(a, b)
+
+    def test_membership_change_restarts_heartbeat_grace(self, tmp_path):
+        """Regression: a re-added owner's STALE pre-removal heartbeat (or
+        a just-added owner with no beat yet) must not be declared lost
+        before one full deadline under the NEW membership."""
+        fleet = tmp_path / "gr-fleet"
+        hb_dir = fleet / "heartbeats"
+        hb_dir.mkdir(parents=True)
+        now = [1000.0]
+        mem = FleetMembership(2, [0, 1, 2], {0: 0, 1: 1, 2: 1})
+        stale = {"process": 2, "time": now[0] - 60, "step": 0}
+        (hb_dir / "heartbeat-2.json").write_text(json.dumps(stale))
+        mon = ElasticMonitor(
+            str(fleet), _copy_membership(mem), process_id=0,
+            heartbeat_deadline=5.0, min_poll_interval=0.0,
+            clock=lambda: now[0],
+        )
+        mon.install_membership(_copy_membership(mem))
+        assert mon.poll(force=True) is None  # grace: implicit fresh beat
+        now[0] += 10.0  # past the deadline with STILL no beat -> lost
+        prop = mon.poll(force=True)
+        assert prop is not None and 2 not in prop["hosts"]
+
+    def test_physical_owners_diagnostic_for_unknown_max_host(self):
+        mem = FleetMembership(1, [0, 1, 2], {0: 0, 1: 1, 2: 1}).without([2])
+        with pytest.raises(ValueError, match=r"owners \[2\].*membership"):
+            mem.physical_owners(np.asarray([0, 2, 1]))
+
+    def test_replan_rejects_version_gap(self, glmix, tmp_path):
+        mem = FleetMembership(1, [0, 1], {0: 0, 1: 1})
+        manifests = _build_fleet(glmix, tmp_path, mem)
+        mon = ElasticMonitor(str(tmp_path / "f"), _copy_membership(mem), 0)
+        sess = ElasticSession(str(tmp_path / "f"), 0, 2, mon)
+        gap = dict(mem.with_added({2: 0}).to_meta())
+        gap["version"] = 5
+        with pytest.raises(ElasticError, match="does not follow"):
+            sess.replan_prepare(manifests[0], gap)
+
+
+# ---------------------------------------------------------------------------
+# mid-epoch drain + resume, and the plan-versioned checkpoint ref
+# ---------------------------------------------------------------------------
+
+
+class _StubMonitor:
+    """Deterministic drain trigger: fires the proposal on the N-th poll."""
+
+    def __init__(self, fire_on, proposal):
+        self.calls = 0
+        self.fire_on = fire_on
+        self.proposal = proposal
+
+    def poll(self, step=None, force=False):
+        self.calls += 1
+        return self.proposal if self.calls >= self.fire_on else None
+
+
+class TestDrainAndResume:
+    def test_block_boundary_drain_carries_done_gids(self, glmix, tmp_path):
+        mem = FleetMembership(1, [0, 1], {0: 0, 1: 0})  # all blocks local
+        man = _build_fleet(glmix, tmp_path, mem)[0]
+        prop = dict(mem.without([1]).to_meta(), reason="stub")
+        coord = _coord(man, tmp_path, "drain",
+                       elastic=_StubMonitor(2, prop))
+        resid = _resid(glmix)
+        with pytest.raises(ReplanRequired) as ei:
+            coord.update(resid, coord.initial_coefficients())
+        partial = ei.value.partial
+        assert partial is not None
+        m = partial["meta"]
+        assert m["kind"] == "streaming_re"
+        assert m["plan_version"] == 1
+        assert len(m["done_global_ids"]) == m["blocks_done"] >= 1
+        assert ei.value.proposal["version"] == 2
+
+        # resume on a REBUILT coordinate (same manifest/state_root, the
+        # epoch floor raised past the interrupted epoch) is bitwise the
+        # uninterrupted run
+        resumed = _coord(man, tmp_path, "drain", initial_epoch=2)
+        s_res, _ = resumed.update(
+            resid, resumed.initial_coefficients(), resume=partial
+        )
+        plain = _coord(man, tmp_path, "plain")
+        s_plain, _ = plain.update(resid, plain.initial_coefficients())
+        for i in range(len(man.blocks)):
+            np.testing.assert_array_equal(s_res.block(i), s_plain.block(i))
+
+    def test_update_entry_drain_has_no_partial(self, glmix, tmp_path):
+        mem = FleetMembership(1, [0, 1], {0: 0, 1: 0})
+        man = _build_fleet(glmix, tmp_path, mem, tag="entry")[0]
+        prop = dict(mem.without([1]).to_meta(), reason="stub")
+        coord = _coord(man, tmp_path, "entry", elastic=_StubMonitor(1, prop))
+        with pytest.raises(ReplanRequired) as ei:
+            coord.update(_resid(glmix), coord.initial_coefficients())
+        assert ei.value.partial is None
+
+    def test_checkpoint_v1_restores_under_v2(self, glmix, tmp_path):
+        """The checkpoint.py satellite: refs written under plan v1 rebuild
+        under the re-planned v2 manifest — per-global-id shapes validated,
+        moved-in coefficient files present after the session's re-base."""
+        mem = FleetMembership.initial(2)
+        manifests = _build_fleet(glmix, tmp_path, mem)
+        resid = _resid(glmix)
+        coords = {p: _coord(m, tmp_path, f"ck-{p}")
+                  for p, m in manifests.items()}
+        states = {}
+        for p, c in coords.items():
+            states[p], _ = c.update(resid, c.initial_coefficients())
+        refs = {p: s.__checkpoint_ref__() for p, s in states.items()}
+        for p in refs:
+            assert refs[p]["kind"] == "perhost_spilled_re_state"
+            assert refs[p]["plan_version"] == 1
+
+        fleet = tmp_path / "ck-fleet"
+        declare_lost_hosts(str(fleet), [1])
+        prop = _proposal_for(fleet, mem)
+        # the coordinate names EVERY live spill dir (input + output): the
+        # checkpoint a drain leaves behind may reference either one
+        # depending on the drained boundary (the FE-boundary case
+        # restores the update's OUTPUT)
+        for p, c in coords.items():
+            assert c.replan_state_dirs()[-1] == states[p].dir
+        results = _run_fleet_replan(
+            fleet, mem, manifests, prop,
+            state_dirs={p: coords[p].replan_state_dirs()
+                        for p in coords},
+            epochs={p: 1 for p in states},
+        )
+        # physical 0 now owns everything; its re-based manifest's template
+        # rebuilds the v1 ref — including blocks moved in from host 1
+        new_man = results[0].manifest
+        assert sorted(new_man.global_block_ids) == list(
+            range(results[0].blocks_total)
+        )
+        template = _coord(new_man, tmp_path, "ck-post").initial_coefficients()
+        assert isinstance(template, PerHostSpilledREState)
+        rebuilt = template.__checkpoint_from_ref__(refs[0])
+        gid_of = {p: list(manifests[p].global_block_ids)
+                  for p in manifests}
+        for i, g in enumerate(new_man.global_block_ids):
+            src_p = 0 if g in gid_of[0] else 1
+            want = states[src_p].block(gid_of[src_p].index(g))
+            np.testing.assert_array_equal(
+                rebuilt.block(i), want, err_msg=f"gid {g}"
+            )
+
+    def test_preelastic_positional_ref_is_refused(self, glmix, tmp_path):
+        from photon_ml_tpu.checkpoint import CheckpointRefError
+
+        mem = FleetMembership.initial(1)
+        man = _build_fleet(glmix, tmp_path, mem, tag="old")[0]
+        template = _coord(man, tmp_path, "old").initial_coefficients()
+        old_ref = {"kind": "spilled_re_state", "dir": str(tmp_path),
+                   "shapes": [], "written": False}
+        with pytest.raises(CheckpointRefError, match="pre-elastic"):
+            template.__checkpoint_from_ref__(old_ref)
+
+
+# ---------------------------------------------------------------------------
+# the per-block cache satellite
+# ---------------------------------------------------------------------------
+
+
+class TestOwnedBlockCacheKeys:
+    def test_unmoved_blocks_keep_warm_entries_across_topology_change(
+        self, glmix, tmp_path
+    ):
+        """Regression for the blanket topology-change invalidation: the
+        per-block entries are keyed on owned-block IDENTITY (no process
+        scope), so losing 1 host of 3 leaves every survivor block's entry
+        warm — the old process-scoped dir key rebuilt everything."""
+        from photon_ml_tpu.io.tensor_cache import CacheStats, TensorCache
+
+        mem3 = FleetMembership.initial(3)
+        base = "elastic-cache-test"
+        stats_cold = CacheStats()
+        cache = TensorCache(str(tmp_path / "bc"), stats=stats_cold)
+        manifests = _build_fleet(
+            glmix, tmp_path, mem3, tag="c3",
+            block_cache=cache, block_key_base=base,
+        )
+        total = manifests[0].num_blocks_total
+        cold = stats_cold.snapshot()
+        assert cold["hits"] == 0 and cold["writes"] == total
+
+        # the topology changes (3 -> 2 hosts): rebuilt layouts must HIT
+        # for every block — none of the block tensors changed
+        stats_warm = CacheStats()
+        warm_cache = TensorCache(str(tmp_path / "bc"), stats=stats_warm)
+        mem2 = FleetMembership(2, [0, 1], {0: 0, 1: 1})
+        manifests2 = _build_fleet(
+            glmix, tmp_path, mem2, tag="c2",
+            block_cache=warm_cache, block_key_base=base,
+        )
+        warm = stats_warm.snapshot()
+        owned2 = sum(len(m.blocks) for m in manifests2.values())
+        assert owned2 == total
+        assert warm["hits"] == total
+        assert warm["misses"] == 0
+
+    def test_dir_cache_and_block_cache_compose(self, glmix, tmp_path):
+        """The multihost driver passes BOTH: the scoped dir-level entry
+        (identical-topology fast path) and the unscoped per-block entries.
+        A dir hit short-circuits before any block-cache traffic; a dir
+        miss (fresh scope) rebuilds through warm block entries."""
+        from photon_ml_tpu.io.tensor_cache import (
+            CacheStats,
+            TensorCache,
+            process_shard_scope,
+        )
+
+        src = tmp_path / "in.bin"
+        src.write_bytes(b"inputs")
+        dir_cache = TensorCache(
+            str(tmp_path / "tc"), shard_scope=process_shard_scope(0, 1),
+        )
+        key = dir_cache.key_for([str(src)], {"kind": "elastic-compose"})
+        bstats = CacheStats()
+        bcache = TensorCache(str(tmp_path / "tc"), stats=bstats)
+        rows = _host_rows(glmix)
+        kw = dict(
+            block_entities=BLOCK_ENTITIES, bucketer=LADDER,
+            shared_vocab=glmix.id_vocabs["userId"],
+            tensor_cache=dir_cache, cache_key=key,
+            block_cache=bcache, block_key_base="compose-test",
+        )
+        man1 = build_perhost_streaming_manifest(
+            rows, RE_CFG, str(tmp_path / "b1"), None, 1, 0, **kw
+        )
+        writes_after_build = bstats.snapshot()["writes"]
+        assert writes_after_build == len(man1.blocks)
+        man2 = build_perhost_streaming_manifest(
+            rows, RE_CFG, str(tmp_path / "b2"), None, 1, 0, **kw
+        )
+        # dir-level hit: same committed entry, no new block-cache traffic
+        assert man2.dir == man1.dir
+        snap = bstats.snapshot()
+        assert snap["writes"] == writes_after_build
+        assert snap["hits"] == 0
+
+    def test_scoped_dir_keys_still_differ_per_topology(self, tmp_path):
+        """The dir-level scoped key keeps its old semantics (identical
+        topology -> identical key; topology change -> rebuild)."""
+        from photon_ml_tpu.io.tensor_cache import process_shard_scope
+
+        assert process_shard_scope(0, 2) != process_shard_scope(0, 3)
+
+
+# ---------------------------------------------------------------------------
+# chaos: the three new fault sites
+# ---------------------------------------------------------------------------
+
+
+class TestChaos:
+    def test_replan_barrier_fault_falls_back(self, glmix, tmp_path,
+                                             monkeypatch):
+        mem = FleetMembership(1, [0, 1], {0: 0, 1: 0})
+        man = _build_fleet(glmix, tmp_path, mem, tag="bar")[0]
+        fleet = tmp_path / "bar-fleet"
+        declare_lost_hosts(str(fleet), [1])
+        prop = _proposal_for(fleet, mem)
+        monkeypatch.setenv(
+            "PHOTON_FAULTS", "multihost.replan_barrier:rate=1.0,seed=2"
+        )
+        mon = ElasticMonitor(str(fleet), _copy_membership(mem), 0)
+        sess = ElasticSession(str(fleet), 0, 1, mon, barrier_timeout=5)
+        with pytest.raises(ReplanBarrierError, match="supervised relaunch"):
+            sess.replan(man, prop)
+
+    def test_barrier_timeout_names_missing_peer(self, glmix, tmp_path):
+        mem = FleetMembership.initial(2)
+        manifests = _build_fleet(glmix, tmp_path, mem, tag="tm")
+        fleet = tmp_path / "tm-fleet"
+        declare_lost_hosts(str(fleet), [1])
+        # NOTE: losing logical host 1 still expects PHYSICAL process 1 to
+        # ack (virtual elasticity keeps the cohort); here process 1 never
+        # shows up — the deadline converts the hang into the fallback
+        prop = _proposal_for(fleet, mem)
+        mon = ElasticMonitor(str(fleet), _copy_membership(mem), 0)
+        sess = ElasticSession(str(fleet), 0, 2, mon, barrier_timeout=1.0)
+        with pytest.raises(ReplanBarrierError, match=r"\[1\]"):
+            sess.replan(manifests[0], prop)
+
+    def test_block_transfer_fault_degrades_to_recorded_cold_rebuild(
+        self, glmix, tmp_path, monkeypatch
+    ):
+        mem = FleetMembership(1, [0, 1, 2], {0: 0, 1: 1, 2: 1})
+        manifests = _build_fleet(glmix, tmp_path, mem, tag="tf")
+        ref_man, _ = _reference(glmix, tmp_path)
+
+        def rebuild(gi):
+            # the durable single-host layout doubles as the re-ingest
+            # oracle: a real driver re-decodes the block's rows instead
+            z = np.load(os.path.join(ref_man.dir, f"block-{gi:05d}.npz"))
+            return {k: np.asarray(z[k]) for k in z.files}
+
+        fleet = tmp_path / "tf-fleet"
+        declare_lost_hosts(str(fleet), [2])
+        prop = _proposal_for(fleet, mem)
+        monkeypatch.setenv(
+            "PHOTON_FAULTS", "io.block_transfer:rate=1.0,seed=5"
+        )
+        results = _run_fleet_replan(
+            fleet, mem, manifests, prop,
+            rebuild={0: rebuild, 1: rebuild},
+        )
+        incoming = [g for r in results.values() for g in r.incoming]
+        rebuilt = [g for r in results.values() for g in r.rebuilt]
+        assert incoming and sorted(rebuilt) == sorted(incoming)
+        assert any("cold rebuild" in d
+                   for r in results.values() for d in r.decisions)
+        # never a wrong result: rebuilt block files byte-match the
+        # single-host reference
+        for r in results.values():
+            for b in r.manifest.blocks:
+                ref = np.load(os.path.join(ref_man.dir, b["file"]))
+                got = np.load(os.path.join(r.manifest.dir, b["file"]))
+                for k in ref.files:
+                    np.testing.assert_array_equal(ref[k], got[k])
+
+    def test_block_transfer_fault_without_rebuilder_is_loud(
+        self, glmix, tmp_path, monkeypatch
+    ):
+        mem = FleetMembership(1, [0, 1, 2], {0: 0, 1: 1, 2: 1})
+        manifests = _build_fleet(glmix, tmp_path, mem, tag="tl")
+        fleet = tmp_path / "tl-fleet"
+        declare_lost_hosts(str(fleet), [2])
+        prop = _proposal_for(fleet, mem)
+        monkeypatch.setenv(
+            "PHOTON_FAULTS", "io.block_transfer:rate=1.0,seed=5"
+        )
+        # short barrier: the failing host aborts, so its peer's done-wait
+        # must expire rather than hold the test open
+        with pytest.raises(ElasticError, match="missing block"):
+            _run_fleet_replan(fleet, mem, manifests, prop, timeout=3)
+
+    def test_scale_up_with_out_of_cohort_binding_never_publishes(
+        self, tmp_path
+    ):
+        """Regression: proposals are first-writer-wins and never
+        retracted, so an invalid binding must be rejected BEFORE
+        publication (a published one would wedge every later re-plan)."""
+        fleet = tmp_path / "oc2-fleet"
+        mem = FleetMembership.initial(2)
+        request_scale_up(str(fleet), {3: 7}, reason="typo")
+        mon = ElasticMonitor(
+            str(fleet), _copy_membership(mem), process_id=0,
+            num_processes=2,
+        )
+        assert mon.poll(force=True) is None
+        assert not (fleet / "proposals" / "proposal-v2.json").exists()
+        # a corrected request goes through
+        request_scale_up(str(fleet), {3: 1}, reason="fixed")
+        prop = mon.poll(force=True)
+        assert prop is not None and prop["binding"]["3"] == 1
+
+    def test_degenerate_all_hosts_lost_is_ignored_not_crashed(
+        self, tmp_path
+    ):
+        """A declaration naming EVERY owner cannot re-plan; it must be
+        ignored with a log, never escape a drain poll as a non-Preempted
+        crash past CD's emergency-checkpoint machinery."""
+        fleet = tmp_path / "dg-fleet"
+        mem = FleetMembership.initial(2)
+        declare_lost_hosts(str(fleet), [0, 1], reason="decommission typo")
+        mon = ElasticMonitor(
+            str(fleet), _copy_membership(mem), process_id=0
+        )
+        assert mon.poll(force=True) is None
+
+    def test_torn_plan_sidecars_refuse_loudly(self, glmix, tmp_path):
+        """A crash between the three sidecar renames leaves arrays and
+        plan.json from different plan versions — detected via the digests
+        plan.json records, not silently mixed into an empty delta."""
+        mem = FleetMembership.initial(1)
+        man = _build_fleet(glmix, tmp_path, mem, tag="torn")[0]
+        owners_path = os.path.join(man.dir, "plan-owners.npy")
+        torn = np.load(owners_path)
+        np.save(owners_path, (torn + 1).astype(np.int32))
+        with pytest.raises(ValueError, match="torn"):
+            load_plan_sidecars(man.dir)
+
+    def test_membership_site_is_retried(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("PHOTON_FAULTS", "multihost.membership:at=1")
+        mem = FleetMembership.initial(2)
+        from photon_ml_tpu.parallel.elastic import commit_membership
+
+        commit_membership(str(tmp_path / "m"), mem)
+        got = read_membership(str(tmp_path / "m"))
+        assert got is not None and got.version == 1 and got.hosts == [0, 1]
+
+    def test_heartbeat_deadline_detection_proposes_removal(self, tmp_path):
+        fleet = tmp_path / "hb-fleet"
+        mem = FleetMembership.initial(2)
+        hb_dir = fleet / "heartbeats"
+        hb_dir.mkdir(parents=True)
+        stale = {"process": 1, "time": time.time() - 60, "step": 0}
+        (hb_dir / "heartbeat-1.json").write_text(json.dumps(stale))
+        now = [time.time()]
+        mon = ElasticMonitor(
+            str(fleet), _copy_membership(mem), process_id=0,
+            heartbeat_deadline=5.0, clock=lambda: now[0],
+        )
+        # inside the startup grace (ages are capped at time-under-this-
+        # membership) nothing is lost yet; once the deadline elapses with
+        # no fresh beat, host 1 is proposed out
+        assert mon.poll(force=True) is None
+        now[0] += 10.0
+        prop = mon.poll(force=True)
+        assert prop is not None
+        assert prop["hosts"] == [0]
+        assert "heartbeat" in prop["reason"]
+
+    def test_missing_heartbeat_respects_startup_grace(self, tmp_path):
+        from photon_ml_tpu.parallel.multihost import lost_hosts
+
+        # a peer that NEVER beat is only lost once the observer's uptime
+        # exceeds the deadline
+        assert lost_hosts({}, [1], 5.0, missing_grace_elapsed=2.0) == []
+        assert lost_hosts({}, [1], 5.0, missing_grace_elapsed=9.0) == [1]
+        assert lost_hosts({1: 7.0}, [1], 5.0) == [1]
+        assert lost_hosts({1: 3.0}, [1], 5.0) == []
+
+
+# ---------------------------------------------------------------------------
+# lint scope (satellite)
+# ---------------------------------------------------------------------------
+
+
+def test_elastic_module_in_scan_scope():
+    """parallel/elastic.py is inside photon-lint's default scan scope: its
+    three fault sites are registry-checked both ways, and a broad except
+    or bare jit in the re-plan path cannot land without tripping tier-1."""
+    from tools.photon_lint import engine
+
+    paths = [os.path.join(REPO, p) for p in engine.DEFAULT_SCOPE]
+    scanned = {
+        os.path.relpath(p, REPO).replace(os.sep, "/")
+        for p in engine.iter_py_files(paths)
+    }
+    assert "photon_ml_tpu/parallel/elastic.py" in scanned
+
+
+# ---------------------------------------------------------------------------
+# the 2-process arms (slow): loss + scale-up, bitwise vs single host
+# ---------------------------------------------------------------------------
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _launch_workers(tmp_path, mode, env_extra=None):
+    env = {
+        **os.environ,
+        "PHOTON_SOLVE_CHUNK": "off",
+        "PHOTON_SPARSE_KERNEL": "off",
+        "PHOTON_SHAPE_LADDER": "off",
+        "ELASTIC_MODE": mode,
+        **(env_extra or {}),
+    }
+    port = _free_port()
+    return [
+        subprocess.Popen(
+            [sys.executable, WORKER, str(i), "2", str(port), str(tmp_path)],
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+            text=True, cwd=REPO, env=env,
+        )
+        for i in range(2)
+    ]
+
+
+def _single_host_reference(tmp_path):
+    """The flags-off single-host streaming CD run of the workers' seeded
+    dataset — bitwise-equal (PR 9 pinned) to an uninterrupted run on ANY
+    topology, including the survivor/scaled topologies the elastic arms
+    end on."""
+    data = _sorted_vocab_data(
+        np.random.default_rng(97),
+        num_users=60, rows_per_user_range=(4, 16), d_fixed=5, d_random=4,
+    )
+    from photon_ml_tpu.algorithm.coordinate_descent import CoordinateDescent
+    from photon_ml_tpu.algorithm.streaming_fixed_effect import (
+        StreamingFixedEffectCoordinate,
+    )
+    from photon_ml_tpu.optim.problem import GLMOptimizationProblem
+    from photon_ml_tpu.optim.streaming import ChunkedGLMSource
+    from photon_ml_tpu.ops import losses as losses_mod
+
+    N = data.num_rows
+    man = write_re_entity_blocks(
+        data, RE_CFG, str(tmp_path / "ref-blocks"), block_entities=16
+    )
+    re_ref = StreamingRandomEffectCoordinate(
+        man, TaskType.LOGISTIC_REGRESSION,
+        OptimizerType.LBFGS, RE_OPT, RE_REG,
+        state_root=str(tmp_path / "ref-state"),
+    )
+    gf = data.shards["global"]
+    x_fe = np.zeros((N, gf.dim), np.float32)
+    x_fe[np.repeat(np.arange(N), np.diff(gf.indptr)), gf.indices] = gf.values
+    fe_ref = StreamingFixedEffectCoordinate(
+        ChunkedGLMSource.from_arrays(
+            x_fe, data.response.astype(np.float32), 128
+        ),
+        GLMOptimizationProblem(
+            TaskType.LOGISTIC_REGRESSION, OptimizerType.LBFGS,
+            OptimizerConfig(max_iterations=6, tolerance=1e-8),
+            RegularizationContext.l2(0.5),
+        ),
+    )
+    labels = jnp.asarray(data.response.astype(np.float32))
+    weights = jnp.asarray(data.weight.astype(np.float32))
+    loss = losses_mod.for_task(TaskType.LOGISTIC_REGRESSION)
+    cd = CoordinateDescent(
+        {"fixed": fe_ref, "per-user": re_ref},
+        lambda s: jnp.sum(weights * loss.loss(s, labels)),
+    )
+    ref = cd.run(num_iterations=2, num_rows=N)
+    ref_means = re_ref.entity_means_by_raw_id(ref.coefficients["per-user"])
+    return ref, ref_means
+
+
+def _assert_workers_match_reference(tmp_path, ref, ref_means):
+    run = np.load(tmp_path / "run.npz")
+    np.testing.assert_array_equal(
+        run["fe"], np.asarray(ref.coefficients["fixed"])
+    )
+    np.testing.assert_array_equal(
+        run["total_scores"], np.asarray(ref.total_scores)
+    )
+    np.testing.assert_array_equal(
+        run["objectives"], np.asarray(ref.objective_history, np.float64)
+    )
+    merged = {}
+    for pid in range(2):
+        z = np.load(tmp_path / f"means-host{pid}.npz", allow_pickle=True)
+        for name, vec in zip(z["names"], z["stack"]):
+            assert name not in merged
+            merged[str(name)] = vec
+    assert sorted(merged) == sorted(ref_means)
+    for k, vec in ref_means.items():
+        np.testing.assert_array_equal(merged[k], vec, err_msg=k)
+
+
+def _communicate(procs, timeout=900):
+    outs = []
+    for p in procs:
+        out, err = p.communicate(timeout=timeout)
+        assert p.returncode == 0, (
+            f"worker failed rc={p.returncode}:\n{out[-3000:]}\n{err[-3000:]}"
+        )
+        outs.append(out)
+    return outs
+
+
+@pytest.mark.slow
+def test_two_process_host_loss_replans_and_stays_bitwise(tmp_path):
+    """THE loss acceptance gate: 3 virtual owners on 2 processes; owner 2
+    is killed mid-epoch (its heartbeats stop + the loss is declared), the
+    fleet drains at block boundaries, re-plans within the deadline (NO
+    supervised relaunch), transfers only the delta blocks, and finishes
+    bitwise-equal to an uninterrupted run on the survivor topology (the
+    single-host reference — PR 9 pins their equality)."""
+    procs = _launch_workers(tmp_path, "loss")
+    outs = _communicate(procs)
+    assert all("ELASTICOK" in o for o in outs)
+    assert all("replanned_to_v2" in o for o in outs)
+    assert any("blocks_moved=" in o for o in outs)
+    assert not any("supervised-relaunch" in o for o in outs)
+    ref, ref_means = _single_host_reference(tmp_path)
+    _assert_workers_match_reference(tmp_path, ref, ref_means)
+
+
+@pytest.mark.slow
+def test_two_process_scale_up_redistributes_and_stays_bitwise(tmp_path):
+    """Scale-up arm: capacity arrives mid-run (operator request adds owner
+    2), the fleet re-plans, blocks redistribute onto the new owner, and the
+    run stays bitwise-equal."""
+    procs = _launch_workers(tmp_path, "scaleup")
+    outs = _communicate(procs)
+    assert all("ELASTICOK" in o for o in outs)
+    assert all("replanned_to_v2" in o for o in outs)
+    assert any("blocks_moved=" in o for o in outs)
+    ref, ref_means = _single_host_reference(tmp_path)
+    _assert_workers_match_reference(tmp_path, ref, ref_means)

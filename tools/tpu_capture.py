@@ -75,6 +75,26 @@ def bank_quantized_serving(payload):
     log(f"quantized_serving capture banked to {out}")
 
 
+def bank_elastic_reshard(payload):
+    """Bank the elastic_reshard section of a healthy TPU capture to
+    docs/ELASTIC_RESHARD_r15.json (replacing the CPU seed record). Only a
+    capture that actually ran the section's gates writes the file."""
+    keys = {k: v for k, v in payload.items() if k.startswith("elastic_reshard")}
+    if not keys or (payload.get("errors") or {}).get("elastic_reshard"):
+        log("elastic_reshard section absent/failed — doc record untouched")
+        return
+    keys["platform"] = payload.get("platform")
+    keys["note"] = (
+        "Self-captured on the live TPU via tools/tpu_capture.py "
+        f"({time.strftime('%Y-%m-%d %H:%M UTC', time.gmtime())})."
+    )
+    out = os.path.join(REPO, "docs", "ELASTIC_RESHARD_r15.json")
+    with open(out + ".tmp", "w") as f:
+        json.dump(keys, f, indent=1)
+    os.replace(out + ".tmp", out)
+    log(f"elastic_reshard capture banked to {out}")
+
+
 def main():
     # phase 1: the FULL BENCH first — it runs its own autotune race at the
     # bench shape, and if the tunnel dies again mid-capture the headline
@@ -114,6 +134,7 @@ def main():
             json.dump(payload, f, indent=1)
         log(f"TPU capture preserved to {out}")
         bank_quantized_serving(payload)
+        bank_elastic_reshard(payload)
         # phase 2: wider-shape autotune diagnostics (own claim; never
         # killed; losing this to a re-wedge costs only the report)
         rc = subprocess.run(
