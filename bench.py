@@ -3128,6 +3128,251 @@ def _bench_retrain_delta(extra, on_tpu):
         shutil.rmtree(tmp, ignore_errors=True)
 
 
+def _bench_delta_rollout(extra, on_tpu):
+    """Fleet-wide delta rollout (serve/fleet/swap.rollout_delta): the last
+    arc of the daily loop measured end to end — a committed delta
+    retrain's fleet export rolls through the generation barrier as ONE
+    atomic swap while request traffic flows. Arms: (1) provenance
+    refusals — an export built from the WRONG model and an unfinished
+    retrain (no committed retrain.json) must both abort with the old
+    generation still serving; (2) the timed rollout under concurrent
+    traffic: zero new compiles, zero dropped requests, and every
+    in-flight request scored WHOLLY at one generation (bitwise vs the
+    matching single-store oracle — never a mix); (3) post-rollout, the
+    full request set is bitwise-equal to the new generation's oracle.
+
+    Replicas are in-process (ReplicaEngine + LocalReplicaClient): the
+    barrier/pinning logic under test is transport-independent, and the
+    serving_fleet section already prices the TCP layer."""
+    import shutil
+    import tempfile
+    import threading
+    import time as _time
+
+    from game_test_utils import (
+        game_avro_records,
+        make_glmix_data,
+        save_synthetic_game_model,
+        serve_requests_from_records,
+    )
+
+    from photon_ml_tpu.compile import ShapeBucketer
+    from photon_ml_tpu.retrain.manifest import RetrainManifest
+    from photon_ml_tpu.serve import (
+        FleetStats,
+        ModelStore,
+        ScoringServer,
+        ServeStats,
+        build_model_store,
+    )
+    from photon_ml_tpu.serve.fleet import (
+        FleetRouter,
+        FleetSwapError,
+        FleetSwapper,
+        LocalReplicaClient,
+        ReplicaEngine,
+        build_fleet_stores,
+        load_fleet_meta,
+        replica_store_dir,
+    )
+
+    tmp = tempfile.mkdtemp(prefix="bench-delta-rollout-")
+    sections = {"global": ["fixedFeatures"], "per_user": ["userFeatures"]}
+    num_replicas = 2
+    try:
+        rng = np.random.default_rng(23)
+        num_users = 96
+        d_fixed, d_random = 8, 6
+        data, truth = make_glmix_data(
+            rng, num_users=num_users, rows_per_user_range=(4, 8),
+            d_fixed=d_fixed, d_random=d_random,
+        )
+        offsets = rng.normal(size=data.num_rows).astype(np.float32)
+        reqs = serve_requests_from_records(list(
+            game_avro_records(data, range(data.num_rows), truth, offsets)
+        ))
+
+        # two model generations (same shapes — a delta retrain never
+        # changes slab geometry) + their fleet exports and oracles
+        model_dirs, fleet_dirs, oracle = [], [], []
+        for g in range(2):
+            mdir = os.path.join(tmp, f"model-g{g}")
+            save_synthetic_game_model(
+                mdir, np.random.default_rng(1142 + g), d_fixed=d_fixed,
+                d_random=d_random, num_users=num_users,
+            )
+            fdir = os.path.join(tmp, f"fleet-g{g}")
+            build_fleet_stores(
+                mdir, fdir, num_replicas=num_replicas,
+                bucketer=ShapeBucketer(),
+            )
+            sdir = os.path.join(tmp, f"store-g{g}")
+            build_model_store(mdir, sdir, bucketer=ShapeBucketer())
+            server = ScoringServer(
+                ModelStore(sdir), shard_sections=sections,
+                max_batch_rows=32, max_wait_ms=2.0, stats=ServeStats(),
+            )
+            server.warmup(warm_nnz=16)
+            oracle.append(server.score_rows(reqs))
+            server.close()
+            model_dirs.append(mdir)
+            fleet_dirs.append(fdir)
+
+        engines = []
+        for r in range(num_replicas):
+            e = ReplicaEngine(
+                ModelStore(replica_store_dir(fleet_dirs[0], r)),
+                replica_id=r, num_replicas=num_replicas,
+                shard_sections=sections, max_batch_rows=32,
+                max_wait_ms=2.0, stats=ServeStats(),
+            )
+            e.warmup(warm_nnz=16)
+            engines.append(e)
+        router = FleetRouter(
+            load_fleet_meta(fleet_dirs[0]),
+            [LocalReplicaClient(e) for e in engines], stats=FleetStats(),
+        )
+
+        # per-request row offsets (a request may expand to >1 score row):
+        # a gen-0 pre-pass both warms the fleet and records the widths
+        lens = [len(router.score_rows([q])) for q in reqs]
+        off = np.concatenate([[0], np.cumsum(lens)])
+        assert np.array_equal(
+            np.concatenate([router.score_rows([q]) for q in reqs]),
+            oracle[0],
+        ), "2-replica fleet diverges from the gen-0 single-store oracle"
+
+        def committed_retrain(name, mdir):
+            rd = os.path.join(tmp, name)
+            os.makedirs(rd)
+            RetrainManifest(
+                output_dir=rd, model_dir=mdir,
+                task="LOGISTIC_REGRESSION", file_stats=[], ingest_inputs={},
+                ingest_digest="bench", updating_sequence=[], coordinates={},
+            ).save(rd)
+            return rd
+
+        swapper = FleetSwapper(router)
+
+        # --- arm 1: provenance refusals (old generation intact) -----------
+        refusals = 0
+        try:
+            swapper.rollout_delta(
+                fleet_dirs[1], committed_retrain("retrain-wrong",
+                                                 model_dirs[0])
+            )
+        except FleetSwapError as e:
+            assert "mismatched" in str(e), e
+            refusals += 1
+        unfinished = os.path.join(tmp, "retrain-unfinished")
+        os.makedirs(unfinished)
+        try:
+            swapper.rollout_delta(fleet_dirs[1], unfinished)
+        except FleetSwapError as e:
+            assert "no committed" in str(e), e
+            refusals += 1
+        if refusals != 2 or router.generation != 0:
+            raise AssertionError(
+                f"provenance refusal arm: {refusals}/2 refusals, "
+                f"generation {router.generation} (want 0)"
+            )
+        _log("delta_rollout: both provenance refusals held (gen 0 intact)")
+
+        # --- arm 2: the timed rollout under concurrent traffic -----------
+        retrain_dir = committed_retrain("retrain-ok", model_dirs[1])
+        stop = threading.Event()
+        served = {"g0": 0, "g1": 0, "mixed": 0, "errors": 0}
+        lock = threading.Lock()
+
+        def traffic(tid):
+            i = tid
+            while not stop.is_set():
+                k = i % len(reqs)
+                lo, hi = int(off[k]), int(off[k + 1])
+                try:
+                    got = router.score_rows([reqs[k]])
+                except Exception:  # noqa: BLE001 — gate counts, assert below
+                    with lock:
+                        served["errors"] += 1
+                else:
+                    if np.array_equal(got, oracle[0][lo:hi]):
+                        key = "g0"
+                    elif np.array_equal(got, oracle[1][lo:hi]):
+                        key = "g1"
+                    else:
+                        key = "mixed"
+                    with lock:
+                        served[key] += 1
+                i += 3
+        threads = [
+            threading.Thread(target=traffic, args=(t,), daemon=True)
+            for t in range(3)
+        ]
+        for t in threads:
+            t.start()
+        _time.sleep(0.3)  # traffic established before the roll begins
+        t0 = _time.perf_counter()
+        report = swapper.rollout_delta(fleet_dirs[1], retrain_dir)
+        swap_s = _time.perf_counter() - t0
+        _time.sleep(0.3)  # post-flip traffic must all land on gen 1
+        stop.set()
+        for t in threads:
+            t.join(timeout=60)
+
+        post = np.concatenate([router.score_rows([q]) for q in reqs])
+        post_bitwise = bool(np.array_equal(post, oracle[1]))
+        total = sum(served[k] for k in ("g0", "g1", "mixed"))
+        _log(
+            f"delta_rollout: swap {swap_s * 1e3:.1f}ms, "
+            f"{report['new_compiles']} new compiles, "
+            f"{report['dropped_requests']} dropped; traffic "
+            f"{total} reqs (g0={served['g0']} g1={served['g1']} "
+            f"mixed={served['mixed']} errors={served['errors']})"
+        )
+
+        extra["delta_rollout_config"] = {
+            "replicas": num_replicas, "users": num_users,
+            "requests": len(reqs), "traffic_threads": 3,
+        }
+        extra["delta_rollout_swap_ms"] = round(swap_s * 1e3, 1)
+        extra["delta_rollout_generation"] = int(report["generation"])
+        extra["delta_rollout_new_compiles"] = int(report["new_compiles"])
+        extra["delta_rollout_dropped_requests"] = int(
+            report["dropped_requests"]
+        )
+        extra["delta_rollout_provenance_refusals"] = refusals
+        extra["delta_rollout_traffic_requests"] = int(total)
+        extra["delta_rollout_traffic_g0"] = int(served["g0"])
+        extra["delta_rollout_traffic_g1"] = int(served["g1"])
+        extra["delta_rollout_traffic_mixed"] = int(served["mixed"])
+        extra["delta_rollout_traffic_errors"] = int(served["errors"])
+        extra["delta_rollout_post_bitwise"] = post_bitwise
+
+        problems = []
+        if report["new_compiles"]:
+            problems.append(f"{report['new_compiles']} new compiles")
+        if report["dropped_requests"]:
+            problems.append(f"{report['dropped_requests']} dropped requests")
+        if served["mixed"]:
+            problems.append(f"{served['mixed']} mixed-generation scores")
+        if served["errors"]:
+            problems.append(f"{served['errors']} traffic errors")
+        if served["g1"] == 0:
+            problems.append("no traffic observed at the new generation")
+        if not post_bitwise:
+            problems.append("post-rollout scores diverge from gen-1 oracle")
+        if problems:
+            raise AssertionError(
+                "delta rollout gates violated: " + "; ".join(problems)
+            )
+
+        router.close()
+        for e in engines:
+            e.close()
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
 def _bench_quantized_serving(extra, on_tpu):
     """Quantized serving slabs (serve/quantize.py): the repo's first
     measured accuracy/speed dial. Races f32 vs bf16 vs int8 stores of ONE
@@ -3372,6 +3617,7 @@ SECTION_ORDER = (
     "serving_fleet",
     "quantized_serving",
     "retrain_delta",
+    "delta_rollout",
     "ingest",
 )
 # orchestrator per-section deadlines (s): generous — tunnel compiles are slow,
@@ -3396,7 +3642,10 @@ SECTION_DEADLINES = {"dense": 3600, "game": 3600, "game5": 2400, "grid": 2400,
                      "retrain_delta": 3600,
                      # 3 store exports + 3 warmed servers + a batch-driver
                      # oracle run + the int8 swap arm
-                     "quantized_serving": 1800}
+                     "quantized_serving": 1800,
+                     # 2 model generations (exports + oracles) + an
+                     # in-process 2-replica fleet + the traffic'd roll
+                     "delta_rollout": 1800}
 DEFAULT_SECTION_DEADLINE = 1800
 
 
@@ -3535,6 +3784,8 @@ def _run_sections(names, extra, errors, on_tpu, state=None, after=None):
                 _bench_quantized_serving(extra, on_tpu)
             elif name == "retrain_delta":
                 _bench_retrain_delta(extra, on_tpu)
+            elif name == "delta_rollout":
+                _bench_delta_rollout(extra, on_tpu)
             elif name == "ingest":
                 _bench_ingest(extra)
         except Exception:  # noqa: BLE001 — per-section fence: failure recorded in errors, bench continues

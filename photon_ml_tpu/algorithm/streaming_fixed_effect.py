@@ -257,8 +257,11 @@ class PerHostStreamingFixedEffectCoordinate:
     plan: Optional[object] = None
     # elastic drain hook, polled ONLY at update/score entry (the chunk
     # merges inside an evaluation are collectives — see the single-host
-    # coordinate's note); FE chunk ownership itself is per PHYSICAL
-    # process, so a virtual-owner re-plan never moves chunks
+    # coordinate's note). FE chunk ownership is LOGICAL and versioned
+    # with the entity-shard plan (EntityShardPlan.fe_chunk_owners): a
+    # re-plan re-bases chunks across the surviving hosts the same way it
+    # re-bases RE blocks, and the driver rebuilds this coordinate's
+    # owned_loaders from plan.owned_fe_chunks() for the new membership
     elastic: Optional[object] = None
 
     # streams + reduces per evaluation: CoordinateDescent must call it raw

@@ -279,6 +279,432 @@ def _check_multihost_support(p) -> None:
         )
 
 
+def _attempt_relaunch_adoption(p, mh, ctx, logger) -> Dict[str, object]:
+    """Relaunch-time re-plan (parallel/elastic.py:relaunch_replan) for
+    every streaming random-effect coordinate: restore the prior cohort's
+    plan-versioned sidecars, re-plan against THIS cohort's membership, and
+    delta-transfer only the moved block/state files — a supervised relaunch
+    onto a smaller or larger fleet resumes instead of re-ingesting.
+
+    Returns ``{coordinate: RelaunchReplanResult}`` only when EVERY host
+    succeeded for EVERY coordinate (one collective vote); any failure — or
+    a same-cohort restart, which needs no re-plan — returns ``{}`` and the
+    caller takes the ordinary full-ingest path on all hosts together."""
+    import re as _re
+
+    from photon_ml_tpu.parallel.elastic import ElasticError, relaunch_replan
+    from photon_ml_tpu.parallel.perhost_streaming import load_plan_sidecars
+    from photon_ml_tpu.parallel.shuffle import collective_max
+
+    names = [
+        n for n in p.updating_sequence
+        if n in p.random_effect_data_configs and n not in p.factored_configs
+    ]
+    state_base = os.path.join(p.output_dir, "streaming-re-state")
+    adopted: Dict[str, object] = {}
+    code, why = 1, ""  # 0 = failed, 1 = adopted, 2 = same cohort
+    try:
+        prior_cohort = None
+        first_root = (
+            os.path.join(p.output_dir, "streaming-re", names[0])
+            if names else None
+        )
+        if first_root and os.path.isdir(first_root):
+            for d in sorted(os.listdir(first_root)):
+                mdir = os.path.join(first_root, d)
+                if d.startswith("process-") and os.path.isfile(
+                        os.path.join(mdir, "manifest.json")):
+                    meta, _, _ = load_plan_sidecars(mdir)
+                    if meta is not None:
+                        prior_cohort = sorted(
+                            {int(q) for q in meta["binding"].values()}
+                        )
+                    break
+        if prior_cohort is None:
+            code, why = 0, "no committed plan-versioned prior layout"
+        elif prior_cohort == list(range(mh.num_processes)):
+            code = 2
+        else:
+            for name in names:
+                coord_root = os.path.join(p.output_dir, "streaming-re", name)
+                # prior spill roots by OLD physical pid, grouped per
+                # coordinate-state instance (the -<seq> suffix), each paired
+                # with MY destination root of the same instance
+                pairs = []
+                if os.path.isdir(state_base):
+                    pat = _re.compile(_re.escape(name) + r"-host(\d+)-(\d+)$")
+                    by_seq: Dict[int, Dict[int, str]] = {}
+                    for d in os.listdir(state_base):
+                        m = pat.match(d)
+                        if m:
+                            by_seq.setdefault(int(m.group(2)), {})[
+                                int(m.group(1))
+                            ] = os.path.join(state_base, d)
+                    pairs = [
+                        (srcs, os.path.join(
+                            state_base, f"{name}-host{mh.process_id}-{seq}"
+                        ))
+                        for seq, srcs in sorted(by_seq.items())
+                    ]
+                adopted[name] = relaunch_replan(
+                    coord_root, mh.process_id, mh.num_processes,
+                    state_root_pairs=pairs,
+                )
+    except (ElasticError, OSError, ValueError, KeyError) as e:
+        code, why = 0, f"{type(e).__name__}: {e}"
+        adopted = {}
+    # EVERY host votes, failed or not — the verdict must be unanimous or
+    # everyone falls back to the full re-ingest TOGETHER (a mixed resume
+    # would strand the routing collectives)
+    v = np.asarray([code], np.int64)
+    vmax = int(collective_max(v, ctx, mh.num_processes)[0])
+    vmin = -int(collective_max(-v, ctx, mh.num_processes)[0])
+    if vmax != vmin or vmin != 1:
+        if vmax == vmin == 2:
+            logger.info(
+                "relaunch: same cohort as the prior run — plain resume "
+                "from the plan-versioned checkpoints, no re-plan needed"
+            )
+        else:
+            logger.warn(
+                "relaunch re-plan unavailable on at least one host"
+                + (f" (here: {why})" if code != 1 else "")
+                + " — full re-ingest on the new cohort (recorded decision)"
+            )
+        return {}
+    return adopted
+
+
+def _fe_chunk_share(all_files, adopted, mh, logger):
+    """This host's input-file share. An adopted re-plan carries the prior
+    run's fixed-effect chunk ownership re-based onto the new cohort (chunk
+    c IS input file c, versioned with the entity-shard plan); otherwise the
+    split is the deterministic positional share."""
+    if adopted:
+        result = next(iter(adopted.values()))
+        shard_plan = result.plan
+        own = getattr(shard_plan, "fe_chunk_owners", None)
+        if own is not None and len(own) == len(all_files):
+            chunks = shard_plan.owned_fe_chunks(
+                mh.process_id, membership=result.membership
+            )
+            logger.info(
+                f"host {mh.process_id}: FE chunk ownership from re-based "
+                f"plan v{shard_plan.version} "
+                f"({len(chunks)}/{len(all_files)} chunks)"
+            )
+            return [(all_files[int(c)], int(c)) for c in chunks]
+        logger.info(
+            "adopted plan has no usable FE chunk ownership — positional "
+            "file share (chunk merge is exact either way; ownership only "
+            "balances the streaming fixed-effect load)"
+        )
+    return host_file_share(all_files, mh.num_processes, mh.process_id)
+
+
+def _attach_fe_ownership(mh, all_files, g_file_counts, streaming_manifests,
+                         logger) -> None:
+    """Fresh ingest: fold the ACTUAL per-host file split into every
+    streaming coordinate's committed plan sidecars, so a later relaunch
+    re-plan re-bases fixed-effect chunks exactly like entity blocks."""
+    from photon_ml_tpu.parallel.perhost_streaming import (
+        attach_fe_chunks_to_sidecars,
+    )
+
+    owners = np.zeros(len(all_files), np.int32)
+    for pid in range(mh.num_processes):
+        for _, ordinal in host_file_share(all_files, mh.num_processes, pid):
+            owners[ordinal] = pid
+    for name, sm in streaming_manifests.items():
+        try:
+            attach_fe_chunks_to_sidecars(sm.dir, owners, g_file_counts)
+        except (OSError, ValueError) as e:
+            logger.warn(
+                f"streaming RE {name}: could not record FE chunk ownership "
+                f"in the plan sidecars ({e}) — a relaunch re-plan falls "
+                "back to the positional file share"
+            )
+
+
+def _mh_ingest_inputs(p, plan) -> Dict[str, object]:
+    """The pre-feature-map ingest identity (the single-process driver's
+    ``_ingest_inputs`` shape) — what the delta planner compares."""
+    bk = plan.bucketer
+    return {
+        "sections": {k: list(v) for k, v in sorted(
+            (p.feature_shard_sections or {}).items())},
+        "intercepts": {k: bool(v) for k, v in sorted(
+            (p.feature_shard_intercepts or {}).items())},
+        "id_types": sorted({c.random_effect_id
+                            for c in p.random_effect_data_configs.values()}),
+        "ladder": (
+            f"{bk.base}:{bk.growth:g}" if bk is not None else None
+        ),
+        "offheap_indexmap_dir": p.offheap_indexmap_dir,
+        "name_and_term": p.feature_name_and_term_set_path,
+    }
+
+
+def _mh_eval_identity(p) -> Dict[str, object]:
+    """Validation-side identity (file stats + evaluator specs): a changed
+    validation set must re-score even when training has nothing to do."""
+    from photon_ml_tpu.cli.game_training_driver import (
+        _input_files,
+        resolve_date_range_dirs,
+    )
+    from photon_ml_tpu.io.tensor_cache import file_stat_token
+
+    val_files = []
+    if p.validate_input_dirs:
+        val_files = _input_files(resolve_date_range_dirs(
+            p.validate_input_dirs, p.validate_date_range,
+            p.validate_date_range_days_ago,
+        ))
+    return {
+        "validate_files": file_stat_token(val_files),
+        "evaluators": [
+            [etype.value, k, id_name]
+            for etype, k, id_name in (p.evaluators or [])
+        ],
+    }
+
+
+def _mh_ingest_digest(p, plan, shard_maps) -> str:
+    """SHA-256 of the full ingest identity incl. per-shard feature-map
+    digests (the feature-space identity warm reuse requires)."""
+    import hashlib
+    import json as _json
+
+    from photon_ml_tpu.io.tensor_cache import index_map_digest
+
+    cfg = dict(
+        _mh_ingest_inputs(p, plan),
+        index_maps={
+            shard: index_map_digest(imap)
+            for shard, imap in sorted(shard_maps.items())
+        },
+    )
+    return hashlib.sha256(
+        _json.dumps(cfg, sort_keys=True, default=str).encode()
+    ).hexdigest()
+
+
+def _blocking_unchanged(prior, name, manifest) -> bool:
+    """Freezing a streaming coordinate additionally requires the prior
+    run's entity blocking to BE this run's blocking — ``block_of`` is a
+    pure function of the agreed entity counts, so the guard is
+    membership-invariant (it holds across topology changes) and fails
+    closed for a prior without plan sidecars (e.g. a single-process
+    run's manifest)."""
+    rec = prior.coordinates.get(name)
+    if rec is None or not rec.streaming_manifest_dir:
+        return False
+    from photon_ml_tpu.parallel.perhost_streaming import _PLAN_BLOCK_OF
+
+    try:
+        prior_bo = np.load(
+            os.path.join(rec.streaming_manifest_dir, _PLAN_BLOCK_OF)
+        )
+        cur_bo, _ = manifest.plan_arrays()
+    except OSError:
+        return False
+    return bool(np.array_equal(np.asarray(prior_bo), np.asarray(cur_bo)))
+
+
+def _prepare_multihost_warm(p, mh, ctx, logger, plan, shard_maps, all_files,
+                            streaming_manifests, combos):
+    """--warm-start-from for the multihost driver: every host plans its
+    own delta against the prior ``retrain.json``, builds its warm seeds,
+    and ONE collective agreement compares a digest of the outcome
+    (classification + warm + frozen sets) across the cohort. Any
+    disagreement — or any host's unusable prior, including an injected
+    ``retrain.multihost_delta_agree`` fault — degrades EVERY host to a
+    RECORDED cold run; a split-brain warm resume is impossible by
+    construction.
+
+    Returns ``(initial_params or None, frozen_blocks_by_name,
+    frozen_coordinate_names)``."""
+    if not p.warm_start_from:
+        return None, {}, set()
+    import hashlib
+    import json as _json
+
+    from photon_ml_tpu import retrain
+    from photon_ml_tpu.parallel.shuffle import collective_max
+    from photon_ml_tpu.resilience import faults
+    from photon_ml_tpu.retrain.delta import NEW
+
+    prior = delta = None
+    warm: Dict[str, object] = {}
+    frozen_blocks: Dict[str, frozenset] = {}
+    frozen: set = set()
+    digest, why = -1, ""
+    try:
+        # the chaos seam fires FIRST and the collectives run AFTER, no
+        # matter what: a one-sided failure poisons THIS host's digest
+        # (-1) but the host still votes below — it must never strand its
+        # peers in a collective
+        faults.inject(
+            "retrain.multihost_delta_agree", process=int(mh.process_id)
+        )
+        prior = retrain.load_prior_manifest(p.warm_start_from)
+        combo_configs = None
+        if len(combos) == 1:
+            combo_configs = {
+                name: str(combos[0].get(name, CoordinateOptConfig()))
+                for name in p.updating_sequence
+            }
+        delta = retrain.plan_delta(
+            prior, all_files,
+            task=p.task_type.value,
+            updating_sequence=p.updating_sequence,
+            ingest_inputs=_mh_ingest_inputs(p, plan),
+            combo_configs=combo_configs,
+            eval_identity=_mh_eval_identity(p),
+        )
+        freezable = (
+            delta.frozen_coordinates() if len(combos) == 1 else set()
+        )
+        for name in p.updating_sequence:
+            cdelta = delta.coordinates.get(name)
+            if cdelta is None or cdelta.status == NEW:
+                continue
+            if name in p.fixed_effect_data_configs:
+                spec = p.fixed_effect_data_configs[name]
+                w0 = retrain.fixed_effect_init(
+                    prior.model_dir, name,
+                    shard_maps[spec.feature_shard_id],
+                )
+                if w0 is None:
+                    logger.info(f"warm start {name}: prior fixed-effect "
+                                "model missing — cold")
+                    continue
+                warm[name] = jnp.asarray(w0)
+                if name in freezable:
+                    frozen.add(name)
+            elif name in streaming_manifests:
+                dc = p.random_effect_data_configs[name]
+                means = retrain.random_effect_entity_means(
+                    prior.model_dir, name, shard_maps[dc.feature_shard_id]
+                )
+                if means is None:
+                    logger.info(f"warm start {name}: prior random-effect "
+                                "model missing or factored — cold")
+                    continue
+                warm[name] = retrain.seed_perhost_spilled_state(
+                    streaming_manifests[name], means,
+                    os.path.join(p.output_dir, "retrain-warm",
+                                 f"{name}-host{mh.process_id}"),
+                )
+                if name in freezable and _blocking_unchanged(
+                        prior, name, streaming_manifests[name]):
+                    frozen.add(name)
+                    # every LOCAL owned block skips its solve bitwise —
+                    # per-host, the fleet-wide freeze the agreement
+                    # guarantees is consistent
+                    frozen_blocks[name] = frozenset(
+                        range(len(streaming_manifests[name].blocks))
+                    )
+            else:
+                # in-memory multihost RE solvers hold device-sharded slabs
+                # with no host-side seeding path — a recorded cold solve,
+                # the same rule as factored coordinates
+                logger.info(f"warm start {name}: no multihost warm path "
+                            "for this coordinate kind — cold")
+        canon = _json.dumps(
+            {
+                "status": {n: c.status for n, c in
+                           delta.coordinates.items()},
+                "warm": sorted(warm),
+                "frozen": sorted(frozen),
+            },
+            sort_keys=True,
+        )
+        # non-negative int63 (-1 stays a distinguishable poison value)
+        digest = int.from_bytes(
+            hashlib.sha256(canon.encode()).digest()[:8], "big"
+        ) >> 1
+    except Exception as e:  # noqa: BLE001 — ANY unusable prior (bad JSON, vanished model, unwritable seed dir, injected fault) must degrade to a cold run, never a wrong warm result or a stranded collective
+        warm, frozen_blocks, frozen = {}, {}, set()
+        why = f"{type(e).__name__}: {e}"
+    d = np.asarray([digest], np.int64)
+    dmax = int(collective_max(d, ctx, mh.num_processes)[0])
+    dmin = -int(collective_max(-d, ctx, mh.num_processes)[0])
+    if dmax != dmin or dmin < 0:
+        logger.warn(
+            "--warm-start-from: delta plan "
+            + ("disagrees across hosts" if dmax != dmin
+               else "failed on at least one host")
+            + (f" (here: {why})" if why else "")
+            + " — retraining cold everywhere (recorded decision)"
+        )
+        return None, {}, set()
+    logger.info(
+        f"delta retrain plan (agreed across {mh.num_processes} hosts): "
+        f"files {delta.files.describe()}; "
+        + " ".join(f"{n}={c.status}" for n, c in delta.coordinates.items())
+    )
+    for line in delta.describe_decisions():
+        logger.info(f"delta retrain: {line}")
+    if warm:
+        logger.info(
+            f"warm start: {sorted(warm)} seeded from {prior.model_dir}"
+            + (f"; frozen {sorted(frozen)}" if frozen else "")
+        )
+    return (warm or None), frozen_blocks, frozen
+
+
+def _write_mh_retrain_manifest(p, plan, best_dir, shard_maps, combos,
+                               best_index, streaming_manifests,
+                               coord_cache_keys, train_file_stats,
+                               logger) -> None:
+    """The coordinator's ``retrain.json`` (the single-process driver's
+    record, multihost leg): next run's planner diffs against it, and the
+    fleet rollout's provenance check traces its ``model_dir``."""
+    from photon_ml_tpu.retrain import RetrainManifest
+    from photon_ml_tpu.retrain.manifest import CoordinateRecord
+
+    sel = combos[best_index]
+    coords: Dict[str, CoordinateRecord] = {}
+    for name in p.updating_sequence:
+        if name in p.fixed_effect_data_configs:
+            kind = "fixed"
+        elif name in p.factored_configs:
+            kind = "factored"
+        elif name in streaming_manifests:
+            kind = "streaming_random"
+        elif p.bucketed_random_effects:
+            kind = "bucketed"
+        else:
+            kind = "random"
+        sm = streaming_manifests.get(name)
+        coords[name] = CoordinateRecord(
+            kind=kind,
+            opt_config=str(sel.get(name, CoordinateOptConfig())),
+            cache_key=coord_cache_keys.get(name),
+            streaming_manifest_dir=(
+                os.path.abspath(sm.dir) if sm is not None else None
+            ),
+            shard_plan_version=int(
+                getattr(sm, "plan_version", 1) if sm is not None else 1
+            ),
+        )
+    manifest = RetrainManifest(
+        output_dir=os.path.abspath(p.output_dir),
+        model_dir=os.path.abspath(best_dir),
+        task=p.task_type.value,
+        file_stats=train_file_stats,
+        ingest_inputs=_mh_ingest_inputs(p, plan),
+        ingest_digest=_mh_ingest_digest(p, plan, shard_maps),
+        updating_sequence=list(p.updating_sequence),
+        coordinates=coords,
+        data_cache_key=None,
+        eval_identity=_mh_eval_identity(p),
+    )
+    path = manifest.save(p.output_dir)
+    logger.info(f"retrain manifest written: {path}")
+
+
 def _main_once(mh_args: dict, p, restart: bool = False) -> dict:
     mh = multihost.initialize(
         coordinator_address=mh_args["coordinator"],
@@ -395,7 +821,22 @@ def _main_once(mh_args: dict, p, restart: bool = False) -> dict:
     all_files = _input_files(resolve_date_range_dirs(
         p.train_input_dirs, p.train_date_range, p.train_date_range_days_ago
     ))
-    host_files = host_file_share(all_files, mh.num_processes, mh.process_id)
+    # pre-ingest stat tokens for the retrain manifest (a file overwritten
+    # mid-run must be recorded with its pre-overwrite identity, same rule
+    # as the single-process driver)
+    from photon_ml_tpu.io.tensor_cache import file_stat_token
+
+    train_file_stats = file_stat_token(all_files)
+    # relaunch-time re-plan (the elasticity x supervised-relaunch seam): a
+    # restart onto a DIFFERENT cohort adopts the prior cohort's durable
+    # streaming layout — plan-versioned sidecars restored, replan() against
+    # the new membership, only MOVED block/state files copied — instead of
+    # re-ingesting everything. ANY host failing degrades EVERY host to a
+    # recorded full re-ingest (collectively agreed: never a mixed resume).
+    adopted: Dict[str, object] = {}
+    if restart and p.streaming_random_effects:
+        adopted = _attempt_relaunch_adoption(p, mh, ctx, logger)
+    host_files = _fe_chunk_share(all_files, adopted, mh, logger)
     id_types = sorted({c.random_effect_id
                        for c in p.random_effect_data_configs.values()})
     gds = []
@@ -435,6 +876,7 @@ def _main_once(mh_args: dict, p, restart: bool = False) -> dict:
     fe_chunks: Dict[str, tuple] = {}  # streaming: (chunk_sizes, owned, dim)
     re_datasets: Dict[str, object] = {}
     streaming_manifests: Dict[str, object] = {}
+    coord_cache_keys: Dict[str, Optional[str]] = {}
     # per-file row counts (identical on every host): the global chunk grid
     # of the streaming fixed effect — chunk c IS input file c, so chunk
     # ownership falls out of the per-host file share with no routing
@@ -502,6 +944,19 @@ def _main_once(mh_args: dict, p, restart: bool = False) -> dict:
                     f"projector in its data config (got {dc.projector!r}) — "
                     "the latent matrix projects the global shard space"
                 )
+            if name in adopted:
+                # relaunch adoption (agreed above, so every host skips the
+                # routing collectives together): the re-based manifest IS
+                # this run's ingest output — resume without re-reading a row
+                streaming_manifests[name] = adopted[name].manifest
+                logger.info(
+                    f"streaming RE {name}: adopted relaunch re-plan "
+                    f"v{adopted[name].plan.version} — host {mh.process_id} "
+                    f"owns {len(streaming_manifests[name].blocks)}/"
+                    f"{streaming_manifests[name].num_blocks_total} blocks, "
+                    "no re-ingest"
+                )
+                continue
             parts = []
             for ordinal, gd in gds:
                 f = gd.shards[dc.feature_shard_id]
@@ -591,6 +1046,7 @@ def _main_once(mh_args: dict, p, restart: bool = False) -> dict:
                     tensor_cache=cache, cache_key=cache_key,
                     block_cache=block_cache, block_key_base=block_key_base,
                 )
+                coord_cache_keys[name] = cache_key
                 logger.info(
                     f"streaming RE {name}: host {mh.process_id} owns "
                     f"{len(streaming_manifests[name].blocks)}/"
@@ -609,6 +1065,22 @@ def _main_once(mh_args: dict, p, restart: bool = False) -> dict:
                 projection_seed=dc.seed,
                 projection_keep_intercept=dc.random_projection_intercept,
             )
+
+    # fresh ingest: record the ACTUAL fixed-effect chunk ownership (the
+    # host_file_share split above) into the versioned plan sidecars, so a
+    # later relaunch re-plan re-bases FE chunks exactly like RE blocks
+    if streaming_manifests and not adopted:
+        _attach_fe_ownership(
+            mh, all_files, g_file_counts, streaming_manifests, logger
+        )
+
+    # ---- --warm-start-from: fleet-wide delta retrain ----------------------
+    # per-host delta plans agreed collectively; disagreement (or any host's
+    # unusable prior) degrades EVERY host to a recorded cold run
+    warm_init_mh, mh_frozen_blocks, frozen_names = _prepare_multihost_warm(
+        p, mh, ctx, logger, plan, shard_maps, all_files,
+        streaming_manifests, combos,
+    )
 
     stream_state_seq = [0]
 
@@ -660,6 +1132,11 @@ def _main_once(mh_args: dict, p, restart: bool = False) -> dict:
                     # PR 4 / PR 7 wins on the billion-coefficient path
                     plan=plan,
                     ctx=ctx, num_processes=mh.num_processes,
+                    # delta retrain: LOCAL block indices whose solves are
+                    # skipped bitwise (coefficients carried from the warm
+                    # seed) — set only when the delta plan froze this
+                    # coordinate on every host
+                    frozen_blocks=mh_frozen_blocks.get(name),
                 )
             elif name in p.fixed_effect_data_configs:
                 coords[name] = fe_tensors[name].rebind(
@@ -752,7 +1229,13 @@ def _main_once(mh_args: dict, p, restart: bool = False) -> dict:
                 CoordinateDescentCheckpointer(
                     os.path.join(p.checkpoint_dir, f"combo-{i}"),
                     run_fingerprint=fingerprint({
-                        "multihost": mh.num_processes,
+                        # cohort-INVARIANT marker, deliberately not
+                        # num_processes: a supervised relaunch onto a
+                        # smaller/larger cohort must restore this
+                        # plan-versioned checkpoint and resume — per-host
+                        # streaming state re-bases through the plan
+                        # sidecars (see MIGRATION.md)
+                        "multihost": True,
                         "coordinates": p.updating_sequence,
                         "num_rows": n_global,
                         "combo": i,
@@ -770,9 +1253,18 @@ def _main_once(mh_args: dict, p, restart: bool = False) -> dict:
             result = cd.run(
                 num_iterations=p.num_iterations, num_rows=n_global,
                 checkpointer=checkpointer,
+                # combo 0 (or the whole run, without --grid-warm-start)
+                # seeds from the delta-retrain warm start; later combos
+                # under --grid-warm-start keep the previous combo's
+                # coefficients (the stronger start)
                 initial_params=(
-                    prev_coefficients if mh_args["grid_warm_start"] else None
+                    prev_coefficients
+                    if mh_args["grid_warm_start"] and prev_coefficients
+                    is not None else warm_init_mh
                 ),
+                # non-empty only for a single-combo run (a sweep compares
+                # configurations, so nothing may be skipped)
+                frozen=frozen_names,
             )
         finally:
             # async fence before this combo retires (preemption already
@@ -851,6 +1343,22 @@ def _main_once(mh_args: dict, p, restart: bool = False) -> dict:
             )
         mh.barrier(f"saved-{name}")
     logger.info(f"model saved to {out}")
+    # the coordinator leaves this run's retrain.json so the NEXT run (and
+    # the fleet rollout's provenance check) can diff against it — the
+    # multihost leg of the retrain -> re-shard -> export -> swap loop
+    if mh.coordinator_only_io():
+        try:
+            _write_mh_retrain_manifest(
+                p, plan, out, shard_maps, combos, best_index,
+                streaming_manifests, coord_cache_keys, train_file_stats,
+                logger,
+            )
+        except (OSError, TypeError, ValueError) as e:
+            # a failed manifest write degrades tomorrow's run to cold — it
+            # must not fail TODAY's completed training run
+            logger.warn(f"retrain manifest write failed ({e}); the next "
+                        "run retrains cold")
+    mh.barrier("retrain-manifest")
     from photon_ml_tpu.compile import compile_stats
 
     logger.info(compile_stats.summary())

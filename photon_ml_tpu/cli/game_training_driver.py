@@ -181,6 +181,7 @@ class GameTrainingDriver:
         self._warm_fixed: Dict[str, np.ndarray] = {}
         self._warm_dense_re: Dict[str, np.ndarray] = {}
         self._warm_spilled: Dict[str, object] = {}  # coord -> SpilledREState
+        self._warm_bucketed: Dict[str, list] = {}  # coord -> per-bucket stacks
         self._warm_means_cache: Dict[str, Optional[dict]] = {}
         self._coord_cache_keys: Dict[str, Optional[str]] = {}
         self._data_cache_key: Optional[str] = None
@@ -1094,9 +1095,21 @@ class GameTrainingDriver:
                     self._warm_fixed[name] = w
                 continue
             if p.bucketed_random_effects and name in self.bucketed_bundles:
+                means = self._prior_entity_means(name)
+                if means is None:
+                    self.logger.info(
+                        f"delta retrain [{name}]: prior model has no "
+                        "reusable coefficients for this bucketed "
+                        "coordinate — cold solve"
+                    )
+                    continue
+                self._warm_bucketed[name] = retrain.bucketed_random_effect_init(
+                    means, self.bucketed_bundles[name]
+                )
                 self.logger.info(
-                    f"delta retrain [{name}]: bucketed per-bucket stacks "
-                    "have no warm-start path yet — cold solve"
+                    f"delta retrain [{name}]: warm-starting "
+                    f"{len(self._warm_bucketed[name])} bucket stacks from "
+                    "the prior model (gathered through the bucket layout)"
                 )
                 continue
             means = self._prior_entity_means(name)
@@ -1151,6 +1164,9 @@ class GameTrainingDriver:
             out[n] = jnp.asarray(w)
         for n, w in self._warm_dense_re.items():
             out[n] = jnp.asarray(w)
+        for n, stacks in self._warm_bucketed.items():
+            # per-bucket stacks mirror initial_coefficients()'s tuple
+            out[n] = tuple(jnp.asarray(w) for w in stacks)
         out.update(self._warm_spilled)
         return out or None
 

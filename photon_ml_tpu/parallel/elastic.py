@@ -76,9 +76,11 @@ __all__ = [
     "ElasticMonitor",
     "ElasticSession",
     "FleetMembership",
+    "RelaunchReplanResult",
     "ReplanBarrierError",
     "ReplanRequired",
     "ReshardResult",
+    "relaunch_replan",
     "commit_membership",
     "pending_proposal",
     "propose_membership",
@@ -912,6 +914,8 @@ class ElasticSession:
             plan_version=new_mem.version,
             membership=new_mem,
             block_costs=new_plan.block_costs,
+            fe_chunk_owners=new_plan.fe_chunk_owners,
+            fe_chunk_costs=new_plan.fe_chunk_costs,
         )
 
         # ---- the done barrier: no peer resumes (and GC's epochs / rewrites
@@ -1053,4 +1057,201 @@ def drain_if_replan_pending(monitor: Optional[ElasticMonitor],
         site="block",
         partial=partial,
         proposal=prop,
+    )
+
+
+# ---------------------------------------------------------------------------
+# relaunch-time re-plan (supervised relaunch onto a DIFFERENT cohort)
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class RelaunchReplanResult:
+    """What one relaunched host's offline re-plan produced."""
+
+    plan: object  # the new EntityShardPlan (version +1)
+    membership: FleetMembership  # identity-bound over the new cohort
+    manifest: object  # this host's re-based PerHostStreamingManifest
+    moved: List[Tuple[int, int, int]]  # (gid, old physical, new physical)
+    adopted: List[int]  # gids whose block files were copied onto THIS host
+    state_files_adopted: int  # spilled coefficient files copied in
+    decisions: List[str] = dataclasses.field(default_factory=list)
+
+
+def relaunch_replan(
+    coord_root: str,
+    process_id: int,
+    num_processes: int,
+    *,
+    state_root_pairs: Sequence[Tuple[Dict[int, str], str]] = (),
+) -> RelaunchReplanResult:
+    """Offline re-plan of one streaming coordinate's durable layout onto a
+    NEW physical cohort at supervised-relaunch time — the path the in-band
+    :class:`ElasticSession` cannot take (a dead physical process can never
+    ack its barrier). Runs independently on every relaunched host: the new
+    plan is a pure function of the persisted sidecars and the cohort size,
+    so all hosts derive the identical plan with no collective, and each
+    host copies only the block/state files IT now owns.
+
+    ``coord_root`` holds the prior cohort's ``process-<pid>`` manifest
+    dirs (shared storage). ``state_root_pairs`` lists
+    ``({old physical pid: its spill root}, my destination spill root)``
+    per live coordinate state instance; adopted blocks' ``coefs-g*.npy``
+    files are copied epoch-subdir-by-name, exactly like the in-band
+    re-base, so a later plan-versioned checkpoint restore finds them.
+
+    ANY failure raises (fault site ``multihost.relaunch_replan`` at
+    entry): the caller records the decision and falls back to a full
+    re-ingest — degraded cost, never a wrong resume."""
+    from photon_ml_tpu.resilience import faults
+    from photon_ml_tpu.parallel.perhost_streaming import (
+        EntityShardPlan,
+        PerHostStreamingManifest,
+        commit_perhost_manifest,
+        load_plan_sidecars,
+    )
+
+    faults.inject(
+        "multihost.relaunch_replan",
+        process=int(process_id), root=coord_root,
+    )
+    proc_dirs = {
+        int(d.split("-", 1)[1]): os.path.join(coord_root, d)
+        for d in os.listdir(coord_root)
+        if d.startswith("process-")
+        and os.path.isfile(os.path.join(coord_root, d, "manifest.json"))
+    }
+    if not proc_dirs:
+        raise ElasticError(
+            f"{coord_root} has no prior process-<pid> manifest dirs — "
+            "nothing to re-plan from"
+        )
+    # the newest committed plan is authoritative; its binding names the
+    # prior cohort's dirs (stale leftover dirs from even older topologies
+    # are ignored). Torn sidecars raise inside load_plan_sidecars.
+    versions = {
+        pid: load_plan_sidecars(d)[0] for pid, d in proc_dirs.items()
+    }
+    if any(m is None for m in versions.values()):
+        raise ElasticError(
+            f"{coord_root} holds pre-versioned manifests (no plan.json) — "
+            "relaunch re-plan needs plan sidecars; re-ingest instead"
+        )
+    vmax = max(int(m["version"]) for m in versions.values())
+    auth_pid = min(
+        pid for pid, m in versions.items() if int(m["version"]) == vmax
+    )
+    auth_meta = versions[auth_pid]
+    old_mem = FleetMembership(
+        version=vmax,
+        hosts=[int(h) for h in auth_meta["hosts"]],
+        binding={int(h): int(q) for h, q in auth_meta["binding"].items()},
+    )
+    old_cohort = sorted(set(old_mem.binding.values()))
+    stale = [
+        q for q in old_cohort
+        if q not in versions or int(versions[q]["version"]) != vmax
+    ]
+    if stale:
+        raise ElasticError(
+            f"prior cohort processes {stale} have missing or stale plan "
+            f"sidecars (expected v{vmax}) — a re-shard crashed mid-commit; "
+            "re-ingest instead of resuming from mixed plan versions"
+        )
+    old_plan = EntityShardPlan.from_sidecars(proc_dirs[auth_pid])
+    new_mem = FleetMembership(
+        version=vmax + 1,
+        hosts=list(range(int(num_processes))),
+        binding={h: h for h in range(int(num_processes))},
+    )
+    new_plan = old_plan.replan(new_mem.hosts, version=new_mem.version)
+    moved = old_plan.moved_blocks(new_plan, old_mem, new_mem)
+    old_phys = old_mem.physical_owners(old_plan.owners)
+    new_phys = new_mem.physical_owners(new_plan.owners)
+    new_owned = [
+        g for g in range(len(new_plan.owners))
+        if int(new_phys[g]) == int(process_id)
+    ]
+    my_dir = os.path.join(coord_root, f"process-{int(process_id)}")
+    os.makedirs(my_dir, exist_ok=True)
+
+    # block metadata by gid, from the prior manifests that owned them
+    blocks_meta: Dict[int, dict] = {}
+    for pid in old_cohort:
+        with open(os.path.join(proc_dirs[pid], "manifest.json")) as f:
+            m = json.load(f)
+        for g, meta in zip(m["global_block_ids"], m["blocks"]):
+            blocks_meta[int(g)] = meta
+
+    decisions: List[str] = []
+    adopted: List[int] = []
+    state_copied = 0
+    for g in new_owned:
+        meta = blocks_meta.get(g)
+        if meta is None:
+            raise ElasticError(
+                f"block {g}: no prior manifest records it — plan sidecars "
+                "and manifests disagree; re-ingest instead"
+            )
+        src_pid = int(old_phys[g])
+        dst = os.path.join(my_dir, meta["file"])
+        if src_pid != int(process_id) or not os.path.exists(dst):
+            _copy_with_transfer_site(
+                os.path.join(proc_dirs[src_pid], meta["file"]), dst, g,
+                what="block",
+            )
+            adopted.append(g)
+            # spilled coefficient state rides along: same file name, every
+            # epoch subdir the old owner's live spill roots hold it in
+            fname = f"coefs-g{g:05d}.npy"
+            for src_by_pid, dst_root in state_root_pairs:
+                src_root = src_by_pid.get(src_pid)
+                if src_root is None or not os.path.isdir(src_root):
+                    continue
+                for sub in sorted(os.listdir(src_root)):
+                    src = os.path.join(src_root, sub, fname)
+                    if os.path.isfile(src):
+                        _copy_with_transfer_site(
+                            src, os.path.join(dst_root, sub, fname), g,
+                            what="state",
+                        )
+                        state_copied += 1
+
+    base = PerHostStreamingManifest.load(proc_dirs[auth_pid])
+    base = dataclasses.replace(
+        base,
+        process_index=int(process_id),
+        num_processes=int(num_processes),
+    )
+    commit_perhost_manifest(
+        my_dir,
+        [blocks_meta[g] for g in new_owned],
+        base,
+        owned_gids=new_owned,
+        owners=new_plan.owners,
+        block_of=new_plan.block_of_vocab,
+        plan_version=new_mem.version,
+        membership=new_mem,
+        block_costs=new_plan.block_costs,
+        fe_chunk_owners=new_plan.fe_chunk_owners,
+        fe_chunk_costs=new_plan.fe_chunk_costs,
+    )
+    decisions.insert(0, (
+        f"relaunch re-plan {coord_root}: v{vmax} cohort "
+        f"{old_cohort} -> v{new_mem.version} cohort "
+        f"{sorted(set(new_mem.binding.values()))}; "
+        f"{len(moved)}/{len(new_plan.owners)} blocks moved fleet-wide, "
+        f"{len(adopted)} adopted onto process {int(process_id)} "
+        f"({state_copied} coefficient-state files), no re-ingest"
+    ))
+    for d in decisions:
+        logger.info("relaunch re-plan: %s", d)
+    return RelaunchReplanResult(
+        plan=new_plan,
+        membership=new_mem,
+        manifest=PerHostStreamingManifest.load(my_dir),
+        moved=moved,
+        adopted=adopted,
+        state_files_adopted=state_copied,
+        decisions=decisions,
     )

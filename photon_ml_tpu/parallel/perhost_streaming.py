@@ -226,6 +226,14 @@ class EntityShardPlan:
     version: int = 1
     hosts: Optional[List[int]] = None  # logical owner ids; None = identity
     block_costs: Optional[np.ndarray] = None  # (n_blocks,) int64 solve cost
+    # fixed-effect CHUNK ownership, versioned WITH the plan: one LOGICAL
+    # owner per global FE chunk (chunk c is input file c), so FE work
+    # re-bases across a re-plan exactly the way RE blocks do instead of
+    # being pinned to the physical process that first decoded the file.
+    # None on plans that never attached chunks (pre-FE-ownership sidecars
+    # fall back to the physical host_file_share split).
+    fe_chunk_owners: Optional[np.ndarray] = None  # (n_chunks,) int32 logical
+    fe_chunk_costs: Optional[np.ndarray] = None  # (n_chunks,) int64 row cost
 
     @classmethod
     def build(
@@ -279,6 +287,52 @@ class EntityShardPlan:
         return (list(self.hosts) if self.hosts is not None
                 else list(range(self.num_processes)))
 
+    def with_fe_chunks(self, chunk_costs: Sequence[int],
+                       owners: Optional[Sequence[int]] = None
+                       ) -> "EntityShardPlan":
+        """Attach fixed-effect chunk ownership: by default the same
+        deterministic balanced assignment the RE blocks use, over per-chunk
+        row counts. A fresh run instead passes the EXPLICIT ``owners`` its
+        decode actually used (the physical ``host_file_share`` split), so
+        the recorded v1 ownership matches the chunks each host already
+        holds — the balanced re-assignment only kicks in at
+        :meth:`replan`, when ownership must move anyway. Chunk composition
+        (chunk c = input file c) is membership-invariant just like block
+        composition, so replan re-bases it."""
+        costs = np.asarray([int(c) for c in chunk_costs], np.int64)
+        if owners is None:
+            fe_owners = balanced_owners_over_hosts(costs, self.host_list())
+        else:
+            fe_owners = np.asarray([int(o) for o in owners], np.int32)
+            if len(fe_owners) != len(costs):
+                raise ValueError(
+                    f"FE chunk owners ({len(fe_owners)}) and costs "
+                    f"({len(costs)}) disagree on the chunk count"
+                )
+        return dataclasses.replace(
+            self,
+            fe_chunk_owners=fe_owners.astype(np.int32),
+            fe_chunk_costs=costs,
+        )
+
+    def owned_fe_chunks(self, process_id: int,
+                        membership=None) -> List[int]:
+        """Global FE chunk ids this PHYSICAL process hosts under the plan
+        (logical owners resolved through ``membership``; identity when
+        None). Raises if the plan never attached chunk ownership — the
+        caller must fall back to the physical file share."""
+        if self.fe_chunk_owners is None:
+            raise ValueError(
+                "plan carries no FE chunk ownership (pre-FE-ownership "
+                "sidecar) — fall back to the physical host_file_share"
+            )
+        if membership is None:
+            return [c for c in range(len(self.fe_chunk_owners))
+                    if int(self.fe_chunk_owners[c]) == process_id]
+        phys = membership.physical_owners(self.fe_chunk_owners)
+        return [c for c in range(len(self.fe_chunk_owners))
+                if int(phys[c]) == process_id]
+
     def replan(self, hosts: Sequence[int],
                version: Optional[int] = None) -> "EntityShardPlan":
         """The same blocking re-assigned over a NEW owner-host set: blocks
@@ -292,11 +346,19 @@ class EntityShardPlan:
             )
         host_list = sorted(int(h) for h in hosts)
         owners = balanced_owners_over_hosts(self.block_costs, host_list)
+        fe_owners = self.fe_chunk_owners
+        if self.fe_chunk_costs is not None:
+            # FE chunks re-base the same way: costs are membership-
+            # invariant, only the balanced owner map re-runs
+            fe_owners = balanced_owners_over_hosts(
+                self.fe_chunk_costs, host_list
+            ).astype(np.int32)
         return dataclasses.replace(
             self,
             owners=owners.astype(np.int32),
             hosts=host_list,
             version=self.version + 1 if version is None else int(version),
+            fe_chunk_owners=fe_owners,
         )
 
     def moved_blocks(self, new_plan: "EntityShardPlan",
@@ -333,6 +395,8 @@ class EntityShardPlan:
             np.sort(order[bounds[g]:bounds[g + 1]]).astype(np.int64)
             for g in range(n_blocks)
         ]
+        fe_owners = meta.get("fe_chunk_owners")
+        fe_costs = meta.get("fe_chunk_costs")
         return cls(
             blocks=blocks,
             owners=owners.astype(np.int32),
@@ -342,6 +406,10 @@ class EntityShardPlan:
             version=int(meta["version"]),
             hosts=[int(h) for h in meta["hosts"]],
             block_costs=np.asarray(meta["block_costs"], np.int64),
+            fe_chunk_owners=(None if fe_owners is None
+                             else np.asarray(fe_owners, np.int32)),
+            fe_chunk_costs=(None if fe_costs is None
+                            else np.asarray(fe_costs, np.int64)),
         )
 
     def owned_block_ids(self, process_id: int,
@@ -381,12 +449,15 @@ def write_plan_sidecars(
     block_costs: np.ndarray,
     num_entities: int,
     num_processes: int = 1,
+    fe_chunk_owners: Optional[np.ndarray] = None,
+    fe_chunk_costs: Optional[np.ndarray] = None,
 ) -> None:
     """Persist the plan next to the blocks: the two routing arrays plus
     ``plan.json`` — version, logical host set, logical->physical binding,
-    and the per-block costs a re-plan re-balances over. Everything an
-    elastic session (or a relaunched cohort restoring a v1 checkpoint
-    under v2) needs is durable and addressable here."""
+    the per-block costs a re-plan re-balances over, and (when attached)
+    the fixed-effect chunk ownership that re-bases alongside the blocks.
+    Everything an elastic session (or a relaunched cohort restoring a v1
+    checkpoint under v2) needs is durable and addressable here."""
     # tmp+rename like every other commit on this path: an elastic re-base
     # OVERWRITES live sidecars, and a crash mid-np.save must never leave a
     # torn owners array next to the previous version's plan.json. The
@@ -410,6 +481,14 @@ def write_plan_sidecars(
         "owners_sha": _plan_array_sha(owners),
         "block_of_sha": _plan_array_sha(block_of),
     }
+    if fe_chunk_owners is not None:
+        meta["fe_chunk_owners"] = [int(o) for o in np.asarray(fe_chunk_owners)]
+        meta["fe_chunk_costs"] = [
+            int(c) for c in np.asarray(
+                fe_chunk_costs if fe_chunk_costs is not None
+                else np.zeros(len(meta["fe_chunk_owners"]), np.int64)
+            )
+        ]
     tmp = os.path.join(dir_path, _PLAN_META + ".tmp")
     with open(tmp, "w") as f:
         json.dump(meta, f)
@@ -442,6 +521,41 @@ def load_plan_sidecars(
                 "re-ingests)"
             )
     return meta, owners, block_of
+
+
+def attach_fe_chunks_to_sidecars(
+    dir_path: str,
+    fe_chunk_owners: Sequence[int],
+    fe_chunk_costs: Sequence[int],
+) -> None:
+    """Record fixed-effect chunk ownership into ALREADY-COMMITTED plan
+    sidecars (idempotent re-commit through :func:`write_plan_sidecars`, so
+    the digest/commit-point discipline holds). The fresh-run driver calls
+    this after decode: the manifest build committed the plan before the
+    global row layout (and thus the per-chunk costs) existed, and the
+    ownership recorded must be the split decode ACTUALLY used — not a
+    recomputed one — so a later relaunch re-bases from ground truth."""
+    meta, owners, block_of = load_plan_sidecars(dir_path)
+    if meta is None:
+        raise ValueError(
+            f"{dir_path} has pre-versioned plan sidecars (no plan.json) — "
+            "FE chunk ownership needs a versioned plan to ride in"
+        )
+    write_plan_sidecars(
+        dir_path, owners, block_of,
+        version=int(meta["version"]),
+        hosts=[int(h) for h in meta["hosts"]],
+        binding={int(h): int(p) for h, p in meta["binding"].items()},
+        block_costs=np.asarray(meta["block_costs"], np.int64),
+        num_entities=int(meta["num_entities"]),
+        num_processes=int(meta.get("num_processes", 1)),
+        fe_chunk_owners=np.asarray(
+            [int(o) for o in fe_chunk_owners], np.int32
+        ),
+        fe_chunk_costs=np.asarray(
+            [int(c) for c in fe_chunk_costs], np.int64
+        ),
+    )
 
 
 @dataclasses.dataclass
@@ -494,6 +608,8 @@ def commit_perhost_manifest(
     plan_version: int,
     membership,
     block_costs: np.ndarray,
+    fe_chunk_owners: Optional[np.ndarray] = None,
+    fe_chunk_costs: Optional[np.ndarray] = None,
 ) -> None:
     """Atomically (re)write a per-host ``manifest.json`` + plan sidecars.
     ONE definition shared by the initial build (:func:`_write_owned_blocks`)
@@ -508,6 +624,8 @@ def commit_perhost_manifest(
         block_costs=block_costs,
         num_entities=int(base.num_entities_global),
         num_processes=int(base.num_processes),
+        fe_chunk_owners=fe_chunk_owners,
+        fe_chunk_costs=fe_chunk_costs,
     )
     manifest = dict(
         blocks=list(metas),

@@ -48,6 +48,9 @@ FAULT_SITES: Dict[str, str] = {
     "serve.route": "fleet router request-routing entry (serve/fleet/router.py)",
     "serve.replica_scatter": "per sub-request dispatch to a slab-owner replica (serve/fleet/router.py)",
     "serve.fleet_swap_barrier": "fleet-wide swap generation barrier, between prepare-all and commit (serve/fleet/swap.py)",
+    "serve.fleet_delta_rollout": "delta-retrain fleet rollout entry: export-manifest validation before the generation barrier; a failure aborts to the old generation (serve/fleet/swap.py)",
+    "multihost.relaunch_replan": "relaunch-time re-plan of a smaller/larger cohort from plan sidecars; a failure degrades to a recorded full re-ingest (parallel/elastic.py)",
+    "retrain.multihost_delta_agree": "cross-host delta-classification agreement check; disagreement or injected fault degrades every host to a recorded cold run (cli/game_multihost_driver.py)",
 }
 
 #: Preemption poll boundaries (the safe drain points) accepted by

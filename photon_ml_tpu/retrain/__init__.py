@@ -48,9 +48,11 @@ from photon_ml_tpu.retrain.manifest import (
     load_prior_manifest,
 )
 from photon_ml_tpu.retrain.warm import (
+    bucketed_random_effect_init,
     dense_random_effect_init,
     fixed_effect_init,
     random_effect_entity_means,
+    seed_perhost_spilled_state,
     seed_spilled_state,
 )
 
@@ -61,6 +63,7 @@ __all__ = [
     "FileDelta",
     "RETRAIN_MANIFEST",
     "RetrainManifest",
+    "bucketed_random_effect_init",
     "build_delta_streaming_manifest",
     "dense_random_effect_init",
     "diff_files",
@@ -70,5 +73,6 @@ __all__ = [
     "plan_delta",
     "probe_dirty_entities",
     "random_effect_entity_means",
+    "seed_perhost_spilled_state",
     "seed_spilled_state",
 ]

@@ -33,9 +33,11 @@ from photon_ml_tpu.io import model_io
 from photon_ml_tpu.types import real_dtype
 
 __all__ = [
+    "bucketed_random_effect_init",
     "dense_random_effect_init",
     "fixed_effect_init",
     "random_effect_entity_means",
+    "seed_perhost_spilled_state",
     "seed_spilled_state",
 ]
 
@@ -96,6 +98,89 @@ def dense_random_effect_init(
                 entity_means[raw].astype(real_dtype()), local_to_global[p]
             )
     return w
+
+
+def bucketed_random_effect_init(
+    entity_means: Dict[str, np.ndarray], bundle
+) -> List[np.ndarray]:
+    """Per-bucket warm coefficient stacks for a bucketed random-effect
+    coordinate (one ``(E_b, D_loc)`` array per bucket of a
+    :class:`~photon_ml_tpu.algorithm.bucketed_random_effect.
+    BucketedDatasetBundle`, matching ``initial_coefficients()``'s shapes
+    including ladder padding — padded rows stay 0, the cold init).
+
+    Each bucket's prior rows gather through the bucket layout exactly like
+    the export walks it (``vocab_position_maps``): bucket rows map dense
+    bucket-local ids to tensor positions, dense ids map back to the run's
+    vocab, and each positioned entity gathers its prior global row through
+    its own ``local_to_global`` projection — so an unchanged entity's
+    local coefficients reproduce BITWISE (the module-docstring argument)."""
+    stacks: List[np.ndarray] = []
+    for entity_ids, ds, dense_ids in zip(
+        bundle.buckets, bundle.datasets, bundle.dense_ids
+    ):
+        # ladder-canonicalized buckets pad entity_pos with -1 rows beyond
+        # the real rows dense_ids covers — slice to match (the same walk
+        # as BucketedRandomEffectCoordinate.vocab_position_maps)
+        entity_pos = np.asarray(ds.entity_pos)[: len(dense_ids)]
+        known = entity_pos >= 0
+        pos_of_dense = np.full(len(entity_ids), -1, np.int32)
+        pos_of_dense[dense_ids[known]] = entity_pos[known]
+        local_to_global = np.asarray(ds.local_to_global)
+        w = np.zeros((int(ds.num_entities), int(ds.local_dim)), real_dtype())
+        for d, vi in enumerate(entity_ids):
+            p = int(pos_of_dense[d])
+            if p < 0:
+                continue
+            raw = bundle.vocab[int(vi)]
+            if raw in entity_means:
+                w[p] = _gather_local(
+                    entity_means[raw].astype(real_dtype()),
+                    local_to_global[p],
+                )
+        stacks.append(w)
+    return stacks
+
+
+def seed_perhost_spilled_state(
+    manifest, entity_means: Dict[str, np.ndarray], state_dir: str
+):
+    """The multihost twin of :func:`seed_spilled_state`: a
+    :class:`~photon_ml_tpu.parallel.perhost_streaming.PerHostSpilledREState`
+    under ``state_dir`` seeded from the prior model for THIS host's owned
+    blocks only (files keyed by global block id, so the state survives an
+    elastic re-plan). Same metadata-only walk, same bitwise guarantee for
+    unchanged blocks; untouched blocks stay unwritten (zeros)."""
+    from photon_ml_tpu.algorithm.streaming_random_effect import (
+        _positions_of_dense,
+    )
+    from photon_ml_tpu.parallel.perhost_streaming import (
+        PerHostSpilledREState,
+    )
+
+    shapes = [(b["num_entities"], b["local_dim"]) for b in manifest.blocks]
+    state = PerHostSpilledREState(
+        dir=state_dir, shapes=shapes,
+        global_ids=[int(g) for g in manifest.global_block_ids],
+        plan_version=int(getattr(manifest, "plan_version", 1)),
+    )
+    for i in range(len(manifest.blocks)):
+        meta = manifest.load_block_meta(i)
+        pos_of_dense = _positions_of_dense(meta)
+        w = np.zeros(shapes[i], real_dtype())
+        touched = False
+        for j, vi in enumerate(meta.entity_ids):
+            raw = manifest.vocab[vi]
+            p = int(pos_of_dense[j])
+            if p >= 0 and raw in entity_means:
+                w[p] = _gather_local(
+                    entity_means[raw].astype(real_dtype()),
+                    np.asarray(meta.local_to_global[p]),
+                )
+                touched = True
+        if touched:
+            state.write(i, w)
+    return state
 
 
 def seed_spilled_state(

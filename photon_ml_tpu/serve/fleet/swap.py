@@ -34,6 +34,7 @@ current generation as a whole — degraded to one retry, never to a mix.
 from __future__ import annotations
 
 import logging
+import os
 from concurrent.futures import ThreadPoolExecutor
 from typing import List, Optional
 
@@ -189,6 +190,63 @@ class FleetSwapper:
             "%d commit stragglers)",
             epoch, n, new_compiles, len(commit_failures),
         )
+        return report
+
+    def rollout_delta(
+        self, fleet_dir: str, retrain_dir: Optional[str] = None
+    ) -> dict:
+        """Roll a DELTA retrain's fleet export through the generation
+        barrier as one atomic swap — the last arc of the daily loop
+        (retrain → re-shard → export → fleet swap).
+
+        Beyond :meth:`swap`, this validates the provenance seam first:
+        ``fleet_dir``'s export must trace back to the retrain run's saved
+        model (``retrain_dir``'s committed ``retrain.json``), so a fleet
+        cannot atomically adopt an export built from some OTHER model than
+        the retrain it claims to roll out. Fault site
+        ``serve.fleet_delta_rollout`` fires between validation and the
+        swap (the chaos tests' injection point); any failure — injected or
+        real — aborts with the old generation intact everywhere, exactly
+        like a prepare failure. A mid-swap replica loss inside the
+        delegated :meth:`swap` aborts the same way.
+        """
+        failure: Optional[str] = None
+        if retrain_dir is not None:
+            from photon_ml_tpu.retrain.manifest import RetrainManifest
+
+            try:
+                rman = RetrainManifest.load(retrain_dir)
+            except (OSError, ValueError, KeyError) as e:
+                failure = (
+                    f"retrain dir {retrain_dir} has no committed "
+                    f"retrain.json ({e}) — the retrain did not finish; "
+                    "nothing to roll out"
+                )
+            else:
+                exported = load_fleet_meta(fleet_dir).get("source_model_dir")
+                want = os.path.abspath(rman.model_dir)
+                if exported is None or os.path.abspath(exported) != want:
+                    failure = (
+                        f"fleet export {fleet_dir} was built from "
+                        f"{exported}, not the delta retrain's saved model "
+                        f"{want} — refusing to roll out a mismatched model"
+                    )
+        if failure is None:
+            try:
+                faults.inject(
+                    "serve.fleet_delta_rollout",
+                    fleet_dir=fleet_dir, retrain_dir=retrain_dir,
+                )
+            except OSError as e:
+                failure = f"delta rollout entry failed: {e}"
+        if failure is not None:
+            raise FleetSwapError(
+                f"delta rollout aborted ({failure}); old generation "
+                f"{self.router.generation} still serving on all replicas"
+            )
+        report = self.swap(fleet_dir)
+        report["rollout"] = "delta"
+        report["retrain_dir"] = retrain_dir
         return report
 
     def _redrive_commits(self) -> None:

@@ -1,0 +1,251 @@
+"""Worker for the 2-process SUPERVISED-RELAUNCH harness (launched by
+test_survivable_loop.py; also runnable by hand:
+
+    RELAUNCH_PHASE=seed     python tests/relaunch_replan_worker.py <pid> 2 <port> <dir>
+    RELAUNCH_PHASE=relaunch python tests/relaunch_replan_worker.py 0 1 - <dir>
+
+Unlike the in-band elastic arms (elastic_reshard_worker.py), this
+exercises the path the ElasticSession CANNOT take: the cohort itself
+changes across a process boundary. Phase ``seed`` runs a 2-process
+streaming CD for ONE checkpointed iteration and exits — the simulated
+preemption: host 1's capacity is gone and will not come back. Phase
+``relaunch`` starts ONE fresh process (the survivor), which must NOT
+re-ingest: it restores the prior cohort's plan-versioned sidecars,
+re-plans onto the 1-host cohort (relaunch_replan), delta-copies only the
+block/state files it newly owns, re-derives its fixed-effect chunk share
+from the plan's recorded FE ownership, and resumes the descent from the
+step-aligned checkpoint — finishing BITWISE-equal to an uninterrupted
+2-iteration run on the final topology (the single-host reference, which
+PR 9 pins equal to every topology)."""
+
+import os
+import sys
+import time
+
+proc_id, nprocs, port, outdir = (
+    int(sys.argv[1]), int(sys.argv[2]), sys.argv[3], sys.argv[4]
+)
+PHASE = os.environ.get("RELAUNCH_PHASE", "seed")
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+import jax.numpy as jnp
+
+from photon_ml_tpu.parallel import multihost
+
+mh = None
+ctx = None
+if PHASE == "seed":
+    mh = multihost.initialize(
+        coordinator_address=f"127.0.0.1:{port}", num_processes=nprocs,
+        process_id=proc_id,
+    )
+    ctx = mh.mesh_context()
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from game_test_utils import make_glmix_data  # noqa: E402
+
+from photon_ml_tpu.algorithm.coordinate_descent import CoordinateDescent  # noqa: E402
+from photon_ml_tpu.algorithm.streaming_fixed_effect import (  # noqa: E402
+    PerHostStreamingFixedEffectCoordinate,
+)
+from photon_ml_tpu.checkpoint import CoordinateDescentCheckpointer  # noqa: E402
+from photon_ml_tpu.compile.plan import ExecutionPlan  # noqa: E402
+from photon_ml_tpu.data.game import RandomEffectDataConfig  # noqa: E402
+from photon_ml_tpu.ops import losses as losses_mod  # noqa: E402
+from photon_ml_tpu.ops.regularization import RegularizationContext  # noqa: E402
+from photon_ml_tpu.optim.common import OptimizerConfig  # noqa: E402
+from photon_ml_tpu.optim.problem import GLMOptimizationProblem  # noqa: E402
+from photon_ml_tpu.parallel.elastic import (  # noqa: E402
+    FleetMembership,
+    relaunch_replan,
+)
+from photon_ml_tpu.parallel.perhost_ingest import HostRows, csr_to_padded  # noqa: E402
+from photon_ml_tpu.parallel.perhost_streaming import (  # noqa: E402
+    PerHostStreamingRandomEffectCoordinate,
+    attach_fe_chunks_to_sidecars,
+    build_perhost_streaming_manifest,
+)
+from photon_ml_tpu.types import OptimizerType, TaskType  # noqa: E402
+
+# ---- the globally seeded dataset (identical in every process) -------------
+rng = np.random.default_rng(97)
+data, _ = make_glmix_data(
+    rng, num_users=60, rows_per_user_range=(4, 16), d_fixed=5, d_random=4
+)
+N = data.num_rows
+D_FE = data.shards["global"].dim
+CHUNK_ROWS = 128
+BLOCK_ENTITIES = 16
+RE_CFG = RandomEffectDataConfig("userId", "per_user")
+FE_PROBLEM = GLMOptimizationProblem(
+    TaskType.LOGISTIC_REGRESSION, OptimizerType.LBFGS,
+    OptimizerConfig(max_iterations=6, tolerance=1e-8),
+    RegularizationContext.l2(0.5),
+)
+RE_OPT = OptimizerConfig(max_iterations=6, tolerance=1e-8)
+RE_REG = RegularizationContext.l2(0.2)
+FINGERPRINT = "relaunch-harness"
+
+coord_root = os.path.join(outdir, "streaming-re", "per-user")
+state_root = lambda pid: os.path.join(outdir, f"re-state-host{pid}")  # noqa: E731
+
+exec_plan = ExecutionPlan.resolve(
+    distributed=(nprocs > 1), streaming=True, num_processes=nprocs
+)
+
+# full-dataset FE design matrix (chunk c = rows [c*128, ...) — chunk
+# composition is host-invariant; only OWNERSHIP is split)
+gf = data.shards["global"]
+x_fe = np.zeros((N, D_FE), np.float32)
+x_fe[np.repeat(np.arange(N), np.diff(gf.indptr)), gf.indices] = gf.values
+chunk_sizes = [
+    min(CHUNK_ROWS, N - c * CHUNK_ROWS)
+    for c in range((N + CHUNK_ROWS - 1) // CHUNK_ROWS)
+]
+
+
+def fe_loaders(owned_chunks):
+    loaders = {}
+    for c in owned_chunks:
+        s = c * CHUNK_ROWS
+        e = s + chunk_sizes[c]
+
+        def load(s=s, e=e):
+            return {"x": x_fe[s:e], "y": data.response[s:e].astype(np.float32)}
+
+        loaders[c] = load
+    return loaders
+
+
+def make_re_coord(man, pid, initial_epoch=0, num_processes=1, mesh=None):
+    return PerHostStreamingRandomEffectCoordinate(
+        man, TaskType.LOGISTIC_REGRESSION,
+        optimizer=OptimizerType.LBFGS, optimizer_config=RE_OPT,
+        regularization=RE_REG,
+        state_root=state_root(pid),
+        plan=exec_plan, initial_epoch=initial_epoch,
+        ctx=mesh, num_processes=num_processes,
+    )
+
+
+def run_cd(fe_coord, re_coord, pid, num_iterations):
+    labels = jnp.asarray(data.response.astype(np.float32))
+    weights = jnp.asarray(data.weight.astype(np.float32))
+    loss = losses_mod.for_task(TaskType.LOGISTIC_REGRESSION)
+    ck = CoordinateDescentCheckpointer(
+        os.path.join(outdir, f"ckpt-host{pid}"),
+        run_fingerprint=FINGERPRINT, save_every=1,
+    )
+    resumed = ck.latest_step()
+    print(f"resumed_from_step={resumed if resumed is not None else 0}",
+          flush=True)
+    cd = CoordinateDescent(
+        {"fixed": fe_coord, "per-user": re_coord},
+        lambda s: jnp.sum(weights * loss.loss(s, labels)),
+    )
+    return cd.run(num_iterations=num_iterations, num_rows=N, checkpointer=ck)
+
+
+if PHASE == "seed":
+    # ---- 2-process cohort: one checkpointed iteration, then exit ----------
+    membership = FleetMembership.initial(nprocs)
+    lo = proc_id * (N // nprocs)
+    hi = N if proc_id == nprocs - 1 else (proc_id + 1) * (N // nprocs)
+    feats = data.shards["per_user"]
+    fi_all, fv_all = csr_to_padded(feats, N)
+    vocab0 = data.id_vocabs["userId"]
+    host_rows = HostRows(
+        entity_raw_ids=[vocab0[i] for i in data.ids["userId"][lo:hi]],
+        row_index=np.arange(lo, hi, dtype=np.int64),
+        labels=data.response[lo:hi].astype(np.float32),
+        weights=data.weight[lo:hi].astype(np.float32),
+        offsets=data.offset[lo:hi].astype(np.float32),
+        feat_idx=fi_all[lo:hi],
+        feat_val=fv_all[lo:hi],
+        global_dim=feats.dim,
+    )
+    manifest = build_perhost_streaming_manifest(
+        host_rows, RE_CFG, os.path.join(coord_root, f"process-{proc_id}"),
+        ctx, nprocs, proc_id, block_entities=BLOCK_ENTITIES,
+        bucketer=exec_plan.bucketer, membership=membership,
+    )
+    # record the FE chunk split the run ACTUALLY uses into the committed
+    # plan sidecars — what the relaunch re-bases instead of re-deciding
+    fe_owners = np.asarray([c % nprocs for c in range(len(chunk_sizes))],
+                           np.int32)
+    attach_fe_chunks_to_sidecars(manifest.dir, fe_owners, chunk_sizes)
+    my_chunks = [c for c in range(len(chunk_sizes))
+                 if int(fe_owners[c]) == proc_id]
+    fe_coord = PerHostStreamingFixedEffectCoordinate(
+        chunk_sizes, fe_loaders(my_chunks), D_FE, FE_PROBLEM,
+        plan=exec_plan, ctx=ctx, num_processes=nprocs,
+    )
+    re_coord = make_re_coord(manifest, proc_id, num_processes=nprocs,
+                             mesh=ctx)
+    t0 = time.perf_counter()
+    result = run_cd(fe_coord, re_coord, proc_id, num_iterations=1)
+    mh.barrier("seed-done")
+    print(
+        f"SEEDOK proc={proc_id} elapsed={time.perf_counter() - t0:.2f}s "
+        f"obj={result.objective_history[-1]:.9g}",
+        flush=True,
+    )
+    # the process simply exits here: host 1 never comes back — the
+    # supervisor relaunches a SMALLER cohort (phase ``relaunch``)
+elif PHASE == "relaunch":
+    # ---- the survivor, alone: re-plan + delta transfer + resume -----------
+    assert proc_id == 0 and nprocs == 1
+    t0 = time.perf_counter()
+    res = relaunch_replan(
+        coord_root, 0, 1,
+        state_root_pairs=[
+            ({0: state_root(0), 1: state_root(1)}, state_root(0)),
+        ],
+    )
+    print(
+        f"replanned_to_v{res.plan.version} adopted={len(res.adopted)} "
+        f"state_files={res.state_files_adopted} moved={len(res.moved)} "
+        f"no-reingest",
+        flush=True,
+    )
+    # FE chunk share from the re-based plan, not a fresh decision
+    my_chunks = res.plan.owned_fe_chunks(0, membership=res.membership)
+    assert sorted(my_chunks) == list(range(len(chunk_sizes))), my_chunks
+    print(f"fe_chunks={len(my_chunks)}/{len(chunk_sizes)}", flush=True)
+    fe_coord = PerHostStreamingFixedEffectCoordinate(
+        chunk_sizes, fe_loaders(my_chunks), D_FE, FE_PROBLEM,
+        plan=exec_plan, ctx=None, num_processes=1,
+    )
+    # epochs continue ABOVE the interrupted numbering so the restored
+    # checkpoint's state dirs (epoch-0...) are never collided with
+    re_coord = make_re_coord(res.manifest, 0, initial_epoch=10)
+    result = run_cd(fe_coord, re_coord, 0, num_iterations=2)
+    means = re_coord.entity_means_by_raw_id(result.coefficients["per-user"])
+    np.savez(
+        os.path.join(outdir, "means-host0.npz"),
+        names=np.asarray(sorted(means), dtype=object),
+        stack=np.stack([means[k] for k in sorted(means)])
+        if means else np.zeros((0, 0)),
+    )
+    np.savez(
+        os.path.join(outdir, "run.npz"),
+        fe=np.asarray(result.coefficients["fixed"]),
+        total_scores=np.asarray(result.total_scores),
+        objectives=np.asarray(result.objective_history, np.float64),
+    )
+    print(
+        f"RELAUNCHOK blocks={len(res.manifest.blocks)} "
+        f"iters={len(result.objective_history) // 2} "
+        f"elapsed={time.perf_counter() - t0:.2f}s "
+        f"obj={result.objective_history[-1]:.9g}",
+        flush=True,
+    )
+else:
+    raise SystemExit(f"unknown RELAUNCH_PHASE {PHASE!r}")

@@ -26,7 +26,8 @@ def test_list_sections_enumerates_all_sections():
         "preemption_resume",
         "perhost", "perhost_streaming", "elastic_reshard", "scoring",
         "serving",
-        "serving_fleet", "quantized_serving", "retrain_delta", "ingest",
+        "serving_fleet", "quantized_serving", "retrain_delta",
+        "delta_rollout", "ingest",
     ]
 
 

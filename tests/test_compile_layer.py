@@ -129,6 +129,7 @@ class TestMaskedPaddingExactness:
         assert np.asarray(f0).tobytes() == np.asarray(f1).tobytes()
         assert np.asarray(g0).tobytes() == np.asarray(g1).tobytes()
 
+    @pytest.mark.slow  # ~19s: tier-1 rides the 870s budget's edge; the masked-padding exactness contract stays tier-1 via test_masked_objective_zero_weight_rows_exact and the bucketed export pin test_streaming_chunk_vg_bit_identical_and_fewer_compiles
     def test_bucketed_update_and_score_bit_identical(self, glmix_small):
         from photon_ml_tpu.algorithm.bucketed_random_effect import (
             BucketedRandomEffectCoordinate,
@@ -336,6 +337,7 @@ class TestRecompileCounts:
         for k in means_off:
             assert means_on[k].tobytes() == means_off[k].tobytes()
 
+    @pytest.mark.slow  # ~9s: ladder export stays tier-1 via test_ladder_manifest_entity_export and compile-count discipline via test_same_ladder_blocks_compile_once
     def test_bucketed_entity_export_with_ladder(self, glmix_small):
         from photon_ml_tpu.algorithm.bucketed_random_effect import (
             BucketedRandomEffectCoordinate,

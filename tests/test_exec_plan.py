@@ -198,6 +198,7 @@ def _matrix_means(driver):
     )
 
 
+@pytest.mark.slow  # ~9s GSPMD compiles; variance export stays tier-1 via test_variance.py test_variance_roundtrips_through_avro_model_layout, mesh x schedule composition via TestResolve::test_mesh_pins_sparse_and_composes_schedule
 def test_mesh_scheduled_variance_export_survives_padding(
     matrix_train_dir, tmp_path
 ):

@@ -1,0 +1,212 @@
+"""fleetctl: the operator control plane writes EXACTLY the files the
+elastic monitor polls.
+
+The CLI is stdlib-only by design (it runs on an operator workstation
+against shared storage), so the shared on-disk contract with
+parallel/elastic.py is enforced here: file names, payload shapes, and
+the byte-identical output of the library writers. Every mutating action
+must validate against the committed membership BEFORE writing — a typo'd
+host id fails at the CLI, not as a livelocked re-plan loop — and must
+leave one JSON audit line behind.
+"""
+
+import json
+import os
+import sys
+
+import pytest
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "tools"))
+
+import fleetctl  # noqa: E402
+
+from photon_ml_tpu.parallel import elastic  # noqa: E402
+
+
+def _commit(fleet_dir, version=1, hosts=(0, 1, 2), binding=None):
+    mem = elastic.FleetMembership(
+        version=version,
+        hosts=list(hosts),
+        binding=binding or {h: h for h in hosts},
+    )
+    elastic.commit_membership(str(fleet_dir), mem)
+    return mem
+
+
+class TestParsing:
+    def test_host_list(self):
+        assert fleetctl.parse_host_list("2,3") == [2, 3]
+        assert fleetctl.parse_host_list("3, 1,1") == [1, 3]  # dedup + sort
+
+    @pytest.mark.parametrize("bad", ["", ",", "2,x", "a"])
+    def test_host_list_refused(self, bad):
+        with pytest.raises(fleetctl.FleetctlError):
+            fleetctl.parse_host_list(bad)
+
+    def test_binding_list(self):
+        assert fleetctl.parse_binding_list("4:0,5:1") == {4: 0, 5: 1}
+
+    @pytest.mark.parametrize(
+        "bad", ["", "4", "4:0:1", "4:x", "4:0,4:1"]
+    )
+    def test_binding_list_refused(self, bad):
+        with pytest.raises(fleetctl.FleetctlError):
+            fleetctl.parse_binding_list(bad)
+
+
+class TestSharedContract:
+    """fleetctl's constants and payloads match parallel/elastic.py's —
+    the monitor consumes what the CLI writes, byte for byte."""
+
+    def test_file_name_constants_match(self):
+        assert fleetctl.MEMBERSHIP_FILE == elastic.MEMBERSHIP_FILE
+        assert fleetctl.LOST_HOSTS_FILE == elastic.LOST_HOSTS_FILE
+        assert fleetctl.SCALE_REQUEST_FILE == elastic.SCALE_REQUEST_FILE
+        assert fleetctl.PROPOSALS_DIR == elastic.PROPOSALS_DIR
+
+    def test_lost_hosts_bytes_match_library_writer(self, tmp_path):
+        a, b = tmp_path / "a", tmp_path / "b"
+        a.mkdir(), b.mkdir()
+        _commit(a), _commit(b)
+        fleetctl.declare_lost_hosts(str(a), [1, 2], "zone-b reclamation")
+        elastic.declare_lost_hosts(str(b), [1, 2], "zone-b reclamation")
+        assert (
+            (a / elastic.LOST_HOSTS_FILE).read_bytes()
+            == (b / elastic.LOST_HOSTS_FILE).read_bytes()
+        )
+
+    def test_scale_request_bytes_match_library_writer(self, tmp_path):
+        a, b = tmp_path / "a", tmp_path / "b"
+        a.mkdir(), b.mkdir()
+        _commit(a), _commit(b)
+        fleetctl.request_scale_up(str(a), {4: 0, 5: 1}, "capacity returned")
+        elastic.request_scale_up(str(b), {4: 0, 5: 1}, "capacity returned")
+        assert (
+            (a / elastic.SCALE_REQUEST_FILE).read_bytes()
+            == (b / elastic.SCALE_REQUEST_FILE).read_bytes()
+        )
+
+    def test_membership_reader_round_trips_committed_meta(self, tmp_path):
+        mem = _commit(tmp_path, version=7, hosts=(0, 2), binding={0: 0, 2: 1})
+        got = fleetctl.read_membership(str(tmp_path))
+        assert got == mem.to_meta()
+
+
+class TestDeclareLostHosts:
+    def test_refused_without_membership(self, tmp_path):
+        with pytest.raises(fleetctl.FleetctlError, match="no committed"):
+            fleetctl.declare_lost_hosts(str(tmp_path), [1], "r")
+        assert not (tmp_path / elastic.LOST_HOSTS_FILE).exists()
+
+    def test_force_overrides_missing_membership(self, tmp_path):
+        fleetctl.declare_lost_hosts(str(tmp_path), [1], "r", force=True)
+        assert (tmp_path / elastic.LOST_HOSTS_FILE).exists()
+
+    def test_refused_for_unknown_owner(self, tmp_path):
+        _commit(tmp_path)
+        with pytest.raises(fleetctl.FleetctlError, match=r"\[9\] are not in"):
+            fleetctl.declare_lost_hosts(str(tmp_path), [1, 9], "r")
+        assert not (tmp_path / elastic.LOST_HOSTS_FILE).exists()
+
+    def test_refused_when_it_would_empty_the_fleet(self, tmp_path):
+        _commit(tmp_path)
+        with pytest.raises(fleetctl.FleetctlError, match="NO owners"):
+            fleetctl.declare_lost_hosts(str(tmp_path), [0, 1, 2], "r")
+
+    def test_missing_fleet_dir_refused(self, tmp_path):
+        with pytest.raises(fleetctl.FleetctlError, match="does not exist"):
+            fleetctl.declare_lost_hosts(str(tmp_path / "nope"), [0], "r")
+
+    def test_audit_line_per_action(self, tmp_path):
+        _commit(tmp_path)
+        fleetctl.declare_lost_hosts(str(tmp_path), [2], "first")
+        fleetctl.request_scale_up(str(tmp_path), {5: 0}, "second")
+        lines = (tmp_path / fleetctl.AUDIT_LOG).read_text().splitlines()
+        assert len(lines) == 2
+        first, second = (json.loads(ln) for ln in lines)
+        assert first["action"] == "declare-lost-hosts"
+        assert first["hosts"] == [2] and first["reason"] == "first"
+        assert first["membership_version"] == 1
+        assert second["action"] == "request-scale-up"
+        assert second["add"] == {"5": 0}
+        for entry in (first, second):
+            assert entry["operator"]  # who asked, answerable from the dir
+            assert entry["time"] > 0
+
+
+class TestRequestScaleUp:
+    def test_refused_without_membership(self, tmp_path):
+        with pytest.raises(fleetctl.FleetctlError, match="no committed"):
+            fleetctl.request_scale_up(str(tmp_path), {4: 0}, "r")
+
+    def test_refused_for_duplicate_logical_owner(self, tmp_path):
+        _commit(tmp_path)
+        with pytest.raises(fleetctl.FleetctlError, match="already in"):
+            fleetctl.request_scale_up(str(tmp_path), {1: 0}, "r")
+        assert not (tmp_path / elastic.SCALE_REQUEST_FILE).exists()
+
+    def test_refused_for_negative_physical_binding(self, tmp_path):
+        _commit(tmp_path)
+        with pytest.raises(fleetctl.FleetctlError, match="negative"):
+            fleetctl.request_scale_up(str(tmp_path), {4: -1}, "r")
+
+
+class TestStatus:
+    def test_snapshot_fields(self, tmp_path):
+        _commit(tmp_path)
+        fleetctl.declare_lost_hosts(str(tmp_path), [2], "r")
+        from photon_ml_tpu.parallel.multihost import write_host_heartbeat
+
+        write_host_heartbeat(
+            os.path.join(str(tmp_path), fleetctl.HEARTBEATS_DIR), 0
+        )
+        status = fleetctl.fleet_status(str(tmp_path))
+        assert status["membership"]["version"] == 1
+        assert status["lost_hosts_request"]["hosts"] == [2]
+        assert status["scale_request"] is None
+        assert "0" in status["heartbeat_ages"]
+        assert status["heartbeat_ages"]["0"] >= 0
+        assert status["consumed_requests"] == []
+        json.dumps(status)  # --json output must be serializable
+
+    def test_consumed_requests_listed(self, tmp_path):
+        _commit(tmp_path)
+        # the monitor archives a consumed request by renaming it
+        (tmp_path / f"{elastic.LOST_HOSTS_FILE}.consumed-v2").write_text("{}")
+        status = fleetctl.fleet_status(str(tmp_path))
+        assert status["consumed_requests"] == [
+            f"{elastic.LOST_HOSTS_FILE}.consumed-v2"
+        ]
+
+
+class TestCli:
+    def test_refusal_exits_2_and_writes_nothing(self, tmp_path, capsys):
+        _commit(tmp_path)
+        rc = fleetctl.main(
+            ["declare-lost-hosts", str(tmp_path), "--hosts", "9"]
+        )
+        assert rc == 2
+        assert "refused" in capsys.readouterr().err
+        assert not (tmp_path / elastic.LOST_HOSTS_FILE).exists()
+
+    def test_declare_and_status_round_trip(self, tmp_path, capsys):
+        _commit(tmp_path)
+        assert fleetctl.main(
+            ["declare-lost-hosts", str(tmp_path), "--hosts", "1,2",
+             "--reason", "drill"]
+        ) == 0
+        assert "declared lost" in capsys.readouterr().out
+        assert fleetctl.main(["status", str(tmp_path), "--json"]) == 0
+        status = json.loads(capsys.readouterr().out)
+        assert status["lost_hosts_request"]["hosts"] == [1, 2]
+
+    def test_scale_up_cli(self, tmp_path, capsys):
+        _commit(tmp_path)
+        assert fleetctl.main(
+            ["request-scale-up", str(tmp_path), "--add", "4:0,5:1"]
+        ) == 0
+        assert "scale-up requested" in capsys.readouterr().out
+        payload = json.loads(
+            (tmp_path / elastic.SCALE_REQUEST_FILE).read_text()
+        )
+        assert payload["add"] == {"4": 0, "5": 1}

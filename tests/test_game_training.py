@@ -383,6 +383,7 @@ def test_full_game_four_coordinate_cycle():
         assert float(area_under_roc_curve(result.total_scores, labels)) > 0.8
 
 
+@pytest.mark.slow  # ~8s: warm-start-from-initial-params stays tier-1 via test_retrain.py's warm-start pins and test_vmapped_grid.py test_grid_warm_start_reaches_same_optima
 def test_initial_params_warm_start(glmix):
     """run(initial_params=...) seeds named coordinates from a previous
     result (the grid warm-start hook): a second run warm-started from a

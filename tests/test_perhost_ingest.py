@@ -372,6 +372,7 @@ class TestAvroPerHostDecode:
 
 
 class TestPerHostCoordinateDescent:
+    @pytest.mark.slow  # ~10s: the perhost-coordinate-in-CD contract stays tier-1 via test_perhost_composes_with_fused_cycle and TestBucketedPerHost::test_bucketed_in_coordinate_descent
     def test_full_descent_with_perhost_coordinate(self, glmix, ctx):
         """PerHostRandomEffectSolver as a CoordinateDescent coordinate:
         fixed + per-host RE descent must match the plain (unsharded)
@@ -770,6 +771,7 @@ class TestPerHostProjectors:
             rtol=5e-4, atol=5e-4,
         )
 
+    @pytest.mark.slow  # ~14s: the factored-distributed contract stays tier-1 via test_parallel.py test_distributed_factored_matches_local and the bucket composition via test_random_composes_with_buckets here
     def test_factored_perhost_matches_single_device(self, glmix, ctx):
         """PerHostFactoredRandomEffectCoordinate (entity-sharded v, psum'd
         latent refit) must reproduce the single-device

@@ -149,6 +149,22 @@ def test_fleet_package_in_scan_scope():
     assert "photon_ml_tpu/cli/fleet_driver.py" in scanned
 
 
+def test_survivable_loop_surfaces_in_scan_scope():
+    """The operator control plane (tools/fleetctl.py) and the multihost
+    driver carrying the relaunch re-plan / delta-agreement glue are inside
+    the default scan scope — a broad except or unregistered fault site in
+    either cannot land without tripping tier-1."""
+    paths = [os.path.join(REPO, p) for p in engine.DEFAULT_SCOPE]
+    scanned = {
+        os.path.relpath(p, REPO).replace(os.sep, "/")
+        for p in engine.iter_py_files(paths)
+    }
+    assert "tools/fleetctl.py" in scanned
+    assert "photon_ml_tpu/cli/game_multihost_driver.py" in scanned
+    assert "photon_ml_tpu/parallel/elastic.py" in scanned
+    assert "photon_ml_tpu/retrain/warm.py" in scanned
+
+
 def test_exec_plan_module_in_scan_scope():
     """The execution-plan module (compile/plan.py) is inside the default
     scan scope: its resolve() consults env vars and constructs policy

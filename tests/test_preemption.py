@@ -616,6 +616,7 @@ class TestBucketedPreemption:
             for j in range(int(partial["meta"]["bucket"]))
         )
 
+    @pytest.mark.slow  # ~13s: bucket-boundary resume stays tier-1 via test_mid_bucket_emergency_checkpoint_resume_bitwise and test_mid_chunk_in_bucket_carries_partial here
     def test_bucket_boundary_drain_and_resume(self, glmix):
         """PHOTON_PREEMPT_AT grammar covers the new 'bucket' site: the
         drain lands BETWEEN buckets (no inner snapshot) and resumes
@@ -742,6 +743,7 @@ class TestMidBlockPreemption:
         )
         _assert_cd_results_equal(clean, resumed)
 
+    @pytest.mark.slow  # ~10s: mid-chunk-resume stays tier-1 via TestMidChunkPreemption (both optimizers) and mid-BLOCK resume via test_mid_block_emergency_resume_bitwise here
     def test_mid_chunk_inside_streaming_block_resumes_bitwise(
         self, glmix, tmp_path
     ):

@@ -239,6 +239,7 @@ class TestMicroBatcher:
         # a saturated queue never waits the window out: row cap flushes
         assert max(calls) <= 4 and len(calls) >= 2
 
+    @pytest.mark.slow  # ~10s randomized sweep; the cap contract stays tier-1 via test_batch_cap_flushes_without_wait / test_coalesces_and_slices
     def test_multi_row_requests_never_overshoot_cap(self):
         """A coalesced batch must stay <= max_batch_rows even when multi-
         row requests arrive (overshoot would pad to an unwarmed ladder

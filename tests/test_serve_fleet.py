@@ -521,6 +521,109 @@ class TestFleetSwap:
 
 
 # ---------------------------------------------------------------------------
+# Delta rollout: the retrain -> export -> fleet-swap provenance seam
+# ---------------------------------------------------------------------------
+
+
+def _retrain_manifest(tmp_path, model_dir, name="rollout"):
+    """A committed retrain.json whose saved model is ``model_dir`` (the
+    provenance the delta rollout traces)."""
+    from photon_ml_tpu.retrain.manifest import RetrainManifest
+
+    rd = tmp_path / name
+    rd.mkdir()
+    RetrainManifest(
+        output_dir=str(rd), model_dir=model_dir,
+        task="LOGISTIC_REGRESSION", file_stats=[], ingest_inputs={},
+        ingest_digest="d", updating_sequence=[], coordinates={},
+    ).save(str(rd))
+    return str(rd)
+
+
+class TestDeltaRollout:
+    def test_rollout_traces_retrain_and_swaps_atomically(
+        self, fleet_world, tmp_path
+    ):
+        retrain_dir = _retrain_manifest(tmp_path, fleet_world["model2"])
+        router, engines, _ = _local_fleet(fleet_world)
+        report = FleetSwapper(router).rollout_delta(
+            fleet_world["fleet2"], retrain_dir
+        )
+        assert report["rollout"] == "delta"
+        assert report["retrain_dir"] == retrain_dir
+        assert report["generation"] == 1
+        assert report["new_compiles"] == 0
+        assert report["dropped_requests"] == 0
+        assert router.generation == 1
+        _close_fleet(router, engines)
+
+    def test_mismatched_model_refused_old_generation_serves(
+        self, fleet_world, tmp_path
+    ):
+        """The export traces to model_dir but the retrain saved model2:
+        adopting it would serve a model the retrain never produced."""
+        retrain_dir = _retrain_manifest(tmp_path, fleet_world["model_dir"])
+        router, engines, _ = _local_fleet(fleet_world)
+        before = router.score_rows(fleet_world["requests"][:4])
+        with pytest.raises(FleetSwapError, match="mismatched"):
+            FleetSwapper(router).rollout_delta(
+                fleet_world["fleet2"], retrain_dir
+            )
+        assert router.generation == 0
+        assert all(e.epoch == 0 for e in engines)
+        np.testing.assert_array_equal(
+            before, router.score_rows(fleet_world["requests"][:4])
+        )
+        _close_fleet(router, engines)
+
+    def test_unfinished_retrain_refused(self, fleet_world, tmp_path):
+        """No committed retrain.json = the retrain never finished — there
+        is nothing to roll out, no matter how fresh the export looks."""
+        empty = tmp_path / "no-manifest"
+        empty.mkdir()
+        router, engines, _ = _local_fleet(fleet_world)
+        with pytest.raises(FleetSwapError, match="no committed"):
+            FleetSwapper(router).rollout_delta(
+                fleet_world["fleet2"], str(empty)
+            )
+        assert router.generation == 0
+        _close_fleet(router, engines)
+
+    def test_chaos_fault_aborts_then_next_rollout_succeeds(
+        self, fleet_world, tmp_path
+    ):
+        retrain_dir = _retrain_manifest(tmp_path, fleet_world["model2"])
+        router, engines, _ = _local_fleet(fleet_world)
+        before = router.score_rows(fleet_world["requests"][:4])
+        with faults.fault_scope(faults.FaultPlan(
+            [faults.FaultSpec("serve.fleet_delta_rollout", at=1)]
+        )):
+            with pytest.raises(FleetSwapError, match="delta rollout"):
+                FleetSwapper(router).rollout_delta(
+                    fleet_world["fleet2"], retrain_dir
+                )
+        assert router.generation == 0
+        assert all(e.epoch == 0 for e in engines)
+        np.testing.assert_array_equal(
+            before, router.score_rows(fleet_world["requests"][:4])
+        )
+        # nothing staged leaks: the next rollout goes through
+        report = FleetSwapper(router).rollout_delta(
+            fleet_world["fleet2"], retrain_dir
+        )
+        assert report["generation"] == 1
+        _close_fleet(router, engines)
+
+    def test_no_retrain_dir_skips_provenance(self, fleet_world):
+        router, engines, _ = _local_fleet(fleet_world)
+        report = FleetSwapper(router).rollout_delta(fleet_world["fleet2"])
+        assert report["rollout"] == "delta"
+        assert report["retrain_dir"] is None
+        assert report["generation"] == 1
+        _close_fleet(router, engines)
+
+
+# ---------------------------------------------------------------------------
 # Chaos: route faults, scatter faults, lost replicas
 # ---------------------------------------------------------------------------
 

@@ -1,0 +1,790 @@
+"""The survivable production loop: relaunch-time re-plan, plan-versioned
+fixed-effect chunk ownership, multihost delta-retrain agreement, and the
+warm-start builders that feed them.
+
+Fast single-process coverage drives the REAL production code paths with
+the same identity-routing trick as test_elastic_reshard (a fleet of
+per-physical-host manifests built from the full dataset, plus the
+single-process collective passthrough for the driver's agreement votes).
+The 2-process supervised-relaunch arm — kill a host, relaunch ONE
+survivor, re-plan, delta-transfer, resume bitwise — lives in
+tests/relaunch_replan_worker.py (slow-marked)."""
+
+import os
+import socket
+import subprocess
+import sys
+import types
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from game_test_utils import make_glmix_data
+
+from photon_ml_tpu.data.game import RandomEffectDataConfig
+from photon_ml_tpu.io import model_io
+from photon_ml_tpu.io.index_map import IndexMap, feature_key
+from photon_ml_tpu.optim.common import OptimizerConfig
+from photon_ml_tpu.ops.regularization import RegularizationContext
+from photon_ml_tpu.parallel.elastic import (
+    ElasticError,
+    FleetMembership,
+    relaunch_replan,
+)
+from photon_ml_tpu.parallel.perhost_ingest import (
+    HostRows,
+    csr_to_padded,
+    host_file_share,
+)
+from photon_ml_tpu.parallel.perhost_streaming import (
+    EntityShardPlan,
+    PerHostSpilledREState,
+    _PLAN_BLOCK_OF,
+    _PLAN_OWNERS,
+    attach_fe_chunks_to_sidecars,
+    build_perhost_streaming_manifest,
+    load_plan_sidecars,
+    write_plan_sidecars,
+)
+from photon_ml_tpu.resilience import faults
+from photon_ml_tpu.retrain.manifest import CoordinateRecord, RetrainManifest
+from photon_ml_tpu.types import OptimizerType, TaskType
+
+import photon_ml_tpu.cli.game_multihost_driver as mhd
+
+pytestmark = pytest.mark.elastic
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+WORKER = os.path.join(REPO, "tests", "relaunch_replan_worker.py")
+
+RE_CFG = RandomEffectDataConfig("userId", "per_user")
+RE_OPT = OptimizerConfig(max_iterations=6, tolerance=1e-8)
+RE_REG = RegularizationContext.l2(0.2)
+BLOCK_ENTITIES = 8
+LADDER = "8:2.0"
+TASK = TaskType.LOGISTIC_REGRESSION
+
+
+def _sorted_vocab_data(rng=None, **kw):
+    rng = rng or np.random.default_rng(41)
+    data, _ = make_glmix_data(rng, **kw)
+    vocab = data.id_vocabs["userId"]
+    order = np.argsort(np.asarray(vocab, dtype=object))
+    remap = np.empty(len(vocab), np.int64)
+    remap[order] = np.arange(len(vocab))
+    data.ids["userId"] = remap[data.ids["userId"]].astype(np.int32)
+    data.id_vocabs["userId"] = [vocab[i] for i in order]
+    return data
+
+
+def _host_rows(data):
+    feats = data.shards["per_user"]
+    fi, fv = csr_to_padded(feats, data.num_rows)
+    vocab = data.id_vocabs["userId"]
+    return HostRows(
+        entity_raw_ids=[vocab[i] for i in data.ids["userId"]],
+        row_index=np.arange(data.num_rows, dtype=np.int64),
+        labels=data.response.astype(np.float32),
+        weights=data.weight.astype(np.float32),
+        offsets=data.offset.astype(np.float32),
+        feat_idx=fi, feat_val=fv, global_dim=feats.dim,
+    )
+
+
+@pytest.fixture(scope="module")
+def glmix():
+    return _sorted_vocab_data(
+        num_users=40, rows_per_user_range=(3, 12), d_fixed=4, d_random=3
+    )
+
+
+def _build_cohort(data, coord_root, membership):
+    """One committed ``process-<pid>`` manifest per physical host of the
+    membership (identity routing at num_processes=1; block content is
+    host-invariant — the PR 9 foundation test_elastic_reshard pins)."""
+    rows = _host_rows(data)
+    manifests = {}
+    for p in sorted(set(membership.binding.values())):
+        manifests[p] = build_perhost_streaming_manifest(
+            rows, RE_CFG, os.path.join(coord_root, f"process-{p}"),
+            None, 1, p, block_entities=BLOCK_ENTITIES, bucketer=LADDER,
+            shared_vocab=data.id_vocabs["userId"],
+            membership=FleetMembership(
+                membership.version, list(membership.hosts),
+                dict(membership.binding),
+            ),
+        )
+    return manifests
+
+
+def _two_host_membership():
+    return FleetMembership(1, [0, 1], {0: 0, 1: 1})
+
+
+class _Log:
+    def __init__(self):
+        self.infos, self.warns = [], []
+
+    def info(self, msg):
+        self.infos.append(str(msg))
+
+    def warn(self, msg):
+        self.warns.append(str(msg))
+
+
+# ---------------------------------------------------------------------------
+# fixed-effect chunk ownership rides in the versioned plan
+# ---------------------------------------------------------------------------
+
+
+class TestFeChunkPlan:
+    @pytest.fixture()
+    def plan_dir(self, glmix, tmp_path):
+        _build_cohort(glmix, str(tmp_path / "re"), _two_host_membership())
+        return str(tmp_path / "re" / "process-0")
+
+    def test_plan_without_fe_ownership_refuses(self, plan_dir):
+        plan = EntityShardPlan.from_sidecars(plan_dir)
+        assert plan.fe_chunk_owners is None
+        with pytest.raises(ValueError, match="no FE chunk ownership"):
+            plan.owned_fe_chunks(0)
+
+    def test_explicit_owners_partition_and_validate(self, plan_dir):
+        plan = EntityShardPlan.from_sidecars(plan_dir)
+        fe = plan.with_fe_chunks([5, 3, 2], owners=[0, 1, 0])
+        assert fe.owned_fe_chunks(0) == [0, 2]
+        assert fe.owned_fe_chunks(1) == [1]
+        with pytest.raises(ValueError, match="disagree on the chunk count"):
+            plan.with_fe_chunks([5, 3, 2], owners=[0, 1])
+
+    def test_default_owners_cover_every_chunk(self, plan_dir):
+        plan = EntityShardPlan.from_sidecars(plan_dir)
+        fe = plan.with_fe_chunks([4, 4, 4, 4, 4])
+        covered = sorted(
+            c for h in plan.host_list() for c in fe.owned_fe_chunks(h)
+        )
+        assert covered == list(range(5))
+
+    def test_sidecar_round_trip_and_replan_rebase(self, plan_dir):
+        attach_fe_chunks_to_sidecars(plan_dir, [0, 1, 0, 1], [9, 7, 5, 3])
+        plan = EntityShardPlan.from_sidecars(plan_dir)
+        assert plan.fe_chunk_owners.tolist() == [0, 1, 0, 1]
+        assert plan.fe_chunk_costs.tolist() == [9, 7, 5, 3]
+        # the RE routing arrays are untouched by the FE attach
+        meta, owners, block_of = load_plan_sidecars(plan_dir)
+        assert int(meta["version"]) == plan.version
+        # survivor re-plan: FE chunks re-base onto the new host set just
+        # like entity blocks — every chunk lands on a live owner
+        survivor = plan.replan([0])
+        assert survivor.version == plan.version + 1
+        assert sorted(survivor.owned_fe_chunks(0)) == [0, 1, 2, 3]
+        grown = plan.replan([0, 1, 2])
+        covered = sorted(
+            c for h in (0, 1, 2) for c in grown.owned_fe_chunks(h)
+        )
+        assert covered == [0, 1, 2, 3]
+
+    def test_attach_refuses_pre_versioned_sidecars(self, tmp_path):
+        d = str(tmp_path / "pre")
+        os.makedirs(d)
+        np.save(os.path.join(d, "tmp.npy"), np.zeros(3, np.int32))
+        os.replace(os.path.join(d, "tmp.npy"), os.path.join(d, _PLAN_OWNERS))
+        np.save(os.path.join(d, "tmp.npy"), np.zeros(5, np.int32))
+        os.replace(os.path.join(d, "tmp.npy"),
+                   os.path.join(d, _PLAN_BLOCK_OF))
+        with pytest.raises(ValueError, match="pre-versioned"):
+            attach_fe_chunks_to_sidecars(d, [0], [1])
+
+
+# ---------------------------------------------------------------------------
+# relaunch-time re-plan (the supervised-relaunch seam, unit level)
+# ---------------------------------------------------------------------------
+
+
+class TestRelaunchReplan:
+    def _seed_state(self, manifests, tmp_path):
+        """Fabricated spill roots: one epoch dir per host holding that
+        host's owned blocks' coefficient files (value = host id + 1)."""
+        roots = {}
+        for p, man in manifests.items():
+            root = str(tmp_path / f"spill-{p}")
+            os.makedirs(os.path.join(root, "epoch-0"))
+            for b, gid in zip(man.blocks, man.global_block_ids):
+                np.save(
+                    os.path.join(root, "epoch-0", f"coefs-g{gid:05d}.npy"),
+                    np.full((b["num_entities"], b["local_dim"]),
+                            float(p + 1), np.float32),
+                )
+            roots[p] = root
+        return roots
+
+    def test_survivor_adopts_only_moved_blocks(self, glmix, tmp_path):
+        coord_root = str(tmp_path / "re")
+        manifests = _build_cohort(glmix, coord_root, _two_host_membership())
+        attach_fe_chunks_to_sidecars(
+            manifests[0].dir, [0, 1, 0], [10, 8, 6]
+        )
+        roots = self._seed_state(manifests, tmp_path)
+        res = relaunch_replan(
+            coord_root, 0, 1,
+            state_root_pairs=[({0: roots[0], 1: roots[1]}, roots[0])],
+        )
+        n_blocks = len(res.plan.owners)
+        assert res.plan.version == 2
+        assert res.membership.hosts == [0]
+        # the survivor's re-based manifest covers EVERY global block
+        assert sorted(res.manifest.global_block_ids) == list(range(n_blocks))
+        # only the dead host's blocks were copied; the survivor's own
+        # files stayed put (delta transfer, not a re-ingest)
+        assert sorted(res.adopted) == sorted(manifests[1].global_block_ids)
+        assert res.adopted  # the 2-host split genuinely moved blocks
+        by_gid = {g: b for g, b in zip(manifests[1].global_block_ids,
+                                       manifests[1].blocks)}
+        for g in res.adopted:
+            src = os.path.join(manifests[1].dir, by_gid[g]["file"])
+            dst = os.path.join(manifests[0].dir, by_gid[g]["file"])
+            with open(src, "rb") as a, open(dst, "rb") as b:
+                assert a.read() == b.read()
+            # the spilled coefficients rode along, epoch dir by name
+            moved = np.load(os.path.join(
+                roots[0], "epoch-0", f"coefs-g{g:05d}.npy"
+            ))
+            assert float(moved[0, 0]) == 2.0
+        assert res.state_files_adopted == len(res.adopted)
+        # FE chunk ownership re-based with the plan: all chunks -> host 0
+        assert sorted(res.plan.owned_fe_chunks(0, res.membership)) == [0, 1, 2]
+        assert any("no re-ingest" in d for d in res.decisions)
+
+    def test_chaos_site_fires_at_entry(self, glmix, tmp_path):
+        coord_root = str(tmp_path / "re")
+        _build_cohort(glmix, coord_root, _two_host_membership())
+        with faults.fault_scope(faults.FaultPlan(
+            [faults.FaultSpec("multihost.relaunch_replan", at=1)]
+        )):
+            with pytest.raises(OSError):
+                relaunch_replan(coord_root, 0, 1)
+        # the failure left the prior layout intact: a retry succeeds
+        res = relaunch_replan(coord_root, 0, 1)
+        assert res.plan.version == 2
+
+    def test_stale_cohort_member_refused(self, glmix, tmp_path):
+        coord_root = str(tmp_path / "re")
+        _build_cohort(glmix, coord_root, _two_host_membership())
+        d0 = os.path.join(coord_root, "process-0")
+        meta, owners, block_of = load_plan_sidecars(d0)
+        # simulate a re-shard that crashed mid-commit: host 0 moved to v2,
+        # host 1 never did — resuming from mixed versions must refuse
+        write_plan_sidecars(
+            d0, owners, block_of, version=2,
+            hosts=[int(h) for h in meta["hosts"]],
+            binding={int(h): int(q) for h, q in meta["binding"].items()},
+            block_costs=np.asarray(meta["block_costs"], np.int64),
+            num_entities=int(meta["num_entities"]),
+            num_processes=int(meta["num_processes"]),
+        )
+        with pytest.raises(ElasticError, match="stale"):
+            relaunch_replan(coord_root, 0, 1)
+
+    def test_empty_root_refused(self, tmp_path):
+        os.makedirs(str(tmp_path / "empty"))
+        with pytest.raises(ElasticError, match="nothing to re-plan"):
+            relaunch_replan(str(tmp_path / "empty"), 0, 1)
+
+
+# ---------------------------------------------------------------------------
+# warm-start builders (satellite: bucketed + per-host streaming)
+# ---------------------------------------------------------------------------
+
+
+class TestWarmBuilders:
+    def test_bucketed_export_seed_export_is_bitwise(self):
+        """export -> bucketed_random_effect_init -> export is the identity
+        (the property that makes a warm-started bucket exact)."""
+        from photon_ml_tpu.algorithm.bucketed_random_effect import (
+            BucketedDatasetBundle,
+            BucketedRandomEffectCoordinate,
+        )
+        from photon_ml_tpu.retrain import bucketed_random_effect_init
+
+        rng = np.random.default_rng(7)
+        data, _ = make_glmix_data(
+            rng, num_users=14, rows_per_user_range=(2, 12), d_random=3
+        )
+        bundle = BucketedDatasetBundle.build(data, RE_CFG)
+        coord = BucketedRandomEffectCoordinate(
+            data, RE_CFG, TASK, bundle=bundle
+        )
+        state = tuple(
+            jnp.asarray(rng.normal(size=np.asarray(w).shape)
+                        .astype(np.float32))
+            for w in coord.initial_coefficients()
+        )
+        means = coord.entity_means_by_raw_id(state)
+        assert means  # the fixture produced positioned entities
+        stacks = bucketed_random_effect_init(means, bundle)
+        assert len(stacks) == len(bundle.buckets)
+        means_back = coord.entity_means_by_raw_id(
+            tuple(jnp.asarray(s) for s in stacks)
+        )
+        assert sorted(means_back) == sorted(means)
+        for raw, row in means.items():
+            np.testing.assert_array_equal(means_back[raw], row, err_msg=raw)
+
+    def test_unknown_entities_stay_cold(self):
+        from photon_ml_tpu.algorithm.bucketed_random_effect import (
+            BucketedDatasetBundle,
+        )
+        from photon_ml_tpu.retrain import bucketed_random_effect_init
+
+        rng = np.random.default_rng(8)
+        data, _ = make_glmix_data(
+            rng, num_users=6, rows_per_user_range=(2, 6), d_random=3
+        )
+        bundle = BucketedDatasetBundle.build(data, RE_CFG)
+        stacks = bucketed_random_effect_init({}, bundle)
+        for s in stacks:
+            assert not s.any()  # no prior rows -> the cold init everywhere
+
+    def test_perhost_seed_export_round_trip(self, glmix, tmp_path):
+        """Per-host twin: spill random coefficients, export them, seed a
+        fresh state from the export — the re-export is bitwise-equal."""
+        from photon_ml_tpu.parallel.perhost_streaming import (
+            PerHostStreamingRandomEffectCoordinate,
+        )
+        from photon_ml_tpu.retrain import seed_perhost_spilled_state
+
+        man = _build_cohort(
+            glmix, str(tmp_path / "re"), FleetMembership.initial(1)
+        )[0]
+        coord = PerHostStreamingRandomEffectCoordinate(
+            man, TASK, OptimizerType.LBFGS, RE_OPT, RE_REG,
+            state_root=str(tmp_path / "state"), ctx=None, num_processes=1,
+        )
+        rng = np.random.default_rng(9)
+        state = PerHostSpilledREState(
+            dir=str(tmp_path / "spill"),
+            shapes=[(b["num_entities"], b["local_dim"]) for b in man.blocks],
+            global_ids=[int(g) for g in man.global_block_ids],
+            plan_version=int(man.plan_version),
+        )
+        for i, b in enumerate(man.blocks):
+            state.write(i, rng.normal(
+                size=(b["num_entities"], b["local_dim"])
+            ).astype(np.float32))
+        means = coord.entity_means_by_raw_id(state)
+        assert means
+        seeded = seed_perhost_spilled_state(
+            man, means, str(tmp_path / "seeded")
+        )
+        assert seeded.global_ids == [int(g) for g in man.global_block_ids]
+        means_back = coord.entity_means_by_raw_id(seeded)
+        assert sorted(means_back) == sorted(means)
+        for raw, row in means.items():
+            np.testing.assert_array_equal(means_back[raw], row, err_msg=raw)
+
+
+# ---------------------------------------------------------------------------
+# multihost driver glue (single-process collective passthrough)
+# ---------------------------------------------------------------------------
+
+
+def _mh():
+    return types.SimpleNamespace(process_id=0, num_processes=1)
+
+
+class TestRelaunchAdoption:
+    def _p(self, tmp_path):
+        return types.SimpleNamespace(
+            updating_sequence=["per-user"],
+            random_effect_data_configs={"per-user": RE_CFG},
+            factored_configs={},
+            output_dir=str(tmp_path),
+        )
+
+    def test_smaller_cohort_adopts(self, glmix, tmp_path):
+        p = self._p(tmp_path)
+        _build_cohort(
+            glmix, os.path.join(str(tmp_path), "streaming-re", "per-user"),
+            _two_host_membership(),
+        )
+        log = _Log()
+        adopted = mhd._attempt_relaunch_adoption(p, _mh(), None, log)
+        assert set(adopted) == {"per-user"}
+        res = adopted["per-user"]
+        assert res.plan.version == 2
+        assert res.membership.hosts == [0]
+        assert res.adopted
+
+    def test_same_cohort_is_a_plain_resume(self, glmix, tmp_path):
+        p = self._p(tmp_path)
+        _build_cohort(
+            glmix, os.path.join(str(tmp_path), "streaming-re", "per-user"),
+            FleetMembership.initial(1),
+        )
+        log = _Log()
+        assert mhd._attempt_relaunch_adoption(p, _mh(), None, log) == {}
+        assert any("same cohort" in m for m in log.infos)
+        assert not log.warns
+
+    def test_no_prior_layout_falls_back_to_ingest(self, tmp_path):
+        log = _Log()
+        assert mhd._attempt_relaunch_adoption(
+            self._p(tmp_path), _mh(), None, log
+        ) == {}
+        assert any(
+            "relaunch re-plan unavailable" in m for m in log.warns
+        )
+
+
+class TestFeChunkShare:
+    def test_adopted_plan_drives_the_share(self, glmix, tmp_path):
+        coord_root = str(tmp_path / "re")
+        manifests = _build_cohort(glmix, coord_root, _two_host_membership())
+        attach_fe_chunks_to_sidecars(manifests[0].dir, [0, 1, 0], [4, 4, 2])
+        res = relaunch_replan(coord_root, 0, 1)
+        files = ["part-0", "part-1", "part-2"]
+        log = _Log()
+        share = mhd._fe_chunk_share(files, {"per-user": res}, _mh(), log)
+        assert sorted(share) == [(f, c) for c, f in enumerate(files)]
+        assert any("re-based plan v2" in m for m in log.infos)
+
+    def test_ownership_width_mismatch_falls_back(self, glmix, tmp_path):
+        coord_root = str(tmp_path / "re")
+        manifests = _build_cohort(glmix, coord_root, _two_host_membership())
+        attach_fe_chunks_to_sidecars(manifests[0].dir, [0, 1, 0], [4, 4, 2])
+        res = relaunch_replan(coord_root, 0, 1)
+        files = ["part-0", "part-1"]  # the input set changed size
+        log = _Log()
+        share = mhd._fe_chunk_share(files, {"per-user": res}, _mh(), log)
+        assert share == host_file_share(files, 1, 0)
+        assert any("positional" in m for m in log.infos)
+
+    def test_no_adoption_is_the_positional_share(self):
+        files = [f"part-{i}" for i in range(5)]
+        share = mhd._fe_chunk_share(files, {}, _mh(), _Log())
+        assert share == host_file_share(files, 1, 0)
+
+
+class TestMultihostWarm:
+    """_prepare_multihost_warm at num_processes=1: the collective vote is
+    the local passthrough, so the agreement/poison seams run for real."""
+
+    def _p(self, tmp_path, prior_dir, **over):
+        kw = dict(
+            warm_start_from=str(prior_dir),
+            task_type=TASK,
+            updating_sequence=["global"],
+            fixed_effect_data_configs={
+                "global": types.SimpleNamespace(feature_shard_id="global"),
+            },
+            random_effect_data_configs={},
+            factored_configs={},
+            feature_shard_sections=None,
+            feature_shard_intercepts=None,
+            offheap_indexmap_dir=None,
+            feature_name_and_term_set_path=None,
+            validate_input_dirs=None,
+            evaluators=None,
+            output_dir=str(tmp_path),
+        )
+        kw.update(over)
+        return types.SimpleNamespace(**kw)
+
+    def _prior(self, p, prior_dir, files, coordinates, plan):
+        from photon_ml_tpu.io.tensor_cache import file_stat_token
+
+        model_dir = os.path.join(str(prior_dir), "model")
+        os.makedirs(model_dir, exist_ok=True)
+        man = RetrainManifest(
+            output_dir=str(prior_dir),
+            model_dir=model_dir,
+            task=TASK.value,
+            file_stats=file_stat_token(files),
+            ingest_inputs=mhd._mh_ingest_inputs(p, plan),
+            ingest_digest="d0",
+            updating_sequence=list(p.updating_sequence),
+            coordinates=coordinates,
+            eval_identity=mhd._mh_eval_identity(p),
+        )
+        man.save(str(prior_dir))
+        return model_dir
+
+    def test_no_flag_is_a_cold_run(self, tmp_path):
+        p = types.SimpleNamespace(warm_start_from=None)
+        assert mhd._prepare_multihost_warm(
+            p, _mh(), None, _Log(), None, {}, [], {}, [{}]
+        ) == (None, {}, set())
+
+    def test_unusable_prior_degrades_to_recorded_cold(self, tmp_path):
+        plan = types.SimpleNamespace(bucketer=None)
+        p = self._p(tmp_path, tmp_path / "never-written")
+        log = _Log()
+        out = mhd._prepare_multihost_warm(
+            p, _mh(), None, log, plan, {}, [], {}, [{}]
+        )
+        assert out == (None, {}, set())
+        assert any("failed on at least one host" in m for m in log.warns)
+        assert any("recorded decision" in m for m in log.warns)
+
+    def test_agreed_fixed_effect_warm_and_frozen(self, tmp_path):
+        from photon_ml_tpu.io.tensor_cache import file_stat_token  # noqa: F401
+
+        plan = types.SimpleNamespace(bucketer=None)
+        a = str(tmp_path / "part-0")
+        with open(a, "wb") as f:
+            f.write(b"train bytes")
+        prior_dir = tmp_path / "prior"
+        p = self._p(tmp_path, prior_dir)
+        imap = IndexMap.build(
+            [feature_key(f"f{i}") for i in range(6)], add_intercept=False
+        )
+        model_dir = self._prior(
+            p, prior_dir, [a],
+            {"global": CoordinateRecord(
+                kind="fixed", opt_config=str(mhd.CoordinateOptConfig())
+            )},
+            plan,
+        )
+        rng = np.random.default_rng(11)
+        means = rng.normal(size=(len(imap),)).astype(np.float32)
+        model_io.save_fixed_effect(model_dir, "global", TASK, means, imap)
+        log = _Log()
+        warm, frozen_blocks, frozen = mhd._prepare_multihost_warm(
+            p, _mh(), None, log, plan, {"global": imap}, [a], {}, [{}]
+        )
+        assert warm is not None and set(warm) == {"global"}
+        np.testing.assert_array_equal(np.asarray(warm["global"]), means)
+        assert frozen == {"global"} and frozen_blocks == {}
+        assert any("agreed across 1 hosts" in m for m in log.infos)
+        assert not log.warns
+
+    def test_agreed_streaming_warm_freezes_every_owned_block(
+        self, glmix, tmp_path
+    ):
+        plan = types.SimpleNamespace(bucketer=None)
+        man = _build_cohort(
+            glmix, str(tmp_path / "re"), FleetMembership.initial(1)
+        )[0]
+        a = str(tmp_path / "part-0")
+        with open(a, "wb") as f:
+            f.write(b"train bytes")
+        prior_dir = tmp_path / "prior"
+        p = self._p(
+            tmp_path, prior_dir,
+            updating_sequence=["per-user"],
+            fixed_effect_data_configs={},
+            random_effect_data_configs={"per-user": RE_CFG},
+        )
+        gdim = _host_rows(glmix).global_dim
+        imap = IndexMap.build(
+            [feature_key(f"f{i}") for i in range(gdim)], add_intercept=False
+        )
+        model_dir = self._prior(
+            p, prior_dir, [a],
+            {"per-user": CoordinateRecord(
+                kind="streaming_random",
+                opt_config=str(mhd.CoordinateOptConfig()),
+                streaming_manifest_dir=man.dir,
+            )},
+            plan,
+        )
+        rng = np.random.default_rng(12)
+        vocab = glmix.id_vocabs["userId"]
+        prior_means = {
+            vocab[0]: rng.normal(size=(len(imap),)).astype(np.float32),
+            vocab[3]: rng.normal(size=(len(imap),)).astype(np.float32),
+        }
+        model_io.save_random_effect(
+            model_dir, "per-user", TASK, prior_means, imap,
+            random_effect_id="userId", feature_shard_id="per_user",
+        )
+        log = _Log()
+        warm, frozen_blocks, frozen = mhd._prepare_multihost_warm(
+            p, _mh(), None, log, plan, {"per_user": imap}, [a],
+            {"per-user": man}, [{}],
+        )
+        assert warm is not None and set(warm) == {"per-user"}
+        assert isinstance(warm["per-user"], PerHostSpilledREState)
+        assert frozen == {"per-user"}
+        assert frozen_blocks["per-user"] == frozenset(
+            range(len(man.blocks))
+        )
+        assert any("agreed across 1 hosts" in m for m in log.infos)
+
+    def test_chaos_fault_degrades_to_cold(self, tmp_path):
+        plan = types.SimpleNamespace(bucketer=None)
+        a = str(tmp_path / "part-0")
+        with open(a, "wb") as f:
+            f.write(b"train bytes")
+        prior_dir = tmp_path / "prior"
+        p = self._p(tmp_path, prior_dir)
+        imap = IndexMap.build([feature_key("f0")], add_intercept=False)
+        model_dir = self._prior(
+            p, prior_dir, [a],
+            {"global": CoordinateRecord(
+                kind="fixed", opt_config=str(mhd.CoordinateOptConfig())
+            )},
+            plan,
+        )
+        model_io.save_fixed_effect(
+            model_dir, "global", TASK, np.zeros(1, np.float32), imap
+        )
+        log = _Log()
+        with faults.fault_scope(faults.FaultPlan(
+            [faults.FaultSpec("retrain.multihost_delta_agree", at=1)]
+        )):
+            out = mhd._prepare_multihost_warm(
+                p, _mh(), None, log, plan, {"global": imap}, [a], {}, [{}]
+            )
+        assert out == (None, {}, set())
+        assert any("recorded decision" in m for m in log.warns)
+        # the seam is once-per-plan: the very next attempt warms normally
+        warm, _, frozen = mhd._prepare_multihost_warm(
+            p, _mh(), None, _Log(), plan, {"global": imap}, [a], {}, [{}]
+        )
+        assert warm is not None and frozen == {"global"}
+
+
+def test_multihost_fingerprint_is_cohort_invariant():
+    """The relaunch contract: the CD checkpoint fingerprint must NOT bake
+    in num_processes, or a smaller/larger cohort could never resume the
+    prior cohort's checkpoints (MIGRATION.md pins this)."""
+    import inspect
+
+    src = inspect.getsource(mhd)
+    assert '"multihost": True' in src
+    assert '"multihost": mh.num_processes' not in src
+
+
+# ---------------------------------------------------------------------------
+# the 2-process supervised-relaunch arm (slow): seed on 2 hosts, kill one,
+# relaunch ONE survivor, resume bitwise vs the single-host reference
+# ---------------------------------------------------------------------------
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _communicate(procs, timeout=900):
+    outs = []
+    for p in procs:
+        out, err = p.communicate(timeout=timeout)
+        assert p.returncode == 0, (
+            f"worker failed rc={p.returncode}:\n{out[-3000:]}\n{err[-3000:]}"
+        )
+        outs.append(out)
+    return outs
+
+
+def _single_host_reference(tmp_path):
+    """The flags-off single-host streaming 2-iteration CD run of the
+    workers' seeded dataset — bitwise-equal (PR 9 pinned) to an
+    uninterrupted run on ANY topology, including the survivor's."""
+    from photon_ml_tpu.algorithm.coordinate_descent import CoordinateDescent
+    from photon_ml_tpu.algorithm.streaming_fixed_effect import (
+        StreamingFixedEffectCoordinate,
+    )
+    from photon_ml_tpu.algorithm.streaming_random_effect import (
+        StreamingRandomEffectCoordinate,
+        write_re_entity_blocks,
+    )
+    from photon_ml_tpu.optim.problem import GLMOptimizationProblem
+    from photon_ml_tpu.optim.streaming import ChunkedGLMSource
+    from photon_ml_tpu.ops import losses as losses_mod
+
+    data = _sorted_vocab_data(
+        np.random.default_rng(97),
+        num_users=60, rows_per_user_range=(4, 16), d_fixed=5, d_random=4,
+    )
+    N = data.num_rows
+    man = write_re_entity_blocks(
+        data, RE_CFG, str(tmp_path / "ref-blocks"), block_entities=16
+    )
+    re_ref = StreamingRandomEffectCoordinate(
+        man, TASK, OptimizerType.LBFGS, RE_OPT, RE_REG,
+        state_root=str(tmp_path / "ref-state"),
+    )
+    gf = data.shards["global"]
+    x_fe = np.zeros((N, gf.dim), np.float32)
+    x_fe[np.repeat(np.arange(N), np.diff(gf.indptr)), gf.indices] = gf.values
+    fe_ref = StreamingFixedEffectCoordinate(
+        ChunkedGLMSource.from_arrays(
+            x_fe, data.response.astype(np.float32), 128
+        ),
+        GLMOptimizationProblem(
+            TASK, OptimizerType.LBFGS,
+            OptimizerConfig(max_iterations=6, tolerance=1e-8),
+            RegularizationContext.l2(0.5),
+        ),
+    )
+    labels = jnp.asarray(data.response.astype(np.float32))
+    weights = jnp.asarray(data.weight.astype(np.float32))
+    loss = losses_mod.for_task(TASK)
+    cd = CoordinateDescent(
+        {"fixed": fe_ref, "per-user": re_ref},
+        lambda s: jnp.sum(weights * loss.loss(s, labels)),
+    )
+    ref = cd.run(num_iterations=2, num_rows=N)
+    ref_means = re_ref.entity_means_by_raw_id(ref.coefficients["per-user"])
+    return ref, ref_means
+
+
+@pytest.mark.slow
+def test_supervised_relaunch_smaller_cohort_resumes_bitwise(tmp_path):
+    """THE relaunch acceptance gate: a 2-host cohort runs one checkpointed
+    iteration and dies (the simulated preemption that does NOT come back);
+    a supervisor relaunches ONE survivor, which re-plans from the sidecars,
+    delta-copies only the dead host's block/state files, re-derives its FE
+    chunk share from the plan, resumes from the step-aligned checkpoint —
+    and finishes bitwise-equal to an uninterrupted single-host run."""
+    env = {
+        **os.environ,
+        "PHOTON_SOLVE_CHUNK": "off",
+        "PHOTON_SPARSE_KERNEL": "off",
+        "PHOTON_SHAPE_LADDER": "off",
+    }
+    port = _free_port()
+    seed = [
+        subprocess.Popen(
+            [sys.executable, WORKER, str(i), "2", str(port), str(tmp_path)],
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+            cwd=REPO, env={**env, "RELAUNCH_PHASE": "seed"},
+        )
+        for i in range(2)
+    ]
+    outs = _communicate(seed)
+    assert all("SEEDOK" in o for o in outs)
+    assert all("resumed_from_step=0" in o for o in outs)
+    survivor = subprocess.Popen(
+        [sys.executable, WORKER, "0", "1", "-", str(tmp_path)],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+        cwd=REPO, env={**env, "RELAUNCH_PHASE": "relaunch"},
+    )
+    out, = _communicate([survivor])
+    assert "RELAUNCHOK" in out
+    assert "replanned_to_v2" in out
+    assert "no-reingest" in out
+    assert "adopted=0 " not in out  # the dead host's blocks genuinely moved
+    assert "resumed_from_step=2" in out  # iteration 1 NOT recomputed
+    assert "fe_chunks=" in out
+    ref, ref_means = _single_host_reference(tmp_path)
+    run = np.load(tmp_path / "run.npz")
+    np.testing.assert_array_equal(
+        run["fe"], np.asarray(ref.coefficients["fixed"])
+    )
+    np.testing.assert_array_equal(
+        run["total_scores"], np.asarray(ref.total_scores)
+    )
+    np.testing.assert_array_equal(
+        run["objectives"], np.asarray(ref.objective_history, np.float64)
+    )
+    z = np.load(tmp_path / "means-host0.npz", allow_pickle=True)
+    merged = {str(n): v for n, v in zip(z["names"], z["stack"])}
+    assert sorted(merged) == sorted(ref_means)
+    for k, vec in ref_means.items():
+        np.testing.assert_array_equal(merged[k], vec, err_msg=k)

@@ -182,6 +182,7 @@ class TestProfilerHooks:
         with maybe_trace("stage"):
             pass  # must not require a profiler session
 
+    @pytest.mark.slow  # ~20s: a real jax.profiler device trace; the hook's noop/enable contract stays tier-1 in test_no_env_is_noop
     def test_trace_writes_artifacts(self, monkeypatch, tmp_path):
         import jax.numpy as jnp
 

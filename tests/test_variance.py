@@ -111,6 +111,7 @@ def _synthetic_training_avro(path, n, d, seed):
     avro_io.write_container(str(path), recs(), schemas.TRAINING_EXAMPLE)
 
 
+@pytest.mark.slow  # ~19s full staged GLM driver run; tier-1 siblings keep the contract: test_variance_is_inverse_hessian_diagonal / test_variance_linear_task pin the math, test_variance_roundtrips_through_avro_model_layout pins persistence
 def test_variance_through_driver_with_normalization(tmp_path):
     """--compute-variance true through the staged GLM driver with
     STANDARDIZATION: variances come back in RAW feature space
@@ -177,6 +178,7 @@ def _glmix_small(seed=11):
     )
 
 
+@pytest.mark.slow  # ~19s (per-entity numpy Hessians); the inverse-Hessian-diagonal contract itself stays tier-1 in test_variance_is_inverse_hessian_diagonal / test_variance_linear_task
 def test_random_effect_per_entity_variance_vs_numpy():
     """coefficient_variances == 1/diag(H_e) per entity, H_e computed
     independently in numpy over that entity's own rows."""
@@ -224,6 +226,7 @@ def test_random_effect_per_entity_variance_vs_numpy():
     assert checked >= 2
 
 
+@pytest.mark.slow  # ~24s full GAME driver run; the RE variance math stays tier-1 via test_random-effect siblings and the avro round trip via test_variance_roundtrips_through_avro_model_layout
 def test_game_driver_persists_re_variances(tmp_path):
     """--compute-variance true through the GAME driver: BOTH the fixed and
     the per-entity random-effect avro records carry variances, and they

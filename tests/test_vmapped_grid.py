@@ -60,6 +60,7 @@ def _coords(data, fe_lam, re_lam):
     return {"fixed": fixed, "random": random}
 
 
+@pytest.mark.slow  # ~15s: the grid-vs-sequential contract stays tier-1 via test_game_drivers.py TestVmappedGrid::test_vmapped_grid_matches_sequential and test_grid_warm_start_reaches_same_optima here
 def test_grid_matches_sequential_runs(setup):
     data, labels, loss_fn = setup
     n = data.num_rows

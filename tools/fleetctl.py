@@ -1,0 +1,368 @@
+#!/usr/bin/env python3
+"""fleetctl — the operator control plane for an elastic training fleet.
+
+Drives a RUNNING fleet through the operator files its
+:class:`photon_ml_tpu.parallel.elastic.ElasticMonitor` already polls at
+every drain boundary: ``lost-hosts.json`` (declare owners lost without
+waiting for the heartbeat deadline — e.g. a cluster manager's reclamation
+notice) and ``scale-request.json`` (fold new owners into the plan at the
+next drain). Plus ``status``, the read side: committed membership,
+per-owner heartbeat ages, any pending proposal, and un-consumed operator
+requests.
+
+Every mutating action is validated against the committed membership
+BEFORE the file is written (a typo'd host id must fail here, not livelock
+the fleet's re-plan loop) and appended to ``fleetctl-audit.log`` in the
+fleet dir — one JSON line per action, so "who asked for this re-plan" is
+answerable from the fleet dir alone.
+
+Deliberately light: imports neither jax nor the package's device-touching
+modules (the heartbeat/membership file formats are the shared on-disk
+contract, documented in parallel/{elastic,multihost}.py), so it runs on
+an operator workstation against shared storage.
+
+Usage:
+
+    python tools/fleetctl.py status            FLEET_DIR
+    python tools/fleetctl.py declare-lost-hosts FLEET_DIR --hosts 2,3 \
+        [--reason "zone-b reclamation"] [--force]
+    python tools/fleetctl.py request-scale-up  FLEET_DIR --add 4:0,5:1 \
+        [--reason "capacity returned"]
+
+``FLEET_DIR`` is the fleet coordination dir the training run was pointed
+at (the driver's ``<output>/elastic`` by convention, or the harness's
+explicit fleet dir).
+"""
+
+from __future__ import annotations
+
+import argparse
+import getpass
+import json
+import os
+import sys
+import time
+from typing import Dict, List, Optional
+
+MEMBERSHIP_FILE = "membership.json"
+PROPOSALS_DIR = "proposals"
+HEARTBEATS_DIR = "heartbeats"
+LOST_HOSTS_FILE = "lost-hosts.json"
+SCALE_REQUEST_FILE = "scale-request.json"
+HEARTBEAT_PREFIX = "heartbeat-"
+AUDIT_LOG = "fleetctl-audit.log"
+
+
+class FleetctlError(RuntimeError):
+    """A refused operator action (validation failed; nothing written)."""
+
+
+def _read_json(path: str) -> Optional[dict]:
+    if not os.path.exists(path):
+        return None
+    with open(path) as f:
+        return json.load(f)
+
+
+def _atomic_write_json(path: str, payload: dict) -> None:
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    tmp = f"{path}.tmp-{os.getpid()}"
+    with open(tmp, "w") as f:
+        json.dump(payload, f)
+    os.replace(tmp, path)
+
+
+def read_membership(fleet_dir: str) -> Optional[dict]:
+    """The committed membership meta (version/hosts/binding), or None
+    before the fleet's first commit."""
+    return _read_json(os.path.join(fleet_dir, MEMBERSHIP_FILE))
+
+
+def heartbeat_ages(fleet_dir: str) -> Dict[int, float]:
+    """Owner id -> seconds since its last beat (shared file format with
+    parallel/multihost.write_host_heartbeat; unreadable beats skipped)."""
+    directory = os.path.join(fleet_dir, HEARTBEATS_DIR)
+    ages: Dict[int, float] = {}
+    if not os.path.isdir(directory):
+        return ages
+    now = time.time()
+    for name in sorted(os.listdir(directory)):
+        if not name.startswith(HEARTBEAT_PREFIX) or not name.endswith(".json"):
+            continue
+        try:
+            with open(os.path.join(directory, name)) as f:
+                payload = json.load(f)
+            ages[int(payload["process"])] = now - float(payload["time"])
+        except (OSError, ValueError, KeyError):
+            continue
+    return ages
+
+
+def pending_proposal(fleet_dir: str, current_version: int) -> Optional[dict]:
+    return _read_json(os.path.join(
+        fleet_dir, PROPOSALS_DIR, f"proposal-v{current_version + 1}.json"
+    ))
+
+
+def write_audit_entry(fleet_dir: str, action: str, detail: dict) -> dict:
+    """Append one JSON line to the fleet dir's audit log (O_APPEND: single
+    lines from concurrent operators interleave whole, never torn)."""
+    entry = {
+        "time": time.time(),
+        "action": action,
+        "operator": getpass.getuser(),
+        **detail,
+    }
+    with open(os.path.join(fleet_dir, AUDIT_LOG), "a") as f:
+        f.write(json.dumps(entry) + "\n")
+    return entry
+
+
+def _require_fleet_dir(fleet_dir: str) -> None:
+    if not os.path.isdir(fleet_dir):
+        raise FleetctlError(f"fleet dir {fleet_dir} does not exist")
+
+
+def parse_host_list(spec: str) -> List[int]:
+    try:
+        hosts = sorted({int(h) for h in spec.split(",") if h.strip() != ""})
+    except ValueError:
+        raise FleetctlError(
+            f"--hosts must be a comma-separated list of owner ids, "
+            f"got {spec!r}"
+        )
+    if not hosts:
+        raise FleetctlError("--hosts names no owners")
+    return hosts
+
+
+def parse_binding_list(spec: str) -> Dict[int, int]:
+    """``logical:physical,logical:physical`` pairs for a scale-up."""
+    added: Dict[int, int] = {}
+    for pair in spec.split(","):
+        if pair.strip() == "":
+            continue
+        parts = pair.split(":")
+        if len(parts) != 2:
+            raise FleetctlError(
+                f"--add takes logical:physical pairs, got {pair!r}"
+            )
+        try:
+            h, q = int(parts[0]), int(parts[1])
+        except ValueError:
+            raise FleetctlError(
+                f"--add takes integer logical:physical pairs, got {pair!r}"
+            )
+        if h in added:
+            raise FleetctlError(f"--add names logical owner {h} twice")
+        added[h] = q
+    if not added:
+        raise FleetctlError("--add names no owners")
+    return added
+
+
+def declare_lost_hosts(
+    fleet_dir: str, hosts: List[int], reason: str, force: bool = False
+) -> dict:
+    """Validate + write ``lost-hosts.json`` + append the audit entry."""
+    _require_fleet_dir(fleet_dir)
+    mem = read_membership(fleet_dir)
+    if mem is None and not force:
+        raise FleetctlError(
+            f"{fleet_dir} has no committed membership yet — the fleet has "
+            "not started (or this is not a fleet dir); --force overrides"
+        )
+    if mem is not None:
+        live = sorted(int(h) for h in mem["hosts"])
+        unknown = [h for h in hosts if h not in live]
+        if unknown:
+            raise FleetctlError(
+                f"hosts {unknown} are not in membership "
+                f"v{mem['version']} (owners: {live}) — a declared loss of "
+                "an unknown owner would sit in lost-hosts.json forever, "
+                "never consumed by any re-plan"
+            )
+        survivors = [h for h in live if h not in hosts]
+        if not survivors:
+            raise FleetctlError(
+                f"declaring {hosts} lost would leave membership "
+                f"v{mem['version']} with NO owners — there is nothing to "
+                "re-plan onto; stop the run instead"
+            )
+    payload = {"hosts": [int(h) for h in hosts], "reason": reason}
+    _atomic_write_json(os.path.join(fleet_dir, LOST_HOSTS_FILE), payload)
+    return write_audit_entry(
+        fleet_dir, "declare-lost-hosts",
+        {"hosts": hosts, "reason": reason,
+         "membership_version": None if mem is None else int(mem["version"])},
+    )
+
+
+def request_scale_up(
+    fleet_dir: str, added: Dict[int, int], reason: str, force: bool = False
+) -> dict:
+    """Validate + write ``scale-request.json`` + append the audit entry."""
+    _require_fleet_dir(fleet_dir)
+    mem = read_membership(fleet_dir)
+    if mem is None and not force:
+        raise FleetctlError(
+            f"{fleet_dir} has no committed membership yet — the fleet has "
+            "not started (or this is not a fleet dir); --force overrides"
+        )
+    bad_phys = sorted(h for h, q in added.items() if q < 0)
+    if bad_phys:
+        raise FleetctlError(
+            f"logical owners {bad_phys} bind to negative physical "
+            "processes — the binding is a process index"
+        )
+    if mem is not None:
+        live = sorted(int(h) for h in mem["hosts"])
+        already = [h for h in added if h in live]
+        if already:
+            raise FleetctlError(
+                f"logical owners {already} are already in membership "
+                f"v{mem['version']} (owners: {live}) — a duplicate add "
+                "would be refused by every re-plan, forever"
+            )
+    payload = {
+        "add": {str(h): int(q) for h, q in sorted(added.items())},
+        "reason": reason,
+    }
+    _atomic_write_json(os.path.join(fleet_dir, SCALE_REQUEST_FILE), payload)
+    return write_audit_entry(
+        fleet_dir, "request-scale-up",
+        {"add": {str(h): int(q) for h, q in sorted(added.items())},
+         "reason": reason,
+         "membership_version": None if mem is None else int(mem["version"])},
+    )
+
+
+def fleet_status(fleet_dir: str) -> dict:
+    """One JSON-able snapshot of the fleet's coordination state."""
+    _require_fleet_dir(fleet_dir)
+    mem = read_membership(fleet_dir)
+    ages = heartbeat_ages(fleet_dir)
+    status: dict = {
+        "fleet_dir": os.path.abspath(fleet_dir),
+        "membership": mem,
+        "heartbeat_ages": {str(h): round(a, 3) for h, a in sorted(ages.items())},
+        "pending_proposal": (
+            pending_proposal(fleet_dir, int(mem["version"])) if mem else None
+        ),
+        "lost_hosts_request": _read_json(
+            os.path.join(fleet_dir, LOST_HOSTS_FILE)
+        ),
+        "scale_request": _read_json(
+            os.path.join(fleet_dir, SCALE_REQUEST_FILE)
+        ),
+    }
+    consumed = sorted(
+        name for name in os.listdir(fleet_dir)
+        if ".consumed-v" in name
+    )
+    status["consumed_requests"] = consumed
+    return status
+
+
+def _format_status(status: dict) -> str:
+    lines = [f"fleet: {status['fleet_dir']}"]
+    mem = status["membership"]
+    if mem is None:
+        lines.append("membership: (not committed yet)")
+    else:
+        lines.append(
+            f"membership: v{mem['version']} owners={mem['hosts']} "
+            f"binding={mem['binding']}"
+        )
+    if status["heartbeat_ages"]:
+        ages = " ".join(
+            f"{h}:{a:.1f}s" for h, a in status["heartbeat_ages"].items()
+        )
+        lines.append(f"heartbeats: {ages}")
+    else:
+        lines.append("heartbeats: (none)")
+    prop = status["pending_proposal"]
+    lines.append(
+        "pending proposal: "
+        + (f"v{prop['version']} ({prop.get('reason', '')})" if prop else "none")
+    )
+    for key, label in (
+        ("lost_hosts_request", "pending lost-hosts request"),
+        ("scale_request", "pending scale request"),
+    ):
+        req = status[key]
+        lines.append(f"{label}: " + (json.dumps(req) if req else "none"))
+    if status["consumed_requests"]:
+        lines.append(
+            "consumed requests: " + ", ".join(status["consumed_requests"])
+        )
+    return "\n".join(lines)
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="fleetctl", description=__doc__.split("\n\n")[0]
+    )
+    sub = parser.add_subparsers(dest="cmd", required=True)
+
+    s = sub.add_parser("status", help="show the fleet's coordination state")
+    s.add_argument("fleet_dir")
+    s.add_argument("--json", action="store_true",
+                   help="machine-readable output")
+
+    d = sub.add_parser(
+        "declare-lost-hosts",
+        help="declare owners lost without waiting for the heartbeat deadline",
+    )
+    d.add_argument("fleet_dir")
+    d.add_argument("--hosts", required=True,
+                   help="comma-separated logical owner ids, e.g. 2,3")
+    d.add_argument("--reason", default="operator-declared loss")
+    d.add_argument("--force", action="store_true",
+                   help="write even when no membership is committed yet")
+
+    u = sub.add_parser(
+        "request-scale-up",
+        help="request new owners be folded into the plan at the next drain",
+    )
+    u.add_argument("fleet_dir")
+    u.add_argument("--add", required=True,
+                   help="comma-separated logical:physical pairs, e.g. 4:0,5:1")
+    u.add_argument("--reason", default="operator scale-up")
+    u.add_argument("--force", action="store_true",
+                   help="write even when no membership is committed yet")
+
+    args = parser.parse_args(argv)
+    try:
+        if args.cmd == "status":
+            status = fleet_status(args.fleet_dir)
+            print(
+                json.dumps(status, indent=1, sort_keys=True)
+                if args.json else _format_status(status)
+            )
+        elif args.cmd == "declare-lost-hosts":
+            entry = declare_lost_hosts(
+                args.fleet_dir, parse_host_list(args.hosts),
+                args.reason, force=args.force,
+            )
+            print(
+                f"declared lost: {entry['hosts']} ({entry['reason']}) — "
+                "the fleet re-plans at its next drain boundary"
+            )
+        elif args.cmd == "request-scale-up":
+            entry = request_scale_up(
+                args.fleet_dir, parse_binding_list(args.add),
+                args.reason, force=args.force,
+            )
+            print(
+                f"scale-up requested: {entry['add']} ({entry['reason']}) — "
+                "the fleet re-plans at its next drain boundary"
+            )
+    except FleetctlError as e:
+        print(f"fleetctl: refused: {e}", file=sys.stderr)
+        return 2
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
