@@ -186,6 +186,21 @@ class BucketedRandomEffectCoordinate:
     # through the shard_map engine). Scheduled buckets re-enter the host
     # between chunks, so the coordinate opts out of the outer CD jit.
     solve_schedule: Optional[object] = None
+    # gap-guided adaptive bucket scheduling (optim.convergence
+    # .AdaptiveSchedule, None = always-visit): per-bucket convergence
+    # scores (max per-lane final gradient norm) are recorded every update,
+    # and a bucket under tolerance for `patience` consecutive epochs is
+    # SKIPPED — its coefficients carry forward unchanged, the skip a
+    # recorded PlanDecision guarded by the `optim.block_skip` fault site
+    # (an injected fault degrades to visit-everything). Buckets keep their
+    # positional order (the resume payload's done.j prefix depends on it;
+    # with <= max_buckets buckets the ordering win is negligible — the
+    # skip is the win). The in-memory ledger lives for the coordinate's
+    # lifetime; the STREAMING coordinate is the one with durable
+    # cross-restart persistence (its blocks are the billion-coefficient
+    # path). Skipping polls host state, so the coordinate opts out of the
+    # outer CD jit exactly like a scheduled one.
+    adaptive: Optional[object] = None
     # sparse per-entity kernels (ops/fused_sparse.py), selected PER BUCKET:
     # None = PHOTON_SPARSE_KERNEL (default off) | "auto" (each bucket races
     # the sparse families and the dense incumbent on its own slab; skewed
@@ -230,10 +245,18 @@ class BucketedRandomEffectCoordinate:
             )
             for i, ds in enumerate(b.datasets)
         ]
-        if self.solve_schedule is not None:
-            # per-bucket chunk pauses re-enter the host: the outer
-            # CoordinateDescent jit must call update raw
+        if self.solve_schedule is not None or self.adaptive is not None:
+            # per-bucket chunk pauses (and adaptive skip decisions)
+            # re-enter the host: the outer CoordinateDescent jit must call
+            # update raw
             self.cd_jit = False
+        # adaptive-schedule state (optim/convergence.py): bucket-indexed
+        # ledger + epoch counter + recorded skip decisions (never silent)
+        from photon_ml_tpu.optim.convergence import ConvergenceLedger
+
+        self._ledger = ConvergenceLedger()
+        self._epoch = 0
+        self.skip_decisions: list = []
         self._solvers = None
         if self.mesh_ctx is not None and self.solve_schedule is None:
             # one-shot mesh solves keep the measured shard_map engine;
@@ -376,6 +399,75 @@ class BucketedRandomEffectCoordinate:
             )
         return {"meta": meta, "arrays": arrays}
 
+    # -- adaptive-schedule plumbing (optim/convergence.py) -------------------
+    def _host_driven(self) -> bool:
+        """Whether update() runs as a host loop (scheduled or adaptive) —
+        only then may recording pull result arrays to host; inside the
+        outer CD jit the results are tracers and telemetry must stay off."""
+        return self.solve_schedule is not None or self.adaptive is not None
+
+    def _record_bucket_result(self, bi: int, res) -> None:
+        if not self._host_driven():
+            return
+        from photon_ml_tpu.optim.scheduler import solve_stats
+
+        score = float(np.max(np.asarray(res.grad_norm)))
+        executed = int(np.sum(np.asarray(res.iterations)))
+        under = (
+            self.adaptive is not None and score < self.adaptive.tolerance
+        )
+        self._ledger.observe(
+            bi, score, executed=executed, epoch=self._epoch,
+            under_tolerance=under,
+        )
+        solve_stats.record_block(
+            f"bucket{bi}", score=score, executed=executed
+        )
+
+    def _record_bucket_skip(self, bi: int) -> None:
+        from photon_ml_tpu.compile.plan import PlanDecision
+        from photon_ml_tpu.optim.scheduler import solve_stats
+
+        self._ledger.record_skip(bi, epoch=self._epoch)
+        solve_stats.record_block(f"bucket{bi}", skipped=True)
+        self.skip_decisions.append(PlanDecision(
+            "adaptive", "skipped",
+            f"bucket {bi} scored under tolerance "
+            f"{self.adaptive.tolerance:g} for >= {self.adaptive.patience} "
+            f"consecutive epochs; epoch {self._epoch} carries its "
+            "coefficients forward",
+        ))
+
+    def _adaptive_skips(self, n_buckets: int, start_bucket: int) -> set:
+        """The buckets this epoch skips under the adaptive policy. The
+        decision boundary is the ``optim.block_skip`` fault site — an
+        injected fault degrades the epoch to visit-everything with a
+        recorded decision, never a silent skip."""
+        if self.adaptive is None:
+            return set()
+        from photon_ml_tpu.compile.plan import PlanDecision
+        from photon_ml_tpu.resilience import faults
+
+        candidates = {
+            bi for bi in range(start_bucket, n_buckets)
+            if self._ledger.should_skip(bi, self.adaptive)
+        }
+        if candidates:
+            try:
+                faults.inject(
+                    "optim.block_skip",
+                    epoch=self._epoch, buckets=len(candidates),
+                )
+            except Exception as e:  # noqa: BLE001 — ANY injected fault means the skip decision is untrusted; visiting everything is the safe degrade
+                self.skip_decisions.append(PlanDecision(
+                    "adaptive", "pinned",
+                    f"bucket-skip fault at epoch {self._epoch} "
+                    f"({type(e).__name__}: {e}); degraded to "
+                    "visit-everything for this epoch",
+                ))
+                return set()
+        return candidates
+
     def update(
         self, residual_offsets: Array, state: Tuple[Array, ...],
         resume: Optional[dict] = None,
@@ -436,6 +528,9 @@ class BucketedRandomEffectCoordinate:
                         if k.startswith("inner.")
                     },
                 }
+        if resume is None:
+            self._epoch += 1
+        skips = self._adaptive_skips(len(units), start_bucket)
         # finished buckets' tracker summaries are telemetry, not state —
         # they are not recomputed on resume (streaming does the same)
         results: List[object] = [None] * start_bucket
@@ -443,6 +538,15 @@ class BucketedRandomEffectCoordinate:
             zip(units, self._row_sels, state)
         ):
             if bi < start_bucket:
+                continue
+            if bi in skips:
+                # adaptive skip: coefficients carry forward unchanged (the
+                # frozen-payload trick — score/regularization recompute
+                # from state, so exports stay exact); recorded, never
+                # silent
+                self._record_bucket_skip(bi)
+                new_state.append(w0)
+                results.append(None)
                 continue
             local_resid = residual_offsets[jnp.asarray(row_sel)]
             try:
@@ -463,6 +567,7 @@ class BucketedRandomEffectCoordinate:
                 ) from e
             new_state.append(coefs)
             results.append(res)
+            self._record_bucket_result(bi, res)
             # bucket-boundary drains only make sense on the host-driven
             # (scheduled) path: a one-shot bucketed update runs inside the
             # outer CoordinateDescent jit, where a poll would execute at

@@ -624,6 +624,21 @@ class StreamingRandomEffectCoordinate:
     # block reuse the same executables, and compaction composes with the
     # prefetch pipeline (block k+1 prefetches while block k's chunks run)
     solve_schedule: Optional[object] = None
+    # gap-guided adaptive visitation (optim.convergence.AdaptiveSchedule,
+    # None = always-visit): blocks are visited in DESCENDING convergence-
+    # score order and a block whose score sat under tolerance for
+    # `patience` consecutive epochs is skipped — coefficients carried
+    # forward bitwise like a frozen block, the skip a recorded
+    # PlanDecision (self.skip_decisions), the `optim.block_skip` fault
+    # site guarding the decision (an injected fault degrades the epoch to
+    # visit-everything). Score recording into the convergence ledger is
+    # ALWAYS on: it is host-side arithmetic over telemetry the solves
+    # already return, so the default path stays bitwise-identical.
+    adaptive: Optional[object] = None
+    # prior-run ledger entries (retrain.json's convergence_ledger) seeding
+    # a run whose manifest dir has no fresh sidecar — scores survive delta
+    # retrains even when the manifest itself is cache-resident
+    ledger_seed: Optional[dict] = None
     # sparse per-entity kernels (ops/fused_sparse.py), selected per block
     # SHAPE: None = PHOTON_SPARSE_KERNEL (default off) | "auto" | family.
     # Block slabs are built host-side once (first epoch) and cached on the
@@ -667,6 +682,8 @@ class StreamingRandomEffectCoordinate:
         if self.plan is not None:
             if self.solve_schedule is None:
                 self.solve_schedule = self.plan.schedule
+            if self.adaptive is None:
+                self.adaptive = self.plan.adaptive
             if self.sparse_kernel is None:
                 self.sparse_kernel = self.plan.sparse_kernel or "off"
             if self.prefetch_depth is None:
@@ -713,6 +730,26 @@ class StreamingRandomEffectCoordinate:
         # frozen block -> (row_sel, host scores): epoch-invariant by the
         # frozen contract, so one streaming pass covers the whole descent
         self._frozen_scores: dict = {}
+        # the adaptive-schedule convergence ledger (optim/convergence.py):
+        # per-GLOBAL-block scores + visit/skip/cost accounting, persisted
+        # as an atomic sidecar so skipping survives restarts. A same-run
+        # sidecar wins over a prior run's retrain.json seed.
+        from photon_ml_tpu.optim.convergence import ConvergenceLedger
+
+        self._ledger = ConvergenceLedger.load(self._ledger_dir())
+        if self._ledger is None and self.ledger_seed:
+            self._ledger = ConvergenceLedger.from_json(self.ledger_seed)
+        if self._ledger is None:
+            self._ledger = ConvergenceLedger()
+        # local indices skipped by the LAST update (their coefficients are
+        # unchanged, so their score/variance exports reuse cached values —
+        # the PR 13 frozen-payload trick, invalidated the moment the block
+        # is actually solved again)
+        self._adaptive_skipped: set = set()
+        self._skipped_scores: dict = {}
+        #: every adaptive skip / degrade, recorded as PlanDecisions in the
+        #: order they were taken (drivers log them; tests pin no-silent-skip)
+        self.skip_decisions: list = []
 
     def _update_fn(self, ds, local_resid, w0, slab=None):
         return _block_update(
@@ -744,6 +781,99 @@ class StreamingRandomEffectCoordinate:
         """State-object factory — the per-host coordinate overrides it to
         spill files keyed by GLOBAL block id (elastic re-plan transfers)."""
         return SpilledREState(dir=dir_path, shapes=self._shapes)
+
+    # -- adaptive-schedule plumbing (optim/convergence.py) -------------------
+    def _ledger_gid(self, i: int) -> int:
+        """Ledger key for local block index ``i`` — GLOBAL block id in the
+        per-host subclass so entries survive elastic re-plans; identity
+        here (single-host manifests own every block)."""
+        return int(i)
+
+    def _ledger_dir(self) -> str:
+        """Where the convergence-ledger sidecar lives: next to the
+        manifest (the durable location re-based by the elastic protocol),
+        unless the manifest is a cache-resident immutable entry (only
+        cache commits carry meta.json) — then under this run's state root."""
+        base = self.manifest.dir
+        if os.path.exists(os.path.join(base, "meta.json")):
+            return self.state_root
+        return base
+
+    def ledger_export(self) -> dict:
+        """JSON-safe ledger entries ({gid: entry}) for retrain.json and
+        the elastic re-plan ack records."""
+        return self._ledger.to_json()
+
+    def _save_ledger(self) -> None:
+        try:
+            self._ledger.save(self._ledger_dir())
+        except OSError:
+            # the ledger is an optimization's memory, never load-bearing:
+            # an unwritable dir degrades to always-visit after a restart
+            pass
+
+    def _record_block_result(self, i: int, res) -> None:
+        """Fold one solved block's telemetry into the convergence ledger +
+        solve_stats — pure host arithmetic over arrays ``update`` already
+        pulled to host, so recording is unconditionally on (bitwise-safe).
+        The score proxy is the max per-lane final gradient norm (ladder-pad
+        lanes converge at ~0 and never win the max); the cost is the
+        summed per-lane iteration count."""
+        from photon_ml_tpu.optim.scheduler import solve_stats
+
+        gid = self._ledger_gid(i)
+        score = float(np.max(np.asarray(res.grad_norm)))
+        executed = int(np.sum(np.asarray(res.iterations)))
+        under = (
+            self.adaptive is not None and score < self.adaptive.tolerance
+        )
+        self._ledger.observe(
+            gid, score, executed=executed, epoch=self._epoch,
+            under_tolerance=under,
+        )
+        solve_stats.record_block(f"g{gid}", score=score, executed=executed)
+        self._adaptive_skipped.discard(i)
+        self._skipped_scores.pop(i, None)
+        self._save_ledger()
+
+    def _adaptive_partition(self, pending: List[int]) -> "Tuple[List[int], List[int]]":
+        """(visit, skip) split of the pending local blocks under the
+        adaptive policy: visit order is descending convergence score
+        (unknown scores first), skips are the blocks whose score sat under
+        tolerance for `patience` consecutive epochs. The decision boundary
+        is the ``optim.block_skip`` fault site — an injected fault
+        degrades THIS epoch to visit-everything with a recorded decision,
+        never a silent skip. Always-visit (adaptive None) returns pending
+        unchanged: the default path's visitation is byte-identical to the
+        pre-adaptive coordinate."""
+        if self.adaptive is None or not pending:
+            return pending, []
+        from photon_ml_tpu.compile.plan import PlanDecision
+        from photon_ml_tpu.resilience import faults
+
+        gid_of = {i: self._ledger_gid(i) for i in pending}
+        rank = {g: r for r, g in enumerate(self._ledger.order(gid_of.values()))}
+        by_gap = sorted(pending, key=lambda i: rank[gid_of[i]])
+        candidates = [
+            i for i in by_gap
+            if self._ledger.should_skip(self._ledger_gid(i), self.adaptive)
+        ]
+        if candidates:
+            try:
+                faults.inject(
+                    "optim.block_skip",
+                    epoch=self._epoch, blocks=len(candidates),
+                )
+            except Exception as e:  # noqa: BLE001 — ANY injected fault means the skip decision is untrusted; visiting everything is the safe degrade
+                self.skip_decisions.append(PlanDecision(
+                    "adaptive", "pinned",
+                    f"block-skip fault at epoch {self._epoch} "
+                    f"({type(e).__name__}: {e}); degraded to "
+                    "visit-everything for this epoch",
+                ))
+                return by_gap, []
+        visit = [i for i in by_gap if i not in candidates]
+        return visit, candidates
 
     def replan_state_dirs(self) -> List[str]:
         """The spill dirs an elastic re-plan must re-base
@@ -950,6 +1080,33 @@ class StreamingRandomEffectCoordinate:
         # and are not recomputed — None placeholders, one slot per block
         summaries: List[Optional[object]] = [None] * n_blocks
         pending = [i for i in active if i not in done_locals]
+        # adaptive scheduling: reorder the pending blocks by descending
+        # convergence score and split off the persistently-converged ones
+        # (optim/convergence.py). Skips happen BEFORE the visit loop —
+        # coefficients carry forward bitwise like frozen blocks, the
+        # ledger + skip decisions are recorded and persisted up front, and
+        # the skipped blocks join done_locals so a later preemption's
+        # resume payload already counts them
+        pending, skipped = self._adaptive_partition(pending)
+        if skipped:
+            from photon_ml_tpu.compile.plan import PlanDecision
+            from photon_ml_tpu.optim.scheduler import solve_stats
+
+            for i in skipped:
+                gid = self._ledger_gid(i)
+                new_state.write(i, state.block(i))
+                self._ledger.record_skip(gid, epoch=self._epoch)
+                solve_stats.record_block(f"g{gid}", skipped=True)
+                self.skip_decisions.append(PlanDecision(
+                    "adaptive", "skipped",
+                    f"block g{gid} scored under tolerance "
+                    f"{self.adaptive.tolerance:g} for >= "
+                    f"{self.adaptive.patience} consecutive epochs; epoch "
+                    f"{self._epoch} carries its coefficients forward",
+                ))
+                self._adaptive_skipped.add(i)
+                done_locals.add(i)
+            self._save_ledger()
         # pipelined block loop: block k+1 reads from disk + transfers H2D
         # on the background stage while block k's vmapped solve runs —
         # resume streams ONLY the unfinished blocks (a re-plan leaves done
@@ -992,6 +1149,7 @@ class StreamingRandomEffectCoordinate:
             # pull the tracker to host NOW: keeping the vmapped OptResult
             # as device arrays would pin every block's buffers alive
             summaries[i] = jax.tree.map(np.asarray, res)
+            self._record_block_result(i, summaries[i])
             del ds, coefs, res
             done_locals.add(i)
             if len(done_locals) < len(active):
@@ -1021,12 +1179,18 @@ class StreamingRandomEffectCoordinate:
         self._elastic_drain(where="streaming-RE score entry")
         total = np.zeros(self.manifest.num_rows, real_dtype())
         # frozen blocks: coefficients and rows are epoch-invariant, so the
-        # first pass's scores serve every later call without touching disk
+        # first pass's scores serve every later call without touching disk.
+        # Adaptive-skipped blocks get the same treatment while skipped:
+        # their coefficients are unchanged since the cached pass, and the
+        # cache entry is dropped the moment the block is solved again —
+        # skipping keeps score/variance export exact (the PR 13 trick).
         stream = []
         for i in range(len(self.manifest.blocks)):
-            cached = (
-                self._frozen_scores.get(i) if i in self.frozen_blocks else None
-            )
+            cached = None
+            if i in self.frozen_blocks:
+                cached = self._frozen_scores.get(i)
+            elif i in self._adaptive_skipped:
+                cached = self._skipped_scores.get(i)
             if cached is not None:
                 row_sel, vals = cached
                 total[row_sel] = vals
@@ -1042,6 +1206,8 @@ class StreamingRandomEffectCoordinate:
             total[row_sel] = vals
             if i in self.frozen_blocks:
                 self._frozen_scores[i] = (np.asarray(row_sel), vals)
+            elif i in self._adaptive_skipped:
+                self._skipped_scores[i] = (np.asarray(row_sel), vals)
             del ds, w
         return jnp.asarray(total)
 

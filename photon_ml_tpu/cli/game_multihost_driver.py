@@ -657,7 +657,7 @@ def _prepare_multihost_warm(p, mh, ctx, logger, plan, shard_maps, all_files,
 def _write_mh_retrain_manifest(p, plan, best_dir, shard_maps, combos,
                                best_index, streaming_manifests,
                                coord_cache_keys, train_file_stats,
-                               logger) -> None:
+                               logger, coord_objs=None) -> None:
     """The coordinator's ``retrain.json`` (the single-process driver's
     record, multihost leg): next run's planner diffs against it, and the
     fleet rollout's provenance check traces its ``model_dir``."""
@@ -678,6 +678,13 @@ def _write_mh_retrain_manifest(p, plan, best_dir, shard_maps, combos,
         else:
             kind = "random"
         sm = streaming_manifests.get(name)
+        # the coordinator's convergence ledger (its OWN blocks, keyed by
+        # global block id) rides along; the other hosts' entries live in
+        # their per-host manifest-dir sidecars, re-based by elastic commits
+        ledger = None
+        export = getattr((coord_objs or {}).get(name), "ledger_export", None)
+        if callable(export):
+            ledger = export() or None
         coords[name] = CoordinateRecord(
             kind=kind,
             opt_config=str(sel.get(name, CoordinateOptConfig())),
@@ -688,6 +695,7 @@ def _write_mh_retrain_manifest(p, plan, best_dir, shard_maps, combos,
             shard_plan_version=int(
                 getattr(sm, "plan_version", 1) if sm is not None else 1
             ),
+            convergence_ledger=ledger,
         )
     manifest = RetrainManifest(
         output_dir=os.path.abspath(p.output_dir),
@@ -757,6 +765,7 @@ def _main_once(mh_args: dict, p, restart: bool = False) -> dict:
     plan = ExecutionPlan.resolve(
         shape_canonicalization=p.shape_canonicalization,
         solve_compaction=p.solve_compaction,
+        adaptive_schedule=p.adaptive_schedule,
         distributed=True,
         streaming=p.streaming_random_effects,
         bucketed=p.bucketed_random_effects,
@@ -1351,7 +1360,7 @@ def _main_once(mh_args: dict, p, restart: bool = False) -> dict:
             _write_mh_retrain_manifest(
                 p, plan, out, shard_maps, combos, best_index,
                 streaming_manifests, coord_cache_keys, train_file_stats,
-                logger,
+                logger, coord_objs=coords,
             )
         except (OSError, TypeError, ValueError) as e:
             # a failed manifest write degrades tomorrow's run to cold — it
@@ -1362,10 +1371,16 @@ def _main_once(mh_args: dict, p, restart: bool = False) -> dict:
     from photon_ml_tpu.compile import compile_stats
 
     logger.info(compile_stats.summary())
-    if plan.schedule is not None:
+    if plan.schedule is not None or plan.adaptive is not None:
         from photon_ml_tpu.optim.scheduler import solve_stats
 
         logger.info(solve_stats.summary())
+    if plan.adaptive is not None:
+        # every adaptive skip/degrade is a recorded decision — per host,
+        # like the plan's own composition decisions above
+        for name, coord in coords.items():
+            for dec in getattr(coord, "skip_decisions", ()) or ():
+                logger.info(f"[{name}] {dec.describe()}")
     logger.close()
     return {
         "objective_history": result.objective_history,

@@ -355,6 +355,14 @@ class GameTrainingParams:
     # batches between chunks, results are BITWISE-equal to the one-shot
     # kernel. None defers to PHOTON_SOLVE_CHUNK (default off).
     solve_compaction: Optional[str] = None
+    # gap-guided adaptive solve scheduling (optim/convergence.py): "off" |
+    # "on" | TOL | "TOL:K" — streaming/bucketed random-effect coordinates
+    # visit blocks in descending convergence-score order and skip a block
+    # whose gradient-norm score stayed under TOL for K consecutive epochs
+    # (coefficients carried forward bitwise, every skip a recorded
+    # PlanDecision). Off = bitwise-identical visitation to today. None
+    # defers to PHOTON_ADAPTIVE_SCHEDULE (default off).
+    adaptive_schedule: Optional[str] = None
     # non-"false": train the lambda grid through the traced-lambda grid API
     # (CoordinateDescent.run_grid — ONE compiled cycle serves every combo;
     # the batched G-lane vmapped variant this flag once selected lost every
@@ -460,12 +468,21 @@ class GameTrainingParams:
         except ValueError as e:
             errors.append(f"--solve-compaction: {e}")
             compaction_spec = "off"
+        adaptive_spec = self.adaptive_schedule
+        try:
+            from photon_ml_tpu.optim.convergence import resolve_adaptive
+
+            resolve_adaptive(adaptive_spec)
+        except ValueError as e:
+            errors.append(f"--adaptive-schedule: {e}")
+            adaptive_spec = "off"
         try:
             from photon_ml_tpu.compile.plan import ExecutionPlan
 
             ExecutionPlan.resolve(
                 shape_canonicalization=ladder_spec,
                 solve_compaction=compaction_spec,
+                adaptive_schedule=adaptive_spec,
                 distributed=self.distributed,
                 streaming=self.streaming_random_effects,
                 bucketed=self.bucketed_random_effects,
@@ -624,6 +641,18 @@ def build_training_parser() -> argparse.ArgumentParser:
            "--streaming-random-effects incl. the multihost per-host path "
            "(per-block owner-computes compaction); only --fused-cycle and "
            "--vmapped-grid true cannot pause at chunk boundaries")
+    a("--adaptive-schedule", default=None,
+      help="gap-guided adaptive solve scheduling for streaming/bucketed "
+           "random effects: visit blocks in descending convergence-score "
+           "order and, in tolerance mode, skip blocks whose gradient-norm "
+           "score stayed under TOL for K consecutive epochs (coefficients "
+           "carried forward bitwise, every skip a recorded plan decision): "
+           "off | on | TOL | TOL:K (e.g. 1e-5:2). Default defers to "
+           "PHOTON_ADAPTIVE_SCHEDULE. The per-block ledger persists in the "
+           "streaming manifest and retrain.json, and feeds observed block "
+           "costs into elastic re-plans; pinned to always-visit for "
+           "non-streaming/bucketed coordinates, fenced with --fused-cycle "
+           "and --vmapped-grid true")
     a("--vmapped-grid", default="false",
       help="train the lambda grid through the shared-compile grid API (ONE "
            "compiled cycle serves every combo; lambda-only grids on plain "
@@ -704,6 +733,7 @@ def parse_training_params(argv: Optional[List[str]] = None) -> GameTrainingParam
         store_dtype=ns.store_dtype,
         shape_canonicalization=ns.shape_canonicalization,
         solve_compaction=ns.solve_compaction,
+        adaptive_schedule=ns.adaptive_schedule,
         vmapped_grid=(
             "auto" if str(ns.vmapped_grid).lower() == "auto"
             else "true" if _truthy(ns.vmapped_grid) else "false"

@@ -147,6 +147,7 @@ class GameTrainingDriver:
         self.plan = ExecutionPlan.resolve(
             shape_canonicalization=params.shape_canonicalization,
             solve_compaction=params.solve_compaction,
+            adaptive_schedule=params.adaptive_schedule,
             distributed=params.distributed,
             streaming=params.streaming_random_effects,
             bucketed=params.bucketed_random_effects,
@@ -802,6 +803,17 @@ class GameTrainingDriver:
                     # their solves (coefficients carry forward bitwise
                     # from the warm-seeded state; empty/None when cold)
                     frozen_blocks=self._frozen_blocks.get(name),
+                    # warm delta retrain seeds the adaptive convergence
+                    # ledger from the prior run's record so importance
+                    # ordering survives across runs (manifest-sidecar
+                    # ledgers, when present, still win inside the coord)
+                    ledger_seed=(
+                        rec.convergence_ledger
+                        if self.retrain_prior is not None
+                        and (rec := self.retrain_prior.coordinates.get(name))
+                        is not None
+                        else None
+                    ),
                     # spilled state goes under OUR output dir, never inside
                     # the manifest dir (a --tensor-cache hit points that at
                     # the shared cache entry, which must stay run-agnostic);
@@ -863,6 +875,7 @@ class GameTrainingDriver:
                     bundle=self.bucketed_bundles[name],
                     mesh_ctx=self._mesh_context() if p.distributed else None,
                     solve_schedule=self.solve_schedule,
+                    adaptive=self.plan.adaptive,
                 )
             else:
                 scheduled_mesh = p.distributed and self.solve_schedule is not None
@@ -1724,10 +1737,17 @@ class GameTrainingDriver:
         from photon_ml_tpu.compile import compile_stats
 
         self.logger.info(compile_stats.summary())
-        if self.solve_schedule is not None:
+        if self.solve_schedule is not None or self.plan.adaptive is not None:
             from photon_ml_tpu.optim.scheduler import solve_stats
 
             self.logger.info(solve_stats.summary())
+        if self.plan.adaptive is not None:
+            # every adaptive skip/degrade is a recorded decision; surface
+            # them in the log like the plan's own composition decisions
+            for combo in self.combo_coords:
+                for name, coord in combo.items():
+                    for dec in getattr(coord, "skip_decisions", ()) or ():
+                        self.logger.info(f"[{name}] {dec.describe()}")
         if p.tensor_cache_dir:
             from photon_ml_tpu.io.tensor_cache import cache_stats
 
@@ -1803,6 +1823,17 @@ class GameTrainingDriver:
                 else:
                     kind = "random"
                 sm = self.streaming_manifests.get(name)
+                # the best combo's convergence ledger rides along so the
+                # next run's adaptive schedule starts warm (None when the
+                # coordinate kind has no ledger or the run kept none)
+                ledger = None
+                if self.combo_coords and 0 <= self.best_index < len(
+                    self.combo_coords
+                ):
+                    coord = self.combo_coords[self.best_index].get(name)
+                    export = getattr(coord, "ledger_export", None)
+                    if callable(export):
+                        ledger = export() or None
                 coords[name] = CoordinateRecord(
                     kind=kind,
                     opt_config=str(sel.get(name, CoordinateOptConfig())),
@@ -1813,6 +1844,7 @@ class GameTrainingDriver:
                     shard_plan_version=int(
                         getattr(sm, "plan_version", 1) if sm is not None else 1
                     ),
+                    convergence_ledger=ledger,
                 )
             manifest = RetrainManifest(
                 output_dir=os.path.abspath(p.output_dir),

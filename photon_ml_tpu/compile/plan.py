@@ -85,6 +85,12 @@ class ExecutionPlan:
 
     bucketer: Optional[ShapeBucketer] = None
     schedule: Optional[object] = None  # optim.scheduler.SolveSchedule
+    # gap-guided adaptive block visitation (optim.convergence
+    # .AdaptiveSchedule, None = always-visit): the epoch-level layer above
+    # ``schedule`` — streaming/bucketed coordinates visit blocks in
+    # descending convergence-score order and skip persistently-converged
+    # ones, every skip a recorded PlanDecision
+    adaptive: Optional[object] = None
     sharding: str = "none"
     sparse_kernel: Optional[str] = None
     prefetch_depth: Optional[int] = None
@@ -104,6 +110,7 @@ class ExecutionPlan:
         *,
         shape_canonicalization: Optional[str] = None,
         solve_compaction: Optional[object] = None,
+        adaptive_schedule: Optional[object] = None,
         distributed: bool = False,
         streaming: bool = False,
         bucketed: bool = False,
@@ -119,10 +126,12 @@ class ExecutionPlan:
         return the plan. Raises :class:`PlanError` only for the pairs
         that are impossible by construction."""
         from photon_ml_tpu.ops.fused_sparse import resolve_sparse_kernel
+        from photon_ml_tpu.optim.convergence import resolve_adaptive
         from photon_ml_tpu.optim.scheduler import resolve_schedule
 
         bucketer = resolve_bucketer(shape_canonicalization)
         schedule = resolve_schedule(solve_compaction)
+        adaptive = resolve_adaptive(adaptive_schedule)
         sparse = resolve_sparse_kernel(sparse_kernel)
         # resolved to a concrete int HERE (PHOTON_PREFETCH_DEPTH consumed
         # once), so coordinates reading the plan never re-resolve the env
@@ -150,6 +159,19 @@ class ExecutionPlan:
                 "--solve-compaction: chunk pauses re-enter the host "
                 "inside the compiled grid cycle; use --vmapped-grid auto "
                 "to fall back to the per-combo grid"
+            )
+        if fused_cycle and adaptive is not None:
+            raise PlanError(
+                "--adaptive-schedule orders and skips block visits on the "
+                "host between solves; --fused-cycle (one XLA program per "
+                "iteration) cannot compose"
+            )
+        if vmapped_grid == "true" and adaptive is not None:
+            raise PlanError(
+                "--vmapped-grid true cannot compose with "
+                "--adaptive-schedule: the block-visitation loop is "
+                "host-side; use --vmapped-grid auto to fall back to the "
+                "per-combo grid"
             )
 
         # ---- subsumed pairs ----------------------------------------------
@@ -192,6 +214,35 @@ class ExecutionPlan:
                 "independently through the shared chunk kernels",
             ))
 
+        # ---- adaptive block scheduling: needs block/bucket granularity ----
+        if adaptive is not None and not (streaming or bucketed):
+            decisions.append(PlanDecision(
+                "adaptive", "pinned",
+                "adaptive scheduling needs block/bucket visitation "
+                "granularity; in-memory dense coordinates solve all "
+                "entities in one vmapped call (lane-level skew is the "
+                "compaction schedule's job) — pinned to always-visit",
+            ))
+            adaptive = None
+        elif adaptive is not None and sharding == "perhost_streaming":
+            decisions.append(PlanDecision(
+                "adaptive", "composed",
+                "per-host streaming visits owned blocks in "
+                "descending-gap order and skips persistently-converged "
+                "ones; the per-block ledger is keyed by GLOBAL block id, "
+                "rides the elastic ack records, and feeds observed costs "
+                "into the next shard re-plan",
+            ))
+        elif adaptive is not None:
+            decisions.append(PlanDecision(
+                "adaptive", "composed",
+                "blocks/buckets are visited in descending "
+                "convergence-score order; a block under tolerance for "
+                f"{adaptive.patience} consecutive epochs is skipped with "
+                "a recorded decision (coefficients carried forward "
+                "bitwise, frozen-payload reuse)",
+            ))
+
         # ladder binds INTO the schedule: compacted lane rungs and padded
         # block shapes share one rung vocabulary (the PR 4 contract)
         if schedule is not None and bucketer is not None:
@@ -200,6 +251,7 @@ class ExecutionPlan:
         return cls(
             bucketer=bucketer,
             schedule=schedule,
+            adaptive=adaptive,
             sharding=sharding,
             sparse_kernel=sparse,
             prefetch_depth=prefetch_depth,
@@ -238,6 +290,8 @@ class ExecutionPlan:
             f"ladder={self.bucketer.describe() if self.bucketer else 'off'}",
             (f"schedule={self.schedule.describe()}"
              if self.schedule is not None else "schedule=one-shot"),
+            (f"adaptive={self.adaptive.describe()}"
+             if self.adaptive is not None else "adaptive=off"),
             (f"sharding={self.sharding}"
              + (f"@plan-v{self.shard_plan_version}"
                 if self.shard_plan_version != 1 else "")),

@@ -182,17 +182,23 @@ class SolveStats:
     plain counters, and only the worst (largest-baseline) record plus a
     short ring of the most recent ones are retained — a B-blocks x
     I-iterations x C-combos run records B*I*C solves without growing
-    process memory with the run length."""
+    process memory with the run length. The per-block convergence ledger
+    added for adaptive scheduling (optim/convergence.py) is keyed by block
+    label and updated in place, so it is bounded by the BLOCK COUNT, not
+    the run length."""
 
     RECENT_KEEP = 32
+    HOTTEST_KEEP = 5
 
     def __init__(self):
         self._lock = threading.Lock()
         self._counters = dict.fromkeys(
-            ("solves", "lanes", "executed", "baseline"), 0
+            ("solves", "lanes", "executed", "baseline",
+             "blocks_visited", "blocks_skipped"), 0
         )
         self._worst: Optional[SolveRecord] = None
         self._recent: List[SolveRecord] = []
+        self._blocks: dict = {}
 
     def record(self, rec: SolveRecord) -> None:
         with self._lock:
@@ -205,16 +211,43 @@ class SolveStats:
             self._recent.append(rec)
             del self._recent[: -self.RECENT_KEEP]
 
+    def record_block(self, label: str, *, score: Optional[float] = None,
+                     executed: int = 0, skipped: bool = False) -> None:
+        """One block-level visitation event for the adaptive-schedule
+        ledger (optim/convergence.py): a solved visit carries the block's
+        fresh convergence score and lane-iteration cost; an adaptive skip
+        carries neither (the score is unchanged by definition)."""
+        with self._lock:
+            e = self._blocks.setdefault(
+                label, {"visits": 0, "skips": 0, "score": None, "executed": 0}
+            )
+            if skipped:
+                e["skips"] += 1
+                self._counters["blocks_skipped"] += 1
+            else:
+                e["visits"] += 1
+                e["executed"] += int(executed)
+                if score is not None:
+                    e["score"] = float(score)
+                self._counters["blocks_visited"] += 1
+
     def snapshot(self) -> List[SolveRecord]:
         """The most recent solve records (bounded ring, newest last)."""
         with self._lock:
             return list(self._recent)
+
+    def block_totals(self) -> dict:
+        """Per-block visitation ledger snapshot: label -> visits/skips/
+        last score/cumulative lane-iterations."""
+        with self._lock:
+            return {k: dict(v) for k, v in self._blocks.items()}
 
     def reset(self) -> None:
         with self._lock:
             self._counters = dict.fromkeys(self._counters, 0)
             self._worst = None
             self._recent.clear()
+            self._blocks.clear()
 
     def totals(self) -> dict:
         with self._lock:
@@ -240,27 +273,49 @@ class SolveStats:
                 "saved_lane_iterations": (
                     self._counters["baseline"] - self._counters["executed"]
                 ),
+                "blocks_visited": self._counters["blocks_visited"],
+                "blocks_skipped": self._counters["blocks_skipped"],
             }
             worst = self._worst
+            blocks = {k: dict(v) for k, v in self._blocks.items()}
+        lines = []
         if not t["solves"]:
-            return "solve compaction: no compacted solves recorded"
-        pct = (
-            100.0 * t["saved_lane_iterations"] / t["baseline_lane_iterations"]
-            if t["baseline_lane_iterations"]
-            else 0.0
-        )
-        lines = [
-            f"solve compaction: {t['solves']} solves / {t['lanes']} lanes; "
-            f"{t['executed_lane_iterations']} lane-iterations executed vs "
-            f"{t['baseline_lane_iterations']} one-shot "
-            f"(saved {t['saved_lane_iterations']}, {pct:.1f}%)"
-        ]
+            lines.append("solve compaction: no compacted solves recorded")
+        else:
+            pct = (
+                100.0 * t["saved_lane_iterations"]
+                / t["baseline_lane_iterations"]
+                if t["baseline_lane_iterations"]
+                else 0.0
+            )
+            lines.append(
+                f"solve compaction: {t['solves']} solves / {t['lanes']} lanes; "
+                f"{t['executed_lane_iterations']} lane-iterations executed vs "
+                f"{t['baseline_lane_iterations']} one-shot "
+                f"(saved {t['saved_lane_iterations']}, {pct:.1f}%)"
+            )
         if worst is not None:
             decay = " -> ".join(
                 f"{c.active_lanes}/{c.batch_lanes}@{c.limit}" for c in worst.chunks
             )
             lines.append(
                 f"  [{worst.label}] active-lane decay (active/batch@limit): {decay}"
+            )
+        if blocks:
+            hottest = sorted(
+                ((k, v) for k, v in blocks.items() if v["score"] is not None),
+                key=lambda kv: -kv[1]["score"],
+            )[: self.HOTTEST_KEEP]
+            lines.append(
+                f"adaptive blocks: {t['blocks_visited']} visits / "
+                f"{t['blocks_skipped']} skips across {len(blocks)} blocks"
+                + (
+                    "; hottest: " + ", ".join(
+                        f"{k}(score={v['score']:.3g}, "
+                        f"iters={v['executed']})" for k, v in hottest
+                    )
+                    if hottest else ""
+                )
             )
         return "\n".join(lines)
 

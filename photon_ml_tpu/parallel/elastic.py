@@ -640,11 +640,19 @@ class ElasticSession:
         state_dir=None,
         epoch: int = 0,
         rebuild_block: Optional[Callable[[int], dict]] = None,
+        ledger: Optional[dict] = None,
     ) -> None:
         """Write this host's re-plan record (its block dir, durable state
         location, and per-block metadata) for the proposed version. Split
         from :meth:`replan_finish` so single-process tests can drive a
-        whole simulated fleet through the protocol."""
+        whole simulated fleet through the protocol.
+
+        ``ledger`` — this host's convergence-ledger entries
+        ({gid: entry}, the coordinate's ``ledger_export()``): they ride
+        the ack record so every survivor computes the identical merged
+        ledger, feeds realized per-block costs into the v+1 owner
+        balancing (hot blocks spread across owners), and re-bases each
+        moved block's entry to its new owner's sidecar."""
         from photon_ml_tpu.parallel.perhost_streaming import EntityShardPlan
 
         new_mem = FleetMembership.from_meta(proposal)
@@ -718,6 +726,8 @@ class ElasticSession:
                 str(g): m for g, m in zip(owned, manifest.blocks)
             },
         }
+        if ledger:
+            record["ledger"] = {str(g): dict(e) for g, e in ledger.items()}
         _atomic_write_json(self._ack_path(new_mem.version, "json"), record)
         self._pending = {
             "proposal": proposal,
@@ -804,8 +814,27 @@ class ElasticSession:
                 records[q] = json.load(f)
 
         # ---- the deterministic new plan: THE replan primitive the unit
-        # tests pin, not a parallel inline re-derivation ---------------------
-        new_plan = old_plan.replan(new_mem.hosts, version=new_mem.version)
+        # tests pin, not a parallel inline re-derivation. When any record
+        # carries convergence-ledger entries, every survivor folds them
+        # into ONE merged ledger (deterministic merge, ordered record
+        # iteration) and the realized per-block costs drive the owner
+        # balancing — hot blocks spread across owners -----------------------
+        from photon_ml_tpu.optim.convergence import ConvergenceLedger
+
+        merged_ledger = None
+        if any(r.get("ledger") for r in records.values()):
+            merged_ledger = ConvergenceLedger()
+            for q in sorted(records):
+                merged_ledger.merge({
+                    int(g): e
+                    for g, e in (records[q].get("ledger") or {}).items()
+                })
+        new_plan = old_plan.replan(
+            new_mem.hosts, version=new_mem.version,
+            observed_costs=(
+                merged_ledger.observed_costs() if merged_ledger else None
+            ),
+        )
         moved = old_plan.moved_blocks(new_plan, old_mem, new_mem)
         old_phys = old_mem.physical_owners(old_plan.owners)
         new_phys = new_mem.physical_owners(new_plan.owners)
@@ -917,6 +946,17 @@ class ElasticSession:
             fe_chunk_owners=new_plan.fe_chunk_owners,
             fe_chunk_costs=new_plan.fe_chunk_costs,
         )
+        if merged_ledger is not None:
+            # re-base the convergence ledger alongside the manifest: each
+            # survivor's sidecar carries exactly its NEW owned blocks'
+            # entries (a moved-in block's skip streak survives the move),
+            # so the rebuilt coordinate resumes adaptive scheduling warm
+            rebased = ConvergenceLedger()
+            rebased.merge({
+                g: e for g in new_owned
+                for e in [merged_ledger.entry(g)] if e is not None
+            })
+            rebased.save(my_dir)
 
         # ---- the done barrier: no peer resumes (and GC's epochs / rewrites
         # state) while another is still copying from its dirs --------------
@@ -1026,13 +1066,16 @@ class ElasticSession:
         state_dir=None,
         epoch: int = 0,
         rebuild_block: Optional[Callable[[int], dict]] = None,
+        ledger: Optional[dict] = None,
     ) -> ReshardResult:
         """detect(ed) -> agree -> delta-transfer -> re-base, one call.
         ``state_dir`` is a path OR a sequence of paths (the coordinate's
-        ``replan_state_dirs()``) naming every live spill dir to re-base."""
+        ``replan_state_dirs()``) naming every live spill dir to re-base;
+        ``ledger`` is the coordinate's ``ledger_export()`` (convergence
+        scores ride the re-plan so observed costs drive the balancing)."""
         self.replan_prepare(
             manifest, proposal, state_dir=state_dir, epoch=epoch,
-            rebuild_block=rebuild_block,
+            rebuild_block=rebuild_block, ledger=ledger,
         )
         return self.replan_finish()
 

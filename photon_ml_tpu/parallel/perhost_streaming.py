@@ -334,18 +334,40 @@ class EntityShardPlan:
                 if int(phys[c]) == process_id]
 
     def replan(self, hosts: Sequence[int],
-               version: Optional[int] = None) -> "EntityShardPlan":
+               version: Optional[int] = None,
+               observed_costs: Optional[Dict[int, float]] = None
+               ) -> "EntityShardPlan":
         """The same blocking re-assigned over a NEW owner-host set: blocks
-        and costs are untouched (block composition is membership-invariant
-        — the bitwise foundation), only the deterministic balanced owner
-        map re-runs. Every survivor derives the identical v+1 plan."""
+        are untouched (block composition is membership-invariant — the
+        bitwise foundation), only the deterministic balanced owner map
+        re-runs. Every survivor derives the identical v+1 plan.
+
+        ``observed_costs`` (gid -> realized lane-iterations per visit,
+        from the convergence ledger, optim/convergence.py) replaces the
+        static row-count proxy for the blocks it covers, so hot blocks
+        spread across owners instead of balancing by count — skew-aware
+        rebalancing. The effective costs are persisted as the new plan's
+        ``block_costs`` (the sidecars record what was actually balanced).
+        Owner assignment never touches block arithmetic, so a re-plan with
+        observed costs stays bitwise-pinned vs a fresh run on the same
+        assignment. None (the default) is byte-identical to the static
+        re-plan."""
         if self.block_costs is None:
             raise ValueError(
                 "plan carries no block costs (pre-versioned sidecar) — "
                 "cannot re-plan; rebuild the manifest instead"
             )
         host_list = sorted(int(h) for h in hosts)
-        owners = balanced_owners_over_hosts(self.block_costs, host_list)
+        block_costs = self.block_costs
+        if observed_costs:
+            eff = np.asarray(block_costs, np.int64).copy()
+            for g, c in observed_costs.items():
+                g = int(g)
+                if 0 <= g < len(eff) and c > 0:
+                    # ceil so a tiny-but-hot block never rounds to 0 cost
+                    eff[g] = max(int(np.ceil(float(c))), 1)
+            block_costs = eff
+        owners = balanced_owners_over_hosts(block_costs, host_list)
         fe_owners = self.fe_chunk_owners
         if self.fe_chunk_costs is not None:
             # FE chunks re-base the same way: costs are membership-
@@ -358,6 +380,7 @@ class EntityShardPlan:
             owners=owners.astype(np.int32),
             hosts=host_list,
             version=self.version + 1 if version is None else int(version),
+            block_costs=block_costs,
             fe_chunk_owners=fe_owners,
         )
 
@@ -1159,6 +1182,13 @@ class PerHostStreamingRandomEffectCoordinate(StreamingRandomEffectCoordinate):
             getattr(self.manifest, "num_entities_global", 0)
             or self.manifest.num_entities
         )
+
+    def _ledger_gid(self, i: int) -> int:
+        """Convergence-ledger key = GLOBAL block id: entries stay valid
+        when an elastic re-plan moves the block to a different owner (the
+        re-base merges every host's entries and re-writes each survivor's
+        sidecar for its NEW owned set, parallel/elastic.py)."""
+        return int(self._global_ids[i])
 
     # -- elastic re-sharding hooks (parallel/elastic.py) --------------------
     def _make_state(self, dir_path: str) -> PerHostSpilledREState:

@@ -43,6 +43,7 @@ FAULT_SITES: Dict[str, str] = {
     "multihost.streaming_reduce": "exact cross-host streaming merges: score scatters, FE chunk partials, reg terms (parallel/perhost_streaming.py)",
     "io.perhost_block_write": "per-host streaming entity-block writes (parallel/perhost_streaming.py)",
     "optim.step": "coordinate-descent updates, NaN corruption (algorithm/coordinate_descent.py)",
+    "optim.block_skip": "adaptive-schedule skip decision boundary; an injected fault degrades the epoch to visit-everything, never a silent skip (algorithm/streaming_random_effect.py, algorithm/bucketed_random_effect.py)",
     "preempt.signal": "preemption polls; flags instead of raising (resilience/preemption.py)",
     "serve.dequant": "quantized-store open gate: scale-sidecar/budget validation before a bf16/int8 slab may serve (serve/model_store.py)",
     "serve.route": "fleet router request-routing entry (serve/fleet/router.py)",

@@ -59,6 +59,13 @@ class CoordinateRecord:
     # against the live plan so topology drift is a recorded re-plan, not
     # a silent blanket rebuild.
     shard_plan_version: int = 1
+    # the coordinate's convergence ledger at the end of the run
+    # (ConvergenceLedger.to_json(), optim/convergence.py): per-block
+    # gradient-norm scores and visit/skip counts. A warm delta retrain
+    # seeds the next run's adaptive schedule from it so importance
+    # ordering survives across runs, not just across epochs. Optional and
+    # never load-bearing — a missing/old record just starts cold.
+    convergence_ledger: Optional[dict] = None
 
 
 @dataclasses.dataclass

@@ -1,0 +1,714 @@
+"""Gap-guided adaptive solve scheduling (optim/convergence.py): the
+policy spellings, the convergence ledger, the streaming/bucketed skip
+paths with their bitwise pins, the `optim.block_skip` chaos degrade, and
+the persistence seams (sidecar, retrain.json, preemption resume).
+
+The contract under test: with the policy OFF (default) every path is
+bitwise-identical to the pre-adaptive coordinate; the tolerance-0
+ordering-only mode is ALSO bitwise (reordering block visits never changes
+any block's arithmetic); tolerance mode skips only with a recorded
+PlanDecision and carries skipped coefficients forward bitwise. The
+2-process ordering-only pin lives in tests/test_perhost_streaming.py
+(slow); the fleet-level re-base pin in tests/test_elastic_reshard.py."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from game_test_utils import make_glmix_data
+
+from photon_ml_tpu.algorithm.bucketed_random_effect import (
+    BucketedRandomEffectCoordinate,
+)
+from photon_ml_tpu.algorithm.streaming_random_effect import (
+    StreamingRandomEffectCoordinate,
+    write_re_entity_blocks,
+)
+from photon_ml_tpu.data.game import RandomEffectDataConfig
+from photon_ml_tpu.optim.common import OptimizerConfig
+from photon_ml_tpu.optim.convergence import (
+    LEDGER_FILENAME,
+    AdaptiveSchedule,
+    ConvergenceLedger,
+    resolve_adaptive,
+)
+from photon_ml_tpu.optim.scheduler import solve_stats
+from photon_ml_tpu.ops.regularization import RegularizationContext
+from photon_ml_tpu.resilience import faults, preemption
+from photon_ml_tpu.types import OptimizerType, TaskType
+
+RE_CFG = RandomEffectDataConfig("userId", "per_user")
+RE_OPT = OptimizerConfig(max_iterations=12, tolerance=1e-6)
+RE_REG = RegularizationContext.l2(0.3)
+# a tolerance no real gradient norm reaches from 12 LBFGS iterations on
+# this fixture: every block is a skip candidate once its streak allows
+SKIP_ALL = AdaptiveSchedule(tolerance=10.0, patience=2)
+
+
+# ---------------------------------------------------------------------------
+# the policy spellings (flag + env share resolve_adaptive)
+# ---------------------------------------------------------------------------
+
+
+class TestResolveSpec:
+    @pytest.mark.parametrize(
+        "spec", ["off", "false", "none", "0", "", "OFF", False, None]
+    )
+    def test_off_spellings(self, spec, monkeypatch):
+        monkeypatch.delenv("PHOTON_ADAPTIVE_SCHEDULE", raising=False)
+        assert resolve_adaptive(spec) is None
+
+    @pytest.mark.parametrize("spec", ["on", "true", "default", True])
+    def test_on_spellings_give_defaults(self, spec):
+        sched = resolve_adaptive(spec)
+        assert sched == AdaptiveSchedule()
+
+    def test_tolerance_and_patience_spellings(self):
+        assert resolve_adaptive("1e-4") == AdaptiveSchedule(tolerance=1e-4)
+        assert resolve_adaptive("1e-4:3") == AdaptiveSchedule(
+            tolerance=1e-4, patience=3
+        )
+        # the explicit float spelling of 0 is the ORDERING-ONLY mode (no
+        # block has a score < 0, so it never skips), NOT "off": the
+        # bitwise tests run the visitation reorder through it
+        assert resolve_adaptive("0.0:1") == AdaptiveSchedule(
+            tolerance=0.0, patience=1
+        )
+        assert resolve_adaptive(2.5e-3) == AdaptiveSchedule(tolerance=2.5e-3)
+
+    def test_env_fallback_only_when_unset(self, monkeypatch):
+        monkeypatch.setenv("PHOTON_ADAPTIVE_SCHEDULE", "1e-5:4")
+        assert resolve_adaptive(None) == AdaptiveSchedule(
+            tolerance=1e-5, patience=4
+        )
+        # an explicit spec wins over the env
+        assert resolve_adaptive("off") is None
+        monkeypatch.delenv("PHOTON_ADAPTIVE_SCHEDULE")
+        assert resolve_adaptive(None) is None
+
+    @pytest.mark.parametrize("bad", ["nope", "1e-3:x", ":2", "1:2:3"])
+    def test_bad_specs_are_loud(self, bad):
+        with pytest.raises(ValueError, match="adaptive-schedule spec"):
+            resolve_adaptive(bad)
+
+    def test_invalid_values_refused(self):
+        with pytest.raises(ValueError, match="tolerance"):
+            AdaptiveSchedule(tolerance=-1.0)
+        with pytest.raises(ValueError, match="tolerance"):
+            AdaptiveSchedule(tolerance=float("nan"))
+        with pytest.raises(ValueError, match="patience"):
+            AdaptiveSchedule(patience=0)
+
+
+# ---------------------------------------------------------------------------
+# the ledger
+# ---------------------------------------------------------------------------
+
+
+class TestConvergenceLedger:
+    def test_order_unknown_first_then_descending_score(self):
+        led = ConvergenceLedger()
+        led.observe(1, 0.5, epoch=1)
+        led.observe(2, 2.0, epoch=1)
+        led.observe(3, 0.5, epoch=1)
+        # 4 never observed -> first; ties (1 vs 3) break on ascending gid
+        assert led.order([1, 2, 3, 4]) == [4, 2, 1, 3]
+
+    def test_should_skip_needs_score_streak_and_positive_tolerance(self):
+        sched = AdaptiveSchedule(tolerance=1e-3, patience=2)
+        led = ConvergenceLedger()
+        assert not led.should_skip(0, sched)  # never observed
+        led.observe(0, 1e-4, epoch=1, under_tolerance=True)
+        assert not led.should_skip(0, sched)  # streak 1 < patience 2
+        led.observe(0, 1e-4, epoch=2, under_tolerance=True)
+        assert led.should_skip(0, sched)
+        # a skip extends the streak without a fresh score
+        led.record_skip(0, epoch=3)
+        assert led.should_skip(0, sched)
+        # one hot epoch resets the streak
+        led.observe(0, 5.0, epoch=4, under_tolerance=False)
+        assert not led.should_skip(0, sched)
+        # tolerance 0 (ordering-only) never skips, whatever the streak
+        led.observe(1, 0.0, epoch=1, under_tolerance=True)
+        led.observe(1, 0.0, epoch=2, under_tolerance=True)
+        assert not led.should_skip(1, AdaptiveSchedule(tolerance=0.0))
+
+    def test_observed_costs_are_mean_lane_iterations(self):
+        led = ConvergenceLedger()
+        led.observe(0, 0.1, executed=30, epoch=1)
+        led.observe(0, 0.1, executed=10, epoch=2)
+        led.observe(1, 0.1, executed=0, epoch=1)  # visited but free
+        led.record_skip(2, epoch=1)  # never solved
+        assert led.observed_costs() == {0: 20.0}
+
+    def test_merge_is_recency_won_and_deterministic(self):
+        a = ConvergenceLedger()
+        a.observe(0, 1.0, epoch=3, executed=5)
+        a.observe(1, 2.0, epoch=1, executed=5)
+        other = {
+            0: {"score": 9.0, "visits": 1, "skips": 0, "streak": 0,
+                "last_epoch": 1, "executed": 1},  # older -> loses
+            1: {"score": 7.0, "visits": 2, "skips": 1, "streak": 2,
+                "last_epoch": 4, "executed": 8},  # newer -> wins
+            5: {"score": 3.0, "visits": 1, "skips": 0, "streak": 1,
+                "last_epoch": 2, "executed": 4},  # new gid -> added
+        }
+        b = ConvergenceLedger()
+        b.merge(a.to_json() and {int(g): e for g, e in a.to_json().items()})
+        a.merge(other)
+        assert a.entry(0)["score"] == 1.0
+        assert a.entry(1)["score"] == 7.0
+        assert a.entry(1)["streak"] == 2
+        assert a.entry(5)["score"] == 3.0
+        # merging the same records in any grouping yields the same ledger
+        c = ConvergenceLedger()
+        c.merge(other)
+        c.merge({int(g): e for g, e in b.to_json().items()})
+        assert sorted(c.gids()) == sorted(a.gids())
+        for g in a.gids():
+            assert c.entry(g) == a.entry(g), g
+
+    def test_sidecar_round_trip_and_unreadable_degrade(self, tmp_path):
+        led = ConvergenceLedger()
+        led.observe(3, 0.25, executed=12, epoch=2, under_tolerance=True)
+        led.record_skip(7, epoch=2)
+        path = led.save(str(tmp_path))
+        assert os.path.basename(path) == LEDGER_FILENAME
+        back = ConvergenceLedger.load(str(tmp_path))
+        assert back is not None
+        assert back.to_json() == led.to_json()
+        # no sidecar / torn sidecar / wrong format -> cold start, not a crash
+        assert ConvergenceLedger.load(str(tmp_path / "nope")) is None
+        with open(tmp_path / LEDGER_FILENAME, "w") as f:
+            f.write("{torn")
+        assert ConvergenceLedger.load(str(tmp_path)) is None
+        with open(tmp_path / LEDGER_FILENAME, "w") as f:
+            json.dump({"format": 99, "blocks": {}}, f)
+        assert ConvergenceLedger.load(str(tmp_path)) is None
+
+
+# ---------------------------------------------------------------------------
+# streaming coordinate: bitwise pins, skips, persistence
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def glmix():
+    rng = np.random.default_rng(170)
+    data, _ = make_glmix_data(
+        rng, num_users=48, rows_per_user_range=(3, 10), d_fixed=4, d_random=3
+    )
+    return data
+
+
+def _manifest(glmix, path):
+    return write_re_entity_blocks(glmix, RE_CFG, str(path), block_entities=16)
+
+
+def _coord(manifest, tmp_path, tag, **kw):
+    return StreamingRandomEffectCoordinate(
+        manifest, TaskType.LOGISTIC_REGRESSION,
+        optimizer=OptimizerType.LBFGS,
+        optimizer_config=RE_OPT, regularization=RE_REG,
+        state_root=str(tmp_path / f"state-{tag}"),
+        **kw,
+    )
+
+
+def _snapshot(state):
+    # epoch spill dirs are GC'd on later updates — copy out the arrays
+    return [np.array(state.block(i)) for i in range(len(state.shapes))]
+
+
+def _run(coord, glmix, epochs):
+    resid = jnp.zeros((glmix.num_rows,), jnp.float32)
+    state = coord.initial_coefficients()
+    snaps = []
+    for _ in range(epochs):
+        state, _ = coord.update(resid, state)
+        snaps.append(_snapshot(state))
+    return state, snaps
+
+
+def _assert_states_equal(a, b):
+    for i in range(len(a.shapes)):
+        np.testing.assert_array_equal(a.block(i), b.block(i), err_msg=f"block {i}")
+
+
+class TestStreamingAdaptive:
+    def test_ordering_only_mode_is_bitwise(self, glmix, tmp_path):
+        """tolerance=0: descending-score visitation, zero skips — the
+        reorder must be invisible in every block's coefficients and in the
+        score export (per-block arithmetic is visit-order-independent)."""
+        m_off = _manifest(glmix, tmp_path / "blocks-off")
+        m_ord = _manifest(glmix, tmp_path / "blocks-ord")
+        off = _coord(m_off, tmp_path, "off")
+        order_only = _coord(
+            m_ord, tmp_path, "ord",
+            adaptive=AdaptiveSchedule(tolerance=0.0, patience=1),
+        )
+        s_off, _ = _run(off, glmix, 3)
+        s_ord, _ = _run(order_only, glmix, 3)
+        _assert_states_equal(s_off, s_ord)
+        np.testing.assert_array_equal(
+            np.asarray(off.score(s_off)), np.asarray(order_only.score(s_ord))
+        )
+        assert order_only.skip_decisions == []
+        # recording is always-on: even the OFF run wrote the sidecar
+        assert ConvergenceLedger.load(m_off.dir) is not None
+
+    def test_tolerance_mode_skips_with_recorded_decisions(self, glmix, tmp_path):
+        """patience=2 epochs under tolerance, then every later epoch skips:
+        coefficients carried forward bitwise, one PlanDecision per skip
+        (never silent), ledger + solve_stats agreeing on the counts."""
+        m = _manifest(glmix, tmp_path / "blocks")
+        coord = _coord(m, tmp_path, "tol", adaptive=SKIP_ALL)
+        n_blocks = len(m.blocks)
+        solve_stats.reset()
+        _, snaps = _run(coord, glmix, 4)
+        # epochs 1-2 visit (streak builds), epochs 3-4 skip everything
+        led = coord._ledger
+        for g in range(n_blocks):
+            e = led.entry(g)
+            assert e["visits"] == 2 and e["skips"] == 2, (g, e)
+        assert len(coord.skip_decisions) == 2 * n_blocks
+        for dec in coord.skip_decisions:
+            assert (dec.policy, dec.action) == ("adaptive", "skipped")
+            assert "carries its coefficients forward" in dec.reason
+        # skipped epochs carry coefficients forward bitwise
+        for a, b in zip(snaps[1], snaps[-1]):
+            np.testing.assert_array_equal(a, b)
+        totals = solve_stats.block_totals()
+        assert sum(b["skips"] for b in totals.values()) == 2 * n_blocks
+        assert sum(b["visits"] for b in totals.values()) == 2 * n_blocks
+
+    def test_skipped_blocks_score_like_a_fresh_coordinate(self, glmix, tmp_path):
+        """Score export after a skipping run must equal a fresh
+        always-visit coordinate's streaming pass over the same state — the
+        frozen-payload score reuse may never change the numbers."""
+        m = _manifest(glmix, tmp_path / "blocks")
+        coord = _coord(m, tmp_path, "tol", adaptive=SKIP_ALL)
+        final, _ = _run(coord, glmix, 3)
+        assert coord._adaptive_skipped  # the run really skipped
+        fresh = _coord(m, tmp_path, "fresh")
+        np.testing.assert_array_equal(
+            np.asarray(coord.score(final)), np.asarray(fresh.score(final))
+        )
+
+    def test_ledger_seed_resumes_skipping_warm(self, glmix, tmp_path):
+        """A retrain.json-seeded coordinate (no sidecar in the manifest
+        dir) starts with the prior run's streaks: blocks already
+        persistently converged skip from the FIRST epoch."""
+        m = _manifest(glmix, tmp_path / "blocks")
+        n_blocks = len(m.blocks)
+        seed = {
+            str(g): {"score": 1e-9, "visits": 3, "skips": 0, "streak": 3,
+                     "last_epoch": 3, "executed": 30}
+            for g in range(n_blocks)
+        }
+        coord = _coord(
+            m, tmp_path, "seeded",
+            adaptive=AdaptiveSchedule(tolerance=1e-3, patience=2),
+            ledger_seed=seed,
+        )
+        final, _ = _run(coord, glmix, 1)
+        assert len(coord.skip_decisions) == n_blocks
+        led = coord._ledger
+        assert all(led.entry(g)["skips"] == 1 for g in range(n_blocks))
+        # everything skipped on epoch 1 -> initial (zero) coefficients
+        for i in range(n_blocks):
+            assert not np.asarray(final.block(i)).any()
+
+    def test_same_run_sidecar_wins_over_seed(self, glmix, tmp_path):
+        """A sidecar already in the manifest dir is the SAME run's fresher
+        state — the retrain seed must not clobber it."""
+        m = _manifest(glmix, tmp_path / "blocks")
+        on_disk = ConvergenceLedger()
+        on_disk.observe(0, 42.0, epoch=9)
+        on_disk.save(m.dir)
+        coord = _coord(
+            m, tmp_path, "both", adaptive=SKIP_ALL,
+            ledger_seed={"0": {"score": 1e-9, "visits": 1, "skips": 0,
+                               "streak": 1, "last_epoch": 1, "executed": 1}},
+        )
+        assert coord._ledger.entry(0)["score"] == 42.0
+
+
+# ---------------------------------------------------------------------------
+# chaos: the optim.block_skip fault site degrades to visit-everything
+# ---------------------------------------------------------------------------
+
+
+class TestChaosDegrade:
+    def test_streaming_fault_degrades_epoch_to_visit_everything(
+        self, glmix, tmp_path
+    ):
+        m = _manifest(glmix, tmp_path / "blocks")
+        n_blocks = len(m.blocks)
+        coord = _coord(
+            m, tmp_path, "chaos",
+            adaptive=AdaptiveSchedule(tolerance=10.0, patience=1),
+        )
+        resid = jnp.zeros((glmix.num_rows,), jnp.float32)
+        state = coord.initial_coefficients()
+        state, _ = coord.update(resid, state)  # epoch 1: visits, streak 1
+        with faults.fault_scope(faults.FaultPlan(
+            [faults.FaultSpec("optim.block_skip", at=1)]
+        )):
+            state, _ = coord.update(resid, state)  # would skip; degrades
+        led = coord._ledger
+        assert all(led.entry(g)["visits"] == 2 for g in range(n_blocks))
+        assert all(led.entry(g)["skips"] == 0 for g in range(n_blocks))
+        pinned = [d for d in coord.skip_decisions if d.action == "pinned"]
+        assert len(pinned) == 1
+        assert "visit-everything" in pinned[0].reason
+        # the NEXT epoch (fault plan gone) skips normally
+        state, _ = coord.update(resid, state)
+        assert sum(led.entry(g)["skips"] for g in range(n_blocks)) == n_blocks
+
+    def test_bucketed_fault_degrades_like_streaming(self):
+        rng = np.random.default_rng(7)
+        data, _ = make_glmix_data(
+            rng, num_users=24, rows_per_user_range=(3, 30),
+            d_fixed=4, d_random=3,
+        )
+        coord = BucketedRandomEffectCoordinate(
+            data, RE_CFG, TaskType.LOGISTIC_REGRESSION,
+            OptimizerType.LBFGS, RE_OPT, RE_REG,
+            adaptive=AdaptiveSchedule(tolerance=10.0, patience=1),
+        )
+        resid = jnp.zeros((data.num_rows,), jnp.float32)
+        st, _ = coord.update(resid, coord.initial_coefficients())
+        with faults.fault_scope(faults.FaultPlan(
+            [faults.FaultSpec("optim.block_skip", at=1)]
+        )):
+            st, _ = coord.update(resid, st)
+        assert not any(
+            e["skips"] for e in map(coord._ledger.entry, coord._ledger.gids())
+        )
+        pinned = [d for d in coord.skip_decisions if d.action == "pinned"]
+        assert len(pinned) == 1
+        st, _ = coord.update(resid, st)
+        assert any(d.action == "skipped" for d in coord.skip_decisions)
+
+
+# ---------------------------------------------------------------------------
+# bucketed coordinate: bitwise pin + skip accounting
+# ---------------------------------------------------------------------------
+
+
+class TestBucketedAdaptive:
+    @pytest.fixture(scope="class")
+    def data(self):
+        rng = np.random.default_rng(11)
+        d, _ = make_glmix_data(
+            rng, num_users=24, rows_per_user_range=(3, 30),
+            d_fixed=4, d_random=3,
+        )
+        return d
+
+    def _bucketed(self, data, **kw):
+        return BucketedRandomEffectCoordinate(
+            data, RE_CFG, TaskType.LOGISTIC_REGRESSION,
+            OptimizerType.LBFGS, RE_OPT, RE_REG, **kw,
+        )
+
+    def test_ordering_only_mode_is_bitwise(self, data):
+        """The adaptive path forces the host-driven bucket loop
+        (cd_jit off); with tolerance 0 it must still produce bitwise the
+        default path's scores."""
+        resid = jnp.zeros((data.num_rows,), jnp.float32)
+        off = self._bucketed(data)
+        ordered = self._bucketed(
+            data, adaptive=AdaptiveSchedule(tolerance=0.0, patience=1)
+        )
+        s_off, _ = off.update(resid, off.initial_coefficients())
+        s_ord, _ = ordered.update(resid, ordered.initial_coefficients())
+        np.testing.assert_array_equal(
+            np.asarray(off.score(s_off)), np.asarray(ordered.score(s_ord))
+        )
+        assert ordered.skip_decisions == []
+
+    def test_tolerance_mode_skips_buckets_with_decisions(self, data):
+        coord = self._bucketed(
+            data, adaptive=AdaptiveSchedule(tolerance=10.0, patience=1)
+        )
+        resid = jnp.zeros((data.num_rows,), jnp.float32)
+        st, _ = coord.update(resid, coord.initial_coefficients())
+        score_1 = np.asarray(coord.score(st))
+        st, _ = coord.update(resid, st)  # every bucket skips
+        n_buckets = len(coord.buckets)
+        skipped = [d for d in coord.skip_decisions if d.action == "skipped"]
+        assert len(skipped) == n_buckets
+        # skipped buckets carry coefficients forward: scores unchanged
+        np.testing.assert_array_equal(np.asarray(coord.score(st)), score_1)
+
+
+# ---------------------------------------------------------------------------
+# persistence: retrain.json round trip + mid-epoch preemption resume
+# ---------------------------------------------------------------------------
+
+
+class TestPersistence:
+    def test_retrain_record_round_trips_ledger(self, glmix, tmp_path):
+        from photon_ml_tpu.retrain.manifest import (
+            CoordinateRecord,
+            RetrainManifest,
+        )
+
+        m = _manifest(glmix, tmp_path / "blocks")
+        coord = _coord(m, tmp_path, "rt", adaptive=SKIP_ALL)
+        _run(coord, glmix, 3)
+        export = coord.ledger_export()
+        assert export  # non-trivial run
+        manifest = RetrainManifest(
+            output_dir=str(tmp_path), model_dir=str(tmp_path / "model"),
+            task="LOGISTIC_REGRESSION", file_stats=[],
+            ingest_inputs={}, ingest_digest="d", updating_sequence=["re"],
+            coordinates={
+                "re": CoordinateRecord(
+                    kind="streaming_random", convergence_ledger=export
+                )
+            },
+        )
+        manifest.save(str(tmp_path))
+        back = RetrainManifest.load(str(tmp_path))
+        assert back.coordinates["re"].convergence_ledger == export
+        # ...and the round-tripped payload seeds a working ledger
+        led = ConvergenceLedger.from_json(
+            back.coordinates["re"].convergence_ledger
+        )
+        assert led.gids() == sorted(int(g) for g in export)
+
+    def test_preempted_epoch_resumes_to_identical_ledger(self, glmix, tmp_path):
+        """A mid-epoch preemption at a block boundary + resume must land
+        on the SAME ledger (and coefficients) as the uninterrupted run —
+        skips already taken are not re-counted, pending blocks record
+        once."""
+        epochs = 3
+        m_clean = _manifest(glmix, tmp_path / "blocks-clean")
+        clean = _coord(m_clean, tmp_path, "clean", adaptive=SKIP_ALL)
+        s_clean, _ = _run(clean, glmix, epochs)
+
+        m_pre = _manifest(glmix, tmp_path / "blocks-pre")
+        resid = jnp.zeros((glmix.num_rows,), jnp.float32)
+        first = _coord(m_pre, tmp_path, "pre", adaptive=SKIP_ALL)
+        state = first.initial_coefficients()
+        state, _ = first.update(resid, state)  # epoch 1 completes
+        preemption.install_plan({"block": 2})
+        try:
+            with pytest.raises(preemption.Preempted) as ei:
+                first.update(resid, state)  # epoch 2 interrupted mid-epoch
+        finally:
+            preemption.reset()
+        partial = ei.value.partial
+        assert partial["meta"]["kind"] == "streaming_re"
+        assert partial["meta"]["done_blocks"]  # genuinely mid-epoch
+
+        # a FRESH coordinate (the restarted process) resumes: it reloads
+        # the sidecar the interrupted epoch already persisted
+        resumed = _coord(m_pre, tmp_path, "resumed", adaptive=SKIP_ALL)
+        state, _ = resumed.update(resid, state, resume=partial)
+        state, _ = resumed.update(resid, state)  # epoch 3
+        _assert_states_equal(s_clean, state)
+        led_clean = ConvergenceLedger.load(m_clean.dir)
+        led_resumed = ConvergenceLedger.load(m_pre.dir)
+        assert led_clean is not None and led_resumed is not None
+        assert led_resumed.to_json() == led_clean.to_json()
+
+
+# ---------------------------------------------------------------------------
+# fleet skew rebalancing: observed costs into the shard re-plan
+# ---------------------------------------------------------------------------
+
+
+class TestObservedCostReplan:
+    def _plan(self):
+        from photon_ml_tpu.parallel.perhost_streaming import EntityShardPlan
+
+        counts = np.asarray([4] * 24, np.int64)
+        return EntityShardPlan.build(
+            counts, 2, global_dim=3, block_entities=4
+        )
+
+    def test_observed_costs_replace_static_proxy(self):
+        plan = self._plan()
+        costs = {0: 500.0, 1: 2.2}
+        new = plan.replan([0, 1], observed_costs=costs)
+        assert new.version == plan.version + 1
+        assert new.block_costs[0] == 500
+        assert new.block_costs[1] == 3  # ceil, never rounds hot->0
+        # uncovered blocks keep the static row-count proxy
+        np.testing.assert_array_equal(
+            new.block_costs[2:], plan.block_costs[2:]
+        )
+        # the hot block's owner carries fewer other blocks than it would
+        # under the static proxy (skew-aware balancing engaged)
+        static = plan.replan([0, 1])
+        hot_owner = int(new.owners[0])
+        assert (
+            int(np.sum(new.owners == hot_owner))
+            <= int(np.sum(static.owners == int(static.owners[0])))
+        )
+
+    def test_replan_with_costs_is_deterministic(self):
+        plan = self._plan()
+        costs = {3: 120.0, 5: 90.0}
+        a = plan.replan([0, 1], observed_costs=dict(costs))
+        b = plan.replan([0, 1], observed_costs=dict(reversed(costs.items())))
+        np.testing.assert_array_equal(a.owners, b.owners)
+        np.testing.assert_array_equal(a.block_costs, b.block_costs)
+
+    def test_none_costs_byte_identical_to_static_replan(self):
+        plan = self._plan()
+        a = plan.replan([0, 1])
+        b = plan.replan([0, 1], observed_costs=None)
+        np.testing.assert_array_equal(a.owners, b.owners)
+        np.testing.assert_array_equal(a.block_costs, b.block_costs)
+
+
+# ---------------------------------------------------------------------------
+# the fleet-visible summary (SolveStats.summary / fleetctl shares it)
+# ---------------------------------------------------------------------------
+
+
+class TestSolveStatsLedger:
+    def test_summary_reports_block_ledger(self):
+        solve_stats.reset()
+        try:
+            solve_stats.record_block("g0", score=0.5, executed=40)
+            solve_stats.record_block("g1", score=0.002, executed=8)
+            solve_stats.record_block("g1", skipped=True)
+            text = solve_stats.summary()
+            assert "adaptive blocks: 2 visits / 1 skips across 2 blocks" in text
+            assert "g0(score=0.5" in text  # hottest named, score first
+            totals = solve_stats.block_totals()
+            assert totals["g0"] == {
+                "visits": 1, "skips": 0, "score": 0.5, "executed": 40
+            }
+            assert totals["g1"]["skips"] == 1
+        finally:
+            solve_stats.reset()
+
+    def test_no_blocks_no_ledger_line(self):
+        solve_stats.reset()
+        assert "adaptive blocks" not in solve_stats.summary()
+
+
+# ---------------------------------------------------------------------------
+# plan fences + composition decisions
+# ---------------------------------------------------------------------------
+
+
+class TestPlanComposition:
+    def test_adaptive_fused_cycle_impossible(self):
+        from photon_ml_tpu.compile.plan import ExecutionPlan, PlanError
+
+        with pytest.raises(PlanError, match="adaptive-schedule"):
+            ExecutionPlan.resolve(
+                adaptive_schedule="1e-4", fused_cycle=True
+            )
+
+    def test_adaptive_vmapped_grid_true_impossible(self):
+        from photon_ml_tpu.compile.plan import ExecutionPlan, PlanError
+
+        with pytest.raises(PlanError, match="vmapped-grid"):
+            ExecutionPlan.resolve(
+                adaptive_schedule="1e-4", vmapped_grid="true"
+            )
+
+    def test_dense_in_memory_pins_to_always_visit(self):
+        from photon_ml_tpu.compile.plan import ExecutionPlan
+
+        plan = ExecutionPlan.resolve(adaptive_schedule="1e-4")
+        assert plan.adaptive is None
+        pinned = [
+            d for d in plan.decisions
+            if d.policy == "adaptive" and d.action == "pinned"
+        ]
+        assert len(pinned) == 1
+
+    def test_streaming_composes_with_recorded_decision(self):
+        from photon_ml_tpu.compile.plan import ExecutionPlan
+
+        plan = ExecutionPlan.resolve(
+            adaptive_schedule="1e-4:3", streaming=True
+        )
+        assert plan.adaptive == AdaptiveSchedule(tolerance=1e-4, patience=3)
+        composed = [
+            d for d in plan.decisions
+            if d.policy == "adaptive" and d.action == "composed"
+        ]
+        assert len(composed) == 1
+        assert "adaptive=adaptive(tol=0.0001, patience=3)" in plan.describe()
+
+    def test_perhost_streaming_composition_mentions_ledger(self):
+        from photon_ml_tpu.compile.plan import ExecutionPlan
+
+        plan = ExecutionPlan.resolve(
+            adaptive_schedule="on", streaming=True, distributed=True,
+            num_processes=2,
+        )
+        assert plan.adaptive is not None
+        composed = [
+            d for d in plan.decisions if d.policy == "adaptive"
+        ]
+        assert any("GLOBAL block id" in d.reason for d in composed)
+
+
+# ---------------------------------------------------------------------------
+# slow: the tolerance sweep (tier-1 sibling:
+# TestStreamingAdaptive::test_tolerance_mode_skips_with_recorded_decisions)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.slow
+def test_tolerance_sweep_trades_iterations_for_bounded_drift(glmix, tmp_path):
+    """Sweeping the tolerance from 0 upward must monotonically reduce
+    lane-iterations (more skipping) while the final coefficients stay
+    within the loosest tolerance of the always-visit run — the declared
+    contract of the tolerance knob. Tier-1 sibling:
+    TestStreamingAdaptive::test_tolerance_mode_skips_with_recorded_decisions."""
+    epochs = 5
+    runs = {}
+    for tag, adaptive in (
+        ("off", None),
+        ("t0", AdaptiveSchedule(tolerance=0.0, patience=1)),
+        ("mid", AdaptiveSchedule(tolerance=5e-3, patience=2)),
+        ("hot", AdaptiveSchedule(tolerance=10.0, patience=2)),
+    ):
+        m = _manifest(glmix, tmp_path / f"blocks-{tag}")
+        coord = _coord(m, tmp_path, tag, adaptive=adaptive)
+        solve_stats.reset()
+        final, _ = _run(coord, glmix, epochs)
+        totals = solve_stats.block_totals()
+        runs[tag] = {
+            "iters": sum(b["executed"] for b in totals.values()),
+            "skips": sum(b["skips"] for b in totals.values()),
+            "state": final,
+            "coord": coord,
+        }
+    solve_stats.reset()
+    assert runs["off"]["iters"] == runs["t0"]["iters"]  # ordering-only: free
+    assert runs["t0"]["skips"] == 0
+    # loosening the tolerance never costs iterations, and end-to-end the
+    # sweep must actually save (this fixture's blocks all park under the
+    # mid tolerance, so mid and hot may tie — monotone, not strict)
+    assert runs["mid"]["iters"] <= runs["t0"]["iters"]
+    assert runs["hot"]["iters"] <= runs["mid"]["iters"]
+    assert runs["hot"]["iters"] < runs["t0"]["iters"]
+    assert runs["mid"]["skips"] > 0
+    assert runs["hot"]["skips"] >= runs["mid"]["skips"]
+    _assert_states_equal(runs["off"]["state"], runs["t0"]["state"])
+    # skipped-run coefficients stay near the always-visit run (the skipped
+    # epochs' drift is bounded by how converged the blocks already were)
+    for tag in ("mid", "hot"):
+        for i in range(len(runs["off"]["state"].shapes)):
+            np.testing.assert_allclose(
+                runs[tag]["state"].block(i), runs["off"]["state"].block(i),
+                atol=0.05, err_msg=f"{tag} block {i}",
+            )

@@ -160,7 +160,8 @@ def _resid(data, seed=5):
 
 def _run_fleet_replan(fleet_dir, membership, manifests, proposal, *,
                       state_dirs=None, epochs=None, rebuild=None,
-                      block_cache=None, block_key_base=None, timeout=30):
+                      block_cache=None, block_key_base=None, ledgers=None,
+                      timeout=30):
     """Drive every physical host's session concurrently (the file-based
     barrier needs all records before any host finishes)."""
     phys = sorted(set(membership.binding.values()))
@@ -180,6 +181,7 @@ def _run_fleet_replan(fleet_dir, membership, manifests, proposal, *,
                 state_dir=(state_dirs or {}).get(p),
                 epoch=(epochs or {}).get(p, 0),
                 rebuild_block=(rebuild or {}).get(p),
+                ledger=(ledgers or {}).get(p),
             )
         except BaseException as e:  # noqa: BLE001 — surfaced to the test below
             errors[p] = e
@@ -345,6 +347,59 @@ class TestReplanEndToEnd:
         meta, owners, _ = load_plan_sidecars(results[0].manifest.dir)
         assert meta["hosts"] == [0, 1, 2]
         assert set(owners.tolist()) == {0, 1, 2}
+
+    def test_ledger_rides_replan_and_rebases_to_new_owners(
+        self, glmix, tmp_path
+    ):
+        """The convergence ledger rides the re-plan: each host's export
+        travels in its ack record, the merged realized costs replace the
+        static row-count proxy in the v2 plan, and every survivor's
+        re-based sidecar holds EXACTLY its new owned blocks' entries — a
+        moved block's skip streak survives the move."""
+        import math
+
+        from photon_ml_tpu.optim.convergence import (
+            LEDGER_FILENAME,
+            ConvergenceLedger,
+        )
+
+        mem = FleetMembership(1, [0, 1, 2], {0: 0, 1: 1, 2: 2})
+        manifests = _build_fleet(glmix, tmp_path, mem, tag="led")
+        ledgers, expected = {}, {}
+        for p, man in manifests.items():
+            led = ConvergenceLedger()
+            for g in man.global_block_ids:
+                led.observe(
+                    g, 0.25 + 0.5 * g, executed=7 * g + 3, epoch=4,
+                    under_tolerance=True,
+                )
+                led.record_skip(g, epoch=5)
+                expected[g] = led.entry(g)
+            ledgers[p] = led.to_json()
+        fleet = tmp_path / "led-fleet"
+        declare_lost_hosts(str(fleet), [2], reason="spot reclamation")
+        prop = _proposal_for(fleet, mem)
+        results = _run_fleet_replan(
+            fleet, mem, manifests, prop, ledgers=ledgers
+        )
+
+        total = results[0].blocks_total
+        assert sorted(expected) == list(range(total))  # every gid covered
+        # the v2 plan balanced on the OBSERVED costs: ceil(executed/visits)
+        meta, _, _ = load_plan_sidecars(results[0].manifest.dir)
+        for g in range(total):
+            e = expected[g]
+            want = max(math.ceil(e["executed"] / e["visits"]), 1)
+            assert meta["block_costs"][g] == want, g
+        for p, res in results.items():
+            man = res.manifest
+            sidecar = ConvergenceLedger.load(man.dir)
+            assert sidecar is not None, (p, LEDGER_FILENAME)
+            assert sidecar.gids() == sorted(man.global_block_ids)
+            for g in man.global_block_ids:
+                got = sidecar.entry(g)
+                assert got == expected[g], (p, g)
+                assert got["streak"] == expected[g]["streak"]  # survives
 
     def test_replan_refuses_binding_outside_cohort(self, glmix, tmp_path):
         """A scale-up typo binding an owner to a nonexistent physical

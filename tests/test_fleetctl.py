@@ -179,6 +179,100 @@ class TestStatus:
         ]
 
 
+class TestConvergenceStatus:
+    """The status surface over the adaptive-schedule convergence ledgers:
+    fleetctl reads the sidecars photon_ml_tpu/optim/convergence.py writes
+    (the same shared-contract discipline as the membership files)."""
+
+    def _write_ledger(self, directory, entries):
+        from photon_ml_tpu.optim.convergence import ConvergenceLedger
+
+        led = ConvergenceLedger()
+        for gid, (score, visits, skips) in entries.items():
+            for _ in range(visits):
+                led.observe(gid, score, executed=4)
+            for _ in range(skips):
+                led.record_skip(gid)
+        os.makedirs(directory, exist_ok=True)
+        led.save(str(directory))
+
+    def test_file_name_matches_library_writer(self, tmp_path):
+        from photon_ml_tpu.optim import convergence
+
+        assert fleetctl.LEDGER_FILE == convergence.LEDGER_FILENAME
+
+    def test_aggregates_across_hosts_max_score_summed_counts(self, tmp_path):
+        self._write_ledger(tmp_path / "h0", {0: (0.5, 2, 1), 1: (0.1, 3, 0)})
+        self._write_ledger(tmp_path / "h1", {0: (0.9, 1, 2), 2: (2.0, 1, 0)})
+        conv = fleetctl.read_convergence_ledgers(
+            [str(tmp_path / "h0"), str(tmp_path / "h1")]
+        )
+        assert conv["ledger_dirs"] == 2
+        assert conv["blocks"] == 3
+        assert conv["visits"] == 7 and conv["skips"] == 3
+        # per-block: counts sum, score takes the max across hosts
+        assert conv["hottest"][0] == {"block": "2", "score": 2.0, "visits": 1}
+        g0 = [h for h in conv["hottest"] if h["block"] == "0"][0]
+        assert g0["score"] == 0.9 and g0["visits"] == 3
+
+    def test_hottest_is_top_n_descending(self, tmp_path):
+        self._write_ledger(
+            tmp_path / "h0",
+            {g: (float(g), 1, 0) for g in range(fleetctl.LEDGER_TOP_N + 3)},
+        )
+        conv = fleetctl.read_convergence_ledgers([str(tmp_path / "h0")])
+        assert len(conv["hottest"]) == fleetctl.LEDGER_TOP_N
+        scores = [h["score"] for h in conv["hottest"]]
+        assert scores == sorted(scores, reverse=True)
+
+    def test_unreadable_sidecars_skipped_none_when_zero(self, tmp_path):
+        missing = tmp_path / "nope"
+        bad = tmp_path / "bad"
+        bad.mkdir()
+        (bad / fleetctl.LEDGER_FILE).write_text(
+            json.dumps({"format": 99, "blocks": {}})
+        )
+        torn = tmp_path / "torn"
+        torn.mkdir()
+        (torn / fleetctl.LEDGER_FILE).write_text("{torn")
+        assert fleetctl.read_convergence_ledgers(
+            [str(missing), str(bad), str(torn)]
+        ) is None
+        # one readable dir among the junk is enough for a fleet view
+        self._write_ledger(tmp_path / "ok", {0: (0.5, 1, 0)})
+        conv = fleetctl.read_convergence_ledgers(
+            [str(missing), str(bad), str(tmp_path / "ok")]
+        )
+        assert conv is not None and conv["ledger_dirs"] == 1
+
+    def test_status_carries_convergence_only_when_asked(self, tmp_path):
+        _commit(tmp_path)
+        self._write_ledger(tmp_path / "h0", {0: (0.5, 2, 1)})
+        status = fleetctl.fleet_status(str(tmp_path))
+        assert status["convergence"] is None
+        status = fleetctl.fleet_status(
+            str(tmp_path), block_dirs=[str(tmp_path / "h0")]
+        )
+        assert status["convergence"]["visits"] == 2
+        json.dumps(status)  # --json output must stay serializable
+        text = fleetctl._format_status(status)
+        assert "adaptive blocks: 2 visits / 1 skips across 1 blocks" in text
+        assert "hottest: g0(score=0.5, visits=2)" in text
+
+    def test_status_cli_block_dir_flag(self, tmp_path, capsys):
+        _commit(tmp_path)
+        self._write_ledger(tmp_path / "h0", {0: (0.5, 2, 1)})
+        self._write_ledger(tmp_path / "h1", {1: (0.7, 1, 0)})
+        assert fleetctl.main(
+            ["status", str(tmp_path), "--json",
+             "--block-dir", str(tmp_path / "h0"),
+             "--block-dir", str(tmp_path / "h1")]
+        ) == 0
+        status = json.loads(capsys.readouterr().out)
+        assert status["convergence"]["ledger_dirs"] == 2
+        assert status["convergence"]["visits"] == 3
+
+
 class TestCli:
     def test_refusal_exits_2_and_writes_nothing(self, tmp_path, capsys):
         _commit(tmp_path)

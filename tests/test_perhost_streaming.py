@@ -382,6 +382,7 @@ def _launch_workers(tmp_path, env_extra=None):
         "PHOTON_SOLVE_CHUNK": "off",
         "PHOTON_SPARSE_KERNEL": "off",
         "PHOTON_SHAPE_LADDER": "off",
+        "PHOTON_ADAPTIVE_SCHEDULE": "off",
         **(env_extra or {}),
     }
     return [
@@ -506,6 +507,36 @@ def test_two_process_all_flags_on_bitwise_vs_single_host(tmp_path):
     assert all("compaction_saved=" in o for o in outs)
     ref, ref_means = _single_host_reference(tmp_path)
     _assert_workers_match_reference(tmp_path, ref, ref_means)
+
+
+@pytest.mark.slow
+def test_two_process_adaptive_ordering_only_bitwise_vs_single_host(tmp_path):
+    """Adaptive-schedule acceptance at multihost scale: the SAME 2-process
+    harness with PHOTON_ADAPTIVE_SCHEDULE=0.0:1 (descending-gap visitation,
+    tolerance 0 so nothing ever skips) stays bitwise-equal to the flags-off
+    single-host reference — the convergence-ledger-ordered visit sequence
+    must be invisible in every coefficient, score, and objective. Tier-1
+    siblings: tests/test_adaptive_schedule.py
+    TestStreamingAdaptive::test_ordering_only_mode_is_bitwise (single-host)
+    and TestPlanComposition (the env->plan resolution)."""
+    procs = _launch_workers(
+        tmp_path, env_extra={"PHOTON_ADAPTIVE_SCHEDULE": "0.0:1"}
+    )
+    outs = []
+    for p in procs:
+        out, err = p.communicate(timeout=900)
+        assert p.returncode == 0, f"worker failed:\n{out[-3000:]}\n{err[-3000:]}"
+        outs.append(out)
+    assert all("PHSOK" in o for o in outs)
+    ref, ref_means = _single_host_reference(tmp_path)
+    _assert_workers_match_reference(tmp_path, ref, ref_means)
+    # the ordering engaged: each worker's manifest dir now carries the
+    # convergence-ledger sidecar for exactly its owned blocks
+    from photon_ml_tpu.optim.convergence import ConvergenceLedger
+
+    for pid in range(2):
+        led = ConvergenceLedger.load(str(tmp_path / f"re-host{pid}"))
+        assert led is not None and led.gids()
 
 
 @pytest.mark.slow

@@ -179,6 +179,19 @@ def test_exec_plan_module_in_scan_scope():
     assert "photon_ml_tpu/compile/__init__.py" in scanned
 
 
+def test_convergence_module_in_scan_scope():
+    """The adaptive-scheduling convergence module (optim/convergence.py)
+    is inside the default scan scope — its ledger I/O and env-resolved
+    policy are exactly the surfaces the broad-except / fault-sites rules
+    police."""
+    paths = [os.path.join(REPO, p) for p in engine.DEFAULT_SCOPE]
+    scanned = {
+        os.path.relpath(p, REPO).replace(os.sep, "/")
+        for p in engine.iter_py_files(paths)
+    }
+    assert "photon_ml_tpu/optim/convergence.py" in scanned
+
+
 # ---------------------------------------------------------------------------
 # engine: suppression-tag grammar
 # ---------------------------------------------------------------------------

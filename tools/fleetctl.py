@@ -51,6 +51,10 @@ LOST_HOSTS_FILE = "lost-hosts.json"
 SCALE_REQUEST_FILE = "scale-request.json"
 HEARTBEAT_PREFIX = "heartbeat-"
 AUDIT_LOG = "fleetctl-audit.log"
+# shared on-disk contract with photon_ml_tpu/optim/convergence.py (like
+# the heartbeat/membership formats above — fleetctl reads, never writes)
+LEDGER_FILE = "convergence-ledger.json"
+LEDGER_TOP_N = 5
 
 
 class FleetctlError(RuntimeError):
@@ -237,7 +241,58 @@ def request_scale_up(
     )
 
 
-def fleet_status(fleet_dir: str) -> dict:
+def read_convergence_ledgers(block_dirs: List[str]) -> Optional[dict]:
+    """Aggregate the adaptive-schedule convergence ledgers under the given
+    per-host streaming block dirs (``convergence-ledger.json``, written by
+    photon_ml_tpu/optim/convergence.py) into one fleet view: visit/skip
+    totals and the hottest (highest-score) blocks. Unreadable or absent
+    sidecars are skipped — the ledger is telemetry, never load-bearing."""
+    blocks: Dict[str, dict] = {}
+    scanned = 0
+    for directory in block_dirs:
+        try:
+            payload = _read_json(os.path.join(directory, LEDGER_FILE))
+        except (ValueError, OSError):
+            continue  # torn mid-write or unreadable: telemetry, skip it
+        if not isinstance(payload, dict) or payload.get("format") != 1:
+            continue
+        scanned += 1
+        for gid, entry in payload.get("blocks", {}).items():
+            if not isinstance(entry, dict):
+                continue
+            agg = blocks.setdefault(
+                str(gid), {"visits": 0, "skips": 0, "score": None}
+            )
+            agg["visits"] += int(entry.get("visits", 0) or 0)
+            agg["skips"] += int(entry.get("skips", 0) or 0)
+            score = entry.get("score")
+            if score is not None and (
+                agg["score"] is None or float(score) > agg["score"]
+            ):
+                agg["score"] = float(score)
+    if scanned == 0:
+        return None
+    hottest = sorted(
+        (
+            (gid, e) for gid, e in blocks.items() if e["score"] is not None
+        ),
+        key=lambda kv: (-kv[1]["score"], kv[0]),
+    )[:LEDGER_TOP_N]
+    return {
+        "ledger_dirs": scanned,
+        "blocks": len(blocks),
+        "visits": sum(e["visits"] for e in blocks.values()),
+        "skips": sum(e["skips"] for e in blocks.values()),
+        "hottest": [
+            {"block": gid, "score": e["score"], "visits": e["visits"]}
+            for gid, e in hottest
+        ],
+    }
+
+
+def fleet_status(
+    fleet_dir: str, block_dirs: Optional[List[str]] = None
+) -> dict:
     """One JSON-able snapshot of the fleet's coordination state."""
     _require_fleet_dir(fleet_dir)
     mem = read_membership(fleet_dir)
@@ -261,6 +316,9 @@ def fleet_status(fleet_dir: str) -> dict:
         if ".consumed-v" in name
     )
     status["consumed_requests"] = consumed
+    status["convergence"] = (
+        read_convergence_ledgers(block_dirs) if block_dirs else None
+    )
     return status
 
 
@@ -296,6 +354,20 @@ def _format_status(status: dict) -> str:
         lines.append(
             "consumed requests: " + ", ".join(status["consumed_requests"])
         )
+    conv = status.get("convergence")
+    if conv is not None:
+        line = (
+            f"adaptive blocks: {conv['visits']} visits / "
+            f"{conv['skips']} skips across {conv['blocks']} blocks "
+            f"({conv['ledger_dirs']} ledger dirs)"
+        )
+        if conv["hottest"]:
+            line += "; hottest: " + ", ".join(
+                f"g{h['block']}(score={h['score']:.3g}, "
+                f"visits={h['visits']})"
+                for h in conv["hottest"]
+            )
+        lines.append(line)
     return "\n".join(lines)
 
 
@@ -309,6 +381,11 @@ def main(argv: Optional[List[str]] = None) -> int:
     s.add_argument("fleet_dir")
     s.add_argument("--json", action="store_true",
                    help="machine-readable output")
+    s.add_argument("--block-dir", action="append", default=[],
+                   metavar="DIR", dest="block_dirs",
+                   help="per-host streaming block dir holding a "
+                        "convergence-ledger.json (repeatable); adds the "
+                        "adaptive-schedule visit/skip/hottest summary")
 
     d = sub.add_parser(
         "declare-lost-hosts",
@@ -335,7 +412,7 @@ def main(argv: Optional[List[str]] = None) -> int:
     args = parser.parse_args(argv)
     try:
         if args.cmd == "status":
-            status = fleet_status(args.fleet_dir)
+            status = fleet_status(args.fleet_dir, block_dirs=args.block_dirs)
             print(
                 json.dumps(status, indent=1, sort_keys=True)
                 if args.json else _format_status(status)
