@@ -2984,6 +2984,234 @@ def _bench_adaptive_schedule(extra, on_tpu):
         shutil.rmtree(out, ignore_errors=True)
 
 
+def _bench_plan_auto(extra, on_tpu):
+    """Cost-based plan optimizer (compile/cost.py + ExecutionPlan --plan
+    auto) against hand-tuned solve-chunk configs on TWO workload shapes —
+    skewed (a thin ill-conditioned tail next to an easy bulk) and uniform
+    (every lane converges alike). Cost is the planner's own DETERMINISTIC
+    unit — executed lane-iterations plus the chunk-pause tariff from the
+    SolveStats ledger — never wall-clock, so the auto-vs-hand-tuned gates
+    reproduce bitwise across runs. Three gates per shape: (1) the COLD
+    planner (static priors) strictly beats the worst hand-tuned arm;
+    (2) the WARM planner (re-resolved from the cost-model.json sidecar the
+    cold run persisted, with every arm's realized cost banked into the
+    model) lands within PLAN_AUTO_BOUND of the best arm; (3) across the
+    two shapes the warm rerun REVISES at least one planned decision —
+    realized costs actually changed the model's mind, the loop is closed."""
+    import shutil
+    import tempfile
+
+    import jax
+    import jax.numpy as jnp
+
+    from photon_ml_tpu.compile import ExecutionPlan
+    from photon_ml_tpu.compile.cost import (
+        CHUNK_PAUSE_COST,
+        WorkloadProfile,
+    )
+    from photon_ml_tpu.ops.regularization import RegularizationContext
+    from photon_ml_tpu.optim.common import OptimizerConfig
+    from photon_ml_tpu.optim.scheduler import (
+        SolveSchedule,
+        compacted_solve,
+        solve_stats,
+    )
+    from photon_ml_tpu.types import OptimizerType, TaskType
+
+    PLAN_AUTO_BOUND = 1.05  # declared: warm auto within 5% of best arm
+    E = 2048 if on_tpu else 512
+    M, D, hard = 32, 16, 8
+    task = TaskType.LOGISTIC_REGRESSION
+    opt = OptimizerType.LBFGS
+    cfg = OptimizerConfig(max_iterations=120, tolerance=1e-7)
+    kw = dict(task=task, optimizer=opt, optimizer_config=cfg)
+
+    def make_data(shape):
+        rng = np.random.default_rng(11)
+        x = rng.normal(size=(E, M, D)).astype(np.float32)
+        if shape == "skewed":
+            # a thin SEVERELY ill-conditioned tail (25-46 iters) next to
+            # an easy bulk clustered at 12-16 iters: the band where the
+            # chunk-size lever genuinely trades ceil-waste against the
+            # pause tariff — and where the static priors (easy=6/hard=50)
+            # misjudge the bulk, so the realized feedback has a real
+            # correction to make
+            x[:hard] *= np.geomspace(1.0, 1024.0, D).astype(np.float32)
+            reg = RegularizationContext.l2(0.7)
+        else:  # uniform: every lane identically easy, no tail to chase
+            reg = RegularizationContext.l2(1.0)
+        w_true = (rng.normal(size=(E, D)) * 0.5).astype(np.float32)
+        z = np.einsum("emd,ed->em", x.astype(np.float64), w_true)
+        with np.errstate(over="ignore"):  # huge |z|: sigmoid saturates to 0/1
+            y = (1.0 / (1.0 + np.exp(-z)) > rng.random((E, M))).astype(
+                np.float32
+            )
+        data = tuple(
+            jnp.asarray(a)
+            for a in (x, y, np.zeros((E, M), np.float32),
+                      np.ones((E, M), np.float32))
+        )
+        return data, jnp.zeros((E, D), jnp.float32), reg
+
+    # profiles describe the two shapes to the planner (signature() keys
+    # the model's memory: skewed and uniform never contaminate each other)
+    profiles = {
+        "skewed": WorkloadProfile(
+            num_lanes=E, max_rows=M * 100, median_rows=M, dim=D
+        ),
+        "uniform": WorkloadProfile(
+            num_lanes=E, max_rows=M, median_rows=M, dim=D
+        ),
+    }
+
+    def realized_of(schedule, data, w0, reg):
+        """One measured config in planner units (ledger, not wall-clock)."""
+        solve_stats.reset()
+        res = compacted_solve(
+            data, w0, schedule=schedule, label="plan-bench",
+            regularization=reg, **kw,
+        )
+        jax.block_until_ready(res.coefficients)
+        t = solve_stats.totals()
+        return (
+            float(t["executed_lane_iterations"]
+                  + CHUNK_PAUSE_COST * t["chunk_dispatches"]),
+            int(t["baseline_lane_iterations"]),
+        )
+
+    sidecar_dir = tempfile.mkdtemp(prefix="plan-auto-bench-")
+    try:
+        report = {}
+        revised = []
+        for shape in ("skewed", "uniform"):
+            data, w0, reg = make_data(shape)
+            profile = profiles[shape]
+
+            # ---- hand-tuned arms: every chunk size + the one-shot burn --
+            arms = {}
+            baseline = None
+            for c in (2, 4, 8, 16, 32):
+                cost, baseline = realized_of(
+                    SolveSchedule(chunk_size=c), data, w0, reg
+                )
+                arms[f"chunk:{c}"] = cost
+            # one-shot = the vmapped burn the ledger already accounts as
+            # baseline (every lane padded to the slowest lane's budget)
+            arms["one-shot"] = float(baseline)
+            best_arm = min(arms, key=lambda a: (arms[a], a))
+            worst_arm = max(arms, key=lambda a: (arms[a], a))
+
+            # ---- cold planner: static priors only ----------------------
+            cold = ExecutionPlan.resolve(
+                plan="auto", workload=profile, cost_model_dir=sidecar_dir,
+            )
+            cold_pick = next(
+                d.planned_choice() for d in cold.decisions
+                if d.policy == "schedule"
+            )
+            cold_cost = arms[cold_pick]
+            cold.record_realized("schedule", cold_cost)
+            # bank EVERY arm's realized cost — the hand-tuned sweep IS the
+            # capture that feeds the model (the docs/*.json story)
+            for action, cost in arms.items():
+                if action != cold_pick:
+                    cold.cost_model.observe(
+                        "schedule", action, profile, cost
+                    )
+            cold.save_cost_model(sidecar_dir)
+
+            # ---- warm planner: re-resolved from the persisted sidecar --
+            warm = ExecutionPlan.resolve(
+                plan="auto", workload=profile, cost_model_dir=sidecar_dir,
+            )
+            src = next(
+                d for d in warm.decisions if d.policy == "cost-model"
+            )
+            if "loaded" not in src.action:
+                raise AssertionError(
+                    f"warm resolve did not load the sidecar: {src.action} "
+                    f"({src.reason})"
+                )
+            warm_pick = next(
+                d.planned_choice() for d in warm.decisions
+                if d.policy == "schedule"
+            )
+            warm_cost = arms[warm_pick]
+            warm.record_realized("schedule", warm_cost)
+            warm.save_cost_model(sidecar_dir)
+            if warm_pick != cold_pick:
+                revised.append(
+                    {"shape": shape, "policy": "schedule",
+                     "cold": cold_pick, "warm": warm_pick}
+                )
+
+            # ---- the three gates ---------------------------------------
+            if cold_cost >= arms[worst_arm]:
+                raise AssertionError(
+                    f"{shape}: cold auto ({cold_pick}, {cold_cost:.0f}) "
+                    f"does not beat the worst hand-tuned arm "
+                    f"({worst_arm}, {arms[worst_arm]:.0f})"
+                )
+            if warm_cost > PLAN_AUTO_BOUND * arms[best_arm]:
+                raise AssertionError(
+                    f"{shape}: warm auto ({warm_pick}, {warm_cost:.0f}) "
+                    f"outside {PLAN_AUTO_BOUND}x of the best arm "
+                    f"({best_arm}, {arms[best_arm]:.0f})"
+                )
+            sched_dec = next(
+                d for d in warm.decisions if d.policy == "schedule"
+            )
+            if (sched_dec.predicted_cost is None
+                    or sched_dec.realized_cost is None):
+                raise AssertionError(
+                    f"{shape}: schedule decision missing predicted/"
+                    f"realized cost: {sched_dec.describe()}"
+                )
+            _log(
+                f"plan_auto[{shape}]: arms "
+                + " ".join(f"{a}={arms[a]:.0f}" for a in sorted(arms))
+            )
+            _log(
+                f"plan_auto[{shape}]: cold={cold_pick} ({cold_cost:.0f}) "
+                f"warm={warm_pick} ({warm_cost:.0f}) best={best_arm} "
+                f"worst={worst_arm}; {sched_dec.describe()}"
+            )
+            report[shape] = {
+                "arms": {a: round(arms[a], 1) for a in sorted(arms)},
+                "cold_pick": cold_pick,
+                "cold_cost": round(cold_cost, 1),
+                "warm_pick": warm_pick,
+                "warm_cost": round(warm_cost, 1),
+                "best_arm": best_arm,
+                "worst_arm": worst_arm,
+                "within_bound": round(
+                    warm_cost / max(arms[best_arm], 1e-9), 4
+                ),
+            }
+        if not revised:
+            raise AssertionError(
+                "warm rerun revised no decision on either shape — the "
+                "realized-cost feedback is not changing the model's mind"
+            )
+        _log(
+            "plan_auto: warm rerun revised "
+            + ", ".join(
+                f"{r['shape']}:{r['policy']} {r['cold']}->{r['warm']}"
+                for r in revised
+            )
+        )
+        extra["plan_auto"] = {
+            "bound": PLAN_AUTO_BOUND,
+            "cost_unit": "executed lane-iterations + "
+                         f"{CHUNK_PAUSE_COST:.0f}/chunk-dispatch pause "
+                         "tariff (deterministic, never wall-clock)",
+            "workloads": report,
+            "revised": revised,
+        }
+    finally:
+        shutil.rmtree(sidecar_dir, ignore_errors=True)
+
+
 def _bench_preempt(extra, on_tpu):
     """Preemption-safe training (resilience/preemption.py +
     checkpoint_async.py): (1) emergency-checkpoint latency — how long the
@@ -3932,6 +4160,7 @@ SECTION_ORDER = (
     "dense", "sparse", "sparse_race", "game", "game5", "grid",
     "streaming", "streaming_pipeline", "compile_reuse", "compaction",
     "adaptive_schedule",
+    "plan_auto",
     "preemption_resume",
     "perhost", "perhost_streaming", "elastic_reshard", "scoring", "serving",
     "serving_fleet",
@@ -4091,6 +4320,8 @@ def _run_sections(names, extra, errors, on_tpu, state=None, after=None):
                 _bench_compaction(extra, on_tpu)
             elif name == "adaptive_schedule":
                 _bench_adaptive_schedule(extra, on_tpu)
+            elif name == "plan_auto":
+                _bench_plan_auto(extra, on_tpu)
             elif name == "preemption_resume":
                 _bench_preempt(extra, on_tpu)
             elif name == "perhost":

@@ -942,6 +942,10 @@ class StreamingRandomEffectCoordinate:
         slab = fused_sparse.build_and_select(
             self.task, np.asarray(ds.x), ds.labels, ds.base_offsets,
             ds.weights, self._sparse_spec, f"streaming-re[block {i}]",
+            # planner-narrowed race: the predicted family validated
+            # against the dense incumbent only (--plan=auto); None = the
+            # full per-bucket family race, exactly as before
+            candidates=getattr(self.plan, "sparse_candidates", None),
         )
         if slab is not None:
             # cache HOST-resident: the streaming contract keeps device

@@ -363,6 +363,13 @@ class GameTrainingParams:
     # PlanDecision). Off = bitwise-identical visitation to today. None
     # defers to PHOTON_ADAPTIVE_SCHEDULE (default off).
     adaptive_schedule: Optional[str] = None
+    # cost-based query planner (compile/cost.py): "off" | "auto" — under
+    # auto, knobs left UNSET (ladder, solve chunk, sparse family, prefetch
+    # depth, blocking) are chosen by the cost model from workload
+    # statistics and the cost-model.json sidecar's realized-cost feedback;
+    # explicit flags/envs always win. Off = today's behavior bitwise.
+    # None defers to PHOTON_PLAN (default off).
+    plan: Optional[str] = None
     # non-"false": train the lambda grid through the traced-lambda grid API
     # (CoordinateDescent.run_grid — ONE compiled cycle serves every combo;
     # the batched G-lane vmapped variant this flag once selected lost every
@@ -476,6 +483,14 @@ class GameTrainingParams:
         except ValueError as e:
             errors.append(f"--adaptive-schedule: {e}")
             adaptive_spec = "off"
+        plan_spec = self.plan
+        try:
+            from photon_ml_tpu.compile.overrides import resolve_plan_mode
+
+            resolve_plan_mode(plan_spec)
+        except ValueError as e:
+            errors.append(str(e))
+            plan_spec = "off"
         try:
             from photon_ml_tpu.compile.plan import ExecutionPlan
 
@@ -488,6 +503,7 @@ class GameTrainingParams:
                 bucketed=self.bucketed_random_effects,
                 fused_cycle=self.fused_cycle,
                 vmapped_grid=self.vmapped_grid,
+                plan=plan_spec,
             )
         except ValueError as e:
             errors.append(str(e))
@@ -653,6 +669,16 @@ def build_training_parser() -> argparse.ArgumentParser:
            "costs into elastic re-plans; pinned to always-visit for "
            "non-streaming/bucketed coordinates, fenced with --fused-cycle "
            "and --vmapped-grid true")
+    a("--plan", default=None,
+      help="cost-based query planner: off | auto. Under auto, knobs left "
+           "unset (shape ladder, solve-chunk size, sparse family, "
+           "prefetch depth, blocking) are chosen by the cost model "
+           "(compile/cost.py) from workload statistics, corrected by the "
+           "realized-cost feedback persisted in the cost-model.json "
+           "sidecar beside retrain.json; every choice is a recorded "
+           "PlanDecision with predicted AND realized cost. Explicit flags "
+           "and env knobs always win over the planner. Default defers to "
+           "PHOTON_PLAN (off = today's behavior, bitwise)")
     a("--vmapped-grid", default="false",
       help="train the lambda grid through the shared-compile grid API (ONE "
            "compiled cycle serves every combo; lambda-only grids on plain "
@@ -734,6 +760,7 @@ def parse_training_params(argv: Optional[List[str]] = None) -> GameTrainingParam
         shape_canonicalization=ns.shape_canonicalization,
         solve_compaction=ns.solve_compaction,
         adaptive_schedule=ns.adaptive_schedule,
+        plan=ns.plan,
         vmapped_grid=(
             "auto" if str(ns.vmapped_grid).lower() == "auto"
             else "true" if _truthy(ns.vmapped_grid) else "false"

@@ -153,6 +153,10 @@ class GameTrainingDriver:
             bucketed=params.bucketed_random_effects,
             fused_cycle=params.fused_cycle,
             vmapped_grid=params.vmapped_grid,
+            plan=params.plan,
+            # warm starts inherit the prior run's realized costs; cold runs
+            # read back their own sidecar on the next invocation
+            cost_model_dir=(params.warm_start_from or params.output_dir),
         )
         self.bucketer = self.plan.bucketer
         self.solve_schedule = self.plan.schedule
@@ -1719,6 +1723,7 @@ class GameTrainingDriver:
                             result,
                             i,
                         )
+                self._record_realized_costs()
                 self._write_retrain_manifest(best_dir)
                 self._export_store(best_dir)
             elif p.warm_start_from or p.export_serve_store:
@@ -1776,6 +1781,73 @@ class GameTrainingDriver:
         self._write_retrain_manifest(best_dir, short_circuit=True)
         self._export_store(best_dir)
 
+    def _record_realized_costs(self) -> None:
+        """Close the planner loop (--plan auto): attach this run's realized
+        costs — from the same stats registries the planner predicts over —
+        to the plan's decisions, fold them into the cost model, and persist
+        the ``cost-model.json`` sidecar beside ``retrain.json`` so the next
+        run (or ``fleetctl status --plan``) starts from observed reality.
+        No-op under --plan off: the sidecar only exists when planning is on."""
+        if getattr(self.plan, "plan_mode", "off") != "auto":
+            return
+        from photon_ml_tpu.compile import compile_stats
+        from photon_ml_tpu.compile.cost import TRACE_COST
+
+        p = self.params
+        from photon_ml_tpu.optim.scheduler import solve_stats
+
+        sched_cost = solve_stats.realized_plan_cost()
+        if sched_cost is not None:
+            self.plan.record_realized("schedule", sched_cost)
+            # sharding's realized burden is the same executed-iteration
+            # ledger the lanes produced, minus the pause tariff
+            self.plan.record_realized(
+                "sharding",
+                float(solve_stats.totals()["executed_lane_iterations"]),
+            )
+        traces = compile_stats.total_traces()
+        if traces:
+            self.plan.record_realized("ladder", TRACE_COST * float(traces))
+        # blocking realized = per-block imbalance from the best combo's
+        # convergence ledgers (the quantity reblock_recommendation gates on)
+        block_costs = self._ledger_block_costs()
+        if block_costs:
+            self.plan.record_realized(
+                "blocking", max(block_costs) / max(1e-9, min(block_costs))
+            )
+        path = self.plan.save_cost_model(p.output_dir)
+        if path:
+            self.logger.info(f"plan cost model written: {path}")
+            for dec in self.plan.decisions:
+                if dec.realized_cost is not None:
+                    self.logger.info(dec.describe())
+
+    def _plan_cost_model_json(self) -> Optional[dict]:
+        """The plan's cost model for retrain.json — None under --plan off
+        (the manifest field stays absent, bitwise-identical to before)."""
+        if getattr(self.plan, "plan_mode", "off") != "auto":
+            return None
+        model = self.plan.cost_model
+        return model.to_json() if model is not None else None
+
+    def _ledger_block_costs(self) -> list:
+        """Best-combo per-block observed costs (empty when no coordinate
+        kept a convergence ledger) — the planner's blocking-drift signal."""
+        costs: list = []
+        if not self.combo_coords:
+            return costs
+        if not (0 <= self.best_index < len(self.combo_coords)):
+            return costs
+        for coord in self.combo_coords[self.best_index].values():
+            ledger = getattr(coord, "_ledger", None)
+            observed = getattr(ledger, "observed_costs", None)
+            if callable(observed):
+                try:
+                    costs.extend(float(c) for c in observed().values())
+                except Exception:  # lint: broad-except — blocking drift is advisory telemetry; a malformed ledger on one coordinate must never fail the training run
+                    continue
+        return costs
+
     def _write_retrain_manifest(self, best_dir: str,
                                 short_circuit: bool = False) -> None:
         """Leave this run's ``retrain.json`` for the next run's planner."""
@@ -1806,6 +1878,7 @@ class GameTrainingDriver:
                 coordinates=dict(prior.coordinates),
                 data_cache_key=prior.data_cache_key,
                 eval_identity=self._eval_identity(),
+                cost_model=self._plan_cost_model_json(),
             )
         else:
             combos = p.config_grid()
@@ -1857,6 +1930,7 @@ class GameTrainingDriver:
                 coordinates=coords,
                 data_cache_key=self._data_cache_key,
                 eval_identity=self._eval_identity(),
+                cost_model=self._plan_cost_model_json(),
             )
         path = manifest.save(p.output_dir)
         self.logger.info(f"retrain manifest written: {path}")

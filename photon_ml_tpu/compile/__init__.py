@@ -23,8 +23,6 @@ debugging use-after-donate reports).
 
 from __future__ import annotations
 
-import os
-
 from photon_ml_tpu.compile.canonical import (
     ShapeBucketer,
     canonicalize_re_arrays,
@@ -32,6 +30,13 @@ from photon_ml_tpu.compile.canonical import (
     pad_axis,
     pad_glm_chunk,
     resolve_bucketer,
+)
+from photon_ml_tpu.compile.cost import CostModel, WorkloadProfile
+from photon_ml_tpu.compile.overrides import (
+    DONATE_ENV as _DONATE_ENV,  # legacy alias, kept for importers
+    Overrides,
+    donation_enabled,
+    resolve_overrides,
 )
 from photon_ml_tpu.compile.plan import ExecutionPlan, PlanDecision, PlanError
 from photon_ml_tpu.compile.stats import (
@@ -41,25 +46,16 @@ from photon_ml_tpu.compile.stats import (
     instrumented_jit,
 )
 
-_DONATE_ENV = "PHOTON_DONATE"
-
-
-def donation_enabled() -> bool:
-    """Whether hot-path jit sites annotate ``donate_argnums`` (default on;
-    ``PHOTON_DONATE=0`` disables, e.g. to rule donation out while
-    debugging a deleted-buffer error)."""
-    return os.environ.get(_DONATE_ENV, "1").strip().lower() not in (
-        "0", "false", "off", "no",
-    )
-
-
 __all__ = [
     "CompileStats",
     "CompileWatermark",
+    "CostModel",
     "ExecutionPlan",
+    "Overrides",
     "PlanDecision",
     "PlanError",
     "ShapeBucketer",
+    "WorkloadProfile",
     "canonicalize_re_arrays",
     "canonicalize_re_dataset",
     "compile_stats",
@@ -68,4 +64,5 @@ __all__ = [
     "pad_axis",
     "pad_glm_chunk",
     "resolve_bucketer",
+    "resolve_overrides",
 ]

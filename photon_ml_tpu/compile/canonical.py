@@ -36,12 +36,11 @@ from __future__ import annotations
 
 import dataclasses
 import math
-import os
 from typing import Optional
 
 import numpy as np
 
-_LADDER_ENV = "PHOTON_SHAPE_LADDER"
+_LADDER_ENV = "PHOTON_SHAPE_LADDER"  # read via compile/overrides.py only
 DEFAULT_BASE = 8
 DEFAULT_GROWTH = 2.0
 
@@ -89,7 +88,11 @@ def resolve_bucketer(
     if isinstance(bucketer, ShapeBucketer):
         return bucketer
     if bucketer is None:
-        raw = os.environ.get(_LADDER_ENV)
+        # the env read lives in the single resolver (compile/overrides.py,
+        # PR 18): this module only owns the ladder GRAMMAR
+        from photon_ml_tpu.compile.overrides import ladder_spec
+
+        raw = ladder_spec()
         if raw is None:
             return None
         return resolve_bucketer(raw)

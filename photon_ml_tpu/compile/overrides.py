@@ -1,0 +1,123 @@
+"""The ONE place photon_ml_tpu reads its tuning environment.
+
+PR 18 retires the hand-tuned env knobs that had scattered across the
+tree (``PHOTON_ML_TPU_DTYPE`` in types.py, ``PHOTON_ML_TPU_SPARSE_TRANSPOSE``
+in ops/features.py, ``PHOTON_DONATE`` in compile/__init__.py,
+``PHOTON_SHAPE_LADDER`` in compile/canonical.py) into this module:
+every knob is read through :func:`env_read`, resolved once into a frozen
+:class:`Overrides` snapshot by :meth:`ExecutionPlan.resolve`, and the
+``env-reads`` photon-lint rule forbids NEW ``os.environ`` reads anywhere
+else in the package (legacy resolver sites are allowlisted with staleness
+checks, the jit-sites pattern).
+
+Why one gate: the planner (:mod:`photon_ml_tpu.compile.cost`) can only
+audit a decision it can SEE. A knob read ad-hoc deep in an op is
+invisible to the plan's decision trail; a knob resolved here lands in
+``ExecutionPlan.overrides`` next to the planner's own choices.
+
+stdlib-only on purpose (no jax, no photon_ml_tpu imports): fleetctl and
+the lint engine stay importable on a device-free host.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Optional
+
+__all__ = [
+    "DONATE_ENV",
+    "DTYPE_ENV",
+    "LADDER_ENV",
+    "PLAN_ENV",
+    "SPARSE_TRANSPOSE_ENV",
+    "Overrides",
+    "donation_enabled",
+    "dtype_name",
+    "env_read",
+    "ladder_spec",
+    "resolve_overrides",
+    "resolve_plan_mode",
+    "sparse_transpose_forced",
+]
+
+PLAN_ENV = "PHOTON_PLAN"
+DTYPE_ENV = "PHOTON_ML_TPU_DTYPE"
+SPARSE_TRANSPOSE_ENV = "PHOTON_ML_TPU_SPARSE_TRANSPOSE"
+DONATE_ENV = "PHOTON_DONATE"
+LADDER_ENV = "PHOTON_SHAPE_LADDER"
+
+_FALSEY = ("0", "false", "off", "no")
+
+
+def env_read(name: str, default: Optional[str] = None) -> Optional[str]:
+    """THE environment gate: every photon_ml_tpu knob read funnels through
+    here (or through an allowlisted legacy resolver) so the env-reads lint
+    rule can hold the line at one module."""
+    return os.environ.get(name, default)
+
+
+def resolve_plan_mode(spec: Optional[str] = None) -> str:
+    """Effective planner mode: explicit value wins; ``None`` falls back to
+    ``PHOTON_PLAN``. Returns ``"off"`` (today's behavior, bitwise) or
+    ``"auto"`` (cost-model-driven choices for unset knobs)."""
+    if spec is None:
+        spec = env_read(PLAN_ENV)
+    if spec is None:
+        return "off"
+    text = str(spec).strip().lower()
+    if text in ("", *_FALSEY, "none"):
+        return "off"
+    if text in ("on", "auto", "1", "true"):
+        return "auto"
+    raise ValueError(f"bad --plan / {PLAN_ENV} spec {spec!r} (want off | auto)")
+
+
+def dtype_name() -> str:
+    """The ONE precision knob's raw value (validated in types.real_dtype)."""
+    return env_read(DTYPE_ENV, "float32")
+
+
+def sparse_transpose_forced() -> bool:
+    """Whether ``PHOTON_ML_TPU_SPARSE_TRANSPOSE=1`` forces the CSC view
+    back on (ops/features.py keeps the measured scatter default)."""
+    return env_read(SPARSE_TRANSPOSE_ENV) == "1"
+
+
+def donation_enabled() -> bool:
+    """Whether hot-path jit sites annotate ``donate_argnums`` (default on;
+    ``PHOTON_DONATE=0`` disables, e.g. to rule donation out while
+    debugging a deleted-buffer error)."""
+    raw = env_read(DONATE_ENV, "1")
+    return str(raw).strip().lower() not in _FALSEY
+
+
+def ladder_spec() -> Optional[str]:
+    """Raw ``PHOTON_SHAPE_LADDER`` value (grammar parsed by
+    canonical.resolve_bucketer, which owns the ladder vocabulary)."""
+    return env_read(LADDER_ENV)
+
+
+@dataclasses.dataclass(frozen=True)
+class Overrides:
+    """The env knobs as resolved ONCE by :meth:`ExecutionPlan.resolve` —
+    the audit-visible snapshot the plan carries next to its decisions.
+
+    Consumers that run before/without a plan (scoring helpers, op-level
+    kernels) keep calling the module functions above; both paths read the
+    same single gate, so the values can never disagree mid-run."""
+
+    plan_mode: str = "off"
+    dtype: str = "float32"
+    sparse_transpose: bool = False
+    donate: bool = True
+
+
+def resolve_overrides(plan: Optional[str] = None) -> Overrides:
+    """Read every retired knob exactly once into a frozen snapshot."""
+    return Overrides(
+        plan_mode=resolve_plan_mode(plan),
+        dtype=dtype_name(),
+        sparse_transpose=sparse_transpose_forced(),
+        donate=donation_enabled(),
+    )

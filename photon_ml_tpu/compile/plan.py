@@ -46,9 +46,11 @@ algorithms.
 from __future__ import annotations
 
 import dataclasses
-from typing import Optional, Tuple
+from typing import Dict, Optional, Tuple
 
 from photon_ml_tpu.compile.canonical import ShapeBucketer, resolve_bucketer
+from photon_ml_tpu.compile.cost import CostModel, WorkloadProfile
+from photon_ml_tpu.compile.overrides import Overrides, env_read, resolve_overrides
 
 __all__ = ["ExecutionPlan", "PlanDecision", "PlanError"]
 
@@ -61,14 +63,35 @@ class PlanError(ValueError):
 @dataclasses.dataclass(frozen=True)
 class PlanDecision:
     """One recorded policy adjustment made during resolution — the audit
-    trail that replaces silent per-class drops (drivers log these)."""
+    trail that replaces silent per-class drops (drivers log these).
+
+    Planner-made choices (``--plan=auto``) additionally carry the model's
+    ``predicted_cost`` at decision time and, once the run executed, the
+    ``realized_cost`` fed back through :meth:`ExecutionPlan.record_realized`
+    — so predicted-vs-realized drift is auditable per decision, not just
+    in aggregate."""
 
     policy: str  # which policy was adjusted ("schedule", "sparse", ...)
-    action: str  # "subsumed" | "pinned" | "composed"
+    action: str  # "subsumed" | "pinned" | "composed" | "planned:<choice>"
     reason: str
+    predicted_cost: Optional[float] = None
+    realized_cost: Optional[float] = None
 
     def describe(self) -> str:
-        return f"{self.policy} {self.action}: {self.reason}"
+        text = f"{self.policy} {self.action}: {self.reason}"
+        if self.predicted_cost is not None:
+            text += f" [predicted={self.predicted_cost:.0f}"
+            if self.realized_cost is not None:
+                text += f" realized={self.realized_cost:.0f}"
+            text += "]"
+        return text
+
+    def planned_choice(self) -> Optional[str]:
+        """The planner's chosen action value ("chunk:8", "on", ...) when
+        this is a ``planned:`` decision, else None."""
+        if self.action.startswith("planned:"):
+            return self.action.split(":", 1)[1]
+        return None
 
 
 @dataclasses.dataclass(frozen=True)
@@ -102,6 +125,18 @@ class ExecutionPlan:
     # re-plan returns a successor via record_replan, so the audit trail
     # names each membership change next to the policy decisions
     shard_plan_version: int = 1
+    # "off" = every knob is the flag/env the human set (today's behavior,
+    # bitwise); "auto" = unset knobs were chosen by the cost model
+    plan_mode: str = "off"
+    # the retired env knobs, resolved ONCE here (compile/overrides.py)
+    overrides: Optional[Overrides] = None
+    # the cost model that made (and keeps learning from) this plan's
+    # planned decisions; None under plan_mode="off"
+    cost_model: Optional[CostModel] = None
+    workload: Optional[WorkloadProfile] = None
+    # planner-narrowed sparse race: predicted family + the dense incumbent
+    # (the cheap validation replacing the full per-bucket family race)
+    sparse_candidates: Optional[Tuple[str, ...]] = None
     decisions: Tuple[PlanDecision, ...] = ()
 
     @classmethod
@@ -119,16 +154,34 @@ class ExecutionPlan:
         sparse_kernel: Optional[str] = None,
         prefetch_depth: Optional[int] = None,
         num_processes: int = 1,
+        plan: Optional[str] = None,
+        workload: Optional[WorkloadProfile] = None,
+        cost_model_dir: Optional[str] = None,
+        block_costs: Optional[Dict[int, float]] = None,
     ) -> "ExecutionPlan":
         """Resolve every policy once (env fallbacks included:
         ``PHOTON_SHAPE_LADDER`` / ``PHOTON_SOLVE_CHUNK`` /
         ``PHOTON_SPARSE_KERNEL``), apply the composition rules, and
         return the plan. Raises :class:`PlanError` only for the pairs
-        that are impossible by construction."""
+        that are impossible by construction.
+
+        Under ``plan="auto"`` (``PHOTON_PLAN``), knobs the caller left
+        UNSET are chosen by the cost model (:mod:`photon_ml_tpu.compile.
+        cost`) from ``workload`` statistics and the ``cost-model.json``
+        sidecar in ``cost_model_dir`` — explicit flags/envs always win
+        over the planner, and ``plan="off"`` (the default) is bitwise
+        today's behavior."""
         from photon_ml_tpu.ops.fused_sparse import resolve_sparse_kernel
         from photon_ml_tpu.optim.convergence import resolve_adaptive
         from photon_ml_tpu.optim.scheduler import resolve_schedule
 
+        overrides = resolve_overrides(plan)
+        # an explicit prefetch depth (arg or env) must win over the
+        # planner — probe BEFORE resolve_depth folds in its default
+        prefetch_explicit = (
+            prefetch_depth is not None
+            or env_read("PHOTON_PREFETCH_DEPTH") is not None
+        )
         bucketer = resolve_bucketer(shape_canonicalization)
         schedule = resolve_schedule(solve_compaction)
         adaptive = resolve_adaptive(adaptive_schedule)
@@ -139,6 +192,32 @@ class ExecutionPlan:
 
         prefetch_depth = resolve_depth(prefetch_depth)
         decisions = []
+
+        # ---- the planner pass (plan_mode="auto" only) ---------------------
+        cost_model: Optional[CostModel] = None
+        sparse_candidates: Optional[Tuple[str, ...]] = None
+        if overrides.plan_mode == "auto":
+            profile = workload or WorkloadProfile()
+            cost_model, loaded_decision = cls._load_cost_model(cost_model_dir)
+            decisions.append(loaded_decision)
+            (schedule, bucketer, sparse, sparse_candidates,
+             prefetch_depth) = cls._plan_choices(
+                cost_model, profile, decisions,
+                schedule=schedule, bucketer=bucketer, sparse=sparse,
+                prefetch_depth=prefetch_depth,
+                prefetch_explicit=prefetch_explicit,
+                fused_cycle=fused_cycle, vmapped_grid=vmapped_grid,
+                resolve_schedule=resolve_schedule,
+            )
+            # the blocking-drift call: realized per-block costs decide when
+            # re-blocking beats another pinned day — always recorded
+            action, predicted, reason = cost_model.reblock_recommendation(
+                block_costs
+            )
+            decisions.append(PlanDecision(
+                "blocking", f"planned:{action}", reason,
+                predicted_cost=predicted,
+            ))
 
         # ---- impossible pairs (the fences the plan KEEPS) -----------------
         if fused_cycle and schedule is not None:
@@ -248,6 +327,20 @@ class ExecutionPlan:
         if schedule is not None and bucketer is not None:
             schedule = dataclasses.replace(schedule, bucketer=bucketer)
 
+        if cost_model is not None:
+            # sharding follows the real process topology (the planner
+            # cannot conjure hosts) — but the predicted cost is recorded
+            # so realized solve cost audits whether the topology paid off
+            decisions.append(PlanDecision(
+                "sharding", f"planned:{sharding}",
+                f"topology {sharding} from --distributed/--streaming at "
+                f"num_processes={num_processes}; predicted cost recorded "
+                "for the realized-cost audit",
+                predicted_cost=cost_model.predict(
+                    "sharding", sharding, workload or WorkloadProfile()
+                ),
+            ))
+
         return cls(
             bucketer=bucketer,
             schedule=schedule,
@@ -258,8 +351,166 @@ class ExecutionPlan:
             streaming=streaming,
             fused_cycle=fused_cycle,
             num_processes=max(int(num_processes), 1),
+            plan_mode=overrides.plan_mode,
+            overrides=overrides,
+            cost_model=cost_model,
+            workload=workload,
+            sparse_candidates=sparse_candidates,
             decisions=tuple(decisions),
         )
+
+    # ------------------------------------------------------------------
+    # the planner pass internals
+    # ------------------------------------------------------------------
+
+    @staticmethod
+    def _load_cost_model(
+        cost_model_dir: Optional[str],
+    ) -> Tuple[CostModel, PlanDecision]:
+        """The sidecar model when readable; static priors — LOUDLY, as a
+        recorded decision — when the sidecar is torn, missing, or no
+        location was given. The sidecar is never load-bearing."""
+        if cost_model_dir is None:
+            return CostModel(), PlanDecision(
+                "cost-model", "priors",
+                "no cost-model sidecar location — planning from static "
+                "priors (first run, or caller opted out of feedback)",
+            )
+        model = CostModel.load(cost_model_dir)
+        if model is None:
+            return CostModel(), PlanDecision(
+                "cost-model", "degraded",
+                f"cost-model.json at {cost_model_dir} is missing or torn — "
+                "degrading to static priors (predictions lose this fleet's "
+                "realized history until the next run re-banks it)",
+            )
+        n = sum(int(o.get("n", 0)) for o in model.observations.values())
+        return model, PlanDecision(
+            "cost-model", "loaded",
+            f"realized-cost model from {model.source} "
+            f"({len(model.observations)} keys, {n} observations)",
+        )
+
+    @classmethod
+    def _plan_choices(
+        cls, model: CostModel, profile: WorkloadProfile, decisions: list,
+        *, schedule, bucketer, sparse, prefetch_depth, prefetch_explicit,
+        fused_cycle, vmapped_grid, resolve_schedule,
+    ):
+        """Choose every knob the caller left unset; explicit settings are
+        never overridden (the planner fills gaps, it does not argue)."""
+        from photon_ml_tpu.io.pipeline import DEFAULT_DEPTH
+
+        # solve-chunk size: the biggest measured lever (PR 4's 71% and the
+        # compaction bench both live here). Respect the fused-cycle /
+        # vmapped-grid fences — the planner must not resolve into a
+        # PlanError the explicit path would have refused.
+        chunk_allowed = not fused_cycle and vmapped_grid != "true"
+        if schedule is None and chunk_allowed:
+            action, predicted, reason = model.choose(
+                "schedule",
+                ("one-shot", "chunk:2", "chunk:4", "chunk:8", "chunk:16",
+                 "chunk:32"),
+                profile,
+            )
+            if action.startswith("chunk:"):
+                schedule = resolve_schedule(action.split(":", 1)[1])
+            decisions.append(PlanDecision(
+                "schedule", f"planned:{action}", reason,
+                predicted_cost=predicted,
+            ))
+        elif schedule is not None:
+            decisions.append(PlanDecision(
+                "schedule", "pinned",
+                f"--solve-compaction={schedule.chunk_size} set explicitly "
+                "— the planner defers to the hand-tuned value",
+                predicted_cost=model.predict(
+                    "schedule", f"chunk:{schedule.chunk_size}", profile
+                ),
+            ))
+
+        # shape ladder
+        if bucketer is None:
+            action, predicted, reason = model.choose(
+                "ladder", ("off", "on"), profile
+            )
+            if action == "on":
+                bucketer = resolve_bucketer("on")
+            decisions.append(PlanDecision(
+                "ladder", f"planned:{action}", reason,
+                predicted_cost=predicted,
+            ))
+
+        # sparse family: predicted pick + cheap validation replaces the
+        # full per-bucket race — the coordinate races ONLY the predicted
+        # family against the dense incumbent (sparse_candidates)
+        sparse_candidates = None
+        if sparse is None and profile.density < 1.0 and profile.density > 0.0:
+            action, predicted, reason = model.choose(
+                "sparse", ("dense", "segment", "scatter", "flat"), profile
+            )
+            if action != "dense":
+                sparse = "auto"
+                sparse_candidates = (action,)
+                reason += (
+                    " — validated per bucket against the dense incumbent "
+                    "only (race narrowed from every family to the "
+                    "predicted one)"
+                )
+            decisions.append(PlanDecision(
+                "sparse", f"planned:{action}", reason,
+                predicted_cost=predicted,
+            ))
+
+        # prefetch depth
+        if not prefetch_explicit:
+            action, predicted, reason = model.choose(
+                "prefetch", (str(DEFAULT_DEPTH), "0", "4"), profile
+            )
+            prefetch_depth = int(action)
+            decisions.append(PlanDecision(
+                "prefetch", f"planned:{action}", reason,
+                predicted_cost=predicted,
+            ))
+
+        return schedule, bucketer, sparse, sparse_candidates, prefetch_depth
+
+    # ------------------------------------------------------------------
+    # realized-cost feedback (the loop-closing half of the planner)
+    # ------------------------------------------------------------------
+
+    def record_realized(self, policy: str, realized: float) -> None:
+        """Attach the realized cost to this plan's ``planned:`` decision
+        for ``policy`` and fold it into the cost model's EMA — the next
+        run's predictions come from what THIS run actually paid. No-op
+        under plan_mode="off" (nothing was planned, nothing to correct)."""
+        if self.plan_mode != "auto" or self.cost_model is None:
+            return
+        profile = self.workload or WorkloadProfile()
+        updated = []
+        hit = False
+        for d in self.decisions:
+            choice = d.planned_choice()
+            if not hit and d.policy == policy and choice is not None:
+                updated.append(dataclasses.replace(d, realized_cost=float(realized)))
+                self.cost_model.observe(
+                    policy, choice, profile, float(realized),
+                    predicted=d.predicted_cost,
+                )
+                hit = True
+            else:
+                updated.append(d)
+        if hit:
+            # decisions is part of a frozen dataclass: swap the tuple via
+            # object.__setattr__ (same object identity, audited mutation)
+            object.__setattr__(self, "decisions", tuple(updated))
+
+    def save_cost_model(self, directory: str) -> Optional[str]:
+        """Persist the fed-back model beside the manifest (atomic); None
+        under plan_mode="off"."""
+        if self.cost_model is None:
+            return None
+        return self.cost_model.save(directory)
 
     # ------------------------------------------------------------------
     def record_replan(self, new_version: int, reason: str) -> "ExecutionPlan":
@@ -298,6 +549,11 @@ class ExecutionPlan:
             f"sparse={self.sparse_kernel or 'off'}",
             f"streaming={'on' if self.streaming else 'off'}",
         ]
+        if self.plan_mode != "off":
+            parts.append(
+                f"plan={self.plan_mode}"
+                + (f"[{self.cost_model.source}]" if self.cost_model else "")
+            )
         return "execution plan: " + " ".join(parts)
 
     def describe_decisions(self) -> Tuple[str, ...]:

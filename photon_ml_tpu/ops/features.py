@@ -260,10 +260,10 @@ SPARSE_TRANSPOSE_MIN_DIM = 1 << 16
 
 def auto_transpose(feats: SparseFeatures) -> SparseFeatures:
     """Apply the production transpose-layout rule (see comment above)."""
-    import os
+    from photon_ml_tpu.compile.overrides import sparse_transpose_forced
 
     if feats.t_idx is not None or feats.dim < SPARSE_TRANSPOSE_MIN_DIM:
         return feats
-    if os.environ.get("PHOTON_ML_TPU_SPARSE_TRANSPOSE") == "1":
+    if sparse_transpose_forced():
         return feats.with_transpose()
     return feats

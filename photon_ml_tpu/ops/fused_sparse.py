@@ -794,11 +794,17 @@ def select_sparse_kernel(
     weights: Array,
     spec: Optional[str] = None,
     label: str = "re",
+    candidates: Optional[Tuple[str, ...]] = None,
 ) -> Optional[str]:
     """Per-bucket family selection. ``spec`` (or PHOTON_SPARSE_KERNEL):
     ``None``/off -> dense path stays; a family name -> forced; ``auto`` ->
     race on this bucket's tensors, cached per (task, shape, platform).
-    Returns the family to use, or ``None`` for the dense path."""
+    Returns the family to use, or ``None`` for the dense path.
+
+    ``candidates`` narrows the race to the named families (plus the dense
+    incumbent): the cost-based planner's "predicted pick + cheap
+    validation" — one predicted family validated against dense instead of
+    every family timed per bucket (``ExecutionPlan.sparse_candidates``)."""
     resolved = resolve_sparse_kernel(spec)
     if resolved is None:
         return None
@@ -809,14 +815,19 @@ def select_sparse_kernel(
     e, m, k = slab.idx.shape
     platform = jax.devices()[0].platform
     # dtype is part of the key: eligibility differs (pallas is out under
-    # f64), so an f32 bucket's winner must not be reused for an f64 slab
+    # f64), so an f32 bucket's winner must not be reused for an f64 slab;
+    # a planner-narrowed race must not poison the full-race cache either
     key = (
         losses_mod.for_task(task).name, e, m, k, slab.dim,
         jnp.dtype(slab.val.dtype).name, platform,
+        tuple(candidates) if candidates else None,
     )
     if key in _race_cache:
         return _race_cache[key]
-    report = race_sparse_kernels(task, slab, x_dense, labels, offsets, weights)
+    report = race_sparse_kernels(
+        task, slab, x_dense, labels, offsets, weights,
+        candidates=tuple(candidates) if candidates else None,
+    )
     _race_reports[(label,) + key] = report
     _race_cache[key] = report["winner"]
     return report["winner"]
@@ -836,16 +847,19 @@ def build_and_select(
     spec: str,
     label: str,
     bucketer=None,
+    candidates: Optional[Tuple[str, ...]] = None,
 ) -> Optional[SparseSlab]:
     """Host-side slab build + family selection for ONE bucket/block — the
     shared sequence behind every coordinate's sparse wiring. ``spec`` is an
-    already-resolved spec (``"auto"`` races on this bucket's own tensors;
-    a family name is forced). Returns the slab carrying the selected
+    already-resolved spec (``"auto"`` races on this bucket's own tensors,
+    optionally narrowed to the planner's predicted ``candidates``; a
+    family name is forced). Returns the slab carrying the selected
     family, or ``None`` when the dense path keeps the bucket."""
     slab = build_sparse_slab(x, bucketer=bucketer)
     if spec == "auto":
         family = select_sparse_kernel(
-            task, slab, x, labels, offsets, weights, spec="auto", label=label
+            task, slab, x, labels, offsets, weights, spec="auto",
+            label=label, candidates=candidates,
         )
     else:
         family = spec

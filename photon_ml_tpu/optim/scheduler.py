@@ -193,7 +193,7 @@ class SolveStats:
     def __init__(self):
         self._lock = threading.Lock()
         self._counters = dict.fromkeys(
-            ("solves", "lanes", "executed", "baseline",
+            ("solves", "lanes", "executed", "baseline", "chunks",
              "blocks_visited", "blocks_skipped"), 0
         )
         self._worst: Optional[SolveRecord] = None
@@ -206,6 +206,7 @@ class SolveStats:
             self._counters["lanes"] += rec.lanes
             self._counters["executed"] += rec.executed
             self._counters["baseline"] += rec.baseline
+            self._counters["chunks"] += len(rec.chunks)
             if self._worst is None or rec.baseline > self._worst.baseline:
                 self._worst = rec
             self._recent.append(rec)
@@ -259,7 +260,24 @@ class SolveStats:
                 "saved_lane_iterations": (
                     self._counters["baseline"] - self._counters["executed"]
                 ),
+                "chunk_dispatches": self._counters["chunks"],
             }
+
+    def realized_plan_cost(self) -> Optional[float]:
+        """This run's solve ledger in planner cost units (compile/cost.py):
+        executed lane-iterations plus the host-pause tariff per chunk
+        dispatch — the realized cost :meth:`ExecutionPlan.record_realized`
+        feeds back into the cost model's schedule predictions. None when
+        no solves ran (nothing to learn from)."""
+        from photon_ml_tpu.compile.cost import CHUNK_PAUSE_COST
+
+        with self._lock:
+            if not self._counters["solves"]:
+                return None
+            return float(
+                self._counters["executed"]
+                + CHUNK_PAUSE_COST * self._counters["chunks"]
+            )
 
     def summary(self) -> str:
         """Driver-log summary: the ledger plus per-chunk active-lane decay

@@ -835,6 +835,17 @@ class ElasticSession:
                 merged_ledger.observed_costs() if merged_ledger else None
             ),
         )
+        blocking_verdict = None
+        if merged_ledger is not None:
+            # the planner's blocking-drift signal (compile/cost.py): when
+            # realized per-block costs are imbalanced past the reblock
+            # threshold, owner re-balancing alone can't fix it — surface
+            # the verdict so fleetctl/--plan auto can schedule a re-block
+            from photon_ml_tpu.compile.cost import CostModel
+
+            blocking_verdict = CostModel().reblock_recommendation(
+                merged_ledger.observed_costs()
+            )
         moved = old_plan.moved_blocks(new_plan, old_mem, new_mem)
         old_phys = old_mem.physical_owners(old_plan.owners)
         new_phys = new_mem.physical_owners(new_plan.owners)
@@ -1005,6 +1016,12 @@ class ElasticSession:
             f"{len(incoming)} onto process {self.process_id} "
             f"({len(rebuilt)} cold-rebuilt), hosts {new_mem.hosts}"
         ))
+        if blocking_verdict is not None:
+            action, imbalance, why = blocking_verdict
+            decisions.append(
+                f"blocking: {action} (realized imbalance {imbalance:.2f}) "
+                f"— {why}"
+            )
         for d in decisions:
             logger.info("elastic re-shard: %s", d)
         return ReshardResult(

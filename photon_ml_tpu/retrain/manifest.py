@@ -94,12 +94,20 @@ class RetrainManifest:
     # even when training has nothing to do (coordinate freezing still
     # applies, so the re-score run skips every solve)
     eval_identity: Dict[str, object] = dataclasses.field(default_factory=dict)
+    # --plan auto: the run's cost model (compile/cost.py to_json) rides
+    # along so warm starts plan from realized costs; None when planning
+    # was off or the run recorded nothing (priors stay in force)
+    cost_model: Optional[dict] = None
     format: int = MANIFEST_FORMAT
 
     # ------------------------------------------------------------------
     def save(self, directory: str) -> str:
         path = os.path.join(directory, RETRAIN_MANIFEST)
         payload = dataclasses.asdict(self)
+        if payload.get("cost_model") is None:
+            # --plan off leaves the manifest bytes exactly as before the
+            # planner existed (the off mode's bitwise-identity guarantee)
+            payload.pop("cost_model", None)
         with open(path + ".tmp", "w") as f:
             json.dump(payload, f, indent=1, sort_keys=True)
         os.replace(path + ".tmp", path)

@@ -83,11 +83,13 @@ def real_dtype():
     rather than silently computing in f32; anything other than
     float32/float64 is rejected loudly.
     """
-    import os
-
     import numpy as np
 
-    name = os.environ.get("PHOTON_ML_TPU_DTYPE", "float32")
+    # the ONE env gate (compile/overrides.py, PR 18): this function owns
+    # validation + the x64 flip, the resolver owns the read
+    from photon_ml_tpu.compile.overrides import dtype_name
+
+    name = dtype_name()
     if name not in ("float32", "float64"):
         raise ValueError(
             f"PHOTON_ML_TPU_DTYPE={name!r}: only float32/float64 are supported"

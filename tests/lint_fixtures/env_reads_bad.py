@@ -1,0 +1,32 @@
+"""Seeded env-reads violations (PR 18): tuning reads outside the single
+resolver (photon_ml_tpu.compile.overrides) in every spelling the rule
+must catch."""
+
+import os
+from os import environ
+
+
+def scattered_get():
+    return os.environ.get("PHOTON_SOME_KNOB")
+
+
+def scattered_subscript():
+    return os.environ["PHOTON_OTHER_KNOB"]
+
+
+def scattered_getenv():
+    return os.getenv("PHOTON_THIRD_KNOB", "1")
+
+
+def bare_environ_get():
+    return environ.get("PHOTON_FOURTH_KNOB")
+
+
+def bare_environ_subscript():
+    return environ["PHOTON_FIFTH_KNOB"]
+
+
+def read_at_default():  # default args evaluate at import: still a read
+    def inner(depth=os.environ.get("PHOTON_DEPTH")):
+        return depth
+    return inner

@@ -1,0 +1,21 @@
+"""Clean env patterns the env-reads rule must NOT flag: writes/pops (a
+bench pinning a child environment), reads through the single resolver,
+and a justified harness-knob suppression."""
+
+import os
+
+
+def pin_child_env():
+    os.environ["PHOTON_SOLVE_CHUNK"] = "off"
+    os.environ.pop("PHOTON_SPARSE_KERNEL", None)
+    del os.environ["PHOTON_SHAPE_LADDER"]
+
+
+def resolver_read():
+    from photon_ml_tpu.compile.overrides import env_read
+
+    return env_read("PHOTON_PLAN")
+
+
+def justified_harness_read():
+    return os.environ.get("PHOTON_TEST_ONLY")  # lint: env-reads — fixture: a genuine harness knob

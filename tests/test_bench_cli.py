@@ -24,6 +24,7 @@ def test_list_sections_enumerates_all_sections():
         "dense", "sparse", "sparse_race", "game", "game5", "grid",
         "streaming", "streaming_pipeline", "compile_reuse", "compaction",
         "adaptive_schedule",
+        "plan_auto",
         "preemption_resume",
         "perhost", "perhost_streaming", "elastic_reshard", "scoring",
         "serving",

@@ -114,3 +114,96 @@ def test_cli_test_expected_list_matches_section_order():
         "test_bench_cli's expected section list drifted from bench.py "
         f"SECTION_ORDER:\n  bench: {order}\n  test:  {expected}"
     )
+
+
+# ---------------------------------------------------------------------------
+# plan_auto lockstep: the cost-planner section, its banked capture, and
+# compile/cost.py's constants must agree (same pure-AST/JSON contract —
+# no bench or jax import)
+# ---------------------------------------------------------------------------
+
+import json
+
+COST = os.path.join(
+    os.path.dirname(__file__), os.pardir,
+    "photon_ml_tpu", "compile", "cost.py",
+)
+CAPTURE = os.path.join(
+    os.path.dirname(__file__), os.pardir, "docs", "PLAN_AUTO_r18.json"
+)
+
+
+def _plan_auto_fn(tree):
+    fn = next(
+        (n for n in tree.body
+         if isinstance(n, ast.FunctionDef) and n.name == "_bench_plan_auto"),
+        None,
+    )
+    assert fn is not None, "bench.py lost _bench_plan_auto"
+    return fn
+
+
+def _fn_const(fn, name):
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Assign) and any(
+            isinstance(t, ast.Name) and t.id == name for t in node.targets
+        ):
+            return ast.literal_eval(node.value)
+    raise AssertionError(f"_bench_plan_auto no longer declares {name}")
+
+
+def test_plan_auto_is_a_section():
+    order = _section_order(_bench_tree())
+    assert "plan_auto" in order, (
+        "plan_auto left SECTION_ORDER — the planner bench gate is gone"
+    )
+
+
+def test_plan_auto_capture_satisfies_declared_gates():
+    """docs/PLAN_AUTO_r18.json is the banked evidence for the planner's
+    acceptance gates; it must still satisfy the bound _bench_plan_auto
+    declares TODAY (a loosened bound with a stale capture, or vice versa,
+    is drift)."""
+    bound = _fn_const(_plan_auto_fn(_bench_tree()), "PLAN_AUTO_BOUND")
+    with open(CAPTURE) as f:
+        capture = json.load(f)
+    plan = capture["extra"]["plan_auto"]
+    assert plan["bound"] == bound, (
+        f"banked capture bound {plan['bound']} != bench.py's declared "
+        f"PLAN_AUTO_BOUND {bound} — re-bank docs/PLAN_AUTO_r18.json"
+    )
+    shapes = set(plan["workloads"])
+    assert {"skewed", "uniform"} <= shapes, (
+        f"capture covers {sorted(shapes)}; the acceptance gate needs both "
+        "skewed and uniform"
+    )
+    for shape, w in plan["workloads"].items():
+        best = min(w["arms"].values())
+        worst = max(w["arms"].values())
+        assert w["warm_cost"] <= bound * best, (
+            f"{shape}: banked warm cost {w['warm_cost']} outside "
+            f"{bound}x of best arm {best}"
+        )
+        assert w["cold_cost"] < worst, (
+            f"{shape}: banked cold cost {w['cold_cost']} does not beat "
+            f"the worst arm {worst}"
+        )
+    assert plan["revised"], (
+        "banked capture shows no warm-rerun decision revision — the "
+        "feedback-loop acceptance gate has no evidence"
+    )
+
+
+def test_plan_auto_pause_tariff_matches_cost_model():
+    """The capture's cost unit embeds CHUNK_PAUSE_COST; cost.py changing
+    the tariff invalidates the banked numbers."""
+    with open(COST) as f:
+        cost_tree = ast.parse(f.read())
+    tariff = ast.literal_eval(_top_level_assign(cost_tree, "CHUNK_PAUSE_COST"))
+    with open(CAPTURE) as f:
+        unit = json.load(f)["extra"]["plan_auto"]["cost_unit"]
+    assert f"{tariff:.0f}/chunk-dispatch" in unit, (
+        f"compile/cost.py CHUNK_PAUSE_COST={tariff} no longer matches the "
+        f"banked capture's cost unit ({unit!r}) — re-bank "
+        "docs/PLAN_AUTO_r18.json"
+    )

@@ -1,0 +1,316 @@
+"""The cost-based plan optimizer (photon_ml_tpu.compile.cost + the
+planner pass in ExecutionPlan.resolve): prior cost algebra in lane-
+iteration units, --plan off pinned bitwise to the pre-planner behavior,
+the torn-sidecar degrade-to-priors path (recorded as a decision, never an
+exception), and the preemption-resume round trip whose final sidecar must
+land byte-identical to an uninterrupted run's. The bench-side acceptance
+gates (auto within bound of best hand-tuned arm on skewed AND uniform,
+warm rerun revising a decision) live in bench.py's plan_auto section with
+their lockstep tests in test_bench_sync.py; the fleet aggregation view is
+covered in test_fleetctl.py (TestPlanStatus); the no-new-env-reads rule
+in test_photon_lint.py."""
+
+import json
+import math
+import os
+
+import pytest
+
+from photon_ml_tpu.compile.cost import (
+    CHUNK_PAUSE_COST,
+    COST_MODEL_FILENAME,
+    DRIFT_THRESHOLD,
+    EMA_ALPHA,
+    PRIOR_EASY_ITERS,
+    PRIOR_HARD_ITERS,
+    TRACE_COST,
+    CostModel,
+    WorkloadProfile,
+)
+from photon_ml_tpu.compile.plan import ExecutionPlan, PlanError
+
+pytestmark = pytest.mark.plan
+
+SKEWED = WorkloadProfile(num_lanes=512, max_rows=3200, median_rows=32, dim=16)
+UNIFORM = WorkloadProfile(num_lanes=512, max_rows=32, median_rows=32, dim=16)
+
+
+@pytest.fixture(autouse=True)
+def _clean_plan_env(monkeypatch):
+    for var in ("PHOTON_PLAN", "PHOTON_SHAPE_LADDER", "PHOTON_SOLVE_CHUNK",
+                "PHOTON_SPARSE_KERNEL", "PHOTON_PREFETCH_DEPTH"):
+        monkeypatch.delenv(var, raising=False)
+
+
+class TestCostUnits:
+    """The analytic priors ARE the contract the planner reasons in; pin
+    the algebra, not just the argmin."""
+
+    def test_signatures_partition_workloads(self):
+        assert SKEWED.signature() == "skewed"
+        assert UNIFORM.signature() == "uniform"
+        assert WorkloadProfile().signature() == "unknown"
+
+    def test_schedule_priors_pay_skew_and_pause_tariff(self):
+        m = CostModel()
+        lanes = SKEWED.num_lanes
+        assert m.prior("schedule", "one-shot", SKEWED) == (
+            lanes * PRIOR_HARD_ITERS
+        )
+        hard_frac = 8.0 / lanes
+        for c in (2, 8, 32):
+            per_easy = math.ceil(PRIOR_EASY_ITERS / c) * c
+            per_hard = math.ceil(PRIOR_HARD_ITERS / c) * c
+            expect = lanes * (
+                (1.0 - hard_frac) * per_easy + hard_frac * per_hard
+            ) + CHUNK_PAUSE_COST * math.ceil(PRIOR_HARD_ITERS / c)
+            assert m.prior("schedule", f"chunk:{c}", SKEWED) == expect
+
+    def test_uniform_prior_prefers_one_shot(self):
+        action, _, _ = CostModel().choose(
+            "schedule",
+            ("one-shot", "chunk:2", "chunk:4", "chunk:8", "chunk:16",
+             "chunk:32"),
+            UNIFORM,
+        )
+        assert action == "one-shot"  # no tail to chase: chunking only pays
+
+    def test_unknown_action_never_wins(self):
+        m = CostModel()
+        assert m.prior(
+            "schedule", "chunk:oops-not-a-number", SKEWED
+        ) == float("inf")
+        assert m.prior("nonsense-policy", "x", SKEWED) == float("inf")
+        action, _, _ = m.choose("ladder", ("off", "on", "sideways"), SKEWED)
+        assert action in ("off", "on")
+
+    def test_observe_is_ema_and_predict_prefers_it(self):
+        m = CostModel()
+        prior = m.prior("schedule", "chunk:8", SKEWED)
+        m.observe("schedule", "chunk:8", SKEWED, 1000.0)
+        assert m.predict("schedule", "chunk:8", SKEWED) == 1000.0
+        m.observe("schedule", "chunk:8", SKEWED, 2000.0)
+        expect = EMA_ALPHA * 2000.0 + (1 - EMA_ALPHA) * 1000.0
+        assert m.predict("schedule", "chunk:8", SKEWED) == expect
+        # the other signature is untouched: shapes never contaminate
+        assert m.predict("schedule", "chunk:8", UNIFORM) == m.prior(
+            "schedule", "chunk:8", UNIFORM
+        )
+        assert prior != 1000.0  # the observation actually displaced it
+
+    def test_drifted_flags_only_past_threshold(self):
+        m = CostModel()
+        m.observe("schedule", "chunk:8", SKEWED, 1000.0, predicted=1000.0)
+        m.observe(
+            "schedule", "chunk:8", SKEWED,
+            1000.0 * (1 + DRIFT_THRESHOLD) + 1, predicted=1000.0,
+        )
+        assert len(m.drifted()) == 1
+
+    def test_merge_is_count_weighted(self):
+        a, b = CostModel(), CostModel()
+        a.observe("ladder", "on", SKEWED, 100.0)
+        a.observe("ladder", "on", SKEWED, 100.0)  # n=2, cost 100
+        b.observe("ladder", "on", SKEWED, 400.0)  # n=1
+        merged = a.merge(b)
+        key = "ladder=on@skewed"
+        assert merged.observations[key]["n"] == 3
+        assert merged.observations[key]["cost"] == pytest.approx(200.0)
+
+
+class TestPlanOffBitwise:
+    """--plan off (the default) must be bitwise today's behavior: no
+    planner decisions, no cost model, no sidecar writes, record_realized
+    a no-op."""
+
+    def test_default_resolution_untouched(self, tmp_path):
+        p = ExecutionPlan.resolve()
+        q = ExecutionPlan.resolve(
+            plan="off", workload=SKEWED, cost_model_dir=str(tmp_path)
+        )
+        for field in ("bucketer", "schedule", "sharding", "sparse_kernel",
+                      "prefetch_depth", "decisions", "sparse_candidates"):
+            assert getattr(p, field) == getattr(q, field)
+        assert q.plan_mode == "off" and q.cost_model is None
+        q.record_realized("schedule", 123.0)
+        assert q.save_cost_model(str(tmp_path)) is None
+        assert not os.path.exists(tmp_path / COST_MODEL_FILENAME)
+        assert "plan=auto" not in q.describe()
+
+    def test_bad_plan_spec_refused(self):
+        with pytest.raises(ValueError, match="PHOTON_PLAN"):
+            ExecutionPlan.resolve(plan="definitely-not-a-mode")
+
+    def test_explicit_knobs_always_win_under_auto(self):
+        p = ExecutionPlan.resolve(
+            plan="auto", workload=SKEWED, solve_compaction="4",
+            shape_canonicalization="on", prefetch_depth=7,
+        )
+        assert p.schedule.chunk_size == 4
+        assert p.prefetch_depth == 7
+        pinned = [d for d in p.decisions
+                  if d.policy == "schedule" and d.action == "pinned"]
+        assert len(pinned) == 1  # audited, not overridden
+
+    def test_auto_respects_fused_cycle_fence(self):
+        # the planner must not resolve INTO a PlanError the explicit path
+        # would refuse: under fused_cycle it never proposes a chunk
+        p = ExecutionPlan.resolve(
+            plan="auto", workload=SKEWED, fused_cycle=True,
+        )
+        assert p.schedule is None
+        assert not [d for d in p.decisions
+                    if d.policy == "schedule"
+                    and d.action.startswith("planned:chunk")]
+
+
+class TestSidecarCorruption:
+    """A torn/missing cost-model.json degrades to static priors LOUDLY —
+    a recorded decision, never an exception, never a half-read model."""
+
+    def test_missing_dir_resolves_from_priors(self):
+        p = ExecutionPlan.resolve(plan="auto", workload=SKEWED)
+        src = next(d for d in p.decisions if d.policy == "cost-model")
+        assert src.action == "priors"
+        assert p.cost_model.source == "static-priors"
+
+    def test_torn_sidecar_degrades_with_recorded_decision(self, tmp_path):
+        (tmp_path / COST_MODEL_FILENAME).write_text('{"format": 1, "obs')
+        p = ExecutionPlan.resolve(
+            plan="auto", workload=SKEWED, cost_model_dir=str(tmp_path)
+        )
+        src = next(d for d in p.decisions if d.policy == "cost-model")
+        assert src.action == "degraded"
+        assert "static priors" in src.reason
+        assert p.cost_model.source == "static-priors"
+        # and the planner still planned — degradation is not paralysis
+        assert [d for d in p.decisions if d.policy == "schedule"]
+
+    def test_wrong_format_and_wrong_types_also_degrade(self, tmp_path):
+        for payload in ('{"format": 99}', '{"format": 1, "observations": 3}',
+                        "[]"):
+            (tmp_path / COST_MODEL_FILENAME).write_text(payload)
+            assert CostModel.load(str(tmp_path)) is None
+
+    def test_save_is_atomic_no_tmp_left_behind(self, tmp_path):
+        m = CostModel()
+        m.observe("schedule", "chunk:8", SKEWED, 900.0)
+        path = m.save(str(tmp_path))
+        assert os.path.basename(path) == COST_MODEL_FILENAME
+        assert os.listdir(tmp_path) == [COST_MODEL_FILENAME]
+        again = CostModel.load(str(tmp_path))
+        assert again.to_json() == m.to_json()
+
+
+class TestPreemptionResume:
+    """A run preempted after persisting its sidecar, then resumed, must
+    land on the SAME cost model bytes as a run that was never interrupted
+    (the convergence-ledger discipline: tmp+rename means a crash leaves
+    the prior sidecar intact, and the EMA is deterministic)."""
+
+    REALIZED = (("schedule", 9332.0), ("ladder", 250.0), ("sharding", 8432.0))
+
+    def _run(self, directory, observations):
+        plan = ExecutionPlan.resolve(
+            plan="auto", workload=SKEWED, cost_model_dir=directory
+        )
+        for policy, realized in observations:
+            plan.record_realized(policy, realized)
+        plan.save_cost_model(directory)
+        return plan
+
+    def test_resume_lands_on_uninterrupted_cost_model(self, tmp_path):
+        clean = tmp_path / "clean"
+        bumpy = tmp_path / "bumpy"
+        clean.mkdir(), bumpy.mkdir()
+        # uninterrupted: two full epochs of realized feedback
+        self._run(str(clean), self.REALIZED)
+        self._run(str(clean), self.REALIZED)
+        # preempted: first epoch persists, then the SECOND attempt dies
+        # mid-write (a torn tmp file the atomic rename never promoted)
+        self._run(str(bumpy), self.REALIZED)
+        (bumpy / (COST_MODEL_FILENAME + ".tmp")).write_text('{"form')
+        # resume: re-resolve from the surviving sidecar, replay the epoch
+        resumed = self._run(str(bumpy), self.REALIZED)
+        src = next(
+            d for d in resumed.decisions if d.policy == "cost-model"
+        )
+        assert src.action == "loaded"  # resumed from the prior epoch
+        clean_bytes = (clean / COST_MODEL_FILENAME).read_bytes()
+        bumpy_bytes = (bumpy / COST_MODEL_FILENAME).read_bytes()
+        assert clean_bytes == bumpy_bytes
+
+    def test_realized_costs_attach_to_decisions(self, tmp_path):
+        plan = self._run(str(tmp_path), self.REALIZED)
+        sched = next(d for d in plan.decisions if d.policy == "schedule")
+        assert sched.realized_cost == 9332.0
+        assert sched.predicted_cost is not None
+        assert "realized=9332" in sched.describe()
+        # a second resolve now predicts FROM the realized value
+        warm = ExecutionPlan.resolve(
+            plan="auto", workload=SKEWED, cost_model_dir=str(tmp_path)
+        )
+        choice = next(
+            d for d in warm.decisions if d.policy == "schedule"
+        ).planned_choice()
+        assert warm.cost_model.predict(
+            "schedule", choice, SKEWED
+        ) <= 9332.0
+
+
+class TestManifestExport:
+    """retrain.json carries the cost model under --plan auto and stays
+    byte-stable without it (back-compat both directions)."""
+
+    def test_manifest_round_trips_cost_model(self, tmp_path):
+        from photon_ml_tpu.retrain.manifest import RetrainManifest
+
+        m = CostModel()
+        m.observe("schedule", "chunk:8", SKEWED, 900.0)
+        manifest = RetrainManifest(
+            output_dir=str(tmp_path), model_dir=str(tmp_path),
+            task="LOGISTIC_REGRESSION", file_stats=[], ingest_inputs=[],
+            ingest_digest="d", updating_sequence=[], coordinates={},
+            cost_model=m.to_json(),
+        )
+        manifest.save(str(tmp_path))
+        back = RetrainManifest.load(str(tmp_path))
+        assert back.cost_model == m.to_json()
+
+    def test_manifest_without_cost_model_stays_clean(self, tmp_path):
+        from photon_ml_tpu.retrain.manifest import RetrainManifest
+
+        manifest = RetrainManifest(
+            output_dir=str(tmp_path), model_dir=str(tmp_path),
+            task="LOGISTIC_REGRESSION", file_stats=[], ingest_inputs=[],
+            ingest_digest="d", updating_sequence=[], coordinates={},
+        )
+        path = manifest.save(str(tmp_path))
+        raw = json.loads(open(path).read())
+        assert "cost_model" not in raw  # --plan off: bytes as before
+        assert RetrainManifest.load(str(tmp_path)).cost_model is None
+
+
+class TestLadderPlanning:
+    def test_planner_turns_ladder_on_for_skewed(self):
+        p = ExecutionPlan.resolve(plan="auto", workload=SKEWED)
+        dec = next(d for d in p.decisions if d.policy == "ladder")
+        assert dec.planned_choice() == "on" and p.bucketer is not None
+
+    def test_realized_trace_cost_can_flip_ladder_off(self, tmp_path):
+        plan = ExecutionPlan.resolve(
+            plan="auto", workload=SKEWED, cost_model_dir=str(tmp_path)
+        )
+        assert plan.bucketer is not None
+        # reality: the ladder re-traced wildly (say a pathological rung
+        # spread) — costlier than the flat-shape alternative's prior
+        off_prior = plan.cost_model.prior("ladder", "off", SKEWED)
+        plan.record_realized("ladder", 4.0 * off_prior)
+        plan.record_realized("ladder", 4.0 * off_prior)
+        plan.save_cost_model(str(tmp_path))
+        warm = ExecutionPlan.resolve(
+            plan="auto", workload=SKEWED, cost_model_dir=str(tmp_path)
+        )
+        dec = next(d for d in warm.decisions if d.policy == "ladder")
+        assert dec.planned_choice() == "off" and warm.bucketer is None
+        assert TRACE_COST > 0  # the unit the realized cost was paid in

@@ -273,6 +273,96 @@ class TestConvergenceStatus:
         assert status["convergence"]["visits"] == 3
 
 
+class TestPlanStatus:
+    """status --plan: the cost-model sidecar fleet view (compile/cost.py
+    writes, fleetctl reads — same shared-contract discipline as the
+    convergence ledgers)."""
+
+    def _write_model(self, directory, observations=None, drift=None):
+        os.makedirs(directory, exist_ok=True)
+        payload = {
+            "format": fleetctl.COST_MODEL_FORMAT,
+            "observations": observations or {},
+            "drift_log": drift or [],
+        }
+        with open(os.path.join(directory, fleetctl.COST_MODEL_FILE), "w") as f:
+            json.dump(payload, f)
+
+    def test_file_name_and_format_match_library_writer(self):
+        from photon_ml_tpu.compile import cost
+
+        assert fleetctl.COST_MODEL_FILE == cost.COST_MODEL_FILENAME
+        assert fleetctl.COST_MODEL_FORMAT == cost.COST_MODEL_FORMAT
+        assert fleetctl.PLAN_DRIFT_THRESHOLD == cost.DRIFT_THRESHOLD
+
+    def test_aggregates_policies_and_flags_drift(self, tmp_path):
+        self._write_model(
+            tmp_path / "r0",
+            observations={
+                "schedule=chunk:8@skewed": {"cost": 5000.0, "n": 3},
+                "ladder=on@skewed": {"cost": 900.0, "n": 1},
+            },
+            drift=[
+                # 100% off: flagged
+                {"policy": "schedule", "action": "chunk:8",
+                 "signature": "skewed", "predicted": 2500.0,
+                 "realized": 5000.0},
+                # spot on: not flagged
+                {"policy": "ladder", "action": "on",
+                 "signature": "skewed", "predicted": 900.0,
+                 "realized": 900.0},
+            ],
+        )
+        self._write_model(
+            tmp_path / "r1",
+            observations={"schedule=one-shot@uniform": {"cost": 1.0, "n": 2}},
+        )
+        plan = fleetctl.read_cost_models(
+            [str(tmp_path / "r0"), str(tmp_path / "r1")]
+        )
+        assert plan["sidecars"] == 2 and plan["unreadable"] == 0
+        assert plan["policies"]["schedule"] == {"keys": 2, "samples": 5}
+        assert plan["policies"]["ladder"] == {"keys": 1, "samples": 1}
+        assert plan["drifted_total"] == 1
+        d = plan["drifted"][0]
+        assert d["policy"] == "schedule" and d["error"] == 1.0
+
+    def test_torn_and_misformatted_sidecars_counted_not_fatal(self, tmp_path):
+        torn = tmp_path / "torn"
+        torn.mkdir()
+        (torn / fleetctl.COST_MODEL_FILE).write_text("{torn")
+        bad = tmp_path / "bad"
+        bad.mkdir()
+        (bad / fleetctl.COST_MODEL_FILE).write_text(
+            json.dumps({"format": 99})
+        )
+        assert fleetctl.read_cost_models([str(tmp_path / "absent")]) is None
+        plan = fleetctl.read_cost_models([str(torn), str(bad)])
+        assert plan["sidecars"] == 0 and plan["unreadable"] == 2
+
+    def test_status_cli_plan_flag(self, tmp_path, capsys):
+        _commit(tmp_path)
+        self._write_model(
+            tmp_path / "run",
+            observations={"prefetch=2@uniform": {"cost": 4.0, "n": 1}},
+            drift=[{"policy": "prefetch", "action": "2",
+                    "signature": "uniform", "predicted": 1.0,
+                    "realized": 4.0}],
+        )
+        status = fleetctl.fleet_status(str(tmp_path))
+        assert status["plan"] is None  # only when asked, like --block-dir
+        assert fleetctl.main(
+            ["status", str(tmp_path), "--json",
+             "--plan", str(tmp_path / "run")]
+        ) == 0
+        status = json.loads(capsys.readouterr().out)
+        assert status["plan"]["sidecars"] == 1
+        assert status["plan"]["drifted_total"] == 1
+        text = fleetctl._format_status(status)
+        assert "plan cost models: 1 sidecars" in text
+        assert "prefetch/2@uniform(err=300%)" in text
+
+
 class TestCli:
     def test_refusal_exits_2_and_writes_nothing(self, tmp_path, capsys):
         _commit(tmp_path)

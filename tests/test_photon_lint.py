@@ -52,6 +52,14 @@ CORPUS = {
     "fault-sites": (
         "fault_sites_bad.py", None, {10, 14, 19, 23, 27},
     ),
+    "env-reads": (
+        # scoped to the photon_ml_tpu package, so presented under a
+        # pretend package relpath (tools/ and bench.py orchestrate
+        # subprocess envs by design)
+        "env_reads_bad.py",
+        "photon_ml_tpu/ops/fixture.py",
+        {10, 14, 18, 22, 26, 30},
+    ),
 }
 
 CLEAN = {
@@ -64,6 +72,7 @@ CLEAN = {
     ),
     "static-key-honesty": ("static_key_ok.py", None),
     "fault-sites": ("fault_sites_ok.py", None),
+    "env-reads": ("env_reads_ok.py", "photon_ml_tpu/ops/fixture.py"),
 }
 
 
@@ -277,6 +286,53 @@ def test_live_jit_allowlist_entry_not_stale():
     src = "import jax\ndef f(x):\n    return jax.jit(x)\n"
     assert not engine.scan_source(src, path="x.py", relpath="x.py", rules=[rule])
     assert not list(rule.finalize(full_scope=False))
+
+
+def test_stale_env_reads_allowlist_entry_fails():
+    """PR-18 satellite: a legacy env-read site migrated onto the single
+    resolver must shrink the allowlist, or the entry silently stops
+    protecting anything (the jit-sites staleness discipline)."""
+    from tools.photon_lint.rules.env_reads import EnvReadsRule
+
+    rule = EnvReadsRule(
+        root=REPO,
+        allowlist={"photon_ml_tpu/x.py:gone": "was migrated"},
+    )
+    findings = engine.scan_source(
+        "VALUE = 1\n", path="x.py", relpath="photon_ml_tpu/x.py",
+        rules=[rule],
+    )
+    assert not findings
+    stale = list(rule.finalize(full_scope=False))
+    assert stale and "stale" in stale[0][2]
+
+
+def test_live_env_reads_allowlist_entry_not_stale():
+    from tools.photon_lint.rules.env_reads import EnvReadsRule
+
+    rule = EnvReadsRule(
+        root=REPO,
+        allowlist={"photon_ml_tpu/x.py:f": "legacy resolver"},
+    )
+    src = "import os\ndef f():\n    return os.environ.get('K')\n"
+    assert not engine.scan_source(
+        src, path="x.py", relpath="photon_ml_tpu/x.py", rules=[rule]
+    )
+    assert not list(rule.finalize(full_scope=False))
+
+
+def test_env_writes_never_flagged_anywhere():
+    """Pinning a child environment (bench arms, test harnesses) is
+    legitimate in-package too: only READS are the planner's business."""
+    src = (
+        "import os\n"
+        "os.environ['PHOTON_SOLVE_CHUNK'] = 'off'\n"
+        "os.environ.pop('PHOTON_SPARSE_KERNEL', None)\n"
+        "del os.environ['PHOTON_SHAPE_LADDER']\n"
+    )
+    assert not engine.scan_source(
+        src, relpath="photon_ml_tpu/ops/x.py", rule_names=["env-reads"]
+    )
 
 
 def test_unused_fault_registry_entry_fails():

@@ -55,6 +55,12 @@ AUDIT_LOG = "fleetctl-audit.log"
 # the heartbeat/membership formats above — fleetctl reads, never writes)
 LEDGER_FILE = "convergence-ledger.json"
 LEDGER_TOP_N = 5
+# shared on-disk contract with photon_ml_tpu/compile/cost.py (--plan auto
+# sidecars written beside each run's retrain.json — fleetctl reads only)
+COST_MODEL_FILE = "cost-model.json"
+COST_MODEL_FORMAT = 1
+PLAN_DRIFT_THRESHOLD = 0.5  # mirrors compile/cost.py DRIFT_THRESHOLD
+PLAN_TOP_N = 5
 
 
 class FleetctlError(RuntimeError):
@@ -290,8 +296,73 @@ def read_convergence_ledgers(block_dirs: List[str]) -> Optional[dict]:
     }
 
 
+def read_cost_models(plan_dirs: List[str]) -> Optional[dict]:
+    """Aggregate the planner cost-model sidecars (``cost-model.json``,
+    written by photon_ml_tpu/compile/cost.py under ``--plan auto``) under
+    the given run output dirs into one fleet view: observation totals per
+    policy and every drift-log entry whose predicted-vs-realized relative
+    error exceeds PLAN_DRIFT_THRESHOLD. Torn/absent/mis-formatted sidecars
+    are counted but skipped — the model is telemetry here, never
+    load-bearing (exactly the planner's own degrade-to-priors rule)."""
+    policies: Dict[str, dict] = {}
+    drifted: List[dict] = []
+    scanned = skipped = 0
+    for directory in plan_dirs:
+        try:
+            payload = _read_json(os.path.join(directory, COST_MODEL_FILE))
+        except (ValueError, OSError):
+            skipped += 1  # torn mid-write or unreadable: skip, but say so
+            continue
+        if (
+            not isinstance(payload, dict)
+            or payload.get("format") != COST_MODEL_FORMAT
+        ):
+            if payload is not None:
+                skipped += 1
+            continue
+        scanned += 1
+        for key, obs in (payload.get("observations") or {}).items():
+            if not isinstance(obs, dict):
+                continue
+            # observation keys are "policy=action@signature"
+            policy = str(key).split("=", 1)[0]
+            agg = policies.setdefault(policy, {"keys": 0, "samples": 0})
+            agg["keys"] += 1
+            agg["samples"] += int(obs.get("n", 0) or 0)
+        for entry in payload.get("drift_log") or []:
+            try:
+                predicted = float(entry["predicted"])
+                realized = float(entry["realized"])
+            except (KeyError, TypeError, ValueError):
+                continue
+            denom = max(abs(predicted), 1e-9)
+            error = abs(realized - predicted) / denom
+            if error > PLAN_DRIFT_THRESHOLD:
+                drifted.append({
+                    "dir": os.path.abspath(directory),
+                    "policy": entry.get("policy"),
+                    "action": entry.get("action"),
+                    "signature": entry.get("signature"),
+                    "predicted": predicted,
+                    "realized": realized,
+                    "error": round(error, 3),
+                })
+    if scanned == 0 and skipped == 0:
+        return None
+    drifted.sort(key=lambda d: -d["error"])
+    return {
+        "sidecars": scanned,
+        "unreadable": skipped,
+        "policies": {p: policies[p] for p in sorted(policies)},
+        "drift_threshold": PLAN_DRIFT_THRESHOLD,
+        "drifted": drifted[:PLAN_TOP_N],
+        "drifted_total": len(drifted),
+    }
+
+
 def fleet_status(
-    fleet_dir: str, block_dirs: Optional[List[str]] = None
+    fleet_dir: str, block_dirs: Optional[List[str]] = None,
+    plan_dirs: Optional[List[str]] = None,
 ) -> dict:
     """One JSON-able snapshot of the fleet's coordination state."""
     _require_fleet_dir(fleet_dir)
@@ -319,6 +390,7 @@ def fleet_status(
     status["convergence"] = (
         read_convergence_ledgers(block_dirs) if block_dirs else None
     )
+    status["plan"] = read_cost_models(plan_dirs) if plan_dirs else None
     return status
 
 
@@ -368,6 +440,28 @@ def _format_status(status: dict) -> str:
                 for h in conv["hottest"]
             )
         lines.append(line)
+    plan = status.get("plan")
+    if plan is not None:
+        summary = " ".join(
+            f"{p}:{agg['samples']}" for p, agg in plan["policies"].items()
+        ) or "(no observations)"
+        lines.append(
+            f"plan cost models: {plan['sidecars']} sidecars "
+            f"({plan['unreadable']} unreadable); samples per policy: "
+            f"{summary}"
+        )
+        if plan["drifted_total"]:
+            lines.append(
+                f"plan drift (> {plan['drift_threshold']:.0%} "
+                f"predicted-vs-realized): {plan['drifted_total']} "
+                "entries; worst: " + ", ".join(
+                    f"{d['policy']}/{d['action']}@{d['signature']}"
+                    f"(err={d['error']:.0%})"
+                    for d in plan["drifted"]
+                )
+            )
+        else:
+            lines.append("plan drift: none above threshold")
     return "\n".join(lines)
 
 
@@ -386,6 +480,13 @@ def main(argv: Optional[List[str]] = None) -> int:
                    help="per-host streaming block dir holding a "
                         "convergence-ledger.json (repeatable); adds the "
                         "adaptive-schedule visit/skip/hottest summary")
+    s.add_argument("--plan", action="append", default=[],
+                   metavar="DIR", dest="plan_dirs",
+                   help="run output dir holding a cost-model.json planner "
+                        "sidecar (repeatable); adds the fleet-wide plan "
+                        "view: observation totals per policy and drift "
+                        "entries where realized cost strayed from the "
+                        "prediction past the threshold")
 
     d = sub.add_parser(
         "declare-lost-hosts",
@@ -412,7 +513,10 @@ def main(argv: Optional[List[str]] = None) -> int:
     args = parser.parse_args(argv)
     try:
         if args.cmd == "status":
-            status = fleet_status(args.fleet_dir, block_dirs=args.block_dirs)
+            status = fleet_status(
+                args.fleet_dir, block_dirs=args.block_dirs,
+                plan_dirs=args.plan_dirs,
+            )
             print(
                 json.dumps(status, indent=1, sort_keys=True)
                 if args.json else _format_status(status)

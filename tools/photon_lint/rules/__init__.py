@@ -16,6 +16,7 @@ from tools.photon_lint.rules.traced_construction import TracedConstructionRule
 from tools.photon_lint.rules.bitwise_reduction import BitwiseReductionRule
 from tools.photon_lint.rules.static_key import StaticKeyRule
 from tools.photon_lint.rules.fault_sites import FaultSitesRule
+from tools.photon_lint.rules.env_reads import EnvReadsRule
 
 #: name -> rule class, in report order.
 RULES: Dict[str, type] = {
@@ -27,6 +28,7 @@ RULES: Dict[str, type] = {
         BitwiseReductionRule,
         StaticKeyRule,
         FaultSitesRule,
+        EnvReadsRule,
     )
 }
 
