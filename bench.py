@@ -2804,6 +2804,314 @@ def _bench_compaction(extra, on_tpu):
     }
 
 
+def _merge_shards(n_shards):
+    """Deterministic disjoint per-shard partials for the merge arms: a
+    (n_shards, 4096, 16) float32 block where every row is written by
+    exactly ONE shard (round-robin owner draw) — the merge_disjoint
+    exactness precondition, so the host fold, the 2-process Gloo merge,
+    and the device psum must all produce the SAME bytes."""
+    rng = np.random.default_rng(17)
+    rows, dim = 4096, 16
+    full = rng.normal(size=(rows, dim)).astype(np.float32)
+    shards = np.zeros((n_shards, rows, dim), np.float32)
+    owners = rng.integers(0, n_shards, size=rows)
+    shards[owners, np.arange(rows)] = full
+    return shards
+
+
+def _merge_worker_main(argv):
+    """Child mode (``--merge-worker PID NPROCS PORT OUTDIR N_SHARDS``): one
+    Gloo process of the fused_schedule section's merge comparator — the
+    HOST-side exact-merge path (parallel/perhost_streaming.merge_disjoint
+    over a real process group) timed on the same deterministic disjoint
+    partials the in-process psum arm merges on the device mesh."""
+    import hashlib
+    import json as _json
+
+    i = argv.index("--merge-worker")
+    pid, nprocs, port, outdir, n_shards = (
+        int(argv[i + 1]), int(argv[i + 2]), argv[i + 3], argv[i + 4],
+        int(argv[i + 5]),
+    )
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    from photon_ml_tpu.parallel import multihost
+    from photon_ml_tpu.parallel.mesh import MeshContext, data_mesh
+    from photon_ml_tpu.parallel.perhost_streaming import merge_disjoint
+
+    multihost.initialize(
+        coordinator_address=f"127.0.0.1:{port}", num_processes=nprocs,
+        process_id=pid,
+    )
+    ctx = MeshContext(data_mesh())
+    shards = _merge_shards(n_shards)
+    # this host's partial: the fold of its round-robin share — still
+    # disjoint ACROSS hosts (every element is written by at most one
+    # shard, and each shard belongs to exactly one host)
+    local = np.zeros(shards.shape[1:], shards.dtype)
+    for s in range(pid, n_shards, nprocs):
+        local = local + shards[s]
+    merged = merge_disjoint(local, ctx, nprocs)  # warm the collective
+    reps = 5
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        merged = merge_disjoint(local, ctx, nprocs)
+    sec = (time.perf_counter() - t0) / reps
+    out = {
+        "process": pid,
+        "sec_per_merge": sec,
+        "digest": hashlib.sha256(
+            np.ascontiguousarray(merged).tobytes()
+        ).hexdigest(),
+    }
+    with open(os.path.join(outdir, f"merge-{pid}.json"), "w") as f:
+        _json.dump(out, f)
+
+
+def _bench_fused_schedule(extra, on_tpu):
+    """On-device whole-cycle compaction (optim/fused_schedule.py): the
+    chunk->compact->resume loop fused into one XLA program per ladder
+    rung vs the host chunk loop, on the skewed 8-hard/512-easy workload —
+    sec/solve, HOST DISPATCHES per solve (the O(#rungs) claim), and the
+    bitwise gate; plus the exact-merge arms: in-process shard_map+psum
+    over the local device mesh vs the 2-process Gloo path on identical
+    disjoint partials (same merge_disjoint discipline). The psum arm
+    needs a multi-device mesh: absent the forced CPU flag it records a
+    structured ``preflight:`` skip instead of wedging."""
+    import hashlib
+    import subprocess
+    import tempfile
+
+    import jax
+    import jax.numpy as jnp
+
+    from photon_ml_tpu import compat
+    from photon_ml_tpu.optim import fused_schedule
+    from photon_ml_tpu.ops.regularization import RegularizationContext
+    from photon_ml_tpu.optim.common import OptimizerConfig
+    from photon_ml_tpu.optim.scheduler import (
+        SolveSchedule,
+        compacted_solve,
+        solve_stats,
+    )
+    from photon_ml_tpu.types import OptimizerType, TaskType
+
+    E = 2048 if on_tpu else 520  # 8 hard stragglers among the easy rest
+    M, D, hard = 32, 16, 8
+    rng = np.random.default_rng(11)
+    x = rng.normal(size=(E, M, D)).astype(np.float32)
+    x[:hard] *= np.geomspace(1.0, 64.0, D).astype(np.float32)
+    w_true = (rng.normal(size=(E, D)) * 0.5).astype(np.float32)
+    z = np.einsum("emd,ed->em", x.astype(np.float64), w_true)
+    y = (1.0 / (1.0 + np.exp(-z)) > rng.random((E, M))).astype(np.float32)
+    data = tuple(
+        jnp.asarray(a)
+        for a in (x, y, np.zeros((E, M), np.float32), np.ones((E, M), np.float32))
+    )
+    w0 = jnp.zeros((E, D), jnp.float32)
+    cfg = OptimizerConfig(max_iterations=120, tolerance=1e-7)
+    kw = dict(
+        task=TaskType.LOGISTIC_REGRESSION, optimizer=OptimizerType.LBFGS,
+        optimizer_config=cfg, regularization=RegularizationContext.l2(1.0),
+    )
+    host_sched = SolveSchedule(chunk_size=16)
+    dev_sched = SolveSchedule(chunk_size=16, loop="device")
+
+    ref = compacted_solve(data, w0, schedule=host_sched, label="warm_host", **kw)
+    res = compacted_solve(data, w0, schedule=dev_sched, label="warm_dev", **kw)
+    jax.block_until_ready(res.coefficients)
+    bitwise = all(
+        np.array_equal(np.asarray(a), np.asarray(b), equal_nan=True)
+        for a, b in zip(res[:7], ref[:7])
+        if a is not None
+    )
+    reps = 3
+    solve_stats.reset()
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        ref = compacted_solve(
+            data, w0, schedule=host_sched, label="host", **kw
+        )
+    jax.block_until_ready(ref.coefficients)
+    t_host = (time.perf_counter() - t0) / reps
+    rec_host = solve_stats.snapshot()[-1]
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        res = compacted_solve(data, w0, schedule=dev_sched, label="dev", **kw)
+    jax.block_until_ready(res.coefficients)
+    t_dev = (time.perf_counter() - t0) / reps
+    rec_dev = solve_stats.snapshot()[-1]
+
+    ladder = fused_schedule.rung_ladder(host_sched.bucketer, E)
+    hops = " -> ".join(
+        f"{c.active_lanes}/{c.batch_lanes}@{c.limit}" for c in rec_dev.chunks
+    )
+    _log(
+        f"fused_schedule: E={E} (hard={hard}) host loop {t_host*1e3:.1f}ms"
+        f"/{rec_host.dispatches} dispatches vs device loop "
+        f"{t_dev*1e3:.1f}ms/{rec_dev.dispatches} dispatches "
+        f"({rec_dev.device_chunks} in-program chunks), bitwise={bitwise}"
+    )
+    _log(f"fused_schedule: rung hops: {hops}")
+    if not bitwise:
+        raise AssertionError(
+            "device loop is not bitwise-equal to the host chunk loop"
+        )
+    if rec_dev.executed != rec_host.executed:
+        raise AssertionError(
+            f"device ledger executed {rec_dev.executed} != host "
+            f"{rec_host.executed} — the re-batching exactness claim broke"
+        )
+    if rec_dev.dispatches > len(ladder):
+        raise AssertionError(
+            f"device loop paid {rec_dev.dispatches} dispatches on a "
+            f"{len(ladder)}-rung ladder — the O(#rungs) claim broke"
+        )
+    if rec_dev.dispatches >= rec_host.dispatches:
+        raise AssertionError(
+            f"device loop saved no dispatches ({rec_dev.dispatches} vs "
+            f"host {rec_host.dispatches})"
+        )
+    extra["fused_schedule_host_ms"] = round(t_host * 1e3, 2)
+    extra["fused_schedule_device_ms"] = round(t_dev * 1e3, 2)
+    extra["fused_schedule_speedup"] = round(t_host / max(t_dev, 1e-9), 3)
+    extra["fused_schedule_host_dispatches"] = int(rec_host.dispatches)
+    extra["fused_schedule_device_dispatches"] = int(rec_dev.dispatches)
+    extra["fused_schedule_device_chunks"] = int(rec_dev.device_chunks)
+    extra["fused_schedule_bitwise_equal"] = bool(bitwise)
+    extra["fused_schedule_config"] = {
+        "entities": E, "hard": hard, "samples": M, "dim": D,
+        "chunk": 16, "max_iter": cfg.max_iterations,
+        "ladder_rungs": len(ladder),
+    }
+
+    # ---- exact-merge arms: device psum vs the 2-process Gloo path -------
+    devs = jax.devices()
+    n_dev = len(devs)
+    psum_digest = None
+    if n_dev < 2:
+        forced = compat.forced_cpu_device_count()
+        reason = (
+            f"preflight: single-device {devs[0].platform} backend "
+            f"(forced_cpu_devices={forced!r}); the psum merge arm needs a "
+            "multi-device mesh — set --xla_force_host_platform_device_count"
+        )
+        extra["fused_schedule_psum"] = {"skipped": reason}
+        _log(f"fused_schedule psum arm SKIPPED ({reason})")
+    else:
+        from photon_ml_tpu.parallel.mesh import MeshContext, data_mesh
+        from photon_ml_tpu.parallel.perhost_streaming import (
+            merge_disjoint_devices,
+        )
+
+        ctx = MeshContext(data_mesh())
+        shards = _merge_shards(n_dev)
+        merged = merge_disjoint_devices(shards, ctx)  # warm
+        t0 = time.perf_counter()
+        for _ in range(5):
+            merged = merge_disjoint_devices(shards, ctx)
+        t_psum = (time.perf_counter() - t0) / 5
+        # exactness gate vs the host-side fold of the same partials
+        fold = np.zeros(shards.shape[1:], shards.dtype)
+        for s in range(n_dev):
+            fold = fold + shards[s]
+        if not np.array_equal(merged, fold):
+            raise AssertionError(
+                "device psum merge is not bitwise-equal to the host fold"
+            )
+        psum_digest = hashlib.sha256(
+            np.ascontiguousarray(merged).tobytes()
+        ).hexdigest()
+        extra["fused_schedule_psum"] = {
+            "devices": n_dev,
+            "sec_per_merge": round(t_psum, 6),
+            "digest": psum_digest[:16],
+        }
+        _log(
+            f"fused_schedule: psum merge over {n_dev} devices "
+            f"{t_psum*1e3:.2f}ms/merge"
+        )
+
+    # Gloo comparator: the same partials through the real 2-process
+    # host-merge path (subprocess-fenced, cohort-killed on any failure)
+    import socket
+
+    n_shards = max(n_dev, 2)
+    here = os.path.abspath(__file__)
+    out = tempfile.mkdtemp(prefix="fused-merge-bench-")
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    env = dict(os.environ)
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+    env["JAX_PLATFORMS"] = "cpu"
+    log_paths = [os.path.join(out, f"merge-worker-{p}.log") for p in range(2)]
+    procs = []
+    try:
+        for p in range(2):
+            with open(log_paths[p], "w") as lf:
+                procs.append(subprocess.Popen(
+                    [sys.executable, here, "--merge-worker", str(p), "2",
+                     str(port), out, str(n_shards)],
+                    stdout=subprocess.DEVNULL, stderr=lf, env=env,
+                ))
+        for p_id, p in enumerate(procs):
+            try:
+                p.communicate(timeout=300)
+            except subprocess.TimeoutExpired:
+                p.kill()
+                p.communicate()
+                raise RuntimeError(
+                    f"merge worker {p_id} exceeded 300s: see {log_paths[p_id]}"
+                )
+            if p.returncode != 0:
+                with open(log_paths[p_id]) as lf:
+                    tail = lf.read()[-1500:]
+                raise RuntimeError(
+                    f"merge worker {p_id} failed rc={p.returncode}:\n{tail}"
+                )
+        results = []
+        for p_id in range(2):
+            with open(os.path.join(out, f"merge-{p_id}.json")) as f:
+                results.append(json.load(f))
+    except BaseException:  # noqa: BLE001 — cohort cleanup then re-raise (a stranded Gloo peer contends with every later section)
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+                p.wait()
+        raise
+    finally:
+        import shutil
+
+        shutil.rmtree(out, ignore_errors=True)
+    gloo_digest = results[0]["digest"]
+    if results[1]["digest"] != gloo_digest:
+        raise AssertionError(
+            "Gloo merge digests disagree across processes: "
+            f"{[r['digest'][:12] for r in results]}"
+        )
+    if psum_digest is not None and gloo_digest != psum_digest:
+        raise AssertionError(
+            "psum and Gloo merges of the same disjoint partials disagree: "
+            f"{psum_digest[:12]} vs {gloo_digest[:12]} — the exact-merge "
+            "discipline broke"
+        )
+    t_gloo = max(r["sec_per_merge"] for r in results)
+    extra["fused_schedule_gloo"] = {
+        "processes": 2,
+        "sec_per_merge": round(t_gloo, 6),
+        "digest": gloo_digest[:16],
+        "matches_psum": bool(psum_digest is not None),
+    }
+    _log(
+        f"fused_schedule: Gloo merge over 2 processes {t_gloo*1e3:.2f}ms"
+        "/merge"
+        + (", digest matches psum arm" if psum_digest is not None else "")
+    )
+
+
 def _bench_adaptive_schedule(extra, on_tpu):
     """Gap-guided adaptive solve scheduling (optim/convergence.py) on a
     SKEWED block-convergence workload — 8 ill-conditioned entities in
@@ -4159,6 +4467,7 @@ def _bench_quantized_serving(extra, on_tpu):
 SECTION_ORDER = (
     "dense", "sparse", "sparse_race", "game", "game5", "grid",
     "streaming", "streaming_pipeline", "compile_reuse", "compaction",
+    "fused_schedule",
     "adaptive_schedule",
     "plan_auto",
     "preemption_resume",
@@ -4186,6 +4495,9 @@ SECTION_DEADLINES = {"dense": 3600, "game": 3600, "game5": 2400, "grid": 2400,
                      # 3 single-host (450 each) + 3 two-process (750 each)
                      # subprocess-fenced worker cohorts — deadline > sum
                      "adaptive_schedule": 3900,
+                     # host/device loop arms in-process + one 2-process
+                     # Gloo merge cohort fenced at 300s
+                     "fused_schedule": 1800,
                      # 3 fleets (1/2/4 replicas) of warmed subprocess
                      # replicas + the kill arm, each spawn fenced at 240s
                      "serving_fleet": 3600,
@@ -4228,16 +4540,31 @@ def _device_preflight():
     ``UNAVAILABLE: TPU device error`` and poisoned every later section in
     the process; probing up front converts that into ONE structured
     ``sections_failed`` reason per skipped section, recorded before any
-    work is lost. Returns (ok, reason)."""
+    work is lost. Returns (ok, reason, info) — ``info`` reports the
+    device topology, including whether a multi-device CPU mesh is FORCED
+    (``--xla_force_host_platform_device_count``): the multi-device psum
+    arms consult it to record a structured ``preflight:`` skip when the
+    flag is absent, instead of wedging in a 1-device collective."""
+    info = {}
     try:
         import jax
         import jax.numpy as jnp
 
+        from photon_ml_tpu import compat
+
         devs = jax.devices()
+        info = {
+            "platform": devs[0].platform,
+            "device_count": len(devs),
+        }
+        if devs[0].platform == "cpu":
+            # a >1-device CPU mesh only exists when forced through
+            # XLA_FLAGS; report the flag so arm-level skips can say WHY
+            info["forced_cpu_devices"] = compat.forced_cpu_device_count()
         out = jax.jit(lambda x: x * 2.0 + 1.0)(jnp.arange(8.0))  # jit-ok: trivial preflight probe kernel, no state worth donating
         got = np.asarray(jax.block_until_ready(out))
         if not np.array_equal(got, np.arange(8.0) * 2.0 + 1.0):
-            return False, f"probe kernel returned wrong values: {got[:4]}"
+            return False, f"probe kernel returned wrong values: {got[:4]}", info
         if len(devs) > 1:
             from jax.sharding import NamedSharding, PartitionSpec as P
 
@@ -4254,10 +4581,10 @@ def _device_preflight():
             )(arr)
             rv = np.asarray(jax.block_until_ready(red))
             if not np.array_equal(rv, np.full(4, float(len(devs)), np.float32)):
-                return False, f"collective probe returned wrong values: {rv}"
-        return True, None
+                return False, f"collective probe returned wrong values: {rv}", info
+        return True, None, info
     except Exception as e:  # noqa: BLE001 — ANY probe failure means the device is unusable; that is the signal
-        return False, f"{type(e).__name__}: {str(e)[:200]}"
+        return False, f"{type(e).__name__}: {str(e)[:200]}", info
 
 
 def _run_sections(names, extra, errors, on_tpu, state=None, after=None):
@@ -4272,10 +4599,11 @@ def _run_sections(names, extra, errors, on_tpu, state=None, after=None):
     value = 0.0
     device_names = [n for n in names if n not in HOST_ONLY_SECTIONS]
     if device_names:
-        ok, reason = _device_preflight()
-        extra["preflight"] = {"ok": bool(ok)} if ok else {
-            "ok": False, "reason": reason
-        }
+        ok, reason, pinfo = _device_preflight()
+        extra["preflight"] = dict(
+            {"ok": bool(ok)} if ok else {"ok": False, "reason": reason},
+            **pinfo,
+        )
         if not ok:
             # structured up-front failure instead of letting an unhealthy
             # device wedge mid-section (BENCH_r05 perhost/scoring mode)
@@ -4318,6 +4646,8 @@ def _run_sections(names, extra, errors, on_tpu, state=None, after=None):
                 _bench_compile_reuse(extra, on_tpu)
             elif name == "compaction":
                 _bench_compaction(extra, on_tpu)
+            elif name == "fused_schedule":
+                _bench_fused_schedule(extra, on_tpu)
             elif name == "adaptive_schedule":
                 _bench_adaptive_schedule(extra, on_tpu)
             elif name == "plan_auto":
@@ -4499,6 +4829,11 @@ def main():
         # SPMD child of the perhost_streaming section (one process per
         # simulated host); same plain-return rule as --section
         _perhost_worker_main(sys.argv)
+        return
+    if "--merge-worker" in sys.argv:
+        # Gloo child of the fused_schedule section's merge comparator;
+        # same plain-return rule as --section
+        _merge_worker_main(sys.argv)
         return
     if "--elastic-worker" in sys.argv:
         # SPMD child of the elastic_reshard section (fresh-survivor and

@@ -350,10 +350,13 @@ class GameTrainingParams:
     # shapes share ~log(N) compiled solver executables
     shape_canonicalization: str = "off"
     # convergence-compacted random-effect solves (optim/scheduler.py):
-    # "off" | "on" | CHUNK — the vmapped per-entity solve runs in chunks of
-    # CHUNK iterations, unconverged lanes are repacked into ladder-sized
-    # batches between chunks, results are BITWISE-equal to the one-shot
-    # kernel. None defers to PHOTON_SOLVE_CHUNK (default off).
+    # "off" | "on" | CHUNK | "device[:CHUNK]" — the vmapped per-entity
+    # solve runs in chunks of CHUNK iterations, unconverged lanes are
+    # repacked into ladder-sized batches between chunks, results are
+    # BITWISE-equal to the one-shot kernel. "device" fuses the whole
+    # chunk→compact→resume cycle into one XLA program per ladder rung
+    # (optim/fused_schedule.py): host dispatches drop to O(#rungs), still
+    # bitwise. None defers to PHOTON_SOLVE_CHUNK (default off).
     solve_compaction: Optional[str] = None
     # gap-guided adaptive solve scheduling (optim/convergence.py): "off" |
     # "on" | TOL | "TOL:K" — streaming/bucketed random-effect coordinates
@@ -450,10 +453,13 @@ class GameTrainingParams:
         # gone — compaction composes with --distributed (GSPMD-sharded
         # chunk kernels) and with streaming (owner-computes per-host block
         # compaction), streaming subsumes --bucketed-random-effects with a
-        # recorded decision, and only the genuinely impossible pairs (host
-        # re-entry inside --fused-cycle's one-XLA-program iterations;
-        # --vmapped-grid true with chunk pauses) still error, raised by
-        # the plan itself so parser and drivers share one rule set.
+        # recorded decision, compaction under --fused-cycle promotes to
+        # the on-device rung loop (streaming gets one fused solve per
+        # block — cycle_fusion="solve"), and only the genuinely
+        # impossible pairs (--vmapped-grid true with chunk pauses;
+        # --adaptive-schedule's host-ordered block visits under
+        # --fused-cycle) still error, raised by the plan itself so parser
+        # and drivers share one rule set.
         # (--checkpoint-dir composes with streaming: the spilled state
         # checkpoints BY REFERENCE, SpilledREState.__checkpoint_ref__.)
         # a broken spec is reported AND normalized to "off" so the plan's
@@ -651,12 +657,15 @@ def build_training_parser() -> argparse.ArgumentParser:
            "per-entity solve in chunks, repacking unconverged lanes into "
            "ladder-sized batches between chunks (bitwise-equal results, "
            "straggler lanes stop burning whole-batch iterations): "
-           "off | on | CHUNK iterations per chunk (e.g. 8). Default defers "
-           "to PHOTON_SOLVE_CHUNK. Composes with --distributed "
-           "(GSPMD-sharded chunk kernels), --bucketed-random-effects, and "
-           "--streaming-random-effects incl. the multihost per-host path "
-           "(per-block owner-computes compaction); only --fused-cycle and "
-           "--vmapped-grid true cannot pause at chunk boundaries")
+           "off | on | CHUNK | device[:CHUNK] (the whole "
+           "chunk-compact-resume cycle inside ONE XLA program per ladder "
+           "rung — host dispatches drop to O(#rungs), results stay "
+           "bitwise). Default defers to PHOTON_SOLVE_CHUNK. Composes with "
+           "--distributed (GSPMD-sharded chunk kernels), "
+           "--bucketed-random-effects, --streaming-random-effects incl. "
+           "the multihost per-host path (per-block owner-computes "
+           "compaction), and --fused-cycle (promotes to the device loop); "
+           "only --vmapped-grid true cannot pause at chunk boundaries")
     a("--adaptive-schedule", default=None,
       help="gap-guided adaptive solve scheduling for streaming/bucketed "
            "random effects: visit blocks in descending convergence-score "

@@ -1199,7 +1199,7 @@ class GameTrainingDriver:
                 f"delta retrain [{n}]: classified unchanged but no warm "
                 "state could be built — re-solving instead of freezing"
             )
-        if out and self.params.fused_cycle:
+        if out and self.plan.cycle_fusion == "full":
             self.logger.info(
                 "delta retrain: --fused-cycle compiles every coordinate "
                 "into one program — frozen coordinates re-solve warm "
@@ -1419,7 +1419,12 @@ class GameTrainingDriver:
                 guard = DivergenceGuard(mode=p.divergence_guard)
             self.combo_coords.append(coords)
             cd = CoordinateDescent(
-                coords, loss_fn, scorer, evaluators, fused_cycle=p.fused_cycle,
+                coords, loss_fn, scorer, evaluators,
+                # full-cycle fusion only when the plan resolved it so:
+                # under compaction/streaming the flag promotes to per-solve
+                # fusion (cycle_fusion="solve", the device scheduler loop)
+                # and the descent loop itself stays host-side
+                fused_cycle=self.plan.cycle_fusion == "full",
                 divergence_guard=guard,
             )
             from photon_ml_tpu.utils.profiling import maybe_trace

@@ -25,7 +25,7 @@ Currently shimmed:
 
 from __future__ import annotations
 
-from typing import Any, Callable
+from typing import Any, Callable, Optional
 
 try:  # modern spelling (jax >= 0.6): stable, check_vma kwarg
     from jax import shard_map as _shard_map  # type: ignore[attr-defined]
@@ -151,6 +151,80 @@ def pallas_tpu_compiler_params(**kwargs: Any):
     if cls is None:  # ancient pallas: a bare dict is the accepted form
         return dict(kwargs)
     return cls(**kwargs)
+
+
+_FORCE_CPU_FLAG = "--xla_force_host_platform_device_count"
+
+
+def forced_cpu_device_count(flags: Optional[str] = None) -> Optional[int]:
+    """The CPU device count forced through ``XLA_FLAGS``
+    (``--xla_force_host_platform_device_count=N``), or ``None`` when the
+    flag is absent or malformed. The LAST occurrence wins, matching XLA's
+    own parse. Pass ``flags`` to inspect a specific string (a child
+    environment under construction); the default reads the process env
+    through the one overrides gate."""
+    if flags is None:
+        from photon_ml_tpu.compile import overrides
+
+        flags = overrides.env_read("XLA_FLAGS", "") or ""
+    count = None
+    for part in flags.split():
+        if part.startswith(_FORCE_CPU_FLAG + "="):
+            try:
+                count = int(part.split("=", 1)[1])
+            except ValueError:
+                return None
+    return count
+
+
+def backends_initialized() -> bool:
+    """Whether jax has already instantiated a PJRT backend — after which
+    ``XLA_FLAGS`` edits are silently ignored. Probes the backend registry
+    WITHOUT initializing it; when the registry moved (version skew), the
+    conservative answer is True (treat flags as latched)."""
+    try:
+        from jax._src import xla_bridge
+
+        return bool(xla_bridge._backends)
+    except (ImportError, AttributeError):
+        return True
+
+
+def force_cpu_devices(n: int) -> bool:
+    """Arrange for the host CPU platform to expose ``n`` devices by
+    pinning ``--xla_force_host_platform_device_count=n`` into
+    ``XLA_FLAGS`` (the multi-device-single-host mesh the psum merge arms
+    ride). XLA reads the flag exactly once, at backend instantiation, so:
+
+      * before jax initializes: rewrite the env (replacing any prior
+        occurrence of the flag) and return True;
+      * after jax initializes: an env edit is a silent no-op — return
+        whether the LIVE CPU backend already satisfies the request, so
+        the caller knows to skip or re-exec in a fresh subprocess (the
+        bench psum arm's structured ``preflight:`` skip).
+    """
+    import os
+
+    if n < 1:
+        raise ValueError(f"force_cpu_devices needs n >= 1, got {n}")
+    if backends_initialized():
+        import jax
+
+        try:
+            return len(jax.devices("cpu")) >= n
+        except RuntimeError:  # no CPU platform in this process's config
+            return False
+    if forced_cpu_device_count() == n:
+        return True
+    from photon_ml_tpu.compile import overrides
+
+    flags = overrides.env_read("XLA_FLAGS", "") or ""
+    parts = [
+        p for p in flags.split() if not p.startswith(_FORCE_CPU_FLAG + "=")
+    ]
+    parts.append(f"{_FORCE_CPU_FLAG}={n}")
+    os.environ["XLA_FLAGS"] = " ".join(parts)
+    return True
 
 
 def ensure_cpu_collectives() -> None:

@@ -156,7 +156,7 @@ class CostModel:
                 # the vmapped one-shot runs every lane to the slowest
                 # lane's budget — skew is paid in full
                 return lanes * hard
-            if action.startswith("chunk:"):
+            if action.startswith("chunk:") or action.startswith("device:"):
                 try:
                     c = max(int(action.split(":", 1)[1]), 1)
                 except ValueError:
@@ -166,7 +166,17 @@ class CostModel:
                 exec_cost = lanes * (
                     (1.0 - hard_frac) * per_easy + hard_frac * per_hard
                 )
+                # the pause tariff is POLICY-DEPENDENT: the host loop pays
+                # one dispatch per chunk of the straggler tail; the fused
+                # device loop (optim/fused_schedule.py) pays one per RUNG
+                # HOP — bounded by the ladder depth, however long the tail
                 pauses = math.ceil(hard / c)
+                if action.startswith("device:"):
+                    rung_hops = (
+                        max(math.ceil(math.log2(max(lanes / 8.0, 1.0))), 0)
+                        + 1
+                    )
+                    pauses = min(pauses, rung_hops)
                 return exec_cost + CHUNK_PAUSE_COST * pauses
         elif policy == "ladder":
             # off: ~one trace per distinct lane shape; on: ~log rungs of

@@ -30,6 +30,7 @@ __all__ = [
     "DTYPE_ENV",
     "LADDER_ENV",
     "PLAN_ENV",
+    "SOLVE_CHUNK_ENV",
     "SPARSE_TRANSPOSE_ENV",
     "Overrides",
     "donation_enabled",
@@ -38,6 +39,7 @@ __all__ = [
     "ladder_spec",
     "resolve_overrides",
     "resolve_plan_mode",
+    "solve_chunk_spec",
     "sparse_transpose_forced",
 ]
 
@@ -46,6 +48,7 @@ DTYPE_ENV = "PHOTON_ML_TPU_DTYPE"
 SPARSE_TRANSPOSE_ENV = "PHOTON_ML_TPU_SPARSE_TRANSPOSE"
 DONATE_ENV = "PHOTON_DONATE"
 LADDER_ENV = "PHOTON_SHAPE_LADDER"
+SOLVE_CHUNK_ENV = "PHOTON_SOLVE_CHUNK"
 
 _FALSEY = ("0", "false", "off", "no")
 
@@ -96,6 +99,13 @@ def ladder_spec() -> Optional[str]:
     """Raw ``PHOTON_SHAPE_LADDER`` value (grammar parsed by
     canonical.resolve_bucketer, which owns the ladder vocabulary)."""
     return env_read(LADDER_ENV)
+
+
+def solve_chunk_spec() -> Optional[str]:
+    """Raw ``PHOTON_SOLVE_CHUNK`` value (grammar — ``off`` | ``on`` |
+    ``CHUNK`` | ``device[:CHUNK]`` — parsed by scheduler.resolve_schedule,
+    which owns the schedule vocabulary)."""
+    return env_read(SOLVE_CHUNK_ENV)
 
 
 @dataclasses.dataclass(frozen=True)

@@ -17,9 +17,15 @@ where skewed convergence and sparse rows pay most.
 :class:`ExecutionPlan` replaces the lattice with one resolution:
 
   * **impossible** pairs raise :class:`PlanError` at resolve time (kept
-    fences, each pinned by a test): anything that must re-enter the host
-    mid-solve — compacted chunk pauses, streaming block loads — cannot
-    live inside ``--fused-cycle``'s one-XLA-program-per-iteration.
+    fences, each pinned by a test): host-side loops — chunk pauses, the
+    adaptive block-visitation loop — cannot live inside ``--vmapped-grid
+    true``'s compiled grid cycle. The historical ``--fused-cycle`` x
+    {compaction, streaming} fences are GONE (PR 19): compaction under
+    ``--fused-cycle`` promotes to the fused DEVICE loop
+    (optim/fused_schedule.py — the whole chunk→compact→resume cycle is
+    one XLA program per ladder rung), and streaming under
+    ``--fused-cycle`` hands each block one fused solve; both land as
+    recorded decisions with ``cycle_fusion="solve"``.
   * **subsumed** pairs resolve to the stronger policy with a recorded
     :class:`PlanDecision` (streaming already sorts entities into
     tightly-padded size blocks, so ``--bucketed-random-effects`` is
@@ -119,6 +125,13 @@ class ExecutionPlan:
     prefetch_depth: Optional[int] = None
     streaming: bool = False
     fused_cycle: bool = False
+    # what --fused-cycle resolved TO: "off" (flag unset), "full" (the
+    # whole descent cycle is one XLA program — CoordinateDescent's
+    # fused branch), or "solve" (a host loop remains — streaming blocks
+    # or rung hops — and fusion applies per solve through the device
+    # scheduler loop). Drivers gate CoordinateDescent(fused_cycle=...)
+    # on cycle_fusion == "full", never on the raw flag.
+    cycle_fusion: str = "off"
     num_processes: int = 1
     # the entity-shard plan version this run executes under (elastic
     # re-sharding, parallel/elastic.py): 1 for a fresh topology; every
@@ -219,19 +232,38 @@ class ExecutionPlan:
                 predicted_cost=predicted,
             ))
 
+        # ---- whole-cycle fusion: promotion, not fences (PR 19) ------------
+        # the --fused-cycle x {compaction, streaming} fences are DELETED:
+        # the device scheduler loop (optim/fused_schedule.py) runs the
+        # chunk→compact→resume cycle inside XLA, so nothing re-enters the
+        # host mid-solve anymore
+        cycle_fusion = "off"
+        if fused_cycle:
+            cycle_fusion = "full"
+            if schedule is not None:
+                schedule = dataclasses.replace(schedule, loop="device")
+                cycle_fusion = "solve"
+                decisions.append(PlanDecision(
+                    "schedule", "composed",
+                    "--solve-compaction under --fused-cycle promotes to "
+                    "the fused DEVICE loop (optim/fused_schedule.py): the "
+                    "whole chunk→compact→resume cycle compiles into one "
+                    "XLA program per ladder rung, so no chunk pause "
+                    "re-enters the host; cycle fusion applies per solve, "
+                    "results bitwise vs the host chunk loop",
+                ))
+            if streaming:
+                cycle_fusion = "solve"
+                decisions.append(PlanDecision(
+                    "fused-cycle", "composed",
+                    "--streaming-random-effects streams blocks through "
+                    "the host per evaluation, so the descent cycle cannot "
+                    "be ONE program; the block loop hands each block one "
+                    "fused solve instead (cycle fusion at solve "
+                    "granularity)",
+                ))
+
         # ---- impossible pairs (the fences the plan KEEPS) -----------------
-        if fused_cycle and schedule is not None:
-            raise PlanError(
-                "--solve-compaction pauses the solve at chunk "
-                "boundaries; --fused-cycle (one XLA program per "
-                "iteration) cannot compose"
-            )
-        if fused_cycle and streaming:
-            raise PlanError(
-                "--streaming-random-effects streams per evaluation; "
-                "--fused-cycle (one XLA program per iteration) cannot "
-                "compose"
-            )
         if vmapped_grid == "true" and schedule is not None:
             raise PlanError(
                 "--vmapped-grid true cannot compose with "
@@ -350,6 +382,7 @@ class ExecutionPlan:
             prefetch_depth=prefetch_depth,
             streaming=streaming,
             fused_cycle=fused_cycle,
+            cycle_fusion=cycle_fusion,
             num_processes=max(int(num_processes), 1),
             plan_mode=overrides.plan_mode,
             overrides=overrides,
@@ -402,31 +435,41 @@ class ExecutionPlan:
         from photon_ml_tpu.io.pipeline import DEFAULT_DEPTH
 
         # solve-chunk size: the biggest measured lever (PR 4's 71% and the
-        # compaction bench both live here). Respect the fused-cycle /
-        # vmapped-grid fences — the planner must not resolve into a
-        # PlanError the explicit path would have refused.
-        chunk_allowed = not fused_cycle and vmapped_grid != "true"
-        if schedule is None and chunk_allowed:
+        # compaction bench both live here). Respect the vmapped-grid fence
+        # — the planner must not resolve into a PlanError the explicit
+        # path would have refused. Under --fused-cycle the host chunk
+        # loop's pauses cannot compose, but the fused DEVICE loop can:
+        # the candidate set narrows to one-shot vs device, each with its
+        # own pause prior (dispatches-per-rung, not per-chunk).
+        if schedule is None and vmapped_grid != "true":
+            candidates = (
+                ("one-shot", "device:8", "device:16")
+                if fused_cycle
+                else ("one-shot", "chunk:2", "chunk:4", "chunk:8",
+                      "chunk:16", "chunk:32", "device:8", "device:16")
+            )
             action, predicted, reason = model.choose(
-                "schedule",
-                ("one-shot", "chunk:2", "chunk:4", "chunk:8", "chunk:16",
-                 "chunk:32"),
-                profile,
+                "schedule", candidates, profile,
             )
             if action.startswith("chunk:"):
                 schedule = resolve_schedule(action.split(":", 1)[1])
+            elif action.startswith("device:"):
+                schedule = resolve_schedule(action)
             decisions.append(PlanDecision(
                 "schedule", f"planned:{action}", reason,
                 predicted_cost=predicted,
             ))
         elif schedule is not None:
+            spelled = (
+                f"device:{schedule.chunk_size}"
+                if schedule.loop == "device"
+                else f"chunk:{schedule.chunk_size}"
+            )
             decisions.append(PlanDecision(
                 "schedule", "pinned",
-                f"--solve-compaction={schedule.chunk_size} set explicitly "
+                f"--solve-compaction={spelled} set explicitly "
                 "— the planner defers to the hand-tuned value",
-                predicted_cost=model.predict(
-                    "schedule", f"chunk:{schedule.chunk_size}", profile
-                ),
+                predicted_cost=model.predict("schedule", spelled, profile),
             ))
 
         # shape ladder
@@ -549,6 +592,8 @@ class ExecutionPlan:
             f"sparse={self.sparse_kernel or 'off'}",
             f"streaming={'on' if self.streaming else 'off'}",
         ]
+        if self.fused_cycle:
+            parts.append(f"fused-cycle={self.cycle_fusion}")
         if self.plan_mode != "off":
             parts.append(
                 f"plan={self.plan_mode}"

@@ -35,7 +35,10 @@ the lane-iteration ledger in :data:`solve_stats` (the CompileStats
 pattern); drivers log ``solve_stats.summary()`` next to the compile stats.
 
 Env control: ``PHOTON_SOLVE_CHUNK`` = ``off`` (default) | ``on`` | K
-(chunk size), the same resolve pattern as ``PHOTON_SHAPE_LADDER``.
+(chunk size) | ``device[:K]`` (the fused on-device loop,
+optim/fused_schedule.py), read via the one env gate
+(``compile/overrides.py``), the same resolve pattern as
+``PHOTON_SHAPE_LADDER``.
 
 Composition (photon_ml_tpu.compile.plan resolves it once per run): the
 chunk kernels take their data as pytree ARGUMENTS, so the same host loop
@@ -43,15 +46,18 @@ drives unsharded solves, GSPMD entity-sharded solves (the mesh path:
 sharded operands partition the vmapped lanes across devices; this loop
 never enters the mesh program), and the per-host streaming block solves
 (owner-computes: each host compacts its owned blocks independently —
-the billion-coefficient path). The only non-compositions are the ones
-with no host boundary to pause at (``--fused-cycle``, the compiled
-traced-lambda grid cycle), raised loudly by the plan.
+the billion-coefficient path). Contexts with no host boundary to pause
+at (``--fused-cycle``, the compiled traced-lambda grid cycle) run the
+DEVICE loop instead: optim/fused_schedule.py fuses the whole
+chunk→compact→resume cycle into one XLA program per ladder rung, so the
+plan promotes the schedule rather than fencing it (only the
+``--vmapped-grid true`` fence remains).
 """
 
 from __future__ import annotations
 
 import dataclasses
-import os
+import logging
 import threading
 from typing import List, Optional
 
@@ -66,7 +72,8 @@ from photon_ml_tpu.resilience import preemption
 
 Array = jax.Array
 
-_CHUNK_ENV = "PHOTON_SOLVE_CHUNK"
+logger = logging.getLogger(__name__)
+
 DEFAULT_CHUNK = 8
 
 # reason code stamped on ladder-pad lanes so the chunk while_loop freezes
@@ -85,20 +92,33 @@ class SolveSchedule:
     ``bucketer`` — the ladder compacted lane counts round up to, so every
     chunk/gather/scatter executable is shared across compaction steps (and
     across blocks/buckets that land on the same rung).
+
+    ``loop`` — ``"host"`` (this module's chunk loop, the default) or
+    ``"device"`` (optim/fused_schedule.py: the whole chunk→compact→resume
+    cycle fused into one XLA program per ladder rung; host dispatches
+    drop from O(max_iter/chunk) to O(#rungs), results stay bitwise).
     """
 
     chunk_size: int = DEFAULT_CHUNK
     bucketer: ShapeBucketer = ShapeBucketer()
+    loop: str = "host"
 
     def __post_init__(self):
         if self.chunk_size < 1:
             raise ValueError(
                 f"solve-compaction chunk size must be >= 1, got {self.chunk_size}"
             )
+        if self.loop not in ("host", "device"):
+            raise ValueError(
+                f"solve-compaction loop must be 'host' or 'device', "
+                f"got {self.loop!r}"
+            )
 
     def describe(self) -> str:
+        loop = f"loop={self.loop}, " if self.loop != "host" else ""
         return (
-            f"compaction(chunk={self.chunk_size}, {self.bucketer.describe()})"
+            f"compaction(chunk={self.chunk_size}, {loop}"
+            f"{self.bucketer.describe()})"
         )
 
 
@@ -110,12 +130,15 @@ def resolve_schedule(
 
     Accepted spellings (driver flag and env var share them):
     ``off``/``false``/``0`` -> None; ``on``/``true`` -> default chunk; a
-    positive integer -> that chunk size.
+    positive integer -> that chunk size; ``device`` or ``device:CHUNK``
+    -> the fused on-device loop (optim/fused_schedule.py).
     """
     if isinstance(spec, SolveSchedule):
         return spec
     if spec is None:
-        raw = os.environ.get(_CHUNK_ENV)
+        from photon_ml_tpu.compile.overrides import solve_chunk_spec
+
+        raw = solve_chunk_spec()
         if raw is None:
             return None
         return resolve_schedule(raw)
@@ -128,12 +151,22 @@ def resolve_schedule(
         return None
     if text in ("on", "true", "default"):
         return SolveSchedule()
+    if text == "device":
+        return SolveSchedule(loop="device")
+    if text.startswith("device:"):
+        inner = resolve_schedule(text.split(":", 1)[1])
+        if inner is None:
+            raise ValueError(
+                f"bad solve-compaction spec {spec!r}: 'device:' needs a "
+                "chunk size (the device loop has no 'off' half)"
+            )
+        return dataclasses.replace(inner, loop="device")
     try:
         chunk = int(text)
     except ValueError as e:
         raise ValueError(
-            f"bad solve-compaction spec {spec!r} (want off | on | CHUNK, "
-            f"e.g. 8): {e}"
+            f"bad solve-compaction spec {spec!r} (want off | on | CHUNK | "
+            f"device[:CHUNK], e.g. 8 or device:8): {e}"
         ) from e
     if chunk < 1:
         raise ValueError(
@@ -160,7 +193,12 @@ class ChunkRecord:
 
 @dataclasses.dataclass
 class SolveRecord:
-    """Lane-iteration ledger of one compacted solve."""
+    """Lane-iteration ledger of one compacted solve.
+
+    ``chunks`` records one entry per HOST DISPATCH — every chunk on the
+    host loop, every rung hop on the device loop (optim/fused_schedule
+    .py), where the in-program chunk iterations additionally land on
+    ``device_chunks`` (0 on the host loop)."""
 
     label: str
     lanes: int  # entity lanes in the full problem
@@ -168,10 +206,18 @@ class SolveRecord:
     executed: int  # sum over chunks of batch_lanes * advanced
     baseline: int  # lanes * max_iteration: the one-shot vmapped burn
     chunks: List[ChunkRecord]
+    device_chunks: int = 0  # chunk iterations run INSIDE fused rung programs
 
     @property
     def saved(self) -> int:
         return self.baseline - self.executed
+
+    @property
+    def dispatches(self) -> int:
+        """Host dispatches this solve paid (the pause-tariff unit in
+        compile/cost.py): chunk dispatches on the host loop, rung hops on
+        the device loop."""
+        return len(self.chunks)
 
 
 class SolveStats:
@@ -194,7 +240,7 @@ class SolveStats:
         self._lock = threading.Lock()
         self._counters = dict.fromkeys(
             ("solves", "lanes", "executed", "baseline", "chunks",
-             "blocks_visited", "blocks_skipped"), 0
+             "device_chunks", "blocks_visited", "blocks_skipped"), 0
         )
         self._worst: Optional[SolveRecord] = None
         self._recent: List[SolveRecord] = []
@@ -207,6 +253,7 @@ class SolveStats:
             self._counters["executed"] += rec.executed
             self._counters["baseline"] += rec.baseline
             self._counters["chunks"] += len(rec.chunks)
+            self._counters["device_chunks"] += rec.device_chunks
             if self._worst is None or rec.baseline > self._worst.baseline:
                 self._worst = rec
             self._recent.append(rec)
@@ -261,14 +308,18 @@ class SolveStats:
                     self._counters["baseline"] - self._counters["executed"]
                 ),
                 "chunk_dispatches": self._counters["chunks"],
+                "device_chunk_iterations": self._counters["device_chunks"],
             }
 
     def realized_plan_cost(self) -> Optional[float]:
         """This run's solve ledger in planner cost units (compile/cost.py):
-        executed lane-iterations plus the host-pause tariff per chunk
-        dispatch — the realized cost :meth:`ExecutionPlan.record_realized`
-        feeds back into the cost model's schedule predictions. None when
-        no solves ran (nothing to learn from)."""
+        executed lane-iterations plus the host-pause tariff per HOST
+        dispatch — every chunk on the host loop, every rung hop on the
+        device loop (in-program chunk iterations pause nothing and pay no
+        tariff: the policy-dependent pricing the device prior predicts).
+        The realized cost :meth:`ExecutionPlan.record_realized` feeds back
+        into the cost model's schedule predictions. None when no solves
+        ran (nothing to learn from)."""
         from photon_ml_tpu.compile.cost import CHUNK_PAUSE_COST
 
         with self._lock:
@@ -534,6 +585,17 @@ def compacted_solve(
     ``resume`` continues the solve bitwise-identically (resumed batches
     restart uncompacted and re-compact at the next pause — lane arithmetic
     is batch-independent, so results are unchanged).
+
+    ``schedule.loop == "device"`` routes the solve through the fused
+    on-device loop (optim/fused_schedule.py) instead — same bitwise
+    results, O(#rungs) host dispatches. The ``optim.device_drain`` fault
+    site guards that dispatch: ANY failure inside the fused path (an
+    injected fault, or a real XLA/runtime error) degrades THIS solve to
+    the host chunk loop below, which recomputes from scratch — lane
+    arithmetic is batch-independent, so the degraded results are still
+    bitwise. Preemption is never a failure: a device-loop
+    :class:`~photon_ml_tpu.resilience.preemption.Preempted` propagates
+    with its rung-boundary snapshot intact.
     """
     cfg = dict(
         task=task,
@@ -541,6 +603,25 @@ def compacted_solve(
         optimizer_config=optimizer_config,
         regularization=regularization,
     )
+    if schedule.loop == "device":
+        from photon_ml_tpu.optim import fused_schedule
+        from photon_ml_tpu.resilience import faults
+
+        try:
+            faults.inject(
+                "optim.device_drain", label=label, lanes=int(w0.shape[0])
+            )
+            return fused_schedule.device_solve(
+                data, w0, schedule=schedule, label=label, resume=resume,
+                **cfg,
+            )
+        except preemption.Preempted:
+            raise
+        except Exception as e:  # noqa: BLE001 — ANY device-loop failure means the fused program is untrusted; the host chunk loop is the bitwise-safe degrade
+            logger.warning(
+                "fused device solve (%s) failed (%s: %s); degrading to "
+                "the host chunk loop", label, type(e).__name__, e,
+            )
     lanes = int(w0.shape[0])
     max_iter = optimizer_config.max_iterations
     chunk = schedule.chunk_size

@@ -43,6 +43,7 @@ from photon_ml_tpu.parallel.perhost_streaming import (
     PerHostStreamingRandomEffectCoordinate,
     build_perhost_streaming_manifest,
     merge_disjoint,
+    merge_disjoint_devices,
 )
 
 __all__ = [
@@ -71,4 +72,5 @@ __all__ = [
     "PerHostStreamingRandomEffectCoordinate",
     "build_perhost_streaming_manifest",
     "merge_disjoint",
+    "merge_disjoint_devices",
 ]

@@ -146,6 +146,59 @@ def merge_disjoint(arr: np.ndarray, ctx: Optional[MeshContext],
     return out.reshape(a.shape)
 
 
+def merge_disjoint_devices(shards, ctx: MeshContext) -> np.ndarray:
+    """The multi-device-single-host form of :func:`merge_disjoint`: exact
+    merge of per-DEVICE disjoint partials over a local device mesh with
+    ONE in-program ``shard_map`` + ``lax.psum`` — no file barrier, no Gloo
+    process group, no host-side fold at all (the DrJAX mapped-reduce
+    framing, arXiv:2403.07128). ``shards`` is ``(n_dev, ...)`` with every
+    element written by at most one device (zeros elsewhere), so the psum
+    adds each value to zeros — the IEEE identity — and the result is
+    bitwise-equal to merge_disjoint's host-side fold of the same
+    partials, on any device count and in any reduction order.
+
+    The mesh is typically the FORCED CPU mesh
+    (``compat.force_cpu_devices`` /
+    ``--xla_force_host_platform_device_count``) standing in for a real
+    accelerator mesh on a dev box; the same fault site as the host merge
+    (``multihost.streaming_reduce``) fires before the collective, so one
+    chaos plan covers both merge paths.
+    """
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from photon_ml_tpu import compat, resilience
+    from photon_ml_tpu.resilience import faults
+
+    a = np.asarray(shards)
+    n = ctx.num_devices
+    if a.ndim < 1 or a.shape[0] != n:
+        raise ValueError(
+            f"merge_disjoint_devices wants one leading shard per mesh "
+            f"device: got shape {a.shape} on a {n}-device mesh"
+        )
+
+    def enter() -> None:
+        faults.inject(
+            "multihost.streaming_reduce",
+            shape=tuple(a.shape), processes=n, path="device",
+        )
+
+    resilience.call_with_retry(
+        enter, resilience.current_config().io_policy,
+        describe="device streaming reduce",
+    )
+    if n == 1:
+        return a[0].copy()
+    g = jax.device_put(a, NamedSharding(ctx.mesh, P(ctx.axis)))
+    merged = jax.jit(  # jit-ok: one-shot exact-merge collective, inputs are live partials (nothing to donate)
+        compat.shard_map(
+            lambda s: jax.lax.psum(s[0], ctx.axis),
+            mesh=ctx.mesh, in_specs=P(ctx.axis), out_specs=P(),
+        )
+    )(g)
+    return np.asarray(jax.device_get(merged))
+
+
 def agree_entity_counts(
     raw_ids: Sequence[str],
     ctx: Optional[MeshContext],

@@ -44,6 +44,7 @@ FAULT_SITES: Dict[str, str] = {
     "io.perhost_block_write": "per-host streaming entity-block writes (parallel/perhost_streaming.py)",
     "optim.step": "coordinate-descent updates, NaN corruption (algorithm/coordinate_descent.py)",
     "optim.block_skip": "adaptive-schedule skip decision boundary; an injected fault degrades the epoch to visit-everything, never a silent skip (algorithm/streaming_random_effect.py, algorithm/bucketed_random_effect.py)",
+    "optim.device_drain": "fused device-loop dispatch gate; an injected fault degrades the solve to the host chunk loop, bitwise (optim/scheduler.py)",
     "preempt.signal": "preemption polls; flags instead of raising (resilience/preemption.py)",
     "serve.dequant": "quantized-store open gate: scale-sidecar/budget validation before a bf16/int8 slab may serve (serve/model_store.py)",
     "serve.route": "fleet router request-routing entry (serve/fleet/router.py)",
@@ -61,4 +62,5 @@ PREEMPT_SITES: Tuple[str, ...] = (
     "block",  # streaming random-effect block boundary
     "chunk",  # compacted-solver chunk boundary (optim/scheduler.py)
     "bucket",  # scheduled bucketed-RE bucket boundary (algorithm/bucketed_random_effect.py)
+    "rung",  # fused device-loop rung-hop boundary (optim/fused_schedule.py)
 )

@@ -23,6 +23,7 @@ def test_list_sections_enumerates_all_sections():
     assert sections == [
         "dense", "sparse", "sparse_race", "game", "game5", "grid",
         "streaming", "streaming_pipeline", "compile_reuse", "compaction",
+        "fused_schedule",
         "adaptive_schedule",
         "plan_auto",
         "preemption_resume",

@@ -1,0 +1,80 @@
+"""compat forced-CPU-mesh helpers: the multi-device-single-host story
+(``merge_disjoint_devices``, the bench psum arm) rides
+``--xla_force_host_platform_device_count``, which XLA reads exactly once
+at backend instantiation — these helpers are how callers detect the flag,
+detect the latch, and pin the flag safely before it latches.
+
+The test process itself runs on the conftest-forced 8-device CPU mesh
+(tests/conftest.py sets XLA_FLAGS before any jax import), which doubles
+as the live-backend fixture for the post-init branches below.
+"""
+
+import os
+
+import pytest
+
+import jax
+
+from photon_ml_tpu import compat
+
+FLAG = "--xla_force_host_platform_device_count"
+
+
+class TestForcedCpuDeviceCount:
+    def test_absent_flag_is_none(self):
+        assert compat.forced_cpu_device_count(flags="") is None
+        assert compat.forced_cpu_device_count(flags="--foo=1 --bar") is None
+
+    def test_parses_count(self):
+        assert compat.forced_cpu_device_count(flags=f"{FLAG}=4") == 4
+        assert (
+            compat.forced_cpu_device_count(flags=f"--foo=1 {FLAG}=12 --bar")
+            == 12
+        )
+
+    def test_last_occurrence_wins(self):
+        # XLA's own parse keeps the last value; the helper must agree
+        assert (
+            compat.forced_cpu_device_count(flags=f"{FLAG}=2 {FLAG}=6") == 6
+        )
+
+    def test_malformed_value_is_none(self):
+        assert compat.forced_cpu_device_count(flags=f"{FLAG}=lots") is None
+
+    def test_default_reads_process_env(self):
+        # conftest.py forces the 8-device CPU mesh for the whole suite
+        assert compat.forced_cpu_device_count() == 8
+
+
+class TestForceCpuDevices:
+    def test_rejects_nonpositive(self):
+        with pytest.raises(ValueError, match="n >= 1"):
+            compat.force_cpu_devices(0)
+
+    def test_post_init_reports_live_backend(self):
+        # jax is long since initialized here: the env is latched, so the
+        # answer is whether the LIVE backend satisfies the request
+        assert compat.backends_initialized()
+        assert compat.force_cpu_devices(8) is True
+        assert compat.force_cpu_devices(2) is True  # 8 >= 2
+        assert compat.force_cpu_devices(64) is False
+
+    def test_pre_init_rewrites_env(self, monkeypatch):
+        monkeypatch.setattr(compat, "backends_initialized", lambda: False)
+        monkeypatch.setenv("XLA_FLAGS", f"--foo=1 {FLAG}=2")
+        assert compat.force_cpu_devices(4) is True
+        # prior occurrence replaced, unrelated flags preserved
+        assert os.environ["XLA_FLAGS"] == f"--foo=1 {FLAG}=4"
+
+    def test_pre_init_matching_flag_is_untouched(self, monkeypatch):
+        monkeypatch.setattr(compat, "backends_initialized", lambda: False)
+        monkeypatch.setenv("XLA_FLAGS", f"{FLAG}=4 --foo=1")
+        assert compat.force_cpu_devices(4) is True
+        # already pinned at the requested count: no rewrite at all
+        assert os.environ["XLA_FLAGS"] == f"{FLAG}=4 --foo=1"
+
+
+def test_forced_mesh_is_live_in_this_process():
+    # the helpers' promise end-to-end: the flag conftest pinned is the
+    # mesh this process actually got
+    assert len(jax.devices("cpu")) == 8

@@ -152,16 +152,23 @@ class TestPlanOffBitwise:
                   if d.policy == "schedule" and d.action == "pinned"]
         assert len(pinned) == 1  # audited, not overridden
 
-    def test_auto_respects_fused_cycle_fence(self):
-        # the planner must not resolve INTO a PlanError the explicit path
-        # would refuse: under fused_cycle it never proposes a chunk
+    def test_auto_under_fused_cycle_plans_device_not_chunk(self):
+        # the planner must not resolve INTO a combination the explicit
+        # path would refuse: under fused_cycle the host chunk loop's
+        # pauses cannot compose, so it never proposes a chunk — but the
+        # fused DEVICE loop can, and on a skewed workload it wins
         p = ExecutionPlan.resolve(
             plan="auto", workload=SKEWED, fused_cycle=True,
         )
-        assert p.schedule is None
         assert not [d for d in p.decisions
                     if d.policy == "schedule"
                     and d.action.startswith("planned:chunk")]
+        assert p.schedule is not None and p.schedule.loop == "device"
+        assert p.cycle_fusion == "solve"
+        planned = [d for d in p.decisions
+                   if d.policy == "schedule"
+                   and d.action.startswith("planned:device")]
+        assert len(planned) == 1
 
 
 class TestSidecarCorruption:

@@ -43,11 +43,38 @@ class TestPlanResolution:
         assert p.schedule.bucketer is p.bucketer
         assert p.sparse_kernel == "segment"
 
-    def test_fused_cycle_fences_are_plan_errors(self):
-        with pytest.raises(PlanError, match="fused-cycle"):
-            ExecutionPlan.resolve(solve_compaction="on", fused_cycle=True)
-        with pytest.raises(PlanError, match="fused-cycle"):
-            ExecutionPlan.resolve(streaming=True, fused_cycle=True)
+    def test_fused_cycle_compaction_promotes_to_device_loop(self):
+        """The historical --fused-cycle x --solve-compaction fence is
+        DELETED (PR 19): compaction promotes to the fused device loop
+        (optim/fused_schedule.py) with a recorded composed decision, and
+        cycle fusion applies per solve."""
+        p = ExecutionPlan.resolve(solve_compaction="on", fused_cycle=True)
+        assert p.schedule is not None and p.schedule.loop == "device"
+        assert p.cycle_fusion == "solve"
+        composed = [d for d in p.decisions
+                    if d.policy == "schedule" and d.action == "composed"]
+        assert len(composed) == 1
+        assert "fused_schedule" in composed[0].reason
+
+    def test_fused_cycle_streaming_composes_per_block_solves(self):
+        """The --fused-cycle x --streaming fence is DELETED too: the host
+        block loop survives and hands each block one fused solve
+        (cycle_fusion="solve"), recorded as a composed decision."""
+        p = ExecutionPlan.resolve(streaming=True, fused_cycle=True)
+        assert p.cycle_fusion == "solve"
+        composed = [d for d in p.decisions
+                    if d.policy == "fused-cycle" and d.action == "composed"]
+        assert len(composed) == 1
+        assert "one" in composed[0].reason and "fused solve" in composed[0].reason
+
+    def test_cycle_fusion_resolution_states(self):
+        assert ExecutionPlan.resolve().cycle_fusion == "off"
+        assert ExecutionPlan.resolve(fused_cycle=True).cycle_fusion == "full"
+        # explicit device loop WITHOUT --fused-cycle: just a schedule mode
+        p = ExecutionPlan.resolve(solve_compaction="device:4")
+        assert p.schedule.loop == "device"
+        assert p.schedule.chunk_size == 4
+        assert p.cycle_fusion == "off"
 
     def test_vmapped_grid_true_fence(self):
         with pytest.raises(PlanError, match="--vmapped-grid true"):

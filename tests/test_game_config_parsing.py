@@ -215,12 +215,20 @@ class TestSolveCompactionFlag:
         with pytest.raises(ValueError, match="solve-compaction"):
             self._parse("--solve-compaction", "sideways")
 
-    def test_fused_cycle_fence(self):
-        """The ONE genuinely impossible pair the execution plan keeps:
-        chunk pauses re-enter the host, --fused-cycle is one XLA program
-        per iteration (pinned — this fence is proven, not assumed)."""
-        with pytest.raises(ValueError, match="fused-cycle"):
-            self._parse("--solve-compaction", "on", "--fused-cycle", "true")
+    def test_fused_cycle_promotes_to_device_loop(self):
+        """The --solve-compaction x --fused-cycle fence is DELETED: the
+        plan promotes the schedule to the on-device rung loop
+        (optim/fused_schedule.py) so no chunk pause re-enters the host —
+        the combination parses and resolves with cycle_fusion='solve'."""
+        p = self._parse("--solve-compaction", "on", "--fused-cycle", "true")
+        assert p.fused_cycle and p.solve_compaction == "on"
+        from photon_ml_tpu.compile.plan import ExecutionPlan
+
+        plan = ExecutionPlan.resolve(
+            solve_compaction=p.solve_compaction, fused_cycle=True
+        )
+        assert plan.schedule.loop == "device"
+        assert plan.cycle_fusion == "solve"
 
     def test_distributed_composes(self):
         """The --solve-compaction x --distributed fence is DELETED: the
@@ -239,12 +247,13 @@ class TestSolveCompactionFlag:
     def test_spec_error_and_fence_reported_together(self):
         """validate() keeps its report-everything-at-once contract: a bad
         ladder spec is normalized to 'off' for the fence checks, so the
-        spec error AND the streaming x fused-cycle fence land in ONE
-        error list instead of surfacing across two runs."""
+        spec error AND the adaptive-schedule x fused-cycle fence (a pair
+        the plan still keeps) land in ONE error list instead of surfacing
+        across two runs."""
         with pytest.raises(ValueError) as ei:
             self._parse(
                 "--shape-canonicalization", "sideways",
-                "--streaming-random-effects", "true",
+                "--adaptive-schedule", "1e-2",
                 "--fused-cycle", "true",
             )
         msg = str(ei.value)

@@ -46,6 +46,7 @@ from photon_ml_tpu.parallel.perhost_streaming import (
     PerHostStreamingRandomEffectCoordinate,
     build_perhost_streaming_manifest,
     merge_disjoint,
+    merge_disjoint_devices,
 )
 from photon_ml_tpu.types import OptimizerType, TaskType
 
@@ -254,6 +255,52 @@ class TestFaultSites:
         np.testing.assert_array_equal(merge_disjoint(a, mesh_ctx, 1), a)
 
 
+class TestDeviceMerge:
+    """merge_disjoint_devices: the in-program shard_map+psum merge over
+    the conftest-forced multi-device CPU mesh is bitwise-equal to the
+    host-side fold of the same disjoint partials."""
+
+    def _disjoint_shards(self, n_dev, rows=64, dim=5, seed=3):
+        rng = np.random.default_rng(seed)
+        full = rng.normal(size=(rows, dim)).astype(np.float32)
+        owners = rng.integers(0, n_dev, size=rows)
+        shards = np.zeros((n_dev, rows, dim), np.float32)
+        shards[owners, np.arange(rows)] = full
+        return shards, full
+
+    def test_psum_merge_bitwise_vs_host_fold(self, mesh_ctx):
+        shards, full = self._disjoint_shards(mesh_ctx.num_devices)
+        out = merge_disjoint_devices(shards, mesh_ctx)
+        assert out.dtype == np.float32
+        # disjoint partials: psum adds each value to zeros (the IEEE
+        # identity), so the merge IS the original — and bitwise-equal to
+        # the host fold merge_disjoint performs over the same partials
+        np.testing.assert_array_equal(out, full)
+        host = np.zeros_like(full)
+        for s in shards:
+            host = host + s
+        np.testing.assert_array_equal(out, host)
+
+    def test_wrong_leading_shape_raises(self, mesh_ctx):
+        bad = np.zeros((mesh_ctx.num_devices + 1, 4), np.float32)
+        with pytest.raises(ValueError, match="leading shard"):
+            merge_disjoint_devices(bad, mesh_ctx)
+
+    def test_single_device_mesh_is_identity(self):
+        ctx1 = MeshContext(data_mesh(n_devices=1))
+        a = np.random.default_rng(5).normal(size=(1, 7)).astype(np.float32)
+        np.testing.assert_array_equal(merge_disjoint_devices(a, ctx1), a[0])
+
+    def test_device_merge_fault_retried(self, mesh_ctx, monkeypatch):
+        # the DEVICE merge rides the same multihost.streaming_reduce fault
+        # site as the host merge: one chaos plan covers both paths
+        monkeypatch.setenv("PHOTON_FAULTS", "multihost.streaming_reduce:at=1")
+        shards, full = self._disjoint_shards(mesh_ctx.num_devices, seed=9)
+        np.testing.assert_array_equal(
+            merge_disjoint_devices(shards, mesh_ctx), full
+        )
+
+
 class TestShardScopedCache:
     """Satellite: per-host cache entries on a shared filesystem must not
     collide or cross-read — the shard scope is folded into every key."""
@@ -339,13 +386,15 @@ class TestParams:
         except ValueError as e:  # pragma: no cover - regression guard
             _pytest.fail(f"streaming x distributed fence resurfaced: {e}")
 
-    def test_streaming_fused_cycle_fence_stays(self):
-        """Genuinely impossible (host streaming inside one XLA program) —
-        the execution plan keeps this fence, pinned here."""
-        with pytest.raises(ValueError, match="fused-cycle|fused_cycle"):
-            self._parse(
-                "--streaming-random-effects", "true", "--fused-cycle", "true"
-            )
+    def test_streaming_fused_cycle_fence_deleted(self):
+        """The streaming x fused-cycle fence is DELETED: the block loop
+        hands each block one fused solve (cycle fusion at solve
+        granularity — tests/test_exec_plan.py pins the plan decision), so
+        the CLI combination parses."""
+        p = self._parse(
+            "--streaming-random-effects", "true", "--fused-cycle", "true"
+        )
+        assert p.streaming_random_effects and p.fused_cycle
 
     def test_streaming_bucketed_subsumed_not_fenced(self):
         """The streaming x bucketed fence is DELETED: streaming already

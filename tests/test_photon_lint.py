@@ -143,6 +143,22 @@ def test_default_scope_covers_the_hot_paths():
     assert any(p.startswith("tools/photon_lint/") for p in scanned)
 
 
+def test_fused_schedule_in_scan_scope():
+    """The fused device loop (PR 19) is inside the default scan scope — a
+    bare jit, an unjustified whole-batch reduce, or an unregistered fault
+    site in the rung program cannot land without tripping tier-1 (the
+    scheduler.rung program is exactly where one-ulp drift would silently
+    break the device-vs-host bitwise pin)."""
+    paths = [os.path.join(REPO, p) for p in engine.DEFAULT_SCOPE]
+    scanned = {
+        os.path.relpath(p, REPO).replace(os.sep, "/")
+        for p in engine.iter_py_files(paths)
+    }
+    assert "photon_ml_tpu/optim/fused_schedule.py" in scanned
+    assert "photon_ml_tpu/optim/scheduler.py" in scanned
+    assert "photon_ml_tpu/compile/overrides.py" in scanned
+
+
 def test_fleet_package_in_scan_scope():
     """The serving-fleet package (PR 11) is inside the default scan scope,
     module by module — a bare jit, broad except, or unregistered fault
@@ -367,7 +383,9 @@ def test_registry_parse_matches_runtime_module():
         "io.read_block", "io.checkpoint_write", "io.cache_read",
         "multihost.barrier", "optim.step", "preempt.signal",
     } <= set(sites.FAULT_SITES)
-    assert set(sites.PREEMPT_SITES) == {"cycle", "block", "chunk", "bucket"}
+    assert set(sites.PREEMPT_SITES) == {
+        "cycle", "block", "chunk", "bucket", "rung",
+    }
 
 
 # ---------------------------------------------------------------------------

@@ -36,7 +36,6 @@ ALLOWLIST = {
     # policy resolvers consumed once by ExecutionPlan.resolve (the env
     # read is already plan-visible through the resolved policy object)
     "photon_ml_tpu/optim/convergence.py:resolve_adaptive": "plan-visible via resolve()",
-    "photon_ml_tpu/optim/scheduler.py:resolve_schedule": "plan-visible via resolve()",
     "photon_ml_tpu/ops/fused_sparse.py:resolve_sparse_kernel": "plan-visible via resolve()",
     "photon_ml_tpu/io/pipeline.py:resolve_depth": "plan-visible via resolve()",
     # kernel-local autotune mode (oracle/manual/auto race selection): a
