@@ -4464,6 +4464,56 @@ def _bench_quantized_serving(extra, on_tpu):
         shutil.rmtree(tmp, ignore_errors=True)
 
 
+def _bench_day_in_life(extra, on_tpu):
+    """One compressed day of serving life under a single enforced error
+    budget (tools/day_in_life.py): a diurnal traffic curve from a
+    synthetic multi-million-user population rides through a REAL delta
+    retrain (--warm-start-from) -> quantized store export -> provenance-
+    gated fleet-wide rollout, an elasticity event (owner kill -9 against
+    live TCP replicas + membership replan with scale-up), seeded chaos at
+    the registered fault sites, and a rolling f32->bf16 dtype migration
+    (mixed-dtype refusal, then a clean same-dtype roll). Every phase runs
+    against its declared SLO; the phase-attributed ledger IS the section
+    capture — the run fails loudly (SLOViolation) if any phase breaks its
+    p50/p99, overspends its error budget, or exhibits a degradation kind
+    its SLO never declared."""
+    import shutil
+    import tempfile
+
+    sys.path.insert(0, os.path.join(os.path.dirname(
+        os.path.abspath(__file__)), "tools"))
+    from day_in_life import DayConfig, run_day
+
+    # env knob downsizes the per-phase wall for smoke runs (the full-fat
+    # arms — real retrain, TCP kill — stay on; only the traffic window
+    # shrinks, exactly like PHOTON_BENCH_268M_ENTITIES)
+    phase_seconds = float(os.environ.get("PHOTON_BENCH_DAY_SECONDS", 3.0))
+    tmp = tempfile.mkdtemp(prefix="bench-day-in-life-")
+    try:
+        result = run_day(DayConfig(
+            out_dir=tmp,
+            phase_seconds=phase_seconds,
+            peak_qps=120.0,
+            traffic_threads=3,
+            real_retrain=True,
+            kill_arm=True,
+        ))
+        ledger = result["ledger"]
+        _log(
+            f"day_in_life: ok={ledger['ok']} "
+            f"{ledger['totals']['requests']} requests, "
+            f"{sum(ledger['totals']['degradations'].values())} attributed "
+            f"degradations, {ledger['totals']['bytes_moved']}B moved"
+        )
+        extra["day_in_life"] = {
+            "phase_seconds": phase_seconds,
+            "ledger": ledger,
+            "harness": result["extra"],
+        }
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
 SECTION_ORDER = (
     "dense", "sparse", "sparse_race", "game", "game5", "grid",
     "streaming", "streaming_pipeline", "compile_reuse", "compaction",
@@ -4476,6 +4526,7 @@ SECTION_ORDER = (
     "quantized_serving",
     "retrain_delta",
     "delta_rollout",
+    "day_in_life",
     "ingest",
 )
 # orchestrator per-section deadlines (s): generous — tunnel compiles are slow,
@@ -4509,7 +4560,11 @@ SECTION_DEADLINES = {"dense": 3600, "game": 3600, "game5": 2400, "grid": 2400,
                      "quantized_serving": 1800,
                      # 2 model generations (exports + oracles) + an
                      # in-process 2-replica fleet + the traffic'd roll
-                     "delta_rollout": 1800}
+                     "delta_rollout": 1800,
+                     # a full compressed day: real delta retrain + TCP
+                     # replica spawns (kill arm) + 6 traffic'd phases +
+                     # 4 store exports — each piece individually fenced
+                     "day_in_life": 3600}
 DEFAULT_SECTION_DEADLINE = 1800
 
 
@@ -4672,6 +4727,8 @@ def _run_sections(names, extra, errors, on_tpu, state=None, after=None):
                 _bench_retrain_delta(extra, on_tpu)
             elif name == "delta_rollout":
                 _bench_delta_rollout(extra, on_tpu)
+            elif name == "day_in_life":
+                _bench_day_in_life(extra, on_tpu)
             elif name == "ingest":
                 _bench_ingest(extra)
         except Exception:  # noqa: BLE001 — per-section fence: failure recorded in errors, bench continues

@@ -8,9 +8,13 @@ the serve driver logs next to ``compile_stats.summary()``.
 What gets recorded:
 
   * per REQUEST: end-to-end latency (submit -> response ready), row count.
-    Latencies keep a bounded reservoir (newest ``max_samples``) so a
-    long-lived server's percentiles track recent behavior without
-    unbounded memory.
+    Latencies feed a bounded-memory streaming digest
+    (:class:`photon_ml_tpu.slo.quantiles.StreamingQuantileDigest`):
+    exact nearest-rank percentiles up to ``max_samples`` raw samples
+    (bit-identical to the old sorted-deque accounting), then O(1) P²
+    estimation over EVERY sample since the last reset — a day-long
+    million-request run keeps honest p50/p99 without holding a latency
+    per request or silently windowing to the newest samples.
   * per BATCH: real rows vs ladder-padded rows (the fill ratio — how much
     of each canonical executable's work was real) and the number of
     requests coalesced into it (avg requests/batch is THE number the
@@ -22,16 +26,9 @@ from __future__ import annotations
 
 import threading
 import time
-from collections import deque
 from typing import Dict, Optional
 
-
-def _percentile(sorted_vals, q: float) -> float:
-    """Nearest-rank percentile over an already-sorted list."""
-    if not sorted_vals:
-        return 0.0
-    idx = min(int(q * (len(sorted_vals) - 1) + 0.5), len(sorted_vals) - 1)
-    return sorted_vals[idx]
+from photon_ml_tpu.slo.quantiles import StreamingQuantileDigest
 
 
 class ServeStats:
@@ -40,7 +37,13 @@ class ServeStats:
 
     def __init__(self, max_samples: int = 100_000):
         self._lock = threading.Lock()
-        self._latencies = deque(maxlen=max_samples)
+        # max_samples bounds the EXACT regime: up to that many raw
+        # latencies are kept (and percentiles are exact nearest-rank,
+        # the historical behavior); past it the digest flips to P²
+        # markers seeded from the exact sample and memory stays O(1)
+        self._latencies = StreamingQuantileDigest(
+            (0.50, 0.99), exact_limit=max_samples
+        )
         self.requests = 0
         self.rows = 0
         self.batches = 0
@@ -62,7 +65,7 @@ class ServeStats:
     def record_request(self, latency_s: float, num_rows: int = 1) -> None:
         now = time.monotonic()
         with self._lock:
-            self._latencies.append(latency_s)
+            self._latencies.add(latency_s)
             self.requests += 1
             self.rows += num_rows
             if self._first_ts is None:
@@ -99,7 +102,6 @@ class ServeStats:
     # -- reading ------------------------------------------------------------
     def snapshot(self) -> Dict[str, float]:
         with self._lock:
-            lat = sorted(self._latencies)
             span = (
                 (self._last_ts - self._first_ts)
                 if self._first_ts is not None and self._last_ts is not None
@@ -110,8 +112,8 @@ class ServeStats:
                 "rows": self.rows,
                 "errors": self.errors,
                 "batches": self.batches,
-                "p50_ms": round(_percentile(lat, 0.50) * 1e3, 3),
-                "p99_ms": round(_percentile(lat, 0.99) * 1e3, 3),
+                "p50_ms": round(self._latencies.quantile(0.50) * 1e3, 3),
+                "p99_ms": round(self._latencies.quantile(0.99) * 1e3, 3),
                 "qps": round(self.requests / span, 1) if span > 0 else 0.0,
                 "rows_per_sec": round(self.rows / span, 1) if span > 0 else 0.0,
                 "batch_fill_ratio": (
@@ -138,7 +140,7 @@ class ServeStats:
 
     def reset(self) -> None:
         with self._lock:
-            self._latencies.clear()
+            self._latencies.reset()
             self.requests = 0
             self.rows = 0
             self.batches = 0

@@ -30,7 +30,7 @@ def test_list_sections_enumerates_all_sections():
         "perhost", "perhost_streaming", "elastic_reshard", "scoring",
         "serving",
         "serving_fleet", "quantized_serving", "retrain_delta",
-        "delta_rollout", "ingest",
+        "delta_rollout", "day_in_life", "ingest",
     ]
 
 

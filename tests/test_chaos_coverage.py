@@ -1,0 +1,158 @@
+"""Chaos-coverage gate: every registered fault and preemption site must
+be exercised by at least one chaos test.
+
+The resilience registry (photon_ml_tpu/resilience/sites.py) is the
+contract photon_lint enforces on the PRODUCTION side: an inject() call
+against an unregistered site fails lint. This gate closes the TEST side:
+a site someone registers (and wires into production code) without ever
+pointing a FaultSpec / PHOTON_FAULTS grammar / PHOTON_PREEMPT_AT plan at
+it is dead chaos — the failure path ships unexercised. The scan matches
+the concrete idioms the suite uses to aim chaos at a site:
+
+  * ``FaultSpec("io.read_block", ...)`` / ``faults.inject("optim.step"``
+  * the env grammar: ``"io.block_transfer:rate=1.0,seed=5"``
+  * preemption plans: ``install_plan({"rung": 1})`` / ``"cycle:3"``
+
+test_photon_lint.py is excluded — it enumerates the registry by name
+without exercising anything, and counting it would let a site pass the
+gate on bookkeeping alone.
+
+Sites that genuinely CANNOT be reached from a single-process test may be
+exempted below with a recorded reason; an exemption for a site the scan
+DOES find covered fails the gate too (stale exemptions rot the list).
+"""
+
+import os
+import re
+
+from photon_ml_tpu.resilience.sites import FAULT_SITES, PREEMPT_SITES
+
+TESTS_DIR = os.path.dirname(os.path.abspath(__file__))
+REPO_ROOT = os.path.dirname(TESTS_DIR)
+
+#: test files that NAME sites without exercising them (registry audits),
+#: plus this gate itself — never counted as coverage
+_REGISTRY_ONLY = {"test_photon_lint.py", "test_chaos_coverage.py"}
+
+#: site -> reason, for fault sites only exercisable with >1 real process.
+#: Every current site is coverable single-process (subprocess harnesses
+#: included), so the list is empty — the structure stays so the NEXT
+#: multi-process-only site records WHY it is exempt instead of silently
+#: shrinking the gate.
+EXEMPT_FAULT_SITES = {}
+
+#: same, for preemption sites
+EXEMPT_PREEMPT_SITES = {}
+
+#: the fault sites the day-in-the-life harness must seed chaos at
+#: (ISSUE/ROADMAP floor for the lifecycle run — the sites a real day
+#: actually crosses: routing, scatter, the swap barrier, membership,
+#: elastic block transfer)
+DAY_IN_LIFE_REQUIRED_SITES = (
+    "serve.route",
+    "serve.replica_scatter",
+    "serve.fleet_swap_barrier",
+    "multihost.membership",
+    "io.block_transfer",
+)
+
+
+def _chaos_test_sources():
+    """filename -> source for every test module that may exercise chaos."""
+    out = {}
+    for name in sorted(os.listdir(TESTS_DIR)):
+        if not name.endswith(".py") or name in _REGISTRY_ONLY:
+            continue
+        with open(os.path.join(TESTS_DIR, name)) as f:
+            out[name] = f.read()
+    return out
+
+
+def _fault_site_pattern(site):
+    # a quoted site name followed by a closing quote (FaultSpec/inject
+    # call) or a grammar separator (the PHOTON_FAULTS env spec)
+    return re.compile(r"[\"']" + re.escape(site) + r"[\"':@,]")
+
+
+def _preempt_site_pattern(site):
+    # a quoted bare site (install_plan key, .site assertion) or the
+    # PHOTON_PREEMPT_AT "site:N" grammar
+    return re.compile(r"[\"']" + re.escape(site) + r"(:\d+)?[\"']")
+
+
+def test_every_fault_site_has_a_chaos_test():
+    sources = _chaos_test_sources()
+    uncovered = []
+    for site in sorted(FAULT_SITES):
+        if site in EXEMPT_FAULT_SITES:
+            continue
+        pat = _fault_site_pattern(site)
+        if not any(pat.search(src) for src in sources.values()):
+            uncovered.append(site)
+    assert not uncovered, (
+        f"fault sites registered but never exercised by any chaos test: "
+        f"{uncovered} — aim a FaultSpec/PHOTON_FAULTS at each, or record "
+        "a reasoned exemption in EXEMPT_FAULT_SITES"
+    )
+
+
+def test_every_preempt_site_has_a_chaos_test():
+    sources = _chaos_test_sources()
+    uncovered = []
+    for site in PREEMPT_SITES:
+        if site in EXEMPT_PREEMPT_SITES:
+            continue
+        pat = _preempt_site_pattern(site)
+        if not any(pat.search(src) for src in sources.values()):
+            uncovered.append(site)
+    assert not uncovered, (
+        f"preemption sites registered but never exercised by any test: "
+        f"{uncovered} — aim a PHOTON_PREEMPT_AT plan at each, or record "
+        "a reasoned exemption in EXEMPT_PREEMPT_SITES"
+    )
+
+
+def test_exemptions_name_real_sites_and_are_not_stale():
+    """An exemption must (a) name a registered site and (b) still be
+    NEEDED — a site that is exempt AND covered is a stale entry hiding
+    future regressions."""
+    unknown = [s for s in EXEMPT_FAULT_SITES if s not in FAULT_SITES]
+    unknown += [s for s in EXEMPT_PREEMPT_SITES if s not in PREEMPT_SITES]
+    assert not unknown, f"exemptions name unregistered sites: {unknown}"
+    sources = _chaos_test_sources()
+    stale = [
+        site for site in EXEMPT_FAULT_SITES
+        if any(_fault_site_pattern(site).search(s) for s in sources.values())
+    ]
+    stale += [
+        site for site in EXEMPT_PREEMPT_SITES
+        if any(
+            _preempt_site_pattern(site).search(s) for s in sources.values()
+        )
+    ]
+    assert not stale, (
+        f"exempted sites are ALSO covered by tests — remove the stale "
+        f"exemptions: {stale}"
+    )
+
+
+def test_day_in_life_seeds_chaos_at_the_required_sites():
+    """The lifecycle harness must seed chaos at every site a real day
+    crosses — the floor is pinned so a refactor cannot quietly drop one
+    of the arms."""
+    with open(os.path.join(REPO_ROOT, "tools", "day_in_life.py")) as f:
+        src = f.read()
+    missing = [
+        site for site in DAY_IN_LIFE_REQUIRED_SITES
+        if not _fault_site_pattern(site).search(src)
+    ]
+    assert not missing, (
+        f"tools/day_in_life.py no longer seeds chaos at {missing}"
+    )
+
+
+def test_required_day_sites_are_registered():
+    missing = [
+        s for s in DAY_IN_LIFE_REQUIRED_SITES if s not in FAULT_SITES
+    ]
+    assert not missing, f"required day sites not in FAULT_SITES: {missing}"

@@ -721,6 +721,128 @@ class TestFleetChaos:
 
 
 # ---------------------------------------------------------------------------
+# Degradation accounting: every degraded response counted EXACTLY once
+# ---------------------------------------------------------------------------
+
+
+class _SlowClient(LocalReplicaClient):
+    """In-process client that answers after ``delay_s`` (or dies after the
+    delay with ``then_fail``) — drives the router's hedge window."""
+
+    def __init__(self, engine, delay_s=0.0, then_fail=False):
+        super().__init__(engine)
+        self.delay_s = delay_s
+        self.then_fail = then_fail
+
+    def call(self, msg, timeout=None):
+        time.sleep(self.delay_s)
+        if self.then_fail:
+            from photon_ml_tpu.serve.fleet import ReplicaUnavailableError
+
+            raise ReplicaUnavailableError("slow replica died")
+        return super().call(msg, timeout)
+
+
+class TestDegradationAccounting:
+    """The SLO ledger auto-attributes FleetStats counter deltas, so the
+    counters must be EXACT: a degraded row counted twice inflates the
+    error story, one counted zero times is a silent degradation. These
+    pin the exactly-once contract through the router's three fallback
+    paths (retry-then-degrade, circuit-open skip, hedged fallback)."""
+
+    def _owners(self, world):
+        plan = ServeShardPlan.from_json(world["meta"]["plan"])
+        return np.asarray(plan.owners_of(
+            [q["ids"]["userId"] for q in world["requests"]]
+        ))
+
+    def _cold_refs(self, world):
+        server = _single_server(world)
+        ref = server.score_rows(world["requests"])
+        cold_ref = server.score_rows(
+            [dict(q, ids={}) for q in world["requests"]]
+        )
+        server.close()
+        return ref, cold_ref
+
+    def test_retry_then_degrade_counts_each_row_exactly_once(
+        self, fleet_world
+    ):
+        """A dead owner burns a routed retry BEFORE degrading; the retry
+        must not double-count the rows that then degrade — the counter
+        delta equals the number of rows the dead replica owned, exactly."""
+        ref, cold_ref = self._cold_refs(fleet_world)
+        owners = self._owners(fleet_world)
+        owned_by_1 = int(np.sum(owners == 1))
+        assert owned_by_1 > 0  # the fixture shards both ways
+
+        router, engines, clients = _local_fleet(fleet_world)
+        clients[1].fail_mode = "killed"
+        served = router.score_rows(fleet_world["requests"])
+        snap = router.stats.snapshot()
+        # the retry fired AND the degraded rows counted once — not once
+        # per attempt
+        assert snap["routed_retries"] >= 1
+        assert snap["degraded_rows"] == owned_by_1
+        for i in range(len(served)):
+            assert served[i] == (ref[i] if owners[i] == 0 else cold_ref[i])
+
+        # second request: the circuit is now open, rows degrade via the
+        # dead-owner path (no retry) — still exactly once per owned row
+        router.score_rows(fleet_world["requests"])
+        snap2 = router.stats.snapshot()
+        assert snap2["degraded_rows"] == 2 * owned_by_1
+        assert snap2["dead_replica_skips"] >= 1
+        _close_fleet(router, engines)
+
+    def test_slow_owner_hedge_wins_primary_no_degradation(
+        self, fleet_world
+    ):
+        """A slow-but-alive owner trips the hedge window; the owner's
+        reply still wins (it carries the random parts), so hedges
+        increment but degraded_rows must NOT."""
+        ref, _ = self._cold_refs(fleet_world)
+        engines = _engines(fleet_world["fleet_dir"])
+        clients = [LocalReplicaClient(engines[0]),
+                   _SlowClient(engines[1], delay_s=0.15)]
+        router = FleetRouter(
+            load_fleet_meta(fleet_world["fleet_dir"]), clients,
+            stats=FleetStats(), hedge_ms=20.0,
+        )
+        served = router.score_rows(fleet_world["requests"])
+        snap = router.stats.snapshot()
+        assert snap["hedges"] >= 1
+        assert snap["degraded_rows"] == 0
+        np.testing.assert_array_equal(served, ref)
+        _close_fleet(router, engines)
+
+    def test_hedged_fallback_counts_hedge_and_degraded_once(
+        self, fleet_world
+    ):
+        """The owner misses the hedge window AND then dies: the hedge's
+        fixed-only answer serves, the hedge counts once, and the owner's
+        random rows degrade exactly once (no retry double-count)."""
+        ref, cold_ref = self._cold_refs(fleet_world)
+        owners = self._owners(fleet_world)
+        owned_by_1 = int(np.sum(owners == 1))
+
+        engines = _engines(fleet_world["fleet_dir"])
+        clients = [LocalReplicaClient(engines[0]),
+                   _SlowClient(engines[1], delay_s=0.15, then_fail=True)]
+        router = FleetRouter(
+            load_fleet_meta(fleet_world["fleet_dir"]), clients,
+            stats=FleetStats(), hedge_ms=20.0,
+        )
+        served = router.score_rows(fleet_world["requests"])
+        snap = router.stats.snapshot()
+        assert snap["hedges"] == 1
+        assert snap["degraded_rows"] == owned_by_1
+        for i in range(len(served)):
+            assert served[i] == (ref[i] if owners[i] == 0 else cold_ref[i])
+        _close_fleet(router, engines)
+
+
+# ---------------------------------------------------------------------------
 # Smoothed-hinge SVM through the fleet (scenario-diversity satellite)
 # ---------------------------------------------------------------------------
 

@@ -1,0 +1,506 @@
+"""Day-in-the-life SLO machinery tests (photon_ml_tpu/slo + tools/day_in_life).
+
+Covers the acceptance claims:
+
+  * Streaming quantiles: the hybrid digest is BIT-IDENTICAL to the exact
+    nearest-rank percentile while inside ``exact_limit`` (the old
+    sorted-deque behavior every existing ServeStats assertion relies on),
+    and within tight relative error of the true percentile over a
+    200k-sample stream it could never hold in memory.
+  * SLO spec validation: unknown degradation kinds, inverted latency
+    bounds, and out-of-range budgets are refused at declaration time.
+  * The ledger: per-phase attribution, the FleetStats counter-delta
+    auto-attribution (a counter that moved without a declaration CANNOT
+    escape), and every violation rule the enforce() gate checks.
+  * The mini day: a full 6-phase lifecycle run (swap chaos, delta
+    rollout, elasticity replan, dtype migration) completes with zero
+    violations and banks the sidecar — the tier-1 sibling of the
+    slow-marked full-fat day (real delta retrain + TCP kill arm).
+"""
+
+import json
+import os
+import sys
+
+import numpy as np
+import pytest
+
+from photon_ml_tpu.slo import (
+    DEGRADATION_KINDS,
+    FLEET_COUNTER_KINDS,
+    SLO_LEDGER_FILE,
+    PhaseSLO,
+    SLOLedger,
+    SLOSpec,
+    SLOViolation,
+    StreamingQuantileDigest,
+    exact_percentile,
+)
+from photon_ml_tpu.slo.quantiles import P2Quantile
+
+pytestmark = pytest.mark.slo
+
+sys.path.insert(
+    0,
+    os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "tools"
+    ),
+)
+
+
+# ---------------------------------------------------------------------------
+# streaming quantiles
+# ---------------------------------------------------------------------------
+
+
+class TestStreamingQuantiles:
+    def test_exact_regime_bit_identical_to_nearest_rank(self):
+        """Inside exact_limit the digest IS the old sorted-path formula —
+        bitwise, for every tracked and untracked q."""
+        rng = np.random.default_rng(3)
+        vals = rng.lognormal(sigma=1.0, size=400).tolist()
+        d = StreamingQuantileDigest((0.50, 0.99), exact_limit=1000)
+        for v in vals:
+            d.add(v)
+        assert d.exact
+        srt = sorted(vals)
+        for q in (0.10, 0.50, 0.90, 0.99):
+            assert d.quantile(q) == exact_percentile(srt, q)
+
+    def test_streaming_regime_tracks_true_percentiles(self):
+        """200k samples through a 1000-sample buffer: P² stays within 1%
+        (p50) / 2% (p99) of the true percentile — the digest never
+        windows to the newest samples."""
+        rng = np.random.default_rng(7)
+        vals = rng.lognormal(mean=0.0, sigma=0.6, size=200_000)
+        d = StreamingQuantileDigest((0.50, 0.99), exact_limit=1000)
+        for v in vals:
+            d.add(v)
+        assert not d.exact
+        assert d.count == 200_000
+        for q, tol in ((0.50, 0.01), (0.99, 0.02)):
+            true = float(np.percentile(vals, q * 100))
+            assert abs(d.quantile(q) - true) / true < tol
+
+    def test_flip_happens_exactly_past_the_limit(self):
+        d = StreamingQuantileDigest((0.50,), exact_limit=10)
+        for i in range(10):
+            d.add(float(i))
+        assert d.exact
+        d.add(10.0)
+        assert not d.exact
+        # estimator regime only knows the tracked quantiles
+        with pytest.raises(KeyError):
+            d.quantile(0.75)
+        assert d.quantile(0.50) > 0.0
+
+    def test_reset_returns_to_exact(self):
+        d = StreamingQuantileDigest((0.50,), exact_limit=5)
+        for i in range(20):
+            d.add(float(i))
+        assert not d.exact
+        d.reset()
+        assert d.count == 0
+        assert d.quantile(0.50) == 0.0
+        d.add(3.0)
+        assert d.exact and d.quantile(0.50) == 3.0
+
+    def test_validation(self):
+        with pytest.raises(ValueError):
+            StreamingQuantileDigest((0.5,), exact_limit=4)
+        with pytest.raises(ValueError):
+            P2Quantile.from_sorted(0.5, [1.0, 2.0, 3.0])
+        with pytest.raises(ValueError):
+            P2Quantile(1.5, [0] * 5, [1, 2, 3, 4, 5])
+
+    def test_empty_digest_answers_zero(self):
+        assert StreamingQuantileDigest().quantile(0.99) == 0.0
+
+    def test_serve_stats_small_sample_agreement(self):
+        """ServeStats (now digest-backed) must report the SAME p50/p99 the
+        exact sorted path always computed for small samples — pinned
+        against exact_percentile on the identical latency list."""
+        from photon_ml_tpu.serve import ServeStats
+
+        rng = np.random.default_rng(11)
+        lats = rng.lognormal(mean=-6.0, sigma=0.8, size=500).tolist()
+        stats = ServeStats()
+        for lat in lats:
+            stats.record_request(lat)
+        snap = stats.snapshot()
+        srt = sorted(lats)
+        assert snap["p50_ms"] == round(exact_percentile(srt, 0.50) * 1e3, 3)
+        assert snap["p99_ms"] == round(exact_percentile(srt, 0.99) * 1e3, 3)
+
+
+# ---------------------------------------------------------------------------
+# spec validation
+# ---------------------------------------------------------------------------
+
+
+class TestSpec:
+    def test_unknown_degradation_kind_refused(self):
+        with pytest.raises(ValueError, match="unknown degradation"):
+            PhaseSLO("p", p50_ms=1, p99_ms=2, allowed_degradations=("nope",))
+
+    def test_inverted_latency_refused(self):
+        with pytest.raises(ValueError, match="p50 <= p99"):
+            PhaseSLO("p", p50_ms=5, p99_ms=2)
+
+    def test_bad_budgets_refused(self):
+        with pytest.raises(ValueError, match="fraction"):
+            PhaseSLO("p", p50_ms=1, p99_ms=2, error_budget=1.5)
+        with pytest.raises(ValueError, match="staleness"):
+            PhaseSLO("p", p50_ms=1, p99_ms=2, staleness_budget=-1)
+
+    def test_duplicate_phase_refused(self):
+        p = PhaseSLO("p", p50_ms=1, p99_ms=2)
+        with pytest.raises(ValueError, match="duplicate"):
+            SLOSpec([p, p])
+
+    def test_undeclared_phase_lookup_fails(self):
+        spec = SLOSpec([PhaseSLO("a", p50_ms=1, p99_ms=2)])
+        with pytest.raises(KeyError, match="no declared SLO"):
+            spec.phase("b")
+
+    def test_json_roundtrip(self, tmp_path):
+        spec = SLOSpec([
+            PhaseSLO(
+                "peak", p50_ms=10, p99_ms=100, error_budget=0.05,
+                staleness_budget=3,
+                allowed_degradations=("hedged_fallback",),
+                chaos_window=True,
+            ),
+            PhaseSLO("drain", p50_ms=5, p99_ms=50),
+        ])
+        path = str(tmp_path / "spec.json")
+        spec.save(path)
+        loaded = SLOSpec.load(path)
+        assert loaded.to_json() == spec.to_json()
+        assert loaded.phase("peak").chaos_window is True
+
+    def test_every_fleet_counter_kind_is_registered(self):
+        for kind in FLEET_COUNTER_KINDS.values():
+            assert kind in DEGRADATION_KINDS
+
+
+# ---------------------------------------------------------------------------
+# the ledger + the gate
+# ---------------------------------------------------------------------------
+
+
+def _spec(**kw):
+    defaults = dict(p50_ms=1e6, p99_ms=1e6)
+    defaults.update(kw)
+    return SLOSpec([PhaseSLO("phase", **defaults)])
+
+
+class TestLedger:
+    def test_phase_protocol_enforced(self):
+        led = SLOLedger(_spec())
+        with pytest.raises(RuntimeError, match="no phase open"):
+            led.record_request(0.001)
+        led.begin_phase("phase")
+        with pytest.raises(RuntimeError, match="still open"):
+            led.begin_phase("phase")
+        with pytest.raises(RuntimeError, match="still open"):
+            led.finalize()
+        led.end_phase()
+        led.enforce()
+
+    def test_clean_phase_passes(self):
+        led = SLOLedger(_spec(p50_ms=100, p99_ms=200))
+        led.begin_phase("phase")
+        for _ in range(50):
+            led.record_request(0.001, num_rows=2)
+        rec = led.end_phase()
+        assert rec["requests"] == 50 and rec["rows"] == 100
+        assert rec["violations"] == []
+        payload = led.enforce()
+        assert payload["ok"] is True
+
+    def test_p99_violation_detected(self):
+        led = SLOLedger(_spec(p50_ms=0.4, p99_ms=0.5))
+        led.begin_phase("phase")
+        for _ in range(100):
+            led.record_request(0.001)  # 1ms > 0.5ms p99
+        led.end_phase()
+        with pytest.raises(SLOViolation, match="p99"):
+            led.enforce()
+
+    def test_error_budget_spend(self):
+        led = SLOLedger(_spec(error_budget=0.10))
+        led.begin_phase("phase")
+        for _ in range(100):
+            led.record_request(0.001)
+        led.record_error(5)
+        rec = led.end_phase()
+        assert rec["error_budget"]["spend"] == pytest.approx(0.05)
+        assert rec["error_budget"]["used"] == pytest.approx(0.5)
+        led.enforce()
+
+        led2 = SLOLedger(_spec(error_budget=0.01))
+        led2.begin_phase("phase")
+        for _ in range(100):
+            led2.record_request(0.001)
+        led2.record_error(5)
+        led2.end_phase()
+        with pytest.raises(SLOViolation, match="error-budget"):
+            led2.enforce()
+
+    def test_drops_outside_chaos_window_fail_even_in_budget(self):
+        led = SLOLedger(_spec(error_budget=0.5, chaos_window=False))
+        led.begin_phase("phase")
+        for _ in range(100):
+            led.record_request(0.001)
+        led.record_drop()
+        led.end_phase()
+        with pytest.raises(SLOViolation, match="outside a declared chaos"):
+            led.enforce()
+
+        led2 = SLOLedger(_spec(error_budget=0.5, chaos_window=True))
+        led2.begin_phase("phase")
+        for _ in range(100):
+            led2.record_request(0.001)
+        led2.record_drop()
+        led2.end_phase()
+        led2.enforce()  # charged to the budget instead
+
+    def test_staleness_budget(self):
+        led = SLOLedger(_spec(staleness_budget=2))
+        led.begin_phase("phase")
+        led.record_request(0.001)
+        led.mark_flip(1)
+        led.record_stale_answer(3)
+        rec = led.end_phase()
+        assert rec["flip_generation"] == 1
+        with pytest.raises(SLOViolation, match="staleness budget"):
+            led.enforce()
+
+    def test_mixed_generation_always_fails(self):
+        led = SLOLedger(_spec())
+        led.begin_phase("phase")
+        led.record_request(0.001)
+        led.record_mixed_generation()
+        led.end_phase()
+        with pytest.raises(SLOViolation, match="mixed-generation"):
+            led.enforce()
+
+    def test_divergence_always_fails(self):
+        led = SLOLedger(_spec())
+        led.begin_phase("phase")
+        led.record_request(0.001)
+        led.record_divergence()
+        led.end_phase()
+        with pytest.raises(SLOViolation, match="bitwise oracle"):
+            led.enforce()
+
+    def test_undeclared_degradation_fails_at_count_one(self):
+        led = SLOLedger(_spec(allowed_degradations=()))
+        led.begin_phase("phase")
+        led.record_request(0.001)
+        led.attribute("swap_abort_chaos", detail="injected barrier fault")
+        rec = led.end_phase()
+        assert rec["degradation_details"] == [
+            "swap_abort_chaos: injected barrier fault"
+        ]
+        with pytest.raises(SLOViolation, match="undeclared degradation"):
+            led.enforce()
+
+        led2 = SLOLedger(_spec(allowed_degradations=("swap_abort_chaos",)))
+        led2.begin_phase("phase")
+        led2.record_request(0.001)
+        led2.attribute("swap_abort_chaos")
+        led2.end_phase()
+        led2.enforce()
+
+    def test_unknown_attribution_kind_is_a_programming_error(self):
+        led = SLOLedger(_spec())
+        led.begin_phase("phase")
+        with pytest.raises(ValueError, match="unknown degradation kind"):
+            led.attribute("not_a_kind")
+        led.end_phase()
+
+    def test_fleet_counter_deltas_auto_attributed(self):
+        """A FleetStats counter that moves during a phase lands in the
+        ledger WITHOUT any driver cooperation — the structural 'never
+        silent' rule. Undeclared, it fails the gate."""
+        from photon_ml_tpu.serve import FleetStats
+
+        stats = FleetStats()
+        stats.record_hedge()  # pre-phase activity must NOT be attributed
+        led = SLOLedger(_spec(allowed_degradations=("cold_entity_zero",)))
+        led.begin_phase("phase", stats=stats)
+        led.record_request(0.001)
+        stats.record_degraded_rows(4)
+        stats.record_routed_retry()
+        rec = led.end_phase()
+        assert rec["degradations"]["cold_entity_zero"] == 4
+        assert rec["degradations"]["chaos_absorbed_retry"] == 1
+        assert "hedged_fallback" not in rec["degradations"]
+        with pytest.raises(SLOViolation, match="chaos_absorbed_retry"):
+            led.enforce()
+
+    def test_sidecar_roundtrip(self, tmp_path):
+        led = SLOLedger(_spec())
+        led.begin_phase("phase")
+        led.record_request(0.002)
+        led.record_bytes_moved(1234)
+        led.end_phase()
+        path = led.write(str(tmp_path))
+        assert os.path.basename(path) == SLO_LEDGER_FILE
+        with open(path) as f:
+            payload = json.load(f)
+        assert payload["format"] == 1
+        assert payload["ok"] is True
+        assert payload["totals"]["bytes_moved"] == 1234
+
+    def test_sidecar_banked_even_over_budget(self, tmp_path):
+        """write() never enforces: an over-budget ledger is still banked
+        so fleetctl can show WHAT went over."""
+        led = SLOLedger(_spec())
+        led.begin_phase("phase")
+        led.record_request(0.001)
+        led.record_divergence()
+        led.end_phase()
+        path = led.write(str(tmp_path))
+        with open(path) as f:
+            payload = json.load(f)
+        assert payload["ok"] is False and payload["violations_total"] == 1
+
+
+# ---------------------------------------------------------------------------
+# fleetctl --slo aggregation
+# ---------------------------------------------------------------------------
+
+
+class TestFleetctlSLO:
+    def _bank(self, directory, *, divergent=0):
+        led = SLOLedger(
+            SLOSpec([PhaseSLO("peak", p50_ms=1e6, p99_ms=1e6)])
+        )
+        led.begin_phase("peak")
+        for _ in range(10):
+            led.record_request(0.001)
+        led.record_divergence(divergent)
+        if divergent:
+            led.attribute("swap_abort_chaos")  # undeclared -> 2nd violation
+        led.end_phase()
+        led.write(str(directory))
+
+    def test_aggregates_and_flags_over_budget(self, tmp_path):
+        import fleetctl
+
+        clean = tmp_path / "clean"
+        dirty = tmp_path / "dirty"
+        torn = tmp_path / "torn"
+        clean.mkdir(), dirty.mkdir(), torn.mkdir()
+        self._bank(clean)
+        self._bank(dirty, divergent=2)
+        (torn / SLO_LEDGER_FILE).write_text("{not json")
+
+        agg = fleetctl.read_slo_ledgers(
+            [str(clean), str(dirty), str(torn), str(tmp_path / "absent")]
+        )
+        assert agg["sidecars"] == 2
+        assert agg["unreadable"] == 1
+        assert agg["requests"] == 20
+        assert agg["ok"] is False
+        assert agg["over_budget_total"] == 1
+        flagged = agg["over_budget"][0]
+        assert flagged["phase"] == "peak"
+        assert any("diverged" in v for v in flagged["violations"])
+        # per-phase totals merged across sidecars
+        assert agg["phases"]["peak"]["requests"] == 20
+        assert agg["phases"]["peak"]["violations"] == 2
+
+    def test_nothing_scanned_returns_none(self, tmp_path):
+        import fleetctl
+
+        assert fleetctl.read_slo_ledgers([str(tmp_path)]) is None
+        assert fleetctl.read_slo_ledgers([]) is None
+
+
+# ---------------------------------------------------------------------------
+# the mini day (tier-1) and the full-fat day (slow sibling)
+# ---------------------------------------------------------------------------
+
+#: the lifecycle attributions every day run must exhibit — one per arm
+LIFECYCLE_KINDS = (
+    "swap_abort_chaos",
+    "rollout_abort_chaos",
+    "mixed_dtype_refusal",
+    "migration_compiles",
+    "chaos_absorbed_retry",
+)
+
+
+def _assert_day_result(result, out_dir):
+    led = result["ledger"]
+    assert led["ok"] is True, led
+    assert led["violations_total"] == 0
+    names = [p["name"] for p in led["phases"]]
+    assert names == [
+        "morning_ramp", "midday_peak", "retrain_window",
+        "elastic_event", "dtype_migration", "night_drain",
+    ]
+    for p in led["phases"]:
+        assert p["requests"] > 0, p["name"]
+        assert p["p99_ms"] >= p["p50_ms"] > 0.0, p["name"]
+    degr = led["totals"]["degradations"]
+    for kind in LIFECYCLE_KINDS:
+        assert degr.get(kind, 0) >= 1, (kind, degr)
+    assert led["totals"]["mixed_generation"] == 0
+    assert led["totals"]["bytes_moved"] > 0
+    # the sidecar banked where fleetctl will look
+    with open(os.path.join(out_dir, SLO_LEDGER_FILE)) as f:
+        assert json.load(f)["ok"] is True
+    # population scale: millions declared, cold draws sampled from it
+    pop = result["extra"]["population"]
+    assert pop["universe"] >= 1_000_000
+
+
+class TestDayInLife:
+    def test_mini_day_end_to_end(self, tmp_path):
+        """The full 6-phase lifecycle — swap chaos, provenance-refused +
+        chaos-aborted + real delta rollout, elasticity replan under
+        membership/block-transfer chaos, mixed-dtype refusal + bf16
+        migration + clean same-dtype roll — under one enforced error
+        budget, downsized to tier-1 wall (synthetic models, in-process
+        replicas). The slow sibling below runs the full-fat arms."""
+        from day_in_life import DayConfig, run_day
+
+        result = run_day(DayConfig(
+            out_dir=str(tmp_path),
+            real_retrain=False,
+            kill_arm=False,
+            phase_seconds=1.0,
+            peak_qps=60.0,
+            traffic_threads=2,
+            cold_pool=8,
+            exact_limit=512,
+        ))
+        _assert_day_result(result, str(tmp_path))
+
+    @pytest.mark.slow
+    def test_full_day_real_retrain_and_kill_arm(self, tmp_path):
+        """Full-fat day: REAL delta retrain (--warm-start-from) under
+        traffic and the TCP replica kill -9 arm (heartbeat detection,
+        replica_killed attribution). Tier-1 sibling:
+        test_mini_day_end_to_end covers the same phase sequence with
+        synthetic models and in-process replicas."""
+        from day_in_life import DayConfig, run_day
+
+        result = run_day(DayConfig(
+            out_dir=str(tmp_path),
+            real_retrain=True,
+            kill_arm=True,
+            phase_seconds=2.0,
+            peak_qps=80.0,
+            traffic_threads=2,
+            cold_pool=12,
+        ))
+        _assert_day_result(result, str(tmp_path))
+        degr = result["ledger"]["totals"]["degradations"]
+        assert degr.get("replica_killed", 0) == 1
+        assert "elastic_heartbeat_detect_s" in result["extra"]

@@ -61,6 +61,11 @@ COST_MODEL_FILE = "cost-model.json"
 COST_MODEL_FORMAT = 1
 PLAN_DRIFT_THRESHOLD = 0.5  # mirrors compile/cost.py DRIFT_THRESHOLD
 PLAN_TOP_N = 5
+# shared on-disk contract with photon_ml_tpu/slo/ledger.py (day-in-the-life
+# SLO ledger sidecars banked next to each run — fleetctl reads only)
+SLO_LEDGER_FILE = "slo-ledger.json"
+SLO_LEDGER_FORMAT = 1
+SLO_TOP_N = 5
 
 
 class FleetctlError(RuntimeError):
@@ -360,9 +365,90 @@ def read_cost_models(plan_dirs: List[str]) -> Optional[dict]:
     }
 
 
+def read_slo_ledgers(slo_dirs: List[str]) -> Optional[dict]:
+    """Aggregate day-in-the-life SLO ledger sidecars (``slo-ledger.json``,
+    written by photon_ml_tpu/slo/ledger.py) under the given run output
+    dirs into one fleet view: per-phase request/error/degradation totals
+    and every phase that went over budget (any recorded violation, or an
+    error-budget spend past its declared budget). Torn/absent/
+    mis-formatted sidecars are counted but skipped — the ledger is read
+    here as telemetry; the hard gate already ran in the harness."""
+    phases: Dict[str, dict] = {}
+    over_budget: List[dict] = []
+    scanned = skipped = 0
+    for directory in slo_dirs:
+        try:
+            payload = _read_json(os.path.join(directory, SLO_LEDGER_FILE))
+        except (ValueError, OSError):
+            skipped += 1  # torn mid-write or unreadable: skip, but say so
+            continue
+        if (
+            not isinstance(payload, dict)
+            or payload.get("format") != SLO_LEDGER_FORMAT
+        ):
+            if payload is not None:
+                skipped += 1
+            continue
+        scanned += 1
+        for entry in payload.get("phases") or []:
+            if not isinstance(entry, dict):
+                continue
+            name = str(entry.get("name"))
+            agg = phases.setdefault(name, {
+                "requests": 0, "errors": 0, "drops": 0,
+                "stale_answers": 0, "violations": 0,
+                "worst_p99_ms": 0.0, "degradations": {},
+            })
+            agg["requests"] += int(entry.get("requests", 0) or 0)
+            agg["errors"] += int(entry.get("errors", 0) or 0)
+            agg["drops"] += int(entry.get("drops", 0) or 0)
+            agg["stale_answers"] += int(entry.get("stale_answers", 0) or 0)
+            violations = [str(v) for v in entry.get("violations") or []]
+            agg["violations"] += len(violations)
+            p99 = float(entry.get("p99_ms", 0) or 0)
+            if p99 > agg["worst_p99_ms"]:
+                agg["worst_p99_ms"] = p99
+            for kind, n in (entry.get("degradations") or {}).items():
+                agg["degradations"][str(kind)] = (
+                    agg["degradations"].get(str(kind), 0) + int(n)
+                )
+            budget = entry.get("error_budget") or {}
+            try:
+                spend = float(budget.get("spend", 0) or 0)
+                declared = float(budget.get("budget", 0) or 0)
+            except (TypeError, ValueError):
+                spend = declared = 0.0
+            if violations or spend > declared:
+                over_budget.append({
+                    "dir": os.path.abspath(directory),
+                    "phase": name,
+                    "spend": spend,
+                    "budget": declared,
+                    "violations": violations,
+                })
+    if scanned == 0 and skipped == 0:
+        return None
+    over_budget.sort(key=lambda e: (-len(e["violations"]), e["phase"]))
+    for agg in phases.values():
+        agg["degradations"] = dict(sorted(agg["degradations"].items()))
+    return {
+        "sidecars": scanned,
+        "unreadable": skipped,
+        "phases": {name: phases[name] for name in sorted(phases)},
+        "requests": sum(a["requests"] for a in phases.values()),
+        "degraded": sum(
+            sum(a["degradations"].values()) for a in phases.values()
+        ),
+        "over_budget": over_budget[:SLO_TOP_N],
+        "over_budget_total": len(over_budget),
+        "ok": not over_budget,
+    }
+
+
 def fleet_status(
     fleet_dir: str, block_dirs: Optional[List[str]] = None,
     plan_dirs: Optional[List[str]] = None,
+    slo_dirs: Optional[List[str]] = None,
 ) -> dict:
     """One JSON-able snapshot of the fleet's coordination state."""
     _require_fleet_dir(fleet_dir)
@@ -391,6 +477,7 @@ def fleet_status(
         read_convergence_ledgers(block_dirs) if block_dirs else None
     )
     status["plan"] = read_cost_models(plan_dirs) if plan_dirs else None
+    status["slo"] = read_slo_ledgers(slo_dirs) if slo_dirs else None
     return status
 
 
@@ -462,6 +549,26 @@ def _format_status(status: dict) -> str:
             )
         else:
             lines.append("plan drift: none above threshold")
+    slo = status.get("slo")
+    if slo is not None:
+        lines.append(
+            f"slo ledgers: {slo['sidecars']} sidecars "
+            f"({slo['unreadable']} unreadable); {slo['requests']} requests, "
+            f"{slo['degraded']} attributed degradations across "
+            f"{len(slo['phases'])} phases"
+        )
+        if slo["over_budget_total"]:
+            lines.append(
+                f"slo OVER BUDGET: {slo['over_budget_total']} phase "
+                "record(s); worst: " + ", ".join(
+                    f"{e['phase']}(spend={e['spend']:.2%} "
+                    f"budget={e['budget']:.2%}, "
+                    f"{len(e['violations'])} violations)"
+                    for e in slo["over_budget"]
+                )
+            )
+        else:
+            lines.append("slo: every phase within budget")
     return "\n".join(lines)
 
 
@@ -487,6 +594,13 @@ def main(argv: Optional[List[str]] = None) -> int:
                         "view: observation totals per policy and drift "
                         "entries where realized cost strayed from the "
                         "prediction past the threshold")
+    s.add_argument("--slo", action="append", default=[],
+                   metavar="DIR", dest="slo_dirs",
+                   help="run output dir holding a slo-ledger.json "
+                        "day-in-the-life sidecar (repeatable); adds the "
+                        "fleet-wide SLO view: per-phase request/"
+                        "degradation totals and every phase over its "
+                        "declared error budget")
 
     d = sub.add_parser(
         "declare-lost-hosts",
@@ -515,7 +629,7 @@ def main(argv: Optional[List[str]] = None) -> int:
         if args.cmd == "status":
             status = fleet_status(
                 args.fleet_dir, block_dirs=args.block_dirs,
-                plan_dirs=args.plan_dirs,
+                plan_dirs=args.plan_dirs, slo_dirs=args.slo_dirs,
             )
             print(
                 json.dumps(status, indent=1, sort_keys=True)
